@@ -1,0 +1,26 @@
+"""flink_tpu — a TPU-native stateful stream-processing framework.
+
+Capabilities of Apache Flink (reference: kenkenk13/flink), designed from
+scratch for JAX/XLA on TPU: keyed event-time windowed dataflows with
+exactly-once fault tolerance, where per-key window panes are dense
+``(key_shard, pane)`` tensors in HBM, aggregations are vectorized lane
+reductions, keyBy repartitioning is an ICI ``all_to_all``, and watermarks
+drive batched trigger evaluation on device. See SURVEY.md for the
+blueprint and the reference structure this mirrors.
+"""
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# Event-time is epoch milliseconds (int64) and keys are 64-bit — both
+# non-negotiable for a streaming framework, so x64 is enabled globally.
+# TPU supports s64; f64 (the TPU-unsupported width) never appears because
+# every float array in the framework is created as explicit float32 and
+# host float64 inputs are cast at the device boundary (records.device_cast).
+_jax.config.update("jax_enable_x64", True)
+
+from flink_tpu.config import Configuration
+from flink_tpu.records import RecordBatch, Schema
+
+__all__ = ["Configuration", "RecordBatch", "Schema", "__version__"]

@@ -1,0 +1,37 @@
+from flink_tpu.api.windowing import (
+    TumblingEventTimeWindows,
+    SlidingEventTimeWindows,
+    EventTimeSessionWindows,
+    GlobalWindows,
+    TimeWindow,
+    Trigger,
+    EventTimeTrigger,
+    CountTrigger,
+    PurgingTrigger,
+)
+from flink_tpu.api.functions import (
+    MapFunction,
+    FilterFunction,
+    FlatMapFunction,
+    ReduceFunction,
+    AggregateFunction,
+    ProcessWindowFunction,
+)
+
+__all__ = [
+    "TumblingEventTimeWindows",
+    "SlidingEventTimeWindows",
+    "EventTimeSessionWindows",
+    "GlobalWindows",
+    "TimeWindow",
+    "Trigger",
+    "EventTimeTrigger",
+    "CountTrigger",
+    "PurgingTrigger",
+    "MapFunction",
+    "FilterFunction",
+    "FlatMapFunction",
+    "ReduceFunction",
+    "AggregateFunction",
+    "ProcessWindowFunction",
+]

@@ -1,0 +1,138 @@
+"""User function interfaces.
+
+ref: flink-core/.../api/common/functions/{MapFunction,FilterFunction,
+FlatMapFunction,ReduceFunction,AggregateFunction}.java and
+streaming/api/functions/{ProcessFunction,windowing/ProcessWindowFunction}.
+
+TPU-first redesign: user functions are **jax-traceable batch functions**
+over struct-of-arrays record data — they get traced into the stage's
+compiled step function exactly once (the analogue of operator chaining +
+codegen; ref: StreamingJobGraphGenerator.isChainable fuses same-thread
+operators, here XLA fuses the traced ops). Scalar-style functions are
+supported via implicit vmap for convenience, but batch style is the
+native path.
+
+A "value" is a dict field→(B,) array (a RecordBatch's data view).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class MapFunction:
+    """1→1 transform (ref: MapFunction.java). Override ``map_batch`` for
+    the native vectorized path, or ``map`` for per-record (vmapped)."""
+
+    def map(self, value: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        raise NotImplementedError
+
+    def map_batch(self, values: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        return jax.vmap(self.map)(values)
+
+
+class FilterFunction:
+    """Keep rows where the predicate holds (ref: FilterFunction.java).
+    Lowered to a validity-mask AND — rows are never compacted on device
+    (static shapes); downstream ops skip invalid rows."""
+
+    def filter(self, value: Dict[str, jax.Array]) -> jax.Array:
+        raise NotImplementedError
+
+    def filter_batch(self, values: Dict[str, jax.Array]) -> jax.Array:
+        return jax.vmap(self.filter)(values)
+
+
+class FlatMapFunction:
+    """1→[0..k] transform with a STATIC max fan-out (ref: FlatMapFunction
+    .java). Dynamic output counts can't exist under jit; emit up to
+    ``max_fanout`` rows per input with a validity mask."""
+
+    max_fanout: int = 1
+
+    def flat_map_batch(
+        self, values: Dict[str, jax.Array], valid: jax.Array
+    ) -> tuple[Dict[str, jax.Array], jax.Array]:
+        """Return (data with leading dim B*max_fanout, valid mask)."""
+        raise NotImplementedError
+
+
+class ReduceFunction:
+    """Commutative+associative combine of two values of the same type
+    (ref: ReduceFunction.java). Must be expressible as elementwise
+    sum/min/max lanes for the dense pane path (SURVEY §8 lane design);
+    arbitrary reduces go through the sort+scan fallback."""
+
+    def reduce(self, a: Dict[str, jax.Array], b: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        raise NotImplementedError
+
+
+class AggregateFunction:
+    """Incremental aggregation ACC/IN/OUT (ref: AggregateFunction.java —
+    createAccumulator/add/merge/getResult). The accumulator is a pytree
+    of scalars; ``add`` and ``merge`` must be jax-traceable. The window
+    operator lowers instances whose merge is a per-leaf sum/min/max to
+    the dense lane layout automatically (ops/aggregates.lower_aggregate);
+    others use the generic sort+segment-scan path."""
+
+    def create_accumulator(self) -> Any:
+        raise NotImplementedError
+
+    def add(self, value: Dict[str, jax.Array], acc: Any) -> Any:
+        raise NotImplementedError
+
+    def merge(self, a: Any, b: Any) -> Any:
+        raise NotImplementedError
+
+    def get_result(self, acc: Any) -> Any:
+        raise NotImplementedError
+
+
+class ProcessWindowFunction:
+    """Post-aggregation per-window hook with window metadata (ref:
+    streaming/api/functions/windowing/ProcessWindowFunction.java, applied
+    via InternalAggregateProcessWindowFunction). Receives the fired
+    (key, window, result) batch; runs on device, vectorized."""
+
+    def process_batch(
+        self,
+        keys: jax.Array,
+        window_starts: jax.Array,
+        window_ends: jax.Array,
+        results: Any,
+        valid: jax.Array,
+    ) -> Any:
+        return results
+
+
+# -- convenience lambdas -----------------------------------------------------
+
+@dataclasses.dataclass
+class LambdaMap(MapFunction):
+    fn: Callable[[Dict[str, jax.Array]], Dict[str, jax.Array]]
+    batch: bool = True
+
+    def map(self, value):
+        return self.fn(value)
+
+    def map_batch(self, values):
+        if self.batch:
+            return self.fn(values)
+        return jax.vmap(self.fn)(values)
+
+
+@dataclasses.dataclass
+class LambdaFilter(FilterFunction):
+    fn: Callable[[Dict[str, jax.Array]], jax.Array]
+    batch: bool = True
+
+    def filter(self, value):
+        return self.fn(value)
+
+    def filter_batch(self, values):
+        if self.batch:
+            return self.fn(values)
+        return jax.vmap(self.fn)(values)

@@ -1,0 +1,243 @@
+"""Typed configuration system.
+
+Reproduces the capability of the reference's Configuration stack
+(ref: flink-core/.../configuration/Configuration.java, ConfigOption.java,
+ConfigOptions.java, GlobalConfiguration.java): typed options with defaults
+and doc strings, addressable as dotted ``a.b.c`` keys, layered resolution
+(defaults < file < env < explicit overrides).
+
+TPU-first deltas: no YAML dependency required (plain ``key: value`` /
+JSON files both parse); options that shape compiled programs (microbatch
+size, key shards, pane ring length) are surfaced here because they become
+*static* shapes under jit.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Dict, Generic, Iterator, Mapping, Optional, TypeVar
+
+T = TypeVar("T")
+
+_REGISTRY: Dict[str, "ConfigOption[Any]"] = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfigOption(Generic[T]):
+    """A typed option constant (ref: ConfigOption.java).
+
+    ``parse`` converts a string (env/file) representation to ``T``.
+    """
+
+    key: str
+    default: T
+    description: str = ""
+    parse: Optional[Callable[[str], T]] = None
+
+    def __post_init__(self) -> None:
+        _REGISTRY[self.key] = self
+
+    def _coerce(self, raw: Any) -> T:
+        if isinstance(raw, str) and self.parse is not None:
+            return self.parse(raw)
+        if isinstance(raw, str) and isinstance(self.default, bool):
+            return raw.strip().lower() in ("1", "true", "yes", "on")  # type: ignore[return-value]
+        if isinstance(raw, str) and isinstance(self.default, int):
+            return int(raw)  # type: ignore[return-value]
+        if isinstance(raw, str) and isinstance(self.default, float):
+            return float(raw)  # type: ignore[return-value]
+        return raw
+
+
+def all_options() -> Mapping[str, ConfigOption[Any]]:
+    """Registry of every declared option — the docs-generation seam
+    (ref: flink-docs/ config option reference generator)."""
+    return dict(_REGISTRY)
+
+
+class Configuration:
+    """Layered key→value store (ref: Configuration.java).
+
+    Resolution order, lowest to highest precedence:
+    option defaults < loaded file < ``FLINK_TPU_*`` env vars < ``set()``.
+    """
+
+    ENV_PREFIX = "FLINK_TPU_"
+
+    def __init__(self, values: Optional[Mapping[str, Any]] = None) -> None:
+        self._file: Dict[str, Any] = {}
+        self._explicit: Dict[str, Any] = dict(values or {})
+
+    # -- loading ---------------------------------------------------------
+    @classmethod
+    def from_file(cls, path: str) -> "Configuration":
+        """Load ``key: value`` lines or a JSON object
+        (ref: GlobalConfiguration.loadConfiguration)."""
+        conf = cls()
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+        stripped = text.lstrip()
+        if stripped.startswith("{"):
+            conf._file.update(json.loads(text))
+            return conf
+        for line in text.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if ":" in line:
+                k, _, v = line.partition(":")
+            elif "=" in line:
+                k, _, v = line.partition("=")
+            else:
+                continue
+            conf._file[k.strip()] = v.strip()
+        return conf
+
+    def _env_lookup(self, key: str) -> Optional[str]:
+        env_key = self.ENV_PREFIX + key.upper().replace(".", "_").replace("-", "_")
+        return os.environ.get(env_key)
+
+    # -- access ----------------------------------------------------------
+    def get(self, option: ConfigOption[T]) -> T:
+        if option.key in self._explicit:
+            return option._coerce(self._explicit[option.key])
+        env = self._env_lookup(option.key)
+        if env is not None:
+            return option._coerce(env)
+        if option.key in self._file:
+            return option._coerce(self._file[option.key])
+        return option.default
+
+    def get_raw(self, key: str, default: Any = None) -> Any:
+        if key in self._explicit:
+            return self._explicit[key]
+        env = self._env_lookup(key)
+        if env is not None:
+            return env
+        return self._file.get(key, default)
+
+    def set(self, option: "ConfigOption[T] | str", value: Any) -> "Configuration":
+        key = option.key if isinstance(option, ConfigOption) else option
+        self._explicit[key] = value
+        return self
+
+    def merged_with(self, other: "Configuration") -> "Configuration":
+        out = Configuration()
+        out._file = {**self._file, **other._file}
+        out._explicit = {**self._explicit, **other._explicit}
+        return out
+
+    def keys(self) -> Iterator[str]:
+        seen = set(self._file) | set(self._explicit)
+        return iter(sorted(seen))
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = dict(self._file)
+        out.update(self._explicit)
+        return out
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Configuration({self.to_dict()!r})"
+
+
+def _parse_duration_ms(raw: str) -> int:
+    """Parse '10 s', '500ms', '1 min' style durations to milliseconds
+    (ref: flink-core/.../configuration/TimeUtils.java)."""
+    raw = raw.strip().lower()
+    units = [
+        ("ms", 1),
+        ("milliseconds", 1),
+        ("s", 1000),
+        ("sec", 1000),
+        ("seconds", 1000),
+        ("min", 60_000),
+        ("minutes", 60_000),
+        ("h", 3_600_000),
+        ("hours", 3_600_000),
+        ("d", 86_400_000),
+        ("days", 86_400_000),
+    ]
+    # longest suffix match wins so "ms" is not parsed as "s"
+    for suffix, mult in sorted(units, key=lambda u: -len(u[0])):
+        if raw.endswith(suffix):
+            num = raw[: -len(suffix)].strip()
+            return int(float(num) * mult)
+    return int(float(raw))
+
+
+def duration_option(key: str, default_ms: int, description: str = "") -> ConfigOption[int]:
+    return ConfigOption(key, default_ms, description, parse=_parse_duration_ms)
+
+
+# ---------------------------------------------------------------------------
+# Core option catalog (ref: TaskManagerOptions / CheckpointingOptions /
+# ExecutionOptions catalogs in flink-core/.../configuration/).
+# ---------------------------------------------------------------------------
+
+class PipelineOptions:
+    MICROBATCH_SIZE = ConfigOption(
+        "pipeline.microbatch-size", 8192,
+        "Records per device per step. Static shape under jit; the latency/"
+        "throughput knob (the BufferDebloater analogue tunes it at runtime).")
+    AUTO_WATERMARK_INTERVAL = duration_option(
+        "pipeline.auto-watermark-interval", 200,
+        "How often the host watermark clock advances (ref: "
+        "pipeline.auto-watermark-interval).")
+    OBJECT_REUSE = ConfigOption(
+        "pipeline.object-reuse", True,
+        "Reuse ingest buffers between steps (always safe here: device "
+        "owns data after dispatch).")
+
+
+class StateOptions:
+    NUM_KEY_SHARDS = ConfigOption(
+        "state.num-key-shards", 128,
+        "Fixed hash space decoupling logical keys from devices — the "
+        "maxParallelism / key-group analogue (ref: "
+        "runtime/state/KeyGroupRangeAssignment.java, default 128). Must be "
+        "a multiple of the mesh device count.")
+    SLOTS_PER_SHARD = ConfigOption(
+        "state.slots-per-shard", 4096,
+        "Distinct keys a shard can hold before spill/eviction. "
+        "slots*shards bounds resident key cardinality in HBM.")
+    BACKEND = ConfigOption(
+        "state.backend", "hbm",
+        "Keyed state backend: 'hbm' (dense pane tensors, the "
+        "HeapKeyedStateBackend analogue) or 'spill' (host offload, the "
+        "RocksDB analogue).")
+
+
+class CheckpointingOptions:
+    INTERVAL = duration_option(
+        "execution.checkpointing.interval", 0,
+        "Checkpoint period in ms; 0 disables (ref: "
+        "execution.checkpointing.interval).")
+    DIRECTORY = ConfigOption(
+        "execution.checkpointing.dir", "/tmp/flink-tpu-checkpoints",
+        "Checkpoint storage root (ref: state.checkpoints.dir).")
+    RETAINED = ConfigOption(
+        "execution.checkpointing.num-retained", 3,
+        "Completed checkpoints kept (ref: state.checkpoints.num-retained).")
+    INCREMENTAL = ConfigOption(
+        "execution.checkpointing.incremental", False,
+        "Upload only dirty panes (RocksDB incremental analogue).")
+
+
+class ClusterOptions:
+    HEARTBEAT_INTERVAL = duration_option(
+        "heartbeat.interval", 10_000,
+        "Runner→coordinator heartbeat period (ref: heartbeat.interval=10s).")
+    HEARTBEAT_TIMEOUT = duration_option(
+        "heartbeat.timeout", 50_000,
+        "Declare a runner dead after this silence (ref: heartbeat.timeout=50s).")
+    RESTART_STRATEGY = ConfigOption(
+        "restart-strategy.type", "exponential-delay",
+        "fixed-delay | exponential-delay | failure-rate | none (ref: "
+        "runtime/executiongraph/failover restart strategies).")
+    RESTART_ATTEMPTS = ConfigOption(
+        "restart-strategy.fixed-delay.attempts", 3,
+        "Max restarts for fixed-delay strategy.")
+    RESTART_DELAY = duration_option(
+        "restart-strategy.fixed-delay.delay", 1_000,
+        "Delay between restarts for fixed-delay strategy.")

@@ -1,0 +1,21 @@
+from flink_tpu.ops.aggregates import (
+    LaneAggregate,
+    count,
+    sum_of,
+    max_of,
+    min_of,
+    avg_of,
+    multi,
+    lower_aggregate,
+)
+
+__all__ = [
+    "LaneAggregate",
+    "count",
+    "sum_of",
+    "max_of",
+    "min_of",
+    "avg_of",
+    "multi",
+    "lower_aggregate",
+]

@@ -1,0 +1,284 @@
+"""Aggregate lowering: user aggregation → dense accumulator lanes.
+
+The reference evaluates ``AggregateFunction.add`` once per element against
+a per-(key, window) heap/RocksDB accumulator object (ref: flink-core/.../
+api/common/functions/AggregateFunction.java, applied in streaming/runtime/
+operators/windowing/WindowOperator.processElement via AggregatingState).
+
+TPU-first redesign: accumulators become fixed-width **lanes** in a dense
+``(slots, panes, width)`` tensor, and a whole microbatch is folded in with
+three scatter ops (add / max / min) — one per combine class. Anything
+expressible as per-lane sum/max/min composes freely: count, sum, avg
+(sum+count), max, min, argmax-by-packing, etc. This covers every
+BASELINE.json config. ``lower_aggregate`` adapts the reference-style
+AggregateFunction class to this form when its merge is recognizably
+per-leaf sum/max/min.
+
+Invariants:
+- identity elements: sum→0, max→-inf, min→+inf (padding rows lift to
+  identities, so invalid records are no-ops).
+- ``finalize`` maps lane vectors back to user-visible results and also
+  receives the built-in count lane (number of elements in the cell).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Arrays = Dict[str, jax.Array]
+
+F32_NEG_INF = float("-inf")
+F32_POS_INF = float("inf")
+
+
+@dataclasses.dataclass(frozen=True)
+class LaneAggregate:
+    """A windowed aggregation as sum/max/min lanes.
+
+    lift(data)  -> (sum (B,S), max (B,M), min (B,m)) per-record lane values
+    finalize(sums, maxs, mins, counts) -> result dict; inputs have shape
+    (..., width) / counts (...,) and must broadcast over leading dims.
+    """
+
+    sum_width: int
+    max_width: int
+    min_width: int
+    lift: Callable[[Arrays], Tuple[jax.Array, jax.Array, jax.Array]]
+    finalize: Callable[[jax.Array, jax.Array, jax.Array, jax.Array], Arrays]
+    name: str = "agg"
+
+    def lift_masked(self, data: Arrays, valid: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Lift a batch, mapping invalid rows to identity elements.
+        Normalizes shape to (B, width) even when lift can't know B
+        (e.g. count() over a batch with no data fields)."""
+        b = valid.shape[0]
+        s, mx, mn = self.lift(data)
+
+        v = valid[:, None]
+
+        def norm(x, width, fill):
+            if width == 0:
+                return jnp.full((b, width), fill, dtype=jnp.float32)
+            if x is None or x.ndim != 2 or x.shape[0] != b or x.shape[-1] != width:
+                raise ValueError(
+                    f"aggregate '{self.name}': lift returned shape "
+                    f"{None if x is None else x.shape}, expected ({b}, {width})")
+            return jnp.where(v, x, jnp.full_like(x, fill))
+
+        return (
+            norm(s, self.sum_width, 0.0),
+            norm(mx, self.max_width, F32_NEG_INF),
+            norm(mn, self.min_width, F32_POS_INF),
+        )
+
+
+def _empty_lanes(b: jax.Array) -> jax.Array:
+    return jnp.zeros(b.shape[:1] + (0,), dtype=jnp.float32)
+
+
+def count(result_field: str = "count") -> LaneAggregate:
+    """COUNT(*) — pure count-lane read (Nexmark Q5's per-key COUNT).
+    ref role: CountAggregator in windowed WordCount examples."""
+
+    def lift(data: Arrays):
+        b = next(iter(data.values())) if data else jnp.zeros((0,))
+        z = _empty_lanes(b)
+        return z, z, z
+
+    def finalize(sums, maxs, mins, counts):
+        return {result_field: counts}
+
+    return LaneAggregate(0, 0, 0, lift, finalize, name="count")
+
+
+def sum_of(field: str, result_field: Optional[str] = None) -> LaneAggregate:
+    out = result_field or f"sum_{field}"
+
+    def lift(data: Arrays):
+        s = data[field].astype(jnp.float32)[:, None]
+        z = _empty_lanes(data[field])
+        return s, z, z
+
+    def finalize(sums, maxs, mins, counts):
+        return {out: sums[..., 0]}
+
+    return LaneAggregate(1, 0, 0, lift, finalize, name=f"sum({field})")
+
+
+def max_of(field: str, result_field: Optional[str] = None) -> LaneAggregate:
+    out = result_field or f"max_{field}"
+
+    def lift(data: Arrays):
+        m = data[field].astype(jnp.float32)[:, None]
+        z = _empty_lanes(data[field])
+        return z, m, z
+
+    def finalize(sums, maxs, mins, counts):
+        return {out: maxs[..., 0]}
+
+    return LaneAggregate(0, 1, 0, lift, finalize, name=f"max({field})")
+
+
+def min_of(field: str, result_field: Optional[str] = None) -> LaneAggregate:
+    out = result_field or f"min_{field}"
+
+    def lift(data: Arrays):
+        m = data[field].astype(jnp.float32)[:, None]
+        z = _empty_lanes(data[field])
+        return z, z, m
+
+    def finalize(sums, maxs, mins, counts):
+        return {out: mins[..., 0]}
+
+    return LaneAggregate(0, 0, 1, lift, finalize, name=f"min({field})")
+
+
+def avg_of(field: str, result_field: Optional[str] = None) -> LaneAggregate:
+    out = result_field or f"avg_{field}"
+
+    def lift(data: Arrays):
+        s = data[field].astype(jnp.float32)[:, None]
+        z = _empty_lanes(data[field])
+        return s, z, z
+
+    def finalize(sums, maxs, mins, counts):
+        c = jnp.maximum(counts, 1).astype(jnp.float32)
+        return {out: sums[..., 0] / c}
+
+    return LaneAggregate(1, 0, 0, lift, finalize, name=f"avg({field})")
+
+
+def multi(*aggs: LaneAggregate) -> LaneAggregate:
+    """Compose several aggregations over one window into one lane layout
+    (e.g. Q7 needs max(price); a dashboard wants count+sum+max at once)."""
+    sw = sum(a.sum_width for a in aggs)
+    mw = sum(a.max_width for a in aggs)
+    nw = sum(a.min_width for a in aggs)
+
+    def lift(data: Arrays):
+        ss, ms, ns = [], [], []
+        for a in aggs:
+            s, m, n = a.lift(data)
+            ss.append(s)
+            ms.append(m)
+            ns.append(n)
+        return (
+            jnp.concatenate(ss, axis=-1) if ss else None,
+            jnp.concatenate(ms, axis=-1) if ms else None,
+            jnp.concatenate(ns, axis=-1) if ns else None,
+        )
+
+    def finalize(sums, maxs, mins, counts):
+        out: Arrays = {}
+        so = mo = no = 0
+        for a in aggs:
+            r = a.finalize(
+                sums[..., so : so + a.sum_width],
+                maxs[..., mo : mo + a.max_width],
+                mins[..., no : no + a.min_width],
+                counts,
+            )
+            out.update(r)
+            so += a.sum_width
+            mo += a.max_width
+            no += a.min_width
+        return out
+
+    return LaneAggregate(sw, mw, nw, lift, finalize, name="+".join(a.name for a in aggs))
+
+
+# ---------------------------------------------------------------------------
+# Lowering reference-style AggregateFunction classes.
+# ---------------------------------------------------------------------------
+
+def lower_aggregate(fn: Any, probe_fields: Dict[str, Any]) -> LaneAggregate:
+    """Adapt a user AggregateFunction (create_accumulator/add/merge/
+    get_result, ref: AggregateFunction.java) to the lane layout.
+
+    Strategy: trace ``merge`` on symbolic accumulators and classify each
+    accumulator leaf as sum-merged (a+b), max-merged, or min-merged by
+    evaluating merge on probe values. Leaves that don't match any lane
+    class are rejected — the caller should fall back to composing
+    built-in lane aggregates (sum_of/max_of/...) or restructure.
+
+    probe_fields: field name → numpy dtype, the record schema the
+    aggregate will see (needed to build probe batches).
+    """
+    import numpy as np
+
+    acc0 = fn.create_accumulator()
+    leaves0, treedef = jax.tree_util.tree_flatten(acc0)
+
+    # classify each leaf by behaviour of merge on probe numbers
+    probes_a = [np.float64(3.0)] * len(leaves0)
+    probes_b = [np.float64(5.0)] * len(leaves0)
+    a = jax.tree_util.tree_unflatten(treedef, [jnp.asarray(p) for p in probes_a])
+    b = jax.tree_util.tree_unflatten(treedef, [jnp.asarray(p) for p in probes_b])
+    merged = fn.merge(a, b)
+    mleaves = [float(x) for x in jax.tree_util.tree_leaves(merged)]
+
+    kinds = []
+    for m in mleaves:
+        if abs(m - 8.0) < 1e-9:
+            kinds.append("sum")
+        elif abs(m - 5.0) < 1e-9:
+            kinds.append("max")
+        elif abs(m - 3.0) < 1e-9:
+            kinds.append("min")
+        else:
+            raise NotImplementedError(
+                f"accumulator leaf merges as neither sum/max/min (got {m} from "
+                "merge(3,5)); compose flink_tpu.ops built-in lane aggregates "
+                "instead")
+    # disambiguate max vs min with a second probe (merge(5,3))
+    a2 = jax.tree_util.tree_unflatten(treedef, [jnp.asarray(np.float64(5.0))] * len(leaves0))
+    b2 = jax.tree_util.tree_unflatten(treedef, [jnp.asarray(np.float64(3.0))] * len(leaves0))
+    m2 = [float(x) for x in jax.tree_util.tree_leaves(fn.merge(a2, b2))]
+    for i, (k, v) in enumerate(zip(kinds, m2)):
+        if k == "max" and abs(v - 5.0) > 1e-9:
+            raise NotImplementedError("non-commutative merge")
+        if k == "min" and abs(v - 3.0) > 1e-9:
+            kinds[i] = "max"  # merge(3,5)=3? then (5,3)=5 would be 'first'; reject
+            raise NotImplementedError("non-commutative merge")
+
+    sum_ix = [i for i, k in enumerate(kinds) if k == "sum"]
+    max_ix = [i for i, k in enumerate(kinds) if k == "max"]
+    min_ix = [i for i, k in enumerate(kinds) if k == "min"]
+
+    def lift(data: Arrays):
+        # one vmapped add against a fresh accumulator lifts each record
+        def one(row: Arrays):
+            acc = fn.create_accumulator()
+            return fn.add(row, acc)
+
+        accs = jax.vmap(one)(data)
+        leaves = jax.tree_util.tree_leaves(accs)
+        cols = [l.astype(jnp.float32).reshape(l.shape[0], -1) for l in leaves]
+
+        def gather(ix):
+            if not ix:
+                return jnp.zeros((cols[0].shape[0], 0), dtype=jnp.float32)
+            return jnp.concatenate([cols[i] for i in ix], axis=-1)
+
+        return gather(sum_ix), gather(max_ix), gather(min_ix)
+
+    def finalize(sums, maxs, mins, counts):
+        leaves = [None] * len(leaves0)
+        for j, i in enumerate(sum_ix):
+            leaves[i] = sums[..., j]
+        for j, i in enumerate(max_ix):
+            leaves[i] = maxs[..., j]
+        for j, i in enumerate(min_ix):
+            leaves[i] = mins[..., j]
+        acc = jax.tree_util.tree_unflatten(treedef, leaves)
+        res = fn.get_result(acc)
+        if not isinstance(res, dict):
+            res = {"result": res}
+        return res
+
+    return LaneAggregate(len(sum_ix), len(max_ix), len(min_ix), lift, finalize,
+                         name=type(fn).__name__)

@@ -1,0 +1,498 @@
+"""The window operator — the north-star component.
+
+ref: streaming/runtime/operators/windowing/WindowOperator.java
+(processElement: assign windows → per-(key,window) state add → trigger;
+onEventTime: fire → emit via InternalWindowFunction → purge) and the
+timer loop it rides (streaming/api/operators/InternalTimerServiceImpl
+.advanceWatermark — a per-timer heap poll).
+
+TPU-first redesign (SURVEY §6.7, §8): no per-element window lists, no
+timer heap, no per-key callbacks. Three dense kernels over a
+``(slots, pane_ring)`` accumulator tensor:
+
+- ``apply``: one microbatch → pane index per record → masked scatter
+  add/max/min into (slot, pane) cells. Sliding windows cost ONE write
+  per element (the Table-runtime slicing trick, ref SliceAssigner), not
+  ``size/slide`` writes like the reference's DataStream WindowOperator.
+- ``fire``: a watermark advance makes whole *windows* fireable at once;
+  each is a gather of its ``panes_per_window`` ring columns + a
+  sum/max/min reduction over the pane axis — vectorized over every key
+  slot simultaneously (the batched Trigger.onEventTime).
+- ``clear``: panes no window can ever need again (watermark past
+  end + allowed lateness) are reset to identities; the ring reuses them.
+
+The host-side ``WindowOperator`` class owns the watermark clock, the ring
+bookkeeping (which global pane lives in which ring column), allowed
+lateness / late side output, and late re-firing — control flow the
+reference keeps in triggers/timers, which is inherently scalar and cheap,
+so it stays on the host while all per-record and per-key work is on
+device.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.api.windowing import WindowAssigner
+from flink_tpu.ops.aggregates import LaneAggregate
+from flink_tpu.state.keyed import KeyDirectory, PaneState, PaneStateLayout, init_state
+from flink_tpu.time.watermarks import LONG_MIN
+
+
+# ---------------------------------------------------------------------------
+# Pure kernels (jittable; operate on LOCAL slot ids).
+# ---------------------------------------------------------------------------
+
+def apply_kernel(
+    state: PaneState,
+    slot_ids: jax.Array,   # (B,) int32/int64 local slots; dump row for invalid
+    ts: jax.Array,         # (B,) int64
+    valid: jax.Array,      # (B,) bool
+    data: Dict[str, jax.Array],
+    *,
+    agg: LaneAggregate,
+    pane_ms: int,
+    offset_ms: int,
+    ring: int,
+    dump_row: int,
+) -> PaneState:
+    """Fold one microbatch into pane state (the processElement hot loop,
+    batched). All shapes static; invalid rows scatter into the dump row
+    with identity lane values (doubly safe)."""
+    pane = (ts - offset_ms) // pane_ms
+    ring_ix = (pane % ring).astype(jnp.int32)
+    rows = jnp.where(valid, slot_ids, dump_row).astype(jnp.int32)
+
+    s_l, mx_l, mn_l = agg.lift_masked(data, valid)
+    new = PaneState(
+        sums=state.sums.at[rows, ring_ix].add(s_l),
+        maxs=state.maxs.at[rows, ring_ix].max(mx_l),
+        mins=state.mins.at[rows, ring_ix].min(mn_l),
+        counts=state.counts.at[rows, ring_ix].add(valid.astype(jnp.int32)),
+    )
+    return new
+
+
+def fire_kernel(
+    state: PaneState,
+    end_panes: jax.Array,  # (W,) int64 global pane ids (window end, exclusive)
+    w_valid: jax.Array,    # (W,) bool
+    pane_lo: jax.Array,    # scalar int64: oldest written-and-uncleared pane
+    pane_hi: jax.Array,    # scalar int64: newest written pane
+    *,
+    panes_per_window: int,
+    ring: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Evaluate every (key, fireable-window) pair at once.
+
+    Returns (sums (rows,W,sw), maxs, mins, counts (rows,W)) — the lane
+    reduction over each window's pane span. ref role: WindowOperator.
+    onEventTime → emitWindowContents, for all keys in one shot.
+
+    The [pane_lo, pane_hi] range masks ring aliasing: a window's pane that
+    was never written (or already purged) may share a ring column with a
+    newer pane; such cells read as identity. The ingest-side ring guard
+    ensures at most one live pane per column within the range.
+    """
+    ppw = panes_per_window
+    want = end_panes[:, None] - ppw + jnp.arange(ppw)[None, :]            # (W, ppw) global panes
+    ring_ix = (want % ring).astype(jnp.int32)
+    live = (want >= pane_lo) & (want <= pane_hi)                           # (W, ppw)
+    m3 = live[None, :, :, None]
+    m2 = live[None, :, :]
+    sums = jnp.sum(jnp.where(m3, state.sums[:, ring_ix, :], 0.0), axis=2)   # (rows, W, sw)
+    maxs = jnp.max(jnp.where(m3, state.maxs[:, ring_ix, :], -jnp.inf), axis=2)
+    mins = jnp.min(jnp.where(m3, state.mins[:, ring_ix, :], jnp.inf), axis=2)
+    counts = jnp.sum(jnp.where(m2, state.counts[:, ring_ix], 0), axis=2)    # (rows, W)
+    counts = jnp.where(w_valid[None, :], counts, 0)
+    return sums, maxs, mins, counts
+
+
+def clear_kernel(state: PaneState, clear_mask: jax.Array) -> PaneState:
+    """Reset ring columns selected by clear_mask (ring,) to identities
+    (ref role: WindowOperator.clearAllState / registerCleanupTimer)."""
+    m3 = clear_mask[None, :, None]
+    m2 = clear_mask[None, :]
+    return PaneState(
+        sums=jnp.where(m3, 0.0, state.sums),
+        maxs=jnp.where(m3, -jnp.inf, state.maxs),
+        mins=jnp.where(m3, jnp.inf, state.mins),
+        counts=jnp.where(m2, 0, state.counts),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Planning: static layout from assigner + timing characteristics.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class WindowPlan:
+    pane_ms: int
+    offset_ms: int
+    size_ms: int
+    slide_ms: int
+    panes_per_window: int
+    panes_per_slide: int
+    ring: int
+    allowed_lateness_ms: int
+
+    @classmethod
+    def plan(
+        cls,
+        assigner: WindowAssigner,
+        *,
+        allowed_lateness_ms: int = 0,
+        max_out_of_orderness_ms: int = 0,
+        headroom_panes: int = 4,
+    ) -> "WindowPlan":
+        pane = assigner.pane_ms
+        # Live pane span: a pane stays until wm >= pane_start + size +
+        # lateness; the newest writable pane is at max_ts = wm + delay.
+        # headroom covers event time running ahead of the watermark clock
+        # between advances (one microbatch's worth of time progress).
+        span_ms = assigner.size_ms + allowed_lateness_ms + max_out_of_orderness_ms
+        ring = -(-span_ms // pane) + 1 + headroom_panes
+        if ring > 65536:
+            raise ValueError(
+                f"pane ring of {ring} panes (pane={pane}ms from gcd(size={assigner.size_ms},"
+                f" slide={assigner.slide_ms})) is degenerate — choose a slide that divides"
+                " the window size (or shares a larger common divisor)")
+        return cls(
+            pane_ms=pane,
+            offset_ms=assigner.offset_ms,
+            size_ms=assigner.size_ms,
+            slide_ms=assigner.slide_ms,
+            panes_per_window=assigner.panes_per_window,
+            panes_per_slide=assigner.panes_per_slide,
+            ring=ring,
+            allowed_lateness_ms=allowed_lateness_ms,
+        )
+
+    def pane_of(self, ts: np.ndarray) -> np.ndarray:
+        return (ts - self.offset_ms) // self.pane_ms
+
+    def window_end_ms(self, end_pane: int) -> int:
+        return int(end_pane) * self.pane_ms + self.offset_ms
+
+    def window_dead(self, end_pane: int, wm: int) -> bool:
+        """A window is dead (late beyond lateness) iff
+        window.maxTimestamp() + allowedLateness <= watermark
+        (ref: WindowOperator.isWindowLate / isCleanupTime)."""
+        end_ms = end_pane * self.pane_ms + self.offset_ms
+        return end_ms - 1 + self.allowed_lateness_ms <= wm
+
+    def first_dead_pane(self, wm: int) -> int:
+        """Panes strictly below this are finally purged at watermark wm:
+        the LAST window containing the pane is dead. Exact reference
+        boundary: ((p//pps)*pps + ppw) is that window's end pane."""
+        if wm == LONG_MIN:
+            return np.iinfo(np.int64).min // 2
+        pps, ppw = self.panes_per_slide, self.panes_per_window
+        t = wm + 1 - self.allowed_lateness_ms - self.offset_ms
+        q = t // self.pane_ms - ppw
+        return (q // pps + 1) * pps
+
+    def fireable_end_panes(
+        self, wm_prev: int, wm_now: int, min_pane_seen: Optional[int] = None
+    ) -> List[int]:
+        """Slide-aligned window end panes e with wm_prev < end-1 <= wm_now
+        — the first-time firings this advance unlocks (batched
+        EventTimeTrigger: fire iff wm >= window.maxTimestamp).
+
+        min_pane_seen bounds the range at job start (windows entirely
+        before the first record are empty and never emit anyway).
+        """
+        if wm_now == LONG_MIN:
+            return []
+        pps, ppw = self.panes_per_slide, self.panes_per_window
+        # Window STARTS are slide-aligned (multiples of pps), so END panes
+        # satisfy e ≡ ppw (mod pps) — not e ≡ 0 unless size % slide == 0.
+        def align_down(m: int) -> int:
+            return m - ((m - ppw) % pps)
+
+        # window end time must satisfy end - 1 <= wm  => end_ms <= wm + 1
+        hi_end = align_down((wm_now + 1 - self.offset_ms) // self.pane_ms)
+        if wm_prev == LONG_MIN:
+            if min_pane_seen is None:
+                return []
+            lo_end = align_down(min_pane_seen)
+        else:
+            lo_end = align_down((wm_prev + 1 - self.offset_ms) // self.pane_ms)
+        out = []
+        e = lo_end + pps
+        while e <= hi_end:
+            out.append(int(e))
+            e += pps
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Host-side operator runtime (single shard range; the sharded pipeline in
+# exchange/ reuses the same kernels inside shard_map).
+# ---------------------------------------------------------------------------
+
+class WindowOperator:
+    """Drives the kernels for one keyed window aggregation.
+
+    Semantics golden-checked against the reference's WindowOperatorTest
+    behaviours (ref: flink-streaming-java/src/test/.../windowing/
+    WindowOperatorTest.java): event-time firing, allowed lateness with
+    late re-firings, late-beyond-lateness side output, purge on cleanup.
+    """
+
+    def __init__(
+        self,
+        assigner: WindowAssigner,
+        agg: LaneAggregate,
+        *,
+        num_shards: int = 128,
+        slots_per_shard: int = 1024,
+        allowed_lateness_ms: int = 0,
+        max_out_of_orderness_ms: int = 0,
+        shard_range: Optional[Tuple[int, int]] = None,
+    ) -> None:
+        self.assigner = assigner
+        self.agg = agg
+        self.plan = WindowPlan.plan(
+            assigner,
+            allowed_lateness_ms=allowed_lateness_ms,
+            max_out_of_orderness_ms=max_out_of_orderness_ms,
+        )
+        self.directory = KeyDirectory(num_shards, slots_per_shard, shard_range)
+        self.layout = PaneStateLayout(
+            slots=self.directory.local_slots,
+            ring=self.plan.ring,
+            sum_width=agg.sum_width,
+            max_width=agg.max_width,
+            min_width=agg.min_width,
+        )
+        self.state = init_state(self.layout)
+        self.watermark = LONG_MIN
+        self._cleared_below = self.plan.first_dead_pane(LONG_MIN)  # panes < this are dead
+        self._fired_below_end: Optional[int] = None  # highest end pane fired
+        self._refire: set[int] = set()
+        self._min_pane_seen: Optional[int] = None
+        self._max_pane_seen: Optional[int] = None
+        self.late_records: int = 0
+
+        self._apply = jax.jit(
+            functools.partial(
+                apply_kernel,
+                agg=agg,
+                pane_ms=self.plan.pane_ms,
+                offset_ms=self.plan.offset_ms,
+                ring=self.plan.ring,
+                dump_row=self.layout.slots,
+            )
+        )
+        self._fire = jax.jit(
+            functools.partial(
+                fire_kernel,
+                panes_per_window=self.plan.panes_per_window,
+                ring=self.plan.ring,
+            )
+        )
+        self._clear = jax.jit(clear_kernel)
+
+    # -- data path -------------------------------------------------------
+    def process_batch(
+        self,
+        keys: np.ndarray,
+        ts: np.ndarray,
+        data: Dict[str, np.ndarray],
+        valid: Optional[np.ndarray] = None,
+    ) -> None:
+        """Fold a batch of records in. Late-beyond-lateness rows are
+        dropped (side output; ref: WindowOperator sideOutput/
+        numLateRecordsDropped) and late-within-lateness rows mark their
+        windows for re-firing."""
+        keys = np.asarray(keys, dtype=np.int64)
+        ts = np.asarray(ts, dtype=np.int64)
+        valid = np.ones(len(ts), bool) if valid is None else np.asarray(valid, bool)
+        panes = self.plan.pane_of(ts)
+
+        dead = self._cleared_below
+        late_mask = valid & (panes < dead)
+        self.late_records += int(late_mask.sum())
+        valid = valid & ~late_mask
+
+        if valid.any():
+            mn = int(panes[valid].min())
+            mx = int(panes[valid].max())
+            if self._min_pane_seen is None or mn < self._min_pane_seen:
+                self._min_pane_seen = mn
+            if self._max_pane_seen is None or mx > self._max_pane_seen:
+                self._max_pane_seen = mx
+
+            # ring overflow guard: watermark clock must keep up with event
+            # time (at most one live pane per ring column)
+            live_lo = max(dead, self._min_pane_seen)
+            if mx - live_lo >= self.plan.ring:
+                raise RuntimeError(
+                    f"pane ring overflow: pane {mx} vs oldest live {live_lo}, "
+                    f"ring {self.plan.ring}; watermark lagging event time beyond "
+                    "plan bounds (raise max_out_of_orderness_ms)")
+
+        # late-but-allowed → re-fire affected, already-fired windows with
+        # updated contents (ref: EventTimeTrigger.onElement fires
+        # immediately for late elements within allowed lateness)
+        if self._fired_below_end is not None:
+            late_ok = valid & (panes < self._fired_below_end)
+            if late_ok.any():
+                pps = self.plan.panes_per_slide
+                ppw = self.plan.panes_per_window
+                for p in np.unique(panes[late_ok]).tolist():
+                    # windows containing pane p start at pps-multiples in
+                    # (p-ppw, p], so ends are (p//pps)*pps + ppw stepping
+                    # down by pps while > p; skip windows already beyond
+                    # allowed lateness (ref: isWindowLate skips the window,
+                    # element still feeds its remaining live windows)
+                    e = (p // pps) * pps + ppw
+                    while e > p:
+                        if e <= self._fired_below_end and not self.plan.window_dead(e, self.watermark):
+                            self._refire.add(int(e))
+                        e -= pps
+
+        slots = self.directory.assign(keys)
+        bad = slots < 0
+        if bad.any():
+            # shard full or misrouted: drop with accounting (spill backend
+            # is the round-2 home for these)
+            valid = valid & ~bad
+        from flink_tpu.records import device_cast
+        self.state = self._apply(
+            self.state, jnp.asarray(slots), jnp.asarray(ts), jnp.asarray(valid),
+            {k: jnp.asarray(device_cast(v)) for k, v in data.items()})
+
+    # -- time path -------------------------------------------------------
+    def advance_watermark(self, wm: int) -> Dict[str, np.ndarray]:
+        """Advance event time; fire newly-complete windows plus pending
+        re-fires; purge dead panes. Returns the fired-window batch
+        (key, window_start, window_end, count, result fields...)."""
+        if wm < self.watermark or (wm == self.watermark and not self._refire):
+            return _empty_fired(self.agg)
+        prev = self.watermark
+        self.watermark = wm
+
+        ends = self.plan.fireable_end_panes(prev, wm, self._min_pane_seen)
+        ends = sorted(set(ends) | self._refire)
+        self._refire.clear()
+        out = self._fire_ends(ends)
+
+        if ends:
+            top = max(ends)
+            self._fired_below_end = max(self._fired_below_end or top, top)
+
+        # purge panes no window can need anymore; only columns actually
+        # written (>= min pane seen) can hold data
+        new_dead = self.plan.first_dead_pane(wm)
+        if new_dead > self._cleared_below:
+            lo = self._cleared_below
+            if self._min_pane_seen is not None:
+                lo = max(lo, self._min_pane_seen)
+            else:
+                lo = new_dead  # nothing written yet — nothing to clear
+            hi = new_dead
+            if hi > lo:
+                if hi - lo >= self.plan.ring:
+                    mask = np.ones(self.plan.ring, dtype=bool)
+                else:
+                    ring_positions = np.arange(lo, hi) % self.plan.ring
+                    mask = np.zeros(self.plan.ring, dtype=bool)
+                    mask[ring_positions] = True
+                self.state = self._clear(self.state, jnp.asarray(mask))
+            self._cleared_below = new_dead
+        return out
+
+    def _fire_ends(self, ends: List[int]) -> Dict[str, np.ndarray]:
+        if not ends or self._max_pane_seen is None:
+            return _empty_fired(self.agg)
+        # windows entirely outside the written pane range are empty — skip
+        lo = max(self._cleared_below, self._min_pane_seen)
+        hi = self._max_pane_seen
+        ppw = self.plan.panes_per_window
+        ends = [e for e in ends if e > lo and e - ppw <= hi]
+        if not ends:
+            return _empty_fired(self.agg)
+        W = len(ends)
+        end_arr = jnp.asarray(np.asarray(ends, dtype=np.int64))
+        w_valid = jnp.ones(W, dtype=bool)
+        sums, maxs, mins, counts = self._fire(
+            self.state, end_arr, w_valid, jnp.int64(lo), jnp.int64(hi))
+        return self._emit(np.asarray(sums), np.asarray(maxs), np.asarray(mins),
+                          np.asarray(counts), ends)
+
+    def _emit(self, sums, maxs, mins, counts, ends: List[int]) -> Dict[str, np.ndarray]:
+        """Select non-empty (registered-key, window) cells and finalize.
+        ref role: InternalSingleValueWindowFunction.process + collector."""
+        used = self.directory.used_mask()
+        rows = self.layout.slots
+        nonzero = counts[:rows] > 0                       # (rows, W)
+        nonzero &= used[:, None]
+        slot_ix, w_ix = np.nonzero(nonzero)
+        if len(slot_ix) == 0:
+            return _empty_fired(self.agg)
+        res = self.agg.finalize(
+            jnp.asarray(sums[slot_ix, w_ix]),
+            jnp.asarray(maxs[slot_ix, w_ix]),
+            jnp.asarray(mins[slot_ix, w_ix]),
+            jnp.asarray(counts[slot_ix, w_ix]),
+        )
+        ends_arr = np.asarray(ends, dtype=np.int64)[w_ix]
+        window_end = ends_arr * self.plan.pane_ms + self.plan.offset_ms
+        out: Dict[str, np.ndarray] = {
+            "key": self.directory.key_of_slots(slot_ix),
+            "window_start": window_end - self.plan.size_ms,
+            "window_end": window_end,
+            "count": counts[slot_ix, w_ix],
+        }
+        for k, v in res.items():
+            out[k] = np.asarray(v)
+        return out
+
+    # -- snapshot seam (checkpoint/ uses this) ---------------------------
+    def snapshot_state(self) -> Dict[str, Any]:
+        return {
+            "panes": jax.tree_util.tree_map(np.asarray, self.state),
+            "directory": self.directory.snapshot(),
+            "watermark": self.watermark,
+            "cleared_below": self._cleared_below,
+            "fired_below_end": self._fired_below_end,
+            "min_pane_seen": self._min_pane_seen,
+            "max_pane_seen": self._max_pane_seen,
+            "refire": sorted(self._refire),
+            "late_records": self.late_records,
+        }
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        self.state = jax.tree_util.tree_map(jnp.asarray, snap["panes"])
+        self.directory = KeyDirectory.restore(
+            self.directory.num_shards, self.directory.slots_per_shard,
+            snap["directory"], (self.directory.shard_lo, self.directory.shard_hi))
+        self.watermark = snap["watermark"]
+        self._cleared_below = snap["cleared_below"]
+        self._fired_below_end = snap["fired_below_end"]
+        self._min_pane_seen = snap["min_pane_seen"]
+        self._max_pane_seen = snap["max_pane_seen"]
+        self._refire = set(snap["refire"])
+        self.late_records = snap["late_records"]
+
+
+def _empty_fired(agg: LaneAggregate) -> Dict[str, np.ndarray]:
+    out = {
+        "key": np.zeros(0, np.int64),
+        "window_start": np.zeros(0, np.int64),
+        "window_end": np.zeros(0, np.int64),
+        "count": np.zeros(0, np.int32),
+    }
+    res = agg.finalize(
+        jnp.zeros((0, agg.sum_width)), jnp.zeros((0, agg.max_width)),
+        jnp.zeros((0, agg.min_width)), jnp.zeros((0,), jnp.int32))
+    for k, v in res.items():
+        out[k] = np.asarray(v)
+    return out

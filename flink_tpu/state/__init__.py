@@ -1,0 +1,8 @@
+from flink_tpu.state.keyed import (
+    PaneStateLayout,
+    PaneState,
+    KeyDirectory,
+    init_state,
+)
+
+__all__ = ["PaneStateLayout", "PaneState", "KeyDirectory", "init_state"]

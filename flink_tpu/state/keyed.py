@@ -1,0 +1,244 @@
+"""Keyed state: dense HBM pane tensors + host key directory.
+
+This is the HeapKeyedStateBackend replacement (ref: flink-runtime/.../
+runtime/state/heap/{HeapKeyedStateBackend,CopyOnWriteStateTable,
+CopyOnWriteStateMap}.java — a per-record nested-hash-map probe), redesigned
+for TPU: state lives as dense ``(slots, panes, width)`` accumulator
+tensors in HBM so a whole microbatch folds in with three scatters, and the
+hash-map role (key → state address) moves to a **host-side directory**
+that assigns each distinct key a stable slot inside its key shard.
+
+Key shards (ref: runtime/state/KeyGroupRangeAssignment.java — key groups,
+default max-parallelism 128) decouple the logical key space from physical
+devices: shard = splitmix64(key) % num_shards; a device owns a contiguous
+shard range; global slot = shard * slots_per_shard + local index. Rescale
+= re-assign shard ranges (checkpoint/reshard reads this layout).
+
+Copy-on-write snapshot isolation comes free: jax arrays are immutable, so
+a checkpoint simply keeps a reference to the state pytree of a step
+boundary while processing continues on new arrays (the CopyOnWriteStateTable
+role collapses into XLA donation semantics).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.records import hash_keys_numpy
+
+
+@dataclasses.dataclass(frozen=True)
+class PaneStateLayout:
+    """Static shape of one window-operator state family (per device shard
+    range when sharded; ``slots`` is the LOCAL slot count).
+
+    One extra "dump" row at index ``slots`` swallows scatters from
+    padding rows — branchless masking, no dynamic shapes.
+    """
+
+    slots: int          # local key capacity (num_local_shards * slots_per_shard)
+    ring: int           # pane ring length (>= live pane span, see plan())
+    sum_width: int
+    max_width: int
+    min_width: int
+
+    @property
+    def rows(self) -> int:
+        return self.slots + 1  # + dump row
+
+    def bytes(self) -> int:
+        per_cell = 4 * (self.sum_width + self.max_width + self.min_width) + 4
+        return self.rows * self.ring * per_cell
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class PaneState:
+    """Device-resident accumulator tensors. counts is always present (it
+    is the COUNT lane, the trigger-count source, and the non-empty mask)."""
+
+    sums: jax.Array   # (rows, ring, sum_width) f32
+    maxs: jax.Array   # (rows, ring, max_width) f32
+    mins: jax.Array   # (rows, ring, min_width) f32
+    counts: jax.Array  # (rows, ring) i32
+
+    def tree_flatten(self):
+        return (self.sums, self.maxs, self.mins, self.counts), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def init_state(layout: PaneStateLayout) -> PaneState:
+    return PaneState(
+        sums=jnp.zeros((layout.rows, layout.ring, layout.sum_width), jnp.float32),
+        maxs=jnp.full((layout.rows, layout.ring, layout.max_width), -jnp.inf, jnp.float32),
+        mins=jnp.full((layout.rows, layout.ring, layout.min_width), jnp.inf, jnp.float32),
+        counts=jnp.zeros((layout.rows, layout.ring), jnp.int32),
+    )
+
+
+class _NumpyHashTable:
+    """Open-addressing int64→int64 map with fully vectorized batch lookup
+    (linear probing; load factor kept ≤ 0.5 by doubling). Inserts go one
+    at a time — they only happen for never-before-seen keys."""
+
+    def __init__(self, capacity_hint: int = 1024) -> None:
+        size = 1
+        while size < max(capacity_hint * 2, 16):
+            size *= 2
+        self._keys = np.zeros(size, dtype=np.int64)
+        self._vals = np.zeros(size, dtype=np.int64)
+        self._used = np.zeros(size, dtype=bool)
+        self._count = 0
+
+    def lookup(self, keys: np.ndarray, key_hashes: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """(values, found) for a batch. Vectorized probe: each round
+        resolves every query that hits its key or an empty bucket."""
+        mask = len(self._keys) - 1
+        ix = (key_hashes & mask).astype(np.int64)
+        out = np.full(len(keys), -1, dtype=np.int64)
+        found = np.zeros(len(keys), dtype=bool)
+        pending = np.arange(len(keys))
+        for _ in range(len(self._keys)):
+            if len(pending) == 0:
+                break
+            pix = ix[pending]
+            hit = self._used[pix] & (self._keys[pix] == keys[pending])
+            empty = ~self._used[pix]
+            out[pending[hit]] = self._vals[pix[hit]]
+            found[pending[hit]] = True
+            pending = pending[~hit & ~empty]
+            ix[pending] = (ix[pending] + 1) & mask
+        return out, found
+
+    def insert(self, key: int, key_hash: int, val: int) -> None:
+        if (self._count + 1) * 2 > len(self._keys):
+            self._grow()
+        mask = len(self._keys) - 1
+        ix = key_hash & mask
+        while self._used[ix]:
+            if self._keys[ix] == key:
+                self._vals[ix] = val
+                return
+            ix = (ix + 1) & mask
+        self._keys[ix] = key
+        self._vals[ix] = val
+        self._used[ix] = True
+        self._count += 1
+
+    def _grow(self) -> None:
+        old_keys, old_vals, old_used = self._keys, self._vals, self._used
+        self.__init__(capacity_hint=len(old_keys))
+        live = np.nonzero(old_used)[0]
+        hashes = hash_keys_numpy(old_keys[live])
+        for k, h, v in zip(old_keys[live].tolist(), hashes.tolist(), old_vals[live].tolist()):
+            self.insert(k, h, v)
+
+
+class KeyDirectory:
+    """Host-side key → slot mapping (the hash-map half of the state
+    backend; ref role: CopyOnWriteStateMap.get/put, but amortized over a
+    batch and off the device hot path).
+
+    Batch lookups are fully vectorized over a numpy open-addressing
+    table; only never-before-seen keys take the per-key insert path.
+    Slot ids are stable for the life of the job (and across checkpoints —
+    the directory is part of the snapshot manifest).
+    """
+
+    FULL = -2  # sentinel: shard out of slots (spill backend takes over)
+
+    def __init__(self, num_shards: int, slots_per_shard: int,
+                 shard_range: Tuple[int, int] | None = None) -> None:
+        self.num_shards = num_shards
+        self.slots_per_shard = slots_per_shard
+        # shard range owned by this directory (global view: (0, num_shards))
+        self.shard_lo, self.shard_hi = shard_range or (0, num_shards)
+        self._table = _NumpyHashTable()
+        self._next_free = np.zeros(num_shards, dtype=np.int64)
+        n_local = (self.shard_hi - self.shard_lo) * slots_per_shard
+        self._rev_keys = np.zeros(n_local, dtype=np.int64)
+        self._rev_used = np.zeros(n_local, dtype=bool)
+
+    @property
+    def local_slots(self) -> int:
+        return (self.shard_hi - self.shard_lo) * self.slots_per_shard
+
+    def shard_of(self, keys: np.ndarray) -> np.ndarray:
+        return hash_keys_numpy(np.asarray(keys, dtype=np.int64)) % self.num_shards
+
+    def assign(self, keys: np.ndarray) -> np.ndarray:
+        """Map raw int64 keys → LOCAL slot ids (relative to shard_lo).
+
+        Returns -1 where the key's shard is outside this directory's
+        range (caller routed wrong) and FULL where the shard is out of
+        slots (spill-layer responsibility).
+        """
+        keys = np.asarray(keys, dtype=np.int64)
+        hashes = hash_keys_numpy(keys)
+        slots, found = self._table.lookup(keys, hashes)
+        if not found.all():
+            miss_ix = np.nonzero(~found)[0]
+            # insert each distinct new key once
+            uniq, first = np.unique(keys[miss_ix], return_index=True)
+            uh = hashes[miss_ix][first]
+            for k, h in zip(uniq.tolist(), uh.tolist()):
+                self._insert(int(k), int(h))
+            slots2, _ = self._table.lookup(keys[miss_ix], hashes[miss_ix])
+            slots[miss_ix] = slots2
+        return slots
+
+    def _insert(self, key: int, key_hash: int) -> int:
+        shard = int(key_hash % self.num_shards)
+        if not (self.shard_lo <= shard < self.shard_hi):
+            self._table.insert(key, key_hash, -1)
+            return -1
+        local_ix = self._next_free[shard]
+        if local_ix >= self.slots_per_shard:
+            self._table.insert(key, key_hash, self.FULL)
+            return self.FULL
+        self._next_free[shard] += 1
+        slot = (shard - self.shard_lo) * self.slots_per_shard + int(local_ix)
+        self._table.insert(key, key_hash, slot)
+        self._rev_keys[slot] = key
+        self._rev_used[slot] = True
+        return slot
+
+    def key_of_slots(self, slots: np.ndarray) -> np.ndarray:
+        return self._rev_keys[slots]
+
+    def used_mask(self) -> np.ndarray:
+        """(local_slots,) bool — which slots hold a registered key."""
+        return self._rev_used
+
+    def num_keys(self) -> int:
+        return int(self._rev_used.sum())
+
+    # -- snapshot (part of the checkpoint manifest) ----------------------
+    def snapshot(self) -> Dict[str, np.ndarray]:
+        return {
+            "rev_keys": self._rev_keys.copy(),
+            "rev_used": self._rev_used.copy(),
+            "next_free": self._next_free.copy(),
+        }
+
+    @classmethod
+    def restore(cls, num_shards: int, slots_per_shard: int,
+                snap: Dict[str, np.ndarray],
+                shard_range: Tuple[int, int] | None = None) -> "KeyDirectory":
+        d = cls(num_shards, slots_per_shard, shard_range)
+        d._rev_keys = snap["rev_keys"].copy()
+        d._rev_used = snap["rev_used"].copy()
+        d._next_free = snap["next_free"].copy()
+        used = np.nonzero(d._rev_used)[0]
+        keys = d._rev_keys[used]
+        hashes = hash_keys_numpy(keys)
+        for k, h, s in zip(keys.tolist(), hashes.tolist(), used.tolist()):
+            d._table.insert(int(k), int(h), int(s))
+        return d

@@ -1,0 +1,13 @@
+from flink_tpu.time.watermarks import (
+    WatermarkStrategy,
+    BoundedOutOfOrdernessWatermarks,
+    MonotonousWatermarks,
+    WatermarkTracker,
+)
+
+__all__ = [
+    "WatermarkStrategy",
+    "BoundedOutOfOrdernessWatermarks",
+    "MonotonousWatermarks",
+    "WatermarkTracker",
+]

@@ -1,0 +1,133 @@
+"""Event-time watermarks.
+
+The reference threads ``Watermark`` records in-band through every exchange
+and takes the per-channel minimum at each input (ref: flink-core/.../api/
+common/eventtime/WatermarkStrategy.java, BoundedOutOfOrdernessWatermarks
+.java; streaming/runtime/watermarkstatus/StatusWatermarkValve.java).
+
+TPU-first redesign: steps are globally synchronous, so watermarks need no
+in-band flow — the **host watermark clock** advances once per microbatch
+from the batch's max timestamp (periodic-emit analogue), and the min over
+parallel sources is taken in the driver (the StatusWatermarkValve role).
+A watermark value then drives one *vectorized* trigger evaluation on
+device instead of a per-timer callback loop (ref hot loop replaced:
+streaming/api/operators/InternalTimerServiceImpl.advanceWatermark).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import numpy as np
+
+from flink_tpu.records import MIN_TS
+
+LONG_MIN = int(MIN_TS)
+# Watermark value meaning "end of input reached" (ref: Watermark.MAX_WATERMARK).
+MAX_WATERMARK = np.iinfo(np.int64).max
+
+
+@dataclasses.dataclass(frozen=True)
+class WatermarkStrategy:
+    """How far behind the max seen timestamp the watermark trails.
+
+    ref: WatermarkStrategy.forBoundedOutOfOrderness / forMonotonousTimestamps.
+    """
+
+    max_out_of_orderness_ms: int = 0
+    idleness_ms: Optional[int] = None  # mark source idle after this silence
+
+    @classmethod
+    def for_monotonous_timestamps(cls) -> "WatermarkStrategy":
+        return cls(0)
+
+    @classmethod
+    def for_bounded_out_of_orderness(cls, ms: int) -> "WatermarkStrategy":
+        return cls(ms)
+
+    def with_idleness(self, ms: int) -> "WatermarkStrategy":
+        return dataclasses.replace(self, idleness_ms=ms)
+
+
+class MonotonousWatermarks:
+    """wm = max_ts - 1 (ref: AscendingTimestampsWatermarks)."""
+
+    def __init__(self) -> None:
+        self._max_ts = LONG_MIN
+
+    def on_batch(self, max_ts: int) -> int:
+        if max_ts > self._max_ts:
+            self._max_ts = max_ts
+        return self.current()
+
+    def current(self) -> int:
+        return self._max_ts - 1 if self._max_ts != LONG_MIN else LONG_MIN
+
+
+class BoundedOutOfOrdernessWatermarks:
+    """wm = max_ts - delay - 1 (ref: BoundedOutOfOrdernessWatermarks.java:
+    onPeriodicEmit emits maxTimestamp - outOfOrdernessMillis - 1)."""
+
+    def __init__(self, delay_ms: int) -> None:
+        self._delay = int(delay_ms)
+        self._max_ts = LONG_MIN
+
+    def on_batch(self, max_ts: int) -> int:
+        if max_ts > self._max_ts:
+            self._max_ts = max_ts
+        return self.current()
+
+    def current(self) -> int:
+        if self._max_ts == LONG_MIN:
+            return LONG_MIN
+        return self._max_ts - self._delay - 1
+
+
+def make_generator(strategy: WatermarkStrategy):
+    if strategy.max_out_of_orderness_ms <= 0:
+        return MonotonousWatermarks()
+    return BoundedOutOfOrdernessWatermarks(strategy.max_out_of_orderness_ms)
+
+
+class WatermarkTracker:
+    """Min-over-inputs watermark combiner with idleness handling — the
+    StatusWatermarkValve analogue, but over logical source partitions on
+    the host instead of network channels.
+
+    ref: streaming/runtime/watermarkstatus/StatusWatermarkValve.java
+    (per-channel min, idle channels excluded from the min).
+    """
+
+    def __init__(self) -> None:
+        self._per_input: Dict[str, int] = {}
+        self._idle: Dict[str, bool] = {}
+        self._current = LONG_MIN
+
+    def register_input(self, input_id: str) -> None:
+        """Declare an input channel before data flows (ref: the valve is
+        constructed with the channel count). Unregistered inputs joining
+        later cannot regress the emitted watermark."""
+        self._per_input.setdefault(input_id, LONG_MIN)
+        self._idle.setdefault(input_id, False)
+
+    def update(self, input_id: str, watermark: int, idle: bool = False) -> int:
+        self._idle[input_id] = idle
+        if not idle:
+            prev = self._per_input.get(input_id, LONG_MIN)
+            # watermarks never regress per input (ref: valve asserts this)
+            self._per_input[input_id] = max(prev, watermark)
+        return self.current()
+
+    def current(self) -> int:
+        active = [
+            wm for iid, wm in self._per_input.items() if not self._idle.get(iid, False)
+        ]
+        if not active:
+            # all idle: watermark may advance from idle inputs' last values
+            active = list(self._per_input.values())
+        if not active:
+            return self._current
+        combined = min(active)
+        if combined > self._current:
+            self._current = combined
+        return self._current

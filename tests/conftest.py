@@ -1,0 +1,19 @@
+"""Test harness environment.
+
+Tests run on CPU jax with a virtual 8-device mesh — the MiniCluster
+analogue (ref: flink-runtime/.../runtime/minicluster/MiniCluster.java runs
+a whole cluster in one JVM; here XLA's forced host platform device count
+gives N "chips" in one process, so keyBy all_to_all, sharded state, and
+checkpoint/reshard are all testable without TPUs). SURVEY.md §5 mapping.
+
+Must run before jax initializes a backend, hence top of conftest.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

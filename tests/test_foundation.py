@@ -1,0 +1,173 @@
+"""Foundation unit tests: config, records, watermarks, assigners.
+
+Pattern per SURVEY.md §5 tier 1 (pure unit tests; ref:
+flink-core/src/test configuration + eventtime tests).
+"""
+import numpy as np
+import pytest
+
+from flink_tpu.config import (
+    Configuration,
+    ConfigOption,
+    PipelineOptions,
+    StateOptions,
+    duration_option,
+    _parse_duration_ms,
+)
+from flink_tpu.records import (
+    RecordBatch,
+    Schema,
+    hash_keys_device,
+    hash_keys_numpy,
+    hash_string_key,
+    MIN_TS,
+)
+from flink_tpu.time.watermarks import (
+    BoundedOutOfOrdernessWatermarks,
+    MonotonousWatermarks,
+    WatermarkTracker,
+    LONG_MIN,
+)
+from flink_tpu.api.windowing import (
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+    TimeWindow,
+    EventTimeTrigger,
+    CountTrigger,
+    PurgingTrigger,
+    TriggerResult,
+)
+
+
+class TestConfiguration:
+    def test_defaults(self):
+        conf = Configuration()
+        assert conf.get(PipelineOptions.MICROBATCH_SIZE) == 8192
+        assert conf.get(StateOptions.NUM_KEY_SHARDS) == 128
+
+    def test_set_overrides(self):
+        conf = Configuration().set(PipelineOptions.MICROBATCH_SIZE, 1024)
+        assert conf.get(PipelineOptions.MICROBATCH_SIZE) == 1024
+
+    def test_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("FLINK_TPU_PIPELINE_MICROBATCH_SIZE", "2048")
+        assert Configuration().get(PipelineOptions.MICROBATCH_SIZE) == 2048
+
+    def test_file_loading(self, tmp_path):
+        p = tmp_path / "config.yaml"
+        p.write_text("pipeline.microbatch-size: 4096\n# comment\nstate.num-key-shards: 64\n")
+        conf = Configuration.from_file(str(p))
+        assert conf.get(PipelineOptions.MICROBATCH_SIZE) == 4096
+        assert conf.get(StateOptions.NUM_KEY_SHARDS) == 64
+
+    def test_duration_parsing(self):
+        assert _parse_duration_ms("500ms") == 500
+        assert _parse_duration_ms("10 s") == 10_000
+        assert _parse_duration_ms("1 min") == 60_000
+        assert _parse_duration_ms("250") == 250
+
+
+class TestRecordBatch:
+    def test_round_trip_and_padding(self):
+        rb = RecordBatch.from_numpy(
+            {"k": np.array([1, 2, 3])}, np.array([10, 20, 30]), capacity=8)
+        assert rb.capacity == 8
+        assert int(rb.num_valid()) == 3
+        rows = rb.compacted_rows()
+        np.testing.assert_array_equal(rows["k"], [1, 2, 3])
+        np.testing.assert_array_equal(rows["__ts__"], [10, 20, 30])
+
+    def test_mask_filter(self):
+        rb = RecordBatch.from_numpy({"k": np.array([1, 2, 3])}, np.array([10, 20, 30]))
+        filtered = rb.mask(rb.field("k") > 1)
+        assert int(filtered.num_valid()) == 2
+
+    def test_pytree(self):
+        import jax
+        rb = RecordBatch.from_numpy({"k": np.array([1, 2])}, np.array([1, 2]))
+        leaves = jax.tree_util.tree_leaves(rb)
+        assert len(leaves) == 3  # k, timestamps, valid
+
+    def test_hash_host_device_identical(self):
+        keys = np.array([0, 1, 7, 12345, 2**40, -17, 2**62], dtype=np.int64)
+        h_host = hash_keys_numpy(keys)
+        h_dev = np.asarray(hash_keys_device(keys))
+        np.testing.assert_array_equal(h_host, h_dev)
+        assert (h_host >= 0).all()
+        # avalanche sanity: sequential keys land in distinct shards
+        assert len(np.unique(hash_keys_numpy(np.arange(1000)) % 128)) > 100
+
+    def test_string_hash_stable(self):
+        assert hash_string_key("hello") == hash_string_key("hello")
+        assert hash_string_key("hello") != hash_string_key("world")
+        assert hash_string_key("hello") >= 0
+
+
+class TestWatermarks:
+    def test_monotonous(self):
+        g = MonotonousWatermarks()
+        assert g.current() == LONG_MIN
+        assert g.on_batch(100) == 99
+        assert g.on_batch(50) == 99  # never regress
+
+    def test_bounded_out_of_orderness(self):
+        # ref semantics: wm = max_ts - delay - 1
+        g = BoundedOutOfOrdernessWatermarks(10)
+        assert g.on_batch(100) == 89
+        assert g.on_batch(200) == 189
+
+    def test_tracker_min_over_inputs(self):
+        t = WatermarkTracker()
+        t.register_input("a")
+        t.register_input("b")
+        assert t.update("a", 100) == LONG_MIN  # b hasn't reported
+        assert t.update("b", 50) == 50
+        assert t.update("b", 150) == 100
+
+    def test_tracker_never_regresses(self):
+        t = WatermarkTracker()
+        t.update("a", 100)
+        assert t.update("b", 50) == 100  # late-joining input can't regress
+
+    def test_tracker_idleness(self):
+        t = WatermarkTracker()
+        t.register_input("a")
+        t.register_input("b")
+        t.update("a", 100)
+        t.update("b", 50)
+        assert t.current() == 50
+        assert t.update("b", 0, idle=True) == 100  # idle input leaves the min
+
+
+class TestAssigners:
+    def test_tumbling(self):
+        a = TumblingEventTimeWindows.of(1000)
+        assert a.pane_ms == 1000
+        assert a.panes_per_window == 1
+        assert a.assign_windows(1500) == [TimeWindow(1000, 2000)]
+        assert a.assign_windows(999) == [TimeWindow(0, 1000)]
+
+    def test_sliding_panes(self):
+        a = SlidingEventTimeWindows.of(10_000, 1_000)
+        assert a.pane_ms == 1000
+        assert a.panes_per_window == 10
+        assert a.panes_per_slide == 1
+        ws = a.assign_windows(10_500)
+        assert len(ws) == 10
+        assert ws[0] == TimeWindow(1000, 11_000)
+        assert ws[-1] == TimeWindow(10_000, 20_000)
+
+    def test_tumbling_offset(self):
+        a = TumblingEventTimeWindows.of(1000, offset_ms=200)
+        assert a.assign_windows(1100) == [TimeWindow(200, 1200)]
+
+    def test_triggers(self):
+        w = TimeWindow(0, 1000)
+        t = EventTimeTrigger.create()
+        assert t.on_event_time(998, w) == TriggerResult.CONTINUE
+        assert t.on_event_time(999, w) == TriggerResult.FIRE
+        c = CountTrigger.of(3)
+        assert c.on_element(5, w, 2) == TriggerResult.CONTINUE
+        assert c.on_element(5, w, 3) == TriggerResult.FIRE
+        p = PurgingTrigger.of(t)
+        assert p.on_event_time(999, w) == TriggerResult.FIRE_AND_PURGE

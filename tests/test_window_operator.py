@@ -1,0 +1,330 @@
+"""Window operator harness tests — the WindowOperatorTest analogue.
+
+ref: flink-streaming-java/src/test/java/.../streaming/runtime/operators/
+windowing/WindowOperatorTest.java — assigner × trigger × lateness × purge
+matrix, driven through a single-operator harness with explicit elements
+and watermarks, golden-checked against a pure-Python reference model.
+
+Semantics note: firing is batch-granular here (late elements re-fire
+their windows at the next watermark call, not per element) — the
+documented microbatching tradeoff; the golden model implements the same
+granularity so contents must match exactly.
+"""
+import collections
+import dataclasses
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.windowing import (
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+)
+from flink_tpu.ops.aggregates import avg_of, count, max_of, min_of, multi, sum_of
+from flink_tpu.ops.window import WindowOperator
+from flink_tpu.time.watermarks import LONG_MIN
+
+
+# ---------------------------------------------------------------------------
+# Golden reference model (scalar, dict-based — reference semantics).
+# ---------------------------------------------------------------------------
+
+class GoldenWindows:
+    def __init__(self, assigner, lateness=0):
+        self.assigner = assigner
+        self.lateness = lateness
+        self.contents = collections.defaultdict(lambda: collections.defaultdict(list))
+        self.wm = LONG_MIN
+        self.pending_refire = set()
+        self.attempted_max_end = None
+        self.dropped = 0
+
+    def add_batch(self, recs):
+        """recs: list of (key, ts, value)"""
+        for key, ts, v in recs:
+            windows = self.assigner.assign_windows(ts)
+            live = [w for w in windows if not (w.end - 1 + self.lateness <= self.wm)]
+            if not live:
+                self.dropped += 1
+                continue
+            for w in live:
+                self.contents[w][key].append(v)
+                already_passed = self.wm >= w.end - 1
+                if already_passed:
+                    self.pending_refire.add(w)
+
+    def advance(self, wm):
+        """Returns list of (key, window_start, window_end, values_list)."""
+        if wm < self.wm:
+            return []
+        prev, self.wm = self.wm, wm
+        fire = set(self.pending_refire)
+        self.pending_refire.clear()
+        for w in list(self.contents):
+            if prev < w.end - 1 <= wm:
+                fire.add(w)
+        out = []
+        for w in sorted(fire):
+            for key, vals in sorted(self.contents.get(w, {}).items()):
+                if vals:
+                    out.append((key, w.start, w.end, list(vals)))
+        # purge
+        for w in list(self.contents):
+            if w.end - 1 + self.lateness <= wm:
+                del self.contents[w]
+        return out
+
+
+def run_pair(assigner, agg, events, watermarks, lateness=0, ooo=0, golden_agg=None):
+    """Drive operator and golden model through interleaved batches and
+    watermark advances; return (ours, golden) emission lists."""
+    op = WindowOperator(assigner, agg, num_shards=8, slots_per_shard=64,
+                        allowed_lateness_ms=lateness, max_out_of_orderness_ms=ooo)
+    gold = GoldenWindows(assigner, lateness)
+    ours, golden = [], []
+    for batch, wm in zip(events, watermarks):
+        if batch:
+            keys = np.array([k for k, _, _ in batch], dtype=np.int64)
+            ts = np.array([t for _, t, _ in batch], dtype=np.int64)
+            vals = np.array([v for _, _, v in batch], dtype=np.float64)
+            op.process_batch(keys, ts, {"v": vals})
+            gold.add_batch(batch)
+        if wm is not None:
+            fired = op.advance_watermark(wm)
+            for i in range(len(fired["key"])):
+                row = {f: fired[f][i] for f in fired}
+                ours.append(row)
+            for key, ws, we, vals in gold.advance(wm):
+                golden.append((key, ws, we, vals, golden_agg(vals) if golden_agg else len(vals)))
+    return op, ours, golden
+
+
+def assert_match(ours, golden, result_field, approx=False):
+    ours_set = sorted(
+        (int(r["key"]), int(r["window_start"]), int(r["window_end"]),
+         round(float(r[result_field]), 4))
+        for r in ours)
+    gold_set = sorted(
+        (int(k), int(ws), int(we), round(float(res), 4))
+        for k, ws, we, vals, res in golden)
+    assert ours_set == gold_set, f"\nours:   {ours_set}\ngolden: {gold_set}"
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestTumblingCount:
+    def test_basic_single_key(self):
+        a = TumblingEventTimeWindows.of(1000)
+        events = [[(1, 100, 1.0), (1, 200, 1.0), (1, 1100, 1.0)]]
+        op, ours, golden = run_pair(a, count(), events, [2000])
+        assert_match(ours, golden, "count")
+        assert len(ours) == 2  # two windows fired
+
+    def test_multiple_keys(self):
+        a = TumblingEventTimeWindows.of(1000)
+        events = [[(k, t, 1.0) for k in range(5) for t in (10, 500, 990)]]
+        op, ours, golden = run_pair(a, count(), events, [999])
+        assert_match(ours, golden, "count")
+        assert len(ours) == 5
+        assert all(int(r["count"]) == 3 for r in ours)
+
+    def test_watermark_exactly_at_max_timestamp(self):
+        # fire iff wm >= end - 1 (ref: EventTimeTrigger.onEventTime)
+        a = TumblingEventTimeWindows.of(1000)
+        op, ours, golden = run_pair(a, count(), [[(1, 0, 1.0)], []], [998, 999])
+        assert_match(ours, golden, "count")
+        assert len(ours) == 1
+
+    def test_empty_windows_not_emitted(self):
+        a = TumblingEventTimeWindows.of(1000)
+        op, ours, golden = run_pair(a, count(), [[(1, 100, 1.0)]], [10_000])
+        assert len(ours) == 1
+
+    def test_no_regression_on_old_watermark(self):
+        a = TumblingEventTimeWindows.of(1000)
+        op = WindowOperator(a, count(), num_shards=4, slots_per_shard=16)
+        op.process_batch(np.array([1]), np.array([100]), {})
+        op.advance_watermark(2000)
+        fired = op.advance_watermark(1000)
+        assert len(fired["key"]) == 0
+
+
+class TestAggregates:
+    def test_sum_max_min_avg(self):
+        a = TumblingEventTimeWindows.of(1000)
+        agg = multi(count(), sum_of("v"), max_of("v"), min_of("v"), avg_of("v"))
+        events = [[(1, 100, 3.0), (1, 200, 5.0), (1, 800, 1.0), (2, 300, 10.0)]]
+        op, ours, golden = run_pair(a, agg, events, [1500])
+        by_key = {int(r["key"]): r for r in ours}
+        assert by_key[1]["count"] == 3
+        assert by_key[1]["sum_v"] == 9.0
+        assert by_key[1]["max_v"] == 5.0
+        assert by_key[1]["min_v"] == 1.0
+        assert abs(by_key[1]["avg_v"] - 3.0) < 1e-6
+        assert by_key[2]["max_v"] == 10.0
+
+    def test_sum_golden(self):
+        a = TumblingEventTimeWindows.of(500)
+        events = [[(k, t, float(k * t % 7)) for k in range(3) for t in (10, 400, 600, 900)]]
+        op, ours, golden = run_pair(a, sum_of("v"), events, [2000], golden_agg=sum)
+        assert_match(ours, golden, "sum_v")
+
+
+class TestSlidingWindows:
+    def test_q5_shape_sliding_count(self):
+        # 10s window / 1s slide — the Nexmark Q5 configuration
+        a = SlidingEventTimeWindows.of(10_000, 1_000)
+        events = [[(1, 500, 1.0), (1, 5500, 1.0), (2, 9_999, 1.0)]]
+        op, ours, golden = run_pair(a, count(), events, [30_000])
+        assert_match(ours, golden, "count")
+        # element at 500 belongs to 10 windows (ends 1000..10000)
+        k1 = [r for r in ours if r["key"] == 1]
+        assert sum(int(r["count"]) for r in k1) == 10 + 10
+
+    def test_sliding_incremental_watermarks(self):
+        a = SlidingEventTimeWindows.of(3000, 1000)
+        events = [[(1, 500, 1.0)], [(1, 1500, 1.0)], [(1, 2500, 1.0)], []]
+        op, ours, golden = run_pair(a, count(), events, [999, 1999, 2999, 10_000])
+        assert_match(ours, golden, "count")
+
+
+class TestLateness:
+    def test_late_beyond_lateness_dropped(self):
+        a = TumblingEventTimeWindows.of(1000)
+        op = WindowOperator(a, count(), num_shards=4, slots_per_shard=16,
+                            allowed_lateness_ms=0, max_out_of_orderness_ms=5000)
+        op.process_batch(np.array([1]), np.array([100]), {})
+        op.advance_watermark(2000)
+        op.process_batch(np.array([1]), np.array([500]), {})  # window [0,1000) dead
+        assert op.late_records == 1
+        fired = op.advance_watermark(3000)
+        assert len(fired["key"]) == 0
+
+    def test_allowed_lateness_refires(self):
+        a = TumblingEventTimeWindows.of(1000)
+        events = [[(1, 100, 1.0)], [(1, 500, 1.0)], []]
+        # wm 1500: window [0,1000) fired with count 1; late element at 500
+        # arrives within lateness 1000 → refire with count 2
+        op, ours, golden = run_pair(a, count(), events, [1500, 1500, 1600],
+                                    lateness=1000, ooo=2000)
+        assert_match(ours, golden, "count")
+        counts = sorted(int(r["count"]) for r in ours)
+        assert counts == [1, 2]
+
+    def test_lateness_cleanup_boundary(self):
+        # window [0,1000): dead exactly when wm >= end - 1 + lateness = 1499
+        a = TumblingEventTimeWindows.of(1000)
+        op = WindowOperator(a, count(), num_shards=4, slots_per_shard=16,
+                            allowed_lateness_ms=500, max_out_of_orderness_ms=5000)
+        op.process_batch(np.array([1]), np.array([100]), {})
+        op.advance_watermark(1498)  # not yet dead
+        op.process_batch(np.array([1]), np.array([200]), {})
+        assert op.late_records == 0
+        fired = op.advance_watermark(1498)
+        assert [int(c) for c in fired["count"]] == [2]  # refire with update
+        op.advance_watermark(1499)  # now dead
+        op.process_batch(np.array([1]), np.array([300]), {})
+        assert op.late_records == 1
+
+
+class TestPurge:
+    def test_state_cleared_after_lateness(self):
+        a = TumblingEventTimeWindows.of(1000)
+        op = WindowOperator(a, count(), num_shards=4, slots_per_shard=16,
+                            max_out_of_orderness_ms=2000)
+        op.process_batch(np.array([1]), np.array([100]), {})
+        op.advance_watermark(5000)
+        # all counts back to zero after purge
+        assert int(np.asarray(op.state.counts).sum()) == 0
+
+
+class TestSnapshotRestore:
+    def test_snapshot_restore_mid_window(self):
+        # ref pattern: WindowOperatorTest snapshot→restore→continue
+        a = SlidingEventTimeWindows.of(3000, 1000)
+        op1 = WindowOperator(a, count(), num_shards=4, slots_per_shard=16)
+        op1.process_batch(np.array([1, 2]), np.array([500, 700]), {})
+        op1.advance_watermark(999)
+        snap = op1.snapshot_state()
+
+        op2 = WindowOperator(a, count(), num_shards=4, slots_per_shard=16)
+        op2.restore_state(snap)
+        op2.process_batch(np.array([1]), np.array([1500]), {})
+        fired = op2.advance_watermark(10_000)
+
+        # golden: same events, no restore
+        op3 = WindowOperator(a, count(), num_shards=4, slots_per_shard=16)
+        op3.process_batch(np.array([1, 2]), np.array([500, 700]), {})
+        op3.advance_watermark(999)
+        op3.process_batch(np.array([1]), np.array([1500]), {})
+        expected = op3.advance_watermark(10_000)
+
+        got = sorted(zip(fired["key"], fired["window_end"], fired["count"]))
+        want = sorted(zip(expected["key"], expected["window_end"], expected["count"]))
+        assert [tuple(map(int, g)) for g in got] == [tuple(map(int, w)) for w in want]
+
+
+class TestSnapshotPendingRefire:
+    def test_refire_survives_restore(self):
+        # checkpoint between a late element and its re-firing must not
+        # lose the emission (exactly-once recovery)
+        a = TumblingEventTimeWindows.of(1000)
+        kw = dict(num_shards=4, slots_per_shard=16,
+                  allowed_lateness_ms=1000, max_out_of_orderness_ms=2000)
+        op1 = WindowOperator(a, count(), **kw)
+        op1.process_batch(np.array([1]), np.array([100]), {})
+        op1.advance_watermark(1500)                      # fires count=1
+        op1.process_batch(np.array([1]), np.array([500]), {})  # pending refire
+        snap = op1.snapshot_state()
+        op2 = WindowOperator(a, count(), **kw)
+        op2.restore_state(snap)
+        fired = op2.advance_watermark(1600)
+        assert [int(c) for c in fired["count"]] == [2]
+
+
+class TestNonDivisibleSlide:
+    def test_size_not_multiple_of_slide(self):
+        # windows START at slide multiples; ends are offset by size
+        a = SlidingEventTimeWindows.of(5000, 2000)
+        events = [[(1, 100, 1.0)], []]
+        op, ours, golden = run_pair(a, count(), events, [None, 60_000])
+        assert_match(ours, golden, "count")
+        ends = sorted(int(r["window_end"]) for r in ours)
+        assert ends == [1000, 3000, 5000]
+
+    def test_degenerate_pane_rejected(self):
+        from flink_tpu.ops.window import WindowPlan
+        with pytest.raises(ValueError, match="degenerate"):
+            WindowPlan.plan(SlidingEventTimeWindows.of(3600_000, 7))
+
+
+class TestFuzzVsGolden:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    @pytest.mark.parametrize("size,slide,lateness", [
+        (1000, 1000, 0),
+        (5000, 1000, 1500),
+        (4000, 2000, 0),
+        (5000, 2000, 0),     # size NOT a multiple of slide
+        (5000, 2000, 1500),
+    ])
+    def test_randomized(self, seed, size, slide, lateness):
+        rng = np.random.default_rng(seed)
+        a = SlidingEventTimeWindows.of(size, slide) if slide != size \
+            else TumblingEventTimeWindows.of(size)
+        ooo = 3000
+        n_batches, batch = 12, 40
+        events, wms = [], []
+        max_ts = 0
+        for i in range(n_batches):
+            ts = rng.integers(max(0, max_ts - ooo), max_ts + 2000, batch)
+            max_ts = max(max_ts, int(ts.max()))
+            keys = rng.integers(0, 10, batch)
+            b = [(int(k), int(t), 1.0) for k, t in zip(keys, ts)]
+            events.append(b)
+            wms.append(max_ts - ooo - 1)
+        events.append([])
+        wms.append(max_ts + size + lateness + 10_000)
+        op, ours, golden = run_pair(a, count(), events, wms,
+                                    lateness=lateness, ooo=ooo)
+        assert_match(ours, golden, "count")
