@@ -1,0 +1,107 @@
+"""The keyBy exchange: hash repartitioning as an ICI all_to_all.
+
+ref: the reference routes each serialized record through
+KeyGroupStreamPartitioner → RecordWriter → Netty credit-based channels
+(ref: streaming/runtime/partitioner/KeyGroupStreamPartitioner.java,
+runtime/io/network/api/writer/RecordWriter.java,
+runtime/io/network/netty/CreditBasedPartitionRequestClientHandler.java).
+
+TPU-first redesign: a whole microbatch is repartitioned in one
+``jax.lax.all_to_all`` inside the compiled step (SURVEY §3.6 TPU mapping).
+Each device buckets its records by destination device (slot ownership),
+pads buckets to a static capacity, exchanges, and flattens. Credit-based
+flow control collapses into the SPMD step cadence: in-flight data is
+bounded by construction (one microbatch per step), so backpressure is
+simply step time.
+
+Bucketing is sort-based (static shapes): stable argsort by destination,
+then each record's within-bucket position is its sorted rank minus its
+bucket's start offset. Records overflowing a bucket's capacity are
+dropped on device and COUNTED (returned per destination) so the host can
+retry/resize — never silently.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from flink_tpu.parallel.mesh import AXIS
+
+Arrays = Dict[str, jax.Array]
+
+
+def bucket_by_destination(
+    dest: jax.Array,      # (B,) int32 destination device per record
+    valid: jax.Array,     # (B,) bool
+    payload: Arrays,      # field → (B,) arrays (must include everything to ship)
+    *,
+    n_dest: int,
+    capacity: int,
+) -> Tuple[Arrays, jax.Array, jax.Array]:
+    """Pack records into (n_dest, capacity) padded buckets.
+
+    Returns (bucketed payload, bucket_valid (n_dest, capacity),
+    overflow_count (n_dest,)).
+    """
+    b = dest.shape[0]
+    # invalid records sort to a virtual bucket n_dest (dropped)
+    key = jnp.where(valid, dest, n_dest).astype(jnp.int32)
+    order = jnp.argsort(key, stable=True)
+    sorted_key = key[order]
+    counts = jnp.bincount(key, length=n_dest + 1)
+    starts = jnp.concatenate([jnp.zeros(1, counts.dtype), jnp.cumsum(counts)[:-1]])
+    within = jnp.arange(b) - starts[sorted_key]
+    keep = (sorted_key < n_dest) & (within < capacity)
+    # scatter into flat (n_dest * capacity) buckets
+    flat_ix = jnp.where(keep, sorted_key * capacity + within, n_dest * capacity)
+    out: Arrays = {}
+    for name, arr in payload.items():
+        holder = jnp.zeros((n_dest * capacity + 1,), dtype=arr.dtype)
+        out[name] = holder.at[flat_ix].set(arr[order]).reshape(-1)[:-1].reshape(n_dest, capacity)
+    bv = (
+        jnp.zeros((n_dest * capacity + 1,), dtype=bool)
+        .at[flat_ix]
+        .set(keep)[:-1]
+        .reshape(n_dest, capacity)
+    )
+    overflow = jnp.maximum(counts[:n_dest] - capacity, 0)
+    return out, bv, overflow
+
+
+def all_to_all_records(
+    buckets: Arrays,       # field → (n_dest, capacity)
+    bucket_valid: jax.Array,
+    axis_name: str = AXIS,
+) -> Tuple[Arrays, jax.Array]:
+    """Exchange buckets over the mesh axis; flatten received records.
+
+    Must run inside shard_map over ``axis_name``. After the collective,
+    row j of the result came from device j (the all-to-all transpose) —
+    each device ends up holding every record destined for it.
+    """
+    out: Arrays = {}
+    for name, arr in buckets.items():
+        out[name] = lax.all_to_all(arr, axis_name, split_axis=0, concat_axis=0).reshape(-1)
+    rv = lax.all_to_all(bucket_valid, axis_name, split_axis=0, concat_axis=0).reshape(-1)
+    return out, rv
+
+
+def keyby_exchange(
+    dest: jax.Array,
+    valid: jax.Array,
+    payload: Arrays,
+    *,
+    n_devices: int,
+    capacity: int,
+    axis_name: str = AXIS,
+) -> Tuple[Arrays, jax.Array, jax.Array]:
+    """bucket → all_to_all → flatten. Returns (received payload arrays of
+    shape (n_devices*capacity,), received valid, local overflow counts)."""
+    buckets, bv, overflow = bucket_by_destination(
+        dest, valid, payload, n_dest=n_devices, capacity=capacity)
+    recv, rv = all_to_all_records(buckets, bv, axis_name)
+    return recv, rv, overflow

@@ -37,9 +37,12 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
 
 from flink_tpu.api.windowing import WindowAssigner
 from flink_tpu.ops.aggregates import LaneAggregate
+from flink_tpu.parallel.mesh import AXIS, MeshPlan
 from flink_tpu.state.keyed import KeyDirectory, PaneState, PaneStateLayout, init_state
 from flink_tpu.time.watermarks import LONG_MIN
 
@@ -255,23 +258,32 @@ class WindowOperator:
         allowed_lateness_ms: int = 0,
         max_out_of_orderness_ms: int = 0,
         shard_range: Optional[Tuple[int, int]] = None,
+        mesh_plan: Optional[MeshPlan] = None,
+        exchange_capacity: Optional[int] = None,
     ) -> None:
         self.assigner = assigner
         self.agg = agg
+        self.mesh_plan = mesh_plan
+        self.exchange_capacity = exchange_capacity
         self.plan = WindowPlan.plan(
             assigner,
             allowed_lateness_ms=allowed_lateness_ms,
             max_out_of_orderness_ms=max_out_of_orderness_ms,
         )
+        if mesh_plan is not None:
+            num_shards = mesh_plan.num_shards
+            slots_per_shard = mesh_plan.slots_per_shard
+            shard_range = None  # directory is global; devices own row blocks
         self.directory = KeyDirectory(num_shards, slots_per_shard, shard_range)
+        per_block_slots = (
+            mesh_plan.slots_per_device if mesh_plan else self.directory.local_slots)
         self.layout = PaneStateLayout(
-            slots=self.directory.local_slots,
+            slots=per_block_slots,
             ring=self.plan.ring,
             sum_width=agg.sum_width,
             max_width=agg.max_width,
             min_width=agg.min_width,
         )
-        self.state = init_state(self.layout)
         self.watermark = LONG_MIN
         self._cleared_below = self.plan.first_dead_pane(LONG_MIN)  # panes < this are dead
         self._fired_below_end: Optional[int] = None  # highest end pane fired
@@ -279,11 +291,21 @@ class WindowOperator:
         self._min_pane_seen: Optional[int] = None
         self._max_pane_seen: Optional[int] = None
         self.late_records: int = 0
+        self.exchange_overflow: int = 0
 
+        if mesh_plan is None:
+            self.state = init_state(self.layout)
+            self._build_local_kernels()
+        else:
+            self.state = self._init_sharded_state()
+            self._build_sharded_kernels()
+
+    # -- kernel construction --------------------------------------------
+    def _build_local_kernels(self) -> None:
         self._apply = jax.jit(
             functools.partial(
                 apply_kernel,
-                agg=agg,
+                agg=self.agg,
                 pane_ms=self.plan.pane_ms,
                 offset_ms=self.plan.offset_ms,
                 ring=self.plan.ring,
@@ -298,6 +320,82 @@ class WindowOperator:
             )
         )
         self._clear = jax.jit(clear_kernel)
+
+    def _init_sharded_state(self) -> PaneState:
+        mp = self.mesh_plan
+        total_rows = mp.n_devices * self.layout.rows
+        sharding = mp.row_sharding()
+
+        @functools.partial(jax.jit, out_shardings=sharding)
+        def init():
+            return PaneState(
+                sums=jnp.zeros((total_rows, self.layout.ring, self.layout.sum_width), jnp.float32),
+                maxs=jnp.full((total_rows, self.layout.ring, self.layout.max_width), -jnp.inf, jnp.float32),
+                mins=jnp.full((total_rows, self.layout.ring, self.layout.min_width), jnp.inf, jnp.float32),
+                counts=jnp.zeros((total_rows, self.layout.ring), jnp.int32),
+            )
+
+        return init()
+
+    def _build_sharded_kernels(self) -> None:
+        """The full distributed hot path: per-device bucket-by-owner →
+        all_to_all over the mesh (keyBy repartition on ICI) → local pane
+        scatter. Fire/clear are embarrassingly parallel over row blocks.
+        """
+        from flink_tpu.exchange.keyby import keyby_exchange
+
+        mp = self.mesh_plan
+        agg = self.agg
+        plan = self.plan
+        layout = self.layout
+        spd = mp.slots_per_device
+        n_dev = mp.n_devices
+
+        def apply_shard(state, slot, ts, valid, data):
+            cap = self.exchange_capacity or slot.shape[0]
+            dest = jnp.where(valid, slot // spd, 0).astype(jnp.int32)
+            payload = {"__slot__": slot, "__ts__": ts, **data}
+            recv, rvalid, overflow = keyby_exchange(
+                dest, valid, payload, n_devices=n_dev, capacity=cap)
+            my = lax.axis_index(AXIS)
+            local_slot = recv["__slot__"] - my.astype(jnp.int64) * spd
+            new_state = apply_kernel(
+                state, local_slot, recv["__ts__"], rvalid,
+                {k: v for k, v in recv.items() if not k.startswith("__")},
+                agg=agg, pane_ms=plan.pane_ms, offset_ms=plan.offset_ms,
+                ring=plan.ring, dump_row=layout.slots)
+            return new_state, lax.psum(jnp.sum(overflow), AXIS)
+
+        def fire_shard(state, end_panes, w_valid, lo, hi):
+            return fire_kernel(state, end_panes, w_valid, lo, hi,
+                               panes_per_window=plan.panes_per_window,
+                               ring=plan.ring)
+
+        state_spec = jax.tree_util.tree_map(lambda _: P(AXIS), self.state)
+        batch_spec = P(AXIS)
+        rep = P()
+
+        self._apply_sharded = jax.jit(
+            jax.shard_map(
+                apply_shard, mesh=mp.mesh,
+                in_specs=(state_spec, batch_spec, batch_spec, batch_spec, batch_spec),
+                out_specs=(state_spec, rep),
+            )
+        )
+        self._fire = jax.jit(
+            jax.shard_map(
+                fire_shard, mesh=mp.mesh,
+                in_specs=(state_spec, rep, rep, rep, rep),
+                out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+            )
+        )
+        self._clear = jax.jit(
+            jax.shard_map(
+                clear_kernel, mesh=mp.mesh,
+                in_specs=(state_spec, rep),
+                out_specs=state_spec,
+            )
+        )
 
     # -- data path -------------------------------------------------------
     def process_batch(
@@ -365,9 +463,26 @@ class WindowOperator:
             # is the round-2 home for these)
             valid = valid & ~bad
         from flink_tpu.records import device_cast
-        self.state = self._apply(
-            self.state, jnp.asarray(slots), jnp.asarray(ts), jnp.asarray(valid),
-            {k: jnp.asarray(device_cast(v)) for k, v in data.items()})
+        data = {k: device_cast(v) for k, v in data.items()}
+        if self.mesh_plan is None:
+            self.state = self._apply(
+                self.state, jnp.asarray(slots), jnp.asarray(ts), jnp.asarray(valid),
+                {k: jnp.asarray(v) for k, v in data.items()})
+        else:
+            # pad batch to a multiple of the device count (arrival split)
+            n_dev = self.mesh_plan.n_devices
+            b = len(ts)
+            pad = (-b) % n_dev
+            if pad:
+                slots = np.concatenate([slots, np.zeros(pad, np.int64)])
+                ts = np.concatenate([ts, np.zeros(pad, np.int64)])
+                valid = np.concatenate([valid, np.zeros(pad, bool)])
+                data = {k: np.concatenate([v, np.zeros((pad,) + v.shape[1:], v.dtype)])
+                        for k, v in data.items()}
+            self.state, overflow = self._apply_sharded(
+                self.state, jnp.asarray(slots), jnp.asarray(ts), jnp.asarray(valid),
+                {k: jnp.asarray(v) for k, v in data.items()})
+            self.exchange_overflow += int(overflow)
 
     # -- time path -------------------------------------------------------
     def advance_watermark(self, wm: int) -> Dict[str, np.ndarray]:
@@ -427,29 +542,41 @@ class WindowOperator:
         return self._emit(np.asarray(sums), np.asarray(maxs), np.asarray(mins),
                           np.asarray(counts), ends)
 
+    def _row_of_slots(self, slots: np.ndarray) -> np.ndarray:
+        """Global slot id → row in the state array (sharded state carries
+        one dump row per device block)."""
+        if self.mesh_plan is None:
+            return slots
+        return self.mesh_plan.global_slot_to_row(slots)
+
+    def _slot_of_rows(self, rows: np.ndarray) -> np.ndarray:
+        if self.mesh_plan is None:
+            return rows
+        return rows - rows // self.layout.rows
+
     def _emit(self, sums, maxs, mins, counts, ends: List[int]) -> Dict[str, np.ndarray]:
         """Select non-empty (registered-key, window) cells and finalize.
         ref role: InternalSingleValueWindowFunction.process + collector."""
-        used = self.directory.used_mask()
-        rows = self.layout.slots
-        nonzero = counts[:rows] > 0                       # (rows, W)
-        nonzero &= used[:, None]
-        slot_ix, w_ix = np.nonzero(nonzero)
-        if len(slot_ix) == 0:
+        used_rows = np.zeros(counts.shape[0], dtype=bool)
+        used_slots = np.nonzero(self.directory.used_mask())[0]
+        used_rows[self._row_of_slots(used_slots)] = True
+        nonzero = (counts > 0) & used_rows[:, None]       # (rows, W)
+        row_ix, w_ix = np.nonzero(nonzero)
+        if len(row_ix) == 0:
             return _empty_fired(self.agg)
         res = self.agg.finalize(
-            jnp.asarray(sums[slot_ix, w_ix]),
-            jnp.asarray(maxs[slot_ix, w_ix]),
-            jnp.asarray(mins[slot_ix, w_ix]),
-            jnp.asarray(counts[slot_ix, w_ix]),
+            jnp.asarray(sums[row_ix, w_ix]),
+            jnp.asarray(maxs[row_ix, w_ix]),
+            jnp.asarray(mins[row_ix, w_ix]),
+            jnp.asarray(counts[row_ix, w_ix]),
         )
         ends_arr = np.asarray(ends, dtype=np.int64)[w_ix]
         window_end = ends_arr * self.plan.pane_ms + self.plan.offset_ms
         out: Dict[str, np.ndarray] = {
-            "key": self.directory.key_of_slots(slot_ix),
+            "key": self.directory.key_of_slots(self._slot_of_rows(row_ix)),
             "window_start": window_end - self.plan.size_ms,
             "window_end": window_end,
-            "count": counts[slot_ix, w_ix],
+            "count": counts[row_ix, w_ix],
         }
         for k, v in res.items():
             out[k] = np.asarray(v)
@@ -470,7 +597,10 @@ class WindowOperator:
         }
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
-        self.state = jax.tree_util.tree_map(jnp.asarray, snap["panes"])
+        state = jax.tree_util.tree_map(jnp.asarray, snap["panes"])
+        if self.mesh_plan is not None:
+            state = jax.device_put(state, self.mesh_plan.row_sharding())
+        self.state = state
         self.directory = KeyDirectory.restore(
             self.directory.num_shards, self.directory.slots_per_shard,
             snap["directory"], (self.directory.shard_lo, self.directory.shard_hi))

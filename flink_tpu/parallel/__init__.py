@@ -1,0 +1,3 @@
+from flink_tpu.parallel.mesh import MeshPlan, make_mesh_plan, AXIS
+
+__all__ = ["MeshPlan", "make_mesh_plan", "AXIS"]
