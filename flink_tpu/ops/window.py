@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+from collections.abc import Mapping
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -114,6 +115,51 @@ def fire_kernel(
     counts = jnp.sum(jnp.where(m2, state.counts[:, ring_ix], 0), axis=2)    # (rows, W)
     counts = jnp.where(w_valid[None, :], counts, 0)
     return sums, maxs, mins, counts
+
+
+def fire_pack_kernel(
+    state: PaneState,
+    end_panes: jax.Array,   # (W,) int64
+    w_valid: jax.Array,     # (W,) bool
+    pane_lo: jax.Array,
+    pane_hi: jax.Array,
+    used_mask: jax.Array,   # (rows,) bool — registered-key rows
+    *,
+    agg: LaneAggregate,
+    panes_per_window: int,
+    ring: int,
+) -> Dict[str, jax.Array]:
+    """fire + select + finalize entirely on device, returning packed
+    fixed-size arrays so the host needs exactly ONE transfer per firing
+    watermark advance (the device→host round trip is the latency floor
+    of the emit path — batch everything into it).
+
+    Output arrays have static length rows*W; entries past ``n`` are
+    padding. ref role: the whole onEventTime → emitWindowContents →
+    Collector.collect chain, batched."""
+    sums, maxs, mins, counts = fire_kernel(
+        state, end_panes, w_valid, pane_lo, pane_hi,
+        panes_per_window=panes_per_window, ring=ring)
+    rows = counts.shape[0]
+    W = end_panes.shape[0]
+    nz = (counts > 0) & used_mask[:, None] & w_valid[None, :]
+    flat = nz.reshape(-1)
+    k = rows * W
+    idx = jnp.nonzero(flat, size=k, fill_value=k)[0]
+    row = (idx // W).astype(jnp.int32)
+    wi = (idx % W).astype(jnp.int32)
+    row_c = jnp.minimum(row, rows - 1)
+    sel_counts = counts[row_c, wi]
+    res = agg.finalize(sums[row_c, wi], maxs[row_c, wi], mins[row_c, wi], sel_counts)
+    out = {
+        "__row__": row,
+        "__end_pane__": end_panes[wi],
+        "count": sel_counts,
+        "__n__": jnp.sum(flat),
+    }
+    for name, v in res.items():
+        out[name] = v
+    return out
 
 
 def clear_kernel(state: PaneState, clear_mask: jax.Array) -> PaneState:
@@ -312,9 +358,10 @@ class WindowOperator:
                 dump_row=self.layout.slots,
             )
         )
-        self._fire = jax.jit(
+        self._fire_pack = jax.jit(
             functools.partial(
-                fire_kernel,
+                fire_pack_kernel,
+                agg=self.agg,
                 panes_per_window=self.plan.panes_per_window,
                 ring=self.plan.ring,
             )
@@ -366,10 +413,17 @@ class WindowOperator:
                 ring=plan.ring, dump_row=layout.slots)
             return new_state, lax.psum(jnp.sum(overflow), AXIS)
 
-        def fire_shard(state, end_panes, w_valid, lo, hi):
-            return fire_kernel(state, end_panes, w_valid, lo, hi,
-                               panes_per_window=plan.panes_per_window,
-                               ring=plan.ring)
+        rows_local = layout.rows
+
+        def fire_shard(state, end_panes, w_valid, lo, hi, used_mask):
+            packed = fire_pack_kernel(
+                state, end_panes, w_valid, lo, hi, used_mask,
+                agg=agg, panes_per_window=plan.panes_per_window, ring=plan.ring)
+            # globalize row ids (each device block carries its own rows)
+            my = lax.axis_index(AXIS).astype(jnp.int32)
+            packed["__row__"] = packed["__row__"] + my * rows_local
+            packed["__n__"] = packed["__n__"].reshape(1)
+            return packed
 
         state_spec = jax.tree_util.tree_map(lambda _: P(AXIS), self.state)
         batch_spec = P(AXIS)
@@ -382,11 +436,11 @@ class WindowOperator:
                 out_specs=(state_spec, rep),
             )
         )
-        self._fire = jax.jit(
+        self._fire_pack = jax.jit(
             jax.shard_map(
                 fire_shard, mesh=mp.mesh,
-                in_specs=(state_spec, rep, rep, rep, rep),
-                out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+                in_specs=(state_spec, rep, rep, rep, rep, P(AXIS)),
+                out_specs=P(AXIS),
             )
         )
         self._clear = jax.jit(
@@ -485,12 +539,14 @@ class WindowOperator:
             self.exchange_overflow += int(overflow)
 
     # -- time path -------------------------------------------------------
-    def advance_watermark(self, wm: int) -> Dict[str, np.ndarray]:
+    def advance_watermark(self, wm: int) -> "FiredWindows":
         """Advance event time; fire newly-complete windows plus pending
         re-fires; purge dead panes. Returns the fired-window batch
-        (key, window_start, window_end, count, result fields...)."""
+        (key, window_start, window_end, count, result fields...) as a
+        lazy ``FiredWindows`` — the device work is dispatched here, the
+        single device→host transfer happens on first access."""
         if wm < self.watermark or (wm == self.watermark and not self._refire):
-            return _empty_fired(self.agg)
+            return self._empty()
         prev = self.watermark
         self.watermark = wm
 
@@ -524,23 +580,80 @@ class WindowOperator:
             self._cleared_below = new_dead
         return out
 
-    def _fire_ends(self, ends: List[int]) -> Dict[str, np.ndarray]:
+    def _fire_ends(self, ends: List[int]) -> "FiredWindows":
         if not ends or self._max_pane_seen is None:
-            return _empty_fired(self.agg)
+            return self._empty()
         # windows entirely outside the written pane range are empty — skip
         lo = max(self._cleared_below, self._min_pane_seen)
         hi = self._max_pane_seen
         ppw = self.plan.panes_per_window
         ends = [e for e in ends if e > lo and e - ppw <= hi]
         if not ends:
-            return _empty_fired(self.agg)
+            return self._empty()
+        # pad the window axis to a power of two so the fire kernel
+        # compiles once per bucket size, not once per distinct fire count
         W = len(ends)
-        end_arr = jnp.asarray(np.asarray(ends, dtype=np.int64))
-        w_valid = jnp.ones(W, dtype=bool)
-        sums, maxs, mins, counts = self._fire(
-            self.state, end_arr, w_valid, jnp.int64(lo), jnp.int64(hi))
-        return self._emit(np.asarray(sums), np.asarray(maxs), np.asarray(mins),
-                          np.asarray(counts), ends)
+        Wp = 1
+        while Wp < W:
+            Wp *= 2
+        ends_padded = ends + [ends[-1]] * (Wp - W)
+        end_arr = jnp.asarray(np.asarray(ends_padded, dtype=np.int64))
+        w_valid = jnp.asarray(np.arange(Wp) < W)
+        packed = self._fire_pack(
+            self.state, end_arr, w_valid, jnp.int64(lo), jnp.int64(hi),
+            self._used_mask_device())
+        return FiredWindows(fetch=functools.partial(self._materialize, packed))
+
+    def _materialize(self, packed: Dict[str, jax.Array]) -> Dict[str, np.ndarray]:
+        """ONE device→host round trip for the whole fired batch, then
+        host-side decoration (slot → original key, pane → window times)."""
+        h = jax.device_get(packed)
+        if self.mesh_plan is None:
+            segs = [(h, 0, int(h["__n__"]))]
+        else:
+            k_local = len(h["__row__"]) // self.mesh_plan.n_devices
+            segs = [
+                (h, d * k_local, d * k_local + int(n))
+                for d, n in enumerate(h["__n__"])
+            ]
+        fields = [k for k in h if not k.startswith("__")]
+        parts = {k: [] for k in fields}
+        rows_l = []
+        ends_l = []
+        for seg, a, b in segs:
+            rows_l.append(seg["__row__"][a:b])
+            ends_l.append(seg["__end_pane__"][a:b])
+            for k in fields:
+                parts[k].append(seg[k][a:b])
+        rows = np.concatenate(rows_l) if rows_l else np.zeros(0, np.int32)
+        end_pane = np.concatenate(ends_l) if ends_l else np.zeros(0, np.int64)
+        window_end = end_pane * self.plan.pane_ms + self.plan.offset_ms
+        out: Dict[str, np.ndarray] = {
+            "key": self.directory.key_of_slots(self._slot_of_rows(rows)),
+            "window_start": window_end - self.plan.size_ms,
+            "window_end": window_end,
+        }
+        for k in fields:
+            out[k] = np.concatenate(parts[k])
+        return out
+
+    def _used_mask_device(self) -> jax.Array:
+        """(rows,) bool on device, marking registered-key rows; re-pushed
+        only when the directory registered new keys (h2d is cheap and
+        one-way; the d2h round trip is what the packed fire avoids)."""
+        nk = self.directory.num_keys()
+        if getattr(self, "_used_pushed", -1) != nk:
+            n_rows = self.layout.rows * (
+                self.mesh_plan.n_devices if self.mesh_plan else 1)
+            used = np.zeros(n_rows, dtype=bool)
+            used_slots = np.nonzero(self.directory.used_mask())[0]
+            used[self._row_of_slots(used_slots)] = True
+            if self.mesh_plan is not None:
+                self._used_dev = jax.device_put(used, self.mesh_plan.row_sharding())
+            else:
+                self._used_dev = jnp.asarray(used)
+            self._used_pushed = nk
+        return self._used_dev
 
     def _row_of_slots(self, slots: np.ndarray) -> np.ndarray:
         """Global slot id → row in the state array (sharded state carries
@@ -554,33 +667,12 @@ class WindowOperator:
             return rows
         return rows - rows // self.layout.rows
 
-    def _emit(self, sums, maxs, mins, counts, ends: List[int]) -> Dict[str, np.ndarray]:
-        """Select non-empty (registered-key, window) cells and finalize.
-        ref role: InternalSingleValueWindowFunction.process + collector."""
-        used_rows = np.zeros(counts.shape[0], dtype=bool)
-        used_slots = np.nonzero(self.directory.used_mask())[0]
-        used_rows[self._row_of_slots(used_slots)] = True
-        nonzero = (counts > 0) & used_rows[:, None]       # (rows, W)
-        row_ix, w_ix = np.nonzero(nonzero)
-        if len(row_ix) == 0:
-            return _empty_fired(self.agg)
-        res = self.agg.finalize(
-            jnp.asarray(sums[row_ix, w_ix]),
-            jnp.asarray(maxs[row_ix, w_ix]),
-            jnp.asarray(mins[row_ix, w_ix]),
-            jnp.asarray(counts[row_ix, w_ix]),
-        )
-        ends_arr = np.asarray(ends, dtype=np.int64)[w_ix]
-        window_end = ends_arr * self.plan.pane_ms + self.plan.offset_ms
-        out: Dict[str, np.ndarray] = {
-            "key": self.directory.key_of_slots(self._slot_of_rows(row_ix)),
-            "window_start": window_end - self.plan.size_ms,
-            "window_end": window_end,
-            "count": counts[row_ix, w_ix],
-        }
-        for k, v in res.items():
-            out[k] = np.asarray(v)
-        return out
+    def _empty(self) -> "FiredWindows":
+        """Cached empty fired-batch (a fresh one would dispatch tiny
+        device ops on every no-op watermark advance)."""
+        if not hasattr(self, "_empty_cache"):
+            self._empty_cache = _empty_fired(self.agg)
+        return FiredWindows(data=dict(self._empty_cache))
 
     # -- snapshot seam (checkpoint/ uses this) ---------------------------
     def snapshot_state(self) -> Dict[str, Any]:
@@ -611,6 +703,38 @@ class WindowOperator:
         self._max_pane_seen = snap["max_pane_seen"]
         self._refire = set(snap["refire"])
         self.late_records = snap["late_records"]
+        self._used_pushed = -1  # directory changed: invalidate device used-mask
+
+
+class FiredWindows(Mapping):
+    """A fired-window batch with lazy host materialization.
+
+    The device work (fire + select + finalize) was already dispatched
+    when this object was created; only the device→host transfer is
+    deferred to first access. The runtime driver drains these on a
+    separate thread — the analogue of the reference handing serialized
+    buffers to Netty's IO thread off the mailbox thread (ref:
+    runtime/io/network/api/writer/RecordWriter.java → PipelinedSubpartition
+    .notifyDataAvailable), so emission latency never blocks ingest."""
+
+    def __init__(self, data: Optional[Dict[str, np.ndarray]] = None, fetch=None):
+        self._data = data
+        self._fetch = fetch
+
+    def materialize(self) -> Dict[str, np.ndarray]:
+        if self._data is None:
+            self._data = self._fetch()
+            self._fetch = None
+        return self._data
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        return self.materialize()[key]
+
+    def __iter__(self):
+        return iter(self.materialize())
+
+    def __len__(self) -> int:
+        return len(self.materialize())
 
 
 def _empty_fired(agg: LaneAggregate) -> Dict[str, np.ndarray]:
