@@ -1,0 +1,261 @@
+"""The fluent DataStream API.
+
+ref: streaming/api/datastream/{DataStream,KeyedStream,WindowedStream,
+DataStreamSource,SingleOutputStreamOperator,JoinedStreams}.java — the
+reference's primary user API. Each call appends a Transformation; nothing
+runs until ``StreamExecutionEnvironment.execute()``.
+
+TPU-first deltas: user functions are jax-traceable **batch** functions
+over struct-of-arrays dicts (fused into one compiled step per stage, the
+chaining analogue), filter is a validity-mask AND (no compaction under
+jit), flat_map has a static max fan-out, and keys are int64 columns
+(strings must be dictionary-encoded in a prior map — strings never reach
+the device).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from flink_tpu.api.windowing import (
+    EventTimeSessionWindows,
+    Trigger,
+    WindowAssigner,
+)
+from flink_tpu.graph.transformations import (
+    KeyByTransformation,
+    MapTransformation,
+    SessionAggregateTransformation,
+    SinkTransformation,
+    Transformation,
+    UnionTransformation,
+    WindowAggregateTransformation,
+    WindowJoinTransformation,
+)
+from flink_tpu.ops.aggregates import LaneAggregate
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+
+class DataStream:
+    """ref: streaming/api/datastream/DataStream.java"""
+
+    def __init__(self, env: "StreamExecutionEnvironment", transform: Transformation):
+        self.env = env
+        self.transform = transform
+
+    # -- stateless ops (chained) -----------------------------------------
+    def map(self, fn: Callable, name: str = "map") -> "DataStream":
+        """``fn(data_dict) -> data_dict`` over (B,) field arrays —
+        jax-traceable, traced once into the stage step function
+        (ref: DataStream.map → StreamMap)."""
+
+        def op(data, ts, valid):
+            return fn(data), ts, valid
+
+        return self._append(MapTransformation(name, (self.transform,), fn=op, kind="map"))
+
+    def map_with_timestamps(self, fn: Callable, name: str = "map_ts") -> "DataStream":
+        """``fn(data, ts, valid) -> (data, ts, valid)`` — full-control map
+        (reassign timestamps, e.g. event-time extraction)."""
+        return self._append(MapTransformation(name, (self.transform,), fn=fn, kind="map"))
+
+    def filter(self, pred: Callable, name: str = "filter") -> "DataStream":
+        """``pred(data_dict) -> (B,) bool`` (ref: DataStream.filter →
+        StreamFilter). Lowered to a validity-mask AND."""
+
+        def op(data, ts, valid):
+            return data, ts, valid & pred(data)
+
+        return self._append(MapTransformation(name, (self.transform,), fn=op, kind="filter"))
+
+    def flat_map(self, fn: Callable, name: str = "flat_map") -> "DataStream":
+        """``fn(data, ts, valid) -> (data', ts', valid')`` with any output
+        length (ref: DataStream.flatMap → StreamFlatMap). Ingest chains
+        execute on the HOST (numpy), so fan-out is unconstrained here;
+        only device-fused functions need the static-fan-out form
+        (api/functions.FlatMapFunction.max_fanout)."""
+        return self._append(MapTransformation(name, (self.transform,), fn=fn, kind="flatmap"))
+
+    def assign_timestamps_and_watermarks(
+        self, strategy: WatermarkStrategy, ts_field: Optional[str] = None,
+        name: str = "assign_ts",
+    ) -> "DataStream":
+        """ref: DataStream.assignTimestampsAndWatermarks. With ts_field,
+        record timestamps are re-read from that column."""
+        self.env._watermark_strategy = strategy
+        if ts_field is None:
+            return self
+
+        def op(data, ts, valid):
+            return data, data[ts_field].astype(np.int64), valid
+
+        return self._append(MapTransformation(name, (self.transform,), fn=op, kind="map"))
+
+    def union(self, *others: "DataStream") -> "DataStream":
+        inputs = (self.transform,) + tuple(o.transform for o in others)
+        return self._append(UnionTransformation("union", inputs))
+
+    # -- keying ----------------------------------------------------------
+    def key_by(self, key: Union[str, Callable], name: str = "keyBy") -> "KeyedStream":
+        """ref: DataStream.keyBy → KeyedStream. ``key`` is an int64 column
+        name, or a device fn(data_dict)->(B,) int64 evaluated in-stage."""
+        if callable(key):
+            t = KeyByTransformation(name, (self.transform,), key_field="__key__", key_fn=key)
+            t.key_field = f"__key_{t.id}__"  # unique per keyBy: two keyBys
+            # off one stream must not clobber each other's derived column
+        else:
+            t = KeyByTransformation(name, (self.transform,), key_field=key)
+        self.env._register(t)
+        return KeyedStream(self.env, t)
+
+    # -- joins -----------------------------------------------------------
+    def join(self, other: "DataStream") -> "JoinBuilder":
+        """ref: DataStream.join → JoinedStreams (where/equalTo/window)."""
+        return JoinBuilder(self, other)
+
+    # -- sinks -----------------------------------------------------------
+    def add_sink(self, sink: Any, name: str = "sink") -> "DataStream":
+        return self._append(SinkTransformation(name, (self.transform,), sink=sink))
+
+    def print(self, prefix: str = "", limit: Optional[int] = None) -> "DataStream":
+        from flink_tpu.api.sinks import PrintSink
+
+        return self.add_sink(PrintSink(prefix, limit), name="print")
+
+    def collect(self) -> "Any":
+        """Attach a CollectSink and return it (materializes at execute();
+        ref: DataStream.executeAndCollect)."""
+        from flink_tpu.api.sinks import CollectSink
+
+        sink = CollectSink()
+        self.add_sink(sink, name="collect")
+        return sink
+
+    def _append(self, t: Transformation) -> "DataStream":
+        self.env._register(t)
+        return DataStream(self.env, t)
+
+
+class KeyedStream(DataStream):
+    """ref: streaming/api/datastream/KeyedStream.java"""
+
+    def window(self, assigner: WindowAssigner) -> "WindowedStream":
+        if isinstance(assigner, EventTimeSessionWindows):
+            return SessionWindowedStream(self, assigner)
+        return WindowedStream(self, assigner)
+
+    def count_window(self, size: int) -> "WindowedStream":
+        raise NotImplementedError(
+            "count windows pending; use time windows with CountTrigger")
+
+    # keyed reduce without windows = running aggregate over an eternal
+    # window; expressible via GlobalWindows + custom trigger (later).
+
+
+class WindowedStream:
+    """ref: streaming/api/datastream/WindowedStream.java"""
+
+    def __init__(self, keyed: KeyedStream, assigner: WindowAssigner):
+        self.keyed = keyed
+        self.assigner = assigner
+        self._lateness = 0
+        self._trigger: Optional[Trigger] = None
+
+    def allowed_lateness(self, ms: int) -> "WindowedStream":
+        self._lateness = ms
+        return self
+
+    def trigger(self, trigger: Trigger) -> "WindowedStream":
+        self._trigger = trigger
+        return self
+
+    def aggregate(self, agg: LaneAggregate, name: str = "window_agg") -> DataStream:
+        """ref: WindowedStream.aggregate(AggregateFunction) — but taking
+        the lane-lowered form directly; ``lower_aggregate`` adapts
+        reference-style AggregateFunction classes."""
+        kt = self.keyed.transform
+        assert isinstance(kt, KeyByTransformation)
+        t = WindowAggregateTransformation(
+            name, (kt,),
+            assigner=self.assigner, aggregate=agg, trigger=self._trigger,
+            allowed_lateness_ms=self._lateness, key_field=kt.key_field)
+        self.keyed.env._register(t)
+        return DataStream(self.keyed.env, t)
+
+    def count(self) -> DataStream:
+        from flink_tpu.ops.aggregates import count as count_agg
+
+        return self.aggregate(count_agg())
+
+    def sum(self, field: str) -> DataStream:
+        from flink_tpu.ops.aggregates import sum_of
+
+        return self.aggregate(sum_of(field))
+
+    def max(self, field: str) -> DataStream:
+        from flink_tpu.ops.aggregates import max_of
+
+        return self.aggregate(max_of(field))
+
+    def min(self, field: str) -> DataStream:
+        from flink_tpu.ops.aggregates import min_of
+
+        return self.aggregate(min_of(field))
+
+
+class SessionWindowedStream(WindowedStream):
+    def aggregate(self, agg: LaneAggregate, name: str = "session_agg") -> DataStream:
+        kt = self.keyed.transform
+        assert isinstance(kt, KeyByTransformation)
+        t = SessionAggregateTransformation(
+            name, (kt,), gap_ms=self.assigner.gap, aggregate=agg,
+            allowed_lateness_ms=self._lateness, key_field=kt.key_field)
+        self.keyed.env._register(t)
+        return DataStream(self.keyed.env, t)
+
+
+class JoinBuilder:
+    """where/equalTo/window/apply chain (ref: JoinedStreams.java)."""
+
+    def __init__(self, left: DataStream, right: DataStream):
+        self._left = left
+        self._right = right
+        self._left_key: Optional[str] = None
+        self._right_key: Optional[str] = None
+
+    def where(self, key_field: str) -> "JoinBuilder":
+        self._left_key = key_field
+        return self
+
+    def equal_to(self, key_field: str) -> "JoinBuilder":
+        self._right_key = key_field
+        return self
+
+    def window(self, assigner: WindowAssigner) -> "WindowedJoin":
+        return WindowedJoin(self, assigner)
+
+
+class WindowedJoin:
+    def __init__(self, builder: JoinBuilder, assigner: WindowAssigner):
+        self.b = builder
+        self.assigner = assigner
+
+    def apply(
+        self,
+        left_fields: Sequence[str] = (),
+        right_fields: Sequence[str] = (),
+        name: str = "window_join",
+    ) -> DataStream:
+        """Emit one row per (key, window) present on BOTH sides, carrying
+        selected aggregated fields from each (see ops/join.py for the
+        exact per-pair semantics vs the reference's cross-product)."""
+        env = self.b._left.env
+        t = WindowJoinTransformation(
+            name, (self.b._left.transform, self.b._right.transform),
+            assigner=self.assigner,
+            left_key=self.b._left_key or "key",
+            right_key=self.b._right_key or "key",
+            left_fields=tuple(left_fields), right_fields=tuple(right_fields))
+        env._register(t)
+        return DataStream(env, t)

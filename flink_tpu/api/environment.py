@@ -1,0 +1,94 @@
+"""StreamExecutionEnvironment — program entry and execution.
+
+ref: streaming/api/environment/StreamExecutionEnvironment.java
+(getExecutionEnvironment, fromCollection/fromSource, execute →
+StreamGraphGenerator → JobGraph → submission).
+
+TPU-first: ``execute()`` lowers the transformation DAG to fused stages
+(graph/compiler.py) and runs them on the local driver (runtime/driver.py)
+over the configured device mesh — the LocalExecutor/MiniCluster path.
+Remote submission to a coordinator process reuses the same lowered plan
+(runtime/coordinator.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional
+
+import numpy as np
+
+from flink_tpu.api.datastream import DataStream
+from flink_tpu.api.sources import CollectionSource, Source
+from flink_tpu.config import Configuration
+from flink_tpu.graph.transformations import SourceTransformation, Transformation
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+
+class StreamExecutionEnvironment:
+    def __init__(self, config: Optional[Configuration] = None):
+        self.config = config or Configuration()
+        self._transforms: List[Transformation] = []
+        self._watermark_strategy = WatermarkStrategy.for_monotonous_timestamps()
+
+    @classmethod
+    def get_execution_environment(
+        cls, config: Optional[Configuration] = None
+    ) -> "StreamExecutionEnvironment":
+        return cls(config)
+
+    # -- sources ---------------------------------------------------------
+    def from_source(
+        self,
+        source: Source,
+        watermark_strategy: Optional[WatermarkStrategy] = None,
+        name: str = "source",
+    ) -> DataStream:
+        t = SourceTransformation(name, (), source=source,
+                                 watermark_strategy=watermark_strategy)
+        self._register(t)
+        return DataStream(self, t)
+
+    def from_collection(
+        self,
+        data: Mapping[str, np.ndarray],
+        timestamps: np.ndarray,
+        batch_size: Optional[int] = None,
+        name: str = "collection",
+    ) -> DataStream:
+        from flink_tpu.config import PipelineOptions
+
+        bs = batch_size or self.config.get(PipelineOptions.MICROBATCH_SIZE)
+        return self.from_source(
+            CollectionSource(dict(data), np.asarray(timestamps, np.int64), bs),
+            name=name)
+
+    def _register(self, t: Transformation) -> None:
+        self._transforms.append(t)
+
+    # -- execution -------------------------------------------------------
+    def execute(self, job_name: str = "job") -> "JobResult":
+        """Lower and run to completion (bounded) or until cancelled
+        (ref: execute → LocalExecutor → MiniCluster.submitJob)."""
+        from flink_tpu.graph.compiler import compile_job
+        from flink_tpu.runtime.driver import Driver
+
+        plan = compile_job(self._transforms, self.config, self._watermark_strategy)
+        driver = Driver(plan, self.config)
+        return driver.run(job_name)
+
+    def compile_plan(self):
+        """Lowered execution plan without running (inspection/tests —
+        the getExecutionPlan analogue)."""
+        from flink_tpu.graph.compiler import compile_job
+
+        return compile_job(self._transforms, self.config, self._watermark_strategy)
+
+
+class JobResult:
+    """ref: api/common/JobExecutionResult.java"""
+
+    def __init__(self, job_name: str, metrics: Dict[str, Any]):
+        self.job_name = job_name
+        self.metrics = metrics
+
+    def __repr__(self) -> str:
+        return f"JobResult({self.job_name}, {self.metrics})"

@@ -1,0 +1,112 @@
+"""Sinks — consume fired/transformed batches on the host.
+
+ref: Sink API v2 (flink-core/.../api/connector/sink2/{Sink,SinkWriter,
+Committer}.java). The exactly-once contract: a sink buffers writes per
+checkpoint epoch and commits them only on ``notify_checkpoint_complete``
+(the reference's two-phase-commit sink protocol, ref: streaming/runtime/
+operators/sink/CommitterOperator.java); non-transactional sinks just
+write through.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class Sink:
+    def write(self, batch: Dict[str, np.ndarray]) -> None:
+        raise NotImplementedError
+
+    # -- exactly-once seam ------------------------------------------------
+    def prepare_commit(self, checkpoint_id: int) -> None:
+        """Stage everything written since the previous barrier under this
+        checkpoint id (ref: SinkWriter.prepareCommit)."""
+
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        """Commit staged epochs <= checkpoint_id (ref: Committer.commit)."""
+
+    def close(self) -> None:
+        pass
+
+
+@dataclasses.dataclass
+class CollectSink(Sink):
+    """Gather results in memory (ref: DataStream.executeAndCollect)."""
+
+    rows: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+
+    def write(self, batch: Dict[str, np.ndarray]) -> None:
+        if not batch:
+            return
+        n = len(next(iter(batch.values())))
+        for i in range(n):
+            self.rows.append({k: v[i] for k, v in batch.items()})
+
+    def batches(self) -> List[Dict[str, np.ndarray]]:
+        return self.rows
+
+
+@dataclasses.dataclass
+class PrintSink(Sink):
+    """ref: DataStream.print / PrintSinkFunction."""
+
+    prefix: str = ""
+    limit: Optional[int] = None
+    _printed: int = 0
+
+    def write(self, batch: Dict[str, np.ndarray]) -> None:
+        if not batch:
+            return
+        n = len(next(iter(batch.values())))
+        for i in range(n):
+            if self.limit is not None and self._printed >= self.limit:
+                return
+            row = {k: v[i] for k, v in batch.items()}
+            print(f"{self.prefix}{row}")
+            self._printed += 1
+
+
+@dataclasses.dataclass
+class FnSink(Sink):
+    """Adapter for a plain callable(batch_dict)."""
+
+    fn: Callable[[Dict[str, np.ndarray]], None]
+
+    def write(self, batch: Dict[str, np.ndarray]) -> None:
+        self.fn(batch)
+
+
+@dataclasses.dataclass
+class TransactionalCollectSink(Sink):
+    """Exactly-once collect: rows become visible only when their epoch's
+    checkpoint completes; uncommitted epochs are discarded on restore
+    (the TwoPhaseCommitSinkFunction contract, ref: streaming/api/
+    functions/sink/TwoPhaseCommitSinkFunction.java)."""
+
+    committed: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        self._pending: List[Dict[str, Any]] = []
+        self._staged: Dict[int, List[Dict[str, Any]]] = {}
+
+    def write(self, batch: Dict[str, np.ndarray]) -> None:
+        if not batch:
+            return
+        n = len(next(iter(batch.values())))
+        for i in range(n):
+            self._pending.append({k: v[i] for k, v in batch.items()})
+
+    def prepare_commit(self, checkpoint_id: int) -> None:
+        self._staged[checkpoint_id] = self._pending
+        self._pending = []
+
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        for cid in sorted([c for c in self._staged if c <= checkpoint_id]):
+            self.committed.extend(self._staged.pop(cid))
+
+    def abort_uncommitted(self) -> None:
+        """Restore path: drop staged-but-uncommitted epochs."""
+        self._staged.clear()
+        self._pending = []

@@ -1,0 +1,199 @@
+"""Graph lowering: Transformation DAG → executable stage plan.
+
+ref: the two-step lowering StreamGraphGenerator (streaming/api/graph/
+StreamGraphGenerator.java) → StreamingJobGraphGenerator.createJobGraph
+(chaining decided in ``isChainable``). Here the chaining analogue fuses
+every run of stateless transformations between stateful/exchange
+boundaries into ONE host ingest function per stage — and the heavy
+lifting (keyed window state, shuffles, aggregation) is inside the
+stateful ops' compiled device programs.
+
+The plan is a DAG of ExecNodes the driver walks per microbatch:
+  ExecSource → ExecChain (fused stateless fns) → ExecWindowAgg /
+  ExecSessionAgg / ExecJoin → ExecChain → ExecSink
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flink_tpu.config import Configuration, PipelineOptions, StateOptions
+from flink_tpu.graph.transformations import (
+    KeyByTransformation,
+    MapTransformation,
+    SessionAggregateTransformation,
+    SinkTransformation,
+    SourceTransformation,
+    Transformation,
+    UnionTransformation,
+    WindowAggregateTransformation,
+    WindowJoinTransformation,
+)
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+
+@dataclasses.dataclass
+class ExecNode:
+    id: int
+    kind: str                 # source | chain | window | session | join | sink | union
+    downstream: List[int] = dataclasses.field(default_factory=list)
+    # kind-specific payloads
+    source: Any = None
+    watermark_strategy: Optional[WatermarkStrategy] = None
+    fns: List[Callable] = dataclasses.field(default_factory=list)
+    key_field: str = "key"
+    key_fn: Optional[Callable] = None
+    window_transform: Any = None
+    sink: Any = None
+    # join: which input edge is left/right (by upstream node id)
+    left_input: Optional[int] = None
+    right_input: Optional[int] = None
+    name: str = ""
+
+
+@dataclasses.dataclass
+class ExecutionPlan:
+    nodes: Dict[int, ExecNode]
+    sources: List[int]
+    topo_order: List[int]
+    watermark_strategy: WatermarkStrategy
+
+    def node(self, nid: int) -> ExecNode:
+        return self.nodes[nid]
+
+
+def compile_job(
+    transforms: Sequence[Transformation],
+    config: Configuration,
+    default_wm: WatermarkStrategy,
+) -> ExecutionPlan:
+    """Lower the transformation list. Chaining rule (the isChainable
+    analogue): consecutive Map/Filter/FlatMap nodes with a single
+    consumer fuse into one ExecChain; KeyBy folds into the downstream
+    stateful op (the exchange lives inside its device program)."""
+    # consumers per transformation
+    consumers: Dict[int, List[Transformation]] = {}
+    for t in transforms:
+        for up in t.inputs:
+            consumers.setdefault(up.id, []).append(t)
+
+    nodes: Dict[int, ExecNode] = {}
+    t2node: Dict[int, int] = {}  # transformation id -> exec node id
+    next_id = [0]
+
+    def new_node(kind: str, name: str, **kw) -> ExecNode:
+        n = ExecNode(id=next_id[0], kind=kind, name=name, **kw)
+        next_id[0] += 1
+        nodes[n.id] = n
+        return n
+
+    def node_for(t: Transformation) -> int:
+        """Exec node that PRODUCES t's output batches."""
+        if t.id in t2node:
+            return t2node[t.id]
+        if isinstance(t, SourceTransformation):
+            n = new_node("source", t.name, source=t.source,
+                         watermark_strategy=t.watermark_strategy)
+        elif isinstance(t, MapTransformation):
+            up = node_for(t.inputs[0])
+            upn = nodes[up]
+            # chain into upstream chain node if it's a chain with a
+            # single consumer path (always true here: we create chains
+            # per linear run)
+            if upn.kind == "chain" and len(consumers.get(t.inputs[0].id, [])) == 1:
+                upn.fns.append(t.fn)
+                t2node[t.id] = upn.id
+                return upn.id
+            n = new_node("chain", t.name, fns=[t.fn])
+            upn.downstream.append(n.id)
+        elif isinstance(t, KeyByTransformation):
+            # keyBy is virtual: the downstream stateful op reads key_field
+            up = node_for(t.inputs[0])
+            t2node[t.id] = up
+            # key_fn materializes the key column via an appended chain fn;
+            # fuse into the upstream chain only when this keyBy is its
+            # sole consumer (sibling branches must not see the injected
+            # key column — same single-consumer rule as map chaining)
+            if t.key_fn is not None:
+                fn = t.key_fn
+
+                def add_key(data, ts, valid, _fn=fn, _kf=t.key_field):
+                    data = dict(data)
+                    data[_kf] = np.asarray(_fn(data), np.int64)
+                    return data, ts, valid
+
+                upn = nodes[up]
+                if (upn.kind == "chain"
+                        and len(consumers.get(t.inputs[0].id, [])) == 1):
+                    upn.fns.append(add_key)
+                else:
+                    n = new_node("chain", "key_extract", fns=[add_key])
+                    upn.downstream.append(n.id)
+                    t2node[t.id] = n.id
+                    return n.id
+            return up
+        elif isinstance(t, WindowAggregateTransformation):
+            up = node_for(t.inputs[0])
+            n = new_node("window", t.name, window_transform=t,
+                         key_field=t.key_field)
+            nodes[up].downstream.append(n.id)
+        elif isinstance(t, SessionAggregateTransformation):
+            up = node_for(t.inputs[0])
+            n = new_node("session", t.name, window_transform=t,
+                         key_field=t.key_field)
+            nodes[up].downstream.append(n.id)
+        elif isinstance(t, WindowJoinTransformation):
+            lup = node_for(t.inputs[0])
+            rup = node_for(t.inputs[1])
+            n = new_node("join", t.name, window_transform=t,
+                         left_input=lup, right_input=rup)
+            nodes[lup].downstream.append(n.id)
+            nodes[rup].downstream.append(n.id)
+        elif isinstance(t, SinkTransformation):
+            up = node_for(t.inputs[0])
+            n = new_node("sink", t.name, sink=t.sink)
+            nodes[up].downstream.append(n.id)
+        elif isinstance(t, UnionTransformation):
+            n = new_node("union", t.name)
+            for inp in t.inputs:
+                up = node_for(inp)
+                nodes[up].downstream.append(n.id)
+        else:
+            raise NotImplementedError(f"transformation {type(t).__name__}")
+        t2node[t.id] = n.id
+        return n.id
+
+    for t in transforms:
+        node_for(t)
+
+    sources = [n.id for n in nodes.values() if n.kind == "source"]
+    if not sources:
+        raise ValueError("job has no sources")
+    sinks = [n for n in nodes.values() if n.kind == "sink"]
+    if not sinks:
+        raise ValueError("job has no sinks (add_sink/print/collect)")
+
+    topo = _topo_order(nodes, sources)
+    return ExecutionPlan(nodes=nodes, sources=sources, topo_order=topo,
+                         watermark_strategy=default_wm)
+
+
+def _topo_order(nodes: Dict[int, ExecNode], sources: List[int]) -> List[int]:
+    indeg: Dict[int, int] = {nid: 0 for nid in nodes}
+    for n in nodes.values():
+        for d in n.downstream:
+            indeg[d] += 1
+    order: List[int] = []
+    ready = [nid for nid, d in indeg.items() if d == 0]
+    while ready:
+        nid = ready.pop()
+        order.append(nid)
+        for d in nodes[nid].downstream:
+            indeg[d] -= 1
+            if indeg[d] == 0:
+                ready.append(d)
+    if len(order) != len(nodes):
+        raise ValueError("cycle in transformation graph")
+    return order

@@ -1,0 +1,122 @@
+"""Logical transformation DAG — what the fluent API builds.
+
+ref: streaming/api/transformations/{OneInputTransformation,
+PartitionTransformation,SourceTransformation,SinkTransformation,
+UnionTransformation}.java — each fluent call appends one node; nothing
+executes until the graph is lowered and run (lazy, like the reference's
+StreamExecutionEnvironment.execute()).
+
+TPU-first notes: transformations carry no parallelism (parallelism is a
+property of the device mesh chosen at execution, not of graph nodes), and
+the stateless ones carry jax-traceable batch functions that the lowering
+step fuses into one compiled step function per stage (the operator
+chaining analogue; ref: StreamingJobGraphGenerator.isChainable).
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from flink_tpu.api.windowing import Trigger, WindowAssigner
+from flink_tpu.ops.aggregates import LaneAggregate
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+_ids = itertools.count()
+
+
+@dataclasses.dataclass
+class Transformation:
+    """Base DAG node. ``inputs`` are upstream transformations."""
+
+    name: str
+    inputs: Tuple["Transformation", ...] = ()
+
+    def __post_init__(self) -> None:
+        self.id = next(_ids)
+
+    def __hash__(self) -> int:
+        return self.id
+
+    def __eq__(self, other: object) -> bool:
+        return self is other
+
+
+@dataclasses.dataclass(eq=False)
+class SourceTransformation(Transformation):
+    """ref: SourceTransformation.java + the FLIP-27 Source seam
+    (flink-core/.../api/connector/source/Source.java)."""
+
+    source: Any = None  # flink_tpu.api.sources.Source
+    watermark_strategy: Optional[WatermarkStrategy] = None
+
+
+@dataclasses.dataclass(eq=False)
+class MapTransformation(Transformation):
+    """map/filter/flatMap — chainable stateless batch fns
+    (ref: OneInputTransformation wrapping StreamMap/StreamFilter/
+    StreamFlatMap operators)."""
+
+    # fn(data: dict, ts, valid) -> (data, ts, valid); traced into the
+    # stage step function
+    fn: Optional[Callable] = None
+    kind: str = "map"  # map | filter | flatmap | process
+
+
+@dataclasses.dataclass(eq=False)
+class KeyByTransformation(Transformation):
+    """Hash partition by key (ref: PartitionTransformation with
+    KeyGroupStreamPartitioner). key_field names an int64 column; key_fn
+    optionally derives it on device first."""
+
+    key_field: str = "key"
+    key_fn: Optional[Callable] = None
+
+
+@dataclasses.dataclass(eq=False)
+class WindowAggregateTransformation(Transformation):
+    """Keyed window + aggregate (ref: WindowedStream.aggregate →
+    WindowOperator via WindowOperatorBuilder)."""
+
+    assigner: Optional[WindowAssigner] = None
+    aggregate: Optional[LaneAggregate] = None
+    trigger: Optional[Trigger] = None
+    allowed_lateness_ms: int = 0
+    key_field: str = "key"
+
+
+@dataclasses.dataclass(eq=False)
+class WindowJoinTransformation(Transformation):
+    """Two-input tumbling-window equi-join (ref: streaming/api/datastream/
+    JoinedStreams.java lowered onto WindowOperator with a union state;
+    here a dedicated two-family pane join — Q8)."""
+
+    assigner: Optional[WindowAssigner] = None
+    left_key: str = "key"
+    right_key: str = "key"
+    left_fields: Tuple[str, ...] = ()
+    right_fields: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(eq=False)
+class SessionAggregateTransformation(Transformation):
+    """Keyed session windows (ref: EventTimeSessionWindows +
+    MergingWindowSet) — host span registry + device accumulators."""
+
+    gap_ms: int = 0
+    aggregate: Optional[LaneAggregate] = None
+    allowed_lateness_ms: int = 0
+    key_field: str = "key"
+
+
+@dataclasses.dataclass(eq=False)
+class SinkTransformation(Transformation):
+    """ref: SinkTransformation.java + Sink API v2
+    (flink-core/.../api/connector/sink2/Sink.java)."""
+
+    sink: Any = None  # flink_tpu.api.sinks.Sink
+
+
+@dataclasses.dataclass(eq=False)
+class UnionTransformation(Transformation):
+    """ref: UnionTransformation.java — merge same-schema streams."""

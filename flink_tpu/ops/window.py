@@ -550,14 +550,31 @@ class WindowOperator:
         prev = self.watermark
         self.watermark = wm
 
-        ends = self.plan.fireable_end_panes(prev, wm, self._min_pane_seen)
+        if self._max_pane_seen is None:
+            ends: List[int] = []
+        else:
+            # clamp the fire scan to windows that can contain data — a
+            # large watermark jump (idle gap, end-of-input flush) must
+            # not enumerate millions of provably-empty windows
+            ends_wm = min(wm, self._last_data_end_ms() - 1)
+            if prev != LONG_MIN and prev >= ends_wm:
+                ends = []
+            else:
+                ends = self.plan.fireable_end_panes(prev, ends_wm, self._min_pane_seen)
         ends = sorted(set(ends) | self._refire)
+        # the fired frontier must track the WATERMARK, not just enumerated
+        # ends: a late-within-lateness record landing in any window the
+        # watermark already passed (fired or empty-skipped) must trigger
+        # an immediate late firing (ref: EventTimeTrigger.onElement FIREs
+        # when window.maxTimestamp() <= currentWatermark)
+        pps = self.plan.panes_per_slide
+        ppw = self.plan.panes_per_window
+        m = (wm + 1 - self.plan.offset_ms) // self.plan.pane_ms
+        frontier = m - ((m - ppw) % pps)
+        if self._fired_below_end is None or frontier > self._fired_below_end:
+            self._fired_below_end = frontier
         self._refire.clear()
         out = self._fire_ends(ends)
-
-        if ends:
-            top = max(ends)
-            self._fired_below_end = max(self._fired_below_end or top, top)
 
         # purge panes no window can need anymore; only columns actually
         # written (>= min pane seen) can hold data
@@ -666,6 +683,21 @@ class WindowOperator:
         if self.mesh_plan is None:
             return rows
         return rows - rows // self.layout.rows
+
+    def _last_data_end_ms(self) -> int:
+        """End time (ms) of the last window that can contain data (the
+        final window covering ``_max_pane_seen``)."""
+        pps = self.plan.panes_per_slide
+        last_end = (self._max_pane_seen // pps) * pps + self.plan.panes_per_window
+        return last_end * self.plan.pane_ms + self.plan.offset_ms
+
+    def final_watermark(self) -> int:
+        """Watermark that completes (and purges) every window that can
+        hold data — the end-of-input flush point (ref role: advancing to
+        Watermark.MAX_WATERMARK on input end, kept finite here)."""
+        if self._max_pane_seen is None:
+            return self.watermark if self.watermark != LONG_MIN else 0
+        return self._last_data_end_ms() + self.plan.allowed_lateness_ms + 1
 
     def _empty(self) -> "FiredWindows":
         """Cached empty fired-batch (a fresh one would dispatch tiny

@@ -1,0 +1,234 @@
+"""The per-host driver loop — the StreamTask/mailbox analogue.
+
+ref: streaming/runtime/tasks/{StreamTask,OneInputStreamTask}.java and
+tasks/mailbox/MailboxProcessor.runMailboxLoop — the reference's
+single-threaded event loop where the default action processes input and
+control actions (checkpoints, timers) interleave as mails.
+
+TPU-first redesign: the loop's unit is a **microbatch**, not a record.
+One iteration = pull a batch from a source, run the fused host ingest
+chain, fold it into the stateful ops' device state, advance the
+watermark clock, and hand fired windows to downstream nodes/sinks.
+Control actions (checkpoint snapshots) happen between iterations — a
+step boundary is a global barrier (SURVEY §6.4), which is what makes
+exactly-once cheap here.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.config import (
+    CheckpointingOptions,
+    Configuration,
+    PipelineOptions,
+    StateOptions,
+)
+from flink_tpu.graph.compiler import ExecNode, ExecutionPlan
+from flink_tpu.time.watermarks import LONG_MIN, WatermarkTracker, make_generator
+
+Batch = Tuple[Dict[str, np.ndarray], np.ndarray, np.ndarray]  # data, ts, valid
+
+
+class Driver:
+    """Single-process execution of a lowered plan (the LocalExecutor /
+    MiniCluster path; multi-host runs the same loop per host runner under
+    the coordinator, ref: runtime/minicluster/MiniCluster.java)."""
+
+    def __init__(self, plan: ExecutionPlan, config: Configuration,
+                 mesh_plan: Optional[Any] = None):
+        self.plan = plan
+        self.config = config
+        self.mesh_plan = mesh_plan
+        self._upstream: Dict[int, List[int]] = {nid: [] for nid in plan.nodes}
+        for n in plan.nodes.values():
+            for d in n.downstream:
+                self._upstream[d].append(n.id)
+        self._ops: Dict[int, Any] = {}
+        self._out_wm: Dict[int, int] = {nid: LONG_MIN for nid in plan.nodes}
+        self._wm_gens: Dict[int, Any] = {}
+        self._max_ts: Dict[int, int] = {}
+        self.metrics: Dict[str, int] = {
+            "records_in": 0, "records_out": 0, "batches": 0, "fired_windows": 0,
+        }
+        self._build_ops()
+
+    # -- construction ----------------------------------------------------
+    def _build_ops(self) -> None:
+        from flink_tpu.ops.window import WindowOperator
+
+        num_shards = self.config.get(StateOptions.NUM_KEY_SHARDS)
+        slots = self.config.get(StateOptions.SLOTS_PER_SHARD)
+        # pane-ring sizing must cover the worst watermark lag of ANY
+        # source feeding the job (per-source strategies override the
+        # plan default)
+        ooos = [self.plan.watermark_strategy.max_out_of_orderness_ms]
+        for n in self.plan.nodes.values():
+            if n.kind == "source" and n.watermark_strategy is not None:
+                ooos.append(n.watermark_strategy.max_out_of_orderness_ms)
+        wm = dataclasses.replace(self.plan.watermark_strategy,
+                                 max_out_of_orderness_ms=max(ooos))
+        for n in self.plan.nodes.values():
+            if n.kind == "window":
+                t = n.window_transform
+                self._ops[n.id] = WindowOperator(
+                    t.assigner, t.aggregate,
+                    num_shards=num_shards, slots_per_shard=slots,
+                    allowed_lateness_ms=t.allowed_lateness_ms,
+                    max_out_of_orderness_ms=max(wm.max_out_of_orderness_ms, 0),
+                    mesh_plan=self.mesh_plan,
+                )
+            elif n.kind == "session":
+                from flink_tpu.ops.session import SessionOperator
+
+                t = n.window_transform
+                self._ops[n.id] = SessionOperator(
+                    gap_ms=t.gap_ms, agg=t.aggregate,
+                    allowed_lateness_ms=t.allowed_lateness_ms,
+                    num_shards=num_shards, slots_per_shard=slots,
+                    max_out_of_orderness_ms=max(wm.max_out_of_orderness_ms, 0),
+                )
+            elif n.kind == "join":
+                from flink_tpu.ops.join import WindowJoinOperator
+
+                t = n.window_transform
+                self._ops[n.id] = WindowJoinOperator(
+                    t.assigner,
+                    left_fields=t.left_fields, right_fields=t.right_fields,
+                    num_shards=num_shards, slots_per_shard=slots,
+                    max_out_of_orderness_ms=max(wm.max_out_of_orderness_ms, 0),
+                )
+
+    # -- run loop --------------------------------------------------------
+    def run(self, job_name: str = "job"):
+        from flink_tpu.api.environment import JobResult
+
+        srcs = {}
+        for sid in self.plan.sources:
+            n = self.plan.node(sid)
+            its = [n.source.open_split(s) for s in n.source.splits()]
+            srcs[sid] = its
+            strategy = n.watermark_strategy or self.plan.watermark_strategy
+            # one watermark generator PER SPLIT, combined with min — the
+            # per-channel rule (ref: StatusWatermarkValve; a lagging split
+            # must hold the source watermark back or its records would be
+            # dropped as late)
+            self._wm_gens[sid] = [make_generator(strategy) for _ in its]
+            self._max_ts[sid] = LONG_MIN
+
+        active = {sid: list(range(len(its))) for sid, its in srcs.items()}
+        while any(active.values()):
+            for sid, splits_alive in list(active.items()):
+                if not splits_alive:
+                    continue
+                for split_ix in list(splits_alive):
+                    it = srcs[sid][split_ix]
+                    nxt = next(it, None)
+                    if nxt is None:
+                        splits_alive.remove(split_ix)
+                        continue
+                    data, ts = nxt
+                    ts = np.asarray(ts, np.int64)
+                    valid = np.ones(len(ts), bool)
+                    self.metrics["records_in"] += len(ts)
+                    self.metrics["batches"] += 1
+                    self._push_downstream(sid, (dict(data), ts, valid))
+                    if len(ts):
+                        mx = int(ts.max())
+                        self._max_ts[sid] = max(self._max_ts[sid], mx)
+                        self._wm_gens[sid][split_ix].on_batch(mx)
+                # exhausted splits stop holding the watermark back
+                # (ref: idle-channel handling in the valve)
+                gens = [g for i, g in enumerate(self._wm_gens[sid])
+                        if i in splits_alive]
+                if gens:
+                    self._out_wm[sid] = min(g.current() for g in gens)
+                elif self._wm_gens[sid]:
+                    self._out_wm[sid] = min(g.current() for g in self._wm_gens[sid])
+                self._propagate_watermarks()
+
+        # end of input: final watermark per stateful op flushes everything
+        for sid in self.plan.sources:
+            self._out_wm[sid] = _FINAL
+        self._propagate_watermarks(final=True)
+        for n in self.plan.nodes.values():
+            if n.kind == "sink":
+                n.sink.close()
+        return JobResult(job_name, dict(self.metrics))
+
+    # -- data plane ------------------------------------------------------
+    def _push_downstream(self, nid: int, batch: Batch) -> None:
+        for d in self.plan.node(nid).downstream:
+            self._push(d, batch, from_node=nid)
+
+    def _push(self, nid: int, batch: Batch, from_node: int) -> None:
+        n = self.plan.node(nid)
+        data, ts, valid = batch
+        if n.kind == "chain":
+            for fn in n.fns:
+                data, ts, valid = fn(data, ts, valid)
+            self._push_downstream(nid, (data, ts, valid))
+        elif n.kind == "union":
+            self._push_downstream(nid, batch)
+        elif n.kind == "window" or n.kind == "session":
+            op = self._ops[nid]
+            keys = np.asarray(data[n.key_field], np.int64)
+            dev_data = {k: v for k, v in data.items()
+                        if np.asarray(v).dtype != object}
+            op.process_batch(keys, ts, dev_data, valid)
+        elif n.kind == "join":
+            op = self._ops[nid]
+            t = n.window_transform
+            if from_node == n.left_input:
+                keys = np.asarray(data[t.left_key], np.int64)
+                op.process_left(keys, ts, data, valid)
+            else:
+                keys = np.asarray(data[t.right_key], np.int64)
+                op.process_right(keys, ts, data, valid)
+        elif n.kind == "sink":
+            compact = {k: v[valid] for k, v in data.items()}
+            nrec = int(valid.sum())
+            if nrec:
+                self.metrics["records_out"] += nrec
+                n.sink.write(compact)
+        else:
+            raise AssertionError(f"unroutable node kind {n.kind}")
+
+    # -- time plane ------------------------------------------------------
+    def _propagate_watermarks(self, final: bool = False) -> None:
+        """Advance node watermarks in topo order (the StatusWatermarkValve
+        min-over-inputs rule applied at node granularity, ref: streaming/
+        runtime/watermarkstatus/StatusWatermarkValve.java)."""
+        for nid in self.plan.topo_order:
+            n = self.plan.node(nid)
+            if n.kind == "source":
+                continue
+            ups = self._upstream[nid]
+            in_wm = min(self._out_wm[u] for u in ups) if ups else LONG_MIN
+            if n.kind in ("window", "session", "join"):
+                op = self._ops[nid]
+                wm = in_wm
+                if in_wm == _FINAL:
+                    wm = op.final_watermark()
+                if wm > op.watermark or final:
+                    fired = op.advance_watermark(wm)
+                    self._emit_fired(nid, fired)
+                self._out_wm[nid] = in_wm
+            else:
+                self._out_wm[nid] = in_wm
+
+    def _emit_fired(self, nid: int, fired) -> None:
+        out = dict(fired)
+        nrec = len(out.get("key", ()))
+        if nrec == 0:
+            return
+        self.metrics["fired_windows"] += nrec
+        ts = np.asarray(out["window_end"], np.int64) - 1
+        valid = np.ones(nrec, bool)
+        self._push_downstream(nid, (out, ts, valid))
+
+
+_FINAL = np.iinfo(np.int64).max  # end-of-input marker watermark

@@ -328,3 +328,26 @@ class TestFuzzVsGolden:
         op, ours, golden = run_pair(a, count(), events, wms,
                                     lateness=lateness, ooo=ooo)
         assert_match(ours, golden, "count")
+
+
+class TestLateAfterIdleGap:
+    def test_late_window_after_idle_gap_fires(self):
+        """Regression: a record in a window the watermark passed during an
+        idle gap (within allowed lateness) must fire that window late
+        (ref: EventTimeTrigger.onElement fires immediately when
+        window.maxTimestamp() <= currentWatermark)."""
+        from flink_tpu.api.windowing import TumblingEventTimeWindows
+        from flink_tpu.ops import aggregates
+
+        op = WindowOperator(
+            TumblingEventTimeWindows.of(1000), aggregates.count(),
+            num_shards=8, slots_per_shard=16, allowed_lateness_ms=10_000,
+            max_out_of_orderness_ms=10_000)
+        op.process_batch(np.array([1]), np.array([500]), {})
+        fired = op.advance_watermark(50_000)  # idle gap: only [0,1000) fires
+        assert {(int(k), int(e)) for k, e in zip(fired["key"], fired["window_end"])} == {(1, 1000)}
+        # late but within lateness: 45999 + 10000 > 50000
+        op.process_batch(np.array([2]), np.array([45_500]), {})
+        fired = op.advance_watermark(50_001)
+        assert {(int(k), int(e)) for k, e in zip(fired["key"], fired["window_end"])} == {(2, 46_000)}
+        assert op.late_records == 0
