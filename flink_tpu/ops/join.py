@@ -1,0 +1,129 @@
+"""Windowed equi-join — the Nexmark Q8 shape.
+
+ref: streaming/api/datastream/{JoinedStreams,CoGroupedStreams}.java —
+the reference lowers join(a,b).where(k).equalTo(k).window(w) onto a
+WindowOperator over the union of both inputs, buffering raw elements in
+ListState and emitting the CROSS PRODUCT of left×right per (key, window)
+at fire time.
+
+TPU-first redesign: raw-element buffers and dynamic cross products are
+hostile to static shapes, and the benchmark joins (Q8: person ⋈ their
+auctions) are effectively aggregate joins. So each side folds into its
+own dense pane-state family (same layout as the window operator), and a
+fire emits ONE row per (key, window) present on BOTH sides, carrying
+each side's aggregated lanes (count + selected field aggregates).
+Multiplicity-expanded cross products, when truly needed, are a host-side
+expansion of these aggregate rows (deferred; the count lanes carry the
+multiplicities)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flink_tpu.api.windowing import WindowAssigner
+from flink_tpu.ops import aggregates
+from flink_tpu.ops.window import FiredWindows, WindowOperator
+from flink_tpu.time.watermarks import LONG_MIN
+
+
+def _side_agg(fields: Sequence[str], prefix: str) -> aggregates.LaneAggregate:
+    """count + a max-lane carry per selected field (for single-valued
+    fields per (key, window) — the Q8 case — max IS the value; for
+    multi-valued it is a deterministic representative)."""
+    aggs = [aggregates.count(f"{prefix}count")]
+    for f in fields:
+        aggs.append(aggregates.max_of(f, f"{prefix}{f}"))
+    return aggregates.multi(*aggs)
+
+
+class WindowJoinOperator:
+    """Two keyed window aggregations joined on (key, window) at fire time.
+
+    The two sides share the watermark clock (the reference's two-input
+    operator takes min over both inputs' watermarks — done by the driver
+    before calling advance_watermark)."""
+
+    def __init__(
+        self,
+        assigner: WindowAssigner,
+        *,
+        left_fields: Sequence[str] = (),
+        right_fields: Sequence[str] = (),
+        num_shards: int = 128,
+        slots_per_shard: int = 1024,
+        max_out_of_orderness_ms: int = 0,
+        allowed_lateness_ms: int = 0,
+    ) -> None:
+        kw = dict(
+            num_shards=num_shards, slots_per_shard=slots_per_shard,
+            max_out_of_orderness_ms=max_out_of_orderness_ms,
+            allowed_lateness_ms=allowed_lateness_ms,
+        )
+        self.left = WindowOperator(assigner, _side_agg(left_fields, "left_"), **kw)
+        self.right = WindowOperator(assigner, _side_agg(right_fields, "right_"), **kw)
+        self.left_fields = tuple(left_fields)
+        self.right_fields = tuple(right_fields)
+
+    @property
+    def watermark(self) -> int:
+        return min(self.left.watermark, self.right.watermark)
+
+    def process_left(self, keys, ts, data, valid=None) -> None:
+        # only configured fields reach the device (passthrough columns —
+        # strings in particular — must not hit the pane kernels)
+        self.left.process_batch(
+            keys, ts, {f: data[f] for f in self.left_fields}, valid)
+
+    def process_right(self, keys, ts, data, valid=None) -> None:
+        self.right.process_batch(
+            keys, ts, {f: data[f] for f in self.right_fields}, valid)
+
+    def advance_watermark(self, wm: int) -> FiredWindows:
+        # a late record on ONE side must re-emit the joined row, so both
+        # sides re-fire the union of affected windows (ref role: the
+        # merged WindowOperator fires once for the unioned input)
+        union_refire = self.left._refire | self.right._refire
+        self.left._refire = set(union_refire)
+        self.right._refire = set(union_refire)
+        fl = self.left.advance_watermark(wm)
+        fr = self.right.advance_watermark(wm)
+
+        def merge() -> Dict[str, np.ndarray]:
+            l = fl.materialize()
+            r = fr.materialize()
+            # vectorized (key, window_end) inner match — the emit path
+            # must stay off per-row Python (same rule as the fire kernel)
+            lp = np.stack([l["key"], l["window_end"]], axis=1)
+            rp = np.stack([r["key"], r["window_end"]], axis=1)
+            uniq, inv = np.unique(np.concatenate([lp, rp]), axis=0,
+                                  return_inverse=True)
+            linv, rinv = inv[: len(lp)], inv[len(lp):]
+            pos = np.full(len(uniq), -1, dtype=np.int64)
+            pos[linv] = np.arange(len(lp))
+            match = pos[rinv] >= 0
+            ri = np.nonzero(match)[0]
+            li = pos[rinv[match]]
+            out: Dict[str, np.ndarray] = {
+                "key": l["key"][li] if len(li) else np.zeros(0, np.int64),
+                "window_start": l["window_start"][li] if len(li) else np.zeros(0, np.int64),
+                "window_end": l["window_end"][li] if len(li) else np.zeros(0, np.int64),
+            }
+            for f in ("left_count",) + tuple(f"left_{x}" for x in self.left_fields):
+                out[f] = l[f][li] if len(li) else np.zeros(0)
+            for f in ("right_count",) + tuple(f"right_{x}" for x in self.right_fields):
+                out[f] = r[f][ri] if len(ri) else np.zeros(0)
+            return out
+
+        return FiredWindows(fetch=merge)
+
+    def final_watermark(self) -> int:
+        return max(self.left.final_watermark(), self.right.final_watermark())
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        return {"left": self.left.snapshot_state(),
+                "right": self.right.snapshot_state()}
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        self.left.restore_state(snap["left"])
+        self.right.restore_state(snap["right"])

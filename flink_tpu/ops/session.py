@@ -1,0 +1,259 @@
+"""Session windows — gap-merged, dynamically-bounded windows.
+
+ref: streaming/api/windowing/assigners/EventTimeSessionWindows.java and
+the merge machinery MergingWindowSet.java + WindowOperator's merging
+branch (each element opens [ts, ts+gap) and overlapping windows merge,
+state merges via namespace re-targeting).
+
+TPU-first redesign (SURVEY §8.4 item 3): dynamic merging cannot be a
+static pane layout, so the decomposition is:
+- **batch sessionization is vectorized**: sort the microbatch by
+  (key, ts); session boundaries are where the key changes or the time
+  gap exceeds ``gap``; per-batch-session aggregates come from numpy
+  ``reduceat`` segments (C-speed host work — the per-RECORD cost is
+  vectorized away, matching how the reference's cost is per element).
+- a **host span registry** keeps open sessions per key (tiny: one entry
+  per active session, not per record) and merges batch-sessions into
+  them — the MergingWindowSet role.
+- fired sessions stay in the registry until allowed lateness expires so
+  late records re-open/merge and re-fire (late firing semantics).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.ops.aggregates import LaneAggregate
+from flink_tpu.time.watermarks import LONG_MIN
+
+
+@dataclasses.dataclass
+class _Span:
+    start: int
+    last_ts: int          # max event ts in session; end = last_ts + gap
+    sums: np.ndarray
+    maxs: np.ndarray
+    mins: np.ndarray
+    count: int
+    fired: bool = False   # already emitted once (re-fire on late merge)
+    refire: bool = False  # must (re-)emit at the next advance
+
+
+class SessionOperator:
+    """Keyed event-time session aggregation with allowed lateness."""
+
+    def __init__(
+        self,
+        gap_ms: int,
+        agg: LaneAggregate,
+        *,
+        allowed_lateness_ms: int = 0,
+        num_shards: int = 128,
+        slots_per_shard: int = 1024,
+        max_out_of_orderness_ms: int = 0,
+    ) -> None:
+        if gap_ms <= 0:
+            raise ValueError("session gap must be positive")
+        self.gap = int(gap_ms)
+        self.agg = agg
+        self.lateness = int(allowed_lateness_ms)
+        self.watermark = LONG_MIN
+        self.late_records = 0
+        # key -> list of open/retained spans, disjoint, sorted by start
+        self._spans: Dict[int, List[_Span]] = {}
+        self._has_refire = False
+
+    # -- ingest ----------------------------------------------------------
+    def process_batch(self, keys, ts, data: Dict[str, np.ndarray], valid=None) -> None:
+        keys = np.asarray(keys, np.int64)
+        ts = np.asarray(ts, np.int64)
+        valid = np.ones(len(ts), bool) if valid is None else np.asarray(valid, bool)
+
+        # drop beyond-lateness records (side output accounting): a record
+        # is late iff its singleton session is dead AND it cannot merge
+        # into any retained span (the reference checks isWindowLate on
+        # the POST-merge window — a record touching a live retained
+        # session rides that session's lateness)
+        if self.watermark != LONG_MIN:
+            late = valid & (ts + self.gap - 1 + self.lateness <= self.watermark)
+            if late.any():
+                for i in np.nonzero(late)[0]:
+                    k, t = int(keys[i]), int(ts[i])
+                    for sp in self._spans.get(k, ()):
+                        if t <= sp.last_ts + self.gap and sp.start <= t + self.gap:
+                            late[i] = False
+                            break
+            self.late_records += int(late.sum())
+            valid = valid & ~late
+        if not valid.any():
+            return
+        keys = keys[valid]
+        ts = ts[valid]
+        data = {k: np.asarray(v)[valid] for k, v in data.items()}
+
+        # vectorized batch sessionization: sort by (key, ts)
+        order = np.lexsort((ts, keys))
+        sk, st = keys[order], ts[order]
+        sdata = {k: v[order] for k, v in data.items()}
+        new_seg = np.empty(len(sk), bool)
+        new_seg[0] = True
+        new_seg[1:] = (sk[1:] != sk[:-1]) | (st[1:] - st[:-1] > self.gap)
+        seg_starts = np.nonzero(new_seg)[0]
+
+        # per-segment lane aggregates (host lift on CPU jax → numpy)
+        s_l, mx_l, mn_l = self._host_lift(sdata, np.ones(len(sk), bool))
+        seg_sum = np.add.reduceat(s_l, seg_starts, axis=0) if s_l.shape[1] else np.zeros((len(seg_starts), 0), np.float32)
+        seg_max = np.maximum.reduceat(mx_l, seg_starts, axis=0) if mx_l.shape[1] else np.zeros((len(seg_starts), 0), np.float32)
+        seg_min = np.minimum.reduceat(mn_l, seg_starts, axis=0) if mn_l.shape[1] else np.zeros((len(seg_starts), 0), np.float32)
+        seg_ends = np.append(seg_starts[1:], len(sk))
+        seg_count = seg_ends - seg_starts
+        seg_key = sk[seg_starts]
+        seg_tmin = st[seg_starts]
+        seg_tmax = st[seg_ends - 1]
+
+        # merge batch segments into the registry (MergingWindowSet role)
+        for i in range(len(seg_starts)):
+            self._merge_span(
+                int(seg_key[i]),
+                _Span(int(seg_tmin[i]), int(seg_tmax[i]),
+                      seg_sum[i], seg_max[i], seg_min[i], int(seg_count[i])))
+
+    def _host_lift(self, data, valid) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Run the aggregate's lift on the host CPU backend (session lane
+        math is per-batch-segment, tiny — shipping it to the accelerator
+        would cost a round trip per batch)."""
+        import jax
+
+        cpu = jax.local_devices(backend="cpu")[0]
+        with jax.default_device(cpu):
+            import jax.numpy as jnp
+
+            s, mx, mn = self.agg.lift_masked(
+                {k: jnp.asarray(v) for k, v in data.items()}, jnp.asarray(valid))
+            return np.asarray(s), np.asarray(mx), np.asarray(mn)
+
+    def _merge_span(self, key: int, new: _Span) -> None:
+        spans = self._spans.setdefault(key, [])
+        merged = new
+        keep: List[_Span] = []
+        refire_needed = False
+        for sp in spans:
+            # overlap iff [start, last+gap) ranges touch
+            if merged.start <= sp.last_ts + self.gap and sp.start <= merged.last_ts + self.gap:
+                refire_needed = refire_needed or sp.fired
+                merged = _Span(
+                    start=min(sp.start, merged.start),
+                    last_ts=max(sp.last_ts, merged.last_ts),
+                    sums=sp.sums + merged.sums,
+                    maxs=np.maximum(sp.maxs, merged.maxs),
+                    mins=np.minimum(sp.mins, merged.mins),
+                    count=sp.count + merged.count,
+                    fired=False,
+                    refire=sp.refire or merged.refire,
+                )
+            else:
+                keep.append(sp)
+        if refire_needed or (self.watermark != LONG_MIN
+                             and merged.last_ts + self.gap - 1 <= self.watermark):
+            # late merge into a fired session, or a session already
+            # complete at the current watermark → (re-)fire on next advance
+            merged.refire = True
+            self._has_refire = True
+        keep.append(merged)
+        keep.sort(key=lambda s: s.start)
+        self._spans[key] = keep
+
+    # -- time ------------------------------------------------------------
+    def advance_watermark(self, wm: int):
+        from flink_tpu.ops.window import FiredWindows
+
+        if wm < self.watermark and not self._has_refire:
+            return FiredWindows(data=self._empty())
+        self.watermark = max(self.watermark, wm)
+        self._has_refire = False
+        out_rows: List[Tuple[int, _Span]] = []
+        for key, spans in list(self._spans.items()):
+            retained: List[_Span] = []
+            for sp in spans:
+                end = sp.last_ts + self.gap
+                complete = end - 1 <= self.watermark
+                # merges always produce fired=False spans, so an
+                # incomplete refire-flagged span fires naturally at its
+                # (new, later) completion — emit only when complete
+                if complete and (not sp.fired or sp.refire):
+                    out_rows.append((key, sp))
+                sp.refire = False
+                if end - 1 + self.lateness <= self.watermark:
+                    continue  # retention over: drop
+                if complete:
+                    sp.fired = True
+                retained.append(sp)
+            if retained:
+                self._spans[key] = retained
+            else:
+                self._spans.pop(key, None)
+        if not out_rows:
+            return FiredWindows(data=self._empty())
+        for _, sp in out_rows:
+            sp.fired = True
+        return FiredWindows(data=self._emit(out_rows))
+
+    def _emit(self, rows: List[Tuple[int, _Span]]) -> Dict[str, np.ndarray]:
+        import jax
+
+        n = len(rows)
+        sums = np.stack([sp.sums for _, sp in rows]) if n else np.zeros((0, self.agg.sum_width), np.float32)
+        maxs = np.stack([sp.maxs for _, sp in rows]) if n else np.zeros((0, self.agg.max_width), np.float32)
+        mins = np.stack([sp.mins for _, sp in rows]) if n else np.zeros((0, self.agg.min_width), np.float32)
+        counts = np.array([sp.count for _, sp in rows], np.int32)
+        cpu = jax.local_devices(backend="cpu")[0]
+        with jax.default_device(cpu):
+            import jax.numpy as jnp
+
+            res = self.agg.finalize(jnp.asarray(sums), jnp.asarray(maxs),
+                                    jnp.asarray(mins), jnp.asarray(counts))
+        out = {
+            "key": np.array([k for k, _ in rows], np.int64),
+            "window_start": np.array([sp.start for _, sp in rows], np.int64),
+            "window_end": np.array([sp.last_ts + self.gap for _, sp in rows], np.int64),
+            "count": counts,
+        }
+        for k, v in res.items():
+            out[k] = np.asarray(v)
+        return out
+
+    def _empty(self) -> Dict[str, np.ndarray]:
+        if not hasattr(self, "_empty_cache"):
+            self._empty_cache = self._emit([])
+        return dict(self._empty_cache)
+
+    def final_watermark(self) -> int:
+        mx = LONG_MIN
+        for spans in self._spans.values():
+            for sp in spans:
+                mx = max(mx, sp.last_ts)
+        if mx == LONG_MIN:
+            return self.watermark if self.watermark != LONG_MIN else 0
+        return mx + self.gap + self.lateness + 1
+
+    # -- snapshot --------------------------------------------------------
+    def snapshot_state(self) -> Dict[str, Any]:
+        return {
+            "watermark": self.watermark,
+            "late_records": self.late_records,
+            "spans": {
+                k: [(sp.start, sp.last_ts, sp.sums.copy(), sp.maxs.copy(),
+                     sp.mins.copy(), sp.count, sp.fired, sp.refire) for sp in v]
+                for k, v in self._spans.items()
+            },
+        }
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        self.watermark = snap["watermark"]
+        self.late_records = snap["late_records"]
+        self._spans = {
+            k: [_Span(*t) for t in v] for k, v in snap["spans"].items()
+        }
+        self._has_refire = any(sp.refire for v in self._spans.values() for sp in v)
