@@ -1,0 +1,86 @@
+"""Checkpoint coordination — trigger, collect, complete, restore.
+
+ref: runtime/checkpoint/CheckpointCoordinator.java (triggerCheckpoint /
+receiveAcknowledgeMessage / restoreLatestCheckpointedStateToAll) and the
+task-side SubtaskCheckpointCoordinatorImpl.checkpointState.
+
+TPU-first simplification (SURVEY §6.4): a microbatch step boundary IS a
+global barrier — no in-band barrier alignment, no channel state. A
+checkpoint is: freeze (source positions, per-operator state snapshots,
+watermarks), upload, mark complete, notify sinks to commit their staged
+epoch. Exactly-once = replayable sources (positions) + state rollback +
+transactional sinks.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from flink_tpu.checkpoint.storage import CheckpointHandle, FsCheckpointStorage
+
+
+@dataclasses.dataclass
+class CheckpointStats:
+    """ref: CheckpointStatsTracker — per-checkpoint visibility."""
+
+    checkpoint_id: int
+    trigger_ts_ms: int
+    duration_ms: float
+    size_bytes: int
+
+
+class CheckpointCoordinator:
+    def __init__(self, storage: FsCheckpointStorage) -> None:
+        self.storage = storage
+        self._next_id = 1
+        self.stats: List[CheckpointStats] = []
+
+    def trigger(
+        self,
+        snapshot_fn: Callable[[], Dict[str, Any]],
+        commit_fns: List[Callable[[int], None]],
+        prepare_fns: List[Callable[[int], None]],
+        savepoint: bool = False,
+    ) -> CheckpointHandle:
+        """One full checkpoint cycle (synchronous local form; the
+        coordinator process does the same over RPC for multi-host):
+        1. sinks stage their epoch (prepareCommit)
+        2. collect state snapshot at the step boundary
+        3. persist (manifest last)
+        4. notify complete → sinks commit (2PC)
+        """
+        cid = self._next_id
+        self._next_id += 1
+        t0 = time.time()
+        for p in prepare_fns:
+            p(cid)
+        payload = snapshot_fn()
+        payload["checkpoint_id"] = cid
+        handle = self.storage.save(cid, payload, savepoint=savepoint)
+        for c in commit_fns:
+            c(cid)
+        import os
+
+        size = 0
+        for root, _, files in os.walk(handle.path):
+            for fn in files:
+                size += os.path.getsize(os.path.join(root, fn))
+        self.stats.append(CheckpointStats(
+            cid, int(t0 * 1000), (time.time() - t0) * 1000, size))
+        return handle
+
+    def restore_latest(self) -> Optional[Dict[str, Any]]:
+        h = self.storage.latest()
+        if h is None:
+            return None
+        payload = FsCheckpointStorage.load(h)
+        self.resume_numbering(payload)
+        return payload
+
+    def resume_numbering(self, payload: Dict[str, Any]) -> None:
+        """Checkpoint ids must keep increasing across restores — id reuse
+        would clobber retained checkpoints and replay 2PC epoch ids
+        (ref: CheckpointIDCounter in HA services)."""
+        self._next_id = max(self._next_id,
+                            int(payload.get("checkpoint_id", 0)) + 1)

@@ -1,0 +1,114 @@
+"""Checkpoint storage — durable snapshot layout and retention.
+
+ref: runtime/state/CheckpointStorage + filesystem layout of
+FsCheckpointStorage (state.checkpoints.dir/<job>/chk-<n>/...) and
+CompletedCheckpointStore retention (state.checkpoints.num-retained).
+
+Layout here:
+    <root>/<job_id>/chk-<n>/state.pkl      operator + source snapshots
+    <root>/<job_id>/chk-<n>/MANIFEST.json  metadata; written LAST —
+                                           a checkpoint without a
+                                           manifest is incomplete and
+                                           ignored/garbage-collected
+Savepoints are the same format under <root>/<job_id>/savepoint-<n>/
+(ref: SavepointType — manually triggered, never auto-retired).
+
+Format note: the round-1 payload codec is pickle+numpy; a versioned
+binary format (the TypeSerializerSnapshot schema-evolution analogue)
+replaces it when the C++ codec lands.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pickle
+import shutil
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class CheckpointHandle:
+    checkpoint_id: int
+    path: str
+    timestamp_ms: int
+    is_savepoint: bool = False
+
+
+class FsCheckpointStorage:
+    def __init__(self, root: str, job_id: str, retained: int = 3) -> None:
+        self.root = root
+        self.job_id = job_id
+        self.retained = max(1, retained)
+        self.job_dir = os.path.join(root, job_id)
+        os.makedirs(self.job_dir, exist_ok=True)
+
+    def _dir(self, checkpoint_id: int, savepoint: bool) -> str:
+        prefix = "savepoint" if savepoint else "chk"
+        return os.path.join(self.job_dir, f"{prefix}-{checkpoint_id}")
+
+    def save(self, checkpoint_id: int, payload: Dict[str, Any],
+             savepoint: bool = False) -> CheckpointHandle:
+        """Write snapshot; manifest lands last so readers only ever see
+        complete checkpoints (the atomic-rename pattern of
+        FsCompletedCheckpointStorageLocation)."""
+        d = self._dir(checkpoint_id, savepoint)
+        tmp = d + ".inprogress"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        with open(os.path.join(tmp, "state.pkl"), "wb") as f:
+            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        ts = int(time.time() * 1000)
+        with open(os.path.join(tmp, "MANIFEST.json"), "w", encoding="utf-8") as f:
+            json.dump({
+                "checkpoint_id": checkpoint_id,
+                "timestamp_ms": ts,
+                "job_id": self.job_id,
+                "savepoint": savepoint,
+                "format_version": 1,
+            }, f)
+        if os.path.exists(d):
+            shutil.rmtree(d)
+        os.rename(tmp, d)
+        if not savepoint:
+            self._retire_old()
+        return CheckpointHandle(checkpoint_id, d, ts, savepoint)
+
+    def list_complete(self) -> List[CheckpointHandle]:
+        out = []
+        for name in os.listdir(self.job_dir):
+            d = os.path.join(self.job_dir, name)
+            mf = os.path.join(d, "MANIFEST.json")
+            if not os.path.isfile(mf):
+                continue
+            try:
+                with open(mf, "r", encoding="utf-8") as f:
+                    m = json.load(f)
+                out.append(CheckpointHandle(
+                    m["checkpoint_id"], d, m["timestamp_ms"],
+                    m.get("savepoint", False)))
+            except (json.JSONDecodeError, KeyError):
+                continue
+        return sorted(out, key=lambda h: h.checkpoint_id)
+
+    def latest(self) -> Optional[CheckpointHandle]:
+        hs = [h for h in self.list_complete() if not h.is_savepoint]
+        return hs[-1] if hs else None
+
+    @staticmethod
+    def load(handle_or_path) -> Dict[str, Any]:
+        path = getattr(handle_or_path, "path", handle_or_path)
+        with open(os.path.join(path, "state.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def _retire_old(self) -> None:
+        hs = [h for h in self.list_complete() if not h.is_savepoint]
+        for h in hs[: -self.retained]:
+            shutil.rmtree(h.path, ignore_errors=True)
+        # sweep orphaned in-progress dirs
+        for name in os.listdir(self.job_dir):
+            if name.endswith(".inprogress"):
+                shutil.rmtree(os.path.join(self.job_dir, name),
+                              ignore_errors=True)

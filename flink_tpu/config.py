@@ -222,6 +222,11 @@ class CheckpointingOptions:
     INCREMENTAL = ConfigOption(
         "execution.checkpointing.incremental", False,
         "Upload only dirty panes (RocksDB incremental analogue).")
+    RESTORE = ConfigOption(
+        "execution.checkpointing.restore", "",
+        "'' (fresh start), 'latest' (resume from newest complete "
+        "checkpoint), or a checkpoint/savepoint directory path (ref: "
+        "execution.savepoint.path).")
 
 
 class ClusterOptions:

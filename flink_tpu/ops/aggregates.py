@@ -80,6 +80,16 @@ def _empty_lanes(b: jax.Array) -> jax.Array:
     return jnp.zeros(b.shape[:1] + (0,), dtype=jnp.float32)
 
 
+def _cached(factory):
+    """Memoize built-in aggregate factories so equal configurations share
+    one LaneAggregate instance — and therefore one compiled kernel
+    (jit caches key on the aggregate object)."""
+    import functools
+
+    return functools.lru_cache(maxsize=None)(factory)
+
+
+@_cached
 def count(result_field: str = "count") -> LaneAggregate:
     """COUNT(*) — pure count-lane read (Nexmark Q5's per-key COUNT).
     ref role: CountAggregator in windowed WordCount examples."""
@@ -95,6 +105,7 @@ def count(result_field: str = "count") -> LaneAggregate:
     return LaneAggregate(0, 0, 0, lift, finalize, name="count")
 
 
+@_cached
 def sum_of(field: str, result_field: Optional[str] = None) -> LaneAggregate:
     out = result_field or f"sum_{field}"
 
@@ -109,6 +120,7 @@ def sum_of(field: str, result_field: Optional[str] = None) -> LaneAggregate:
     return LaneAggregate(1, 0, 0, lift, finalize, name=f"sum({field})")
 
 
+@_cached
 def max_of(field: str, result_field: Optional[str] = None) -> LaneAggregate:
     out = result_field or f"max_{field}"
 
@@ -123,6 +135,7 @@ def max_of(field: str, result_field: Optional[str] = None) -> LaneAggregate:
     return LaneAggregate(0, 1, 0, lift, finalize, name=f"max({field})")
 
 
+@_cached
 def min_of(field: str, result_field: Optional[str] = None) -> LaneAggregate:
     out = result_field or f"min_{field}"
 
@@ -137,6 +150,7 @@ def min_of(field: str, result_field: Optional[str] = None) -> LaneAggregate:
     return LaneAggregate(0, 0, 1, lift, finalize, name=f"min({field})")
 
 
+@_cached
 def avg_of(field: str, result_field: Optional[str] = None) -> LaneAggregate:
     out = result_field or f"avg_{field}"
 
@@ -152,6 +166,7 @@ def avg_of(field: str, result_field: Optional[str] = None) -> LaneAggregate:
     return LaneAggregate(1, 0, 0, lift, finalize, name=f"avg({field})")
 
 
+@_cached
 def multi(*aggs: LaneAggregate) -> LaneAggregate:
     """Compose several aggregations over one window into one lane layout
     (e.g. Q7 needs max(price); a dashboard wants count+sum+max at once)."""

@@ -90,6 +90,8 @@ class WindowJoinOperator:
         fr = self.right.advance_watermark(wm)
 
         def merge() -> Dict[str, np.ndarray]:
+            # both sides fetch in ONE device→host round trip
+            FiredWindows.materialize_many([fl, fr])
             l = fl.materialize()
             r = fr.materialize()
             # vectorized (key, window_end) inner match — the emit path

@@ -117,8 +117,11 @@ class SessionOperator:
         for i in range(len(seg_starts)):
             self._merge_span(
                 int(seg_key[i]),
+                # .copy(): a row view would pin the whole batch's segment
+                # arrays in memory for the span's retention lifetime
                 _Span(int(seg_tmin[i]), int(seg_tmax[i]),
-                      seg_sum[i], seg_max[i], seg_min[i], int(seg_count[i])))
+                      seg_sum[i].copy(), seg_max[i].copy(),
+                      seg_min[i].copy(), int(seg_count[i])))
 
     def _host_lift(self, data, valid) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Run the aggregate's lift on the host CPU backend (session lane

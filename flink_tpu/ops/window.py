@@ -128,14 +128,17 @@ def fire_pack_kernel(
     agg: LaneAggregate,
     panes_per_window: int,
     ring: int,
-) -> Dict[str, jax.Array]:
-    """fire + select + finalize entirely on device, returning packed
-    fixed-size arrays so the host needs exactly ONE transfer per firing
-    watermark advance (the device→host round trip is the latency floor
-    of the emit path — batch everything into it).
+) -> jax.Array:
+    """fire + select + finalize entirely on device, packed into ONE
+    int32 buffer so the host pays exactly one transfer per firing
+    advance. The device→host round trip is the latency floor of the
+    emit path, and (crucially) separate result arrays do NOT pipeline
+    when the ingest thread shares the transport — so everything rides
+    one buffer: row 0 = [n, 0, ...]; rows 1..K = [slot_row, end_pane
+    delta vs pane_lo, count, f32-bitcast result lanes...] with result
+    columns in sorted-field order.
 
-    Output arrays have static length rows*W; entries past ``n`` are
-    padding. ref role: the whole onEventTime → emitWindowContents →
+    ref role: the whole onEventTime → emitWindowContents →
     Collector.collect chain, batched."""
     sums, maxs, mins, counts = fire_kernel(
         state, end_panes, w_valid, pane_lo, pane_hi,
@@ -151,15 +154,20 @@ def fire_pack_kernel(
     row_c = jnp.minimum(row, rows - 1)
     sel_counts = counts[row_c, wi]
     res = agg.finalize(sums[row_c, wi], maxs[row_c, wi], mins[row_c, wi], sel_counts)
-    out = {
-        "__row__": row,
-        "__end_pane__": end_panes[wi],
-        "count": sel_counts,
-        "__n__": jnp.sum(flat),
-    }
-    for name, v in res.items():
-        out[name] = v
-    return out
+    end_delta = (end_panes[wi] - pane_lo).astype(jnp.int32)
+    cols = [row, end_delta, sel_counts.astype(jnp.int32)]
+    for name in sorted(res):
+        v = res[name].reshape(k)
+        if jnp.issubdtype(v.dtype, jnp.integer):
+            # integer result lanes (counts) stay exact i32; float lanes
+            # ride as f32 bitcasts (decode reads the dtype probe)
+            cols.append(v.astype(jnp.int32))
+        else:
+            cols.append(lax.bitcast_convert_type(v.astype(jnp.float32), jnp.int32))
+    body = jnp.stack(cols, axis=1)                       # (K, C)
+    head = jnp.zeros((1, body.shape[1]), jnp.int32).at[0, 0].set(
+        jnp.sum(flat).astype(jnp.int32))
+    return jnp.concatenate([head, body])                 # (K+1, C)
 
 
 def clear_kernel(state: PaneState, clear_mask: jax.Array) -> PaneState:
@@ -173,6 +181,21 @@ def clear_kernel(state: PaneState, clear_mask: jax.Array) -> PaneState:
         mins=jnp.where(m3, jnp.inf, state.mins),
         counts=jnp.where(m2, 0, state.counts),
     )
+
+
+_JIT_APPLY = jax.jit(
+    apply_kernel,
+    static_argnames=("agg", "pane_ms", "offset_ms", "ring", "dump_row"))
+_JIT_FIRE_PACK = jax.jit(
+    fire_pack_kernel,
+    static_argnames=("agg", "panes_per_window", "ring"))
+_JIT_CLEAR = jax.jit(clear_kernel)
+
+# catch-up fires are evaluated in chunks of this many windows so they
+# reuse the steady-state compiled kernels (pow2 pads: 1,2) and keep each
+# packed buffer small — device→host bandwidth is the emit ceiling and
+# chunked buffers still fetch together in one round trip
+MAX_FIRE_CHUNK = 2
 
 
 # ---------------------------------------------------------------------------
@@ -348,25 +371,24 @@ class WindowOperator:
 
     # -- kernel construction --------------------------------------------
     def _build_local_kernels(self) -> None:
-        self._apply = jax.jit(
-            functools.partial(
-                apply_kernel,
-                agg=self.agg,
-                pane_ms=self.plan.pane_ms,
-                offset_ms=self.plan.offset_ms,
-                ring=self.plan.ring,
-                dump_row=self.layout.slots,
-            )
+        # module-level jits (statics in the cache key) so operators with
+        # equal configuration — across jobs in one process — share one
+        # compiled kernel instead of recompiling per instance
+        self._apply = functools.partial(
+            _JIT_APPLY,
+            agg=self.agg,
+            pane_ms=self.plan.pane_ms,
+            offset_ms=self.plan.offset_ms,
+            ring=self.plan.ring,
+            dump_row=self.layout.slots,
         )
-        self._fire_pack = jax.jit(
-            functools.partial(
-                fire_pack_kernel,
-                agg=self.agg,
-                panes_per_window=self.plan.panes_per_window,
-                ring=self.plan.ring,
-            )
+        self._fire_pack = functools.partial(
+            _JIT_FIRE_PACK,
+            agg=self.agg,
+            panes_per_window=self.plan.panes_per_window,
+            ring=self.plan.ring,
         )
-        self._clear = jax.jit(clear_kernel)
+        self._clear = _JIT_CLEAR
 
     def _init_sharded_state(self) -> PaneState:
         mp = self.mesh_plan
@@ -419,11 +441,11 @@ class WindowOperator:
             packed = fire_pack_kernel(
                 state, end_panes, w_valid, lo, hi, used_mask,
                 agg=agg, panes_per_window=plan.panes_per_window, ring=plan.ring)
-            # globalize row ids (each device block carries its own rows)
+            # globalize row ids (each device block carries its own rows);
+            # column 0 of body rows is the slot row, head row 0 holds n
             my = lax.axis_index(AXIS).astype(jnp.int32)
-            packed["__row__"] = packed["__row__"] + my * rows_local
-            packed["__n__"] = packed["__n__"].reshape(1)
-            return packed
+            offset = jnp.zeros_like(packed[:, 0]).at[1:].set(my * rows_local)
+            return packed.at[:, 0].add(offset)
 
         state_spec = jax.tree_util.tree_map(lambda _: P(AXIS), self.state)
         batch_spec = P(AXIS)
@@ -607,51 +629,85 @@ class WindowOperator:
         ends = [e for e in ends if e > lo and e - ppw <= hi]
         if not ends:
             return self._empty()
-        # pad the window axis to a power of two so the fire kernel
-        # compiles once per bucket size, not once per distinct fire count
-        W = len(ends)
-        Wp = 1
-        while Wp < W:
-            Wp *= 2
-        ends_padded = ends + [ends[-1]] * (Wp - W)
-        end_arr = jnp.asarray(np.asarray(ends_padded, dtype=np.int64))
-        w_valid = jnp.asarray(np.arange(Wp) < W)
-        packed = self._fire_pack(
-            self.state, end_arr, w_valid, jnp.int64(lo), jnp.int64(hi),
-            self._used_mask_device())
-        return FiredWindows(fetch=functools.partial(self._materialize, packed))
+        # pad the window axis to a power of two (compile once per bucket
+        # size, not per distinct fire count) and CHUNK large fires at
+        # MAX_FIRE_CHUNK windows: a catch-up advance reuses the small
+        # steady-state kernels instead of compiling a one-off giant one
+        used = self._used_mask_device()
+        packs = []
+        for c0 in range(0, len(ends), MAX_FIRE_CHUNK):
+            chunk = ends[c0:c0 + MAX_FIRE_CHUNK]
+            W = len(chunk)
+            Wp = 1
+            while Wp < W:
+                Wp *= 2
+            ends_padded = chunk + [chunk[-1]] * (Wp - W)
+            end_arr = jnp.asarray(np.asarray(ends_padded, dtype=np.int64))
+            w_valid = jnp.asarray(np.arange(Wp) < W)
+            buf = self._fire_pack(
+                self.state, end_arr, w_valid, jnp.int64(lo), jnp.int64(hi),
+                used)
+            # start the device→host copy NOW (non-blocking): by the time
+            # the drain thread materializes, the data is already local
+            buf.copy_to_host_async()
+            packs.append((lo, buf))
+        return FiredWindows(op=self, packs=packs)
 
-    def _materialize(self, packed: Dict[str, jax.Array]) -> Dict[str, np.ndarray]:
-        """ONE device→host round trip for the whole fired batch, then
-        host-side decoration (slot → original key, pane → window times)."""
-        h = jax.device_get(packed)
-        if self.mesh_plan is None:
-            segs = [(h, 0, int(h["__n__"]))]
+    def _result_fields(self) -> List[str]:
+        """Sorted result-lane field names — the packed buffer's column
+        order past [row, end_delta, count]. MUST mirror
+        fire_pack_kernel's ``sorted(res)`` exactly (including a result
+        field named 'count' if the aggregate emits one)."""
+        if not hasattr(self, "_res_fields"):
+            agg = self.agg
+            res = agg.finalize(
+                np.zeros((0, agg.sum_width), np.float32),
+                np.zeros((0, agg.max_width), np.float32),
+                np.zeros((0, agg.min_width), np.float32),
+                np.zeros((0,), np.int32))
+            self._res_fields = sorted(res)
+            self._res_is_int = {
+                k: np.issubdtype(np.asarray(res[k]).dtype, np.integer)
+                for k in res
+            }
+        return self._res_fields
+
+    def _decode_packs(self, packs, bufs) -> Dict[str, np.ndarray]:
+        """Host-side decode of fetched fire buffers (bitcast lanes,
+        slot → key, pane → window times)."""
+        fields = self._result_fields()
+        segs = []  # (buffer_body_slice, lo)
+        for (lo, _), buf in zip(packs, bufs):
+            if self.mesh_plan is None:
+                n = int(buf[0, 0])
+                segs.append((buf[1:1 + n], lo))
+            else:
+                blk = len(buf) // self.mesh_plan.n_devices
+                for d in range(self.mesh_plan.n_devices):
+                    block = buf[d * blk:(d + 1) * blk]
+                    n = int(block[0, 0])
+                    segs.append((block[1:1 + n], lo))
+        if segs:
+            body = np.concatenate([s for s, _ in segs])
+            lo_col = np.concatenate(
+                [np.full(len(s), lo, np.int64) for s, lo in segs])
         else:
-            k_local = len(h["__row__"]) // self.mesh_plan.n_devices
-            segs = [
-                (h, d * k_local, d * k_local + int(n))
-                for d, n in enumerate(h["__n__"])
-            ]
-        fields = [k for k in h if not k.startswith("__")]
-        parts = {k: [] for k in fields}
-        rows_l = []
-        ends_l = []
-        for seg, a, b in segs:
-            rows_l.append(seg["__row__"][a:b])
-            ends_l.append(seg["__end_pane__"][a:b])
-            for k in fields:
-                parts[k].append(seg[k][a:b])
-        rows = np.concatenate(rows_l) if rows_l else np.zeros(0, np.int32)
-        end_pane = np.concatenate(ends_l) if ends_l else np.zeros(0, np.int64)
+            body = np.zeros((0, 3 + len(fields)), np.int32)
+            lo_col = np.zeros(0, np.int64)
+        rows = body[:, 0]
+        end_pane = lo_col + body[:, 1]
         window_end = end_pane * self.plan.pane_ms + self.plan.offset_ms
         out: Dict[str, np.ndarray] = {
             "key": self.directory.key_of_slots(self._slot_of_rows(rows)),
             "window_start": window_end - self.plan.size_ms,
             "window_end": window_end,
+            "count": body[:, 2],
         }
-        for k in fields:
-            out[k] = np.concatenate(parts[k])
+        for i, k in enumerate(fields):
+            if k == "count":
+                continue  # the exact i32 column beats the bitcast lane
+            col = np.ascontiguousarray(body[:, 3 + i])
+            out[k] = col if self._res_is_int[k] else col.view(np.float32)
         return out
 
     def _used_mask_device(self) -> jax.Array:
@@ -709,6 +765,7 @@ class WindowOperator:
     # -- snapshot seam (checkpoint/ uses this) ---------------------------
     def snapshot_state(self) -> Dict[str, Any]:
         return {
+            "n_dev": self.mesh_plan.n_devices if self.mesh_plan else 1,
             "panes": jax.tree_util.tree_map(np.asarray, self.state),
             "directory": self.directory.snapshot(),
             "watermark": self.watermark,
@@ -721,7 +778,17 @@ class WindowOperator:
         }
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
-        state = jax.tree_util.tree_map(jnp.asarray, snap["panes"])
+        panes = snap["panes"]
+        snap_dev = snap.get("n_dev", 1)
+        cur_dev = self.mesh_plan.n_devices if self.mesh_plan else 1
+        if snap_dev != cur_dev:
+            # RESHARD: the key-shard space is fixed (the maxParallelism
+            # contract) but the device count changed — re-block the row
+            # axis, dropping the old per-block dump rows and inserting
+            # fresh ones (ref role: StateAssignmentOperation re-splitting
+            # key-group ranges on rescale)
+            panes = _reblock_panes(panes, snap_dev, cur_dev)
+        state = jax.tree_util.tree_map(jnp.asarray, panes)
         if self.mesh_plan is not None:
             state = jax.device_put(state, self.mesh_plan.row_sharding())
         self.state = state
@@ -738,6 +805,37 @@ class WindowOperator:
         self._used_pushed = -1  # directory changed: invalidate device used-mask
 
 
+def _reblock_panes(panes: PaneState, old_dev: int, new_dev: int) -> PaneState:
+    """Re-block state rows from old_dev device blocks to new_dev blocks.
+    Each block is (slots_local + 1 dump) rows; logical slot order is
+    preserved (global slot = shard * slots_per_shard, contiguous)."""
+
+    def reblock(arr: np.ndarray, dump_fill) -> np.ndarray:
+        arr = np.asarray(arr)
+        rpl = arr.shape[0] // old_dev          # rows per old block
+        blocks = [arr[d * rpl:(d + 1) * rpl - 1] for d in range(old_dev)]
+        logical = np.concatenate(blocks)       # (total_slots, ...)
+        if logical.shape[0] % new_dev != 0:
+            raise ValueError(
+                f"cannot reshard {logical.shape[0]} slots onto {new_dev} "
+                "devices — num_shards * slots_per_shard must be divisible "
+                "by the device count (the key-group contract)")
+        slots_new = logical.shape[0] // new_dev
+        out = []
+        for d in range(new_dev):
+            blk = logical[d * slots_new:(d + 1) * slots_new]
+            dump = np.full((1,) + arr.shape[1:], dump_fill, dtype=arr.dtype)
+            out.append(np.concatenate([blk, dump]))
+        return np.concatenate(out)
+
+    return PaneState(
+        sums=reblock(panes.sums, 0.0),
+        maxs=reblock(panes.maxs, -np.inf),
+        mins=reblock(panes.mins, np.inf),
+        counts=reblock(panes.counts, 0),
+    )
+
+
 class FiredWindows(Mapping):
     """A fired-window batch with lazy host materialization.
 
@@ -747,17 +845,41 @@ class FiredWindows(Mapping):
     separate thread — the analogue of the reference handing serialized
     buffers to Netty's IO thread off the mailbox thread (ref:
     runtime/io/network/api/writer/RecordWriter.java → PipelinedSubpartition
-    .notifyDataAvailable), so emission latency never blocks ingest."""
+    .notifyDataAvailable), so emission latency never blocks ingest.
+    ``materialize_many`` fetches a whole backlog of fires in ONE
+    device→host round trip (the transport serializes round trips, so
+    one per fire is the emit-path latency floor — batch them)."""
 
-    def __init__(self, data: Optional[Dict[str, np.ndarray]] = None, fetch=None):
+    def __init__(self, data: Optional[Dict[str, np.ndarray]] = None,
+                 fetch=None, op=None, packs=None):
         self._data = data
         self._fetch = fetch
+        self._op = op
+        self._packs = packs
 
     def materialize(self) -> Dict[str, np.ndarray]:
         if self._data is None:
-            self._data = self._fetch()
-            self._fetch = None
+            if self._fetch is not None:
+                self._data = self._fetch()
+                self._fetch = None
+            else:
+                bufs = jax.device_get([b for _, b in self._packs])
+                self._data = self._op._decode_packs(self._packs, bufs)
+                self._packs = self._op = None
         return self._data
+
+    @staticmethod
+    def materialize_many(fireds: List["FiredWindows"]) -> None:
+        """Fetch every pending buffer across ``fireds`` in one
+        device_get, then decode each."""
+        pending = [f for f in fireds if f._data is None and f._packs is not None]
+        if not pending:
+            return
+        all_bufs = jax.device_get(
+            [[b for _, b in f._packs] for f in pending])
+        for f, bufs in zip(pending, all_bufs):
+            f._data = f._op._decode_packs(f._packs, bufs)
+            f._packs = f._op = None
 
     def __getitem__(self, key: str) -> np.ndarray:
         return self.materialize()[key]

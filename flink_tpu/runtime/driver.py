@@ -54,6 +54,15 @@ class Driver:
         self.metrics: Dict[str, int] = {
             "records_in": 0, "records_out": 0, "batches": 0, "fired_windows": 0,
         }
+        self._emit_q = None
+        self._drain_error: Optional[BaseException] = None
+        self._stateless_cache: Dict[int, bool] = {}
+        import threading
+
+        # serializes downstream pushes from the ingest thread and the
+        # drain thread (shared sinks + metrics are single-writer at a
+        # time; the expensive materialization stays outside the lock)
+        self._push_lock = threading.Lock()
         self._build_ops()
 
     # -- construction ----------------------------------------------------
@@ -102,23 +111,109 @@ class Driver:
                     max_out_of_orderness_ms=max(wm.max_out_of_orderness_ms, 0),
                 )
 
+    # -- checkpointing ---------------------------------------------------
+    def _setup_checkpointing(self, job_name: str):
+        from flink_tpu.checkpoint.coordinator import CheckpointCoordinator
+        from flink_tpu.checkpoint.storage import FsCheckpointStorage
+
+        interval = self.config.get(CheckpointingOptions.INTERVAL)
+        restore = self.config.get(CheckpointingOptions.RESTORE)
+        if interval <= 0 and not restore:
+            return None
+        storage = FsCheckpointStorage(
+            self.config.get(CheckpointingOptions.DIRECTORY),
+            job_id=job_name.replace("/", "_"),
+            retained=self.config.get(CheckpointingOptions.RETAINED))
+        return CheckpointCoordinator(storage)
+
+    def _snapshot(self) -> Dict[str, Any]:
+        return {
+            "sources": {sid: dict(pos) for sid, pos in self._positions.items()},
+            "wm_gens": {sid: [g.snapshot() for g in gens]
+                        for sid, gens in self._wm_gens.items()},
+            "max_ts": dict(self._max_ts),
+            "out_wm": dict(self._out_wm),
+            "operators": {nid: op.snapshot_state()
+                          for nid, op in self._ops.items()},
+            "metrics": dict(self.metrics),
+        }
+
+    def _restore(self, payload: Dict[str, Any]) -> None:
+        self._positions = {sid: dict(pos)
+                           for sid, pos in payload["sources"].items()}
+        for sid, states in payload["wm_gens"].items():
+            for g, s in zip(self._wm_gens[sid], states):
+                g.restore(s)
+        self._max_ts.update(payload["max_ts"])
+        self._out_wm.update(payload["out_wm"])
+        for nid, snap in payload["operators"].items():
+            self._ops[nid].restore_state(snap)
+        self.metrics.update(payload["metrics"])
+        for n in self.plan.nodes.values():
+            if n.kind == "sink" and hasattr(n.sink, "abort_uncommitted"):
+                n.sink.abort_uncommitted()
+
+    def checkpoint_now(self, savepoint: bool = False):
+        """Trigger one checkpoint at the current step boundary (ref:
+        CheckpointCoordinator.triggerCheckpoint; savepoint=True for the
+        manually-triggered retained form)."""
+        assert self._coordinator is not None, "checkpointing not configured"
+        self._flush_emits()  # barrier: staged epoch must be complete
+        sinks = [n.sink for n in self.plan.nodes.values() if n.kind == "sink"]
+        return self._coordinator.trigger(
+            self._snapshot,
+            commit_fns=[s.notify_checkpoint_complete for s in sinks],
+            prepare_fns=[s.prepare_commit for s in sinks],
+            savepoint=savepoint,
+        )
+
     # -- run loop --------------------------------------------------------
     def run(self, job_name: str = "job"):
         from flink_tpu.api.environment import JobResult
 
-        srcs = {}
+        import queue
+        import threading
+
+        self._coordinator = self._setup_checkpointing(job_name)
+        interval_ms = self.config.get(CheckpointingOptions.INTERVAL)
+        restore = self.config.get(CheckpointingOptions.RESTORE)
+        self._positions: Dict[int, Dict[int, int]] = {}
+        self._emit_q = queue.Queue()
+        drain = threading.Thread(target=self._drain_loop, daemon=True)
+        drain.start()
+
         for sid in self.plan.sources:
             n = self.plan.node(sid)
-            its = [n.source.open_split(s) for s in n.source.splits()]
-            srcs[sid] = its
             strategy = n.watermark_strategy or self.plan.watermark_strategy
             # one watermark generator PER SPLIT, combined with min — the
             # per-channel rule (ref: StatusWatermarkValve; a lagging split
             # must hold the source watermark back or its records would be
             # dropped as late)
-            self._wm_gens[sid] = [make_generator(strategy) for _ in its]
+            self._wm_gens[sid] = [make_generator(strategy)
+                                  for _ in n.source.splits()]
             self._max_ts[sid] = LONG_MIN
+            self._positions[sid] = {i: 0 for i in range(len(n.source.splits()))}
 
+        if restore:
+            from flink_tpu.checkpoint.storage import FsCheckpointStorage
+
+            if restore == "latest":
+                payload = self._coordinator.restore_latest()
+            else:
+                payload = FsCheckpointStorage.load(restore)
+                self._coordinator.resume_numbering(payload)
+            if payload is not None:
+                self._restore(payload)
+
+        srcs = {}
+        for sid in self.plan.sources:
+            n = self.plan.node(sid)
+            srcs[sid] = [
+                n.source.open_split(s, self._positions[sid].get(i, 0))
+                for i, s in enumerate(n.source.splits())
+            ]
+
+        last_chk = time.time()
         active = {sid: list(range(len(its))) for sid, its in srcs.items()}
         while any(active.values()):
             for sid, splits_alive in list(active.items()):
@@ -133,9 +228,11 @@ class Driver:
                     data, ts = nxt
                     ts = np.asarray(ts, np.int64)
                     valid = np.ones(len(ts), bool)
-                    self.metrics["records_in"] += len(ts)
-                    self.metrics["batches"] += 1
-                    self._push_downstream(sid, (dict(data), ts, valid))
+                    with self._push_lock:
+                        self.metrics["records_in"] += len(ts)
+                        self.metrics["batches"] += 1
+                        self._push_downstream(sid, (dict(data), ts, valid))
+                    self._positions[sid][split_ix] += 1
                     if len(ts):
                         mx = int(ts.max())
                         self._max_ts[sid] = max(self._max_ts[sid], mx)
@@ -148,12 +245,26 @@ class Driver:
                     self._out_wm[sid] = min(g.current() for g in gens)
                 elif self._wm_gens[sid]:
                     self._out_wm[sid] = min(g.current() for g in self._wm_gens[sid])
-                self._propagate_watermarks()
+                with self._push_lock:
+                    self._propagate_watermarks()
+                self._check_drain_error()
+            if (self._coordinator is not None and interval_ms > 0
+                    and (time.time() - last_chk) * 1000 >= interval_ms):
+                self.checkpoint_now()
+                last_chk = time.time()
 
         # end of input: final watermark per stateful op flushes everything
         for sid in self.plan.sources:
             self._out_wm[sid] = _FINAL
-        self._propagate_watermarks(final=True)
+        with self._push_lock:
+            self._propagate_watermarks(final=True)
+        self._flush_emits()
+        if self._coordinator is not None and interval_ms > 0:
+            self.checkpoint_now()  # final epoch commit for 2PC sinks
+        self._emit_q.put(None)
+        drain.join()
+        self._emit_q = None
+        self._check_drain_error()
         for n in self.plan.nodes.values():
             if n.kind == "sink":
                 n.sink.close()
@@ -221,6 +332,19 @@ class Driver:
                 self._out_wm[nid] = in_wm
 
     def _emit_fired(self, nid: int, fired) -> None:
+        """Route fired windows downstream. When the downstream subtree is
+        stateless (chains/sinks only), materialization happens on the
+        drain thread — the device→host fetch leaves the hot loop, the
+        way the reference hands buffers to Netty's IO thread off the
+        mailbox thread (ref: PipelinedSubpartition.notifyDataAvailable).
+        Stateful downstream (a second window stage) keeps the in-line
+        path so operator state is touched by one thread only."""
+        if self._emit_q is not None and self._stateless_downstream(nid):
+            self._emit_q.put((nid, fired))
+            return
+        self._emit_fired_sync(nid, fired)
+
+    def _emit_fired_sync(self, nid: int, fired) -> None:
         out = dict(fired)
         nrec = len(out.get("key", ()))
         if nrec == 0:
@@ -229,6 +353,77 @@ class Driver:
         ts = np.asarray(out["window_end"], np.int64) - 1
         valid = np.ones(nrec, bool)
         self._push_downstream(nid, (out, ts, valid))
+
+    def _stateless_downstream(self, nid: int) -> bool:
+        """True iff nothing stateful (window/session/join) is reachable
+        below nid — the async-drain safety condition."""
+        if nid not in self._stateless_cache:
+            seen = set()
+            stack = list(self.plan.node(nid).downstream)
+            ok = True
+            while stack:
+                d = stack.pop()
+                if d in seen:
+                    continue
+                seen.add(d)
+                k = self.plan.node(d).kind
+                if k in ("window", "session", "join"):
+                    ok = False
+                    break
+                stack.extend(self.plan.node(d).downstream)
+            self._stateless_cache[nid] = ok
+        return self._stateless_cache[nid]
+
+    def _drain_loop(self) -> None:
+        import queue as _q
+
+        from flink_tpu.ops.window import FiredWindows
+
+        while True:
+            items = [self._emit_q.get()]
+            # opportunistically take the whole backlog: N queued fires
+            # materialize in ONE device→host round trip instead of N
+            while True:
+                try:
+                    items.append(self._emit_q.get_nowait())
+                except _q.Empty:
+                    break
+            stop = any(i is None for i in items)
+            batch = [i for i in items if i is not None]
+            try:
+                FiredWindows.materialize_many([f for _, f in batch])
+                with self._push_lock:
+                    for nid, fired in batch:
+                        self._emit_fired_sync(nid, fired)
+            except BaseException as e:  # surface at the next barrier —
+                # a silently-dead drain thread would deadlock join()
+                self._drain_error = e
+                for _ in items:
+                    self._emit_q.task_done()
+                # keep consuming so task_done accounting stays balanced
+                while True:
+                    it = self._emit_q.get()
+                    self._emit_q.task_done()
+                    if it is None:
+                        return
+            else:
+                for _ in items:
+                    self._emit_q.task_done()
+            if stop:
+                return
+
+    def _check_drain_error(self) -> None:
+        if self._drain_error is not None:
+            e = self._drain_error
+            self._drain_error = None
+            raise e
+
+    def _flush_emits(self) -> None:
+        """Barrier: all enqueued fires fully delivered (checkpoint
+        consistency + end-of-job ordering)."""
+        if self._emit_q is not None:
+            self._emit_q.join()
+        self._check_drain_error()
 
 
 _FINAL = np.iinfo(np.int64).max  # end-of-input marker watermark

@@ -63,6 +63,12 @@ class MonotonousWatermarks:
     def current(self) -> int:
         return self._max_ts - 1 if self._max_ts != LONG_MIN else LONG_MIN
 
+    def snapshot(self) -> int:
+        return self._max_ts
+
+    def restore(self, state: int) -> None:
+        self._max_ts = state
+
 
 class BoundedOutOfOrdernessWatermarks:
     """wm = max_ts - delay - 1 (ref: BoundedOutOfOrdernessWatermarks.java:
@@ -81,6 +87,12 @@ class BoundedOutOfOrdernessWatermarks:
         if self._max_ts == LONG_MIN:
             return LONG_MIN
         return self._max_ts - self._delay - 1
+
+    def snapshot(self) -> int:
+        return self._max_ts
+
+    def restore(self, state: int) -> None:
+        self._max_ts = state
 
 
 def make_generator(strategy: WatermarkStrategy):
