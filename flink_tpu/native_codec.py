@@ -1,0 +1,180 @@
+"""Native host codec bindings — build, load, and numpy fallbacks.
+
+ref roles: SURVEY §3.10 item 2 (PyFlink Cython coder fast paths →
+C++ record codec + ingest shim). The shared library builds on demand
+from ``native/codec.cc`` with the system toolchain; every entry point
+has a pure-numpy fallback so the package works unbuilt (the .so is a
+fast path, not a dependency).
+
+The token/string hash here is bit-identical to
+``records.hash_string_key`` — host-encoded keys and Python-hashed keys
+must route to the same key shard.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_REPO, "native", "codec.cc")
+_SO = os.path.join(_REPO, "native", "libflinktpucodec.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def build(force: bool = False) -> bool:
+    """Compile the codec .so (g++ -O3). Returns success."""
+    if os.path.exists(_SO) and not force:
+        return True
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO],
+            check=True, capture_output=True)
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_SO) and not build():
+        return None
+    lib = ctypes.CDLL(_SO)
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    lib.tokenize_hash.restype = ctypes.c_int64
+    lib.tokenize_hash.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, i64p, ctypes.c_int64,
+        i64p, i64p, ctypes.c_int64]
+    lib.hash_strings.restype = None
+    lib.hash_strings.argtypes = [ctypes.c_char_p, i64p, ctypes.c_int64, i64p]
+    lib.parse_i64_table.restype = ctypes.c_int64
+    lib.parse_i64_table.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_char, ctypes.c_int64,
+        i64p, ctypes.c_int64]
+    lib.parse_f32_table.restype = ctypes.c_int64
+    lib.parse_f32_table.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_char, ctypes.c_int64,
+        f32p, ctypes.c_int64]
+    lib.encode_i64_rows.restype = ctypes.c_int64
+    lib.encode_i64_rows.argtypes = [
+        i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_char,
+        ctypes.c_char_p, ctypes.c_int64]
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def tokenize_hash(lines: List[str]) -> Tuple[np.ndarray, np.ndarray]:
+    """Tokenize lines on whitespace → (token_hash_ids, line_index).
+    WordCount's ingest hot path (flat_map tokenize + dictionary encode
+    in one native pass)."""
+    lib = _load()
+    if lib is None:
+        return _tokenize_hash_numpy(lines)
+    enc = [s.encode("utf-8") for s in lines]
+    offs = np.zeros(len(enc) + 1, np.int64)
+    np.cumsum([len(b) + 1 for b in enc], out=offs[1:])
+    buf = b"\n".join(enc) + b"\n"
+    cap = max(len(buf), 16)
+    ids = np.empty(cap, np.int64)
+    line_ix = np.empty(cap, np.int64)
+    n = lib.tokenize_hash(buf, len(buf), offs, len(enc), ids, line_ix, cap)
+    assert n >= 0
+    return ids[:n].copy(), line_ix[:n].copy()
+
+
+def _tokenize_hash_numpy(lines: List[str]) -> Tuple[np.ndarray, np.ndarray]:
+    from flink_tpu.records import hash_string_key
+
+    ids, lix = [], []
+    for i, line in enumerate(lines):
+        for w in line.split():
+            ids.append(hash_string_key(w))
+            lix.append(i)
+    return np.asarray(ids, np.int64), np.asarray(lix, np.int64)
+
+
+def hash_strings(strings: List[str]) -> np.ndarray:
+    """Dictionary-encode a string column to stable 63-bit ids."""
+    lib = _load()
+    if lib is None:
+        from flink_tpu.records import hash_string_key
+
+        return np.asarray([hash_string_key(s) for s in strings], np.int64)
+    enc = [s.encode("utf-8") for s in strings]
+    offs = np.zeros(len(enc) + 1, np.int64)
+    np.cumsum([len(b) for b in enc], out=offs[1:])
+    buf = b"".join(enc)
+    out = np.empty(len(enc), np.int64)
+    lib.hash_strings(buf, offs, len(enc), out)
+    return out
+
+
+def parse_i64_table(data: bytes, n_cols: int, delim: str = ",",
+                    max_rows: Optional[int] = None) -> np.ndarray:
+    """Delimited text → (rows, n_cols) int64 (CSV ingest fast path)."""
+    lib = _load()
+    cap = max_rows if max_rows is not None else data.count(b"\n") + 1
+    if lib is None:
+        rows = [r.split(delim.encode()) for r in data.splitlines() if r]
+        out = np.zeros((min(len(rows), cap), n_cols), np.int64)
+        for i, r in enumerate(out):
+            for c in range(n_cols):
+                try:
+                    r[c] = int(rows[i][c])
+                except (IndexError, ValueError):
+                    r[c] = 0
+        return out
+    out = np.zeros((cap, n_cols), np.int64)
+    n = lib.parse_i64_table(data, len(data), delim.encode(), n_cols,
+                            out.reshape(-1), cap)
+    return out[:n]
+
+
+def parse_f32_table(data: bytes, n_cols: int, delim: str = ",",
+                    max_rows: Optional[int] = None) -> np.ndarray:
+    lib = _load()
+    cap = max_rows if max_rows is not None else data.count(b"\n") + 1
+    if lib is None:
+        rows = [r.split(delim.encode()) for r in data.splitlines() if r]
+        out = np.zeros((min(len(rows), cap), n_cols), np.float32)
+        for i in range(out.shape[0]):
+            for c in range(n_cols):
+                try:
+                    out[i, c] = float(rows[i][c])
+                except (IndexError, ValueError):
+                    out[i, c] = 0.0
+        return out
+    out = np.zeros((cap, n_cols), np.float32)
+    n = lib.parse_f32_table(data, len(data), delim.encode(), n_cols,
+                            out.reshape(-1), cap)
+    return out[:n]
+
+
+def encode_i64_rows(vals: np.ndarray, delim: str = ",") -> bytes:
+    """(rows, cols) int64 → delimited text (egress fast path)."""
+    vals = np.ascontiguousarray(vals, np.int64)
+    lib = _load()
+    if lib is None:
+        d = delim
+        return ("".join(d.join(str(int(v)) for v in row) + "\n"
+                        for row in vals)).encode()
+    cap = vals.size * 22 + vals.shape[0] + 16
+    buf = ctypes.create_string_buffer(cap)
+    n = lib.encode_i64_rows(vals.reshape(-1), vals.shape[0],
+                            vals.shape[1] if vals.ndim > 1 else 1,
+                            delim.encode(), buf, cap)
+    assert n >= 0
+    return buf.raw[:n]

@@ -1,0 +1,159 @@
+"""Job coordinator — the control-plane master process.
+
+ref: runtime/dispatcher/Dispatcher.java (submission + bookkeeping),
+runtime/jobmaster/JobMaster.java (per-job control), runtime/heartbeat/
+{HeartbeatManagerImpl,HeartbeatMonitorImpl}.java (failure detection),
+runtime/resourcemanager (runner inventory).
+
+TPU-first shape (SURVEY §3.6 mapping): the coordinator is a HOST-level
+concept — one per job cluster, tracking per-host runners. Data-plane
+exchange never touches it (keyed repartition is an in-step ICI
+all_to_all); it carries only job lifecycle, heartbeats, checkpoint
+control, and rescale decisions, so message volume is tiny and a single
+endpoint thread suffices (the RpcEndpoint discipline).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from flink_tpu.config import ClusterOptions, Configuration
+from flink_tpu.runtime.restart import RestartStrategy, from_config
+from flink_tpu.runtime.rpc import RpcEndpoint, RpcServer
+
+
+@dataclasses.dataclass
+class RunnerInfo:
+    runner_id: str
+    host: str
+    n_devices: int
+    last_heartbeat: float
+    alive: bool = True
+
+
+@dataclasses.dataclass
+class JobInfo:
+    job_id: str
+    state: str = "CREATED"  # CREATED RUNNING RESTARTING FAILED FINISHED CANCELED
+    attempts: int = 0
+    assigned_runners: List[str] = dataclasses.field(default_factory=list)
+    failure: Optional[str] = None
+
+
+class JobCoordinator(RpcEndpoint):
+    """RPC surface (all single-threaded via RpcServer dispatch):
+    register_runner / heartbeat / submit_job / job_status / cancel_job /
+    report_failure / list_runners. A monitor thread expires runners whose
+    heartbeats stop (ref: heartbeat.timeout, default 50s)."""
+
+    def __init__(self, config: Optional[Configuration] = None) -> None:
+        self.config = config or Configuration()
+        self.runners: Dict[str, RunnerInfo] = {}
+        self.jobs: Dict[str, JobInfo] = {}
+        self._strategies: Dict[str, RestartStrategy] = {}
+        self._hb_timeout = self.config.get(ClusterOptions.HEARTBEAT_TIMEOUT) / 1000
+        self._lock = threading.Lock()  # monitor thread + rpc thread
+        self._closed = False
+        self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
+        self._monitor.start()
+
+    # -- rpc methods -----------------------------------------------------
+    def rpc_register_runner(self, runner_id: str, host: str, n_devices: int) -> dict:
+        with self._lock:
+            self.runners[runner_id] = RunnerInfo(
+                runner_id, host, n_devices, time.time())
+        return {"heartbeat_interval_ms":
+                self.config.get(ClusterOptions.HEARTBEAT_INTERVAL)}
+
+    def rpc_heartbeat(self, runner_id: str, metrics: Optional[dict] = None) -> dict:
+        with self._lock:
+            r = self.runners.get(runner_id)
+            if r is None:
+                return {"known": False}  # re-register (coordinator restarted)
+            r.last_heartbeat = time.time()
+            r.alive = True
+        return {"known": True}
+
+    def rpc_submit_job(self, job_id: str, runners: Optional[List[str]] = None) -> dict:
+        with self._lock:
+            alive = [r.runner_id for r in self.runners.values() if r.alive]
+            chosen = runners or alive
+            job = JobInfo(job_id, state="RUNNING", attempts=1,
+                          assigned_runners=chosen)
+            self.jobs[job_id] = job
+            self._strategies[job_id] = from_config(self.config)
+        return {"assigned": chosen}
+
+    def rpc_job_status(self, job_id: str) -> dict:
+        with self._lock:
+            j = self.jobs.get(job_id)
+            if j is None:
+                return {"state": "UNKNOWN"}
+            return {"state": j.state, "attempts": j.attempts,
+                    "failure": j.failure}
+
+    def rpc_cancel_job(self, job_id: str) -> dict:
+        with self._lock:
+            j = self.jobs.get(job_id)
+            if j is not None and j.state in ("RUNNING", "RESTARTING"):
+                j.state = "CANCELED"
+        return {"ok": True}
+
+    def rpc_finish_job(self, job_id: str) -> dict:
+        with self._lock:
+            j = self.jobs.get(job_id)
+            if j is not None:
+                j.state = "FINISHED"
+        return {"ok": True}
+
+    def rpc_report_failure(self, job_id: str, error: str) -> dict:
+        """Task failure → restart decision (ref: DefaultScheduler.
+        updateTaskExecutionState → ExecutionFailureHandler →
+        RestartBackoffTimeStrategy)."""
+        with self._lock:
+            j = self.jobs.get(job_id)
+            if j is None:
+                return {"action": "unknown-job"}
+            j.failure = error
+            strat = self._strategies[job_id]
+            if strat.can_restart():
+                delay = strat.next_delay_ms()
+                j.state = "RESTARTING"
+                j.attempts += 1
+                return {"action": "restart", "delay_ms": delay,
+                        "restore": "latest"}
+            j.state = "FAILED"
+            return {"action": "fail"}
+
+    def rpc_list_runners(self) -> dict:
+        with self._lock:
+            return {rid: {"host": r.host, "n_devices": r.n_devices,
+                          "alive": r.alive}
+                    for rid, r in self.runners.items()}
+
+    # -- failure detection ----------------------------------------------
+    def _monitor_loop(self) -> None:
+        while not self._closed:
+            time.sleep(min(self._hb_timeout / 5, 1.0))
+            now = time.time()
+            with self._lock:
+                for r in self.runners.values():
+                    if r.alive and now - r.last_heartbeat > self._hb_timeout:
+                        r.alive = False
+                        # runner loss fails its jobs → restart path
+                        for j in self.jobs.values():
+                            if (j.state == "RUNNING"
+                                    and r.runner_id in j.assigned_runners):
+                                j.failure = f"runner {r.runner_id} lost"
+                                j.state = "RESTARTING"
+                                j.attempts += 1
+
+    def close(self) -> None:
+        self._closed = True
+
+
+def start_coordinator(config: Optional[Configuration] = None,
+                      port: int = 0) -> RpcServer:
+    return RpcServer(JobCoordinator(config), port)

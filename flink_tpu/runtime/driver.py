@@ -54,6 +54,17 @@ class Driver:
         self.metrics: Dict[str, int] = {
             "records_in": 0, "records_out": 0, "batches": 0, "fired_windows": 0,
         }
+        from flink_tpu.obs.metrics import MetricRegistry
+
+        # ref: TaskIOMetricGroup numRecordsIn/Out + latency markers (§6.1)
+        self.registry = MetricRegistry()
+        g = self.registry.group("driver")
+        g.gauge("records_in", lambda: self.metrics["records_in"])
+        g.gauge("records_out", lambda: self.metrics["records_out"])
+        g.gauge("fired_windows", lambda: self.metrics["fired_windows"])
+        self._eps_meter = g.meter("records_per_sec")
+        self._lat_hist = g.histogram("emit_latency_ms")
+        self._wm_lag = g.gauge("watermark_lag_ms")
         self._emit_q = None
         self._drain_error: Optional[BaseException] = None
         self._stateless_cache: Dict[int, bool] = {}
@@ -174,10 +185,15 @@ class Driver:
         import queue
         import threading
 
+        from flink_tpu.obs.metrics import METRICS_PORT, MetricsServer
+
         self._coordinator = self._setup_checkpointing(job_name)
         interval_ms = self.config.get(CheckpointingOptions.INTERVAL)
         restore = self.config.get(CheckpointingOptions.RESTORE)
         self._positions: Dict[int, Dict[int, int]] = {}
+        port = self.config.get(METRICS_PORT)
+        self._metrics_server = (
+            MetricsServer(self.registry, port) if port else None)
         self._emit_q = queue.Queue()
         drain = threading.Thread(target=self._drain_loop, daemon=True)
         drain.start()
@@ -233,10 +249,12 @@ class Driver:
                         self.metrics["batches"] += 1
                         self._push_downstream(sid, (dict(data), ts, valid))
                     self._positions[sid][split_ix] += 1
+                    self._eps_meter.mark(len(ts))
                     if len(ts):
                         mx = int(ts.max())
                         self._max_ts[sid] = max(self._max_ts[sid], mx)
                         self._wm_gens[sid][split_ix].on_batch(mx)
+                        self._wm_lag.set(mx - self._out_wm[sid])
                 # exhausted splits stop holding the watermark back
                 # (ref: idle-channel handling in the valve)
                 gens = [g for i, g in enumerate(self._wm_gens[sid])
@@ -268,7 +286,15 @@ class Driver:
         for n in self.plan.nodes.values():
             if n.kind == "sink":
                 n.sink.close()
-        return JobResult(job_name, dict(self.metrics))
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+        for nid, op in self._ops.items():
+            if hasattr(op, "late_records"):
+                self.metrics["late_records"] = (
+                    self.metrics.get("late_records", 0) + op.late_records)
+        final = dict(self.metrics)
+        final.update(self.registry.snapshot())
+        return JobResult(job_name, final)
 
     # -- data plane ------------------------------------------------------
     def _push_downstream(self, nid: int, batch: Batch) -> None:
@@ -340,11 +366,11 @@ class Driver:
         Stateful downstream (a second window stage) keeps the in-line
         path so operator state is touched by one thread only."""
         if self._emit_q is not None and self._stateless_downstream(nid):
-            self._emit_q.put((nid, fired))
+            self._emit_q.put((nid, fired, time.time()))
             return
-        self._emit_fired_sync(nid, fired)
+        self._emit_fired_sync(nid, fired, time.time())
 
-    def _emit_fired_sync(self, nid: int, fired) -> None:
+    def _emit_fired_sync(self, nid: int, fired, stamp: float) -> None:
         out = dict(fired)
         nrec = len(out.get("key", ()))
         if nrec == 0:
@@ -353,6 +379,9 @@ class Driver:
         ts = np.asarray(out["window_end"], np.int64) - 1
         valid = np.ones(nrec, bool)
         self._push_downstream(nid, (out, ts, valid))
+        # latency marker: watermark-advance dispatch → delivered at sink
+        # (ref: streaming/runtime/streamrecord/LatencyMarker.java)
+        self._lat_hist.update((time.time() - stamp) * 1000.0)
 
     def _stateless_downstream(self, nid: int) -> bool:
         """True iff nothing stateful (window/session/join) is reachable
@@ -391,10 +420,10 @@ class Driver:
             stop = any(i is None for i in items)
             batch = [i for i in items if i is not None]
             try:
-                FiredWindows.materialize_many([f for _, f in batch])
+                FiredWindows.materialize_many([f for _, f, _ in batch])
                 with self._push_lock:
-                    for nid, fired in batch:
-                        self._emit_fired_sync(nid, fired)
+                    for nid, fired, stamp in batch:
+                        self._emit_fired_sync(nid, fired, stamp)
             except BaseException as e:  # surface at the next barrier —
                 # a silently-dead drain thread would deadlock join()
                 self._drain_error = e

@@ -1,0 +1,39 @@
+"""Job supervision — run with automatic failure recovery.
+
+ref: the region-failover flow (SURVEY §4.E): task failure → restart
+strategy consulted → cancel region → restore from the latest checkpoint
+→ redeploy. The driver's pipeline is one pipelined region, so recovery =
+rebuild the driver and resume from the newest complete checkpoint with
+replayable sources (exactly-once end to end with 2PC sinks)."""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional
+
+from flink_tpu.config import Configuration
+from flink_tpu.runtime.restart import from_config
+
+
+def run_with_recovery(
+    build_env: Callable[[Configuration], Any],
+    config: Configuration,
+    job_name: str = "job",
+    sleep_fn: Callable[[float], None] = time.sleep,
+):
+    """``build_env(config)`` must construct a FRESH
+    StreamExecutionEnvironment (sources/sinks re-created per attempt —
+    the redeploy step). First attempt starts fresh (or per config
+    restore); every retry restores from the latest checkpoint."""
+    strategy = from_config(config)
+    attempt_conf = config
+    while True:
+        env = build_env(attempt_conf)
+        try:
+            return env.execute(job_name)
+        except Exception as e:  # noqa: BLE001 — any task failure
+            if not strategy.can_restart():
+                raise
+            delay = strategy.next_delay_ms()
+            sleep_fn(delay / 1000.0)
+            attempt_conf = Configuration(config.to_dict()).set(
+                "execution.checkpointing.restore", "latest")

@@ -1,0 +1,140 @@
+// Host ingest codec — the native fast path for record decode/encode.
+//
+// ref roles: PyFlink's Cython coders (flink-python/pyflink/fn_execution/
+// coder_impl_fast.pyx — serialization inner loops compiled to C) and the
+// byte→record half of the network stack's deserializers
+// (runtime/io/network/api/serialization/
+// SpillingAdaptiveSpanningRecordDeserializer.java). SURVEY §3.10 item 2.
+//
+// Interface is plain C (ctypes binding — no pybind11 in the image): the
+// Python side passes raw numpy buffers; everything here is branch-light
+// single-pass scanning suitable for saturating a core on the ingest
+// plane while the device does the real aggregation.
+//
+// Hash: 63-bit FNV-1a, BIT-IDENTICAL to records.hash_string_key — keys
+// encoded here and keys hashed in Python MUST route identically.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Tokenize concatenated text and hash each whitespace-separated token.
+//   buf/len        : UTF-8 text of all lines, concatenated
+//   line_offs      : (n_lines+1) offsets of each line in buf
+//   out_ids        : token hash ids (63-bit FNV-1a)
+//   out_line       : originating line index per token
+//   max_out        : capacity of out arrays
+// Returns number of tokens written (or -1 if capacity exceeded).
+int64_t tokenize_hash(const char* buf, int64_t /*len*/,
+                      const int64_t* line_offs, int64_t n_lines,
+                      int64_t* out_ids, int64_t* out_line,
+                      int64_t max_out) {
+  int64_t n = 0;
+  for (int64_t li = 0; li < n_lines; ++li) {
+    const char* p = buf + line_offs[li];
+    const char* end = buf + line_offs[li + 1];
+    while (p < end) {
+      while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+        ++p;
+      if (p >= end) break;
+      uint64_t h = 0xCBF29CE484222325ULL;
+      while (p < end && !(*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) {
+        h = (h ^ (uint8_t)(*p)) * 0x100000001B3ULL;
+        ++p;
+      }
+      if (n >= max_out) return -1;
+      out_ids[n] = (int64_t)(h & 0x7FFFFFFFFFFFFFFFULL);
+      out_line[n] = li;
+      ++n;
+    }
+  }
+  return n;
+}
+
+// Hash fixed-offset byte strings (dictionary encoding of a string
+// column; ref role: StringSerializer + key-group hash).
+void hash_strings(const char* buf, const int64_t* offs, int64_t n,
+                  int64_t* out_ids) {
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t h = 0xCBF29CE484222325ULL;
+    for (const char* p = buf + offs[i]; p < buf + offs[i + 1]; ++p)
+      h = (h ^ (uint8_t)(*p)) * 0x100000001B3ULL;
+    out_ids[i] = (int64_t)(h & 0x7FFFFFFFFFFFFFFFULL);
+  }
+}
+
+// Parse delimiter-separated integer records: n_rows lines, n_cols each.
+//   Unparseable / missing cells read as 0. Returns rows parsed.
+int64_t parse_i64_table(const char* buf, int64_t len, char delim,
+                        int64_t n_cols, int64_t* out, int64_t max_rows) {
+  int64_t row = 0;
+  const char* p = buf;
+  const char* end = buf + len;
+  while (p < end && row < max_rows) {
+    for (int64_t c = 0; c < n_cols; ++c) {
+      int64_t v = 0;
+      bool neg = false;
+      if (p < end && *p == '-') { neg = true; ++p; }
+      while (p < end && *p >= '0' && *p <= '9') v = v * 10 + (*p++ - '0');
+      out[row * n_cols + c] = neg ? -v : v;
+      if (p < end && *p == delim) ++p;
+    }
+    while (p < end && *p != '\n') ++p;  // tolerate ragged tails
+    if (p < end) ++p;
+    ++row;
+  }
+  return row;
+}
+
+// Parse float32 table (same framing as parse_i64_table).
+int64_t parse_f32_table(const char* buf, int64_t len, char delim,
+                        int64_t n_cols, float* out, int64_t max_rows) {
+  int64_t row = 0;
+  const char* p = buf;
+  const char* end = buf + len;
+  while (p < end && row < max_rows) {
+    for (int64_t c = 0; c < n_cols; ++c) {
+      double v = 0.0;
+      bool neg = false;
+      if (p < end && *p == '-') { neg = true; ++p; }
+      while (p < end && *p >= '0' && *p <= '9') v = v * 10.0 + (*p++ - '0');
+      if (p < end && *p == '.') {
+        ++p;
+        double scale = 0.1;
+        while (p < end && *p >= '0' && *p <= '9') {
+          v += (*p++ - '0') * scale;
+          scale *= 0.1;
+        }
+      }
+      out[row * n_cols + c] = (float)(neg ? -v : v);
+      if (p < end && *p == delim) ++p;
+    }
+    while (p < end && *p != '\n') ++p;
+    if (p < end) ++p;
+    ++row;
+  }
+  return row;
+}
+
+// Encode fired-window rows into a delimited byte sink buffer
+// (egress half; returns bytes written or -1 on overflow).
+int64_t encode_i64_rows(const int64_t* vals, int64_t n_rows, int64_t n_cols,
+                        char delim, char* out, int64_t cap) {
+  int64_t w = 0;
+  for (int64_t r = 0; r < n_rows; ++r) {
+    for (int64_t c = 0; c < n_cols; ++c) {
+      int64_t v = vals[r * n_cols + c];
+      char tmp[24];
+      int t = 0;
+      if (v < 0) { if (w >= cap) return -1; out[w++] = '-'; v = -v; }
+      do { tmp[t++] = '0' + (char)(v % 10); v /= 10; } while (v);
+      if (w + t + 1 > cap) return -1;
+      while (t) out[w++] = tmp[--t];
+      out[w++] = (c + 1 < n_cols) ? delim : '\n';
+    }
+  }
+  return w;
+}
+
+}  // extern "C"

@@ -1,0 +1,209 @@
+"""Control-plane tests: RPC, heartbeats/failure detection, restart
+strategies, supervised recovery (ref: the testing-gateway pattern,
+flink-runtime/src/test/.../utils/Testing*Gateway.java — RPC is an
+interface, so distributed logic tests in-process)."""
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu.config import Configuration
+from flink_tpu.runtime.coordinator import JobCoordinator, start_coordinator
+from flink_tpu.runtime.restart import (
+    ExponentialDelayRestartStrategy,
+    FailureRateRestartStrategy,
+    FixedDelayRestartStrategy,
+    NoRestartStrategy,
+)
+from flink_tpu.runtime.rpc import RpcClient, RpcEndpoint, RpcError, RpcServer
+
+
+class TestRpc:
+    def test_call_roundtrip_and_errors(self):
+        class Echo(RpcEndpoint):
+            def rpc_echo(self, x):
+                return {"got": x}
+
+            def rpc_boom(self):
+                raise ValueError("nope")
+
+        srv = RpcServer(Echo())
+        try:
+            c = RpcClient("127.0.0.1", srv.port)
+            assert c.call("echo", x=[1, 2]) == {"got": [1, 2]}
+            with pytest.raises(RpcError, match="nope"):
+                c.call("boom")
+            with pytest.raises(RpcError, match="no such method"):
+                c.call("missing")
+            c.close()
+        finally:
+            srv.close()
+
+    def test_single_threaded_dispatch(self):
+        """Concurrent calls serialize on the endpoint thread — the
+        main-thread discipline means no endpoint locks needed."""
+        import threading
+
+        class Count(RpcEndpoint):
+            def __init__(self):
+                self.v = 0
+
+            def rpc_bump(self):
+                cur = self.v
+                time.sleep(0.001)  # a data race would lose increments
+                self.v = cur + 1
+                return self.v
+
+        ep = Count()
+        srv = RpcServer(ep)
+        try:
+            def worker():
+                c = RpcClient("127.0.0.1", srv.port)
+                for _ in range(10):
+                    c.call("bump")
+                c.close()
+
+            ts = [threading.Thread(target=worker) for _ in range(4)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert ep.v == 40
+        finally:
+            srv.close()
+
+
+class TestCoordinator:
+    def test_register_submit_status(self):
+        srv = start_coordinator(Configuration({"heartbeat.timeout": 500}))
+        try:
+            c = RpcClient("127.0.0.1", srv.port)
+            r = c.call("register_runner", runner_id="r1", host="h1", n_devices=8)
+            assert r["heartbeat_interval_ms"] > 0
+            assert c.call("submit_job", job_id="j1")["assigned"] == ["r1"]
+            assert c.call("job_status", job_id="j1")["state"] == "RUNNING"
+            c.call("finish_job", job_id="j1")
+            assert c.call("job_status", job_id="j1")["state"] == "FINISHED"
+        finally:
+            srv.close()
+
+    def test_heartbeat_timeout_marks_runner_dead_and_restarts_job(self):
+        srv = start_coordinator(Configuration({"heartbeat.timeout": 300}))
+        try:
+            c = RpcClient("127.0.0.1", srv.port)
+            c.call("register_runner", runner_id="r1", host="h1", n_devices=8)
+            c.call("submit_job", job_id="j1")
+            assert c.call("heartbeat", runner_id="r1")["known"]
+            deadline = time.time() + 3
+            while time.time() < deadline:
+                rs = c.call("list_runners")
+                if not rs["r1"]["alive"]:
+                    break
+                time.sleep(0.05)
+            assert not c.call("list_runners")["r1"]["alive"]
+            st = c.call("job_status", job_id="j1")
+            assert st["state"] == "RESTARTING"
+        finally:
+            srv.close()
+
+    def test_report_failure_restart_then_fail(self):
+        srv = start_coordinator(Configuration({
+            "restart-strategy.type": "fixed-delay",
+            "restart-strategy.fixed-delay.attempts": 2,
+            "restart-strategy.fixed-delay.delay": 10}))
+        try:
+            c = RpcClient("127.0.0.1", srv.port)
+            c.call("register_runner", runner_id="r1", host="h", n_devices=1)
+            c.call("submit_job", job_id="j")
+            a1 = c.call("report_failure", job_id="j", error="e1")
+            assert a1["action"] == "restart" and a1["restore"] == "latest"
+            a2 = c.call("report_failure", job_id="j", error="e2")
+            assert a2["action"] == "restart"
+            a3 = c.call("report_failure", job_id="j", error="e3")
+            assert a3["action"] == "fail"
+            assert c.call("job_status", job_id="j")["state"] == "FAILED"
+        finally:
+            srv.close()
+
+
+class TestRestartStrategies:
+    def test_fixed_delay(self):
+        s = FixedDelayRestartStrategy(max_attempts=2, delay_ms=5)
+        assert s.can_restart() and s.next_delay_ms() == 5
+        assert s.can_restart() and s.next_delay_ms() == 5
+        assert not s.can_restart()
+
+    def test_exponential(self):
+        s = ExponentialDelayRestartStrategy(initial_ms=100, max_ms=400)
+        assert s.next_delay_ms() == 100
+        assert s.next_delay_ms() == 200
+        assert s.next_delay_ms() == 400
+        assert s.next_delay_ms() == 400  # capped
+
+    def test_failure_rate(self):
+        s = FailureRateRestartStrategy(max_failures=2, interval_ms=60_000,
+                                       delay_ms=1)
+        assert s.can_restart(); s.next_delay_ms()
+        assert s.can_restart(); s.next_delay_ms()
+        assert not s.can_restart()
+
+    def test_none(self):
+        assert not NoRestartStrategy().can_restart()
+
+
+class TestSupervisedRecovery:
+    def test_run_with_recovery_resumes_exactly_once(self, tmp_path):
+        from flink_tpu.api.environment import StreamExecutionEnvironment
+        from flink_tpu.api.sinks import TransactionalCollectSink
+        from flink_tpu.api.sources import GeneratorSource
+        from flink_tpu.api.windowing import TumblingEventTimeWindows
+        from flink_tpu.runtime.supervisor import run_with_recovery
+        from flink_tpu.time.watermarks import WatermarkStrategy
+
+        sink = TransactionalCollectSink()
+        crashes = {"left": 2}
+
+        def gen(split, i):
+            if i >= 8:
+                return None
+            if i == 5 and crashes["left"] > 0:
+                crashes["left"] -= 1
+                raise RuntimeError("flaky task")
+            rng = np.random.default_rng(i)
+            return ({"k": rng.integers(0, 4, 64).astype(np.int64)},
+                    np.sort(rng.integers(i * 300, i * 300 + 600, 64)).astype(np.int64))
+
+        conf = Configuration({
+            "state.num-key-shards": 8, "state.slots-per-shard": 32,
+            "pipeline.microbatch-size": 64,
+            "execution.checkpointing.dir": str(tmp_path),
+            "execution.checkpointing.interval": 1,
+            "restart-strategy.type": "fixed-delay",
+            "restart-strategy.fixed-delay.attempts": 3,
+            "restart-strategy.fixed-delay.delay": 1,
+        })
+
+        def build(c):
+            env = StreamExecutionEnvironment(c)
+            (env.from_source(GeneratorSource(gen),
+                             WatermarkStrategy.for_bounded_out_of_orderness(600))
+             .key_by("k").window(TumblingEventTimeWindows.of(1000)).count()
+             .add_sink(sink))
+            return env
+
+        res = run_with_recovery(build, conf, "supervised")
+        golden = {}
+        for i in range(8):
+            rng = np.random.default_rng(i)
+            ks = rng.integers(0, 4, 64).astype(np.int64)
+            ts = np.sort(rng.integers(i * 300, i * 300 + 600, 64)).astype(np.int64)
+            for k, t in zip(ks, ts):
+                kk = (int(k), (int(t) // 1000) * 1000)
+                golden[kk] = golden.get(kk, 0) + 1
+        got = {}
+        for r in sink.committed:
+            kk = (int(r["key"]), int(r["window_start"]))
+            assert kk not in got, f"duplicate {kk}"
+            got[kk] = int(r["count"])
+        assert got == golden
+        assert crashes["left"] == 0  # actually crashed twice
