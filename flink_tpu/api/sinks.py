@@ -27,6 +27,19 @@ class Sink:
     def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
         """Commit staged epochs <= checkpoint_id (ref: Committer.commit)."""
 
+    # -- staged-transaction persistence seam ------------------------------
+    # The reference's TwoPhaseCommitSinkFunction keeps pending transactions
+    # IN STATE and re-commits them on restore — a crash between the
+    # checkpoint write and the commit round must not lose the epoch.
+    def snapshot_staged(self) -> Optional[Any]:
+        """Staged-but-uncommitted transactions to persist in the
+        checkpoint; None = sink is not transactional."""
+        return None
+
+    def restore_staged(self, staged: Any, checkpoint_id: int) -> None:
+        """Re-commit staged epochs <= checkpoint_id (the checkpoint's
+        completion proves they must become visible); abort the rest."""
+
     def close(self) -> None:
         pass
 
@@ -90,6 +103,7 @@ class TransactionalCollectSink(Sink):
     def __post_init__(self) -> None:
         self._pending: List[Dict[str, Any]] = []
         self._staged: Dict[int, List[Dict[str, Any]]] = {}
+        self._last_committed = 0
 
     def write(self, batch: Dict[str, np.ndarray]) -> None:
         if not batch:
@@ -105,8 +119,29 @@ class TransactionalCollectSink(Sink):
     def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
         for cid in sorted([c for c in self._staged if c <= checkpoint_id]):
             self.committed.extend(self._staged.pop(cid))
+            self._last_committed = max(self._last_committed, cid)
+
+    def snapshot_staged(self) -> Any:
+        # called AFTER prepare_commit(cid) staged the current epoch, so the
+        # epoch the in-flight checkpoint covers rides inside its own payload
+        return {cid: list(rows) for cid, rows in self._staged.items()}
+
+    def restore_staged(self, staged: Any, checkpoint_id: int) -> None:
+        self._pending = []
+        self._staged = {}
+        for cid in sorted(staged):
+            if cid <= checkpoint_id:
+                # checkpoint N completing proves epoch N must be visible;
+                # re-commit idempotently (a crash may have landed anywhere
+                # between the manifest write and the commit round)
+                if cid > self._last_committed:
+                    self.committed.extend(staged[cid])
+                    self._last_committed = cid
+            # epochs staged after the restored checkpoint replay from
+            # source positions — drop them
 
     def abort_uncommitted(self) -> None:
-        """Restore path: drop staged-but-uncommitted epochs."""
+        """Fresh-start path (no checkpoint found): drop anything a prior
+        attempt staged or buffered on this reused sink instance."""
         self._staged.clear()
         self._pending = []

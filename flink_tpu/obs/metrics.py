@@ -27,6 +27,11 @@ METRICS_PORT = ConfigOption(
     "Serve /metrics (Prometheus text) on this port; 0 disables "
     "(ref: flink-metrics-prometheus reporter port).")
 
+METRICS_BIND = ConfigOption(
+    "metrics.bind-address", "127.0.0.1",
+    "Interface the /metrics endpoint binds; loopback by default (match "
+    "the control-plane RpcServer posture) — set 0.0.0.0 to expose.")
+
 
 class Counter:
     def __init__(self) -> None:
@@ -174,7 +179,8 @@ class MetricRegistry:
 class MetricsServer:
     """Minimal /metrics HTTP endpoint (pull model)."""
 
-    def __init__(self, registry: MetricRegistry, port: int) -> None:
+    def __init__(self, registry: MetricRegistry, port: int,
+                 bind: str = "127.0.0.1") -> None:
         reg = registry
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -193,7 +199,7 @@ class MetricsServer:
             def log_message(self, *a):  # silence
                 pass
 
-        self._httpd = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self._httpd = http.server.ThreadingHTTPServer((bind, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)

@@ -116,16 +116,22 @@ class JobCoordinator(RpcEndpoint):
             j = self.jobs.get(job_id)
             if j is None:
                 return {"action": "unknown-job"}
-            j.failure = error
-            strat = self._strategies[job_id]
-            if strat.can_restart():
-                delay = strat.next_delay_ms()
-                j.state = "RESTARTING"
-                j.attempts += 1
-                return {"action": "restart", "delay_ms": delay,
-                        "restore": "latest"}
-            j.state = "FAILED"
-            return {"action": "fail"}
+            return self._route_failure(j, error)
+
+    def _route_failure(self, j: JobInfo, error: str) -> dict:
+        """Single failure-routing point (lock held): consult the job's
+        restart budget, transition state, report the decision. Both
+        reported failures and runner-loss detection land here."""
+        j.failure = error
+        strat = self._strategies.get(j.job_id)
+        if strat is not None and strat.can_restart():
+            delay = strat.next_delay_ms()
+            j.state = "RESTARTING"
+            j.attempts += 1
+            return {"action": "restart", "delay_ms": delay,
+                    "restore": "latest"}
+        j.state = "FAILED"
+        return {"action": "fail"}
 
     def rpc_list_runners(self) -> dict:
         with self._lock:
@@ -142,13 +148,14 @@ class JobCoordinator(RpcEndpoint):
                 for r in self.runners.values():
                     if r.alive and now - r.last_heartbeat > self._hb_timeout:
                         r.alive = False
-                        # runner loss fails its jobs → restart path
+                        # runner loss fails its jobs through the SAME
+                        # routing as rpc_report_failure (a lost runner must
+                        # not bypass restart-strategy attempt limits)
                         for j in self.jobs.values():
                             if (j.state == "RUNNING"
                                     and r.runner_id in j.assigned_runners):
-                                j.failure = f"runner {r.runner_id} lost"
-                                j.state = "RESTARTING"
-                                j.attempts += 1
+                                self._route_failure(
+                                    j, f"runner {r.runner_id} lost")
 
     def close(self) -> None:
         self._closed = True

@@ -146,6 +146,15 @@ class Driver:
             "out_wm": dict(self._out_wm),
             "operators": {nid: op.snapshot_state()
                           for nid, op in self._ops.items()},
+            # staged-but-uncommitted 2PC sink epochs (prepare ran before
+            # this snapshot, so the in-flight epoch is included) — the
+            # TwoPhaseCommitSinkFunction pending-transaction-in-state rule
+            "sinks": {
+                nid: staged
+                for nid, n in self.plan.nodes.items()
+                if n.kind == "sink"
+                and (staged := n.sink.snapshot_staged()) is not None
+            },
             "metrics": dict(self.metrics),
         }
 
@@ -160,8 +169,16 @@ class Driver:
         for nid, snap in payload["operators"].items():
             self._ops[nid].restore_state(snap)
         self.metrics.update(payload["metrics"])
-        for n in self.plan.nodes.values():
-            if n.kind == "sink" and hasattr(n.sink, "abort_uncommitted"):
+        staged_sinks = payload.get("sinks", {})
+        cid = int(payload.get("checkpoint_id", 0))
+        for nid, n in self.plan.nodes.items():
+            if n.kind != "sink":
+                continue
+            if nid in staged_sinks:
+                # re-commit epochs the completed checkpoint covers; a crash
+                # between manifest write and commit must not lose them
+                n.sink.restore_staged(staged_sinks[nid], cid)
+            elif hasattr(n.sink, "abort_uncommitted"):
                 n.sink.abort_uncommitted()
 
     def checkpoint_now(self, savepoint: bool = False):
@@ -185,15 +202,16 @@ class Driver:
         import queue
         import threading
 
-        from flink_tpu.obs.metrics import METRICS_PORT, MetricsServer
+        from flink_tpu.obs.metrics import METRICS_BIND, METRICS_PORT, MetricsServer
 
         self._coordinator = self._setup_checkpointing(job_name)
         interval_ms = self.config.get(CheckpointingOptions.INTERVAL)
         restore = self.config.get(CheckpointingOptions.RESTORE)
         self._positions: Dict[int, Dict[int, int]] = {}
         port = self.config.get(METRICS_PORT)
+        bind = self.config.get(METRICS_BIND)
         self._metrics_server = (
-            MetricsServer(self.registry, port) if port else None)
+            MetricsServer(self.registry, port, bind) if port else None)
         self._emit_q = queue.Queue()
         drain = threading.Thread(target=self._drain_loop, daemon=True)
         drain.start()
@@ -220,6 +238,14 @@ class Driver:
                 self._coordinator.resume_numbering(payload)
             if payload is not None:
                 self._restore(payload)
+            else:
+                # restore requested but nothing to restore (crash before
+                # the first checkpoint): a sink instance reused across
+                # attempts still holds the crashed attempt's staged rows —
+                # the full replay would commit them twice
+                for n in self.plan.nodes.values():
+                    if n.kind == "sink" and hasattr(n.sink, "abort_uncommitted"):
+                        n.sink.abort_uncommitted()
 
         srcs = {}
         for sid in self.plan.sources:

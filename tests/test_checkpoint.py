@@ -208,3 +208,91 @@ class TestReshard:
         a = sorted(zip(f8["key"], f8["window_end"], f8["count"]))
         b = sorted(zip(f1["key"], f1["window_end"], f1["count"]))
         assert a == b and len(a) > 0
+
+
+class _CrashOnCommitSink(TransactionalCollectSink):
+    """Crashes between the checkpoint manifest write and the 2PC commit
+    round — the exact window the staged-epoch persistence covers."""
+
+    def __init__(self, crash_at_cid):
+        super().__init__()
+        self._crash_at = crash_at_cid
+        self._crashed = False
+
+    def notify_checkpoint_complete(self, checkpoint_id):
+        if checkpoint_id == self._crash_at and not self._crashed:
+            self._crashed = True
+            raise RuntimeError("injected crash before commit")
+        super().notify_checkpoint_complete(checkpoint_id)
+
+
+class TestTwoPhaseCommitRecovery:
+    def test_crash_between_save_and_commit_recommits_epoch(self, tmp_path):
+        """Checkpoint N is saved but the process dies before the sink
+        commit round. On restore the staged epoch persisted INSIDE
+        checkpoint N must be re-committed, not aborted — otherwise that
+        epoch's output is lost forever (sources replay only post-N).
+        ref: TwoPhaseCommitSinkFunction pending-transaction state."""
+        n_batches = 12
+        sink = _CrashOnCommitSink(crash_at_cid=3)
+
+        def build(env, source):
+            return (env.from_source(
+                        source,
+                        WatermarkStrategy.for_bounded_out_of_orderness(1000))
+                    .key_by("k")
+                    .window(TumblingEventTimeWindows.of(1000))
+                    .count()
+                    .add_sink(sink))
+
+        env = StreamExecutionEnvironment(make_conf(tmp_path))
+        build(env, GeneratorSource(failing_source(n_batches)))
+        with pytest.raises(RuntimeError, match="injected crash before commit"):
+            env.execute("cp-crash-job")
+
+        env2 = StreamExecutionEnvironment(make_conf(
+            tmp_path, {"execution.checkpointing.restore": "latest"}))
+        build(env2, GeneratorSource(failing_source(n_batches)))
+        env2.execute("cp-crash-job")
+
+        got = {}
+        for r in sink.committed:
+            kk = (int(r["key"]), int(r["window_start"]))
+            assert kk not in got, f"duplicate emission for {kk}"
+            got[kk] = int(r["count"])
+        assert got == golden_counts(n_batches)
+
+    def test_restore_with_no_checkpoint_aborts_reused_sink(self, tmp_path):
+        """Failure BEFORE the first checkpoint: restore finds nothing, yet
+        a sink instance reused across attempts must still drop the
+        crashed attempt's pending rows or the full replay duplicates
+        them."""
+        n_batches = 6
+        sink = TransactionalCollectSink()
+        conf = {"execution.checkpointing.interval": 10_000_000}  # never mid-run
+
+        def build(env, source):
+            return (env.from_source(
+                        source,
+                        WatermarkStrategy.for_bounded_out_of_orderness(1000))
+                    .key_by("k")
+                    .window(TumblingEventTimeWindows.of(1000))
+                    .count()
+                    .add_sink(sink))
+
+        env = StreamExecutionEnvironment(make_conf(tmp_path, conf))
+        build(env, GeneratorSource(failing_source(n_batches, fail_after=4)))
+        with pytest.raises(RuntimeError, match="injected failure"):
+            env.execute("early-crash-job")
+
+        conf2 = dict(conf, **{"execution.checkpointing.restore": "latest"})
+        env2 = StreamExecutionEnvironment(make_conf(tmp_path, conf2))
+        build(env2, GeneratorSource(failing_source(n_batches)))
+        env2.execute("early-crash-job")
+
+        got = {}
+        for r in sink.committed:
+            kk = (int(r["key"]), int(r["window_start"]))
+            assert kk not in got, f"duplicate emission for {kk}"
+            got[kk] = int(r["count"])
+        assert got == golden_counts(n_batches)

@@ -207,3 +207,43 @@ class TestSupervisedRecovery:
             got[kk] = int(r["count"])
         assert got == golden
         assert crashes["left"] == 0  # actually crashed twice
+
+
+class TestRunnerLossRestartBudget:
+    def test_runner_loss_respects_restart_budget(self):
+        """Heartbeat-timeout failovers must consume the same restart
+        budget as reported failures — with attempts exhausted, runner
+        loss FAILs the job instead of restarting unboundedly (ref:
+        ExecutionFailureHandler routing every failure through the
+        RestartBackoffTimeStrategy)."""
+        import time as _t
+
+        from flink_tpu.runtime.coordinator import start_coordinator
+        from flink_tpu.runtime.rpc import RpcClient
+
+        srv = start_coordinator(Configuration({
+            "heartbeat.timeout": 500,
+            "restart-strategy.type": "fixed-delay",
+            "restart-strategy.fixed-delay.attempts": 1,
+            "restart-strategy.fixed-delay.delay": 10}))
+        try:
+            c = RpcClient("127.0.0.1", srv.port)
+            c.call("register_runner", runner_id="r1", host="h1", n_devices=8)
+            c.call("submit_job", job_id="j1")
+            # burn the single allowed restart via a reported failure,
+            # heartbeating first so the monitor can't race us to it
+            c.call("heartbeat", runner_id="r1")
+            assert c.call("report_failure", job_id="j1",
+                          error="boom")["action"] == "restart"
+            # job back to RUNNING for the next attempt (under the
+            # endpoint lock — the monitor thread reads this state)
+            with srv.endpoint._lock:
+                srv.endpoint.jobs["j1"].state = "RUNNING"
+            deadline = _t.time() + 5
+            while _t.time() < deadline:
+                if c.call("job_status", job_id="j1")["state"] == "FAILED":
+                    break
+                _t.sleep(0.05)
+            assert c.call("job_status", job_id="j1")["state"] == "FAILED"
+        finally:
+            srv.close()
