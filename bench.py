@@ -61,10 +61,13 @@ def run_q5(batch_size: int, n_batches: int, *, shards: int, slots: int) -> dict:
 def main() -> None:
     batch = 1 << 17
     # warmup: same operator configs → shared compiled kernels (covers
-    # apply, steady fires, chunked catch-up fires, clear)
+    # apply, steady fires, chunked catch-up fires, clear, drain stack)
     run_q5(batch, 16, shards=128, slots=256)
 
-    n_meas = 48
+    # long enough that the fixed end-of-input flush (catch-up fires +
+    # final fetch, ~3s on a remote-attached chip) is amortized — the
+    # metric is STEADY-STATE throughput, which is what Nexmark measures
+    n_meas = 192
     start = time.perf_counter()
     metrics = run_q5(batch, n_meas, shards=128, slots=256)
     elapsed = time.perf_counter() - start
@@ -76,7 +79,14 @@ def main() -> None:
         "metric": "nexmark_q5_hot_items_end_to_end_events_per_sec",
         "value": round(eps),
         "unit": "events/sec/chip",
+        # vs an ASSUMED single-node CPU-Flink baseline (no network in
+        # this environment to measure the real one; see BASELINE.md)
         "vs_baseline": round(eps / ASSUMED_FLINK_EVENTS_PER_SEC, 3),
+        "baseline_assumed": True,
+        # fire-dispatch → sink-delivery latency of fired windows (the
+        # latency-marker analogue; BASELINE.md's p99 column)
+        "p99_latency_ms": round(metrics.get("driver.emit_latency_ms.p99", 0.0), 1),
+        "p50_latency_ms": round(metrics.get("driver.emit_latency_ms.p50", 0.0), 1),
     }))
 
 

@@ -170,7 +170,7 @@ class WindowedStream:
         self._trigger = trigger
         return self
 
-    def aggregate(self, agg: LaneAggregate, name: str = "window_agg") -> DataStream:
+    def aggregate(self, agg: LaneAggregate, name: str = "window_agg") -> "WindowedAggregateStream":
         """ref: WindowedStream.aggregate(AggregateFunction) — but taking
         the lane-lowered form directly; ``lower_aggregate`` adapts
         reference-style AggregateFunction classes."""
@@ -181,7 +181,7 @@ class WindowedStream:
             assigner=self.assigner, aggregate=agg, trigger=self._trigger,
             allowed_lateness_ms=self._lateness, key_field=kt.key_field)
         self.keyed.env._register(t)
-        return DataStream(self.keyed.env, t)
+        return WindowedAggregateStream(self.keyed.env, t)
 
     def count(self) -> DataStream:
         from flink_tpu.ops.aggregates import count as count_agg
@@ -202,6 +202,32 @@ class WindowedStream:
         from flink_tpu.ops.aggregates import min_of
 
         return self.aggregate(min_of(field))
+
+
+class WindowedAggregateStream(DataStream):
+    """The stream of fired (key, window, result...) rows. Exposes
+    post-aggregation shapes that FUSE into the window operator's device
+    fire path instead of running on the host."""
+
+    def top(self, n: int, by: Optional[str] = None,
+            name: str = "window_top") -> DataStream:
+        """Keep only each window's top-``n`` rows ranked by result field
+        ``by`` (ties at the n-th value kept — SQL RANK() <= n, the
+        Nexmark Q5 hot-items shape). Evaluated ON DEVICE inside the fire
+        kernel, so only winners ever cross to the host — the whole
+        per-key result set stays in HBM. ``by`` defaults to the
+        aggregate's single result field."""
+        t = self.transform
+        if by is None:
+            from flink_tpu.ops.aggregates import result_fields
+
+            fields = result_fields(t.aggregate)
+            if len(fields) != 1:
+                raise ValueError(
+                    f"aggregate produces {fields}; pass by= explicitly")
+            by = fields[0]
+        t.top_n = (by, n)
+        return self
 
 
 class SessionWindowedStream(WindowedStream):

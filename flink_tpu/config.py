@@ -188,6 +188,29 @@ class PipelineOptions:
         "pipeline.object-reuse", True,
         "Reuse ingest buffers between steps (always safe here: device "
         "owns data after dispatch).")
+    MAX_INFLIGHT_STEPS = ConfigOption(
+        "pipeline.max-inflight-steps", 3,
+        "Microbatch dispatches allowed in flight before ingest blocks on "
+        "the oldest — bounds the transport/device queue so emit polls "
+        "and checkpoints wait on at most this much backlog (the "
+        "credit-based flow-control analogue: SPMD backpressure = step "
+        "time; this is the credit count).")
+    SOURCE_PREFETCH = ConfigOption(
+        "pipeline.source-prefetch", 2,
+        "Batches each source split pulls ahead on a feeder thread, so "
+        "record generation/decode overlaps the loop's keying + transfer "
+        "+ dispatch work (ref: the SourceReader split-fetcher thread "
+        "model). 0 disables.")
+    EMIT_DEFER_MS = duration_option(
+        "pipeline.emit-defer", -1,
+        "How long the emit drain thread lets a fired batch age before "
+        "fetching it, so the async device→host copy issued at dispatch "
+        "completes in the background and the fetch is a local read "
+        "instead of a blocking transfer (the latency/throughput knob of "
+        "the emit path; ref role: BufferDebloater's in-flight target). "
+        "-1 = auto: 0 on CPU hosts (device→host is a memcpy), 200ms on "
+        "accelerator backends. A checkpoint barrier or end-of-input "
+        "flush overrides the deferral immediately.")
 
 
 class StateOptions:

@@ -83,6 +83,9 @@ class WindowAggregateTransformation(Transformation):
     trigger: Optional[Trigger] = None
     allowed_lateness_ms: int = 0
     key_field: str = "key"
+    # (result_field, n): fuse a per-window top-n (ties kept) into the
+    # window operator's device fire path (set via DataStream.top)
+    top_n: Optional[Tuple[str, int]] = None
 
 
 @dataclasses.dataclass(eq=False)

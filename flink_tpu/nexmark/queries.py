@@ -37,25 +37,21 @@ def q5_hot_items(
     """
     stream = env.from_source(
         bids, WatermarkStrategy.for_bounded_out_of_orderness(out_of_orderness_ms))
-    counts = (
+    top = (
         stream.key_by("auction")
         .window(SlidingEventTimeWindows.of(window_ms, slide_ms))
         .count()
+        # per-window argmax (ties kept) FUSED into the device fire path:
+        # the full per-auction count tensor never leaves HBM; only each
+        # window's hot items cross to the host
+        .top(1, by="count")
     )
 
-    def top_per_window(data, ts, valid):
-        wend = np.asarray(data["window_end"])
-        cnt = np.asarray(data["count"])
-        auction = np.asarray(data["key"])
-        uniq, inv = np.unique(wend, return_inverse=True)
-        best = np.zeros(len(uniq), cnt.dtype)
-        np.maximum.at(best, inv, cnt)
-        keep = cnt == best[inv]
-        return ({"auction": auction[keep], "window_end": wend[keep],
-                 "bid_count": cnt[keep]},
-                ts[keep], np.asarray(valid)[keep])
+    def rename(data):
+        return {"auction": data["key"], "window_end": data["window_end"],
+                "bid_count": data["count"]}
 
-    out = counts.flat_map(top_per_window, name="q5_top")
+    out = top.map(rename, name="q5_rename")
     out.add_sink(sink)
     return out
 

@@ -80,6 +80,17 @@ def _empty_lanes(b: jax.Array) -> jax.Array:
     return jnp.zeros(b.shape[:1] + (0,), dtype=jnp.float32)
 
 
+def result_fields(agg: LaneAggregate) -> Tuple[str, ...]:
+    """The result-field names an aggregate's finalize produces (probed on
+    empty lanes; mirrors WindowOperator._result_fields ordering)."""
+    res = agg.finalize(
+        np.zeros((0, agg.sum_width), np.float32),
+        np.zeros((0, agg.max_width), np.float32),
+        np.zeros((0, agg.min_width), np.float32),
+        np.zeros((0,), np.int32))
+    return tuple(sorted(res))
+
+
 def _cached(factory):
     """Memoize built-in aggregate factories so equal configurations share
     one LaneAggregate instance — and therefore one compiled kernel

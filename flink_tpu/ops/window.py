@@ -54,23 +54,24 @@ from flink_tpu.time.watermarks import LONG_MIN
 
 def apply_kernel(
     state: PaneState,
-    slot_ids: jax.Array,   # (B,) int32/int64 local slots; dump row for invalid
-    ts: jax.Array,         # (B,) int64
-    valid: jax.Array,      # (B,) bool
+    packed: jax.Array,     # (B,) int: slot * ring + ring_ix; < 0 = invalid
     data: Dict[str, jax.Array],
     *,
     agg: LaneAggregate,
-    pane_ms: int,
-    offset_ms: int,
     ring: int,
     dump_row: int,
 ) -> PaneState:
     """Fold one microbatch into pane state (the processElement hot loop,
-    batched). All shapes static; invalid rows scatter into the dump row
-    with identity lane values (doubly safe)."""
-    pane = (ts - offset_ms) // pane_ms
-    ring_ix = (pane % ring).astype(jnp.int32)
-    rows = jnp.where(valid, slot_ids, dump_row).astype(jnp.int32)
+    batched). The host pre-packs each record's (slot, pane-ring column)
+    into ONE integer — the only per-record value the scatter needs — so
+    ingest ships a single narrow array instead of (slots, timestamps,
+    validity) three-wide: host→device bytes are the transport currency
+    on remote-attached chips. Negative = invalid → scatters into the
+    dump row with identity lane values (doubly safe)."""
+    valid = packed >= 0
+    p = jnp.where(valid, packed, 0)
+    rows = jnp.where(valid, p // ring, dump_row).astype(jnp.int32)
+    ring_ix = (p % ring).astype(jnp.int32)
 
     s_l, mx_l, mn_l = agg.lift_masked(data, valid)
     new = PaneState(
@@ -117,29 +118,45 @@ def fire_kernel(
     return sums, maxs, mins, counts
 
 
+_END_SENTINEL = np.int64(-(2**62))  # pads the window axis in fire params
+
+
+def _unpack_fire_params(params: jax.Array):
+    """One packed i64 operand per fire — [pane_lo, pane_hi, anchor,
+    end_pane...] with sentinel-padded ends — instead of five separate
+    host→device transfers (each pays a transport round trip)."""
+    pane_lo = params[0]
+    pane_hi = params[1]
+    anchor = params[2]
+    end_panes = params[3:]
+    w_valid = end_panes > _END_SENTINEL // 2
+    return pane_lo, pane_hi, anchor, end_panes, w_valid
+
+
 def fire_pack_kernel(
     state: PaneState,
-    end_panes: jax.Array,   # (W,) int64
-    w_valid: jax.Array,     # (W,) bool
-    pane_lo: jax.Array,
-    pane_hi: jax.Array,
+    params: jax.Array,      # packed: see _unpack_fire_params
     used_mask: jax.Array,   # (rows,) bool — registered-key rows
     *,
     agg: LaneAggregate,
     panes_per_window: int,
     ring: int,
+    out_cap: int,
 ) -> jax.Array:
-    """fire + select + finalize entirely on device, packed into ONE
-    int32 buffer so the host pays exactly one transfer per firing
-    advance. The device→host round trip is the latency floor of the
-    emit path, and (crucially) separate result arrays do NOT pipeline
-    when the ingest thread shares the transport — so everything rides
-    one buffer: row 0 = [n, 0, ...]; rows 1..K = [slot_row, end_pane
-    delta vs pane_lo, count, f32-bitcast result lanes...] with result
-    columns in sorted-field order.
+    """fire + select + finalize + COMPACT entirely on device, packed
+    into ONE int32 buffer so the host pays exactly one transfer per
+    firing advance. The device→host transfer is the throughput ceiling
+    of the emit path (bytes × link bandwidth + per-fetch latency), so
+    the buffer holds only the fired (key, window) rows — ``out_cap`` of
+    them, a host-chosen bound ≥ registered keys × windows, which can
+    therefore never truncate — not the full slots × windows grid:
+    row 0 = [n, 0, ...]; rows 1..n = [slot_row, end_pane delta vs
+    pane_lo, count, f32-bitcast result lanes...] with result columns in
+    sorted-field order.
 
     ref role: the whole onEventTime → emitWindowContents →
     Collector.collect chain, batched."""
+    pane_lo, pane_hi, _anchor, end_panes, w_valid = _unpack_fire_params(params)
     sums, maxs, mins, counts = fire_kernel(
         state, end_panes, w_valid, pane_lo, pane_hi,
         panes_per_window=panes_per_window, ring=ring)
@@ -148,26 +165,90 @@ def fire_pack_kernel(
     nz = (counts > 0) & used_mask[:, None] & w_valid[None, :]
     flat = nz.reshape(-1)
     k = rows * W
-    idx = jnp.nonzero(flat, size=k, fill_value=k)[0]
-    row = (idx // W).astype(jnp.int32)
+    idx = jnp.nonzero(flat, size=out_cap, fill_value=k)[0]
+    row = jnp.minimum(idx // W, rows - 1).astype(jnp.int32)
     wi = (idx % W).astype(jnp.int32)
-    row_c = jnp.minimum(row, rows - 1)
-    sel_counts = counts[row_c, wi]
-    res = agg.finalize(sums[row_c, wi], maxs[row_c, wi], mins[row_c, wi], sel_counts)
+    sel_counts = jnp.where(idx < k, counts[row, wi], 0)
+    res = agg.finalize(sums[row, wi], maxs[row, wi], mins[row, wi], sel_counts)
     end_delta = (end_panes[wi] - pane_lo).astype(jnp.int32)
     cols = [row, end_delta, sel_counts.astype(jnp.int32)]
     for name in sorted(res):
-        v = res[name].reshape(k)
+        v = res[name].reshape(out_cap)
         if jnp.issubdtype(v.dtype, jnp.integer):
             # integer result lanes (counts) stay exact i32; float lanes
             # ride as f32 bitcasts (decode reads the dtype probe)
             cols.append(v.astype(jnp.int32))
         else:
             cols.append(lax.bitcast_convert_type(v.astype(jnp.float32), jnp.int32))
-    body = jnp.stack(cols, axis=1)                       # (K, C)
+    body = jnp.stack(cols, axis=1)                       # (out_cap, C)
     head = jnp.zeros((1, body.shape[1]), jnp.int32).at[0, 0].set(
         jnp.sum(flat).astype(jnp.int32))
-    return jnp.concatenate([head, body])                 # (K+1, C)
+    return jnp.concatenate([head, body])                 # (out_cap+1, C)
+
+
+def ring_append_topn_kernel(
+    state: PaneState,
+    emit_ring: jax.Array,   # (row_cap + 2, C) i32: row 0 = [total, ...],
+                            # rows 1..row_cap = data ring, last row = dump
+    params: jax.Array,      # packed: see _unpack_fire_params
+    used_mask: jax.Array,
+    *,
+    agg: LaneAggregate,
+    panes_per_window: int,
+    ring: int,
+    sel_cap: int,
+    by: str,
+    topn: int,
+) -> jax.Array:
+    """Top-n fire that APPENDS winners to a device-resident emit ring
+    instead of returning a fresh buffer. The host polls the ring — one
+    fixed-shape array whose row 0 carries a monotone total-appended
+    counter — at its own cadence, so N watermark advances cost ONE
+    device→host fetch and zero per-fire transfers. This is the emit
+    architecture for transports where a device→host read pays a large
+    fixed latency (and starves under concurrent ingest): results stay
+    in HBM until the host opens a quiet window.
+
+    Overflow (appends since last poll > row_cap) is detected host-side
+    from the counter, never silent. ref role: RecordWriter's buffer ring
+    + PipelinedSubpartition, collapsed into device memory."""
+    pane_lo, pane_hi, anchor, end_panes, w_valid = _unpack_fire_params(params)
+    sums, maxs, mins, counts = fire_kernel(
+        state, end_panes, w_valid, pane_lo, pane_hi,
+        panes_per_window=panes_per_window, ring=ring)
+    rows = counts.shape[0]
+    W = end_panes.shape[0]
+    nz = (counts > 0) & used_mask[:, None] & w_valid[None, :]
+    res = agg.finalize(sums, maxs, mins, counts)
+    v = jnp.where(nz, res[by].astype(jnp.float32), -jnp.inf)
+    k = min(topn, rows)
+    topv = lax.top_k(v.T, k)[0]
+    thresh = topv[:, k - 1]
+    sel = nz & (v >= thresh[None, :]) & jnp.isfinite(thresh)[None, :]
+    flat = sel.reshape(-1)
+    K = rows * W
+    idx = jnp.nonzero(flat, size=sel_cap, fill_value=K)[0]
+    row = jnp.minimum(idx // W, rows - 1).astype(jnp.int32)
+    wi = (idx % W).astype(jnp.int32)
+    n = jnp.minimum(jnp.sum(flat), sel_cap).astype(jnp.int32)
+    sel_counts = jnp.where(idx < K, counts[row, wi], 0)
+    res_sel = agg.finalize(sums[row, wi], maxs[row, wi], mins[row, wi], sel_counts)
+    end_delta = (end_panes[wi] - anchor).astype(jnp.int32)
+    cols = [row, end_delta, sel_counts.astype(jnp.int32)]
+    for name in sorted(res_sel):
+        u = res_sel[name].reshape(sel_cap)
+        if jnp.issubdtype(u.dtype, jnp.integer):
+            cols.append(u.astype(jnp.int32))
+        else:
+            cols.append(lax.bitcast_convert_type(u.astype(jnp.float32), jnp.int32))
+    body = jnp.stack(cols, axis=1)                         # (sel_cap, C)
+    row_cap = emit_ring.shape[0] - 2
+    total = emit_ring[0, 0]
+    ar = jnp.arange(sel_cap)
+    pos = (total + ar) % row_cap + 1
+    safe_pos = jnp.where(ar < n, pos, row_cap + 1)         # dump row
+    out = emit_ring.at[safe_pos].set(body)
+    return out.at[0, 0].add(n)
 
 
 def clear_kernel(state: PaneState, clear_mask: jax.Array) -> PaneState:
@@ -183,19 +264,39 @@ def clear_kernel(state: PaneState, clear_mask: jax.Array) -> PaneState:
     )
 
 
+# state is donated: each microbatch's update reuses the previous state's
+# HBM buffers in place instead of allocating four fresh tensors (the
+# caller always rebinds ``self.state = apply(self.state, ...)``, and
+# checkpoint snapshots copy to host eagerly, so no stale reference ever
+# reads a donated buffer)
 _JIT_APPLY = jax.jit(
     apply_kernel,
-    static_argnames=("agg", "pane_ms", "offset_ms", "ring", "dump_row"))
+    static_argnames=("agg", "ring", "dump_row"),
+    donate_argnums=(0,))
 _JIT_FIRE_PACK = jax.jit(
     fire_pack_kernel,
-    static_argnames=("agg", "panes_per_window", "ring"))
-_JIT_CLEAR = jax.jit(clear_kernel)
+    static_argnames=("agg", "panes_per_window", "ring", "out_cap"))
+# NOTE: emit_ring is NOT donated — the drain thread may be fetching the
+# previous ring array concurrently with the next append dispatch, and
+# donation would delete the buffer under that read. The append copies
+# the (small, fixed) ring on device instead.
+_JIT_RING_TOPN = jax.jit(
+    ring_append_topn_kernel,
+    static_argnames=("agg", "panes_per_window", "ring", "sel_cap", "by", "topn"))
+_JIT_CLEAR = jax.jit(clear_kernel, donate_argnums=(0,))
 
 # catch-up fires are evaluated in chunks of this many windows so they
-# reuse the steady-state compiled kernels (pow2 pads: 1,2) and keep each
-# packed buffer small — device→host bandwidth is the emit ceiling and
-# chunked buffers still fetch together in one round trip
-MAX_FIRE_CHUNK = 2
+# reuse the steady-state compiled kernels (pow2 pads: 1,2,4) and keep
+# each packed buffer bounded — device→host bandwidth is the emit ceiling
+# and chunked buffers still fetch together in one round trip
+MAX_FIRE_CHUNK = 4
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
 
 
 # ---------------------------------------------------------------------------
@@ -329,11 +430,31 @@ class WindowOperator:
         shard_range: Optional[Tuple[int, int]] = None,
         mesh_plan: Optional[MeshPlan] = None,
         exchange_capacity: Optional[int] = None,
+        top_n: Optional[Tuple[str, int]] = None,
     ) -> None:
         self.assigner = assigner
         self.agg = agg
         self.mesh_plan = mesh_plan
         self.exchange_capacity = exchange_capacity
+        # (result_field, n): fire only each window's top-n rows by that
+        # field (ties kept) — evaluated on device, shrinking the emit
+        # transfer to the winners (Q5 hot-items shape)
+        self._topn = top_n
+        # device-resident emit ring (top-n path): fires append winners in
+        # HBM; the host polls one array at its own cadence (see
+        # ring_append_topn_kernel). Lazy — shape needs result arity.
+        self._emit_ring: Optional[jax.Array] = None
+        self._ring_drained = 0
+        self._ring_anchor: Optional[int] = None
+        self.EMIT_RING_ROWS = 8192
+        # bounded in-flight dispatch (credit-based flow control
+        # analogue): ingest blocks on the oldest outstanding step once
+        # this many are in flight, keeping the transport queue shallow
+        # so emit polls/checkpoints never wait behind a deep backlog
+        self.max_inflight_steps = 3
+        import collections as _c
+
+        self._inflight = _c.deque()
         self.plan = WindowPlan.plan(
             assigner,
             allowed_lateness_ms=allowed_lateness_ms,
@@ -377,8 +498,6 @@ class WindowOperator:
         self._apply = functools.partial(
             _JIT_APPLY,
             agg=self.agg,
-            pane_ms=self.plan.pane_ms,
-            offset_ms=self.plan.offset_ms,
             ring=self.plan.ring,
             dump_row=self.layout.slots,
         )
@@ -388,7 +507,36 @@ class WindowOperator:
             panes_per_window=self.plan.panes_per_window,
             ring=self.plan.ring,
         )
+        if self._topn is not None:
+            by, n = self._topn
+            self._ring_topn = functools.partial(
+                _JIT_RING_TOPN,
+                agg=self.agg,
+                panes_per_window=self.plan.panes_per_window,
+                ring=self.plan.ring,
+                by=by,
+                topn=n,
+            )
         self._clear = _JIT_CLEAR
+
+    def _topn_cap(self, w: int) -> int:
+        """Winner-buffer capacity: n rows per window plus generous tie
+        headroom (ties beyond this raise at decode). Deliberately
+        INDEPENDENT of the chunk's window count so every top-n fire
+        buffer of this operator has one shape — the drain thread's
+        stack-and-fetch then compiles exactly once."""
+        n = self._topn[1]
+        return _next_pow2(MAX_FIRE_CHUNK * max(64, 8 * n))
+
+    def _fire_cap(self, w: int) -> int:
+        """Static compaction capacity for a W-window fire buffer: fired
+        rows per window never exceed registered keys (only used slots
+        with data fire) nor the per-block slot count, so the pow2 bucket
+        of that bound can never truncate. Buckets grow with key count →
+        a handful of retraces over a job's life."""
+        per_block = self.layout.slots
+        nk = max(1, self.directory.num_keys())
+        return _next_pow2(min(nk, per_block) * w)
 
     def _init_sharded_state(self) -> PaneState:
         mp = self.mesh_plan
@@ -420,32 +568,34 @@ class WindowOperator:
         spd = mp.slots_per_device
         n_dev = mp.n_devices
 
-        def apply_shard(state, slot, ts, valid, data):
-            cap = self.exchange_capacity or slot.shape[0]
+        ring_len = plan.ring
+
+        def apply_shard(state, packed, data):
+            # packed = global_slot * ring + ring_ix (see apply_kernel);
+            # route by owner device, then rebase to the local slot block
+            cap = self.exchange_capacity or packed.shape[0]
+            valid = packed >= 0
+            p = jnp.where(valid, packed, 0)
+            slot = p // ring_len
             dest = jnp.where(valid, slot // spd, 0).astype(jnp.int32)
-            payload = {"__slot__": slot, "__ts__": ts, **data}
+            payload = {"__sp__": packed, **data}
             recv, rvalid, overflow = keyby_exchange(
                 dest, valid, payload, n_devices=n_dev, capacity=cap)
             my = lax.axis_index(AXIS)
-            local_slot = recv["__slot__"] - my.astype(jnp.int64) * spd
+            rp = recv["__sp__"]
+            rvalid = rvalid & (rp >= 0)
+            rq = jnp.where(rvalid, rp, 0)
+            local_packed = jnp.where(
+                rvalid,
+                (rq // ring_len - my * spd) * ring_len + rq % ring_len,
+                -1)
             new_state = apply_kernel(
-                state, local_slot, recv["__ts__"], rvalid,
+                state, local_packed,
                 {k: v for k, v in recv.items() if not k.startswith("__")},
-                agg=agg, pane_ms=plan.pane_ms, offset_ms=plan.offset_ms,
-                ring=plan.ring, dump_row=layout.slots)
+                agg=agg, ring=ring_len, dump_row=layout.slots)
             return new_state, lax.psum(jnp.sum(overflow), AXIS)
 
         rows_local = layout.rows
-
-        def fire_shard(state, end_panes, w_valid, lo, hi, used_mask):
-            packed = fire_pack_kernel(
-                state, end_panes, w_valid, lo, hi, used_mask,
-                agg=agg, panes_per_window=plan.panes_per_window, ring=plan.ring)
-            # globalize row ids (each device block carries its own rows);
-            # column 0 of body rows is the slot row, head row 0 holds n
-            my = lax.axis_index(AXIS).astype(jnp.int32)
-            offset = jnp.zeros_like(packed[:, 0]).at[1:].set(my * rows_local)
-            return packed.at[:, 0].add(offset)
 
         state_spec = jax.tree_util.tree_map(lambda _: P(AXIS), self.state)
         batch_spec = P(AXIS)
@@ -454,23 +604,127 @@ class WindowOperator:
         self._apply_sharded = jax.jit(
             jax.shard_map(
                 apply_shard, mesh=mp.mesh,
-                in_specs=(state_spec, batch_spec, batch_spec, batch_spec, batch_spec),
+                in_specs=(state_spec, batch_spec, batch_spec),
                 out_specs=(state_spec, rep),
-            )
+            ),
+            donate_argnums=(0,),
         )
-        self._fire_pack = jax.jit(
-            jax.shard_map(
-                fire_shard, mesh=mp.mesh,
-                in_specs=(state_spec, rep, rep, rep, rep, P(AXIS)),
-                out_specs=P(AXIS),
-            )
-        )
+
+        # compaction capacity is a static shape → one compiled shard_map
+        # per pow2 bucket (cached; bucket grows with registered keys)
+        fire_cache: Dict[int, Any] = {}
+
+        def fire_pack_sharded(state, params, used_mask, out_cap: int):
+            fn = fire_cache.get(out_cap)
+            if fn is None:
+                def fire_shard(state, params, used_mask):
+                    packed = fire_pack_kernel(
+                        state, params, used_mask,
+                        agg=agg, panes_per_window=plan.panes_per_window,
+                        ring=plan.ring, out_cap=out_cap)
+                    # globalize row ids (each device block carries its own
+                    # rows); column 0 of body rows is the slot row, head
+                    # row 0 holds n
+                    my = lax.axis_index(AXIS).astype(jnp.int32)
+                    offset = jnp.zeros_like(packed[:, 0]).at[1:].set(
+                        my * rows_local)
+                    return packed.at[:, 0].add(offset)
+
+                fn = jax.jit(
+                    jax.shard_map(
+                        fire_shard, mesh=mp.mesh,
+                        in_specs=(state_spec, rep, P(AXIS)),
+                        out_specs=P(AXIS),
+                    )
+                )
+                fire_cache[out_cap] = fn
+            return fn(state, params, used_mask)
+
+        self._fire_pack = fire_pack_sharded
+
+        if self._topn is not None:
+            by, topn = self._topn
+            topn_cache: Dict[int, Any] = {}
+
+            def ring_topn_sharded(state, emit_ring, params, used_mask,
+                                  sel_cap: int):
+                fn = topn_cache.get(sel_cap)
+                if fn is None:
+                    def topn_shard(state, emit_ring, params, used_mask):
+                        lo, hi, anchor, end_panes, w_valid = (
+                            _unpack_fire_params(params))
+                        # Global per-window threshold: each device ranks
+                        # its local rows, the top-k candidates ride one
+                        # tiny all_gather over ICI, every device selects
+                        # its local rows against the GLOBAL n-th value
+                        # (distributed RANK() <= n), and appends winners
+                        # to ITS OWN block of the emit ring.
+                        sums, maxs, mins, counts = fire_kernel(
+                            state, end_panes, w_valid, lo, hi,
+                            panes_per_window=plan.panes_per_window,
+                            ring=plan.ring)
+                        rows = counts.shape[0]
+                        W = end_panes.shape[0]
+                        nz = ((counts > 0) & used_mask[:, None]
+                              & w_valid[None, :])
+                        res = agg.finalize(sums, maxs, mins, counts)
+                        v = jnp.where(nz, res[by].astype(jnp.float32),
+                                      -jnp.inf)
+                        k = min(topn, rows)
+                        local_top = lax.top_k(v.T, k)[0]           # (W, k)
+                        all_top = lax.all_gather(
+                            local_top, AXIS, axis=1, tiled=True)   # (W, n_dev*k)
+                        thresh = lax.top_k(all_top, k)[0][:, k - 1]
+                        sel = (nz & (v >= thresh[None, :])
+                               & jnp.isfinite(thresh)[None, :])
+                        flat = sel.reshape(-1)
+                        K = rows * W
+                        idx = jnp.nonzero(flat, size=sel_cap, fill_value=K)[0]
+                        row = jnp.minimum(idx // W, rows - 1).astype(jnp.int32)
+                        wi = (idx % W).astype(jnp.int32)
+                        n = jnp.minimum(jnp.sum(flat), sel_cap).astype(jnp.int32)
+                        sel_counts = jnp.where(idx < K, counts[row, wi], 0)
+                        res_sel = agg.finalize(
+                            sums[row, wi], maxs[row, wi], mins[row, wi],
+                            sel_counts)
+                        end_delta = (end_panes[wi] - anchor).astype(jnp.int32)
+                        my = lax.axis_index(AXIS).astype(jnp.int32)
+                        cols = [row + my * rows_local,
+                                end_delta, sel_counts.astype(jnp.int32)]
+                        for name in sorted(res_sel):
+                            u = res_sel[name].reshape(sel_cap)
+                            if jnp.issubdtype(u.dtype, jnp.integer):
+                                cols.append(u.astype(jnp.int32))
+                            else:
+                                cols.append(lax.bitcast_convert_type(
+                                    u.astype(jnp.float32), jnp.int32))
+                        body = jnp.stack(cols, axis=1)
+                        row_cap = emit_ring.shape[0] - 2
+                        total = emit_ring[0, 0]
+                        ar = jnp.arange(sel_cap)
+                        pos = (total + ar) % row_cap + 1
+                        safe_pos = jnp.where(ar < n, pos, row_cap + 1)
+                        out = emit_ring.at[safe_pos].set(body)
+                        return out.at[0, 0].add(n)
+
+                    fn = jax.jit(
+                        jax.shard_map(
+                            topn_shard, mesh=mp.mesh,
+                            in_specs=(state_spec, P(AXIS), rep, P(AXIS)),
+                            out_specs=P(AXIS),
+                        )
+                    )
+                    topn_cache[sel_cap] = fn
+                return fn(state, emit_ring, params, used_mask)
+
+            self._ring_topn = ring_topn_sharded
         self._clear = jax.jit(
             jax.shard_map(
                 clear_kernel, mesh=mp.mesh,
                 in_specs=(state_spec, rep),
                 out_specs=state_spec,
-            )
+            ),
+            donate_argnums=(0,),
         )
 
     # -- data path -------------------------------------------------------
@@ -503,14 +757,14 @@ class WindowOperator:
             if self._max_pane_seen is None or mx > self._max_pane_seen:
                 self._max_pane_seen = mx
 
-            # ring overflow guard: watermark clock must keep up with event
-            # time (at most one live pane per ring column)
+            # ring capacity guard: at most one live pane per ring column.
+            # When event time runs ahead of the watermark clock beyond
+            # plan bounds (big microbatches, stalled watermark), GROW the
+            # ring and remap live columns instead of failing — the
+            # backpressure answer is more memory, not a crash.
             live_lo = max(dead, self._min_pane_seen)
             if mx - live_lo >= self.plan.ring:
-                raise RuntimeError(
-                    f"pane ring overflow: pane {mx} vs oldest live {live_lo}, "
-                    f"ring {self.plan.ring}; watermark lagging event time beyond "
-                    "plan bounds (raise max_out_of_orderness_ms)")
+                self._grow_ring(mx - live_lo + 1)
 
         # late-but-allowed → re-fire affected, already-fired windows with
         # updated contents (ref: EventTimeTrigger.onElement fires
@@ -540,9 +794,16 @@ class WindowOperator:
             valid = valid & ~bad
         from flink_tpu.records import device_cast
         data = {k: device_cast(v) for k, v in data.items()}
+        # pack (slot, ring column) into one narrow array — the only
+        # per-record value the device scatter needs (see apply_kernel)
+        ring = self.plan.ring
+        packed = slots * ring + panes % ring
+        packed[~valid] = -1
+        dt = np.int32 if (self.layout.rows + 1) * ring < 2**31 else np.int64
+        packed = packed.astype(dt, copy=False)
         if self.mesh_plan is None:
             self.state = self._apply(
-                self.state, jnp.asarray(slots), jnp.asarray(ts), jnp.asarray(valid),
+                self.state, jnp.asarray(packed),
                 {k: jnp.asarray(v) for k, v in data.items()})
         else:
             # pad batch to a multiple of the device count (arrival split)
@@ -550,15 +811,70 @@ class WindowOperator:
             b = len(ts)
             pad = (-b) % n_dev
             if pad:
-                slots = np.concatenate([slots, np.zeros(pad, np.int64)])
-                ts = np.concatenate([ts, np.zeros(pad, np.int64)])
-                valid = np.concatenate([valid, np.zeros(pad, bool)])
+                packed = np.concatenate([packed, np.full(pad, -1, dt)])
                 data = {k: np.concatenate([v, np.zeros((pad,) + v.shape[1:], v.dtype)])
                         for k, v in data.items()}
             self.state, overflow = self._apply_sharded(
-                self.state, jnp.asarray(slots), jnp.asarray(ts), jnp.asarray(valid),
+                self.state, jnp.asarray(packed),
                 {k: jnp.asarray(v) for k, v in data.items()})
             self.exchange_overflow += int(overflow)
+        self._throttle_inflight()
+
+    def _throttle_inflight(self) -> None:
+        """Block on the oldest outstanding step once max_inflight_steps
+        are in flight (ingest backpressure; see ctor comment). The
+        marker is a tiny scalar DERIVED from the new state — the state
+        buffers themselves are donated to the next step, so holding
+        them would read deleted buffers."""
+        self._inflight.append(self.state.counts[0, 0])
+        while len(self._inflight) > self.max_inflight_steps:
+            jax.block_until_ready(self._inflight.popleft())
+
+    def _grow_ring(self, need: int) -> None:
+        """Resize the pane ring to hold ≥ ``need`` live panes and remap
+        every live column old→new (global pane p moves from column
+        p % old_ring to p % new_ring). Rare — a watermark stall or an
+        oversized microbatch — and costs one gather + a kernel rebuild
+        (recompile on next dispatch)."""
+        old_ring = self.plan.ring
+        new_ring = _next_pow2(need + 4)
+        lo = self._cleared_below
+        if self._min_pane_seen is not None:
+            lo = max(lo, self._min_pane_seen)
+        hi = self._max_pane_seen if self._max_pane_seen is not None else lo - 1
+        # column map: new column -> old column (or -1 = identity fill)
+        cmap = np.full(new_ring, -1, np.int64)
+        if hi >= lo:
+            ps = np.arange(lo, hi + 1)
+            cmap[ps % new_ring] = ps % old_ring
+
+        src = jnp.asarray(np.maximum(cmap, 0).astype(np.int32))
+        keep = jnp.asarray(cmap >= 0)
+
+        @jax.jit
+        def remap(state):
+            def cols(arr, fill):
+                g = arr[:, src]
+                m = keep[None, :, None] if g.ndim == 3 else keep[None, :]
+                return jnp.where(m, g, fill)
+
+            return PaneState(
+                sums=cols(state.sums, 0.0),
+                maxs=cols(state.maxs, -jnp.inf),
+                mins=cols(state.mins, jnp.inf),
+                counts=cols(state.counts, 0),
+            )
+
+        new_state = remap(self.state)
+        if self.mesh_plan is not None:
+            new_state = jax.device_put(new_state, self.mesh_plan.row_sharding())
+        self.state = new_state
+        self.plan = dataclasses.replace(self.plan, ring=new_ring)
+        self.layout = dataclasses.replace(self.layout, ring=new_ring)
+        if self.mesh_plan is None:
+            self._build_local_kernels()
+        else:
+            self._build_sharded_kernels()
 
     # -- time path -------------------------------------------------------
     def advance_watermark(self, wm: int) -> "FiredWindows":
@@ -641,16 +957,24 @@ class WindowOperator:
             Wp = 1
             while Wp < W:
                 Wp *= 2
-            ends_padded = chunk + [chunk[-1]] * (Wp - W)
-            end_arr = jnp.asarray(np.asarray(ends_padded, dtype=np.int64))
-            w_valid = jnp.asarray(np.arange(Wp) < W)
-            buf = self._fire_pack(
-                self.state, end_arr, w_valid, jnp.int64(lo), jnp.int64(hi),
-                used)
-            # start the device→host copy NOW (non-blocking): by the time
-            # the drain thread materializes, the data is already local
-            buf.copy_to_host_async()
-            packs.append((lo, buf))
+            if self._topn is not None and self._ring_anchor is None:
+                self._ring_anchor = lo
+            ends_padded = chunk + [int(_END_SENTINEL)] * (Wp - W)
+            params = jnp.asarray(np.asarray(
+                [lo, hi, self._ring_anchor or 0] + ends_padded, dtype=np.int64))
+            if self._topn is not None:
+                self._emit_ring = self._ring_topn(
+                    self.state, self._ensure_ring(), params, used,
+                    sel_cap=self._topn_cap(Wp))
+            else:
+                buf = self._fire_pack(
+                    self.state, params, used, out_cap=self._fire_cap(Wp))
+                # no copy_to_host_async here: the drain thread stacks the
+                # backlog and fetches it in one round trip — a second
+                # in-flight copy would only double the link traffic
+                packs.append((lo, buf))
+        if self._topn is not None:
+            return FiredWindows(op=self, ring=True)
         return FiredWindows(op=self, packs=packs)
 
     def _result_fields(self) -> List[str]:
@@ -680,12 +1004,14 @@ class WindowOperator:
         for (lo, _), buf in zip(packs, bufs):
             if self.mesh_plan is None:
                 n = int(buf[0, 0])
+                self._check_fire_cap(n, len(buf) - 1)
                 segs.append((buf[1:1 + n], lo))
             else:
                 blk = len(buf) // self.mesh_plan.n_devices
                 for d in range(self.mesh_plan.n_devices):
                     block = buf[d * blk:(d + 1) * blk]
                     n = int(block[0, 0])
+                    self._check_fire_cap(n, blk - 1)
                     segs.append((block[1:1 + n], lo))
         if segs:
             body = np.concatenate([s for s, _ in segs])
@@ -709,6 +1035,86 @@ class WindowOperator:
             col = np.ascontiguousarray(body[:, 3 + i])
             out[k] = col if self._res_is_int[k] else col.view(np.float32)
         return out
+
+    def _ensure_ring(self) -> jax.Array:
+        """Lazily allocate the device emit ring: row 0 = monotone counter
+        head, rows 1..cap = data, last row = scatter dump."""
+        if self._emit_ring is None:
+            C = 3 + len(self._result_fields())
+            shape = (self.EMIT_RING_ROWS + 2, C)
+            if self.mesh_plan is not None:
+                n_dev = self.mesh_plan.n_devices
+                self._emit_ring = jax.device_put(
+                    np.zeros((n_dev * shape[0], C), np.int32),
+                    self.mesh_plan.row_sharding())
+                self._ring_drained_blocks = [0] * n_dev
+            else:
+                self._emit_ring = jnp.zeros(shape, jnp.int32)
+        return self._emit_ring
+
+    def drain_ring(self) -> Dict[str, np.ndarray]:
+        """Fetch the emit ring ONCE and decode every row appended since
+        the previous drain (the host-side poll of the device emit
+        buffer). Overflow — more appends than the ring holds between
+        polls — is detected from the monotone counter and raises."""
+        if self._emit_ring is None or self._ring_anchor is None:
+            return dict(self._empty())
+        arr = np.asarray(self._emit_ring)        # ONE round trip
+        row_cap = self.EMIT_RING_ROWS
+        bodies = []
+        if self.mesh_plan is None:
+            blocks = [(arr, 0)]
+        else:
+            blk = len(arr) // self.mesh_plan.n_devices
+            blocks = [(arr[d * blk:(d + 1) * blk], d)
+                      for d in range(self.mesh_plan.n_devices)]
+        for block, d in blocks:
+            drained = (self._ring_drained if self.mesh_plan is None
+                       else self._ring_drained_blocks[d])
+            total = int(block[0, 0])
+            new = total - drained
+            if new > row_cap:
+                raise RuntimeError(
+                    f"emit ring overflow: {new} rows appended since last "
+                    f"drain > capacity {row_cap}; drain more often or "
+                    "raise EMIT_RING_ROWS")
+            if new > 0:
+                ix = (drained + np.arange(new)) % row_cap + 1
+                bodies.append(block[ix])
+            if self.mesh_plan is None:
+                self._ring_drained = total
+            else:
+                self._ring_drained_blocks[d] = total
+        fields = self._result_fields()
+        if bodies:
+            body = np.concatenate(bodies)
+        else:
+            body = np.zeros((0, 3 + len(fields)), np.int32)
+        rows = body[:, 0]
+        end_pane = self._ring_anchor + body[:, 1].astype(np.int64)
+        window_end = end_pane * self.plan.pane_ms + self.plan.offset_ms
+        out: Dict[str, np.ndarray] = {
+            "key": self.directory.key_of_slots(self._slot_of_rows(rows)),
+            "window_start": window_end - self.plan.size_ms,
+            "window_end": window_end,
+            "count": body[:, 2],
+        }
+        for i, k in enumerate(fields):
+            if k == "count":
+                continue
+            col = np.ascontiguousarray(body[:, 3 + i])
+            out[k] = col if self._res_is_int[k] else col.view(np.float32)
+        return out
+
+    def _check_fire_cap(self, n: int, cap: int) -> None:
+        """A packed buffer reporting more fired rows than its capacity
+        means truncation — only reachable on the top-n path when ties at
+        the n-th value exceed the 8× headroom. Fail loudly rather than
+        emit a silently-incomplete result set."""
+        if n > cap:
+            raise RuntimeError(
+                f"fired-row buffer overflow: {n} rows > capacity {cap} "
+                "(top-n tie explosion); raise n or aggregate first")
 
     def _used_mask_device(self) -> jax.Array:
         """(rows,) bool on device, marking registered-key rows; re-pushed
@@ -766,6 +1172,7 @@ class WindowOperator:
     def snapshot_state(self) -> Dict[str, Any]:
         return {
             "n_dev": self.mesh_plan.n_devices if self.mesh_plan else 1,
+            "ring": self.plan.ring,
             "panes": jax.tree_util.tree_map(np.asarray, self.state),
             "directory": self.directory.snapshot(),
             "watermark": self.watermark,
@@ -779,6 +1186,16 @@ class WindowOperator:
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
         panes = snap["panes"]
+        snap_ring = snap.get("ring", self.plan.ring)
+        if snap_ring != self.plan.ring:
+            # the snapshotted operator had auto-grown its pane ring —
+            # adopt that geometry before loading the arrays
+            self.plan = dataclasses.replace(self.plan, ring=snap_ring)
+            self.layout = dataclasses.replace(self.layout, ring=snap_ring)
+            if self.mesh_plan is None:
+                self._build_local_kernels()
+            else:
+                self._build_sharded_kernels()
         snap_dev = snap.get("n_dev", 1)
         cur_dev = self.mesh_plan.n_devices if self.mesh_plan else 1
         if snap_dev != cur_dev:
@@ -803,6 +1220,11 @@ class WindowOperator:
         self._refire = set(snap["refire"])
         self.late_records = snap["late_records"]
         self._used_pushed = -1  # directory changed: invalidate device used-mask
+        # emit ring resets: everything it held was delivered before the
+        # snapshot (checkpoint flushes emits first); replay re-fires
+        self._emit_ring = None
+        self._ring_drained = 0
+        self._ring_anchor = None
 
 
 def _reblock_panes(panes: PaneState, old_dev: int, new_dev: int) -> PaneState:
@@ -851,17 +1273,21 @@ class FiredWindows(Mapping):
     one per fire is the emit-path latency floor — batch them)."""
 
     def __init__(self, data: Optional[Dict[str, np.ndarray]] = None,
-                 fetch=None, op=None, packs=None):
+                 fetch=None, op=None, packs=None, ring: bool = False):
         self._data = data
         self._fetch = fetch
         self._op = op
         self._packs = packs
+        self._ring = ring
 
     def materialize(self) -> Dict[str, np.ndarray]:
         if self._data is None:
             if self._fetch is not None:
                 self._data = self._fetch()
                 self._fetch = None
+            elif self._ring:
+                self._data = self._op.drain_ring()
+                self._op = None
             else:
                 bufs = jax.device_get([b for _, b in self._packs])
                 self._data = self._op._decode_packs(self._packs, bufs)
@@ -870,14 +1296,60 @@ class FiredWindows(Mapping):
 
     @staticmethod
     def materialize_many(fireds: List["FiredWindows"]) -> None:
-        """Fetch every pending buffer across ``fireds`` in one
-        device_get, then decode each."""
+        """Fetch every pending buffer across ``fireds`` in as few
+        device→host round trips as possible, then decode each.
+
+        Every device_get is a separate transport round trip, and on a
+        remote-attached accelerator each one pays the full link latency
+        (measured ~0.3-0.6s under concurrent ingest traffic — it, not
+        bandwidth, was the emit-path ceiling). So same-shape buffers are
+        first STACKED on device (cheap concatenate, padded to a pow2
+        count so the stack kernel compile-caches) and the stack comes
+        back in ONE fetch per distinct shape — steady state: one round
+        trip for the entire backlog."""
+        # ring-mode entries: ONE ring poll per operator serves every
+        # pending marker of that operator (later markers read empty —
+        # the first drain already took the appended rows)
+        ring_ops = {}
+        for f in fireds:
+            if f._data is None and f._ring:
+                op = f._op
+                if id(op) not in ring_ops:
+                    ring_ops[id(op)] = op.drain_ring()
+                    f._data = ring_ops[id(op)]
+                else:
+                    f._data = op._empty().materialize()
+                f._op = None
         pending = [f for f in fireds if f._data is None and f._packs is not None]
         if not pending:
             return
-        all_bufs = jax.device_get(
-            [[b for _, b in f._packs] for f in pending])
-        for f, bufs in zip(pending, all_bufs):
+        entries: Dict[Tuple[int, ...], List[Tuple[int, int, jax.Array]]] = {}
+        for fi, f in enumerate(pending):
+            for pi, (_lo, b) in enumerate(f._packs):
+                entries.setdefault(tuple(b.shape), []).append((fi, pi, b))
+        fetched: Dict[Tuple[int, int], np.ndarray] = {}
+        STACK = 16
+        for shape, es in entries.items():
+            nbytes = int(np.prod(shape)) * 4
+            if nbytes >= 1 << 18:
+                # large buffers: transfer time is bandwidth-bound anyway,
+                # and padding a stack would up-double it — fetch each
+                for e in es:
+                    fetched[(e[0], e[1])] = np.asarray(e[2])
+                continue
+            # small buffers: stack in fixed-width groups — ONE stack
+            # shape per buffer shape, so the eager stack op compiles
+            # exactly once (compiles cost seconds on a remote backend
+            # and a variable-width stack would recompile per backlog
+            # size), and the whole group rides one round trip
+            for g0 in range(0, len(es), STACK):
+                grp = es[g0:g0 + STACK]
+                bufs = [e[2] for e in grp] + [grp[0][2]] * (STACK - len(grp))
+                arr = np.asarray(jnp.stack(bufs))
+                for i, e in enumerate(grp):
+                    fetched[(e[0], e[1])] = arr[i]
+        for fi, f in enumerate(pending):
+            bufs = [fetched[(fi, pi)] for pi in range(len(f._packs))]
             f._data = f._op._decode_packs(f._packs, bufs)
             f._packs = f._op = None
 

@@ -70,6 +70,28 @@ class Driver:
         self._stateless_cache: Dict[int, bool] = {}
         import threading
 
+        # set while a barrier (checkpoint / end-of-input) is waiting on
+        # the emit queue: overrides the drain deferral immediately
+        self._flush_req = threading.Event()
+        # Link-quiet handshake: device→host fetches starve while
+        # host→device ingest traffic flows (measured: a concurrent fetch
+        # NEVER completes under continuous h2d+dispatch on a
+        # remote-attached chip). The drain holds this lock during its
+        # fetch; the ingest loop acquires it once per batch boundary —
+        # so a pending fetch gets a quiet link within one batch, and
+        # ingest resumes the moment the fetch lands.
+        self._link_lock = threading.Lock()
+        defer = self.config.get(PipelineOptions.EMIT_DEFER_MS)
+        if defer < 0:
+            import jax
+
+            # accelerator default 1s: each emit poll pays a fixed
+            # device→host round trip (~0.15-0.5s remote), so the poll
+            # cadence IS the latency/throughput dial; the device emit
+            # ring absorbs fires between polls
+            defer = 0 if jax.default_backend() == "cpu" else 1000
+        self._emit_defer_s = defer / 1000.0
+
         # serializes downstream pushes from the ingest thread and the
         # drain thread (shared sinks + metrics are single-writer at a
         # time; the expensive materialization stays outside the lock)
@@ -82,6 +104,7 @@ class Driver:
 
         num_shards = self.config.get(StateOptions.NUM_KEY_SHARDS)
         slots = self.config.get(StateOptions.SLOTS_PER_SHARD)
+        inflight = self.config.get(PipelineOptions.MAX_INFLIGHT_STEPS)
         # pane-ring sizing must cover the worst watermark lag of ANY
         # source feeding the job (per-source strategies override the
         # plan default)
@@ -100,7 +123,9 @@ class Driver:
                     allowed_lateness_ms=t.allowed_lateness_ms,
                     max_out_of_orderness_ms=max(wm.max_out_of_orderness_ms, 0),
                     mesh_plan=self.mesh_plan,
+                    top_n=t.top_n,
                 )
+                self._ops[n.id].max_inflight_steps = inflight
             elif n.kind == "session":
                 from flink_tpu.ops.session import SessionOperator
 
@@ -248,10 +273,15 @@ class Driver:
                         n.sink.abort_uncommitted()
 
         srcs = {}
+        prefetch = self.config.get(PipelineOptions.SOURCE_PREFETCH)
         for sid in self.plan.sources:
             n = self.plan.node(sid)
             srcs[sid] = [
-                n.source.open_split(s, self._positions[sid].get(i, 0))
+                _Prefetcher(
+                    n.source.open_split(s, self._positions[sid].get(i, 0)),
+                    depth=prefetch)
+                if prefetch > 0
+                else n.source.open_split(s, self._positions[sid].get(i, 0))
                 for i, s in enumerate(n.source.splits())
             ]
 
@@ -270,6 +300,10 @@ class Driver:
                     data, ts = nxt
                     ts = np.asarray(ts, np.int64)
                     valid = np.ones(len(ts), bool)
+                    # yield the transport to a drain fetch in progress
+                    # (see _link_lock): blocks only while one is active
+                    with self._link_lock:
+                        pass
                     with self._push_lock:
                         self.metrics["records_in"] += len(ts)
                         self.metrics["batches"] += 1
@@ -436,6 +470,16 @@ class Driver:
 
         while True:
             items = [self._emit_q.get()]
+            # Deferral: the fire dispatch already issued copy_to_host_async
+            # on its buffers; letting the batch age lets that background
+            # copy finish, so the device_get below is a local read instead
+            # of a blocking round trip (decisive on remote-attached
+            # accelerators where a sync fetch costs ~100ms latency).
+            # A pending barrier (_flush_req) cancels the wait instantly.
+            if self._emit_defer_s > 0 and items[0] is not None:
+                wait = self._emit_defer_s - (time.time() - items[0][2])
+                if wait > 0:
+                    self._flush_req.wait(wait)
             # opportunistically take the whole backlog: N queued fires
             # materialize in ONE device→host round trip instead of N
             while True:
@@ -446,7 +490,8 @@ class Driver:
             stop = any(i is None for i in items)
             batch = [i for i in items if i is not None]
             try:
-                FiredWindows.materialize_many([f for _, f, _ in batch])
+                with self._link_lock:
+                    FiredWindows.materialize_many([f for _, f, _ in batch])
                 with self._push_lock:
                     for nid, fired, stamp in batch:
                         self._emit_fired_sync(nid, fired, stamp)
@@ -475,10 +520,57 @@ class Driver:
 
     def _flush_emits(self) -> None:
         """Barrier: all enqueued fires fully delivered (checkpoint
-        consistency + end-of-job ordering)."""
+        consistency + end-of-job ordering). Cancels the drain deferral
+        for anything in flight."""
         if self._emit_q is not None:
-            self._emit_q.join()
+            self._flush_req.set()
+            try:
+                self._emit_q.join()
+            finally:
+                self._flush_req.clear()
         self._check_drain_error()
+
+
+class _Prefetcher:
+    """Pulls source batches ahead on a feeder thread so record
+    generation/decode overlaps the main loop's keying + h2d + dispatch
+    work (ref: the FLIP-27 SourceReader's split-fetcher threads,
+    runtime/source — IO off the processing thread). Exceptions from the
+    source surface on the consuming side, at the batch where they
+    occurred."""
+
+    def __init__(self, it, depth: int = 2) -> None:
+        import queue
+        import threading
+
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._it = it
+        self._done = False
+        t = threading.Thread(target=self._feed, daemon=True)
+        t.start()
+
+    def _feed(self) -> None:
+        try:
+            for item in self._it:
+                self._q.put(item)
+            self._q.put(StopIteration())
+        except BaseException as e:  # surfaced on consume
+            self._q.put(e)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        item = self._q.get()
+        if isinstance(item, StopIteration):
+            self._done = True
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._done = True
+            raise item
+        return item
 
 
 _FINAL = np.iinfo(np.int64).max  # end-of-input marker watermark

@@ -351,3 +351,60 @@ class TestLateAfterIdleGap:
         fired = op.advance_watermark(50_001)
         assert {(int(k), int(e)) for k, e in zip(fired["key"], fired["window_end"])} == {(2, 46_000)}
         assert op.late_records == 0
+
+
+class TestRingAutoGrow:
+    def test_oversized_batch_grows_ring_exact_results(self):
+        """A microbatch spanning more event time than the pane ring holds
+        must grow the ring and remap live columns — not crash, not lose
+        data (the backpressure answer is memory, then correctness)."""
+        op = WindowOperator(
+            TumblingEventTimeWindows.of(1000), count(),
+            num_shards=8, slots_per_shard=16)
+        ring0 = op.plan.ring
+        # one batch covering 40 windows: far beyond the initial ring
+        keys = np.arange(200) % 5
+        ts = np.linspace(0, 40_000, 200).astype(np.int64)
+        op.process_batch(keys, ts, {})
+        assert op.plan.ring > ring0
+        fired = op.advance_watermark(50_000).materialize()
+        # golden: exact per-(key, window) counts
+        expect = collections.Counter(
+            (int(k), (int(t) // 1000) * 1000 + 1000) for k, t in zip(keys, ts))
+        got = {(int(k), int(e)): int(c) for k, e, c in
+               zip(fired["key"], fired["window_end"], fired["count"])}
+        assert got == dict(expect)
+
+    def test_grow_preserves_live_panes_mid_stream(self):
+        """Grow while earlier panes hold data: pre-grow contents must
+        survive the column remap."""
+        op = WindowOperator(
+            SlidingEventTimeWindows.of(4000, 2000), sum_of("v"),
+            num_shards=8, slots_per_shard=16)
+        op.process_batch(np.array([1, 2]), np.array([500, 1500]),
+                         {"v": np.array([10.0, 20.0], np.float32)})
+        # second batch leaps 60 windows ahead → forces growth
+        op.process_batch(np.array([1]), np.array([120_000]),
+                         {"v": np.array([7.0], np.float32)})
+        fired = op.advance_watermark(200_000).materialize()
+        rows = {(int(k), int(e)): float(s) for k, e, s in
+                zip(fired["key"], fired["window_end"], fired["sum_v"])}
+        assert rows[(1, 2000)] == 10.0 and rows[(1, 4000)] == 10.0
+        assert rows[(2, 2000)] == 20.0 and rows[(2, 4000)] == 20.0
+        assert rows[(1, 122_000)] == 7.0 and rows[(1, 124_000)] == 7.0
+
+    def test_snapshot_restore_across_grown_ring(self):
+        op = WindowOperator(
+            TumblingEventTimeWindows.of(1000), count(),
+            num_shards=8, slots_per_shard=16)
+        op.process_batch(np.arange(50) % 3,
+                         np.linspace(0, 30_000, 50).astype(np.int64), {})
+        snap = op.snapshot_state()
+        op2 = WindowOperator(
+            TumblingEventTimeWindows.of(1000), count(),
+            num_shards=8, slots_per_shard=16)
+        op2.restore_state(snap)
+        a = op.advance_watermark(40_000).materialize()
+        b = op2.advance_watermark(40_000).materialize()
+        assert sorted(zip(a["key"], a["window_end"], a["count"])) == \
+               sorted(zip(b["key"], b["window_end"], b["count"]))
