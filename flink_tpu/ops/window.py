@@ -186,6 +186,54 @@ def fire_pack_kernel(
     return jnp.concatenate([head, body])                 # (out_cap+1, C)
 
 
+def _topn_select_append(
+    emit_ring: jax.Array,
+    sums, maxs, mins, counts,
+    nz: jax.Array,          # (rows, W) candidate mask
+    v: jax.Array,           # (rows, W) ranking values (-inf non-candidates)
+    thresh: jax.Array,      # (W,) n-th value per window (may be -inf)
+    end_panes: jax.Array,
+    anchor,
+    *,
+    agg: LaneAggregate,
+    sel_cap: int,
+    row_offset,             # scalar added to row ids (device block base)
+) -> jax.Array:
+    """Shared tail of both top-n fire kernels (local + mesh): select
+    rows at/above the per-window threshold (ties kept; -inf thresh ⇒
+    all candidates), finalize lanes, append winners to the emit ring.
+    Head col 0 = monotone appended total; col 1 accumulates rows
+    TRUNCATED by sel_cap (tie explosion) — drain_ring raises on it, the
+    loud-overflow contract."""
+    rows, W = counts.shape
+    sel = nz & (v >= thresh[None, :])
+    flat = sel.reshape(-1)
+    K = rows * W
+    idx = jnp.nonzero(flat, size=sel_cap, fill_value=K)[0]
+    row = jnp.minimum(idx // W, rows - 1).astype(jnp.int32)
+    wi = (idx % W).astype(jnp.int32)
+    total_sel = jnp.sum(flat).astype(jnp.int32)
+    n = jnp.minimum(total_sel, sel_cap)
+    sel_counts = jnp.where(idx < K, counts[row, wi], 0)
+    res_sel = agg.finalize(sums[row, wi], maxs[row, wi], mins[row, wi], sel_counts)
+    end_delta = (end_panes[wi] - anchor).astype(jnp.int32)
+    cols = [row + row_offset, end_delta, sel_counts.astype(jnp.int32)]
+    for name in sorted(res_sel):
+        u = res_sel[name].reshape(sel_cap)
+        if jnp.issubdtype(u.dtype, jnp.integer):
+            cols.append(u.astype(jnp.int32))
+        else:
+            cols.append(lax.bitcast_convert_type(u.astype(jnp.float32), jnp.int32))
+    body = jnp.stack(cols, axis=1)                         # (sel_cap, C)
+    row_cap = emit_ring.shape[0] - 2
+    total = emit_ring[0, 0]
+    ar = jnp.arange(sel_cap)
+    pos = (total + ar) % row_cap + 1
+    safe_pos = jnp.where(ar < n, pos, row_cap + 1)         # dump row
+    out = emit_ring.at[safe_pos].set(body)
+    return out.at[0, 0].add(n).at[0, 1].add(total_sel - n)
+
+
 def ring_append_topn_kernel(
     state: PaneState,
     emit_ring: jax.Array,   # (row_cap + 2, C) i32: row 0 = [total, ...],
@@ -217,38 +265,19 @@ def ring_append_topn_kernel(
         state, end_panes, w_valid, pane_lo, pane_hi,
         panes_per_window=panes_per_window, ring=ring)
     rows = counts.shape[0]
-    W = end_panes.shape[0]
     nz = (counts > 0) & used_mask[:, None] & w_valid[None, :]
     res = agg.finalize(sums, maxs, mins, counts)
     v = jnp.where(nz, res[by].astype(jnp.float32), -jnp.inf)
     k = min(topn, rows)
     topv = lax.top_k(v.T, k)[0]
+    # thresh = -inf when a window has fewer than n candidates (top_k
+    # pads with -inf); nz already excludes non-candidates, so
+    # v >= -inf correctly selects ALL of that window's real rows
     thresh = topv[:, k - 1]
-    sel = nz & (v >= thresh[None, :]) & jnp.isfinite(thresh)[None, :]
-    flat = sel.reshape(-1)
-    K = rows * W
-    idx = jnp.nonzero(flat, size=sel_cap, fill_value=K)[0]
-    row = jnp.minimum(idx // W, rows - 1).astype(jnp.int32)
-    wi = (idx % W).astype(jnp.int32)
-    n = jnp.minimum(jnp.sum(flat), sel_cap).astype(jnp.int32)
-    sel_counts = jnp.where(idx < K, counts[row, wi], 0)
-    res_sel = agg.finalize(sums[row, wi], maxs[row, wi], mins[row, wi], sel_counts)
-    end_delta = (end_panes[wi] - anchor).astype(jnp.int32)
-    cols = [row, end_delta, sel_counts.astype(jnp.int32)]
-    for name in sorted(res_sel):
-        u = res_sel[name].reshape(sel_cap)
-        if jnp.issubdtype(u.dtype, jnp.integer):
-            cols.append(u.astype(jnp.int32))
-        else:
-            cols.append(lax.bitcast_convert_type(u.astype(jnp.float32), jnp.int32))
-    body = jnp.stack(cols, axis=1)                         # (sel_cap, C)
-    row_cap = emit_ring.shape[0] - 2
-    total = emit_ring[0, 0]
-    ar = jnp.arange(sel_cap)
-    pos = (total + ar) % row_cap + 1
-    safe_pos = jnp.where(ar < n, pos, row_cap + 1)         # dump row
-    out = emit_ring.at[safe_pos].set(body)
-    return out.at[0, 0].add(n)
+    return _topn_select_append(
+        emit_ring, sums, maxs, mins, counts, nz, v, thresh,
+        end_panes, anchor, agg=agg, sel_cap=sel_cap,
+        row_offset=jnp.int32(0))
 
 
 def clear_kernel(state: PaneState, clear_mask: jax.Array) -> PaneState:
@@ -482,6 +511,9 @@ class WindowOperator:
         self._max_pane_seen: Optional[int] = None
         self.late_records: int = 0
         self.exchange_overflow: int = 0
+        # records dropped because the key directory shard was FULL —
+        # always accounted, surfaced in metrics/JobResult (never silent)
+        self.records_dropped_full: int = 0
 
         if mesh_plan is None:
             self.state = init_state(self.layout)
@@ -664,7 +696,6 @@ class WindowOperator:
                             panes_per_window=plan.panes_per_window,
                             ring=plan.ring)
                         rows = counts.shape[0]
-                        W = end_panes.shape[0]
                         nz = ((counts > 0) & used_mask[:, None]
                               & w_valid[None, :])
                         res = agg.finalize(sums, maxs, mins, counts)
@@ -674,38 +705,14 @@ class WindowOperator:
                         local_top = lax.top_k(v.T, k)[0]           # (W, k)
                         all_top = lax.all_gather(
                             local_top, AXIS, axis=1, tiled=True)   # (W, n_dev*k)
+                        # -inf thresh (< n global candidates) selects all
+                        # real rows — nz masks out non-candidates
                         thresh = lax.top_k(all_top, k)[0][:, k - 1]
-                        sel = (nz & (v >= thresh[None, :])
-                               & jnp.isfinite(thresh)[None, :])
-                        flat = sel.reshape(-1)
-                        K = rows * W
-                        idx = jnp.nonzero(flat, size=sel_cap, fill_value=K)[0]
-                        row = jnp.minimum(idx // W, rows - 1).astype(jnp.int32)
-                        wi = (idx % W).astype(jnp.int32)
-                        n = jnp.minimum(jnp.sum(flat), sel_cap).astype(jnp.int32)
-                        sel_counts = jnp.where(idx < K, counts[row, wi], 0)
-                        res_sel = agg.finalize(
-                            sums[row, wi], maxs[row, wi], mins[row, wi],
-                            sel_counts)
-                        end_delta = (end_panes[wi] - anchor).astype(jnp.int32)
                         my = lax.axis_index(AXIS).astype(jnp.int32)
-                        cols = [row + my * rows_local,
-                                end_delta, sel_counts.astype(jnp.int32)]
-                        for name in sorted(res_sel):
-                            u = res_sel[name].reshape(sel_cap)
-                            if jnp.issubdtype(u.dtype, jnp.integer):
-                                cols.append(u.astype(jnp.int32))
-                            else:
-                                cols.append(lax.bitcast_convert_type(
-                                    u.astype(jnp.float32), jnp.int32))
-                        body = jnp.stack(cols, axis=1)
-                        row_cap = emit_ring.shape[0] - 2
-                        total = emit_ring[0, 0]
-                        ar = jnp.arange(sel_cap)
-                        pos = (total + ar) % row_cap + 1
-                        safe_pos = jnp.where(ar < n, pos, row_cap + 1)
-                        out = emit_ring.at[safe_pos].set(body)
-                        return out.at[0, 0].add(n)
+                        return _topn_select_append(
+                            emit_ring, sums, maxs, mins, counts, nz, v,
+                            thresh, end_panes, anchor, agg=agg,
+                            sel_cap=sel_cap, row_offset=my * rows_local)
 
                     fn = jax.jit(
                         jax.shard_map(
@@ -752,19 +759,30 @@ class WindowOperator:
         if valid.any():
             mn = int(panes[valid].min())
             mx = int(panes[valid].max())
-            if self._min_pane_seen is None or mn < self._min_pane_seen:
+            prev_min = self._min_pane_seen
+            prev_max = self._max_pane_seen
+            if prev_min is None or mn < prev_min:
                 self._min_pane_seen = mn
-            if self._max_pane_seen is None or mx > self._max_pane_seen:
+            if prev_max is None or mx > prev_max:
                 self._max_pane_seen = mx
 
             # ring capacity guard: at most one live pane per ring column.
             # When event time runs ahead of the watermark clock beyond
             # plan bounds (big microbatches, stalled watermark), GROW the
             # ring and remap live columns instead of failing — the
-            # backpressure answer is more memory, not a crash.
+            # backpressure answer is more memory, not a crash. The remap
+            # range must cover only panes ALREADY APPLIED to state
+            # (prev_min..prev_max) — this batch's panes land after the
+            # grow, and remapping their columns would alias unrelated
+            # live panes' data into them.
+            # the live span runs to the OPERATOR max (not just this
+            # batch's): a late-but-allowed record far below the live
+            # range must also trigger growth, or its column write would
+            # alias a newer live pane
             live_lo = max(dead, self._min_pane_seen)
-            if mx - live_lo >= self.plan.ring:
-                self._grow_ring(mx - live_lo + 1)
+            live_hi = self._max_pane_seen
+            if live_hi - live_lo >= self.plan.ring:
+                self._grow_ring(live_hi - live_lo + 1, prev_min, prev_max)
 
         # late-but-allowed → re-fire affected, already-fired windows with
         # updated contents (ref: EventTimeTrigger.onElement fires
@@ -787,10 +805,12 @@ class WindowOperator:
                         e -= pps
 
         slots = self.directory.assign(keys)
-        bad = slots < 0
+        bad = valid & (slots < 0)
         if bad.any():
-            # shard full or misrouted: drop with accounting (spill backend
-            # is the round-2 home for these)
+            # shard full or misrouted: drop WITH accounting — surfaced as
+            # a metric and in JobResult so full directories are loud, not
+            # silently wrong (the spill store is the no-loss home)
+            self.records_dropped_full += int(bad.sum())
             valid = valid & ~bad
         from flink_tpu.records import device_cast
         data = {k: device_cast(v) for k, v in data.items()}
@@ -799,7 +819,11 @@ class WindowOperator:
         ring = self.plan.ring
         packed = slots * ring + panes % ring
         packed[~valid] = -1
-        dt = np.int32 if (self.layout.rows + 1) * ring < 2**31 else np.int64
+        # dtype bound uses GLOBAL rows: in mesh mode slots are global
+        # (apply_shard routes by slot // spd), so the max packed value is
+        # n_devices × the local-block bound
+        n_blocks = self.mesh_plan.n_devices if self.mesh_plan else 1
+        dt = np.int32 if (n_blocks * self.layout.rows + 1) * ring < 2**31 else np.int64
         packed = packed.astype(dt, copy=False)
         if self.mesh_plan is None:
             self.state = self._apply(
@@ -830,18 +854,26 @@ class WindowOperator:
         while len(self._inflight) > self.max_inflight_steps:
             jax.block_until_ready(self._inflight.popleft())
 
-    def _grow_ring(self, need: int) -> None:
+    def _grow_ring(
+        self, need: int, applied_min: Optional[int], applied_max: Optional[int]
+    ) -> None:
         """Resize the pane ring to hold ≥ ``need`` live panes and remap
         every live column old→new (global pane p moves from column
         p % old_ring to p % new_ring). Rare — a watermark stall or an
         oversized microbatch — and costs one gather + a kernel rebuild
-        (recompile on next dispatch)."""
+        (recompile on next dispatch).
+
+        ``applied_min``/``applied_max`` bound the panes actually written
+        to state so far (the caller's pane-seen range BEFORE the batch
+        that triggered the grow) — remapping beyond them would copy
+        whatever live pane aliases those old ring columns into the new
+        columns, duplicating data into phantom windows."""
         old_ring = self.plan.ring
         new_ring = _next_pow2(need + 4)
         lo = self._cleared_below
-        if self._min_pane_seen is not None:
-            lo = max(lo, self._min_pane_seen)
-        hi = self._max_pane_seen if self._max_pane_seen is not None else lo - 1
+        if applied_min is not None:
+            lo = max(lo, applied_min)
+        hi = applied_max if applied_max is not None else lo - 1
         # column map: new column -> old column (or -1 = identity fill)
         cmap = np.full(new_ring, -1, np.int64)
         if hi >= lo:
@@ -1072,6 +1104,13 @@ class WindowOperator:
             drained = (self._ring_drained if self.mesh_plan is None
                        else self._ring_drained_blocks[d])
             total = int(block[0, 0])
+            truncated = int(block[0, 1])
+            if truncated > 0:
+                raise RuntimeError(
+                    f"top-n winner-buffer truncation: {truncated} selected "
+                    "rows exceeded the per-fire selection capacity (tie "
+                    "explosion at the n-th value); raise n or aggregate "
+                    "first")
             new = total - drained
             if new > row_cap:
                 raise RuntimeError(
@@ -1182,6 +1221,7 @@ class WindowOperator:
             "max_pane_seen": self._max_pane_seen,
             "refire": sorted(self._refire),
             "late_records": self.late_records,
+            "records_dropped_full": self.records_dropped_full,
         }
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
@@ -1219,6 +1259,7 @@ class WindowOperator:
         self._max_pane_seen = snap["max_pane_seen"]
         self._refire = set(snap["refire"])
         self.late_records = snap["late_records"]
+        self.records_dropped_full = snap.get("records_dropped_full", 0)
         self._used_pushed = -1  # directory changed: invalidate device used-mask
         # emit ring resets: everything it held was delivered before the
         # snapshot (checkpoint flushes emits first); replay re-fires

@@ -62,6 +62,14 @@ class Driver:
         g.gauge("records_in", lambda: self.metrics["records_in"])
         g.gauge("records_out", lambda: self.metrics["records_out"])
         g.gauge("fired_windows", lambda: self.metrics["fired_windows"])
+        # loss counters — directory-full drops and exchange overflow must
+        # be observable live, not just at job end
+        g.gauge("records_dropped_full", lambda: sum(
+            getattr(op, "records_dropped_full", 0)
+            for op in self._ops.values()))
+        g.gauge("exchange_overflow", lambda: sum(
+            getattr(op, "exchange_overflow", 0)
+            for op in self._ops.values()))
         self._eps_meter = g.meter("records_per_sec")
         self._lat_hist = g.histogram("emit_latency_ms")
         self._wm_lag = g.gauge("watermark_lag_ms")
@@ -85,11 +93,13 @@ class Driver:
         if defer < 0:
             import jax
 
-            # accelerator default 1s: each emit poll pays a fixed
-            # device→host round trip (~0.15-0.5s remote), so the poll
-            # cadence IS the latency/throughput dial; the device emit
-            # ring absorbs fires between polls
-            defer = 0 if jax.default_backend() == "cpu" else 1000
+            # accelerator default 200ms (matches the EMIT_DEFER_MS
+            # docstring): each emit poll pays a fixed device→host round
+            # trip, so the poll cadence trades p99 latency against link
+            # contention; the device emit ring absorbs fires between
+            # polls. 200ms keeps p99 well under a 1s slide while still
+            # amortizing ~dozens of fires per poll.
+            defer = 0 if jax.default_backend() == "cpu" else 200
         self._emit_defer_s = defer / 1000.0
 
         # serializes downstream pushes from the ingest thread and the
@@ -349,9 +359,11 @@ class Driver:
         if self._metrics_server is not None:
             self._metrics_server.close()
         for nid, op in self._ops.items():
-            if hasattr(op, "late_records"):
-                self.metrics["late_records"] = (
-                    self.metrics.get("late_records", 0) + op.late_records)
+            for counter in ("late_records", "records_dropped_full",
+                            "exchange_overflow"):
+                if hasattr(op, counter):
+                    self.metrics[counter] = (
+                        self.metrics.get(counter, 0) + getattr(op, counter))
         final = dict(self.metrics)
         final.update(self.registry.snapshot())
         return JobResult(job_name, final)

@@ -389,9 +389,32 @@ class TestRingAutoGrow:
         fired = op.advance_watermark(200_000).materialize()
         rows = {(int(k), int(e)): float(s) for k, e, s in
                 zip(fired["key"], fired["window_end"], fired["sum_v"])}
-        assert rows[(1, 2000)] == 10.0 and rows[(1, 4000)] == 10.0
-        assert rows[(2, 2000)] == 20.0 and rows[(2, 4000)] == 20.0
-        assert rows[(1, 122_000)] == 7.0 and rows[(1, 124_000)] == 7.0
+        # EXACT equality: the remap must not duplicate pre-grow panes
+        # into phantom windows beyond the applied range
+        assert rows == {
+            (1, 2000): 10.0, (1, 4000): 10.0,
+            (2, 2000): 20.0, (2, 4000): 20.0,
+            (1, 122_000): 7.0, (1, 124_000): 7.0,
+        }
+        assert len(fired["key"]) == 6
+
+    def test_grow_after_forward_leap_no_phantom_windows(self):
+        """Advisor r2 repro: 2-record batch then a forward leap; the grow
+        remap must not duplicate pre-grow sums into windows beyond the
+        applied pane range (exact full-output equality)."""
+        op = WindowOperator(
+            TumblingEventTimeWindows.of(1000), sum_of("v"),
+            num_shards=8, slots_per_shard=16)
+        op.process_batch(np.array([1, 2]), np.array([100, 900]),
+                         {"v": np.array([3.0, 4.0], np.float32)})
+        # leap far ahead in the SAME operator — forces ring growth with
+        # the new max pane way beyond anything applied to state
+        op.process_batch(np.array([1]), np.array([116_000]),
+                         {"v": np.array([5.0], np.float32)})
+        fired = op.advance_watermark(200_000).materialize()
+        rows = {(int(k), int(e)): float(s) for k, e, s in
+                zip(fired["key"], fired["window_end"], fired["sum_v"])}
+        assert rows == {(1, 1000): 3.0, (2, 1000): 4.0, (1, 117_000): 5.0}
 
     def test_snapshot_restore_across_grown_ring(self):
         op = WindowOperator(
@@ -408,3 +431,83 @@ class TestRingAutoGrow:
         b = op2.advance_watermark(40_000).materialize()
         assert sorted(zip(a["key"], a["window_end"], a["count"])) == \
                sorted(zip(b["key"], b["window_end"], b["count"]))
+
+
+class TestTopN:
+    """Device-fused per-window top-n (the Q5 hot-items shape) — ref:
+    Nexmark Q5 RANK() <= n semantics, ties at the n-th value kept."""
+
+    def _op(self, n, by="count", **kw):
+        return WindowOperator(
+            TumblingEventTimeWindows.of(1000), count(),
+            num_shards=8, slots_per_shard=64, top_n=(by, n), **kw)
+
+    def test_fewer_candidates_than_n_emits_all(self):
+        """Advisor r2 high: a window with fewer than n candidate keys
+        must emit ALL of them (top_k pads with -inf ⇒ thresh=-inf ⇒
+        every real candidate selects)."""
+        op = self._op(5)
+        op.process_batch(np.array([1, 2, 3]), np.array([100, 200, 300]), {})
+        fired = op.advance_watermark(2000).materialize()
+        got = {(int(k), int(c)) for k, c in zip(fired["key"], fired["count"])}
+        assert got == {(1, 1), (2, 1), (3, 1)}
+
+    def test_top1_picks_max_with_ties(self):
+        op = self._op(1)
+        # key 1: 3 bids, key 2: 3 bids, key 3: 1 bid → top(1) keeps ties
+        keys = np.array([1, 1, 1, 2, 2, 2, 3])
+        ts = np.full(7, 100)
+        op.process_batch(keys, ts, {})
+        fired = op.advance_watermark(2000).materialize()
+        got = {(int(k), int(c)) for k, c in zip(fired["key"], fired["count"])}
+        assert got == {(1, 3), (2, 3)}
+
+    def test_top2_across_windows(self):
+        op = self._op(2)
+        keys = np.array([1, 1, 1, 2, 2, 3,   4, 5, 5])
+        ts = np.array([0, 1, 2, 3, 4, 5,     1500, 1501, 1502])
+        op.process_batch(keys, ts, {})
+        fired = op.advance_watermark(3000).materialize()
+        got = {(int(k), int(e), int(c)) for k, e, c in
+               zip(fired["key"], fired["window_end"], fired["count"])}
+        # window 1: counts 3,2,1 → top2 = {1:3, 2:2}; window 2: 1,2 → both
+        assert got == {(1, 1000, 3), (2, 1000, 2), (4, 2000, 1), (5, 2000, 2)}
+
+    def test_tie_explosion_raises_loudly(self):
+        """More tied winners than the selection capacity must RAISE at
+        drain (advisor r2 medium: no silent truncation)."""
+        op = self._op(1)
+        cap = op._topn_cap(1)
+        nk = cap + 40
+        assert nk <= 8 * 64
+        keys = np.arange(nk)
+        ts = np.full(nk, 100)
+        op.process_batch(keys, ts, {})  # every key count=1 → all tie
+        with pytest.raises(RuntimeError, match="truncation|tie"):
+            op.advance_watermark(2000).materialize()
+
+
+class TestLateLowPaneGrowth:
+    def test_low_pane_batch_below_live_range_triggers_growth(self):
+        """A batch arriving BELOW the live range (watermark not yet
+        advanced, so not late) whose span vs the live max exceeds the
+        ring must grow it — the batch max alone understates the span,
+        and without growth the low pane's column write aliases the live
+        max pane's column."""
+        op = WindowOperator(
+            TumblingEventTimeWindows.of(1000), sum_of("v"),
+            num_shards=8, slots_per_shard=16)
+        ring0 = op.plan.ring            # 6: 1 pane + 1 + 4 headroom
+        hi_pane = ring0 + 4
+        lo_pane = 4                     # collides: hi_pane % ring0 == 4
+        assert hi_pane % ring0 == lo_pane % ring0
+        op.process_batch(np.array([1]), np.array([hi_pane * 1000 + 499]),
+                         {"v": np.array([2.0], np.float32)})
+        op.process_batch(np.array([2]), np.array([lo_pane * 1000 + 500]),
+                         {"v": np.array([9.0], np.float32)})
+        assert op.plan.ring > ring0
+        fired = op.advance_watermark(10_000_000).materialize()
+        rows = {(int(k), int(e)): float(s) for k, e, s in
+                zip(fired["key"], fired["window_end"], fired["sum_v"])}
+        assert rows == {(1, (hi_pane + 1) * 1000): 2.0,
+                        (2, (lo_pane + 1) * 1000): 9.0}
