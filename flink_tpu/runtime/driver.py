@@ -75,6 +75,12 @@ class Driver:
         self._wm_lag = g.gauge("watermark_lag_ms")
         self._emit_q = None
         self._drain_error: Optional[BaseException] = None
+        # per-run discard cell: set on abort so the run's drain thread
+        # drops (never delivers) everything it still holds. One CELL per
+        # run — an abandoned (wedged, timed-out) drain keeps its own
+        # permanently-set cell, so it can never deliver into, nor be
+        # re-armed by, a later run on the same Driver.
+        self._drain_discard = [False]
         self._stateless_cache: Dict[int, bool] = {}
         import threading
 
@@ -216,6 +222,14 @@ class Driver:
             elif hasattr(n.sink, "abort_uncommitted"):
                 n.sink.abort_uncommitted()
 
+    def _abort_sinks(self) -> None:
+        """Drop every sink's pending (never-committed) rows — the failed
+        or superseded attempt's output must not leak into a later
+        attempt that reuses the sink instances."""
+        for n in self.plan.nodes.values():
+            if n.kind == "sink" and hasattr(n.sink, "abort_uncommitted"):
+                n.sink.abort_uncommitted()
+
     def checkpoint_now(self, savepoint: bool = False):
         """Trigger one checkpoint at the current step boundary (ref:
         CheckpointCoordinator.triggerCheckpoint; savepoint=True for the
@@ -232,8 +246,6 @@ class Driver:
 
     # -- run loop --------------------------------------------------------
     def run(self, job_name: str = "job"):
-        from flink_tpu.api.environment import JobResult
-
         import queue
         import threading
 
@@ -248,9 +260,49 @@ class Driver:
         self._metrics_server = (
             MetricsServer(self.registry, port, bind) if port else None)
         self._emit_q = queue.Queue()
+        self._drain_discard = [False]  # fresh cell per run (see __init__)
         drain = threading.Thread(target=self._drain_loop, daemon=True)
         drain.start()
+        try:
+            return self._run_loop(job_name, drain, interval_ms, restore)
+        except BaseException:
+            # Failed attempt: stop the drain thread BEFORE the exception
+            # escapes, discarding everything it still holds. A daemon
+            # drain left running would deliver this attempt's fires into
+            # sinks reused by the next attempt — duplicate output after
+            # recovery (exactly-once ref: StreamTask.cleanUpInternal
+            # cancels the mailbox + output flusher before failover).
+            self._drain_discard[0] = True
+            self._flush_req.set()
+            if self._emit_q is not None:
+                self._emit_q.put(None)
+                # bounded: the drain may be wedged inside the very device
+                # fetch that killed the run — never convert a crash into
+                # a hang. An abandoned drain is a daemon and keeps its
+                # (permanently-set) discard cell: a late wakeup delivers
+                # nothing, ever.
+                drain.join(timeout=10.0)
+                self._emit_q = None
+            self._drain_error = None
+            self._flush_req.clear()
+            # rows delivered BEFORE the crash still sit in sink buffers;
+            # drop them here too — the restore path only runs when the
+            # next attempt configures restore (ref: StreamTask
+            # .cleanUpInternal aborts pending transactions in cleanup)
+            self._abort_sinks()
+            # unblock + join prefetch feeders: one blocked thread and
+            # `depth` buffered batches would leak per split per attempt
+            for its in getattr(self, "_srcs", {}).values():
+                for it in its:
+                    if isinstance(it, _Prefetcher):
+                        it.close()
+            if self._metrics_server is not None:
+                self._metrics_server.close()
+            raise
 
+    def _run_loop(self, job_name: str, drain, interval_ms: int,
+                  restore) -> "JobResult":
+        from flink_tpu.api.environment import JobResult
         for sid in self.plan.sources:
             n = self.plan.node(sid)
             strategy = n.watermark_strategy or self.plan.watermark_strategy
@@ -278,22 +330,20 @@ class Driver:
                 # the first checkpoint): a sink instance reused across
                 # attempts still holds the crashed attempt's staged rows —
                 # the full replay would commit them twice
-                for n in self.plan.nodes.values():
-                    if n.kind == "sink" and hasattr(n.sink, "abort_uncommitted"):
-                        n.sink.abort_uncommitted()
+                self._abort_sinks()
 
-        srcs = {}
+        # registered on self INCREMENTALLY so prefetchers opened before a
+        # mid-construction open_split failure are reachable from run()'s
+        # failure cleanup
+        srcs = self._srcs = {}
         prefetch = self.config.get(PipelineOptions.SOURCE_PREFETCH)
         for sid in self.plan.sources:
             n = self.plan.node(sid)
-            srcs[sid] = [
-                _Prefetcher(
-                    n.source.open_split(s, self._positions[sid].get(i, 0)),
-                    depth=prefetch)
-                if prefetch > 0
-                else n.source.open_split(s, self._positions[sid].get(i, 0))
-                for i, s in enumerate(n.source.splits())
-            ]
+            lst = srcs[sid] = []
+            for i, s in enumerate(n.source.splits()):
+                it = n.source.open_split(s, self._positions[sid].get(i, 0))
+                lst.append(_Prefetcher(it, depth=prefetch)
+                           if prefetch > 0 else it)
 
         last_chk = time.time()
         active = {sid: list(range(len(its))) for sid, its in srcs.items()}
@@ -480,8 +530,13 @@ class Driver:
 
         from flink_tpu.ops.window import FiredWindows
 
+        # local refs: an abandoned (timed-out) drain must keep operating
+        # on ITS queue and ITS discard cell even after run() nulls
+        # self._emit_q / re-arms for a successor run
+        emit_q = self._emit_q
+        discard = self._drain_discard
         while True:
-            items = [self._emit_q.get()]
+            items = [emit_q.get()]
             # Deferral: the fire dispatch already issued copy_to_host_async
             # on its buffers; letting the batch age lets that background
             # copy finish, so the device_get below is a local read instead
@@ -496,31 +551,39 @@ class Driver:
             # materialize in ONE device→host round trip instead of N
             while True:
                 try:
-                    items.append(self._emit_q.get_nowait())
+                    items.append(emit_q.get_nowait())
                 except _q.Empty:
                     break
             stop = any(i is None for i in items)
-            batch = [i for i in items if i is not None]
+            # aborted run: the attempt's output must never reach sinks —
+            # a later attempt may reuse them (exactly-once would break)
+            batch = ([] if discard[0]
+                     else [i for i in items if i is not None])
             try:
                 with self._link_lock:
                     FiredWindows.materialize_many([f for _, f, _ in batch])
                 with self._push_lock:
-                    for nid, fired, stamp in batch:
-                        self._emit_fired_sync(nid, fired, stamp)
+                    # re-check under the push lock: the run may have
+                    # aborted (and aborted the sinks) while this batch
+                    # was wedged in the device fetch above — delivering
+                    # it now would pollute a successor attempt's sinks
+                    if not discard[0]:
+                        for nid, fired, stamp in batch:
+                            self._emit_fired_sync(nid, fired, stamp)
             except BaseException as e:  # surface at the next barrier —
                 # a silently-dead drain thread would deadlock join()
                 self._drain_error = e
                 for _ in items:
-                    self._emit_q.task_done()
+                    emit_q.task_done()
                 # keep consuming so task_done accounting stays balanced
                 while True:
-                    it = self._emit_q.get()
-                    self._emit_q.task_done()
+                    it = emit_q.get()
+                    emit_q.task_done()
                     if it is None:
                         return
             else:
                 for _ in items:
-                    self._emit_q.task_done()
+                    emit_q.task_done()
             if stop:
                 return
 
@@ -558,16 +621,38 @@ class _Prefetcher:
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._it = it
         self._done = False
-        t = threading.Thread(target=self._feed, daemon=True)
-        t.start()
+        self._closed = False
+        self._thread = threading.Thread(target=self._feed, daemon=True)
+        self._thread.start()
 
     def _feed(self) -> None:
         try:
             for item in self._it:
+                if self._closed:
+                    return
                 self._q.put(item)
+                if self._closed:
+                    return
             self._q.put(StopIteration())
         except BaseException as e:  # surfaced on consume
             self._q.put(e)
+
+    def close(self) -> bool:
+        """Unblock and join the feeder (failed-run cleanup: a feeder
+        left blocked on its full queue would leak one thread + its
+        buffered batches per attempt). Returns False when the feeder is
+        still alive after a bounded wait — e.g. blocked inside the
+        source iterator itself, where only its own completion (gated on
+        ``_closed``) can end it; it stays a daemon and delivers nowhere."""
+        self._closed = True
+        self._done = True
+        while True:  # empty the queue so a blocked put() completes
+            try:
+                self._q.get_nowait()
+            except Exception:
+                break
+        self._thread.join(timeout=1.0)
+        return not self._thread.is_alive()
 
     def __iter__(self):
         return self

@@ -296,3 +296,51 @@ class TestTwoPhaseCommitRecovery:
             assert kk not in got, f"duplicate emission for {kk}"
             got[kk] = int(r["count"])
         assert got == golden_counts(n_batches)
+
+    def test_crashed_attempt_drain_never_pollutes_next_attempt(self, tmp_path):
+        """A crashing run must take its emit-drain thread down WITH it.
+        The drain holds fired-but-undelivered windows; left running (it
+        is a daemon), it would deliver them into the sink instance the
+        NEXT attempt reuses — duplicates after recovery. A large
+        emit-defer forces fires to still be queued at crash time, making
+        the race deterministic (ref: StreamTask.cleanUpInternal cancels
+        the output flusher before failover)."""
+        n_batches = 6
+        sink = TransactionalCollectSink()
+        conf = {
+            "execution.checkpointing.interval": 10_000_000,
+            "pipeline.emit-defer": "500ms",  # fires sit queued at crash
+        }
+
+        def build(env, source):
+            return (env.from_source(
+                        source,
+                        WatermarkStrategy.for_bounded_out_of_orderness(1000))
+                    .key_by("k")
+                    .window(TumblingEventTimeWindows.of(1000))
+                    .count()
+                    .add_sink(sink))
+
+        env = StreamExecutionEnvironment(make_conf(tmp_path, conf))
+        build(env, GeneratorSource(failing_source(n_batches, fail_after=4)))
+        with pytest.raises(RuntimeError, match="injected failure"):
+            env.execute("drain-leak-job")
+
+        conf2 = dict(conf, **{"execution.checkpointing.restore": "latest",
+                              "pipeline.emit-defer": "0ms"})
+        env2 = StreamExecutionEnvironment(make_conf(tmp_path, conf2))
+        build(env2, GeneratorSource(failing_source(n_batches)))
+        env2.execute("drain-leak-job")
+
+        # outlive attempt 1's deferral window: a leaked drain thread
+        # would deliver its held fires into the reused sink about now
+        import time as _time
+        _time.sleep(0.8)
+        assert sink._pending == [], (
+            "crashed attempt's drain thread delivered into the reused sink")
+        got = {}
+        for r in sink.committed:
+            kk = (int(r["key"]), int(r["window_start"]))
+            assert kk not in got, f"duplicate emission for {kk}"
+            got[kk] = int(r["count"])
+        assert got == golden_counts(n_batches)
