@@ -208,7 +208,7 @@ class PipelineOptions:
         "completes in the background and the fetch is a local read "
         "instead of a blocking transfer (the latency/throughput knob of "
         "the emit path; ref role: BufferDebloater's in-flight target). "
-        "-1 = auto: 0 on CPU hosts (device→host is a memcpy), 200ms on "
+        "-1 = auto: 0 on CPU hosts (device→host is a memcpy), 100ms on "
         "accelerator backends. A checkpoint barrier or end-of-input "
         "flush overrides the deferral immediately.")
 

@@ -50,6 +50,11 @@ class LaneAggregate:
     lift: Callable[[Arrays], Tuple[jax.Array, jax.Array, jax.Array]]
     finalize: Callable[[jax.Array, jax.Array, jax.Array, jax.Array], Arrays]
     name: str = "agg"
+    # record fields ``lift`` reads. The operator uploads ONLY these to
+    # the device — on a remote-attached chip the host→device link is the
+    # throughput ceiling, so unused lanes must never ride it (count()
+    # uploads nothing but the packed slot ids). None = unknown: keep all.
+    fields: Optional[Tuple[str, ...]] = None
 
     def lift_masked(self, data: Arrays, valid: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
         """Lift a batch, mapping invalid rows to identity elements.
@@ -113,7 +118,7 @@ def count(result_field: str = "count") -> LaneAggregate:
     def finalize(sums, maxs, mins, counts):
         return {result_field: counts}
 
-    return LaneAggregate(0, 0, 0, lift, finalize, name="count")
+    return LaneAggregate(0, 0, 0, lift, finalize, name="count", fields=())
 
 
 @_cached
@@ -128,7 +133,8 @@ def sum_of(field: str, result_field: Optional[str] = None) -> LaneAggregate:
     def finalize(sums, maxs, mins, counts):
         return {out: sums[..., 0]}
 
-    return LaneAggregate(1, 0, 0, lift, finalize, name=f"sum({field})")
+    return LaneAggregate(1, 0, 0, lift, finalize, name=f"sum({field})",
+                         fields=(field,))
 
 
 @_cached
@@ -143,7 +149,8 @@ def max_of(field: str, result_field: Optional[str] = None) -> LaneAggregate:
     def finalize(sums, maxs, mins, counts):
         return {out: maxs[..., 0]}
 
-    return LaneAggregate(0, 1, 0, lift, finalize, name=f"max({field})")
+    return LaneAggregate(0, 1, 0, lift, finalize, name=f"max({field})",
+                         fields=(field,))
 
 
 @_cached
@@ -158,7 +165,8 @@ def min_of(field: str, result_field: Optional[str] = None) -> LaneAggregate:
     def finalize(sums, maxs, mins, counts):
         return {out: mins[..., 0]}
 
-    return LaneAggregate(0, 0, 1, lift, finalize, name=f"min({field})")
+    return LaneAggregate(0, 0, 1, lift, finalize, name=f"min({field})",
+                         fields=(field,))
 
 
 @_cached
@@ -174,7 +182,8 @@ def avg_of(field: str, result_field: Optional[str] = None) -> LaneAggregate:
         c = jnp.maximum(counts, 1).astype(jnp.float32)
         return {out: sums[..., 0] / c}
 
-    return LaneAggregate(1, 0, 0, lift, finalize, name=f"avg({field})")
+    return LaneAggregate(1, 0, 0, lift, finalize, name=f"avg({field})",
+                         fields=(field,))
 
 
 @_cached
@@ -214,7 +223,15 @@ def multi(*aggs: LaneAggregate) -> LaneAggregate:
             no += a.min_width
         return out
 
-    return LaneAggregate(sw, mw, nw, lift, finalize, name="+".join(a.name for a in aggs))
+    comp_fields: Optional[Tuple[str, ...]] = ()
+    for a in aggs:
+        if a.fields is None:
+            comp_fields = None
+            break
+        comp_fields = tuple(dict.fromkeys(comp_fields + a.fields))
+    return LaneAggregate(sw, mw, nw, lift, finalize,
+                         name="+".join(a.name for a in aggs),
+                         fields=comp_fields)
 
 
 # ---------------------------------------------------------------------------
@@ -307,4 +324,5 @@ def lower_aggregate(fn: Any, probe_fields: Dict[str, Any]) -> LaneAggregate:
         return res
 
     return LaneAggregate(len(sum_ix), len(max_ix), len(min_ix), lift, finalize,
-                         name=type(fn).__name__)
+                         name=type(fn).__name__,
+                         fields=tuple(probe_fields))

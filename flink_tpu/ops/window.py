@@ -30,8 +30,10 @@ device.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
+import time
 from collections.abc import Mapping
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -314,6 +316,32 @@ _JIT_RING_TOPN = jax.jit(
     static_argnames=("agg", "panes_per_window", "ring", "sel_cap", "by", "topn"))
 _JIT_CLEAR = jax.jit(clear_kernel, donate_argnums=(0,))
 
+
+def ring_remap_kernel(state: PaneState, src: jax.Array,
+                      keep: jax.Array) -> PaneState:
+    """Move every live pane column old→new when the pane ring is
+    resized: new column j takes old column src[j] where keep[j], else
+    the identity fill. Module-level jit so a growth (rare but on the
+    latency path) compiles once per (old_ring, new_ring) shape pair per
+    process, not once per growth event."""
+
+    def cols(arr, fill):
+        g = arr[:, src]
+        m = keep[None, :, None] if g.ndim == 3 else keep[None, :]
+        return jnp.where(m, g, fill)
+
+    return PaneState(
+        sums=cols(state.sums, 0.0),
+        maxs=cols(state.maxs, -jnp.inf),
+        mins=cols(state.mins, jnp.inf),
+        counts=cols(state.counts, 0),
+    )
+
+
+# no donation: the remapped output has a different ring width than the
+# input, so XLA could never reuse the buffers anyway (it would only warn)
+_JIT_RING_REMAP = jax.jit(ring_remap_kernel)
+
 # catch-up fires are evaluated in chunks of this many windows so they
 # reuse the steady-state compiled kernels (pow2 pads: 1,2,4) and keep
 # each packed buffer bounded — device→host bandwidth is the emit ceiling
@@ -481,9 +509,10 @@ class WindowOperator:
         # this many are in flight, keeping the transport queue shallow
         # so emit polls/checkpoints never wait behind a deep backlog
         self.max_inflight_steps = 3
-        import collections as _c
-
-        self._inflight = _c.deque()
+        # True when the runtime driver applies backpressure itself by
+        # calling ``throttle()`` outside its push lock (see throttle())
+        self.external_throttle = False
+        self._inflight = collections.deque()
         self.plan = WindowPlan.plan(
             assigner,
             allowed_lateness_ms=allowed_lateness_ms,
@@ -514,6 +543,10 @@ class WindowOperator:
         # records dropped because the key directory shard was FULL —
         # always accounted, surfaced in metrics/JobResult (never silent)
         self.records_dropped_full: int = 0
+        # per-phase wall-time accumulators (seconds) — the profile the
+        # perf work is steered by (PROFILE.md); a few perf_counter calls
+        # per 100k-record batch, so always on
+        self.prof: Dict[str, float] = collections.defaultdict(float)
 
         if mesh_plan is None:
             self.state = init_state(self.layout)
@@ -746,6 +779,7 @@ class WindowOperator:
         dropped (side output; ref: WindowOperator sideOutput/
         numLateRecordsDropped) and late-within-lateness rows mark their
         windows for re-firing."""
+        t0 = time.perf_counter()
         keys = np.asarray(keys, dtype=np.int64)
         ts = np.asarray(ts, dtype=np.int64)
         valid = np.ones(len(ts), bool) if valid is None else np.asarray(valid, bool)
@@ -804,7 +838,10 @@ class WindowOperator:
                             self._refire.add(int(e))
                         e -= pps
 
+        t1 = time.perf_counter()
+        self.prof["pb_host_pre"] += t1 - t0
         slots = self.directory.assign(keys)
+        self.prof["pb_assign"] += time.perf_counter() - t1
         bad = valid & (slots < 0)
         if bad.any():
             # shard full or misrouted: drop WITH accounting — surfaced as
@@ -812,7 +849,13 @@ class WindowOperator:
             # silently wrong (the spill store is the no-loss home)
             self.records_dropped_full += int(bad.sum())
             valid = valid & ~bad
+        t2 = time.perf_counter()
         from flink_tpu.records import device_cast
+        # upload ONLY the lanes the aggregate reads: the host→device link
+        # (not the MXU) is the throughput ceiling on a remote-attached
+        # chip, and e.g. Q5's count() needs no record fields at all
+        if self.agg.fields is not None:
+            data = {k: data[k] for k in self.agg.fields}
         data = {k: device_cast(v) for k, v in data.items()}
         # pack (slot, ring column) into one narrow array — the only
         # per-record value the device scatter needs (see apply_kernel)
@@ -825,6 +868,8 @@ class WindowOperator:
         n_blocks = self.mesh_plan.n_devices if self.mesh_plan else 1
         dt = np.int32 if (n_blocks * self.layout.rows + 1) * ring < 2**31 else np.int64
         packed = packed.astype(dt, copy=False)
+        t3 = time.perf_counter()
+        self.prof["pb_pack"] += t3 - t2
         if self.mesh_plan is None:
             self.state = self._apply(
                 self.state, jnp.asarray(packed),
@@ -842,17 +887,35 @@ class WindowOperator:
                 self.state, jnp.asarray(packed),
                 {k: jnp.asarray(v) for k, v in data.items()})
             self.exchange_overflow += int(overflow)
-        self._throttle_inflight()
-
-    def _throttle_inflight(self) -> None:
-        """Block on the oldest outstanding step once max_inflight_steps
-        are in flight (ingest backpressure; see ctor comment). The
-        marker is a tiny scalar DERIVED from the new state — the state
-        buffers themselves are donated to the next step, so holding
-        them would read deleted buffers."""
+        t4 = time.perf_counter()
+        self.prof["pb_dispatch"] += t4 - t3
+        # inflight marker: a tiny scalar DERIVED from the new state — the
+        # state buffers themselves are donated to the next step, so
+        # holding them would read deleted buffers
         self._inflight.append(self.state.counts[0, 0])
+        if not self.external_throttle:
+            self.throttle()
+
+    def throttle(self) -> None:
+        """Apply ingest backpressure: block on the oldest outstanding
+        step once more than ``max_inflight_steps`` are in flight. The
+        driver sets ``external_throttle`` and calls this OUTSIDE its
+        push lock — the block is where transfer-bound pipelines spend
+        most of their time, and holding the lock through it would stall
+        the drain thread's deliveries behind it (emit latency)."""
+        t0 = time.perf_counter()
         while len(self._inflight) > self.max_inflight_steps:
             jax.block_until_ready(self._inflight.popleft())
+        self.prof["pb_throttle_wait"] += time.perf_counter() - t0
+
+    def quiesce(self) -> None:
+        """Block until every dispatched step has completed. The driver
+        calls this before the FINAL watermark advance so the flush fires
+        dispatch onto an idle device — their emit latency then measures
+        fire+fetch, not the whole tail of the ingest pipeline."""
+        while self._inflight:
+            jax.block_until_ready(self._inflight.popleft())
+        jax.block_until_ready(self.state.counts)
 
     def _grow_ring(
         self, need: int, applied_min: Optional[int], applied_max: Optional[int]
@@ -882,22 +945,7 @@ class WindowOperator:
 
         src = jnp.asarray(np.maximum(cmap, 0).astype(np.int32))
         keep = jnp.asarray(cmap >= 0)
-
-        @jax.jit
-        def remap(state):
-            def cols(arr, fill):
-                g = arr[:, src]
-                m = keep[None, :, None] if g.ndim == 3 else keep[None, :]
-                return jnp.where(m, g, fill)
-
-            return PaneState(
-                sums=cols(state.sums, 0.0),
-                maxs=cols(state.maxs, -jnp.inf),
-                mins=cols(state.mins, jnp.inf),
-                counts=cols(state.counts, 0),
-            )
-
-        new_state = remap(self.state)
+        new_state = _JIT_RING_REMAP(self.state, src, keep)
         if self.mesh_plan is not None:
             new_state = jax.device_put(new_state, self.mesh_plan.row_sharding())
         self.state = new_state
@@ -917,6 +965,7 @@ class WindowOperator:
         single device→host transfer happens on first access."""
         if wm < self.watermark or (wm == self.watermark and not self._refire):
             return self._empty()
+        taw = time.perf_counter()
         prev = self.watermark
         self.watermark = wm
 
@@ -965,6 +1014,7 @@ class WindowOperator:
                     mask[ring_positions] = True
                 self.state = self._clear(self.state, jnp.asarray(mask))
             self._cleared_below = new_dead
+        self.prof["aw_dispatch"] += time.perf_counter() - taw
         return out
 
     def _fire_ends(self, ends: List[int]) -> "FiredWindows":
@@ -1001,11 +1051,16 @@ class WindowOperator:
             else:
                 buf = self._fire_pack(
                     self.state, params, used, out_cap=self._fire_cap(Wp))
-                # no copy_to_host_async here: the drain thread stacks the
-                # backlog and fetches it in one round trip — a second
-                # in-flight copy would only double the link traffic
+                # start the device→host copy NOW: by the time the drain
+                # polls, the bytes are host-cached and np.asarray is
+                # ~0.2ms instead of a ~100ms blocking link round trip
+                # (measured on the remote-attached chip)
+                buf.copy_to_host_async()
                 packs.append((lo, buf))
         if self._topn is not None:
+            # same trick for the emit ring — the drain's poll becomes a
+            # local read of the async copy issued at fire-dispatch time
+            self._emit_ring.copy_to_host_async()
             return FiredWindows(op=self, ring=True)
         return FiredWindows(op=self, packs=packs)
 
@@ -1091,7 +1146,10 @@ class WindowOperator:
         polls — is detected from the monotone counter and raises."""
         if self._emit_ring is None or self._ring_anchor is None:
             return dict(self._empty())
+        tdr = time.perf_counter()
         arr = np.asarray(self._emit_ring)        # ONE round trip
+        self.prof["drain_fetch"] += time.perf_counter() - tdr
+        self.prof["drain_fetches"] += 1
         row_cap = self.EMIT_RING_ROWS
         bodies = []
         if self.mesh_plan is None:
@@ -1340,14 +1398,12 @@ class FiredWindows(Mapping):
         """Fetch every pending buffer across ``fireds`` in as few
         device→host round trips as possible, then decode each.
 
-        Every device_get is a separate transport round trip, and on a
-        remote-attached accelerator each one pays the full link latency
-        (measured ~0.3-0.6s under concurrent ingest traffic — it, not
-        bandwidth, was the emit-path ceiling). So same-shape buffers are
-        first STACKED on device (cheap concatenate, padded to a pow2
-        count so the stack kernel compile-caches) and the stack comes
-        back in ONE fetch per distinct shape — steady state: one round
-        trip for the entire backlog."""
+        Every fire dispatch already issued ``copy_to_host_async`` on its
+        buffers (see _fire_ends), so by drain time the bytes are
+        host-cached and each np.asarray is a local read (~0.2ms measured
+        on the remote-attached chip) instead of a blocking ~100ms link
+        round trip. A buffer whose copy has not landed yet simply blocks
+        on its own in-flight copy — never a second transfer."""
         # ring-mode entries: ONE ring poll per operator serves every
         # pending marker of that operator (later markers read empty —
         # the first drain already took the appended rows)
@@ -1361,38 +1417,11 @@ class FiredWindows(Mapping):
                 else:
                     f._data = op._empty().materialize()
                 f._op = None
-        pending = [f for f in fireds if f._data is None and f._packs is not None]
-        if not pending:
-            return
-        entries: Dict[Tuple[int, ...], List[Tuple[int, int, jax.Array]]] = {}
-        for fi, f in enumerate(pending):
-            for pi, (_lo, b) in enumerate(f._packs):
-                entries.setdefault(tuple(b.shape), []).append((fi, pi, b))
-        fetched: Dict[Tuple[int, int], np.ndarray] = {}
-        STACK = 16
-        for shape, es in entries.items():
-            nbytes = int(np.prod(shape)) * 4
-            if nbytes >= 1 << 18:
-                # large buffers: transfer time is bandwidth-bound anyway,
-                # and padding a stack would up-double it — fetch each
-                for e in es:
-                    fetched[(e[0], e[1])] = np.asarray(e[2])
-                continue
-            # small buffers: stack in fixed-width groups — ONE stack
-            # shape per buffer shape, so the eager stack op compiles
-            # exactly once (compiles cost seconds on a remote backend
-            # and a variable-width stack would recompile per backlog
-            # size), and the whole group rides one round trip
-            for g0 in range(0, len(es), STACK):
-                grp = es[g0:g0 + STACK]
-                bufs = [e[2] for e in grp] + [grp[0][2]] * (STACK - len(grp))
-                arr = np.asarray(jnp.stack(bufs))
-                for i, e in enumerate(grp):
-                    fetched[(e[0], e[1])] = arr[i]
-        for fi, f in enumerate(pending):
-            bufs = [fetched[(fi, pi)] for pi in range(len(f._packs))]
-            f._data = f._op._decode_packs(f._packs, bufs)
-            f._packs = f._op = None
+        for f in fireds:
+            if f._data is None and f._packs is not None:
+                bufs = [np.asarray(b) for _, b in f._packs]
+                f._data = f._op._decode_packs(f._packs, bufs)
+                f._packs = f._op = None
 
     def __getitem__(self, key: str) -> np.ndarray:
         return self.materialize()[key]

@@ -73,6 +73,11 @@ class Driver:
         self._eps_meter = g.meter("records_per_sec")
         self._lat_hist = g.histogram("emit_latency_ms")
         self._wm_lag = g.gauge("watermark_lag_ms")
+        # per-phase wall-time accumulators (seconds) for the ingest loop
+        # and drain thread — merged into JobResult as profile.* so perf
+        # work is steered by measurement (PROFILE.md), not vibes
+        import collections as _collections
+        self.prof: Dict[str, float] = _collections.defaultdict(float)
         self._emit_q = None
         self._drain_error: Optional[BaseException] = None
         # per-run discard cell: set on abort so the run's drain thread
@@ -99,13 +104,12 @@ class Driver:
         if defer < 0:
             import jax
 
-            # accelerator default 200ms (matches the EMIT_DEFER_MS
-            # docstring): each emit poll pays a fixed device→host round
-            # trip, so the poll cadence trades p99 latency against link
-            # contention; the device emit ring absorbs fires between
-            # polls. 200ms keeps p99 well under a 1s slide while still
-            # amortizing ~dozens of fires per poll.
-            defer = 0 if jax.default_backend() == "cpu" else 200
+            # accelerator default 100ms (matches the EMIT_DEFER_MS
+            # docstring): fire dispatch starts an async device→host copy
+            # of its buffers, so a poll is a local read — the deferral
+            # only needs to cover the async copy's flight time, and sets
+            # the emit-latency floor (p50 ≈ defer/2 + decode).
+            defer = 0 if jax.default_backend() == "cpu" else 100
         self._emit_defer_s = defer / 1000.0
 
         # serializes downstream pushes from the ingest thread and the
@@ -142,6 +146,10 @@ class Driver:
                     top_n=t.top_n,
                 )
                 self._ops[n.id].max_inflight_steps = inflight
+                # backpressure blocks happen OUTSIDE the push lock (the
+                # ingest loop calls throttle() after releasing it), so
+                # drain deliveries never queue behind a transfer wait
+                self._ops[n.id].external_throttle = True
             elif n.kind == "session":
                 from flink_tpu.ops.session import SessionOperator
 
@@ -346,6 +354,7 @@ class Driver:
                            if prefetch > 0 else it)
 
         last_chk = time.time()
+        prof = self.prof
         active = {sid: list(range(len(its))) for sid, its in srcs.items()}
         while any(active.values()):
             for sid, splits_alive in list(active.items()):
@@ -353,7 +362,10 @@ class Driver:
                     continue
                 for split_ix in list(splits_alive):
                     it = srcs[sid][split_ix]
+                    t0 = time.perf_counter()
                     nxt = next(it, None)
+                    t1 = time.perf_counter()
+                    prof["source_next"] += t1 - t0
                     if nxt is None:
                         splits_alive.remove(split_ix)
                         continue
@@ -364,10 +376,19 @@ class Driver:
                     # (see _link_lock): blocks only while one is active
                     with self._link_lock:
                         pass
+                    t2 = time.perf_counter()
+                    prof["link_lock_wait"] += t2 - t1
                     with self._push_lock:
                         self.metrics["records_in"] += len(ts)
                         self.metrics["batches"] += 1
                         self._push_downstream(sid, (dict(data), ts, valid))
+                    # backpressure wait OUTSIDE the lock: the drain
+                    # thread must be able to deliver while ingest blocks
+                    # on the device pipeline
+                    for op in self._ops.values():
+                        if hasattr(op, "throttle"):
+                            op.throttle()
+                    prof["push"] += time.perf_counter() - t2
                     self._positions[sid][split_ix] += 1
                     self._eps_meter.mark(len(ts))
                     if len(ts):
@@ -383,15 +404,23 @@ class Driver:
                     self._out_wm[sid] = min(g.current() for g in gens)
                 elif self._wm_gens[sid]:
                     self._out_wm[sid] = min(g.current() for g in self._wm_gens[sid])
+                t3 = time.perf_counter()
                 with self._push_lock:
                     self._propagate_watermarks()
+                prof["advance_wm"] += time.perf_counter() - t3
                 self._check_drain_error()
             if (self._coordinator is not None and interval_ms > 0
                     and (time.time() - last_chk) * 1000 >= interval_ms):
                 self.checkpoint_now()
                 last_chk = time.time()
 
-        # end of input: final watermark per stateful op flushes everything
+        # end of input: final watermark per stateful op flushes everything.
+        # Quiesce the device pipeline first (outside the push lock — the
+        # drain keeps delivering) so the flush fires don't queue behind
+        # in-flight ingest steps and their latency stays steady-state.
+        for op in self._ops.values():
+            if hasattr(op, "quiesce"):
+                op.quiesce()
         for sid in self.plan.sources:
             self._out_wm[sid] = _FINAL
         with self._push_lock:
@@ -416,6 +445,12 @@ class Driver:
                         self.metrics.get(counter, 0) + getattr(op, counter))
         final = dict(self.metrics)
         final.update(self.registry.snapshot())
+        for k, v in self.prof.items():
+            final[f"profile.driver.{k}"] = v
+        for nid, op in self._ops.items():
+            for k, v in getattr(op, "prof", {}).items():
+                final[f"profile.op{nid}.{k}"] = final.get(
+                    f"profile.op{nid}.{k}", 0.0) + v
         return JobResult(job_name, final)
 
     # -- data plane ------------------------------------------------------
@@ -560,8 +595,10 @@ class Driver:
             batch = ([] if discard[0]
                      else [i for i in items if i is not None])
             try:
+                tm0 = time.perf_counter()
                 with self._link_lock:
                     FiredWindows.materialize_many([f for _, f, _ in batch])
+                self.prof["drain_link_held"] += time.perf_counter() - tm0
                 with self._push_lock:
                     # re-check under the push lock: the run may have
                     # aborted (and aborted the sinks) while this batch

@@ -84,9 +84,10 @@ def init_state(layout: PaneStateLayout) -> PaneState:
 
 
 class _NumpyHashTable:
-    """Open-addressing int64→int64 map with fully vectorized batch lookup
-    (linear probing; load factor kept ≤ 0.5 by doubling). Inserts go one
-    at a time — they only happen for never-before-seen keys."""
+    """Open-addressing int64→int64 map with fully vectorized batch
+    lookup AND batch insert/update (linear probing; load factor kept
+    ≤ 0.5 by doubling) — key churn costs numpy probe rounds, never a
+    Python loop per key."""
 
     def __init__(self, capacity_hint: int = 1024) -> None:
         size = 1
@@ -118,27 +119,55 @@ class _NumpyHashTable:
         return out, found
 
     def insert(self, key: int, key_hash: int, val: int) -> None:
-        if (self._count + 1) * 2 > len(self._keys):
+        self.insert_batch(
+            np.asarray([key], np.int64),
+            np.asarray([key_hash], np.uint64),
+            np.asarray([val], np.int64))
+
+    def insert_batch(self, keys: np.ndarray, key_hashes: np.ndarray,
+                     vals: np.ndarray) -> None:
+        """Vectorized linear-probe insert for a batch of DISTINCT keys.
+        Each probe round settles every query whose bucket holds its key
+        (update) or wins an empty bucket (one writer per bucket per
+        round); the rest step forward — same round structure as lookup,
+        so key churn costs O(rounds) numpy passes, not a Python loop
+        per key."""
+        n = len(keys)
+        if n == 0:
+            return
+        while (self._count + n) * 2 > len(self._keys):
             self._grow()
+        keys = np.asarray(keys, np.int64)
+        vals = np.asarray(vals, np.int64)
         mask = len(self._keys) - 1
-        ix = key_hash & mask
-        while self._used[ix]:
-            if self._keys[ix] == key:
-                self._vals[ix] = val
-                return
-            ix = (ix + 1) & mask
-        self._keys[ix] = key
-        self._vals[ix] = val
-        self._used[ix] = True
-        self._count += 1
+        ix = (key_hashes & mask).astype(np.int64)
+        pending = np.arange(n)
+        while len(pending):
+            pix = ix[pending]
+            used = self._used[pix]
+            samekey = used & (self._keys[pix] == keys[pending])
+            upd = pending[samekey]
+            self._vals[ix[upd]] = vals[upd]
+            emp = pending[~used]
+            _, first = np.unique(ix[emp], return_index=True)
+            win = emp[first]
+            self._keys[ix[win]] = keys[win]
+            self._vals[ix[win]] = vals[win]
+            self._used[ix[win]] = True
+            self._count += len(win)
+            settled = np.zeros(n, dtype=bool)
+            settled[upd] = True
+            settled[win] = True
+            pending = pending[~settled[pending]]
+            ix[pending] = (ix[pending] + 1) & mask
 
     def _grow(self) -> None:
         old_keys, old_vals, old_used = self._keys, self._vals, self._used
         self.__init__(capacity_hint=len(old_keys))
         live = np.nonzero(old_used)[0]
-        hashes = hash_keys_numpy(old_keys[live])
-        for k, h, v in zip(old_keys[live].tolist(), hashes.tolist(), old_vals[live].tolist()):
-            self.insert(k, h, v)
+        if len(live):
+            self.insert_batch(
+                old_keys[live], hash_keys_numpy(old_keys[live]), old_vals[live])
 
 
 class KeyDirectory:
@@ -185,30 +214,44 @@ class KeyDirectory:
         slots, found = self._table.lookup(keys, hashes)
         if not found.all():
             miss_ix = np.nonzero(~found)[0]
-            # insert each distinct new key once
+            # allocate + register each distinct new key once, vectorized
+            # (key churn is per-batch steady state in rotating-key
+            # workloads like Nexmark; a Python loop here was 60ms/batch)
             uniq, first = np.unique(keys[miss_ix], return_index=True)
             uh = hashes[miss_ix][first]
-            for k, h in zip(uniq.tolist(), uh.tolist()):
-                self._insert(int(k), int(h))
+            self._table.insert_batch(uniq, uh, self._alloc_slots(uniq, uh))
             slots2, _ = self._table.lookup(keys[miss_ix], hashes[miss_ix])
             slots[miss_ix] = slots2
         return slots
 
-    def _insert(self, key: int, key_hash: int) -> int:
-        shard = int(key_hash % self.num_shards)
-        if not (self.shard_lo <= shard < self.shard_hi):
-            self._table.insert(key, key_hash, -1)
-            return -1
-        local_ix = self._next_free[shard]
-        if local_ix >= self.slots_per_shard:
-            self._table.insert(key, key_hash, self.FULL)
-            return self.FULL
-        self._next_free[shard] += 1
-        slot = (shard - self.shard_lo) * self.slots_per_shard + int(local_ix)
-        self._table.insert(key, key_hash, slot)
-        self._rev_keys[slot] = key
-        self._rev_used[slot] = True
-        return slot
+    def _alloc_slots(self, keys: np.ndarray, hashes: np.ndarray) -> np.ndarray:
+        """Assign shard-local slots to a batch of DISTINCT new keys:
+        group by shard, hand out contiguous indices from each shard's
+        free pointer, mark FULL past capacity. Pure numpy — no per-key
+        Python."""
+        shards = (hashes % self.num_shards).astype(np.int64)
+        out = np.full(len(keys), -1, dtype=np.int64)
+        inr = (shards >= self.shard_lo) & (shards < self.shard_hi)
+        if not inr.any():
+            return out
+        sub = np.nonzero(inr)[0]
+        order = np.argsort(shards[sub], kind="stable")
+        sub = sub[order]
+        sh = shards[sub]
+        # rank of each key within its equal-shard run
+        starts = np.r_[0, np.nonzero(np.diff(sh))[0] + 1]
+        run_lens = np.diff(np.r_[starts, len(sh)])
+        ranks = np.arange(len(sh)) - np.repeat(starts, run_lens)
+        local_ix = self._next_free[sh] + ranks
+        full = local_ix >= self.slots_per_shard
+        slot = (sh - self.shard_lo) * self.slots_per_shard + local_ix
+        slot[full] = self.FULL
+        np.add.at(self._next_free, sh[~full], 1)
+        ok = slot[~full]
+        self._rev_keys[ok] = keys[sub[~full]]
+        self._rev_used[ok] = True
+        out[sub] = slot
+        return out
 
     def key_of_slots(self, slots: np.ndarray) -> np.ndarray:
         return self._rev_keys[slots]
@@ -238,7 +281,6 @@ class KeyDirectory:
         d._next_free = snap["next_free"].copy()
         used = np.nonzero(d._rev_used)[0]
         keys = d._rev_keys[used]
-        hashes = hash_keys_numpy(keys)
-        for k, h, s in zip(keys.tolist(), hashes.tolist(), used.tolist()):
-            d._table.insert(int(k), int(h), int(s))
+        if len(used):
+            d._table.insert_batch(keys, hash_keys_numpy(keys), used)
         return d

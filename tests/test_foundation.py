@@ -6,6 +6,7 @@ flink-core/src/test configuration + eventtime tests).
 import numpy as np
 import pytest
 
+from flink_tpu.state.keyed import KeyDirectory
 from flink_tpu.config import (
     Configuration,
     ConfigOption,
@@ -171,3 +172,53 @@ class TestAssigners:
         assert c.on_element(5, w, 3) == TriggerResult.FIRE
         p = PurgingTrigger.of(t)
         assert p.on_event_time(999, w) == TriggerResult.FIRE_AND_PURGE
+
+
+class TestKeyDirectory:
+    """The host hash-map half of the state backend (ref role:
+    CopyOnWriteStateMap.get/put). The vectorized batch-insert path must
+    be indistinguishable from a per-key dict model, including shard-FULL
+    sentinels and reverse lookup."""
+
+    def _model_assign(self, model, next_free, keys, num_shards, sps):
+        # the directory allocates a batch's NEW keys in sorted-unique
+        # order (dedupe via np.unique); the model must match that, not
+        # arrival order — slot identity is deterministic either way
+        for k in sorted(set(keys.tolist()) - set(model)):
+            shard = int(hash_keys_numpy(np.asarray([k], np.int64))[0] % num_shards)
+            if next_free[shard] >= sps:
+                model[k] = KeyDirectory.FULL
+            else:
+                model[k] = shard * sps + next_free[shard]
+                next_free[shard] += 1
+        return np.asarray([model[k] for k in keys.tolist()], np.int64)
+
+    def test_batch_insert_matches_dict_model(self):
+        rng = np.random.default_rng(7)
+        num_shards, sps = 4, 8
+        d = KeyDirectory(num_shards, sps)
+        model, next_free = {}, {s: 0 for s in range(num_shards)}
+        for _ in range(30):
+            # heavy churn + duplicates within a batch + eventual overflow
+            keys = rng.integers(0, 120, size=rng.integers(1, 64)).astype(np.int64)
+            got = d.assign(keys)
+            want = self._model_assign(model, next_free, keys, num_shards, sps)
+            np.testing.assert_array_equal(got, want)
+        # reverse map agrees for every registered key
+        live = {k: v for k, v in model.items() if v >= 0}
+        slots = np.asarray(sorted(live.values()), np.int64)
+        inv = {v: k for k, v in live.items()}
+        np.testing.assert_array_equal(
+            d.key_of_slots(slots), np.asarray([inv[int(s)] for s in slots]))
+        assert d.num_keys() == len(live)
+
+    def test_snapshot_restore_round_trip(self):
+        rng = np.random.default_rng(3)
+        d = KeyDirectory(8, 16)
+        keys = rng.integers(0, 1000, size=500).astype(np.int64)
+        before = d.assign(keys)
+        d2 = KeyDirectory.restore(8, 16, d.snapshot())
+        np.testing.assert_array_equal(d2.assign(keys), before)
+        # new keys keep allocating from the restored free pointers
+        more = np.arange(2000, 2050, dtype=np.int64)
+        np.testing.assert_array_equal(d.assign(more), d2.assign(more))
