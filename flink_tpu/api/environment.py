@@ -67,13 +67,46 @@ class StreamExecutionEnvironment:
     # -- execution -------------------------------------------------------
     def execute(self, job_name: str = "job") -> "JobResult":
         """Lower and run to completion (bounded) or until cancelled
-        (ref: execute → LocalExecutor → MiniCluster.submitJob)."""
+        (ref: execute → LocalExecutor → MiniCluster.submitJob). With
+        ``cluster.mesh-devices`` set, keyed state is sharded over the
+        device mesh and the driver runs the distributed step."""
         from flink_tpu.graph.compiler import compile_job
         from flink_tpu.runtime.driver import Driver
 
         plan = compile_job(self._transforms, self.config, self._watermark_strategy)
-        driver = Driver(plan, self.config)
+        driver = Driver(plan, self.config, mesh_plan=self.build_mesh_plan())
         return driver.run(job_name)
+
+    def build_mesh_plan(self):
+        """MeshPlan from ``cluster.mesh-devices`` (None = local
+        single-device execution — the default)."""
+        from flink_tpu.config import ClusterOptions, StateOptions
+
+        spec = str(self.config.get(ClusterOptions.MESH_DEVICES)).strip()
+        if not spec:
+            return None
+        import jax
+
+        from flink_tpu.parallel.mesh import make_mesh_plan
+
+        devices = jax.devices()
+        if spec != "all":
+            n = int(spec)
+            if n < 1:
+                raise ValueError(
+                    f"cluster.mesh-devices must be 'all' or a positive "
+                    f"integer, got {spec!r}")
+            if n > len(devices):
+                raise ValueError(
+                    f"cluster.mesh-devices={n} but only {len(devices)} "
+                    "devices are visible")
+            devices = devices[:n]
+        if len(devices) == 1:
+            return None  # a 1-device mesh is just local execution
+        return make_mesh_plan(
+            self.config.get(StateOptions.NUM_KEY_SHARDS),
+            self.config.get(StateOptions.SLOTS_PER_SHARD),
+            devices)
 
     def compile_plan(self):
         """Lowered execution plan without running (inspection/tests —

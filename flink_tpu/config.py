@@ -253,6 +253,15 @@ class CheckpointingOptions:
 
 
 class ClusterOptions:
+    MESH_DEVICES = ConfigOption(
+        "cluster.mesh-devices", "",
+        "Operator parallelism over a 1-D jax.sharding.Mesh: '' = "
+        "single-device local execution, 'all' = every visible device, "
+        "an integer N = the first N devices. Each device owns "
+        "num-key-shards/N contiguous key shards (the key-group range of "
+        "its 'subtask'); keyed exchanges ride XLA all_to_all over the "
+        "mesh axis (ref: parallelism.default + slot assignment, "
+        "KeyGroupRangeAssignment).")
     HEARTBEAT_INTERVAL = duration_option(
         "heartbeat.interval", 10_000,
         "Runner→coordinator heartbeat period (ref: heartbeat.interval=10s).")

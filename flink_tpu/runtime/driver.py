@@ -15,6 +15,7 @@ exactly-once cheap here.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
@@ -76,8 +77,7 @@ class Driver:
         # per-phase wall-time accumulators (seconds) for the ingest loop
         # and drain thread — merged into JobResult as profile.* so perf
         # work is steered by measurement (PROFILE.md), not vibes
-        import collections as _collections
-        self.prof: Dict[str, float] = _collections.defaultdict(float)
+        self.prof: Dict[str, float] = collections.defaultdict(float)
         self._emit_q = None
         self._drain_error: Optional[BaseException] = None
         # per-run discard cell: set on abort so the run's drain thread

@@ -217,11 +217,12 @@ class KeyDirectory:
             # allocate + register each distinct new key once, vectorized
             # (key churn is per-batch steady state in rotating-key
             # workloads like Nexmark; a Python loop here was 60ms/batch)
-            uniq, first = np.unique(keys[miss_ix], return_index=True)
+            uniq, first, inv = np.unique(
+                keys[miss_ix], return_index=True, return_inverse=True)
             uh = hashes[miss_ix][first]
-            self._table.insert_batch(uniq, uh, self._alloc_slots(uniq, uh))
-            slots2, _ = self._table.lookup(keys[miss_ix], hashes[miss_ix])
-            slots[miss_ix] = slots2
+            alloc = self._alloc_slots(uniq, uh)
+            self._table.insert_batch(uniq, uh, alloc)
+            slots[miss_ix] = alloc[inv]
         return slots
 
     def _alloc_slots(self, keys: np.ndarray, hashes: np.ndarray) -> np.ndarray:

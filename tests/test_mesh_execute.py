@@ -1,0 +1,168 @@
+"""Mesh execution through the PUBLIC API (SURVEY §3.7, §4.A): with
+``cluster.mesh-devices`` set, ``env.execute()`` runs the sharded step
+over the virtual 8-device CPU mesh — and the results must be
+byte-identical to single-device local execution. This is the
+parallelism-rescaling correctness contract (ref: AbstractOperatorRestore
+/ RescalingITCase compare-parallelism pattern).
+"""
+import numpy as np
+import pytest
+import jax
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.api.sinks import CollectSink
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.api.windowing import SlidingEventTimeWindows, TumblingEventTimeWindows
+from flink_tpu.config import Configuration
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+
+def make_env(mesh=None, extra=None):
+    conf = {
+        "state.num-key-shards": 32,
+        "state.slots-per-shard": 16,
+        "pipeline.microbatch-size": 256,
+    }
+    if mesh:
+        conf["cluster.mesh-devices"] = mesh
+    conf.update(extra or {})
+    return StreamExecutionEnvironment(Configuration(conf))
+
+
+def rows_of(sink):
+    out = []
+    for row in sink.rows:
+        out.append(tuple(
+            (k, int(v) if np.issubdtype(np.asarray(v).dtype, np.integer)
+             else round(float(v), 4))
+            for k, v in sorted(row.items())))
+    return sorted(out)
+
+
+def source(n_batches=8, n_keys=100, seed=0):
+    def gen(split, i):
+        if i >= n_batches:
+            return None
+        rng = np.random.default_rng(seed * 1000 + i)
+        b = 192
+        return ({"k": rng.integers(0, n_keys, b).astype(np.int64),
+                 "v": rng.integers(1, 50, b).astype(np.int64)},
+                np.sort(rng.integers(i * 700, i * 700 + 1400, b)).astype(np.int64))
+    return gen
+
+
+def build_q5_shape(env, sink, topn=None, n_batches=8, n_keys=100):
+    """The Q5 pipeline shape: keyed sliding-window count (+ device
+    top-n when ``topn``)."""
+    s = (env.from_source(
+            GeneratorSource(source(n_batches, n_keys)),
+            WatermarkStrategy.for_bounded_out_of_orderness(500))
+         .key_by("k")
+         .window(SlidingEventTimeWindows.of(4_000, 1_000))
+         .count())
+    if topn:
+        s = s.top(topn, by="count")
+    s.add_sink(sink)
+    return s
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8-device mesh")
+class TestMeshExecute:
+    def test_q5_sharded_via_public_api_matches_local(self):
+        env_local = make_env()
+        local_sink = CollectSink()
+        build_q5_shape(env_local, local_sink)
+        env_local.execute("q5-local")
+
+        env_mesh = make_env(mesh="all")
+        mesh_sink = CollectSink()
+        build_q5_shape(env_mesh, mesh_sink)
+        env_mesh.execute("q5-mesh")
+
+        assert rows_of(local_sink) == rows_of(mesh_sink)
+        assert len(rows_of(local_sink)) > 0
+
+    def test_q5_topn_sharded_matches_local(self):
+        env_local = make_env()
+        local_sink = CollectSink()
+        build_q5_shape(env_local, local_sink, topn=3)
+        env_local.execute("q5top-local")
+
+        env_mesh = make_env(mesh="all")
+        mesh_sink = CollectSink()
+        build_q5_shape(env_mesh, mesh_sink, topn=3)
+        env_mesh.execute("q5top-mesh")
+
+        assert rows_of(local_sink) == rows_of(mesh_sink)
+        assert len(rows_of(local_sink)) > 0
+
+    def test_topn_cross_device_ties_kept(self):
+        """Keys engineered so the n-th count TIES across device
+        boundaries: the distributed RANK()<=n (all_gather threshold)
+        must keep every tying key, exactly like the local path."""
+        def gen(split, i):
+            if i >= 1:
+                return None
+            # 12 keys spread over all shards; counts: four keys tie at 5
+            # (the n=2 threshold), others below
+            keys, counts = [], {}
+            rng = np.random.default_rng(42)
+            tie_keys = [3, 40, 77, 90]     # hash to different shards
+            low_keys = [5, 21, 55, 68]
+            rows = []
+            for k in tie_keys:
+                rows += [k] * 5
+            for k in low_keys:
+                rows += [k] * 2
+            rows = np.asarray(rows, np.int64)
+            ts = np.full(len(rows), 500, np.int64)
+            return ({"k": rows}, ts)
+
+        def build(env, sink):
+            (env.from_source(GeneratorSource(gen),
+                             WatermarkStrategy.for_bounded_out_of_orderness(0))
+             .key_by("k")
+             .window(TumblingEventTimeWindows.of(1_000))
+             .count()
+             .top(2, by="count")
+             .add_sink(sink))
+
+        env_local, local_sink = make_env(), CollectSink()
+        build(env_local, local_sink)
+        env_local.execute("ties-local")
+
+        env_mesh, mesh_sink = make_env(mesh="all"), CollectSink()
+        build(env_mesh, mesh_sink)
+        env_mesh.execute("ties-mesh")
+
+        local_rows = rows_of(local_sink)
+        assert local_rows == rows_of(mesh_sink)
+        # all four tying keys survive the distributed threshold
+        keys_out = {dict(r)["key"] for r in local_rows}
+        assert keys_out == {3, 40, 77, 90}
+
+    def test_sum_aggregate_sharded_matches_local(self):
+        def build(env, sink):
+            (env.from_source(GeneratorSource(source(6, 64, seed=9)),
+                             WatermarkStrategy.for_bounded_out_of_orderness(500))
+             .key_by("k")
+             .window(TumblingEventTimeWindows.of(2_000))
+             .sum("v")
+             .add_sink(sink))
+
+        env_local, local_sink = make_env(), CollectSink()
+        build(env_local, local_sink)
+        env_local.execute("sum-local")
+
+        env_mesh, mesh_sink = make_env(mesh="all"), CollectSink()
+        build(env_mesh, mesh_sink)
+        env_mesh.execute("sum-mesh")
+
+        assert rows_of(local_sink) == rows_of(mesh_sink)
+
+    def test_mesh_devices_n_selects_subset(self):
+        env = make_env(mesh="4")
+        mp = env.build_mesh_plan()
+        assert mp.n_devices == 4
+        assert make_env(mesh="1").build_mesh_plan() is None
+        assert make_env().build_mesh_plan() is None
