@@ -65,17 +65,19 @@ class StreamExecutionEnvironment:
         self._transforms.append(t)
 
     # -- execution -------------------------------------------------------
-    def execute(self, job_name: str = "job") -> "JobResult":
+    def execute(self, job_name: str = "job", cancel=None) -> "JobResult":
         """Lower and run to completion (bounded) or until cancelled
         (ref: execute → LocalExecutor → MiniCluster.submitJob). With
         ``cluster.mesh-devices`` set, keyed state is sharded over the
-        device mesh and the driver runs the distributed step."""
+        device mesh and the driver runs the distributed step. ``cancel``
+        is an optional threading.Event: setting it aborts the job at the
+        next batch boundary with JobCancelledError."""
         from flink_tpu.graph.compiler import compile_job
         from flink_tpu.runtime.driver import Driver
 
         plan = compile_job(self._transforms, self.config, self._watermark_strategy)
         driver = Driver(plan, self.config, mesh_plan=self.build_mesh_plan())
-        return driver.run(job_name)
+        return driver.run(job_name, cancel=cancel)
 
     def build_mesh_plan(self):
         """MeshPlan from ``cluster.mesh-devices`` (None = local

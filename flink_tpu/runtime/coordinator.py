@@ -31,6 +31,7 @@ class RunnerInfo:
     n_devices: int
     last_heartbeat: float
     alive: bool = True
+    port: int = 0  # runner gateway (0 = bookkeeping-only registration)
 
 
 @dataclasses.dataclass
@@ -40,6 +41,11 @@ class JobInfo:
     attempts: int = 0
     assigned_runners: List[str] = dataclasses.field(default_factory=list)
     failure: Optional[str] = None
+    # deployment descriptor (None = bookkeeping-only submission): the
+    # job-jar analogue — an importable ``module:function`` that builds
+    # the pipeline on an env, plus its configuration
+    entry: Optional[str] = None
+    config: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
 class JobCoordinator(RpcEndpoint):
@@ -60,31 +66,112 @@ class JobCoordinator(RpcEndpoint):
         self._monitor.start()
 
     # -- rpc methods -----------------------------------------------------
-    def rpc_register_runner(self, runner_id: str, host: str, n_devices: int) -> dict:
+    def rpc_register_runner(self, runner_id: str, host: str, n_devices: int,
+                            port: int = 0) -> dict:
         with self._lock:
             self.runners[runner_id] = RunnerInfo(
-                runner_id, host, n_devices, time.time())
+                runner_id, host, n_devices, time.time(), port=port)
         return {"heartbeat_interval_ms":
                 self.config.get(ClusterOptions.HEARTBEAT_INTERVAL)}
 
-    def rpc_heartbeat(self, runner_id: str, metrics: Optional[dict] = None) -> dict:
+    def rpc_heartbeat(self, runner_id: str, metrics: Optional[dict] = None,
+                      jobs: Optional[List[str]] = None) -> dict:
+        """Heartbeat + job-lease check: ``jobs`` the runner reports
+        running but that are no longer assigned to it (reassigned after
+        a false-positive loss, cancelled, terminal) come back as
+        ``revoked_jobs`` — the runner must cancel them before they
+        produce output (the fencing-token role, ref: JobMaster fencing /
+        TaskExecutor disconnect)."""
+        revoked: List[str] = []
         with self._lock:
             r = self.runners.get(runner_id)
             if r is None:
                 return {"known": False}  # re-register (coordinator restarted)
             r.last_heartbeat = time.time()
             r.alive = True
-        return {"known": True}
+            for job_id in jobs or []:
+                j = self.jobs.get(job_id)
+                # RESTARTING revokes too: the coordinator already
+                # declared this attempt dead — a falsely-lost runner
+                # must stop committing during the restart delay
+                if j is None or j.state in (
+                        "CANCELED", "FAILED", "RESTARTING") or (
+                        runner_id not in j.assigned_runners):
+                    revoked.append(job_id)
+        return {"known": True, "revoked_jobs": revoked}
 
-    def rpc_submit_job(self, job_id: str, runners: Optional[List[str]] = None) -> dict:
+    def rpc_submit_job(self, job_id: str, runners: Optional[List[str]] = None,
+                       entry: Optional[str] = None,
+                       config: Optional[dict] = None) -> dict:
+        """Submit a job. With an ``entry`` (module:function deployment
+        descriptor) the plan is PUSHED to a chosen runner's gateway —
+        the Dispatcher.submitJob → JobMaster → TaskExecutor.submitTask
+        flow; without one it is bookkeeping-only (legacy tests)."""
         with self._lock:
             alive = [r.runner_id for r in self.runners.values() if r.alive]
             chosen = runners or alive
             job = JobInfo(job_id, state="RUNNING", attempts=1,
-                          assigned_runners=chosen)
+                          assigned_runners=chosen, entry=entry,
+                          config=dict(config or {}))
             self.jobs[job_id] = job
             self._strategies[job_id] = from_config(self.config)
+        if entry is not None:
+            self._deploy_async(job_id)
         return {"assigned": chosen}
+
+    # -- deployment ------------------------------------------------------
+    def _deploy_async(self, job_id: str, delay_s: float = 0.0,
+                      exclude: Optional[List[str]] = None) -> None:
+        """Push the job's deployment descriptor to an alive runner on a
+        side thread — dispatch RPCs must not block the endpoint's single
+        dispatch thread (heartbeats ride it)."""
+        t = threading.Timer(delay_s, self._deploy, args=(job_id, exclude or []))
+        t.daemon = True
+        t.start()
+
+    def _deploy(self, job_id: str, exclude: List[str]) -> None:
+        from flink_tpu.runtime.rpc import RpcClient, RpcError
+
+        with self._lock:
+            j = self.jobs.get(job_id)
+            if j is None or j.entry is None or j.state not in (
+                    "RUNNING", "RESTARTING"):
+                return
+            candidates = [r for r in self.runners.values()
+                          if r.alive and r.port]
+            preferred = ([r for r in candidates if r.runner_id not in exclude]
+                         or candidates)
+            if not preferred:
+                j.state = "FAILED"
+                j.failure = "no alive runner to deploy to"
+                return
+            target = preferred[0]
+            j.state = "RUNNING"
+            j.assigned_runners = [target.runner_id]
+            entry, config, attempt = j.entry, dict(j.config), j.attempts
+            if attempt > 1:
+                # recovery attempt resumes from the newest checkpoint
+                config["execution.checkpointing.restore"] = "latest"
+        try:
+            c = RpcClient(target.host, target.port, timeout_s=5.0)
+            try:
+                resp = c.call("run_job", job_id=job_id, entry=entry,
+                              config=config, attempt=attempt)
+            finally:
+                c.close()
+            if not resp.get("accepted"):
+                raise RpcError(f"runner rejected job: {resp}")
+        except RpcError as e:
+            decision: Dict[str, Any] = {}
+            with self._lock:
+                jj = self.jobs.get(job_id)
+                if jj is not None:
+                    decision = self._route_failure(
+                        jj, f"deploy to {target.runner_id} failed: {e}")
+            if decision.get("action") == "restart":
+                self._deploy_async(
+                    job_id, decision.get("delay_ms", 0) / 1000,
+                    exclude=[target.runner_id])
 
     def rpc_job_status(self, job_id: str) -> dict:
         with self._lock:
@@ -95,33 +182,76 @@ class JobCoordinator(RpcEndpoint):
                     "failure": j.failure}
 
     def rpc_cancel_job(self, job_id: str) -> dict:
+        targets: List[RunnerInfo] = []
         with self._lock:
             j = self.jobs.get(job_id)
             if j is not None and j.state in ("RUNNING", "RESTARTING"):
                 j.state = "CANCELED"
+                targets = [r for rid in j.assigned_runners
+                           if (r := self.runners.get(rid)) is not None
+                           and r.port]
+        for r in targets:
+            self._push_cancel_async(r, job_id)
         return {"ok": True}
+
+    def _push_cancel_async(self, runner: RunnerInfo, job_id: str) -> None:
+        """Tell the runner's gateway to stop the job now (heartbeat
+        revocation is the backstop if this push is lost)."""
+        from flink_tpu.runtime.rpc import RpcClient, RpcError
+
+        def push() -> None:
+            try:
+                c = RpcClient(runner.host, runner.port, timeout_s=5.0)
+                try:
+                    c.call("cancel_job", job_id=job_id)
+                finally:
+                    c.close()
+            except RpcError:
+                pass
+
+        t = threading.Thread(target=push, daemon=True)
+        t.start()
 
     def rpc_finish_job(self, job_id: str) -> dict:
         with self._lock:
             j = self.jobs.get(job_id)
-            if j is not None:
+            # terminal states stand: a runner that missed its cancel and
+            # ran to completion does not flip CANCELED back to FINISHED
+            if j is not None and j.state in ("RUNNING", "RESTARTING"):
                 j.state = "FINISHED"
         return {"ok": True}
 
     def rpc_report_failure(self, job_id: str, error: str) -> dict:
         """Task failure → restart decision (ref: DefaultScheduler.
         updateTaskExecutionState → ExecutionFailureHandler →
-        RestartBackoffTimeStrategy)."""
+        RestartBackoffTimeStrategy). Deployable jobs are re-deployed by
+        the coordinator itself — the control loop CLOSES here."""
         with self._lock:
             j = self.jobs.get(job_id)
             if j is None:
                 return {"action": "unknown-job"}
-            return self._route_failure(j, error)
+            decision = self._route_failure(j, error)
+            deployable = j.entry is not None
+        if deployable and decision.get("action") == "restart":
+            self._deploy_async(job_id, decision.get("delay_ms", 0) / 1000)
+        return decision
 
     def _route_failure(self, j: JobInfo, error: str) -> dict:
         """Single failure-routing point (lock held): consult the job's
         restart budget, transition state, report the decision. Both
-        reported failures and runner-loss detection land here."""
+        reported failures and runner-loss detection land here. Terminal
+        states are sinks — a late failure report must never resurrect a
+        CANCELED/FINISHED/FAILED job."""
+        if j.state in ("CANCELED", "FINISHED", "FAILED"):
+            return {"action": "none", "state": j.state}
+        if j.state == "RESTARTING" and j.entry is not None:
+            # one incident, one restart (coordinator-DEPLOYED jobs only —
+            # _deploy owns the RESTARTING→RUNNING transition): the
+            # monitor's runner-loss route and the runner's own failure
+            # report must not each burn an attempt and schedule a deploy
+            # for the same crash. Bookkeeping-only jobs are restarted by
+            # an external supervisor, so each report IS a new incident.
+            return {"action": "restart-pending", "state": j.state}
         j.failure = error
         strat = self._strategies.get(j.job_id)
         if strat is not None and strat.can_restart():
@@ -144,6 +274,7 @@ class JobCoordinator(RpcEndpoint):
         while not self._closed:
             time.sleep(min(self._hb_timeout / 5, 1.0))
             now = time.time()
+            redeploys = []  # (job_id, delay_ms, lost_runner)
             with self._lock:
                 for r in self.runners.values():
                     if r.alive and now - r.last_heartbeat > self._hb_timeout:
@@ -154,8 +285,15 @@ class JobCoordinator(RpcEndpoint):
                         for j in self.jobs.values():
                             if (j.state == "RUNNING"
                                     and r.runner_id in j.assigned_runners):
-                                self._route_failure(
+                                d = self._route_failure(
                                     j, f"runner {r.runner_id} lost")
+                                if (j.entry is not None
+                                        and d.get("action") == "restart"):
+                                    redeploys.append((
+                                        j.job_id, d.get("delay_ms", 0),
+                                        r.runner_id))
+            for job_id, delay_ms, lost in redeploys:
+                self._deploy_async(job_id, delay_ms / 1000, exclude=[lost])
 
     def close(self) -> None:
         self._closed = True

@@ -34,6 +34,13 @@ from flink_tpu.time.watermarks import LONG_MIN, WatermarkTracker, make_generator
 Batch = Tuple[Dict[str, np.ndarray], np.ndarray, np.ndarray]  # data, ts, valid
 
 
+class JobCancelledError(RuntimeError):
+    """Raised inside the run loop when the job's cancel flag is set —
+    the cooperative cancellation point (ref: Task.cancelExecution /
+    StreamTask cancellation). The run() cleanup path treats it like any
+    abort: drain discarded, sinks' uncommitted output dropped."""
+
+
 class Driver:
     """Single-process execution of a lowered plan (the LocalExecutor /
     MiniCluster path; multi-host runs the same loop per host runner under
@@ -253,7 +260,11 @@ class Driver:
         )
 
     # -- run loop --------------------------------------------------------
-    def run(self, job_name: str = "job"):
+    def run(self, job_name: str = "job", cancel=None):
+        """``cancel``: optional threading.Event checked at every batch
+        boundary; when set the run aborts with JobCancelledError through
+        the normal failure cleanup (no output reaches sinks)."""
+        self._cancel = cancel
         import queue
         import threading
 
@@ -361,6 +372,8 @@ class Driver:
                 if not splits_alive:
                     continue
                 for split_ix in list(splits_alive):
+                    if self._cancel is not None and self._cancel.is_set():
+                        raise JobCancelledError(job_name)
                     it = srcs[sid][split_ix]
                     t0 = time.perf_counter()
                     nxt = next(it, None)

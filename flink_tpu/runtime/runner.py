@@ -1,0 +1,210 @@
+"""Task runner — the worker process that executes jobs.
+
+ref: runtime/taskexecutor/TaskExecutor.java (registration with the
+ResourceManager, heartbeats, ``submitTask`` receiving a deployment
+descriptor, task lifecycle + cancellation) and
+TaskManagerRunner.java (the process entrypoint).
+
+TPU-first shape: one runner per HOST, owning that host's devices; a
+"task deployment" is a job ENTRY POINT (``module:function`` building a
+pipeline on a ``StreamExecutionEnvironment``) plus a configuration —
+the analogue of shipping a job jar + JobGraph to a TaskExecutor. The
+runner builds the env (including its device mesh from
+``cluster.mesh-devices``), runs the driver loop, and reports
+finish/failure back to the coordinator, which owns the restart
+decision (SURVEY §4.A deploy flow, §4.E failover).
+
+Run as a process::
+
+    python -m flink_tpu.runtime.runner --coordinator HOST:PORT
+"""
+from __future__ import annotations
+
+import importlib
+import threading
+import time
+import traceback
+import uuid
+from typing import Any, Dict, Optional
+
+from flink_tpu.runtime.rpc import RpcClient, RpcEndpoint, RpcError, RpcServer
+
+
+class TaskRunner(RpcEndpoint):
+    """RPC surface (single dispatch thread): run_job / cancel_job /
+    ping. Job execution happens on a worker thread so the RPC endpoint
+    stays responsive to cancel + health while a job runs."""
+
+    def __init__(self, coordinator_host: str, coordinator_port: int,
+                 runner_id: Optional[str] = None) -> None:
+        self.runner_id = runner_id or f"runner-{uuid.uuid4().hex[:8]}"
+        self._coord = RpcClient(coordinator_host, coordinator_port)
+        self._jobs: Dict[str, Dict[str, Any]] = {}  # job_id -> {cancel, thread}
+        self._lock = threading.Lock()
+        self._closed = False
+        self._server: Optional[RpcServer] = None
+        self._hb_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self, port: int = 0) -> int:
+        """Serve the runner gateway, register with the coordinator,
+        start heartbeating. Returns the gateway port."""
+        import jax
+
+        self._server = RpcServer(self, port)
+        # register the address the gateway is REACHABLE at (RpcServer
+        # binds loopback; a multi-host transport registers its bind addr)
+        resp = self._coord.call(
+            "register_runner",
+            runner_id=self.runner_id,
+            host="127.0.0.1",
+            n_devices=len(jax.devices()),
+            port=self._server.port,
+        )
+        interval = resp.get("heartbeat_interval_ms", 10_000) / 1000
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, args=(interval,), daemon=True)
+        self._hb_thread.start()
+        return self._server.port
+
+    def _heartbeat_loop(self, interval: float) -> None:
+        while not self._closed:
+            time.sleep(interval)
+            try:
+                with self._lock:
+                    running = list(self._jobs)
+                r = self._coord.call("heartbeat", runner_id=self.runner_id,
+                                     jobs=running)
+                # revocation: jobs the coordinator no longer considers
+                # ours (reassigned after a false-positive loss, or
+                # terminal) must stop producing output here — the
+                # zombie-attempt fence (ref: fencing tokens /
+                # TaskExecutor disconnectJobManager)
+                for job_id in r.get("revoked_jobs", []):
+                    with self._lock:
+                        j = self._jobs.get(job_id)
+                        if j is not None:
+                            j["cancel"].set()
+                if not r.get("known"):
+                    # coordinator restarted: re-register (ref:
+                    # TaskExecutor re-connect to ResourceManager)
+                    import jax
+
+                    self._coord.call(
+                        "register_runner", runner_id=self.runner_id,
+                        host="127.0.0.1",
+                        n_devices=len(jax.devices()),
+                        port=self._server.port if self._server else 0)
+            except RpcError:
+                pass  # transient; next beat retries
+
+    def close(self) -> None:
+        self._closed = True
+        if self._server is not None:
+            self._server.close()
+        self._coord.close()
+
+    # -- rpc methods -----------------------------------------------------
+    def rpc_ping(self) -> dict:
+        return {"runner_id": self.runner_id, "jobs": list(self._jobs)}
+
+    def rpc_run_job(self, job_id: str, entry: str,
+                    config: Optional[dict] = None,
+                    attempt: int = 1) -> dict:
+        """Deploy a job: import ``module:function``, build the pipeline,
+        execute. The entry-point contract is the job-jar analogue — the
+        job's code must be importable on the runner host (ref:
+        TaskExecutor.submitTask + TaskDeploymentDescriptor)."""
+        with self._lock:
+            old = self._jobs.get(job_id)
+            if old is not None and old["attempt"] >= attempt:
+                return {"accepted": False, "reason": "already running"}
+            if old is not None:
+                # a NEWER attempt supersedes the stale one still winding
+                # down (its failure report can arrive before its thread
+                # exits): cancel it here, join it on the NEW worker
+                # thread — never on the single RPC dispatch thread,
+                # which must stay responsive within the deploy timeout
+                old["cancel"].set()
+            cancel = threading.Event()
+            rec: Dict[str, Any] = {"cancel": cancel, "attempt": attempt}
+            t = threading.Thread(
+                target=self._run_job,
+                args=(job_id, entry, dict(config or {}), attempt, cancel,
+                      rec, old),
+                daemon=True)
+            rec["thread"] = t
+            self._jobs[job_id] = rec
+            t.start()
+        return {"accepted": True, "runner_id": self.runner_id}
+
+    def rpc_cancel_job(self, job_id: str) -> dict:
+        with self._lock:
+            j = self._jobs.get(job_id)
+            if j is None:
+                return {"ok": False, "reason": "unknown job"}
+            j["cancel"].set()
+        return {"ok": True}
+
+    # -- execution -------------------------------------------------------
+    def _run_job(self, job_id: str, entry: str, config: dict,
+                 attempt: int, cancel: threading.Event,
+                 rec: Dict[str, Any],
+                 old: Optional[Dict[str, Any]] = None) -> None:
+        from flink_tpu.api.environment import StreamExecutionEnvironment
+        from flink_tpu.config import Configuration
+        from flink_tpu.runtime.driver import JobCancelledError
+
+        if old is not None:
+            # bounded wait for the superseded attempt (already
+            # cancelled) — it stops at its next batch boundary; if it is
+            # wedged past this, its cancel flag still discards output
+            old["thread"].join(timeout=30.0)
+        try:
+            mod_name, _, fn_name = entry.partition(":")
+            mod = importlib.import_module(mod_name)
+            build = getattr(mod, fn_name)
+            env = StreamExecutionEnvironment(Configuration(config))
+            build(env)
+            env.execute(job_id, cancel=cancel)
+            self._report("finish_job", job_id=job_id)
+        except JobCancelledError:
+            pass  # the canceller (coordinator) already owns the state
+        except BaseException:  # noqa: BLE001 — every fault goes upstream
+            self._report("report_failure", job_id=job_id,
+                         error=traceback.format_exc(limit=5))
+        finally:
+            with self._lock:
+                # pop only OUR record — a superseding attempt may have
+                # already replaced it
+                if self._jobs.get(job_id) is rec:
+                    self._jobs.pop(job_id)
+
+    def _report(self, method: str, **kw: Any) -> None:
+        try:
+            self._coord.call(method, **kw)
+        except RpcError:
+            pass  # coordinator down: its own recovery re-syncs state
+
+
+def main(argv: Optional[list] = None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description="flink_tpu task runner")
+    p.add_argument("--coordinator", required=True, metavar="HOST:PORT")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--runner-id", default=None)
+    args = p.parse_args(argv)
+    host, _, port = args.coordinator.partition(":")
+    runner = TaskRunner(host, int(port), runner_id=args.runner_id)
+    gateway = runner.start(args.port)
+    print(f"runner {runner.runner_id} gateway on :{gateway}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        runner.close()
+
+
+if __name__ == "__main__":
+    main()

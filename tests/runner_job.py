@@ -1,0 +1,60 @@
+"""Deployable job for the multi-process runner tests — the "job jar".
+
+The runner imports this module by name (``runner_job:build``) and calls
+``build(env)`` to construct the pipeline, exactly like a TaskExecutor
+materializing a shipped job (ref: TaskDeploymentDescriptor). Job
+parameters ride in the submitted Configuration under ``test.*`` keys so
+both attempts (original + post-kill recovery) build the identical,
+deterministically replayable pipeline.
+"""
+import time
+
+import numpy as np
+
+from flink_tpu.api.sinks import FileTransactionalSink
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.api.windowing import TumblingEventTimeWindows
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+N_KEYS = 10
+BATCH = 64
+
+
+def batch_of(i: int):
+    """Deterministic batch i (shared with the test's golden model)."""
+    rng = np.random.default_rng(1234 + i)
+    keys = rng.integers(0, N_KEYS, BATCH).astype(np.int64)
+    ts = np.sort(rng.integers(i * 500, i * 500 + 1000, BATCH)).astype(np.int64)
+    return keys, ts
+
+
+def golden_counts(n_batches: int):
+    expect = {}
+    for i in range(n_batches):
+        keys, ts = batch_of(i)
+        for k, t in zip(keys, ts):
+            kk = (int(k), (int(t) // 1000) * 1000)
+            expect[kk] = expect.get(kk, 0) + 1
+    return expect
+
+
+def build(env):
+    n_batches = int(env.config.get_raw("test.n-batches", 40))
+    sleep_ms = int(env.config.get_raw("test.batch-sleep-ms", 0))
+    sink_dir = env.config.get_raw("test.sink-dir")
+    assert sink_dir, "test.sink-dir must be set"
+
+    def gen(split, i):
+        if i >= n_batches:
+            return None
+        if sleep_ms:
+            time.sleep(sleep_ms / 1000)  # slow stream: killable mid-job
+        keys, ts = batch_of(i)
+        return {"k": keys}, ts
+
+    (env.from_source(GeneratorSource(gen),
+                     WatermarkStrategy.for_bounded_out_of_orderness(1000))
+        .key_by("k")
+        .window(TumblingEventTimeWindows.of(1000))
+        .count()
+        .add_sink(FileTransactionalSink(sink_dir)))
