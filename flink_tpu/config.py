@@ -201,6 +201,15 @@ class PipelineOptions:
         "record generation/decode overlaps the loop's keying + transfer "
         "+ dispatch work (ref: the SourceReader split-fetcher thread "
         "model). 0 disables.")
+    EXCHANGE_CAPACITY = ConfigOption(
+        "pipeline.exchange-capacity", 0,
+        "Per-(source, destination) bucket capacity of the keyBy "
+        "all_to_all exchange, in records. Bounds the exchange buffer to "
+        "devices x capacity per device. 0 = auto (per-device block "
+        "size: can never overflow). When set, batches are SPLIT on the "
+        "host so no bucket can exceed it — skewed keys cost extra "
+        "steps, never data (ref: credit-based flow control's no-loss "
+        "property, SURVEY §3.6).")
     EMIT_DEFER_MS = duration_option(
         "pipeline.emit-defer", -1,
         "How long the emit drain thread lets a fired batch age before "

@@ -492,7 +492,13 @@ class WindowOperator:
         self.assigner = assigner
         self.agg = agg
         self.mesh_plan = mesh_plan
-        self.exchange_capacity = exchange_capacity
+        if exchange_capacity is not None and exchange_capacity < 0:
+            raise ValueError(
+                f"exchange_capacity must be >= 0, got {exchange_capacity}")
+        # 0 means auto everywhere (matches the config option), not
+        # "capacity zero" — normalize here so direct construction and
+        # the Driver path agree
+        self.exchange_capacity = exchange_capacity or None
         # (result_field, n): fire only each window's top-n rows by that
         # field (ties kept) — evaluated on device, shrinking the emit
         # transfer to the winners (Q5 hot-items shape)
@@ -513,6 +519,9 @@ class WindowOperator:
         # calling ``throttle()`` outside its push lock (see throttle())
         self.external_throttle = False
         self._inflight = collections.deque()
+        # device scalars from sharded steps, resolved lazily (see
+        # _resolve_overflow) — never block the pipeline per batch
+        self._overflow_markers = collections.deque()
         self.plan = WindowPlan.plan(
             assigner,
             allowed_lateness_ms=allowed_lateness_ms,
@@ -875,18 +884,35 @@ class WindowOperator:
                 self.state, jnp.asarray(packed),
                 {k: jnp.asarray(v) for k, v in data.items()})
         else:
-            # pad batch to a multiple of the device count (arrival split)
             n_dev = self.mesh_plan.n_devices
-            b = len(ts)
-            pad = (-b) % n_dev
-            if pad:
-                packed = np.concatenate([packed, np.full(pad, -1, dt)])
-                data = {k: np.concatenate([v, np.zeros((pad,) + v.shape[1:], v.dtype)])
-                        for k, v in data.items()}
-            self.state, overflow = self._apply_sharded(
-                self.state, jnp.asarray(packed),
-                {k: jnp.asarray(v) for k, v in data.items()})
-            self.exchange_overflow += int(overflow)
+            ov_total = None
+            for pk, dt_chunk, target in self._split_for_exchange(
+                    packed, data, n_dev):
+                # the chunk length was pow2-bucketed + device-aligned by
+                # the splitter (its capacity check ran against THIS
+                # padded layout); pad to it so the device-side arrival
+                # split sees exactly the blocks the check saw — and so
+                # data-dependent split sizes don't compile a fresh
+                # shard_map program per novel shape
+                pad = target - len(pk)
+                if pad:
+                    pk = np.concatenate([pk, np.full(pad, -1, dt)])
+                    dt_chunk = {
+                        k: np.concatenate(
+                            [v, np.zeros((pad,) + v.shape[1:], v.dtype)])
+                        for k, v in dt_chunk.items()}
+                self.state, overflow = self._apply_sharded(
+                    self.state, jnp.asarray(pk),
+                    {k: jnp.asarray(v) for k, v in dt_chunk.items()})
+                # LAZY overflow accounting: int(overflow) would block the
+                # pipeline on every step. One device-side sum per PUSH
+                # (not per chunk) so the marker deque stays 1:1 with
+                # _inflight and throttle() never touches an in-flight
+                # chunk's scalar. The host-side split makes overflow
+                # structurally impossible — the counter is the backstop.
+                ov_total = overflow if ov_total is None else ov_total + overflow
+            if ov_total is not None:
+                self._overflow_markers.append(ov_total)
         t4 = time.perf_counter()
         self.prof["pb_dispatch"] += t4 - t3
         # inflight marker: a tiny scalar DERIVED from the new state — the
@@ -906,6 +932,10 @@ class WindowOperator:
         t0 = time.perf_counter()
         while len(self._inflight) > self.max_inflight_steps:
             jax.block_until_ready(self._inflight.popleft())
+        # overflow markers older than the steps just retired are ready
+        # (int() is a cheap host read); draining to the same bound keeps
+        # the deque finite in jobs that never checkpoint
+        self._resolve_overflow(bound=self.max_inflight_steps)
         self.prof["pb_throttle_wait"] += time.perf_counter() - t0
 
     def quiesce(self) -> None:
@@ -916,6 +946,70 @@ class WindowOperator:
         while self._inflight:
             jax.block_until_ready(self._inflight.popleft())
         jax.block_until_ready(self.state.counts)
+        self._resolve_overflow()
+
+    def _resolve_overflow(self, bound: int = 0) -> None:
+        """Materialize pending exchange-overflow markers (beyond
+        ``bound``) into the counter. With the host-side batch split, any
+        non-zero value is a routing bug — fail loudly, not under-count."""
+        while len(self._overflow_markers) > bound:
+            self.exchange_overflow += int(self._overflow_markers.popleft())
+        if self.exchange_overflow:
+            raise RuntimeError(
+                f"exchange overflow: {self.exchange_overflow} records "
+                "dropped by the keyBy all_to_all despite the host-side "
+                "split — per-destination routing bug")
+
+    @staticmethod
+    def _pow2_target(b: int, n_dev: int) -> int:
+        """Dispatch length for a ``b``-record chunk: next pow2, then
+        aligned to the device count (one compiled program per bucket)."""
+        t = max(n_dev, _next_pow2(max(b, 1)))
+        return t + (-t) % n_dev
+
+    def _split_for_exchange(
+            self, packed: np.ndarray, data: Dict[str, np.ndarray],
+            n_dev: int) -> List[Tuple[np.ndarray, Dict, int]]:
+        """Split a batch so no (source-block, destination) bucket of the
+        all_to_all exchange exceeds ``exchange_capacity`` — data loss
+        becomes structurally impossible instead of counted (the
+        credit-based no-loss property, ref: SURVEY §3.6; a skewed key
+        routing everything to one shard simply costs more steps).
+
+        Yields ``(chunk, data, target)`` where ``target`` is the padded
+        dispatch length — the capacity check runs against the SAME
+        padded block layout the device-side arrival split will use
+        (block length ``target // n_dev``), so an accepted chunk cannot
+        overflow after padding. Capacity None = block-sized buckets,
+        which can never overflow — one chunk, no check. ``b == 1`` is
+        the termination backstop: a single record occupies one bucket,
+        safe for any capacity ≥ 1 (enforced at config load)."""
+        cap = self.exchange_capacity
+        if cap is None:
+            return [(packed, data, self._pow2_target(len(packed), n_dev))]
+        ring = self.plan.ring
+        spd = self.mesh_plan.slots_per_device
+        out: List[Tuple[np.ndarray, Dict, int]] = []
+        stack = [(packed, data)]
+        while stack:
+            pk, dt = stack.pop()
+            b = len(pk)
+            if not b:
+                continue
+            target = self._pow2_target(b, n_dev)
+            L = target // n_dev  # arrival-split block length AT DISPATCH
+            valid = pk >= 0
+            dest = np.where(valid, (pk // ring) // spd, 0)
+            block = np.arange(b) // L  # < n_dev since b <= target
+            flat = np.where(valid, block * n_dev + dest, n_dev * n_dev)
+            counts = np.bincount(flat, minlength=n_dev * n_dev + 1)
+            if counts[:n_dev * n_dev].max(initial=0) <= cap or b <= 1:
+                out.append((pk, dt, target))
+            else:
+                mid = b // 2
+                stack.append((pk[mid:], {k: v[mid:] for k, v in dt.items()}))
+                stack.append((pk[:mid], {k: v[:mid] for k, v in dt.items()}))
+        return out
 
     def _grow_ring(
         self, need: int, applied_min: Optional[int], applied_max: Optional[int]
@@ -1267,6 +1361,7 @@ class WindowOperator:
 
     # -- snapshot seam (checkpoint/ uses this) ---------------------------
     def snapshot_state(self) -> Dict[str, Any]:
+        self._resolve_overflow()  # a checkpoint must not hide pending loss
         return {
             "n_dev": self.mesh_plan.n_devices if self.mesh_plan else 1,
             "ring": self.plan.ring,

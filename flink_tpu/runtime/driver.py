@@ -132,6 +132,12 @@ class Driver:
         num_shards = self.config.get(StateOptions.NUM_KEY_SHARDS)
         slots = self.config.get(StateOptions.SLOTS_PER_SHARD)
         inflight = self.config.get(PipelineOptions.MAX_INFLIGHT_STEPS)
+        xcap = self.config.get(PipelineOptions.EXCHANGE_CAPACITY)
+        if xcap < 0:
+            raise ValueError(
+                f"pipeline.exchange-capacity must be >= 0 (0 = auto), "
+                f"got {xcap}")
+        xcap = xcap or None
         # pane-ring sizing must cover the worst watermark lag of ANY
         # source feeding the job (per-source strategies override the
         # plan default)
@@ -151,6 +157,7 @@ class Driver:
                     max_out_of_orderness_ms=max(wm.max_out_of_orderness_ms, 0),
                     mesh_plan=self.mesh_plan,
                     top_n=t.top_n,
+                    exchange_capacity=xcap,
                 )
                 self._ops[n.id].max_inflight_steps = inflight
                 # backpressure blocks happen OUTSIDE the push lock (the
