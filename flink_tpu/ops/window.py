@@ -33,6 +33,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import threading
 import time
 from collections.abc import Mapping
 from typing import Any, Dict, List, Optional, Tuple
@@ -47,6 +48,7 @@ from flink_tpu.api.windowing import WindowAssigner
 from flink_tpu.ops.aggregates import LaneAggregate
 from flink_tpu.parallel.mesh import AXIS, MeshPlan
 from flink_tpu.state.keyed import KeyDirectory, PaneState, PaneStateLayout, init_state
+from flink_tpu.state.spill import HostSpillStore
 from flink_tpu.time.watermarks import LONG_MIN
 
 
@@ -488,6 +490,7 @@ class WindowOperator:
         mesh_plan: Optional[MeshPlan] = None,
         exchange_capacity: Optional[int] = None,
         top_n: Optional[Tuple[str, int]] = None,
+        spill: bool = False,
     ) -> None:
         self.assigner = assigner
         self.agg = agg
@@ -522,6 +525,15 @@ class WindowOperator:
         # device scalars from sharded steps, resolved lazily (see
         # _resolve_overflow) — never block the pipeline per batch
         self._overflow_markers = collections.deque()
+        # state.backend='spill': keys past HBM capacity aggregate on the
+        # host (exact, slower) instead of dropping with a counter
+        self._spill = HostSpillStore(agg) if spill else None
+        # top-n + spill: host rows can't ride per-fire markers because
+        # device rows flow through the SHARED emit ring (a coalesced
+        # drain would re-rank against the wrong fires). They queue here
+        # and the drain merges them atomically with its ring poll.
+        self._pending_ring_extras = collections.deque()
+        self._ring_lock = threading.Lock()
         self.plan = WindowPlan.plan(
             assigner,
             allowed_lateness_ms=allowed_lateness_ms,
@@ -853,11 +865,23 @@ class WindowOperator:
         self.prof["pb_assign"] += time.perf_counter() - t1
         bad = valid & (slots < 0)
         if bad.any():
-            # shard full or misrouted: drop WITH accounting — surfaced as
-            # a metric and in JobResult so full directories are loud, not
-            # silently wrong (the spill store is the no-loss home)
-            self.records_dropped_full += int(bad.sum())
-            valid = valid & ~bad
+            full = bad & (slots == KeyDirectory.FULL)
+            if self._spill is not None and full.any():
+                # shard full: the key aggregates on the host instead —
+                # exact results at host speed (see state/spill.py)
+                sub = {k: data[k][full] for k in
+                       (self.agg.fields if self.agg.fields is not None
+                        else data)}
+                self._spill.absorb(keys[full], panes[full], sub)
+                bad = bad & ~full
+            # remaining negatives: shard-full without a spill store, or
+            # misrouted (-1: key outside this operator's shard_range —
+            # a routing error the spill store must NOT absorb, or the
+            # key would aggregate on two workers at once). Drop WITH
+            # accounting — loud, not silently wrong.
+            if bad.any():
+                self.records_dropped_full += int(bad.sum())
+            valid = valid & ~bad & ~full
         t2 = time.perf_counter()
         from flink_tpu.records import device_cast
         # upload ONLY the lanes the aggregate reads: the host→device link
@@ -1087,7 +1111,34 @@ class WindowOperator:
         if self._fired_below_end is None or frontier > self._fired_below_end:
             self._fired_below_end = frontier
         self._refire.clear()
-        out = self._fire_ends(ends)
+        # host-store keys fire on the SAME ends list (incl. refires) —
+        # disjoint key sets, so rows simply ride along
+        extra = (self._spill.fire(
+            ends, self.plan.panes_per_window, self.plan.pane_ms,
+            self.plan.offset_ms, self.plan.size_ms)
+            if self._spill is not None and ends else None)
+        if self._topn is not None and self._spill is not None:
+            # top-n + spill: drain the ring SYNCHRONOUSLY at each fire.
+            # Device rows flow through a shared ring with no per-fire
+            # attribution, so letting the drain thread coalesce fires
+            # would re-rank one fire's host rows against another fire's
+            # device winners (and a refired window's stale rows would
+            # poison the union — rank fields aren't monotone across
+            # refires). One fire per drain makes the union re-rank
+            # trivially exact. The cost — a blocking ring fetch per
+            # advance — lands only in spill mode, which has already
+            # traded peak speed for capacity.
+            with self._ring_lock:
+                out = self._fire_ends(ends)
+                if extra is not None:
+                    self._pending_ring_extras.append(extra)
+            if out._ring or extra is not None:
+                out = FiredWindows(data=self.drain_ring())
+        else:
+            out = self._fire_ends(ends)
+            if extra is not None:
+                out._extra = extra
+                out._topn_spec = self._topn
 
         # purge panes no window can need anymore; only columns actually
         # written (>= min pane seen) can hold data
@@ -1108,6 +1159,8 @@ class WindowOperator:
                     mask[ring_positions] = True
                 self.state = self._clear(self.state, jnp.asarray(mask))
             self._cleared_below = new_dead
+            if self._spill is not None:
+                self._spill.purge_below(new_dead)
         self.prof["aw_dispatch"] += time.perf_counter() - taw
         return out
 
@@ -1238,12 +1291,26 @@ class WindowOperator:
         the previous drain (the host-side poll of the device emit
         buffer). Overflow — more appends than the ring holds between
         polls — is detected from the monotone counter and raises."""
-        if self._emit_ring is None or self._ring_anchor is None:
-            return dict(self._empty())
-        tdr = time.perf_counter()
-        arr = np.asarray(self._emit_ring)        # ONE round trip
-        self.prof["drain_fetch"] += time.perf_counter() - tdr
-        self.prof["drain_fetches"] += 1
+        with self._ring_lock:
+            # pop pending host-spill extras together with the ring read:
+            # the appender holds the same lock across (ring dispatch,
+            # extra enqueue), so the rows observed here are exactly the
+            # fires whose extras we pop — per-fire attribution without
+            # per-fire ring segmentation
+            extras = list(self._pending_ring_extras)
+            self._pending_ring_extras.clear()
+            if self._emit_ring is None or self._ring_anchor is None:
+                arr = None
+            else:
+                tdr = time.perf_counter()
+                arr = np.asarray(self._emit_ring)    # ONE round trip
+                self.prof["drain_fetch"] += time.perf_counter() - tdr
+                self.prof["drain_fetches"] += 1
+        if arr is None:
+            out = dict(self._empty())
+            if extras:
+                out = _drain_merge_extras(out, extras, self._topn)
+            return out
         row_cap = self.EMIT_RING_ROWS
         bodies = []
         if self.mesh_plan is None:
@@ -1295,6 +1362,8 @@ class WindowOperator:
                 continue
             col = np.ascontiguousarray(body[:, 3 + i])
             out[k] = col if self._res_is_int[k] else col.view(np.float32)
+        if extras:
+            out = _drain_merge_extras(out, extras, self._topn)
         return out
 
     def _check_fire_cap(self, n: int, cap: int) -> None:
@@ -1360,9 +1429,15 @@ class WindowOperator:
         return FiredWindows(data=dict(self._empty_cache))
 
     # -- snapshot seam (checkpoint/ uses this) ---------------------------
+    @property
+    def records_spilled(self) -> int:
+        return self._spill.records_spilled if self._spill is not None else 0
+
     def snapshot_state(self) -> Dict[str, Any]:
         self._resolve_overflow()  # a checkpoint must not hide pending loss
         return {
+            "spill": (self._spill.snapshot()
+                      if self._spill is not None else None),
             "n_dev": self.mesh_plan.n_devices if self.mesh_plan else 1,
             "ring": self.plan.ring,
             "panes": jax.tree_util.tree_map(np.asarray, self.state),
@@ -1413,6 +1488,17 @@ class WindowOperator:
         self._refire = set(snap["refire"])
         self.late_records = snap["late_records"]
         self.records_dropped_full = snap.get("records_dropped_full", 0)
+        snap_spill = snap.get("spill")
+        if self._spill is not None and snap_spill is not None:
+            self._spill.restore(snap_spill)
+        elif self._spill is None and snap_spill and snap_spill.get("panes"):
+            # the snapshot carries live host-resident aggregates this
+            # operator (state.backend='hbm') cannot hold — restoring
+            # would silently lose them
+            raise ValueError(
+                "snapshot contains host-spill state for "
+                f"{len(snap_spill['panes'])} pane(s) but state.backend "
+                "is 'hbm'; restore with state.backend='spill'")
         self._used_pushed = -1  # directory changed: invalidate device used-mask
         # emit ring resets: everything it held was delivered before the
         # snapshot (checkpoint flushes emits first); replay re-fires
@@ -1473,6 +1559,10 @@ class FiredWindows(Mapping):
         self._op = op
         self._packs = packs
         self._ring = ring
+        # host-spill rows fired alongside this batch (disjoint keys);
+        # merged in at materialization, reranked if a top-n is active
+        self._extra: Optional[Dict[str, np.ndarray]] = None
+        self._topn_spec: Optional[Tuple[str, int]] = None
 
     def materialize(self) -> Dict[str, np.ndarray]:
         if self._data is None:
@@ -1486,6 +1576,10 @@ class FiredWindows(Mapping):
                 bufs = jax.device_get([b for _, b in self._packs])
                 self._data = self._op._decode_packs(self._packs, bufs)
                 self._packs = self._op = None
+        if self._extra is not None:
+            self._data = _merge_spill_rows(
+                self._data, self._extra, self._topn_spec)
+            self._extra = None
         return self._data
 
     @staticmethod
@@ -1526,6 +1620,61 @@ class FiredWindows(Mapping):
 
     def __len__(self) -> int:
         return len(self.materialize())
+
+
+def _merge_spill_rows(
+    dev: Dict[str, np.ndarray], extra: Dict[str, np.ndarray],
+    topn: Optional[Tuple[str, int]],
+) -> Dict[str, np.ndarray]:
+    """Concatenate device-fired and host-spill-fired rows (pack-mode
+    path — per-fire attribution is exact there, and pack mode never has
+    a top-n, so this is a plain field-wise concat; the ``topn`` arg is
+    accepted for symmetry and future-proofing)."""
+    out = {k: np.concatenate([np.asarray(dev[k]), np.asarray(extra[k])])
+           for k in dev}
+    if topn is None or len(out["window_end"]) == 0:
+        return out
+    field, n = topn
+    keep = _topn_keep(out["window_end"], np.asarray(out[field]), n)
+    return {k: val[keep] for k, val in out.items()}
+
+
+def _topn_keep(we: np.ndarray, v: np.ndarray, n: int,
+               windows: Optional[np.ndarray] = None) -> np.ndarray:
+    """Boolean keep-mask for per-window top-n with ties kept. When
+    ``windows`` is given, only those windows are filtered; rows of other
+    windows pass through."""
+    keep = np.ones(len(we), bool)
+    for w in (np.unique(we) if windows is None else windows):
+        grp = np.flatnonzero(we == w)
+        if len(grp) > n:
+            gv = v[grp]
+            thresh = np.partition(gv, len(gv) - n)[len(gv) - n]
+            keep[grp[gv < thresh]] = False  # ties at thresh stay
+    return keep
+
+
+def _drain_merge_extras(
+    dev: Dict[str, np.ndarray], extras: List[Dict[str, np.ndarray]],
+    topn: Optional[Tuple[str, int]],
+) -> Dict[str, np.ndarray]:
+    """Merge host-spill extras into a ring-drain batch and re-rank the
+    windows the extras touch.
+
+    The device's ring rows are top-n of RESIDENT keys only; the global
+    top-n is always a subset of device-winners ∪ host rows, so the
+    union re-rank over a SINGLE fire is exact — and spill+top-n mode
+    drains synchronously per fire (see advance_watermark), so a drain
+    never mixes fires. Windows with no host rows pass through."""
+    ex = {k: np.concatenate([np.asarray(e[k]) for e in extras])
+          for k in extras[0]}
+    comb = {k: np.concatenate([np.asarray(dev[k]), ex[k]]) for k in dev}
+    if topn is None:
+        return comb
+    field, n = topn
+    keep = _topn_keep(comb["window_end"], np.asarray(comb[field]), n,
+                      windows=np.unique(ex["window_end"]))
+    return {k: v[keep] for k, v in comb.items()}
 
 
 def _empty_fired(agg: LaneAggregate) -> Dict[str, np.ndarray]:

@@ -138,6 +138,10 @@ class Driver:
                 f"pipeline.exchange-capacity must be >= 0 (0 = auto), "
                 f"got {xcap}")
         xcap = xcap or None
+        backend = self.config.get(StateOptions.BACKEND)
+        if backend not in ("hbm", "spill"):
+            raise ValueError(
+                f"state.backend must be 'hbm' or 'spill', got {backend!r}")
         # pane-ring sizing must cover the worst watermark lag of ANY
         # source feeding the job (per-source strategies override the
         # plan default)
@@ -158,6 +162,7 @@ class Driver:
                     mesh_plan=self.mesh_plan,
                     top_n=t.top_n,
                     exchange_capacity=xcap,
+                    spill=(backend == "spill"),
                 )
                 self._ops[n.id].max_inflight_steps = inflight
                 # backpressure blocks happen OUTSIDE the push lock (the
@@ -459,7 +464,7 @@ class Driver:
             self._metrics_server.close()
         for nid, op in self._ops.items():
             for counter in ("late_records", "records_dropped_full",
-                            "exchange_overflow"):
+                            "exchange_overflow", "records_spilled"):
                 if hasattr(op, counter):
                     self.metrics[counter] = (
                         self.metrics.get(counter, 0) + getattr(op, counter))

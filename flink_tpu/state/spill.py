@@ -1,0 +1,237 @@
+"""Host spill store: exact windowed aggregation for keys beyond HBM.
+
+The dense pane-tensor backend (state/keyed.py) holds a FIXED number of
+key slots per shard in HBM. The reference degrades gracefully past RAM
+via RocksDB (ref: runtime/state/RocksDBKeyedStateBackend role, SURVEY
+§3.4): state beyond memory gets slower, never wrong. This module is the
+TPU-native analogue — but instead of swapping slots over the (slow,
+~100ms-RTT remote-attached) host↔device link the way RocksDB pages
+SSTs, it exploits that every lane aggregate is a commutative monoid
+(sum/max/min/count): records whose keys cannot get an HBM slot are
+aggregated ON THE HOST in vectorized numpy, per (key, pane), and the
+host partials fire alongside the device partials. A key lives in
+exactly one store (a key that failed slot allocation once can never be
+resident later — the directory is insert-only), so the two stores'
+key sets are disjoint and their fired rows simply concatenate: exact
+results, no cross-store merge. Hot early keys keep HBM speed; overflow
+keys degrade to host speed. (LRU slot eviction — promoting a late-hot
+key into HBM — is a possible refinement; it would add per-eviction
+link round trips, which measurement shows dominate at ~100ms each, so
+v1 keeps placement static.)
+
+Fire/refire/purge mirror the device path exactly: the operator passes
+the SAME fired-ends list (including re-fires of late-within-lateness
+data) to both stores, and purges both at the same lateness horizon.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from flink_tpu.ops.aggregates import LaneAggregate
+
+_NEG_INF = np.float32(-np.inf)
+_POS_INF = np.float32(np.inf)
+
+
+def _cpu_device():
+    try:
+        return jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        return None
+
+
+class HostSpillStore:
+    """Per-(key, pane) lane accumulators in host numpy arrays.
+
+    Layout: ``panes[p] = (keys sorted (K,), sums (K,S), maxs (K,M),
+    mins (K,m), counts (K,))``. Batch absorption is one lexsort +
+    segment reduce; merging into a pane is a sorted-union splice. Both
+    are O(records + keys) vectorized numpy — no per-key Python loops
+    (the round-2 session-registry mistake, not repeated here).
+    """
+
+    def __init__(self, agg: LaneAggregate):
+        self.agg = agg
+        self.panes: Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                    np.ndarray, np.ndarray]] = {}
+        self.records_spilled = 0
+        self._cpu = _cpu_device()
+
+    # -- ingest ----------------------------------------------------------
+
+    def _lift(self, data: Dict[str, np.ndarray], n: int
+              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Evaluate the aggregate's lane lift for ``n`` host rows.
+        ``lift_masked`` is written in jnp; pin it to the CPU backend so
+        spilled records never ride the device link (that's the whole
+        point). Falls back to the default device if no CPU backend
+        exists — slower, still exact."""
+        valid = np.ones(n, bool)
+        if self._cpu is not None:
+            with jax.default_device(self._cpu):
+                s, mx, mn = self.agg.lift_masked(data, valid)
+        else:
+            s, mx, mn = self.agg.lift_masked(data, valid)
+        return np.asarray(s), np.asarray(mx), np.asarray(mn)
+
+    def absorb(self, keys: np.ndarray, panes: np.ndarray,
+               data: Dict[str, np.ndarray]) -> None:
+        """Fold overflow records into the per-(key, pane) accumulators."""
+        n = len(keys)
+        if n == 0:
+            return
+        self.records_spilled += n
+        sums, maxs, mins = self._lift(data, n)
+
+        # group by (pane, key): lexsort + boundary flags + segment reduce
+        o = np.lexsort((keys, panes))
+        pk, kk = panes[o], keys[o]
+        new_grp = np.empty(n, bool)
+        new_grp[0] = True
+        new_grp[1:] = (pk[1:] != pk[:-1]) | (kk[1:] != kk[:-1])
+        gid = np.cumsum(new_grp) - 1
+        G = int(gid[-1]) + 1
+        S, M, m = self.agg.sum_width, self.agg.max_width, self.agg.min_width
+        g_sum = np.zeros((G, S), np.float32)
+        np.add.at(g_sum, gid, sums[o])
+        g_max = np.full((G, M), _NEG_INF, np.float32)
+        np.maximum.at(g_max, gid, maxs[o])
+        g_min = np.full((G, m), _POS_INF, np.float32)
+        np.minimum.at(g_min, gid, mins[o])
+        g_cnt = np.bincount(gid, minlength=G).astype(np.int64)
+        g_pane = pk[new_grp]
+        g_key = kk[new_grp]
+
+        # splice each touched pane (few per batch — event-time locality)
+        bounds = np.flatnonzero(
+            np.concatenate([[True], g_pane[1:] != g_pane[:-1], [True]]))
+        for i in range(len(bounds) - 1):
+            a, b = bounds[i], bounds[i + 1]
+            self._merge_pane(int(g_pane[a]), g_key[a:b], g_sum[a:b],
+                             g_max[a:b], g_min[a:b], g_cnt[a:b])
+
+    def _merge_pane(self, pane: int, keys, sums, maxs, mins, counts) -> None:
+        cur = self.panes.get(pane)
+        if cur is None:
+            self.panes[pane] = (keys.copy(), sums.copy(), maxs.copy(),
+                                mins.copy(), counts.copy())
+            return
+        ck, cs, cx, cn, cc = cur
+        union = np.union1d(ck, keys)
+        K = len(union)
+        S, M, m = self.agg.sum_width, self.agg.max_width, self.agg.min_width
+        us = np.zeros((K, S), np.float32)
+        ux = np.full((K, M), _NEG_INF, np.float32)
+        un = np.full((K, m), _POS_INF, np.float32)
+        uc = np.zeros(K, np.int64)
+        po = np.searchsorted(union, ck)
+        pn = np.searchsorted(union, keys)
+        us[po] = cs
+        us[pn] += sums
+        ux[po] = cx
+        ux[pn] = np.maximum(ux[pn], maxs)
+        un[po] = cn
+        un[pn] = np.minimum(un[pn], mins)
+        uc[po] = cc
+        uc[pn] += counts
+        self.panes[pane] = (union, us, ux, un, uc)
+
+    # -- fire ------------------------------------------------------------
+
+    def fire(self, ends: List[int], panes_per_window: int, pane_ms: int,
+             offset_ms: int, size_ms: int) -> Optional[Dict[str, np.ndarray]]:
+        """Fired rows for the given end panes, combined across each
+        window's panes with the same monoid ops the device kernel uses.
+        Returns None when no stored pane intersects any window (the
+        common case — keep the hot path allocation-free)."""
+        if not self.panes or not ends:
+            return None
+        ppw = panes_per_window
+        lo_stored = min(self.panes)
+        hi_stored = max(self.panes)
+        live = [e for e in ends if e > lo_stored and e - ppw <= hi_stored]
+        if not live:
+            return None
+        S, M, m = self.agg.sum_width, self.agg.max_width, self.agg.min_width
+        keys_out: List[np.ndarray] = []
+        ends_out: List[np.ndarray] = []
+        cnt_out: List[np.ndarray] = []
+        res_cols: Dict[str, List[np.ndarray]] = {}
+        for e in live:
+            span = [self.panes[p] for p in range(e - ppw, e)
+                    if p in self.panes]
+            if not span:
+                continue
+            union = span[0][0] if len(span) == 1 else np.unique(
+                np.concatenate([s[0] for s in span]))
+            K = len(union)
+            ws = np.zeros((K, S), np.float32)
+            wx = np.full((K, M), _NEG_INF, np.float32)
+            wn = np.full((K, m), _POS_INF, np.float32)
+            wc = np.zeros(K, np.int64)
+            for ck, cs, cx, cn, cc in span:
+                pos = np.searchsorted(union, ck)
+                ws[pos] += cs
+                wx[pos] = np.maximum(wx[pos], cx)
+                wn[pos] = np.minimum(wn[pos], cn)
+                wc[pos] += cc
+            has = wc > 0
+            if not has.any():
+                continue
+            if self._cpu is not None:
+                with jax.default_device(self._cpu):
+                    res = self.agg.finalize(ws[has], wx[has], wn[has],
+                                            wc[has].astype(np.int32))
+            else:
+                res = self.agg.finalize(ws[has], wx[has], wn[has],
+                                        wc[has].astype(np.int32))
+            kk = union[has]
+            keys_out.append(kk)
+            ends_out.append(np.full(len(kk), e, np.int64))
+            cnt_out.append(wc[has])
+            for f, v in res.items():
+                if f == "count":
+                    continue  # the exact element count wins (mirrors
+                    # _decode_packs preferring the i32 count column)
+                res_cols.setdefault(f, []).append(np.asarray(v))
+        if not keys_out:
+            return None
+        end_pane = np.concatenate(ends_out)
+        window_end = end_pane * pane_ms + offset_ms
+        out: Dict[str, np.ndarray] = {
+            "key": np.concatenate(keys_out),
+            "window_start": window_end - size_ms,
+            "window_end": window_end,
+            "count": np.concatenate(cnt_out),
+        }
+        for f, cols in res_cols.items():
+            out[f] = np.concatenate(cols)
+        return out
+
+    # -- lifecycle -------------------------------------------------------
+
+    def purge_below(self, dead_pane: int) -> None:
+        for p in [p for p in self.panes if p < dead_pane]:
+            del self.panes[p]
+
+    @property
+    def key_count(self) -> int:
+        if not self.panes:
+            return 0
+        ks = [t[0] for t in self.panes.values()]
+        return len(np.unique(np.concatenate(ks)))
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "panes": {int(p): tuple(a.copy() for a in t)
+                      for p, t in self.panes.items()},
+            "records_spilled": self.records_spilled,
+        }
+
+    def restore(self, snap: Dict[str, Any]) -> None:
+        self.panes = {int(p): tuple(np.asarray(a) for a in t)
+                      for p, t in snap["panes"].items()}
+        self.records_spilled = int(snap["records_spilled"])
