@@ -145,15 +145,43 @@ class KeyedStream(DataStream):
             return SessionWindowedStream(self, assigner)
         return WindowedStream(self, assigner)
 
-    def count_window(self, size: int) -> "WindowedStream":
-        raise NotImplementedError(
-            "count windows pending; use time windows with CountTrigger")
+    def count_window(self, size: int) -> "CountWindowedStream":
+        """Fires every ``size`` elements per key (ref: KeyedStream.
+        countWindow = GlobalWindows + PurgingTrigger(CountTrigger)).
+        Trigger evaluation is per microbatch — see ops/count_window.py
+        for the documented batching semantics."""
+        return CountWindowedStream(self, size, purge=True)
 
     # keyed reduce without windows = running aggregate over an eternal
     # window; expressible via GlobalWindows + custom trigger (later).
 
 
-class WindowedStream:
+class _AggregateShortcuts:
+    """count/sum/max/min sugar shared by every windowed-stream flavor;
+    each delegates to the subclass's aggregate()."""
+
+    def count(self):
+        from flink_tpu.ops.aggregates import count as count_agg
+
+        return self.aggregate(count_agg())
+
+    def sum(self, field: str):
+        from flink_tpu.ops.aggregates import sum_of
+
+        return self.aggregate(sum_of(field))
+
+    def max(self, field: str):
+        from flink_tpu.ops.aggregates import max_of
+
+        return self.aggregate(max_of(field))
+
+    def min(self, field: str):
+        from flink_tpu.ops.aggregates import min_of
+
+        return self.aggregate(min_of(field))
+
+
+class WindowedStream(_AggregateShortcuts):
     """ref: streaming/api/datastream/WindowedStream.java"""
 
     def __init__(self, keyed: KeyedStream, assigner: WindowAssigner):
@@ -170,10 +198,45 @@ class WindowedStream:
         self._trigger = trigger
         return self
 
+    def _check_trigger(self) -> None:
+        """Validate the trigger/window combination at build time —
+        unsupported combinations must raise, never be silently ignored
+        (ref: WindowedStream.trigger contract)."""
+        from flink_tpu.api.windowing import (
+            CountTrigger, EventTimeTrigger, PurgingTrigger)
+
+        t = self._trigger
+        if t is None or isinstance(t, EventTimeTrigger):
+            return
+        if isinstance(t, PurgingTrigger) and isinstance(
+                t.inner, EventTimeTrigger):
+            # FIRE_AND_PURGE at the watermark: with zero allowed
+            # lateness the window's state is purged at its lateness
+            # horizon — i.e. AT the fire — so the purging wrapper is
+            # exactly the default behavior. With lateness it would
+            # change late-record semantics (fresh state instead of
+            # re-aggregation), which the pane backend doesn't express.
+            if self._lateness == 0:
+                return
+            raise NotImplementedError(
+                "PurgingTrigger(EventTimeTrigger) with allowed lateness "
+                "> 0 is not supported (late records would need "
+                "fresh-state semantics); drop the lateness or the "
+                "purging wrapper")
+        inner = t.inner if isinstance(t, PurgingTrigger) else t
+        if isinstance(inner, CountTrigger):
+            raise NotImplementedError(
+                "count triggers on time windows are not supported; use "
+                "key_by(...).count_window(n) (GlobalWindows + "
+                "CountTrigger, the reference's countWindow lowering)")
+        raise NotImplementedError(
+            f"unsupported trigger {type(t).__name__} for time windows")
+
     def aggregate(self, agg: LaneAggregate, name: str = "window_agg") -> "WindowedAggregateStream":
         """ref: WindowedStream.aggregate(AggregateFunction) — but taking
         the lane-lowered form directly; ``lower_aggregate`` adapts
         reference-style AggregateFunction classes."""
+        self._check_trigger()
         kt = self.keyed.transform
         assert isinstance(kt, KeyByTransformation)
         t = WindowAggregateTransformation(
@@ -183,25 +246,30 @@ class WindowedStream:
         self.keyed.env._register(t)
         return WindowedAggregateStream(self.keyed.env, t)
 
-    def count(self) -> DataStream:
-        from flink_tpu.ops.aggregates import count as count_agg
 
-        return self.aggregate(count_agg())
 
-    def sum(self, field: str) -> DataStream:
-        from flink_tpu.ops.aggregates import sum_of
+class CountWindowedStream(_AggregateShortcuts):
+    """ref: KeyedStream.countWindow — GlobalWindows + (Purging)Count
+    trigger, lowered to the vectorized per-step mask (ops/count_window)."""
 
-        return self.aggregate(sum_of(field))
+    def __init__(self, keyed: KeyedStream, size: int, purge: bool = True):
+        self.keyed = keyed
+        self.size = size
+        self.purge = purge
 
-    def max(self, field: str) -> DataStream:
-        from flink_tpu.ops.aggregates import max_of
+    def aggregate(self, agg: LaneAggregate,
+                  name: str = "count_window_agg") -> DataStream:
+        from flink_tpu.graph.transformations import (
+            CountWindowAggregateTransformation)
 
-        return self.aggregate(max_of(field))
+        kt = self.keyed.transform
+        assert isinstance(kt, KeyByTransformation)
+        t = CountWindowAggregateTransformation(
+            name, (kt,), size=self.size, purge=self.purge,
+            aggregate=agg, key_field=kt.key_field)
+        self.keyed.env._register(t)
+        return DataStream(self.keyed.env, t)
 
-    def min(self, field: str) -> DataStream:
-        from flink_tpu.ops.aggregates import min_of
-
-        return self.aggregate(min_of(field))
 
 
 class WindowedAggregateStream(DataStream):
@@ -232,6 +300,7 @@ class WindowedAggregateStream(DataStream):
 
 class SessionWindowedStream(WindowedStream):
     def aggregate(self, agg: LaneAggregate, name: str = "session_agg") -> DataStream:
+        self._check_trigger()
         kt = self.keyed.transform
         assert isinstance(kt, KeyByTransformation)
         t = SessionAggregateTransformation(

@@ -23,6 +23,7 @@ from flink_tpu.config import Configuration, PipelineOptions, StateOptions
 from flink_tpu.graph.transformations import (
     KeyByTransformation,
     MapTransformation,
+    CountWindowAggregateTransformation,
     SessionAggregateTransformation,
     SinkTransformation,
     SourceTransformation,
@@ -137,6 +138,11 @@ def compile_job(
         elif isinstance(t, WindowAggregateTransformation):
             up = node_for(t.inputs[0])
             n = new_node("window", t.name, window_transform=t,
+                         key_field=t.key_field)
+            nodes[up].downstream.append(n.id)
+        elif isinstance(t, CountWindowAggregateTransformation):
+            up = node_for(t.inputs[0])
+            n = new_node("count_window", t.name, window_transform=t,
                          key_field=t.key_field)
             nodes[up].downstream.append(n.id)
         elif isinstance(t, SessionAggregateTransformation):

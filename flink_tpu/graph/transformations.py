@@ -89,6 +89,18 @@ class WindowAggregateTransformation(Transformation):
 
 
 @dataclasses.dataclass(eq=False)
+class CountWindowAggregateTransformation(Transformation):
+    """Keyed count window (ref: KeyedStream.countWindow = GlobalWindows
+    + PurgingTrigger(CountTrigger(n)); lowered to a vectorized per-step
+    trigger mask — see ops/count_window.py)."""
+
+    size: int = 0
+    purge: bool = True
+    aggregate: Optional[LaneAggregate] = None
+    key_field: str = "key"
+
+
+@dataclasses.dataclass(eq=False)
 class WindowJoinTransformation(Transformation):
     """Two-input tumbling-window equi-join (ref: streaming/api/datastream/
     JoinedStreams.java lowered onto WindowOperator with a union state;

@@ -1,0 +1,256 @@
+"""Count windows: GlobalWindows + Count/Purging trigger, lowered TPU-first.
+
+The reference implements ``countWindow(n)`` as GlobalWindows +
+PurgingTrigger(CountTrigger(n)) — a per-(key, window) trigger count in
+partitioned state, checked on EVERY element (ref: streaming/api/
+datastream/KeyedStream.java countWindow, triggers/CountTrigger.java,
+assigners/GlobalWindows.java). A per-element host check is the opposite
+of what a TPU wants; here the whole microbatch folds into per-key lane
+state with three scatters, and the trigger is a VECTORIZED mask over
+the since-last-fire count lane evaluated once per step, on device.
+Fired rows compact into a packed buffer (count header + rows, the same
+single-transfer shape as the time-window fire path).
+
+Semantics (documented batching tradeoff, same contract as
+CountTrigger's docstring): trigger evaluation happens at microbatch
+boundaries, so a key crossing N within one batch fires ONCE with its
+full accumulated aggregate instead of once per N. Fires are therefore
+deterministic given the batching, and exactly the reference's when
+batch size is 1. As in the reference, GlobalWindows never fires on
+event time — keys holding fewer than N elements at end-of-input emit
+nothing.
+"""
+from __future__ import annotations
+
+import collections
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from flink_tpu.ops.aggregates import LaneAggregate
+from flink_tpu.ops.window import FiredWindows, _next_pow2
+from flink_tpu.state.keyed import KeyDirectory
+from flink_tpu.time.watermarks import LONG_MIN
+
+# GlobalWindow.maxTimestamp() analogue — a finite sentinel end for the
+# eternal window (ref: windowing/windows/GlobalWindow.java)
+GLOBAL_WINDOW_END = np.int64(1) << 62
+
+_NEG_INF = float("-inf")
+_POS_INF = float("inf")
+
+
+class CountWindowOperator:
+    """Keyed count-window aggregation (fires every ``size`` elements).
+
+    ``purge=True`` is ``countWindow(n)`` (PurgingTrigger: window state
+    resets at fire); ``purge=False`` is a bare CountTrigger on
+    GlobalWindows (state keeps accumulating, only the trigger count
+    resets — ref: CountTrigger.onElement clears its ReducingState but
+    not the window contents).
+    """
+
+    def __init__(
+        self,
+        agg: LaneAggregate,
+        size: int,
+        *,
+        purge: bool = True,
+        num_shards: int = 128,
+        slots_per_shard: int = 1024,
+    ) -> None:
+        if size < 1:
+            raise ValueError(f"count window size must be >= 1, got {size}")
+        self.agg = agg
+        self.size = size
+        self.purge = purge
+        self.directory = KeyDirectory(num_shards, slots_per_shard)
+        self.R = num_shards * slots_per_shard
+        R1 = self.R + 1  # + dump row for invalid records
+        self.state = (
+            jnp.zeros((R1, agg.sum_width), jnp.float32),
+            jnp.full((R1, agg.max_width), _NEG_INF, jnp.float32),
+            jnp.full((R1, agg.min_width), _POS_INF, jnp.float32),
+            jnp.zeros((R1,), jnp.int32),   # total count (finalize input)
+            jnp.zeros((R1,), jnp.int32),   # since-last-fire (trigger)
+        )
+        self.watermark = LONG_MIN
+        self.late_records = 0
+        self.records_dropped_full = 0
+        self._pending: collections.deque = collections.deque()
+        res = agg.finalize(
+            np.zeros((0, agg.sum_width), np.float32),
+            np.zeros((0, agg.max_width), np.float32),
+            np.zeros((0, agg.min_width), np.float32),
+            np.zeros((0,), np.int32))
+        self._res_fields = sorted(res)
+        self._res_is_int = {
+            k: np.issubdtype(np.asarray(res[k]).dtype, np.integer)
+            for k in res}
+        self._step = self._build_step()
+        self._empty_cache: Optional[Dict[str, np.ndarray]] = None
+
+    # -- device step -----------------------------------------------------
+
+    def _build_step(self):
+        agg, R, N, purge = self.agg, self.R, self.size, self.purge
+        fields = self._res_fields
+        is_int = self._res_is_int
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(state, slots, valid, data):
+            sums, maxs, mins, counts, since = state
+            s_l, x_l, n_l = agg.lift_masked(data, valid)
+            sums = sums.at[slots].add(s_l)
+            maxs = maxs.at[slots].max(x_l)
+            mins = mins.at[slots].min(n_l)
+            inc = valid.astype(jnp.int32)
+            counts = counts.at[slots].add(inc)
+            since = since.at[slots].add(inc)
+            fired = jnp.arange(R + 1) < R
+            fired = fired & (since >= N)
+            # finalize every row (cheap: R rows, fully vectorized on
+            # device), then compact the fired ones into a packed buffer
+            res = agg.finalize(sums, maxs, mins, counts)
+            cols = [jnp.arange(R + 1, dtype=jnp.int32), counts]
+            for f in fields:
+                v = res[f]
+                cols.append(v.astype(jnp.int32) if is_int[f]
+                            else lax.bitcast_convert_type(
+                                v.astype(jnp.float32), jnp.int32))
+            mat = jnp.stack(cols, axis=1)
+            pos = jnp.cumsum(fired.astype(jnp.int32))
+            idx = jnp.where(fired, pos, R + 1)          # dump to last row
+            buf = jnp.zeros((R + 2, mat.shape[1]), jnp.int32)
+            buf = buf.at[0, 0].set(pos[-1])
+            buf = buf.at[idx].set(mat)
+            if purge:
+                f2 = fired[:, None]
+                sums = jnp.where(f2, 0.0, sums)
+                maxs = jnp.where(f2, _NEG_INF, maxs)
+                mins = jnp.where(f2, _POS_INF, mins)
+                counts = jnp.where(fired, 0, counts)
+            since = jnp.where(fired, 0, since)
+            return (sums, maxs, mins, counts, since), buf
+
+        return step
+
+    # -- data plane ------------------------------------------------------
+
+    def process_batch(
+        self,
+        keys: np.ndarray,
+        ts: np.ndarray,
+        data: Dict[str, np.ndarray],
+        valid: Optional[np.ndarray] = None,
+    ) -> None:
+        keys = np.asarray(keys, dtype=np.int64)
+        b = len(keys)
+        valid = np.ones(b, bool) if valid is None else np.asarray(valid, bool)
+        slots = self.directory.assign(keys)
+        bad = valid & (slots < 0)
+        if bad.any():
+            self.records_dropped_full += int(bad.sum())
+            valid = valid & ~bad
+        slots = np.where(valid, slots, self.R).astype(np.int32)
+        if self.agg.fields is not None:
+            data = {k: data[k] for k in self.agg.fields}
+        # pow2-bucket the batch so each size compiles once
+        target = _next_pow2(max(b, 1))
+        if target != b:
+            pad = target - b
+            slots = np.concatenate([slots, np.full(pad, self.R, np.int32)])
+            valid = np.concatenate([valid, np.zeros(pad, bool)])
+            data = {k: np.concatenate(
+                [np.asarray(v),
+                 np.zeros((pad,) + np.asarray(v).shape[1:],
+                          np.asarray(v).dtype)]) for k, v in data.items()}
+        self.state, buf = self._step(
+            self.state, jnp.asarray(slots), jnp.asarray(valid),
+            {k: jnp.asarray(v) for k, v in data.items()})
+        buf.copy_to_host_async()
+        self._pending.append(buf)
+
+    def take_fired(self) -> Optional[FiredWindows]:
+        """The fires produced by the batches pushed since the last take,
+        as a lazy FiredWindows (the driver emits this right after
+        process_batch — count fires are per-step, not per-watermark)."""
+        if not self._pending:
+            return None
+        bufs = list(self._pending)
+        self._pending.clear()
+        return FiredWindows(fetch=lambda: self._decode(bufs))
+
+    def _decode(self, bufs: List[jax.Array]) -> Dict[str, np.ndarray]:
+        segs = []
+        for buf in bufs:
+            arr = np.asarray(buf)
+            n = int(arr[0, 0])
+            if n:
+                segs.append(arr[1:1 + n])
+        if segs:
+            body = np.concatenate(segs)
+        else:
+            body = np.zeros((0, 2 + len(self._res_fields)), np.int32)
+        nrec = len(body)
+        out: Dict[str, np.ndarray] = {
+            "key": self.directory.key_of_slots(body[:, 0].astype(np.int64)),
+            "window_start": np.zeros(nrec, np.int64),
+            "window_end": np.full(nrec, GLOBAL_WINDOW_END, np.int64),
+            "count": body[:, 1],
+        }
+        for i, f in enumerate(self._res_fields):
+            if f == "count":
+                continue
+            col = np.ascontiguousarray(body[:, 2 + i])
+            out[f] = col if self._res_is_int[f] else col.view(np.float32)
+        return out
+
+    # -- time plane (count windows are event-time-blind) -----------------
+
+    def advance_watermark(self, wm: int) -> FiredWindows:
+        if wm > self.watermark:
+            self.watermark = wm
+        if self._empty_cache is None:
+            from flink_tpu.ops.window import _empty_fired
+            self._empty_cache = _empty_fired(self.agg)
+        return FiredWindows(data=dict(self._empty_cache))
+
+    def final_watermark(self) -> int:
+        # GlobalWindows never completes: no end-of-input flush (ref:
+        # GlobalWindows' default NeverTrigger behavior for non-count
+        # firing) — partial groups emit nothing, like the reference
+        return self.watermark
+
+    def quiesce(self) -> None:
+        jax.block_until_ready(self.state[3])
+
+    def throttle(self) -> None:  # driver-loop protocol compatibility
+        pass
+
+    # -- snapshot seam ----------------------------------------------------
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        return {
+            "kind": "count_window",
+            "arrays": tuple(np.asarray(a) for a in self.state),
+            "directory": self.directory.snapshot(),
+            "watermark": self.watermark,
+            "late_records": self.late_records,
+            "records_dropped_full": self.records_dropped_full,
+        }
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        self.state = tuple(jnp.asarray(a) for a in snap["arrays"])
+        self.directory = KeyDirectory.restore(
+            self.directory.num_shards, self.directory.slots_per_shard,
+            snap["directory"],
+            (self.directory.shard_lo, self.directory.shard_hi))
+        self.watermark = snap["watermark"]
+        self.late_records = snap["late_records"]
+        self.records_dropped_full = snap.get("records_dropped_full", 0)
+        self._pending.clear()

@@ -169,6 +169,17 @@ class Driver:
                 # ingest loop calls throttle() after releasing it), so
                 # drain deliveries never queue behind a transfer wait
                 self._ops[n.id].external_throttle = True
+            elif n.kind == "count_window":
+                from flink_tpu.ops.count_window import CountWindowOperator
+
+                if self.mesh_plan is not None:
+                    raise NotImplementedError(
+                        "count windows on a device mesh are not yet "
+                        "supported; run without cluster.mesh-devices")
+                t = n.window_transform
+                self._ops[n.id] = CountWindowOperator(
+                    t.aggregate, t.size, purge=t.purge,
+                    num_shards=num_shards, slots_per_shard=slots)
             elif n.kind == "session":
                 from flink_tpu.ops.session import SessionOperator
 
@@ -492,12 +503,17 @@ class Driver:
             self._push_downstream(nid, (data, ts, valid))
         elif n.kind == "union":
             self._push_downstream(nid, batch)
-        elif n.kind == "window" or n.kind == "session":
+        elif n.kind in ("window", "session", "count_window"):
             op = self._ops[nid]
             keys = np.asarray(data[n.key_field], np.int64)
             dev_data = {k: v for k, v in data.items()
                         if np.asarray(v).dtype != object}
             op.process_batch(keys, ts, dev_data, valid)
+            if n.kind == "count_window":
+                # count fires are per-step, not per-watermark
+                fired = op.take_fired()
+                if fired is not None:
+                    self._emit_fired(nid, fired)
         elif n.kind == "join":
             op = self._ops[nid]
             t = n.window_transform
@@ -527,6 +543,9 @@ class Driver:
                 continue
             ups = self._upstream[nid]
             in_wm = min(self._out_wm[u] for u in ups) if ups else LONG_MIN
+            # count_window is deliberately absent: it is event-time-blind
+            # (fires ride process_batch), so advancing it would only
+            # queue guaranteed-empty fires through the drain
             if n.kind in ("window", "session", "join"):
                 op = self._ops[nid]
                 wm = in_wm
@@ -578,7 +597,7 @@ class Driver:
                     continue
                 seen.add(d)
                 k = self.plan.node(d).kind
-                if k in ("window", "session", "join"):
+                if k in ("window", "session", "join", "count_window"):
                     ok = False
                     break
                 stack.extend(self.plan.node(d).downstream)

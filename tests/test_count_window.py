@@ -1,0 +1,197 @@
+"""Count windows + trigger family (ref: WindowOperatorTest count/purging
+trigger cases, KeyedStream.countWindow). Semantics under test are the
+documented microbatch-boundary ones: a key crossing N inside one batch
+fires once with its full accumulated aggregate; with batch size 1 the
+behavior equals the reference's exact every-Nth-element firing."""
+import numpy as np
+import pytest
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.api.sinks import CollectSink
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.api.windowing import (
+    CountTrigger, EventTimeTrigger, PurgingTrigger, TumblingEventTimeWindows)
+from flink_tpu.config import Configuration
+from flink_tpu.ops import aggregates
+from flink_tpu.ops.count_window import GLOBAL_WINDOW_END, CountWindowOperator
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+
+def make_env(extra=None):
+    conf = {"state.num-key-shards": 4, "state.slots-per-shard": 32,
+            "pipeline.microbatch-size": 64}
+    conf.update(extra or {})
+    return StreamExecutionEnvironment(Configuration(conf))
+
+
+def single_record_source(keys, values):
+    """One record per batch — exact reference semantics territory."""
+    def gen(split, i):
+        if i >= len(keys):
+            return None
+        return ({"k": np.array([keys[i]], np.int64),
+                 "v": np.array([values[i]], np.int64)},
+                np.array([i * 10], np.int64))
+    return gen
+
+
+class TestCountWindowE2E:
+    def test_fires_every_n_exact_reference_semantics(self):
+        """Batch size 1: countWindow(3) fires at the 3rd, 6th... element
+        per key with the purged (per-window) sum — the reference's exact
+        behavior (ref: CountTrigger.onElement + PurgingTrigger)."""
+        keys = [7, 7, 9, 7, 9, 9, 7, 7, 7]
+        vals = [1, 2, 10, 3, 20, 30, 4, 5, 6]
+        env = make_env()
+        sink = CollectSink()
+        (env.from_source(GeneratorSource(single_record_source(keys, vals)),
+                         WatermarkStrategy.for_monotonous_timestamps())
+         .key_by("k")
+         .count_window(3)
+         .sum("v")
+         .add_sink(sink))
+        env.execute("cw")
+        got = [(int(r["key"]), float(r["sum_v"]), int(r["count"]))
+               for r in sink.rows]
+        assert got == [(7, 6.0, 3), (9, 60.0, 3), (7, 15.0, 3)]
+        # partial group (none left: key 7 fired twice at 6 elements,
+        # key 9 once at 3) — nothing else emitted
+        assert all(int(r["window_end"]) == GLOBAL_WINDOW_END
+                   for r in sink.rows)
+
+    def test_incomplete_groups_emit_nothing_at_end(self):
+        """GlobalWindows never completes: keys below N at end-of-input
+        emit nothing (reference behavior)."""
+        env = make_env()
+        sink = CollectSink()
+        (env.from_source(
+            GeneratorSource(single_record_source([1, 1, 2], [5, 6, 7])),
+            WatermarkStrategy.for_monotonous_timestamps())
+         .key_by("k")
+         .count_window(3)
+         .count()
+         .add_sink(sink))
+        env.execute("cw-partial")
+        assert sink.rows == [] or all(len(np.atleast_1d(
+            list(r.values())[0])) == 0 for r in sink.rows)
+
+    def test_batched_crossing_fires_once_with_full_aggregate(self):
+        """A key receiving 2N elements within ONE microbatch fires once
+        with all of them — the documented batching tradeoff."""
+        def gen(split, i):
+            if i >= 1:
+                return None
+            return ({"k": np.zeros(7, np.int64),
+                     "v": np.arange(1, 8, dtype=np.int64)},
+                    np.arange(7, dtype=np.int64) * 10)
+
+        env = make_env()
+        sink = CollectSink()
+        (env.from_source(GeneratorSource(gen),
+                         WatermarkStrategy.for_monotonous_timestamps())
+         .key_by("k").count_window(3).sum("v").add_sink(sink))
+        env.execute("cw-batched")
+        got = [(float(r["sum_v"]), int(r["count"])) for r in sink.rows]
+        assert got == [(28.0, 7)]  # one fire, full batch accumulated
+
+
+    def test_count_window_downstream_of_time_window_is_stateful(self):
+        """A count window fed by a time window's fires must run on the
+        ingest thread (stateful-downstream rule), not the async drain —
+        and produce correct two-stage results (regression: the
+        stateless-downstream check omitted count_window)."""
+        def gen(split, i):
+            if i >= 6:
+                return None
+            return ({"k": np.array([1, 1, 2], np.int64)},
+                    np.full(3, i * 1000 + 500, np.int64))
+
+        env = make_env()
+        sink = CollectSink()
+        (env.from_source(GeneratorSource(gen),
+                         WatermarkStrategy.for_monotonous_timestamps())
+         .key_by("k")
+         .window(TumblingEventTimeWindows.of(1_000))
+         .count()                      # per (key, second): k1=2, k2=1
+         .key_by("key")
+         .count_window(3)
+         .sum("count")
+         .add_sink(sink))
+        env.execute("two-stage")
+        got = sorted((int(r["key"]), float(r["sum_count"]))
+                     for r in sink.rows)
+        # 6 windows per key; count_window(3) fires twice per key with
+        # 3 window-counts summed each time
+        assert got == [(1, 6.0), (1, 6.0), (2, 3.0), (2, 3.0)]
+
+
+class TestTriggerValidation:
+    def test_count_trigger_on_time_window_raises(self):
+        env = make_env()
+        s = (env.from_source(
+            GeneratorSource(single_record_source([1], [1])),
+            WatermarkStrategy.for_monotonous_timestamps())
+            .key_by("k")
+            .window(TumblingEventTimeWindows.of(1_000))
+            .trigger(CountTrigger.of(5)))
+        with pytest.raises(NotImplementedError, match="count_window"):
+            s.count()
+
+    def test_purging_event_time_ok_without_lateness(self):
+        env = make_env()
+        sink = CollectSink()
+        (env.from_source(
+            GeneratorSource(single_record_source([1, 1], [1, 2])),
+            WatermarkStrategy.for_monotonous_timestamps())
+         .key_by("k")
+         .window(TumblingEventTimeWindows.of(1_000))
+         .trigger(PurgingTrigger.of(EventTimeTrigger.create()))
+         .count()
+         .add_sink(sink))
+        env.execute("purging-ok")
+        assert sum(int(r["count"]) for r in sink.rows) == 2
+
+    def test_purging_event_time_with_lateness_raises(self):
+        env = make_env()
+        s = (env.from_source(
+            GeneratorSource(single_record_source([1], [1])),
+            WatermarkStrategy.for_monotonous_timestamps())
+            .key_by("k")
+            .window(TumblingEventTimeWindows.of(1_000))
+            .allowed_lateness(5_000)
+            .trigger(PurgingTrigger.of(EventTimeTrigger.create())))
+        with pytest.raises(NotImplementedError, match="lateness"):
+            s.count()
+
+
+class TestCountWindowOperator:
+    def test_non_purging_accumulates_across_fires(self):
+        """Bare CountTrigger (no purge): window contents accumulate;
+        only the trigger count resets (ref: CountTrigger clears its own
+        ReducingState, not the window state)."""
+        op = CountWindowOperator(aggregates.sum_of("v"), 2, purge=False,
+                                 num_shards=2, slots_per_shard=8)
+        for vals in ([1, 2], [3, 4]):
+            op.process_batch(np.zeros(2, np.int64),
+                             np.zeros(2, np.int64),
+                             {"v": np.array(vals, np.int64)})
+        fired = op.take_fired().materialize()
+        sums = [float(v) for v in fired["sum_v"]]
+        assert sums == [3.0, 10.0]  # 1+2 then 1+2+3+4
+
+    def test_snapshot_restore_roundtrip(self):
+        def mk():
+            return CountWindowOperator(aggregates.count(), 3,
+                                       num_shards=2, slots_per_shard=8)
+
+        a = mk()
+        a.process_batch(np.array([4, 4], np.int64),
+                        np.zeros(2, np.int64), {})
+        a.take_fired()
+        snap = a.snapshot_state()
+        b = mk()
+        b.restore_state(snap)
+        b.process_batch(np.array([4], np.int64), np.zeros(1, np.int64), {})
+        fired = b.take_fired().materialize()
+        assert [int(k) for k in fired["key"]] == [4]
+        assert [int(c) for c in fired["count"]] == [3]
