@@ -109,6 +109,12 @@ class DataStream:
         self.env._register(t)
         return KeyedStream(self.env, t)
 
+    def window_all(self, assigner: WindowAssigner) -> "AllWindowedStream":
+        """Global (non-keyed) window over ALL records (ref: DataStream.
+        windowAll → AllWindowedStream). Lowered without the reference's
+        parallelism-1 funnel — see ops/window_all.py."""
+        return AllWindowedStream(self, assigner)
+
     # -- joins -----------------------------------------------------------
     def join(self, other: "DataStream") -> "JoinBuilder":
         """ref: DataStream.join → JoinedStreams (where/equalTo/window)."""
@@ -246,6 +252,30 @@ class WindowedStream(_AggregateShortcuts):
         self.keyed.env._register(t)
         return WindowedAggregateStream(self.keyed.env, t)
 
+
+
+class AllWindowedStream(_AggregateShortcuts):
+    """ref: streaming/api/datastream/AllWindowedStream.java"""
+
+    def __init__(self, stream: DataStream, assigner: WindowAssigner):
+        self.stream = stream
+        self.assigner = assigner
+        self._lateness = 0
+
+    def allowed_lateness(self, ms: int) -> "AllWindowedStream":
+        self._lateness = ms
+        return self
+
+    def aggregate(self, agg: LaneAggregate,
+                  name: str = "window_all_agg") -> DataStream:
+        from flink_tpu.graph.transformations import (
+            WindowAllAggregateTransformation)
+
+        t = WindowAllAggregateTransformation(
+            name, (self.stream.transform,), assigner=self.assigner,
+            aggregate=agg, allowed_lateness_ms=self._lateness)
+        self.stream.env._register(t)
+        return DataStream(self.stream.env, t)
 
 
 class CountWindowedStream(_AggregateShortcuts):

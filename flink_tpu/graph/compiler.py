@@ -25,6 +25,7 @@ from flink_tpu.graph.transformations import (
     MapTransformation,
     CountWindowAggregateTransformation,
     SessionAggregateTransformation,
+    WindowAllAggregateTransformation,
     SinkTransformation,
     SourceTransformation,
     Transformation,
@@ -139,6 +140,10 @@ def compile_job(
             up = node_for(t.inputs[0])
             n = new_node("window", t.name, window_transform=t,
                          key_field=t.key_field)
+            nodes[up].downstream.append(n.id)
+        elif isinstance(t, WindowAllAggregateTransformation):
+            up = node_for(t.inputs[0])
+            n = new_node("window_all", t.name, window_transform=t)
             nodes[up].downstream.append(n.id)
         elif isinstance(t, CountWindowAggregateTransformation):
             up = node_for(t.inputs[0])

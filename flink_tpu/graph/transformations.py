@@ -89,6 +89,17 @@ class WindowAggregateTransformation(Transformation):
 
 
 @dataclasses.dataclass(eq=False)
+class WindowAllAggregateTransformation(Transformation):
+    """Non-keyed global window + aggregate (ref: DataStream.windowAll →
+    AllWindowedStream at parallelism 1; here a host-side pane reduce
+    with NO single-shard funnel — see ops/window_all.py)."""
+
+    assigner: Optional[WindowAssigner] = None
+    aggregate: Optional[LaneAggregate] = None
+    allowed_lateness_ms: int = 0
+
+
+@dataclasses.dataclass(eq=False)
 class CountWindowAggregateTransformation(Transformation):
     """Keyed count window (ref: KeyedStream.countWindow = GlobalWindows
     + PurgingTrigger(CountTrigger(n)); lowered to a vectorized per-step

@@ -8,8 +8,6 @@ from __future__ import annotations
 
 from typing import Optional
 
-import numpy as np
-
 from flink_tpu.api.datastream import DataStream
 from flink_tpu.api.environment import StreamExecutionEnvironment
 from flink_tpu.api.sinks import Sink
@@ -64,15 +62,14 @@ def q7_highest_bid(
     window_ms: int = 10_000,
     out_of_orderness_ms: int = 0,
 ) -> DataStream:
-    """Q7: highest bid per tumbling window (global reduce — a constant
-    key routes all records to one key shard, the reference's
-    windowAll/global reduce shape)."""
+    """Q7: highest bid per tumbling window — the windowAll/global reduce
+    shape, WITHOUT the reference's parallelism-1 funnel: the global max
+    folds per pane host-side (see ops/window_all.py for the measured
+    bandwidth rationale), so no key shard or device is a hotspot."""
     stream = env.from_source(
         bids, WatermarkStrategy.for_bounded_out_of_orderness(out_of_orderness_ms))
     out = (
-        stream.map(lambda d: {**d, "__g__": np.zeros(len(d["price"]), np.int64)})
-        .key_by("__g__")
-        .window(TumblingEventTimeWindows.of(window_ms))
+        stream.window_all(TumblingEventTimeWindows.of(window_ms))
         .max("price")
     )
     out.add_sink(sink)

@@ -462,6 +462,63 @@ class WindowPlan:
             e += pps
         return out
 
+    # -- shared host control-plane math (keyed WindowOperator and the
+    # global WindowAllOperator both fire with EXACTLY these rules; any
+    # semantic fix lands here once) --------------------------------------
+
+    def late_refire_ends(self, late_panes: np.ndarray,
+                         fired_below_end: int, wm: int) -> List[int]:
+        """Ends of already-fired, still-live windows that a late-within-
+        lateness record in ``late_panes`` must re-fire (ref:
+        EventTimeTrigger.onElement fires immediately for late elements;
+        isWindowLate skips dead windows)."""
+        out: List[int] = []
+        pps, ppw = self.panes_per_slide, self.panes_per_window
+        for p in np.unique(late_panes).tolist():
+            # windows containing pane p end at (p//pps)*pps + ppw,
+            # stepping down by pps while > p
+            e = (p // pps) * pps + ppw
+            while e > p:
+                if e <= fired_below_end and not self.window_dead(e, wm):
+                    out.append(int(e))
+                e -= pps
+        return out
+
+    def fire_frontier(self, wm: int) -> int:
+        """Highest slide-aligned end pane the watermark has passed — the
+        fired frontier late records compare against."""
+        pps, ppw = self.panes_per_slide, self.panes_per_window
+        m = (wm + 1 - self.offset_ms) // self.pane_ms
+        return m - ((m - ppw) % pps)
+
+    def last_data_end_ms(self, max_pane_seen: int) -> int:
+        """End time (ms) of the last window that can contain data."""
+        pps = self.panes_per_slide
+        last_end = (max_pane_seen // pps) * pps + self.panes_per_window
+        return last_end * self.pane_ms + self.offset_ms
+
+    def enumerate_fire_ends(self, prev_wm: int, wm: int,
+                            min_pane_seen: Optional[int],
+                            max_pane_seen: Optional[int]) -> List[int]:
+        """First-time fireable end panes for a prev_wm → wm advance,
+        clamped to windows that can contain data (a big idle jump must
+        not enumerate provably-empty windows)."""
+        if max_pane_seen is None:
+            return []
+        ends_wm = min(wm, self.last_data_end_ms(max_pane_seen) - 1)
+        if prev_wm != LONG_MIN and prev_wm >= ends_wm:
+            return []
+        return self.fireable_end_panes(prev_wm, ends_wm, min_pane_seen)
+
+    def final_watermark_for(self, watermark: int,
+                            max_pane_seen: Optional[int]) -> int:
+        """Watermark completing (and purging) every window that can hold
+        data — the end-of-input flush point."""
+        if max_pane_seen is None:
+            return watermark if watermark != LONG_MIN else 0
+        return (self.last_data_end_ms(max_pane_seen)
+                + self.allowed_lateness_ms + 1)
+
 
 # ---------------------------------------------------------------------------
 # Host-side operator runtime (single shard range; the sharded pipeline in
@@ -845,19 +902,8 @@ class WindowOperator:
         if self._fired_below_end is not None:
             late_ok = valid & (panes < self._fired_below_end)
             if late_ok.any():
-                pps = self.plan.panes_per_slide
-                ppw = self.plan.panes_per_window
-                for p in np.unique(panes[late_ok]).tolist():
-                    # windows containing pane p start at pps-multiples in
-                    # (p-ppw, p], so ends are (p//pps)*pps + ppw stepping
-                    # down by pps while > p; skip windows already beyond
-                    # allowed lateness (ref: isWindowLate skips the window,
-                    # element still feeds its remaining live windows)
-                    e = (p // pps) * pps + ppw
-                    while e > p:
-                        if e <= self._fired_below_end and not self.plan.window_dead(e, self.watermark):
-                            self._refire.add(int(e))
-                        e -= pps
+                self._refire.update(self.plan.late_refire_ends(
+                    panes[late_ok], self._fired_below_end, self.watermark))
 
         t1 = time.perf_counter()
         self.prof["pb_host_pre"] += t1 - t0
@@ -1087,27 +1133,15 @@ class WindowOperator:
         prev = self.watermark
         self.watermark = wm
 
-        if self._max_pane_seen is None:
-            ends: List[int] = []
-        else:
-            # clamp the fire scan to windows that can contain data — a
-            # large watermark jump (idle gap, end-of-input flush) must
-            # not enumerate millions of provably-empty windows
-            ends_wm = min(wm, self._last_data_end_ms() - 1)
-            if prev != LONG_MIN and prev >= ends_wm:
-                ends = []
-            else:
-                ends = self.plan.fireable_end_panes(prev, ends_wm, self._min_pane_seen)
-        ends = sorted(set(ends) | self._refire)
+        ends = sorted(set(self.plan.enumerate_fire_ends(
+            prev, wm, self._min_pane_seen, self._max_pane_seen))
+            | self._refire)
         # the fired frontier must track the WATERMARK, not just enumerated
         # ends: a late-within-lateness record landing in any window the
         # watermark already passed (fired or empty-skipped) must trigger
         # an immediate late firing (ref: EventTimeTrigger.onElement FIREs
         # when window.maxTimestamp() <= currentWatermark)
-        pps = self.plan.panes_per_slide
-        ppw = self.plan.panes_per_window
-        m = (wm + 1 - self.plan.offset_ms) // self.plan.pane_ms
-        frontier = m - ((m - ppw) % pps)
+        frontier = self.plan.fire_frontier(wm)
         if self._fired_below_end is None or frontier > self._fired_below_end:
             self._fired_below_end = frontier
         self._refire.clear()
@@ -1407,19 +1441,13 @@ class WindowOperator:
         return rows - rows // self.layout.rows
 
     def _last_data_end_ms(self) -> int:
-        """End time (ms) of the last window that can contain data (the
-        final window covering ``_max_pane_seen``)."""
-        pps = self.plan.panes_per_slide
-        last_end = (self._max_pane_seen // pps) * pps + self.plan.panes_per_window
-        return last_end * self.plan.pane_ms + self.plan.offset_ms
+        return self.plan.last_data_end_ms(self._max_pane_seen)
 
     def final_watermark(self) -> int:
-        """Watermark that completes (and purges) every window that can
-        hold data — the end-of-input flush point (ref role: advancing to
-        Watermark.MAX_WATERMARK on input end, kept finite here)."""
-        if self._max_pane_seen is None:
-            return self.watermark if self.watermark != LONG_MIN else 0
-        return self._last_data_end_ms() + self.plan.allowed_lateness_ms + 1
+        """ref role: advancing to Watermark.MAX_WATERMARK on input end,
+        kept finite here — see WindowPlan.final_watermark_for."""
+        return self.plan.final_watermark_for(
+            self.watermark, self._max_pane_seen)
 
     def _empty(self) -> "FiredWindows":
         """Cached empty fired-batch (a fresh one would dispatch tiny

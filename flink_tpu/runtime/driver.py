@@ -169,6 +169,14 @@ class Driver:
                 # ingest loop calls throttle() after releasing it), so
                 # drain deliveries never queue behind a transfer wait
                 self._ops[n.id].external_throttle = True
+            elif n.kind == "window_all":
+                from flink_tpu.ops.window_all import WindowAllOperator
+
+                t = n.window_transform
+                self._ops[n.id] = WindowAllOperator(
+                    t.assigner, t.aggregate,
+                    allowed_lateness_ms=t.allowed_lateness_ms,
+                    max_out_of_orderness_ms=max(wm.max_out_of_orderness_ms, 0))
             elif n.kind == "count_window":
                 from flink_tpu.ops.count_window import CountWindowOperator
 
@@ -503,6 +511,11 @@ class Driver:
             self._push_downstream(nid, (data, ts, valid))
         elif n.kind == "union":
             self._push_downstream(nid, batch)
+        elif n.kind == "window_all":
+            op = self._ops[nid]
+            dev_data = {k: v for k, v in data.items()
+                        if np.asarray(v).dtype != object}
+            op.process_batch(ts, dev_data, valid)
         elif n.kind in ("window", "session", "count_window"):
             op = self._ops[nid]
             keys = np.asarray(data[n.key_field], np.int64)
@@ -546,7 +559,7 @@ class Driver:
             # count_window is deliberately absent: it is event-time-blind
             # (fires ride process_batch), so advancing it would only
             # queue guaranteed-empty fires through the drain
-            if n.kind in ("window", "session", "join"):
+            if n.kind in ("window", "session", "join", "window_all"):
                 op = self._ops[nid]
                 wm = in_wm
                 if in_wm == _FINAL:
@@ -573,7 +586,8 @@ class Driver:
 
     def _emit_fired_sync(self, nid: int, fired, stamp: float) -> None:
         out = dict(fired)
-        nrec = len(out.get("key", ()))
+        nrec = len(out.get("window_end", ()))  # every fired schema has it
+        # (keyed rows also carry "key"; windowAll rows deliberately don't)
         if nrec == 0:
             return
         self.metrics["fired_windows"] += nrec
@@ -597,7 +611,8 @@ class Driver:
                     continue
                 seen.add(d)
                 k = self.plan.node(d).kind
-                if k in ("window", "session", "join", "count_window"):
+                if k in ("window", "session", "join", "count_window",
+                         "window_all"):
                     ok = False
                     break
                 stack.extend(self.plan.node(d).downstream)

@@ -1,0 +1,114 @@
+"""windowAll / global windowed aggregation (ref: AllWindowedStream at
+parallelism 1 — here a host pane reduce with no funnel; the Q7 shape)."""
+import numpy as np
+import pytest
+import jax
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.api.sinks import CollectSink
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.api.windowing import SlidingEventTimeWindows, TumblingEventTimeWindows
+from flink_tpu.config import Configuration
+from flink_tpu.ops import aggregates
+from flink_tpu.ops.window_all import WindowAllOperator
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+
+def make_env(extra=None):
+    conf = {"pipeline.microbatch-size": 256}
+    conf.update(extra or {})
+    return StreamExecutionEnvironment(Configuration(conf))
+
+
+def source(n_batches=6, b=200):
+    def gen(split, i):
+        if i >= n_batches:
+            return None
+        rng = np.random.default_rng(3 + i)
+        return ({"v": rng.integers(1, 1000, b).astype(np.int64)},
+                np.sort(rng.integers(i * 700, i * 700 + 1400, b)).astype(np.int64))
+    return gen
+
+
+class TestWindowAllE2E:
+    def test_global_max_golden(self):
+        env = make_env()
+        sink = CollectSink()
+        (env.from_source(GeneratorSource(source()),
+                         WatermarkStrategy.for_bounded_out_of_orderness(800))
+         .window_all(TumblingEventTimeWindows.of(1_000))
+         .max("v")
+         .add_sink(sink))
+        env.execute("wa-max")
+        want = {}
+        for i in range(6):
+            rng = np.random.default_rng(3 + i)
+            v = rng.integers(1, 1000, 200)
+            ts = np.sort(rng.integers(i * 700, i * 700 + 1400, 200))
+            for vv, t in zip(v, ts):
+                w = (int(t) // 1000) * 1000 + 1000
+                want[w] = max(want.get(w, 0), int(vv))
+        got = {int(r["window_end"]): float(r["max_v"]) for r in sink.rows}
+        assert got == {w: float(m) for w, m in want.items()}
+
+    def test_mesh_mode_no_hotspot_same_results(self):
+        """windowAll on a mesh env must produce identical results — there
+        is no keyed exchange, so no device can be a hotspot (the round-2
+        Q7 funnel weakness)."""
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8-device mesh")
+        res = {}
+        for mesh in (None, "all"):
+            env = make_env({"cluster.mesh-devices": mesh} if mesh else None)
+            sink = CollectSink()
+            (env.from_source(GeneratorSource(source()),
+                             WatermarkStrategy.for_bounded_out_of_orderness(800))
+             .window_all(SlidingEventTimeWindows.of(2_000, 1_000))
+             .sum("v")
+             .add_sink(sink))
+            env.execute(f"wa-{mesh}")
+            res[mesh] = sorted(
+                (int(r["window_end"]), float(r["sum_v"])) for r in sink.rows)
+        assert res[None] == res["all"]
+
+    def test_late_within_lateness_refires(self):
+        op = WindowAllOperator(
+            TumblingEventTimeWindows.of(1_000), aggregates.max_of("v"),
+            allowed_lateness_ms=5_000)
+        op.process_batch(np.array([500], np.int64),
+                         {"v": np.array([10.0], np.float32)})
+        f1 = dict(op.advance_watermark(1_500))
+        assert [float(v) for v in f1["max_v"]] == [10.0]
+        # late-but-allowed record raises the max -> window refires
+        op.process_batch(np.array([600], np.int64),
+                         {"v": np.array([99.0], np.float32)})
+        f2 = dict(op.advance_watermark(1_500))
+        assert [float(v) for v in f2["max_v"]] == [99.0]
+        # beyond-lateness record is dropped and counted
+        op.advance_watermark(20_000)
+        op.process_batch(np.array([100], np.int64),
+                         {"v": np.array([1000.0], np.float32)})
+        assert op.late_records == 1
+
+    def test_snapshot_restore_roundtrip(self):
+        def mk():
+            return WindowAllOperator(
+                TumblingEventTimeWindows.of(1_000), aggregates.avg_of("v"))
+
+        straight = mk()
+        straight.process_batch(np.array([100], np.int64),
+                               {"v": np.array([4.0], np.float32)})
+        straight.process_batch(np.array([700], np.int64),
+                               {"v": np.array([8.0], np.float32)})
+        want = dict(straight.advance_watermark(2_000))
+
+        a = mk()
+        a.process_batch(np.array([100], np.int64),
+                        {"v": np.array([4.0], np.float32)})
+        b = mk()
+        b.restore_state(a.snapshot_state())
+        b.process_batch(np.array([700], np.int64),
+                        {"v": np.array([8.0], np.float32)})
+        got = dict(b.advance_watermark(2_000))
+        assert [float(v) for v in got["avg_v"]] == \
+            [float(v) for v in want["avg_v"]]
