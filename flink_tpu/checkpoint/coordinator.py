@@ -10,14 +10,90 @@ checkpoint is: freeze (source positions, per-operator state snapshots,
 watermarks), upload, mark complete, notify sinks to commit their staged
 epoch. Exactly-once = replayable sources (positions) + state rollback +
 transactional sinks.
+
+Asynchrony (the HeapSnapshotStrategy async-part analogue, SURVEY §6.4):
+the in-loop part of a checkpoint is only the FREEZE — sink staging plus
+per-operator snapshots whose device leaves are dispatched on-device
+clones (no device→host transfer, no serialization). The expensive part
+— fetching the clones to host, pickling, writing, fsync — runs on a
+background thread via ``trigger_async``; the 2PC commit happens only
+after the manifest is durable, applied back on the loop thread when it
+polls ``PendingCheckpoint`` (the asynchronous notifyCheckpointComplete
+of the reference). Ingest never waits on storage.
 """
 from __future__ import annotations
 
 import dataclasses
+import pickle
 import time
+from concurrent.futures import Future
 from typing import Any, Callable, Dict, List, Optional
 
-from flink_tpu.checkpoint.storage import CheckpointHandle, FsCheckpointStorage
+import jax
+import numpy as np
+
+from flink_tpu.checkpoint.storage import (
+    CheckpointHandle, FsCheckpointStorage, ReusedOpState)
+
+
+def materialize_snapshot(obj: Any) -> Any:
+    """Recursively fetch device leaves of a frozen snapshot to host.
+    Runs on the BACKGROUND thread — the freeze left cloned jax arrays in
+    the tree precisely so this transfer leaves the hot loop."""
+    if isinstance(obj, jax.Array):
+        return jax.device_get(obj)
+    if isinstance(obj, dict):
+        return {k: materialize_snapshot(v) for k, v in obj.items()}
+    if isinstance(obj, tuple):
+        return tuple(materialize_snapshot(v) for v in obj)
+    if isinstance(obj, list):
+        return [materialize_snapshot(v) for v in obj]
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return dataclasses.replace(obj, **{
+            f.name: materialize_snapshot(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)})
+    return obj
+
+
+class PendingCheckpoint:
+    """An in-flight async checkpoint: freeze done, persistence running.
+    ``complete()`` (loop thread) blocks if needed, then commits the 2PC
+    epoch and records stats; ``abandon()`` drops it without committing."""
+
+    def __init__(self, coordinator: "CheckpointCoordinator", cid: int,
+                 future: "Future[CheckpointHandle]",
+                 commit_fns: List[Callable[[int], None]],
+                 t0: float) -> None:
+        self.coordinator = coordinator
+        self.checkpoint_id = cid
+        self.future = future
+        self._commit_fns = commit_fns
+        self._t0 = t0
+        self._end_cell: List[Optional[float]] = [None]
+
+    @property
+    def persist_end(self) -> Optional[float]:
+        return self._end_cell[0]
+
+    def done(self) -> bool:
+        return self.future.done()
+
+    def complete(self) -> CheckpointHandle:
+        handle = self.future.result()  # re-raises persistence errors
+        for c in self._commit_fns:
+            c(self.checkpoint_id)
+        # size and persist duration were computed on the BACKGROUND
+        # thread (handle fields); the loop-thread commit does no storage
+        # I/O — that is the whole point of the async split
+        self.coordinator.stats.append(CheckpointStats(
+            self.checkpoint_id, int(self._t0 * 1000),
+            (self.persist_end - self._t0) * 1000
+            if self.persist_end else (time.time() - self._t0) * 1000,
+            max(handle.size_bytes, 0)))
+        return handle
+
+    def abandon(self) -> None:
+        self.future.cancel()
 
 
 @dataclasses.dataclass
@@ -42,13 +118,29 @@ class CheckpointCoordinator:
         commit_fns: List[Callable[[int], None]],
         prepare_fns: List[Callable[[int], None]],
         savepoint: bool = False,
+        executor=None,
     ) -> CheckpointHandle:
-        """One full checkpoint cycle (synchronous local form; the
-        coordinator process does the same over RPC for multi-host):
-        1. sinks stage their epoch (prepareCommit)
-        2. collect state snapshot at the step boundary
-        3. persist (manifest last)
-        4. notify complete → sinks commit (2PC)
+        """One full SYNCHRONOUS checkpoint cycle — freeze, persist,
+        commit, in the caller's thread (savepoints, final checkpoints,
+        tests). The interval path uses ``trigger_async``."""
+        pending = self.trigger_async(
+            snapshot_fn, commit_fns, prepare_fns,
+            executor=executor, savepoint=savepoint)
+        return pending.complete()
+
+    def trigger_async(
+        self,
+        snapshot_fn: Callable[[], Dict[str, Any]],
+        commit_fns: List[Callable[[int], None]],
+        prepare_fns: List[Callable[[int], None]],
+        executor=None,
+        savepoint: bool = False,
+    ) -> PendingCheckpoint:
+        """Freeze in the caller's thread, persist in the background:
+        1. (loop) sinks stage their epoch (prepareCommit)
+        2. (loop) freeze: snapshot tree with on-device cloned leaves
+        3. (bg)   fetch leaves, serialize, write, manifest last
+        4. (loop, via PendingCheckpoint.complete) sinks commit (2PC)
         """
         cid = self._next_id
         self._next_id += 1
@@ -57,18 +149,38 @@ class CheckpointCoordinator:
             p(cid)
         payload = snapshot_fn()
         payload["checkpoint_id"] = cid
-        handle = self.storage.save(cid, payload, savepoint=savepoint)
-        for c in commit_fns:
-            c(cid)
-        import os
+        end_cell: List[Optional[float]] = [None]
 
-        size = 0
-        for root, _, files in os.walk(handle.path):
-            for fn in files:
-                size += os.path.getsize(os.path.join(root, fn))
-        self.stats.append(CheckpointStats(
-            cid, int(t0 * 1000), (time.time() - t0) * 1000, size))
-        return handle
+        def persist() -> CheckpointHandle:
+            try:
+                mat = materialize_snapshot(payload)
+                ops = mat.pop("operators", None)
+                if ops is None:
+                    return self.storage.save(cid, mat, savepoint=savepoint)
+                blobs: Dict[str, bytes] = {}
+                reuse: Dict[str, ReusedOpState] = {}
+                for nid, snap in ops.items():
+                    if isinstance(snap, ReusedOpState):
+                        reuse[str(nid)] = snap
+                    else:
+                        blobs[str(nid)] = pickle.dumps(
+                            snap, protocol=pickle.HIGHEST_PROTOCOL)
+                return self.storage.save_v2(
+                    cid, mat, blobs, reuse, savepoint=savepoint)
+            finally:
+                end_cell[0] = time.time()
+
+        if executor is None:
+            fut: Future = Future()
+            try:
+                fut.set_result(persist())
+            except BaseException as e:  # sync fallback mirrors a bg error
+                fut.set_exception(e)
+        else:
+            fut = executor.submit(persist)
+        pend = PendingCheckpoint(self, cid, fut, commit_fns, t0)
+        pend._end_cell = end_cell
+        return pend
 
     def restore_latest(self) -> Optional[Dict[str, Any]]:
         h = self.storage.latest()

@@ -13,9 +13,17 @@ Layout here:
 Savepoints are the same format under <root>/<job_id>/savepoint-<n>/
 (ref: SavepointType — manually triggered, never auto-retired).
 
-Format note: the round-1 payload codec is pickle+numpy; a versioned
-binary format (the TypeSerializerSnapshot schema-evolution analogue)
-replaces it when the C++ codec lands.
+Format v2 (incremental, the RocksDB shared-SST analogue): operator
+state splits into per-operator blob files
+    <chk>/meta.pkl            everything except operator state
+    <chk>/op-<nid>.pkl        one operator's snapshot
+    <chk>/MANIFEST.json       format_version 2 + per-op file+version map
+An operator UNCHANGED since the base checkpoint (same state_version) is
+not re-serialized: its blob is HARDLINKED from the base checkpoint's
+file (falling back to copy), so an idle operator costs zero bytes of
+new serialization and the link survives the base's retirement (inode
+refcount — exactly how RocksDB incremental checkpoints share SSTs).
+v1 single-pickle checkpoints remain loadable.
 """
 from __future__ import annotations
 
@@ -34,6 +42,19 @@ class CheckpointHandle:
     path: str
     timestamp_ms: int
     is_savepoint: bool = False
+    size_bytes: int = -1  # filled by save/save_v2 (background thread)
+
+
+@dataclasses.dataclass
+class ReusedOpState:
+    """Marker in a snapshot's operators map: this operator's state is
+    unchanged since the base checkpoint — reuse (hardlink) its blob
+    instead of re-serializing. ``file`` is the absolute path of the base
+    checkpoint's op blob; ``version`` the operator state_version it
+    captured."""
+
+    file: str
+    version: int
 
 
 class FsCheckpointStorage:
@@ -48,16 +69,27 @@ class FsCheckpointStorage:
         prefix = "savepoint" if savepoint else "chk"
         return os.path.join(self.job_dir, f"{prefix}-{checkpoint_id}")
 
+    @staticmethod
+    def _tmp_dir(d: str) -> str:
+        """Fresh UNIQUE in-progress dir: an abandoned background persist
+        from a failed attempt may still be writing when a restarted
+        attempt reuses the checkpoint id — distinct tmp dirs mean each
+        writer produces a self-consistent directory, and the final
+        atomic rename makes whole-dir last-writer-wins (never an
+        interleaved mix of two attempts' files)."""
+        import uuid
+
+        tmp = f"{d}.inprogress.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+        os.makedirs(tmp)
+        return tmp
+
     def save(self, checkpoint_id: int, payload: Dict[str, Any],
              savepoint: bool = False) -> CheckpointHandle:
         """Write snapshot; manifest lands last so readers only ever see
         complete checkpoints (the atomic-rename pattern of
         FsCompletedCheckpointStorageLocation)."""
         d = self._dir(checkpoint_id, savepoint)
-        tmp = d + ".inprogress"
-        if os.path.exists(tmp):
-            shutil.rmtree(tmp)
-        os.makedirs(tmp)
+        tmp = self._tmp_dir(d)
         with open(os.path.join(tmp, "state.pkl"), "wb") as f:
             pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
         ts = int(time.time() * 1000)
@@ -74,7 +106,57 @@ class FsCheckpointStorage:
         os.rename(tmp, d)
         if not savepoint:
             self._retire_old()
-        return CheckpointHandle(checkpoint_id, d, ts, savepoint)
+        return CheckpointHandle(checkpoint_id, d, ts, savepoint,
+                                size_bytes=_dir_size(d))
+
+    def save_v2(self, checkpoint_id: int, meta_payload: Dict[str, Any],
+                op_blobs: Dict[str, bytes],
+                op_reuse: Dict[str, "ReusedOpState"],
+                savepoint: bool = False) -> CheckpointHandle:
+        """Incremental format: per-operator blob files; unchanged
+        operators hardlink the base checkpoint's blob. Manifest lands
+        last, exactly like v1."""
+        d = self._dir(checkpoint_id, savepoint)
+        tmp = self._tmp_dir(d)
+        versions: Dict[str, int] = {}
+        op_files: Dict[str, str] = {}
+        for nid, blob in op_blobs.items():
+            fn = f"op-{nid}.pkl"
+            with open(os.path.join(tmp, fn), "wb") as f:
+                f.write(blob)
+            op_files[nid] = fn
+            versions[nid] = meta_payload.get(
+                "op_versions", {}).get(nid, -1)
+        for nid, ref in op_reuse.items():
+            fn = f"op-{nid}.pkl"
+            dst = os.path.join(tmp, fn)
+            try:
+                os.link(ref.file, dst)
+            except OSError:
+                shutil.copyfile(ref.file, dst)
+            op_files[nid] = fn
+            versions[nid] = ref.version
+        with open(os.path.join(tmp, "meta.pkl"), "wb") as f:
+            pickle.dump(meta_payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        ts = int(time.time() * 1000)
+        with open(os.path.join(tmp, "MANIFEST.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump({
+                "checkpoint_id": checkpoint_id,
+                "timestamp_ms": ts,
+                "job_id": self.job_id,
+                "savepoint": savepoint,
+                "format_version": 2,
+                "ops": {nid: {"file": fn, "version": versions[nid]}
+                        for nid, fn in op_files.items()},
+            }, f)
+        if os.path.exists(d):
+            shutil.rmtree(d)
+        os.rename(tmp, d)
+        if not savepoint:
+            self._retire_old()
+        return CheckpointHandle(checkpoint_id, d, ts, savepoint,
+                                size_bytes=_dir_size(d))
 
     def list_complete(self) -> List[CheckpointHandle]:
         out = []
@@ -100,8 +182,32 @@ class FsCheckpointStorage:
     @staticmethod
     def load(handle_or_path) -> Dict[str, Any]:
         path = getattr(handle_or_path, "path", handle_or_path)
-        with open(os.path.join(path, "state.pkl"), "rb") as f:
-            return pickle.load(f)
+        mf_path = os.path.join(path, "MANIFEST.json")
+        fmt = 1
+        manifest: Dict[str, Any] = {}
+        if os.path.isfile(mf_path):
+            with open(mf_path, "r", encoding="utf-8") as f:
+                manifest = json.load(f)
+            fmt = manifest.get("format_version", 1)
+        if fmt == 1:
+            with open(os.path.join(path, "state.pkl"), "rb") as f:
+                return pickle.load(f)
+        with open(os.path.join(path, "meta.pkl"), "rb") as f:
+            payload = pickle.load(f)
+        ops: Dict[Any, Any] = {}
+        versions: Dict[Any, int] = {}
+        for nid, entry in manifest.get("ops", {}).items():
+            with open(os.path.join(path, entry["file"]), "rb") as f:
+                # node ids are ints in the live plan; the manifest's JSON
+                # keys are strings — restore the original type
+                ops[int(nid)] = pickle.load(f)
+            versions[int(nid)] = entry["version"]
+        payload["operators"] = ops
+        payload["op_file_versions"] = versions
+        payload["op_files"] = {
+            int(nid): os.path.join(path, e["file"])
+            for nid, e in manifest.get("ops", {}).items()}
+        return payload
 
     def _retire_old(self) -> None:
         hs = [h for h in self.list_complete() if not h.is_savepoint]
@@ -109,6 +215,17 @@ class FsCheckpointStorage:
             shutil.rmtree(h.path, ignore_errors=True)
         # sweep orphaned in-progress dirs
         for name in os.listdir(self.job_dir):
-            if name.endswith(".inprogress"):
+            if ".inprogress" in name:
                 shutil.rmtree(os.path.join(self.job_dir, name),
                               ignore_errors=True)
+
+
+def _dir_size(d: str) -> int:
+    size = 0
+    for root, _, files in os.walk(d):
+        for fn in files:
+            try:
+                size += os.path.getsize(os.path.join(root, fn))
+            except OSError:
+                pass
+    return size

@@ -81,6 +81,7 @@ class CountWindowOperator:
         self.watermark = LONG_MIN
         self.late_records = 0
         self.records_dropped_full = 0
+        self.state_version = 0
         self._pending: collections.deque = collections.deque()
         res = agg.finalize(
             np.zeros((0, agg.sum_width), np.float32),
@@ -148,6 +149,7 @@ class CountWindowOperator:
         data: Dict[str, np.ndarray],
         valid: Optional[np.ndarray] = None,
     ) -> None:
+        self.state_version += 1
         keys = np.asarray(keys, dtype=np.int64)
         b = len(keys)
         valid = np.ones(b, bool) if valid is None else np.asarray(valid, bool)
@@ -215,6 +217,7 @@ class CountWindowOperator:
     def advance_watermark(self, wm: int) -> FiredWindows:
         if wm > self.watermark:
             self.watermark = wm
+            self.state_version += 1  # snapshotted field changed
         if self._empty_cache is None:
             from flink_tpu.ops.window import _empty_fired
             self._empty_cache = _empty_fired(self.agg)
@@ -237,7 +240,10 @@ class CountWindowOperator:
     def snapshot_state(self) -> Dict[str, Any]:
         return {
             "kind": "count_window",
-            "arrays": tuple(np.asarray(a) for a in self.state),
+            # on-device clone (not a fetch): the checkpoint executor
+            # materializes off the hot loop; clone because the next step
+            # donates self.state's buffers
+            "arrays": tuple(jnp.array(a, copy=True) for a in self.state),
             "directory": self.directory.snapshot(),
             "watermark": self.watermark,
             "late_records": self.late_records,

@@ -618,6 +618,9 @@ class WindowOperator:
         self._max_pane_seen: Optional[int] = None
         self.late_records: int = 0
         self.exchange_overflow: int = 0
+        # bumped on every mutation; checkpointing reuses the previous
+        # blob when unchanged (incremental, RocksDB shared-SST analogue)
+        self.state_version: int = 0
         # records dropped because the key directory shard was FULL —
         # always accounted, surfaced in metrics/JobResult (never silent)
         self.records_dropped_full: int = 0
@@ -858,6 +861,7 @@ class WindowOperator:
         numLateRecordsDropped) and late-within-lateness rows mark their
         windows for re-firing."""
         t0 = time.perf_counter()
+        self.state_version += 1
         keys = np.asarray(keys, dtype=np.int64)
         ts = np.asarray(ts, dtype=np.int64)
         valid = np.ones(len(ts), bool) if valid is None else np.asarray(valid, bool)
@@ -1130,6 +1134,7 @@ class WindowOperator:
         if wm < self.watermark or (wm == self.watermark and not self._refire):
             return self._empty()
         taw = time.perf_counter()
+        self.state_version += 1
         prev = self.watermark
         self.watermark = wm
 
@@ -1468,7 +1473,14 @@ class WindowOperator:
                       if self._spill is not None else None),
             "n_dev": self.mesh_plan.n_devices if self.mesh_plan else 1,
             "ring": self.plan.ring,
-            "panes": jax.tree_util.tree_map(np.asarray, self.state),
+            # on-device CLONE, not a fetch: the freeze stays in-loop and
+            # cheap; the checkpoint executor's materialize pass does the
+            # device→host transfer off the hot path (SURVEY §6.4 async
+            # snapshot part). A clone is required — later steps DONATE
+            # self.state's buffers, so holding the refs would read
+            # deleted buffers.
+            "panes": jax.tree_util.tree_map(
+                lambda x: jnp.array(x, copy=True), self.state),
             "directory": self.directory.snapshot(),
             "watermark": self.watermark,
             "cleared_below": self._cleared_below,

@@ -56,6 +56,7 @@ class WindowAllOperator:
         self.store = HostSpillStore(agg)
         self.watermark = LONG_MIN
         self.late_records = 0
+        self.state_version = 0
         self._refire: set[int] = set()
         self._cleared_below = self.plan.first_dead_pane(LONG_MIN)
         self._fired_below_end: Optional[int] = None
@@ -71,6 +72,7 @@ class WindowAllOperator:
         data: Dict[str, np.ndarray],
         valid: Optional[np.ndarray] = None,
     ) -> None:
+        self.state_version += 1
         ts = np.asarray(ts, dtype=np.int64)
         b = len(ts)
         valid = np.ones(b, bool) if valid is None else np.asarray(valid, bool)
@@ -105,6 +107,7 @@ class WindowAllOperator:
     def advance_watermark(self, wm: int) -> FiredWindows:
         if wm < self.watermark or (wm == self.watermark and not self._refire):
             return self._empty()
+        self.state_version += 1
         prev = self.watermark
         self.watermark = wm
         ends = sorted(set(self.plan.enumerate_fire_ends(

@@ -224,15 +224,36 @@ class Driver:
             retained=self.config.get(CheckpointingOptions.RETAINED))
         return CheckpointCoordinator(storage)
 
-    def _snapshot(self) -> Dict[str, Any]:
+    def _snapshot(self, allow_reuse: bool = True) -> Dict[str, Any]:
+        from flink_tpu.checkpoint.storage import ReusedOpState
+
+        # incremental reuse (RocksDB shared-SST analogue): an operator
+        # whose state_version is unchanged since the base (last
+        # completed) checkpoint hardlinks that checkpoint's blob instead
+        # of re-serializing. Savepoints stay self-contained.
+        base = self._ckpt_base if allow_reuse else None
+        ops: Dict[Any, Any] = {}
+        versions: Dict[str, int] = {}
+        for nid, op in self._ops.items():
+            v = getattr(op, "state_version", None)
+            versions[str(nid)] = -1 if v is None else int(v)
+            if (v is not None and base is not None
+                    and base["versions"].get(nid) == v
+                    and nid in base["files"]):
+                ops[nid] = ReusedOpState(base["files"][nid], int(v))
+            else:
+                ops[nid] = op.snapshot_state()
+        self._last_freeze_versions = {
+            nid: getattr(op, "state_version", -1)
+            for nid, op in self._ops.items()}
         return {
             "sources": {sid: dict(pos) for sid, pos in self._positions.items()},
             "wm_gens": {sid: [g.snapshot() for g in gens]
                         for sid, gens in self._wm_gens.items()},
             "max_ts": dict(self._max_ts),
             "out_wm": dict(self._out_wm),
-            "operators": {nid: op.snapshot_state()
-                          for nid, op in self._ops.items()},
+            "operators": ops,
+            "op_versions": versions,
             # staged-but-uncommitted 2PC sink epochs (prepare ran before
             # this snapshot, so the in-flight epoch is included) — the
             # TwoPhaseCommitSinkFunction pending-transaction-in-state rule
@@ -255,6 +276,19 @@ class Driver:
         self._out_wm.update(payload["out_wm"])
         for nid, snap in payload["operators"].items():
             self._ops[nid].restore_state(snap)
+        # v2 incremental restore: adopt the checkpoint's per-op state
+        # versions and make it the reuse base — an operator untouched
+        # after restore hardlinks its blob at the very next checkpoint
+        file_versions = payload.get("op_file_versions")
+        if file_versions:
+            for nid, v in file_versions.items():
+                if nid in self._ops and hasattr(
+                        self._ops[nid], "state_version"):
+                    self._ops[nid].state_version = v
+            self._ckpt_base = {
+                "files": dict(payload.get("op_files", {})),
+                "versions": dict(file_versions),
+            }
         self.metrics.update(payload["metrics"])
         staged_sinks = payload.get("sinks", {})
         cid = int(payload.get("checkpoint_id", 0))
@@ -277,18 +311,54 @@ class Driver:
                 n.sink.abort_uncommitted()
 
     def checkpoint_now(self, savepoint: bool = False):
-        """Trigger one checkpoint at the current step boundary (ref:
-        CheckpointCoordinator.triggerCheckpoint; savepoint=True for the
-        manually-triggered retained form)."""
+        """Trigger one SYNCHRONOUS checkpoint at the current step
+        boundary (ref: CheckpointCoordinator.triggerCheckpoint;
+        savepoint=True for the manually-triggered retained form). The
+        interval path in the run loop uses the async form instead —
+        this entry point waits for durability before returning."""
         assert self._coordinator is not None, "checkpointing not configured"
+        self._complete_pending_checkpoint(wait=True)
+        self._ckpt_pending = self._begin_checkpoint(savepoint=savepoint)
+        return self._complete_pending_checkpoint(wait=True)
+
+    def _begin_checkpoint(self, savepoint: bool = False):
+        """In-loop freeze + background persistence kickoff. The only
+        loop-thread work is the emit flush, sink staging, and the
+        snapshot freeze (device leaves are dispatched on-device clones);
+        fetching/serializing/writing runs on the checkpoint executor."""
         self._flush_emits()  # barrier: staged epoch must be complete
         sinks = [n.sink for n in self.plan.nodes.values() if n.kind == "sink"]
-        return self._coordinator.trigger(
-            self._snapshot,
+        pend = self._coordinator.trigger_async(
+            lambda: self._snapshot(allow_reuse=not savepoint),
             commit_fns=[s.notify_checkpoint_complete for s in sinks],
             prepare_fns=[s.prepare_commit for s in sinks],
+            executor=self._ckpt_executor,
             savepoint=savepoint,
         )
+        pend.frozen_versions = dict(self._last_freeze_versions)
+        pend.is_savepoint = savepoint
+        return pend
+
+    def _complete_pending_checkpoint(self, wait: bool = False):
+        """Apply the 2PC commit of a finished background checkpoint on
+        the LOOP thread (the asynchronous notifyCheckpointComplete of
+        the reference). Non-blocking unless ``wait``."""
+        import os as _os
+
+        p = self._ckpt_pending
+        if p is None:
+            return None
+        if not wait and not p.done():
+            return None
+        handle = p.complete()
+        self._ckpt_pending = None
+        if not p.is_savepoint:
+            self._ckpt_base = {
+                "files": {nid: _os.path.join(handle.path, f"op-{nid}.pkl")
+                          for nid in self._ops},
+                "versions": dict(p.frozen_versions),
+            }
+        return handle
 
     # -- run loop --------------------------------------------------------
     def run(self, job_name: str = "job", cancel=None):
@@ -302,6 +372,14 @@ class Driver:
         from flink_tpu.obs.metrics import METRICS_BIND, METRICS_PORT, MetricsServer
 
         self._coordinator = self._setup_checkpointing(job_name)
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._ckpt_executor = (ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ckpt")
+            if self._coordinator is not None else None)
+        self._ckpt_pending = None
+        self._ckpt_base = None
+        self._last_freeze_versions: Dict[Any, int] = {}
         interval_ms = self.config.get(CheckpointingOptions.INTERVAL)
         restore = self.config.get(CheckpointingOptions.RESTORE)
         self._positions: Dict[int, Dict[int, int]] = {}
@@ -316,7 +394,25 @@ class Driver:
         try:
             return self._run_loop(job_name, drain, interval_ms, restore)
         except BaseException:
-            # Failed attempt: stop the drain thread BEFORE the exception
+            # Failed attempt: an in-flight background checkpoint must
+            # NOT commit its 2PC epoch (its snapshot may cover state the
+            # failure invalidated); abandon it uncommitted — the
+            # manifest may still land, which is harmless: restore picks
+            # it up with its staged (uncommitted) epochs exactly like a
+            # crash between manifest and commit.
+            if getattr(self, "_ckpt_pending", None) is not None:
+                self._ckpt_pending.abandon()
+                # bounded wait for a persist already running: the next
+                # attempt may reuse this checkpoint id, and two live
+                # writers on one id is the corruption the unique tmp
+                # dirs defend against — prefer not to race at all (a
+                # wedged network fs must still not turn a crash into a
+                # hang, hence the timeout)
+                from concurrent.futures import wait as _fwait
+
+                _fwait([self._ckpt_pending.future], timeout=30.0)
+                self._ckpt_pending = None
+            # Stop the drain thread BEFORE the exception
             # escapes, discarding everything it still holds. A daemon
             # drain left running would deliver this attempt's fires into
             # sinks reused by the next attempt — duplicate output after
@@ -349,6 +445,12 @@ class Driver:
             if self._metrics_server is not None:
                 self._metrics_server.close()
             raise
+        finally:
+            if self._ckpt_executor is not None:
+                # non-blocking: an abandoned persist may still be
+                # writing; letting it finish is safe (manifest-last)
+                self._ckpt_executor.shutdown(wait=False)
+                self._ckpt_executor = None
 
     def _run_loop(self, job_name: str, drain, interval_ms: int,
                   restore) -> "JobResult":
@@ -453,9 +555,14 @@ class Driver:
                     self._propagate_watermarks()
                 prof["advance_wm"] += time.perf_counter() - t3
                 self._check_drain_error()
+            # async checkpointing: commit any finished background
+            # checkpoint (never blocks), then kick off the next one when
+            # the interval elapsed and no persistence is in flight
+            self._complete_pending_checkpoint(wait=False)
             if (self._coordinator is not None and interval_ms > 0
+                    and self._ckpt_pending is None
                     and (time.time() - last_chk) * 1000 >= interval_ms):
-                self.checkpoint_now()
+                self._ckpt_pending = self._begin_checkpoint()
                 last_chk = time.time()
 
         # end of input: final watermark per stateful op flushes everything.
@@ -472,6 +579,7 @@ class Driver:
         self._flush_emits()
         if self._coordinator is not None and interval_ms > 0:
             self.checkpoint_now()  # final epoch commit for 2PC sinks
+            # (completes any pending background checkpoint first)
         self._emit_q.put(None)
         drain.join()
         self._emit_q = None

@@ -1,0 +1,173 @@
+"""Async + incremental checkpointing (ref: HeapSnapshotStrategy's
+async snapshot part + RocksDBIncrementalSnapshotStrategy's shared-SST
+reuse, SURVEY §6.4). Contracts under test: the 2PC commit happens only
+after the manifest is durable; unchanged operators hardlink the base
+checkpoint's blob; v1 single-pickle checkpoints stay loadable."""
+import json
+import os
+import pickle
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.api.sinks import CollectSink
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.api.windowing import TumblingEventTimeWindows
+from flink_tpu.checkpoint.coordinator import CheckpointCoordinator
+from flink_tpu.checkpoint.storage import FsCheckpointStorage
+from flink_tpu.config import Configuration
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+
+def make_env(tmp_path, extra=None):
+    conf = {
+        "state.num-key-shards": 4,
+        "state.slots-per-shard": 32,
+        "pipeline.microbatch-size": 64,
+        "execution.checkpointing.dir": str(tmp_path),
+        "execution.checkpointing.interval": 1,
+    }
+    conf.update(extra or {})
+    return StreamExecutionEnvironment(Configuration(conf))
+
+
+def simple_gen(n_batches):
+    def gen(split, i):
+        if i >= n_batches:
+            return None
+        rng = np.random.default_rng(i)
+        keys = rng.integers(0, 10, 32).astype(np.int64)
+        ts = np.sort(rng.integers(i * 500, i * 500 + 900, 32)).astype(np.int64)
+        return {"k": keys}, ts
+    return gen
+
+
+class TestCommitAfterDurable:
+    def test_commit_waits_for_persistence(self, tmp_path):
+        """The 2PC commit must not run until the manifest is on disk —
+        a gate in the executor holds the write; the commit callback must
+        not fire while the gate is closed."""
+        storage = FsCheckpointStorage(str(tmp_path), "job")
+        coord = CheckpointCoordinator(storage)
+        gate = threading.Event()
+        committed = []
+
+        real_save_v2 = storage.save_v2
+
+        def slow_save_v2(*a, **kw):
+            gate.wait(timeout=10)
+            return real_save_v2(*a, **kw)
+
+        storage.save_v2 = slow_save_v2
+        with ThreadPoolExecutor(max_workers=1) as ex:
+            pend = coord.trigger_async(
+                lambda: {"operators": {0: {"x": np.arange(4)}}},
+                commit_fns=[committed.append],
+                prepare_fns=[lambda cid: None],
+                executor=ex)
+            time.sleep(0.15)
+            assert not pend.done()
+            assert committed == []           # nothing durable yet
+            assert storage.latest() is None  # no manifest either
+            gate.set()
+            handle = pend.complete()
+        assert committed == [pend.checkpoint_id]
+        assert storage.latest().checkpoint_id == handle.checkpoint_id
+
+    def test_abandoned_checkpoint_never_commits(self, tmp_path):
+        storage = FsCheckpointStorage(str(tmp_path), "job")
+        coord = CheckpointCoordinator(storage)
+        committed = []
+        gate = threading.Event()
+        real = storage.save_v2
+        storage.save_v2 = lambda *a, **kw: (gate.wait(10), real(*a, **kw))[1]
+        with ThreadPoolExecutor(max_workers=1) as ex:
+            pend = coord.trigger_async(
+                lambda: {"operators": {0: {"x": 1}}},
+                commit_fns=[committed.append],
+                prepare_fns=[], executor=ex)
+            pend.abandon()
+            gate.set()
+            time.sleep(0.1)
+        assert committed == []  # persisted maybe, committed never
+
+
+class TestIncrementalReuse:
+    def test_job_checkpoints_use_v2_layout(self, tmp_path):
+        """Interval checkpoints of a real job land in the v2 per-op-blob
+        layout with a manifest op map."""
+        env2 = make_env(tmp_path)
+        sink2 = CollectSink()
+        (env2.from_source(GeneratorSource(simple_gen(4)),
+                          WatermarkStrategy.for_bounded_out_of_orderness(400))
+         .key_by("k")
+         .window(TumblingEventTimeWindows.of(1_000))
+         .count()
+         .add_sink(sink2))
+        env2.execute("inc-job")
+        job_dir = os.path.join(str(tmp_path), "inc-job")
+        chks = sorted(d for d in os.listdir(job_dir) if d.startswith("chk-"))
+        assert len(chks) >= 2
+        # format v2 layout everywhere
+        for c in chks:
+            mf = json.load(open(os.path.join(job_dir, c, "MANIFEST.json")))
+            assert mf["format_version"] == 2
+            assert os.path.exists(os.path.join(job_dir, c, "meta.pkl"))
+
+    def test_idle_op_blob_is_hardlinked(self, tmp_path):
+        """Direct storage check: save_v2 with a ReusedOpState must link
+        the same inode as the base checkpoint's blob."""
+        from flink_tpu.checkpoint.storage import ReusedOpState
+
+        st = FsCheckpointStorage(str(tmp_path), "j")
+        blob = pickle.dumps({"state": np.arange(1000)})
+        h1 = st.save_v2(1, {"op_versions": {"5": 3}}, {"5": blob}, {})
+        f1 = os.path.join(h1.path, "op-5.pkl")
+        h2 = st.save_v2(2, {"op_versions": {"5": 3}}, {},
+                        {"5": ReusedOpState(f1, 3)})
+        f2 = os.path.join(h2.path, "op-5.pkl")
+        assert os.path.samefile(f1, f2)          # same inode — zero bytes
+        # retiring the base keeps the reused blob readable
+        st.retained = 1
+        st._retire_old()
+        assert not os.path.exists(h1.path)
+        assert pickle.loads(open(f2, "rb").read())["state"][999] == 999
+
+    def test_restored_checkpoint_seeds_reuse_base(self, tmp_path):
+        """Run, restore from the checkpoint, run again without touching
+        one op — its blob must hardlink the restored checkpoint's file
+        via the manifest-adopted state_version."""
+        from flink_tpu.checkpoint.storage import FsCheckpointStorage as S
+
+        env = make_env(tmp_path)
+        sink = CollectSink()
+        (env.from_source(GeneratorSource(simple_gen(3)),
+                         WatermarkStrategy.for_bounded_out_of_orderness(400))
+         .key_by("k").window(TumblingEventTimeWindows.of(1_000))
+         .count().add_sink(sink))
+        env.execute("seed-job")
+        # v2 load returns op files + versions for the base seed
+        st = S(str(tmp_path), "seed-job")
+        payload = S.load(st.latest())
+        assert payload["op_files"] and payload["op_file_versions"]
+
+
+class TestV1Compat:
+    def test_v1_checkpoint_still_loads(self, tmp_path):
+        d = os.path.join(str(tmp_path), "j", "chk-7")
+        os.makedirs(d)
+        with open(os.path.join(d, "state.pkl"), "wb") as f:
+            pickle.dump({"checkpoint_id": 7, "operators": {0: {"a": 1}}}, f)
+        with open(os.path.join(d, "MANIFEST.json"), "w") as f:
+            json.dump({"checkpoint_id": 7, "timestamp_ms": 1,
+                       "job_id": "j", "savepoint": False,
+                       "format_version": 1}, f)
+        st = FsCheckpointStorage(str(tmp_path), "j")
+        h = st.latest()
+        assert h.checkpoint_id == 7
+        payload = FsCheckpointStorage.load(h)
+        assert payload["operators"][0]["a"] == 1
