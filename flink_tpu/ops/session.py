@@ -12,16 +12,19 @@ static pane layout, so the decomposition is:
   gap exceeds ``gap``; per-batch-session aggregates come from numpy
   ``reduceat`` segments (C-speed host work — the per-RECORD cost is
   vectorized away, matching how the reference's cost is per element).
-- a **host span registry** keeps open sessions per key (tiny: one entry
-  per active session, not per record) and merges batch-sessions into
-  them — the MergingWindowSet role.
+- the **span registry is COLUMNAR** (struct-of-arrays sorted by
+  (key, start), one row per open/retained session — the
+  MergingWindowSet role at fleet scale): batch segments merge into it
+  with one lexsort + an offset-encoded interval-union scan + reduceat
+  combines. No per-key Python objects, no per-span loops — a 1M-key
+  churn batch costs a few array passes (the round-2 registry held a
+  Python list of dataclasses per key and died at exactly that scale).
 - fired sessions stay in the registry until allowed lateness expires so
   late records re-open/merge and re-fire (late firing semantics).
 """
 from __future__ import annotations
 
-import dataclasses
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -29,16 +32,63 @@ from flink_tpu.ops.aggregates import LaneAggregate
 from flink_tpu.time.watermarks import LONG_MIN
 
 
-@dataclasses.dataclass
-class _Span:
-    start: int
-    last_ts: int          # max event ts in session; end = last_ts + gap
-    sums: np.ndarray
-    maxs: np.ndarray
-    mins: np.ndarray
-    count: int
-    fired: bool = False   # already emitted once (re-fire on late merge)
-    refire: bool = False  # must (re-)emit at the next advance
+class _SpanStore:
+    """Columnar open/retained-session registry, sorted by (key, start).
+
+    Invariant: per key, spans are disjoint and separated by more than
+    ``gap`` (anything closer would have merged), so two REGISTRY spans
+    can only merge when a new batch segment bridges them.
+    """
+
+    def __init__(self, sum_w: int, max_w: int, min_w: int) -> None:
+        self.key = np.zeros(0, np.int64)
+        self.start = np.zeros(0, np.int64)
+        self.last = np.zeros(0, np.int64)   # max event ts; end = last+gap
+        self.sums = np.zeros((0, sum_w), np.float32)
+        self.maxs = np.zeros((0, max_w), np.float32)
+        self.mins = np.zeros((0, min_w), np.float32)
+        self.count = np.zeros(0, np.int64)
+        self.fired = np.zeros(0, bool)
+        self.refire = np.zeros(0, bool)
+
+    def __len__(self) -> int:
+        return len(self.key)
+
+    _COLS = ("key", "start", "last", "sums", "maxs", "mins", "count",
+             "fired", "refire")
+
+    def _take(self, idx) -> Tuple[np.ndarray, ...]:
+        return tuple(getattr(self, c)[idx] for c in self._COLS)
+
+    def _filter(self, keep: np.ndarray) -> None:
+        for c in self._COLS:
+            setattr(self, c, getattr(self, c)[keep])
+
+    def ranges_for(self, uk: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """[lo, hi) row ranges of (sorted, unique) keys ``uk``."""
+        return (np.searchsorted(self.key, uk, "left"),
+                np.searchsorted(self.key, uk, "right"))
+
+    def rows_for(self, uk: np.ndarray) -> np.ndarray:
+        """All row indices whose key is in ``uk`` (sorted unique)."""
+        lo, hi = self.ranges_for(uk)
+        lens = hi - lo
+        total = int(lens.sum())
+        if total == 0:
+            return np.zeros(0, np.int64)
+        # concatenated aranges: repeat each lo, add a per-range arange
+        reps = np.repeat(lo - np.concatenate(([0], np.cumsum(lens)[:-1])),
+                         lens)
+        return reps + np.arange(total)
+
+    def insert_sorted(self, cols: Tuple[np.ndarray, ...]) -> None:
+        """Insert rows whose KEYS ARE DISJOINT from the store's (the
+        merge removed every touched key first), keeping (key, start)
+        order — a single searchsorted + np.insert per column."""
+        pos = np.searchsorted(self.key, cols[0])
+        for c, new in zip(self._COLS, cols):
+            cur = getattr(self, c)
+            setattr(self, c, np.insert(cur, pos, new, axis=0))
 
 
 class SessionOperator:
@@ -61,12 +111,13 @@ class SessionOperator:
         self.lateness = int(allowed_lateness_ms)
         self.watermark = LONG_MIN
         self.late_records = 0
-        # key -> list of open/retained spans, disjoint, sorted by start
-        self._spans: Dict[int, List[_Span]] = {}
+        self.state_version = 0
+        self._store = _SpanStore(agg.sum_width, agg.max_width, agg.min_width)
         self._has_refire = False
 
     # -- ingest ----------------------------------------------------------
     def process_batch(self, keys, ts, data: Dict[str, np.ndarray], valid=None) -> None:
+        self.state_version += 1
         keys = np.asarray(keys, np.int64)
         ts = np.asarray(ts, np.int64)
         valid = np.ones(len(ts), bool) if valid is None else np.asarray(valid, bool)
@@ -78,13 +129,19 @@ class SessionOperator:
         # session rides that session's lateness)
         if self.watermark != LONG_MIN:
             late = valid & (ts + self.gap - 1 + self.lateness <= self.watermark)
-            if late.any():
-                for i in np.nonzero(late)[0]:
-                    k, t = int(keys[i]), int(ts[i])
-                    for sp in self._spans.get(k, ()):
-                        if t <= sp.last_ts + self.gap and sp.start <= t + self.gap:
-                            late[i] = False
-                            break
+            cand = np.nonzero(late)[0]
+            if len(cand):
+                st = self._store
+                uk = np.unique(keys[cand])
+                lo, hi = st.ranges_for(uk)
+                pos = np.searchsorted(uk, keys[cand])
+                for j, i in enumerate(cand):
+                    a, b = lo[pos[j]], hi[pos[j]]
+                    t = ts[i]
+                    if a < b and bool(np.any(
+                            (st.start[a:b] <= t + self.gap)
+                            & (t <= st.last[a:b] + self.gap))):
+                        late[i] = False
             self.late_records += int(late.sum())
             valid = valid & ~late
         if not valid.any():
@@ -95,33 +152,27 @@ class SessionOperator:
 
         # vectorized batch sessionization: sort by (key, ts)
         order = np.lexsort((ts, keys))
-        sk, st = keys[order], ts[order]
+        sk, st_ = keys[order], ts[order]
         sdata = {k: v[order] for k, v in data.items()}
         new_seg = np.empty(len(sk), bool)
         new_seg[0] = True
-        new_seg[1:] = (sk[1:] != sk[:-1]) | (st[1:] - st[:-1] > self.gap)
+        new_seg[1:] = (sk[1:] != sk[:-1]) | (st_[1:] - st_[:-1] > self.gap)
         seg_starts = np.nonzero(new_seg)[0]
 
         # per-segment lane aggregates (host lift on CPU jax → numpy)
         s_l, mx_l, mn_l = self._host_lift(sdata, np.ones(len(sk), bool))
-        seg_sum = np.add.reduceat(s_l, seg_starts, axis=0) if s_l.shape[1] else np.zeros((len(seg_starts), 0), np.float32)
-        seg_max = np.maximum.reduceat(mx_l, seg_starts, axis=0) if mx_l.shape[1] else np.zeros((len(seg_starts), 0), np.float32)
-        seg_min = np.minimum.reduceat(mn_l, seg_starts, axis=0) if mn_l.shape[1] else np.zeros((len(seg_starts), 0), np.float32)
+        G = len(seg_starts)
+        seg_sum = (np.add.reduceat(s_l, seg_starts, axis=0)
+                   if s_l.shape[1] else np.zeros((G, 0), np.float32))
+        seg_max = (np.maximum.reduceat(mx_l, seg_starts, axis=0)
+                   if mx_l.shape[1] else np.zeros((G, 0), np.float32))
+        seg_min = (np.minimum.reduceat(mn_l, seg_starts, axis=0)
+                   if mn_l.shape[1] else np.zeros((G, 0), np.float32))
         seg_ends = np.append(seg_starts[1:], len(sk))
-        seg_count = seg_ends - seg_starts
-        seg_key = sk[seg_starts]
-        seg_tmin = st[seg_starts]
-        seg_tmax = st[seg_ends - 1]
-
-        # merge batch segments into the registry (MergingWindowSet role)
-        for i in range(len(seg_starts)):
-            self._merge_span(
-                int(seg_key[i]),
-                # .copy(): a row view would pin the whole batch's segment
-                # arrays in memory for the span's retention lifetime
-                _Span(int(seg_tmin[i]), int(seg_tmax[i]),
-                      seg_sum[i].copy(), seg_max[i].copy(),
-                      seg_min[i].copy(), int(seg_count[i])))
+        self._merge_segments(
+            sk[seg_starts], st_[seg_starts], st_[seg_ends - 1],
+            seg_sum, seg_max, seg_min,
+            (seg_ends - seg_starts).astype(np.int64))
 
     def _host_lift(self, data, valid) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Run the aggregate's lift on the host CPU backend (session lane
@@ -137,36 +188,98 @@ class SessionOperator:
                 {k: jnp.asarray(v) for k, v in data.items()}, jnp.asarray(valid))
             return np.asarray(s), np.asarray(mx), np.asarray(mn)
 
-    def _merge_span(self, key: int, new: _Span) -> None:
-        spans = self._spans.setdefault(key, [])
-        merged = new
-        keep: List[_Span] = []
-        refire_needed = False
-        for sp in spans:
-            # overlap iff [start, last+gap) ranges touch
-            if merged.start <= sp.last_ts + self.gap and sp.start <= merged.last_ts + self.gap:
-                refire_needed = refire_needed or sp.fired
-                merged = _Span(
-                    start=min(sp.start, merged.start),
-                    last_ts=max(sp.last_ts, merged.last_ts),
-                    sums=sp.sums + merged.sums,
-                    maxs=np.maximum(sp.maxs, merged.maxs),
-                    mins=np.minimum(sp.mins, merged.mins),
-                    count=sp.count + merged.count,
-                    fired=False,
-                    refire=sp.refire or merged.refire,
-                )
-            else:
-                keep.append(sp)
-        if refire_needed or (self.watermark != LONG_MIN
-                             and merged.last_ts + self.gap - 1 <= self.watermark):
-            # late merge into a fired session, or a session already
-            # complete at the current watermark → (re-)fire on next advance
-            merged.refire = True
+    def _merge_segments(self, seg_key, seg_tmin, seg_tmax,
+                        seg_sum, seg_max, seg_min, seg_count) -> None:
+        """Merge batch segments into the registry — the MergingWindowSet
+        role, fully vectorized: pull every touched key's spans, run one
+        interval-union scan over (touched ∪ new) sorted by (key, start),
+        combine groups with reduceat, splice the results back."""
+        st = self._store
+        gap = self.gap
+        uk = np.unique(seg_key)
+        touched_idx = st.rows_for(uk)
+        (tk, tstart, tlast, tsum, tmax, tmin, tcount, tfired,
+         trefire) = st._take(touched_idx)
+        if len(touched_idx):
+            keep = np.ones(len(st), bool)
+            keep[touched_idx] = False
+            st._filter(keep)
+
+        n_t = len(tk)
+        all_key = np.concatenate([tk, seg_key])
+        all_start = np.concatenate([tstart, seg_tmin])
+        all_last = np.concatenate([tlast, seg_tmax])
+        all_sum = np.concatenate([tsum, seg_sum])
+        all_max = np.concatenate([tmax, seg_max])
+        all_min = np.concatenate([tmin, seg_min])
+        all_count = np.concatenate([tcount, seg_count])
+        all_fired = np.concatenate([tfired, np.zeros(len(seg_key), bool)])
+        all_refire = np.concatenate([trefire, np.zeros(len(seg_key), bool)])
+        is_new = np.concatenate(
+            [np.zeros(n_t, bool), np.ones(len(seg_key), bool)])
+
+        order = np.lexsort((all_start, all_key))
+        k_o = all_key[order]
+        s_o = all_start[order]
+        l_o = all_last[order]
+
+        # interval-union scan with offset encoding: give each key's
+        # timeline its own disjoint numeric band so ONE global
+        # maximum.accumulate implements the per-key running chain-end
+        # (merge iff start <= chain_last + gap)
+        base = int(s_o.min())
+        span = int(l_o.max()) + gap - base + 2
+        krank = np.searchsorted(uk, k_o).astype(np.int64)
+        if (len(uk) + 1) * span < 2**62:
+            enc_start = krank * span + (s_o - base)
+            enc_chain = krank * span + (l_o - base) + gap
+            cm = np.maximum.accumulate(enc_chain)
+            grp = np.empty(len(order), bool)
+            grp[0] = True
+            grp[1:] = enc_start[1:] > cm[:-1]
+        else:  # pathological time range: per-key reset scan (rare)
+            grp = np.empty(len(order), bool)
+            grp[0] = True
+            chain = l_o[0]
+            for i in range(1, len(order)):
+                if k_o[i] != k_o[i - 1] or s_o[i] > chain + gap:
+                    grp[i] = True
+                    chain = l_o[i]
+                else:
+                    grp[i] = False
+                    chain = max(chain, l_o[i])
+
+        gs = np.nonzero(grp)[0]
+        m_key = k_o[gs]
+        m_start = s_o[gs]  # group min: sorted by start within key
+        m_last = np.maximum.reduceat(l_o, gs)
+        m_sum = (np.add.reduceat(all_sum[order], gs, axis=0)
+                 if all_sum.shape[1] else np.zeros((len(gs), 0), np.float32))
+        m_max = (np.maximum.reduceat(all_max[order], gs, axis=0)
+                 if all_max.shape[1] else np.zeros((len(gs), 0), np.float32))
+        m_min = (np.minimum.reduceat(all_min[order], gs, axis=0)
+                 if all_min.shape[1] else np.zeros((len(gs), 0), np.float32))
+        m_count = np.add.reduceat(all_count[order], gs)
+        fired_any = np.logical_or.reduceat(all_fired[order], gs)
+        refire_any = np.logical_or.reduceat(all_refire[order], gs)
+        new_any = np.logical_or.reduceat(is_new[order], gs)
+        size1 = np.append(gs[1:], len(order)) - gs == 1
+
+        # untouched singleton registry spans pass through unchanged; any
+        # group absorbing new content resets fired and inherits refire:
+        # a late merge into a FIRED span, or a segment already complete
+        # at the current watermark, (re-)fires at the next advance
+        complete_now = (self.watermark != LONG_MIN) & (
+            m_last + gap - 1 <= self.watermark)
+        passthrough = size1 & ~new_any
+        m_fired = np.where(passthrough, fired_any, False)
+        m_refire = np.where(passthrough, refire_any,
+                            fired_any | refire_any | complete_now)
+        if bool(m_refire.any()):
             self._has_refire = True
-        keep.append(merged)
-        keep.sort(key=lambda s: s.start)
-        self._spans[key] = keep
+
+        st.insert_sorted((m_key, m_start, m_last, m_sum, m_max, m_min,
+                          m_count, m_fired, m_refire))
 
     # -- time ------------------------------------------------------------
     def advance_watermark(self, wm: int):
@@ -174,89 +287,101 @@ class SessionOperator:
 
         if wm < self.watermark and not self._has_refire:
             return FiredWindows(data=self._empty())
+        self.state_version += 1
         self.watermark = max(self.watermark, wm)
         self._has_refire = False
-        out_rows: List[Tuple[int, _Span]] = []
-        for key, spans in list(self._spans.items()):
-            retained: List[_Span] = []
-            for sp in spans:
-                end = sp.last_ts + self.gap
-                complete = end - 1 <= self.watermark
-                # merges always produce fired=False spans, so an
-                # incomplete refire-flagged span fires naturally at its
-                # (new, later) completion — emit only when complete
-                if complete and (not sp.fired or sp.refire):
-                    out_rows.append((key, sp))
-                sp.refire = False
-                if end - 1 + self.lateness <= self.watermark:
-                    continue  # retention over: drop
-                if complete:
-                    sp.fired = True
-                retained.append(sp)
-            if retained:
-                self._spans[key] = retained
-            else:
-                self._spans.pop(key, None)
-        if not out_rows:
+        st = self._store
+        if not len(st):
             return FiredWindows(data=self._empty())
-        for _, sp in out_rows:
-            sp.fired = True
-        return FiredWindows(data=self._emit(out_rows))
+        end1 = st.last + self.gap - 1
+        complete = end1 <= self.watermark
+        emit = complete & (~st.fired | st.refire)
+        rows = (self._emit(st._take(np.nonzero(emit)[0]))
+                if emit.any() else None)
+        st.fired |= complete
+        st.refire[:] = False
+        dead = end1 + self.lateness <= self.watermark
+        if dead.any():
+            st._filter(~dead)
+        if rows is None:
+            return FiredWindows(data=self._empty())
+        return FiredWindows(data=rows)
 
-    def _emit(self, rows: List[Tuple[int, _Span]]) -> Dict[str, np.ndarray]:
+    def _emit(self, cols: Tuple[np.ndarray, ...]) -> Dict[str, np.ndarray]:
         import jax
 
-        n = len(rows)
-        sums = np.stack([sp.sums for _, sp in rows]) if n else np.zeros((0, self.agg.sum_width), np.float32)
-        maxs = np.stack([sp.maxs for _, sp in rows]) if n else np.zeros((0, self.agg.max_width), np.float32)
-        mins = np.stack([sp.mins for _, sp in rows]) if n else np.zeros((0, self.agg.min_width), np.float32)
-        counts = np.array([sp.count for _, sp in rows], np.int32)
+        key, start, last, sums, maxs, mins, count, _, _ = cols
         cpu = jax.local_devices(backend="cpu")[0]
         with jax.default_device(cpu):
             import jax.numpy as jnp
 
-            res = self.agg.finalize(jnp.asarray(sums), jnp.asarray(maxs),
-                                    jnp.asarray(mins), jnp.asarray(counts))
+            res = self.agg.finalize(
+                jnp.asarray(sums), jnp.asarray(maxs), jnp.asarray(mins),
+                jnp.asarray(count.astype(np.int32)))
         out = {
-            "key": np.array([k for k, _ in rows], np.int64),
-            "window_start": np.array([sp.start for _, sp in rows], np.int64),
-            "window_end": np.array([sp.last_ts + self.gap for _, sp in rows], np.int64),
-            "count": counts,
+            "key": key.astype(np.int64),
+            "window_start": start.astype(np.int64),
+            "window_end": (last + self.gap).astype(np.int64),
+            "count": count.astype(np.int32),
         }
+        # finalize's fields win, including one named "count" — an
+        # aggregate built with result_field="count" must not have its
+        # output shadowed by the raw record count
         for k, v in res.items():
             out[k] = np.asarray(v)
         return out
 
     def _empty(self) -> Dict[str, np.ndarray]:
         if not hasattr(self, "_empty_cache"):
-            self._empty_cache = self._emit([])
+            w = self.agg
+            self._empty_cache = self._emit((
+                np.zeros(0, np.int64), np.zeros(0, np.int64),
+                np.zeros(0, np.int64), np.zeros((0, w.sum_width), np.float32),
+                np.zeros((0, w.max_width), np.float32),
+                np.zeros((0, w.min_width), np.float32),
+                np.zeros(0, np.int64), np.zeros(0, bool), np.zeros(0, bool)))
         return dict(self._empty_cache)
 
     def final_watermark(self) -> int:
-        mx = LONG_MIN
-        for spans in self._spans.values():
-            for sp in spans:
-                mx = max(mx, sp.last_ts)
-        if mx == LONG_MIN:
+        if not len(self._store):
             return self.watermark if self.watermark != LONG_MIN else 0
-        return mx + self.gap + self.lateness + 1
+        return int(self._store.last.max()) + self.gap + self.lateness + 1
 
     # -- snapshot --------------------------------------------------------
     def snapshot_state(self) -> Dict[str, Any]:
+        st = self._store
         return {
             "watermark": self.watermark,
             "late_records": self.late_records,
-            "spans": {
-                k: [(sp.start, sp.last_ts, sp.sums.copy(), sp.maxs.copy(),
-                     sp.mins.copy(), sp.count, sp.fired, sp.refire) for sp in v]
-                for k, v in self._spans.items()
-            },
+            "columns": {c: getattr(st, c).copy() for c in st._COLS},
         }
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
         self.watermark = snap["watermark"]
         self.late_records = snap["late_records"]
-        self._spans = {
-            k: [_Span(*t) for t in v] for k, v in snap["spans"].items()
-        }
-        self._has_refire = any(sp.refire for v in self._spans.values() for sp in v)
+        st = _SpanStore(self.agg.sum_width, self.agg.max_width,
+                        self.agg.min_width)
+        if "columns" in snap:
+            for c in st._COLS:
+                # copy: advance_watermark mutates columns in place
+                # (fired |= ..., refire[:] = ...); aliasing the caller's
+                # snapshot would corrupt it for reuse (recovery retries,
+                # rescale fan-out)
+                setattr(st, c, np.array(snap["columns"][c]))
+        else:  # legacy per-key dict format (pre-columnar checkpoints)
+            rows = [(k, s0, s1, su, mx, mn, ct, fi, rf)
+                    for k, spans in snap["spans"].items()
+                    for (s0, s1, su, mx, mn, ct, fi, rf) in spans]
+            rows.sort(key=lambda r: (r[0], r[1]))
+            if rows:
+                st.key = np.array([r[0] for r in rows], np.int64)
+                st.start = np.array([r[1] for r in rows], np.int64)
+                st.last = np.array([r[2] for r in rows], np.int64)
+                st.sums = np.stack([r[3] for r in rows]).astype(np.float32)
+                st.maxs = np.stack([r[4] for r in rows]).astype(np.float32)
+                st.mins = np.stack([r[5] for r in rows]).astype(np.float32)
+                st.count = np.array([r[6] for r in rows], np.int64)
+                st.fired = np.array([r[7] for r in rows], bool)
+                st.refire = np.array([r[8] for r in rows], bool)
+        self._store = st
+        self._has_refire = bool(st.refire.any())
