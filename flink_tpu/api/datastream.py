@@ -371,16 +371,20 @@ class WindowedJoin:
         left_fields: Sequence[str] = (),
         right_fields: Sequence[str] = (),
         name: str = "window_join",
+        mode: str = "pairs",
     ) -> DataStream:
-        """Emit one row per (key, window) present on BOTH sides, carrying
-        selected aggregated fields from each (see ops/join.py for the
-        exact per-pair semantics vs the reference's cross-product)."""
+        """``mode='pairs'`` (default): one row per matching left×right
+        pair — the reference's exact JoinFunction semantics.
+        ``mode='aggregate'``: one row per (key, window) present on both
+        sides with per-side count + max-carried fields (cogroup-style
+        summary). See ops/join.py."""
         env = self.b._left.env
         t = WindowJoinTransformation(
             name, (self.b._left.transform, self.b._right.transform),
             assigner=self.assigner,
             left_key=self.b._left_key or "key",
             right_key=self.b._right_key or "key",
-            left_fields=tuple(left_fields), right_fields=tuple(right_fields))
+            left_fields=tuple(left_fields), right_fields=tuple(right_fields),
+            mode=mode)
         env._register(t)
         return DataStream(env, t)

@@ -122,6 +122,7 @@ class WindowJoinTransformation(Transformation):
     right_key: str = "key"
     left_fields: Tuple[str, ...] = ()
     right_fields: Tuple[str, ...] = ()
+    mode: str = "pairs"  # "pairs" (exact) | "aggregate" (cogroup summary)
 
 
 @dataclasses.dataclass(eq=False)

@@ -32,9 +32,9 @@ import numpy as np
 
 from flink_tpu.api.windowing import WindowAssigner
 from flink_tpu.ops.aggregates import LaneAggregate
+from flink_tpu.ops.host_control import HostPaneControl
 from flink_tpu.ops.window import FiredWindows, WindowPlan, _empty_fired
 from flink_tpu.state.spill import HostSpillStore
-from flink_tpu.time.watermarks import LONG_MIN
 
 
 class WindowAllOperator:
@@ -54,15 +54,17 @@ class WindowAllOperator:
             allowed_lateness_ms=allowed_lateness_ms,
             max_out_of_orderness_ms=max_out_of_orderness_ms)
         self.store = HostSpillStore(agg)
-        self.watermark = LONG_MIN
-        self.late_records = 0
+        self.ctl = HostPaneControl(self.plan)
         self.state_version = 0
-        self._refire: set[int] = set()
-        self._cleared_below = self.plan.first_dead_pane(LONG_MIN)
-        self._fired_below_end: Optional[int] = None
-        self._min_pane_seen: Optional[int] = None
-        self._max_pane_seen: Optional[int] = None
         self._empty_cache: Optional[Dict[str, np.ndarray]] = None
+
+    @property
+    def watermark(self) -> int:
+        return self.ctl.watermark
+
+    @property
+    def late_records(self) -> int:
+        return self.ctl.late_records
 
     # -- data plane ------------------------------------------------------
 
@@ -76,26 +78,9 @@ class WindowAllOperator:
         ts = np.asarray(ts, dtype=np.int64)
         b = len(ts)
         valid = np.ones(b, bool) if valid is None else np.asarray(valid, bool)
-        panes = self.plan.pane_of(ts)
-
-        late = valid & (panes < self._cleared_below)
-        self.late_records += int(late.sum())
-        valid = valid & ~late
+        panes, valid = self.ctl.absorb_panes(ts, valid)
         if not valid.any():
             return
-        mn, mx = int(panes[valid].min()), int(panes[valid].max())
-        if self._min_pane_seen is None or mn < self._min_pane_seen:
-            self._min_pane_seen = mn
-        if self._max_pane_seen is None or mx > self._max_pane_seen:
-            self._max_pane_seen = mx
-
-        # late-but-allowed records re-fire already-fired windows with
-        # updated contents (same shared rule as WindowOperator)
-        if self._fired_below_end is not None:
-            late_ok = valid & (panes < self._fired_below_end)
-            if late_ok.any():
-                self._refire.update(self.plan.late_refire_ends(
-                    panes[late_ok], self._fired_below_end, self.watermark))
 
         sub = {k: np.asarray(data[k])[valid] for k in
                (self.agg.fields if self.agg.fields is not None else data)}
@@ -105,25 +90,15 @@ class WindowAllOperator:
     # -- time plane ------------------------------------------------------
 
     def advance_watermark(self, wm: int) -> FiredWindows:
-        if wm < self.watermark or (wm == self.watermark and not self._refire):
+        ends = self.ctl.begin_advance(wm)
+        if ends is None:
             return self._empty()
         self.state_version += 1
-        prev = self.watermark
-        self.watermark = wm
-        ends = sorted(set(self.plan.enumerate_fire_ends(
-            prev, wm, self._min_pane_seen, self._max_pane_seen))
-            | self._refire)
-        frontier = self.plan.fire_frontier(wm)
-        if self._fired_below_end is None or frontier > self._fired_below_end:
-            self._fired_below_end = frontier
-        self._refire.clear()
-
         rows = self.store.fire(ends, self.plan.panes_per_window,
                                self.plan.pane_ms, self.plan.offset_ms,
                                self.plan.size_ms)
-        new_dead = self.plan.first_dead_pane(wm)
-        if new_dead > self._cleared_below:
-            self._cleared_below = new_dead
+        new_dead = self.ctl.purge_horizon(wm)
+        if new_dead is not None:
             self.store.purge_below(new_dead)
         if rows is None:
             return self._empty()
@@ -131,8 +106,7 @@ class WindowAllOperator:
         return FiredWindows(data=rows)
 
     def final_watermark(self) -> int:
-        return self.plan.final_watermark_for(
-            self.watermark, self._max_pane_seen)
+        return self.ctl.final_watermark()
 
     def quiesce(self) -> None:
         pass
@@ -153,21 +127,9 @@ class WindowAllOperator:
         return {
             "kind": "window_all",
             "store": self.store.snapshot(),
-            "watermark": self.watermark,
-            "late_records": self.late_records,
-            "refire": sorted(self._refire),
-            "cleared_below": self._cleared_below,
-            "fired_below_end": self._fired_below_end,
-            "min_pane_seen": self._min_pane_seen,
-            "max_pane_seen": self._max_pane_seen,
+            **self.ctl.snapshot(),
         }
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
         self.store.restore(snap["store"])
-        self.watermark = snap["watermark"]
-        self.late_records = snap["late_records"]
-        self._refire = set(snap["refire"])
-        self._cleared_below = snap["cleared_below"]
-        self._fired_below_end = snap["fired_below_end"]
-        self._min_pane_seen = snap["min_pane_seen"]
-        self._max_pane_seen = snap["max_pane_seen"]
+        self.ctl.restore(snap)

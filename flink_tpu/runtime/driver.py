@@ -207,6 +207,7 @@ class Driver:
                     left_fields=t.left_fields, right_fields=t.right_fields,
                     num_shards=num_shards, slots_per_shard=slots,
                     max_out_of_orderness_ms=max(wm.max_out_of_orderness_ms, 0),
+                    mode=getattr(t, "mode", "pairs"),
                 )
 
     # -- checkpointing ---------------------------------------------------

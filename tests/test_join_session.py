@@ -26,7 +26,7 @@ class TestWindowJoinOperator:
         op = WindowJoinOperator(
             TumblingEventTimeWindows.of(1000),
             left_fields=("price",), right_fields=("name",),
-            num_shards=8, slots_per_shard=16)
+            num_shards=8, slots_per_shard=16, mode="aggregate")
         # window [0,1000): keys 1,2 left; keys 2,3 right → join on 2
         op.process_left(np.array([1, 2]), np.array([100, 200]),
                         {"price": np.array([10.0, 20.0], np.float32)})
@@ -40,7 +40,8 @@ class TestWindowJoinOperator:
 
     def test_join_counts_multiplicity(self):
         op = WindowJoinOperator(TumblingEventTimeWindows.of(1000),
-                                num_shards=8, slots_per_shard=16)
+                                num_shards=8, slots_per_shard=16,
+                                mode="aggregate")
         op.process_left(np.array([1, 1, 1]), np.array([10, 20, 30]), {})
         op.process_right(np.array([1, 1]), np.array([40, 50]), {})
         f = op.advance_watermark(1000)
@@ -82,12 +83,93 @@ class TestWindowJoinOperator:
             .collect()
         )
         env.execute()
-        rows = {(int(r["key"]), int(r["window_start"])):
-                (int(r["left_count"]), int(r["right_count"]), float(r["right_reserve"]))
-                for r in sink.rows}
-        # window [0,1000): person 1 (left) matches 2 auctions (right max reserve 7)
-        # person 3: left at window 1, right at window 2 → no join
-        assert rows == {(1, 0): (1, 2, 7.0)}
+        rows = sorted((int(r["key"]), int(r["window_start"]),
+                       float(r["left_age"]), float(r["right_reserve"]))
+                      for r in sink.rows)
+        # pairs mode (default): person 1 (left) x 2 auctions -> TWO rows
+        # person 3: left at window 1, right at window 2 -> no join
+        assert rows == [(1, 0, 30.0, 5.0), (1, 0, 30.0, 7.0)]
+
+
+class TestWindowJoinPairs:
+    """Exact cross-product semantics (the reference's JoinFunction
+    contract): one output row per matching left x right pair."""
+
+    def test_multi_auction_seller_emits_all_pairs(self):
+        """The round-2 weakness: multi-auction sellers collapsed into
+        one max-carried row. Pairs mode must emit every pair."""
+        op = WindowJoinOperator(
+            TumblingEventTimeWindows.of(1000),
+            left_fields=("age",), right_fields=("reserve",),
+            num_shards=8, slots_per_shard=16)
+        op.process_left(np.array([1]), np.array([100]),
+                        {"age": np.array([30.0], np.float32)})
+        op.process_right(np.array([1, 1, 1]), np.array([200, 300, 400]),
+                         {"reserve": np.array([5.0, 7.0, 9.0], np.float32)})
+        f = op.advance_watermark(1000)
+        rows = sorted((int(k), float(a), float(r)) for k, a, r in
+                      zip(f["key"], f["left_age"], f["right_reserve"]))
+        assert rows == [(1, 30.0, 5.0), (1, 30.0, 7.0), (1, 30.0, 9.0)]
+
+    def test_m_by_n_cross_product(self):
+        op = WindowJoinOperator(TumblingEventTimeWindows.of(1000),
+                                left_fields=("a",), right_fields=("b",),
+                                num_shards=8, slots_per_shard=16)
+        op.process_left(np.array([7, 7, 9]), np.array([10, 20, 30]),
+                        {"a": np.array([1.0, 2.0, 3.0], np.float32)})
+        op.process_right(np.array([7, 7, 7, 9]), np.array([40, 50, 60, 70]),
+                         {"b": np.array([10.0, 20.0, 30.0, 40.0], np.float32)})
+        f = op.advance_watermark(1000)
+        got = sorted((int(k), float(a), float(b)) for k, a, b in
+                     zip(f["key"], f["left_a"], f["right_b"]))
+        want = sorted([(7, a, b) for a in (1.0, 2.0) for b in (10.0, 20.0, 30.0)]
+                      + [(9, 3.0, 40.0)])
+        assert got == want
+
+    def test_late_record_refires_full_pair_set(self):
+        op = WindowJoinOperator(TumblingEventTimeWindows.of(1000),
+                                right_fields=("v",),
+                                allowed_lateness_ms=5000,
+                                num_shards=8, slots_per_shard=16)
+        op.process_left(np.array([1]), np.array([100]), {})
+        op.process_right(np.array([1]), np.array([200]),
+                         {"v": np.array([5.0], np.float32)})
+        f = op.advance_watermark(1500)
+        assert len(f["key"]) == 1
+        # late right-side row within lateness -> window refires with the
+        # UPDATED full pair set (now 2 pairs)
+        op.process_right(np.array([1]), np.array([300]),
+                         {"v": np.array([9.0], np.float32)})
+        f = op.advance_watermark(1500)
+        assert sorted(float(v) for v in f["right_v"]) == [5.0, 9.0]
+
+    def test_snapshot_restore_roundtrip(self):
+        def mk():
+            return WindowJoinOperator(
+                TumblingEventTimeWindows.of(1000), left_fields=("a",),
+                num_shards=8, slots_per_shard=16)
+
+        a = mk()
+        a.process_left(np.array([1, 1]), np.array([100, 200]),
+                       {"a": np.array([1.0, 2.0], np.float32)})
+        b = mk()
+        b.restore_state(a.snapshot_state())
+        for op in (a, b):
+            op.process_right(np.array([1]), np.array([300]), {})
+        fa = dict(a.advance_watermark(2000))
+        fb = dict(b.advance_watermark(2000))
+        assert sorted(map(float, fa["left_a"])) == \
+            sorted(map(float, fb["left_a"])) == [1.0, 2.0]
+
+    def test_mode_mismatch_restore_refuses(self):
+        a = WindowJoinOperator(TumblingEventTimeWindows.of(1000),
+                               num_shards=8, slots_per_shard=16)
+        snap = a.snapshot_state()
+        b = WindowJoinOperator(TumblingEventTimeWindows.of(1000),
+                               num_shards=8, slots_per_shard=16,
+                               mode="aggregate")
+        with pytest.raises(ValueError, match="mode"):
+            b.restore_state(snap)
 
 
 class TestSessionScaleAndFuzz:
