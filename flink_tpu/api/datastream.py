@@ -158,6 +158,20 @@ class KeyedStream(DataStream):
         for the documented batching semantics."""
         return CountWindowedStream(self, size, purge=True)
 
+    def process(self, fn: Any, name: str = "keyed_process") -> "DataStream":
+        """General keyed processing with state + timers (ref: KeyedStream
+        .process(KeyedProcessFunction)). ``fn`` implements
+        api.functions.KeyedProcessFunction — batch-vectorized hooks, or
+        the per-element adapter."""
+        from flink_tpu.graph.transformations import KeyedProcessTransformation
+
+        kt = self.transform
+        assert isinstance(kt, KeyByTransformation)
+        t = KeyedProcessTransformation(
+            name, (kt,), fn=fn, key_field=kt.key_field)
+        self.env._register(t)
+        return DataStream(self.env, t)
+
     # keyed reduce without windows = running aggregate over an eternal
     # window; expressible via GlobalWindows + custom trigger (later).
 

@@ -64,7 +64,8 @@ class ReduceFunction:
     """Commutative+associative combine of two values of the same type
     (ref: ReduceFunction.java). Must be expressible as elementwise
     sum/min/max lanes for the dense pane path (SURVEY §8 lane design);
-    arbitrary reduces go through the sort+scan fallback."""
+    anything else is rejected at lowering time with a pointer to
+    composing ops.aggregates lanes (no silent wrong answers)."""
 
     def reduce(self, a: Dict[str, jax.Array], b: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
         raise NotImplementedError
@@ -75,8 +76,9 @@ class AggregateFunction:
     createAccumulator/add/merge/getResult). The accumulator is a pytree
     of scalars; ``add`` and ``merge`` must be jax-traceable. The window
     operator lowers instances whose merge is a per-leaf sum/min/max to
-    the dense lane layout automatically (ops/aggregates.lower_aggregate);
-    others use the generic sort+segment-scan path."""
+    the dense lane layout automatically (ops/aggregates.lower_aggregate
+    probes the merge); anything else raises at lowering time with a
+    pointer to composing ops.aggregates lanes — loud, never wrong."""
 
     def create_accumulator(self) -> Any:
         raise NotImplementedError
@@ -106,6 +108,39 @@ class ProcessWindowFunction:
         valid: jax.Array,
     ) -> Any:
         return results
+
+
+class KeyedProcessFunction:
+    """General keyed processing with state and timers (ref: streaming/
+    api/functions/KeyedProcessFunction.java via KeyedProcessOperator).
+
+    Native authoring style is per-BATCH: override ``process_batch(ctx)``
+    and ``on_timer(ctx)`` — ``ctx`` (ops/process.ProcessContext) carries
+    the microbatch as struct-of-arrays (``ctx.keys/slots/timestamps/
+    data``), columnar state handles (``ctx.value_state/list_state/
+    map_state``), vectorized timer registration, and ``ctx.emit``.
+
+    The reference's element-at-a-time style is available by overriding
+    ``process_element(key, ts, row, ctx, slot)`` instead — the default
+    ``process_batch`` loops it over the batch (host-loop speed; use it
+    only when the logic is truly sequential per record)."""
+
+    def process_batch(self, ctx) -> None:
+        import numpy as np
+
+        for i in range(len(ctx.keys)):
+            row = {k: v[i] for k, v in ctx.data.items()}
+            self.process_element(int(ctx.keys[i]), int(ctx.timestamps[i]),
+                                 row, ctx, int(ctx.slots[i]))
+
+    def process_element(self, key: int, ts: int, row: Dict[str, Any],
+                        ctx, slot: int) -> None:
+        raise NotImplementedError(
+            "override process_batch (vectorized) or process_element")
+
+    def on_timer(self, ctx) -> None:
+        """Called once per watermark advance with ALL due timers as
+        arrays (ctx.keys/slots/timestamps)."""
 
 
 # -- convenience lambdas -----------------------------------------------------

@@ -24,6 +24,7 @@ from flink_tpu.graph.transformations import (
     KeyByTransformation,
     MapTransformation,
     CountWindowAggregateTransformation,
+    KeyedProcessTransformation,
     SessionAggregateTransformation,
     WindowAllAggregateTransformation,
     SinkTransformation,
@@ -139,6 +140,11 @@ def compile_job(
         elif isinstance(t, WindowAggregateTransformation):
             up = node_for(t.inputs[0])
             n = new_node("window", t.name, window_transform=t,
+                         key_field=t.key_field)
+            nodes[up].downstream.append(n.id)
+        elif isinstance(t, KeyedProcessTransformation):
+            up = node_for(t.inputs[0])
+            n = new_node("process", t.name, window_transform=t,
                          key_field=t.key_field)
             nodes[up].downstream.append(n.id)
         elif isinstance(t, WindowAllAggregateTransformation):

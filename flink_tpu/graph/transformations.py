@@ -89,6 +89,15 @@ class WindowAggregateTransformation(Transformation):
 
 
 @dataclasses.dataclass(eq=False)
+class KeyedProcessTransformation(Transformation):
+    """Keyed process function with state + timers (ref: KeyedStream
+    .process → KeyedProcessOperator; see ops/process.py)."""
+
+    fn: Any = None  # api.functions.KeyedProcessFunction
+    key_field: str = "key"
+
+
+@dataclasses.dataclass(eq=False)
 class WindowAllAggregateTransformation(Transformation):
     """Non-keyed global window + aggregate (ref: DataStream.windowAll →
     AllWindowedStream at parallelism 1; here a host-side pane reduce

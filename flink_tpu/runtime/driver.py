@@ -169,6 +169,12 @@ class Driver:
                 # ingest loop calls throttle() after releasing it), so
                 # drain deliveries never queue behind a transfer wait
                 self._ops[n.id].external_throttle = True
+            elif n.kind == "process":
+                from flink_tpu.ops.process import KeyedProcessOperator
+
+                t = n.window_transform
+                self._ops[n.id] = KeyedProcessOperator(
+                    t.fn, num_shards=num_shards, slots_per_shard=slots)
             elif n.kind == "window_all":
                 from flink_tpu.ops.window_all import WindowAllOperator
 
@@ -625,14 +631,14 @@ class Driver:
             dev_data = {k: v for k, v in data.items()
                         if np.asarray(v).dtype != object}
             op.process_batch(ts, dev_data, valid)
-        elif n.kind in ("window", "session", "count_window"):
+        elif n.kind in ("window", "session", "count_window", "process"):
             op = self._ops[nid]
             keys = np.asarray(data[n.key_field], np.int64)
             dev_data = {k: v for k, v in data.items()
                         if np.asarray(v).dtype != object}
             op.process_batch(keys, ts, dev_data, valid)
-            if n.kind == "count_window":
-                # count fires are per-step, not per-watermark
+            if n.kind in ("count_window", "process"):
+                # these emit per-step, not (only) per-watermark
                 fired = op.take_fired()
                 if fired is not None:
                     self._emit_fired(nid, fired)
@@ -668,7 +674,8 @@ class Driver:
             # count_window is deliberately absent: it is event-time-blind
             # (fires ride process_batch), so advancing it would only
             # queue guaranteed-empty fires through the drain
-            if n.kind in ("window", "session", "join", "window_all"):
+            if n.kind in ("window", "session", "join", "window_all",
+                          "process"):
                 op = self._ops[nid]
                 wm = in_wm
                 if in_wm == _FINAL:
@@ -695,12 +702,18 @@ class Driver:
 
     def _emit_fired_sync(self, nid: int, fired, stamp: float) -> None:
         out = dict(fired)
-        nrec = len(out.get("window_end", ()))  # every fired schema has it
-        # (keyed rows also carry "key"; windowAll rows deliberately don't)
+        if "__ts__" in out:
+            # process-function emissions: explicit per-row timestamps
+            ts = np.asarray(out.pop("__ts__"), np.int64)
+            nrec = len(ts)
+        else:
+            nrec = len(out.get("window_end", ()))  # windowed schemas
+            # (keyed rows also carry "key"; windowAll rows don't)
+            ts = (np.asarray(out["window_end"], np.int64) - 1
+                  if nrec else np.zeros(0, np.int64))
         if nrec == 0:
             return
         self.metrics["fired_windows"] += nrec
-        ts = np.asarray(out["window_end"], np.int64) - 1
         valid = np.ones(nrec, bool)
         self._push_downstream(nid, (out, ts, valid))
         # latency marker: watermark-advance dispatch → delivered at sink
@@ -721,7 +734,7 @@ class Driver:
                 seen.add(d)
                 k = self.plan.node(d).kind
                 if k in ("window", "session", "join", "count_window",
-                         "window_all"):
+                         "window_all", "process"):
                     ok = False
                     break
                 stack.extend(self.plan.node(d).downstream)

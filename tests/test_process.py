@@ -1,0 +1,293 @@
+"""KeyedProcessFunction + keyed state + user timers (ref:
+KeyedProcessOperator / InternalTimerServiceImpl test patterns: state
+updates per element, timers firing on watermark, timeout detection)."""
+import numpy as np
+import pytest
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.api.functions import KeyedProcessFunction
+from flink_tpu.api.sinks import CollectSink
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.config import Configuration
+from flink_tpu.ops.process import KeyedProcessOperator
+from flink_tpu.state.api import (
+    ListStateDescriptor, MapStateDescriptor, StateTtlConfig,
+    ValueStateDescriptor)
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+
+class RunningSum(KeyedProcessFunction):
+    """Emit the running per-key sum after every batch (vectorized)."""
+
+    def process_batch(self, ctx):
+        vs = ctx.value_state(ValueStateDescriptor("sum", 0.0))
+        # in-batch segment-accumulate, then one scatter into state
+        order = np.argsort(ctx.slots, kind="stable")
+        sl, v = ctx.slots[order], ctx.data["v"][order]
+        uniq, starts = np.unique(sl, return_index=True)
+        totals = np.add.reduceat(v.astype(np.float64), starts)
+        vs[uniq] = vs[uniq] + totals
+        ctx.emit({"key": ctx.keys[order][starts], "total": vs[uniq]},
+                 ts=ctx.timestamps[order][starts])
+
+
+class Dedup(KeyedProcessFunction):
+    """First-occurrence filter via a seen flag (classic dedup)."""
+
+    def process_batch(self, ctx):
+        seen = ctx.value_state(ValueStateDescriptor("seen", 0.0))
+        order = np.argsort(ctx.slots, kind="stable")
+        sl = ctx.slots[order]
+        first_in_batch = np.empty(len(sl), bool)
+        first_in_batch[0:1] = True
+        first_in_batch[1:] = sl[1:] != sl[:-1]
+        fresh = first_in_batch & (seen[sl] == 0.0)
+        seen[sl[fresh]] = 1.0
+        keep = order[fresh]
+        ctx.emit({"key": ctx.keys[keep]}, ts=ctx.timestamps[keep])
+
+
+class IdleTimeout(KeyedProcessFunction):
+    """Emit a timeout alert when a key sees no activity for ``gap`` ms —
+    the canonical KeyedProcessFunction timer example."""
+
+    def __init__(self, gap: int):
+        self.gap = gap
+
+    def process_batch(self, ctx):
+        last = ctx.value_state(ValueStateDescriptor("last_ts", -1.0))
+        order = np.argsort(ctx.slots, kind="stable")
+        sl, ts = ctx.slots[order], ctx.timestamps[order]
+        uniq, starts = np.unique(sl, return_index=True)
+        ends = np.append(starts[1:], len(sl))
+        mx = np.maximum.reduceat(ts, starts)
+        newer = mx > last[uniq]
+        last[uniq[newer]] = mx[newer].astype(np.float64)
+        ctx.register_event_time_timers(mx[newer] + self.gap,
+                                       slots=uniq[newer])
+
+    def on_timer(self, ctx):
+        last = ctx.value_state(ValueStateDescriptor("last_ts", -1.0))
+        # fire only if the timer still matches the latest activity
+        # (a newer record re-armed a later timer)
+        live = last[ctx.slots] + self.gap == ctx.timestamps
+        ctx.emit({"key": ctx.keys[live],
+                  "idle_since": last[ctx.slots[live]].astype(np.int64)},
+                 ts=ctx.timestamps[live])
+
+
+class TestOperatorDirect:
+    def test_running_sum(self):
+        op = KeyedProcessOperator(RunningSum(), num_shards=4,
+                                  slots_per_shard=16)
+        op.process_batch(np.array([1, 2, 1], np.int64),
+                         np.array([10, 20, 30], np.int64),
+                         {"v": np.array([1.0, 5.0, 2.0])})
+        f = dict(op.take_fired())
+        got = {int(k): float(t) for k, t in zip(f["key"], f["total"])}
+        assert got == {1: 3.0, 2: 5.0}
+        op.process_batch(np.array([1], np.int64), np.array([40], np.int64),
+                         {"v": np.array([4.0])})
+        f = dict(op.take_fired())
+        assert {int(k): float(t) for k, t in
+                zip(f["key"], f["total"])} == {1: 7.0}
+
+    def test_idle_timeout_timer(self):
+        op = KeyedProcessOperator(IdleTimeout(1000), num_shards=4,
+                                  slots_per_shard=16)
+        op.process_batch(np.array([7], np.int64), np.array([100], np.int64), {})
+        f = dict(op.advance_watermark(500))
+        assert len(f.get("key", ())) == 0          # not idle yet
+        f = dict(op.advance_watermark(1100))       # 100+1000 <= 1100
+        assert [int(k) for k in f["key"]] == [7]
+        assert [int(v) for v in f["idle_since"]] == [100]
+        # re-armed timer: new activity supersedes the old timer
+        op.process_batch(np.array([8], np.int64), np.array([2000], np.int64), {})
+        op.process_batch(np.array([8], np.int64), np.array([2500], np.int64), {})
+        f = dict(op.advance_watermark(3100))       # old timer (3000) stale
+        assert len(f.get("key", ())) == 0
+        f = dict(op.advance_watermark(3600))       # 2500+1000 fires
+        assert [int(k) for k in f["key"]] == [8]
+
+    def test_list_and_map_state(self):
+        class Collect(KeyedProcessFunction):
+            def process_batch(self, ctx):
+                ls = ctx.list_state(ListStateDescriptor("vals"))
+                ms = ctx.map_state(MapStateDescriptor("attrs"))
+                ls.append_batch(ctx.slots, ctx.data["v"])
+                ms.put_batch(ctx.slots, ctx.timestamps.tolist(),
+                             ctx.data["v"].tolist())
+
+            def on_timer(self, ctx):
+                pass
+
+        fn = Collect()
+        op = KeyedProcessOperator(fn, num_shards=4, slots_per_shard=16)
+        op.process_batch(np.array([1, 1, 2], np.int64),
+                         np.array([10, 20, 30], np.int64),
+                         {"v": np.array([1.0, 2.0, 3.0])})
+        slot1 = int(op.directory.assign(np.array([1], np.int64))[0])
+        ls = op._states["vals"]
+        assert ls.get(slot1) == [1.0, 2.0]
+        ms = op._states["attrs"]
+        assert ms.get(slot1) == {10: 1.0, 20: 2.0}
+
+    def test_value_state_ttl_expires(self):
+        desc = ValueStateDescriptor("x", 0.0, ttl=StateTtlConfig(1000))
+
+        class Ttl(KeyedProcessFunction):
+            def process_batch(self, ctx):
+                vs = ctx.value_state(desc)
+                cur = vs.get(ctx.slots, int(ctx.timestamps.max()))
+                vs.update(ctx.slots, cur + ctx.data["v"],
+                          int(ctx.timestamps.max()))
+                ctx.emit({"key": ctx.keys, "x": vs[ctx.slots]})
+
+        op = KeyedProcessOperator(Ttl(), num_shards=4, slots_per_shard=16)
+        op.process_batch(np.array([1], np.int64), np.array([100], np.int64),
+                         {"v": np.array([5.0])})
+        op.take_fired()
+        # second write 2000ms later: the old value expired (ttl 1000)
+        op.process_batch(np.array([1], np.int64), np.array([2100], np.int64),
+                         {"v": np.array([3.0])})
+        f = dict(op.take_fired())
+        assert [float(x) for x in f["x"]] == [3.0]
+
+    def test_per_element_adapter(self):
+        class Alternate(KeyedProcessFunction):
+            """Emit every 2nd record per key — sequential logic, authored
+            per element (the reference's style)."""
+
+            def process_element(self, key, ts, row, ctx, slot):
+                vs = ctx.value_state(ValueStateDescriptor("n", 0.0))
+                vs[slot] = vs[slot] + 1
+                if int(vs[slot]) % 2 == 0:
+                    ctx.emit({"key": np.array([key], np.int64)},
+                             ts=np.array([ts], np.int64))
+
+        op = KeyedProcessOperator(Alternate(), num_shards=4,
+                                  slots_per_shard=16)
+        op.process_batch(np.array([1, 1, 1, 2], np.int64),
+                         np.array([10, 20, 30, 40], np.int64), {})
+        f = dict(op.take_fired())
+        assert [int(k) for k in f["key"]] == [1]  # 1's 2nd record only
+
+    def test_snapshot_restore_roundtrip(self):
+        def mk():
+            return KeyedProcessOperator(RunningSum(), num_shards=4,
+                                        slots_per_shard=16)
+
+        a = mk()
+        a.process_batch(np.array([1], np.int64), np.array([10], np.int64),
+                        {"v": np.array([5.0])})
+        a.take_fired()
+        snap = a.snapshot_state()
+        b = mk()
+        b.restore_state(snap)
+        b.process_batch(np.array([1], np.int64), np.array([20], np.int64),
+                        {"v": np.array([2.0])})
+        f = dict(b.take_fired())
+        assert [float(t) for t in f["total"]] == [7.0]
+
+
+class TestRegressions:
+    def test_restore_empty_timers_then_final_watermark(self):
+        a = KeyedProcessOperator(Dedup(), num_shards=4, slots_per_shard=16)
+        snap = a.snapshot_state()  # zero timers
+        b = KeyedProcessOperator(Dedup(), num_shards=4, slots_per_shard=16)
+        b.restore_state(snap)
+        assert b.final_watermark() == 0  # must not crash on empty set
+
+    def test_ttl_state_rejects_unstamped_write(self):
+        op = KeyedProcessOperator(Dedup(), num_shards=4, slots_per_shard=16)
+        vs = op._state(ValueStateDescriptor("t", 0.0,
+                                            ttl=StateTtlConfig(100)),
+                       __import__("flink_tpu.state.api",
+                                  fromlist=["ValueStateVector"]).ValueStateVector)
+        with pytest.raises(TypeError, match="update"):
+            vs[np.array([0])] = 1.0
+
+    def test_partial_emit_without_ts_raises(self):
+        class Bad(KeyedProcessFunction):
+            def process_batch(self, ctx):
+                ctx.emit({"key": ctx.keys[:1]})  # 1 of 2 rows, no ts
+
+        op = KeyedProcessOperator(Bad(), num_shards=4, slots_per_shard=16)
+        with pytest.raises(ValueError, match="full-batch"):
+            op.process_batch(np.array([1, 2], np.int64),
+                             np.array([10, 20], np.int64), {})
+
+    def test_filtered_records_consume_no_slots(self):
+        op = KeyedProcessOperator(Dedup(), num_shards=1, slots_per_shard=2)
+        keys = np.arange(100, dtype=np.int64)
+        valid = np.zeros(100, bool)
+        valid[:2] = True  # only keys 0,1 are real
+        op.process_batch(keys, np.zeros(100, np.int64), {}, valid)
+        assert op.directory.num_keys() == 2  # 98 filtered keys: no slots
+        assert op.records_dropped_full == 0
+
+    def test_mixed_emit_schemas_raise(self):
+        class Mixed(KeyedProcessFunction):
+            def process_batch(self, ctx):
+                ctx.emit({"a": ctx.keys}, ts=ctx.timestamps)
+                ctx.emit({"b": ctx.keys}, ts=ctx.timestamps)
+
+        op = KeyedProcessOperator(Mixed(), num_shards=4, slots_per_shard=16)
+        op.process_batch(np.array([1], np.int64), np.array([10], np.int64), {})
+        with pytest.raises(ValueError, match="schemas"):
+            op.take_fired().materialize()
+
+    def test_timer_dedup_and_delete(self):
+        from flink_tpu.ops.process import TimerService
+
+        t = TimerService()
+        t.register_batch(np.array([3, 3, 5]), np.array([100, 100, 200]))
+        t.register_batch(np.array([5]), np.array([150]))
+        t.delete_batch(np.array([5]), np.array([200]))
+        s, ts = t.due(1000)
+        assert list(zip(s.tolist(), ts.tolist())) == [(3, 100), (5, 150)]
+        assert t.pending_count == 0
+
+
+class TestProcessE2E:
+    def test_dedup_pipeline(self):
+        def gen(split, i):
+            if i >= 3:
+                return None
+            ks = np.array([[1, 2, 1], [2, 3, 3], [1, 4, 2]][i], np.int64)
+            return {"k": ks}, np.full(3, i * 100, np.int64)
+
+        env = StreamExecutionEnvironment(Configuration(
+            {"pipeline.microbatch-size": 8,
+             "state.num-key-shards": 4, "state.slots-per-shard": 16}))
+        sink = CollectSink()
+        (env.from_source(GeneratorSource(gen),
+                         WatermarkStrategy.for_monotonous_timestamps())
+         .key_by("k")
+         .process(Dedup())
+         .add_sink(sink))
+        env.execute("dedup")
+        assert sorted(int(r["key"]) for r in sink.rows) == [1, 2, 3, 4]
+
+    def test_timeout_pipeline_fires_on_watermark(self):
+        def gen(split, i):
+            if i >= 4:
+                return None
+            if i == 0:
+                return {"k": np.array([5], np.int64)}, np.array([0], np.int64)
+            # keep the watermark advancing with other keys
+            return ({"k": np.array([9], np.int64)},
+                    np.array([i * 1000], np.int64))
+
+        env = StreamExecutionEnvironment(Configuration(
+            {"pipeline.microbatch-size": 8,
+             "state.num-key-shards": 4, "state.slots-per-shard": 16}))
+        sink = CollectSink()
+        (env.from_source(GeneratorSource(gen),
+                         WatermarkStrategy.for_monotonous_timestamps())
+         .key_by("k")
+         .process(IdleTimeout(1500))
+         .add_sink(sink))
+        env.execute("timeout")
+        fired = {int(r["key"]) for r in sink.rows}
+        assert 5 in fired  # idle after ts 0, alert at wm >= 1500
