@@ -109,6 +109,35 @@ class DataStream:
         self.env._register(t)
         return KeyedStream(self.env, t)
 
+    # -- non-keyed partitioning (ref: DataStream.{rebalance,rescale,
+    # shuffle,broadcast,global} → PartitionTransformation) --------------
+    def rebalance(self) -> "DataStream":
+        """Round-robin across parallel subtasks — exact equal spread."""
+        return self._partition("rebalance")
+
+    def rescale(self) -> "DataStream":
+        """Round-robin within the local scale group (never cross-host)."""
+        return self._partition("rescale")
+
+    def shuffle(self) -> "DataStream":
+        """Uniform-random subtask per record (seeded → replay-stable)."""
+        return self._partition("shuffle")
+
+    def broadcast(self) -> "DataStream":
+        """Replicate every record to every subtask."""
+        return self._partition("broadcast")
+
+    def global_(self) -> "DataStream":
+        """Send everything to subtask 0 (trailing underscore: ``global``
+        is a Python keyword)."""
+        return self._partition("global")
+
+    def _partition(self, strategy: str) -> "DataStream":
+        from flink_tpu.graph.transformations import PartitionTransformation
+
+        return self._append(PartitionTransformation(
+            strategy, (self.transform,), strategy=strategy))
+
     def window_all(self, assigner: WindowAssigner) -> "AllWindowedStream":
         """Global (non-keyed) window over ALL records (ref: DataStream.
         windowAll → AllWindowedStream). Lowered without the reference's

@@ -1,0 +1,160 @@
+"""Stream partitioners — record → parallel-subtask assignment.
+
+ref: streaming/runtime/partitioner/{RebalancePartitioner,
+RescalePartitioner,ShufflePartitioner,BroadcastPartitioner,
+GlobalPartitioner,KeyGroupStreamPartitioner}.java — the reference picks
+an output channel per RECORD inside the RecordWriter.
+
+TPU-first redesign: channel selection is a vectorized function from a
+batch to a (B,) subtask-index array (or a replication marker). In this
+runtime the "parallel subtasks" of a non-keyed exchange are mesh
+devices or runner processes; with a single local driver every strategy
+degenerates to pass-through (parallelism 1 — identical to the
+reference's behavior at parallelism 1), while the assignment math here
+is what the multi-runner scheduler and the mesh arrival-split consume.
+The keyed strategy (KeyGroupStreamPartitioner) is NOT here — keyBy's
+hash routing lives in exchange/keyby.py as the in-step all_to_all.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+class Partitioner:
+    """Assign each record of a batch to one subtask in [0, n)."""
+
+    #: True when every record goes to EVERY subtask (fan-out replication)
+    broadcast = False
+
+    def assign(self, b: int, n: int) -> np.ndarray:
+        """(B,) int32 subtask ids for a ``b``-record batch over ``n``
+        subtasks. Stateful strategies (round-robin cursors) persist
+        across calls and are part of the driver snapshot."""
+        raise NotImplementedError
+
+    def advance(self, b: int, n: int) -> None:
+        """Advance the routing state WITHOUT materializing assignments —
+        the parallelism-1 local path keeps cursors/streams deterministic
+        for replay without paying the per-batch allocation."""
+        self.assign(b, n)
+
+    def snapshot(self) -> dict:
+        return {}
+
+    def restore(self, snap: dict) -> None:
+        pass
+
+
+class RebalancePartitioner(Partitioner):
+    """Global round-robin (ref: RebalancePartitioner) — exact equal
+    spread regardless of batch sizes, cursor carried across batches."""
+
+    def __init__(self) -> None:
+        self.cursor = 0
+
+    def assign(self, b: int, n: int) -> np.ndarray:
+        out = ((self.cursor + np.arange(b)) % n).astype(np.int32)
+        self.cursor = int((self.cursor + b) % n)
+        return out
+
+    def advance(self, b: int, n: int) -> None:
+        self.cursor = int((self.cursor + b) % n)
+
+    def snapshot(self) -> dict:
+        return {"cursor": self.cursor}
+
+    def restore(self, snap: dict) -> None:
+        self.cursor = int(snap["cursor"])
+
+
+class RescalePartitioner(RebalancePartitioner):
+    """Round-robin within the LOCAL group only (ref: RescalePartitioner
+    — upstream task i feeds the downstream tasks of its own scale
+    group, never crossing hosts). ``group`` narrows [lo, hi) out of n."""
+
+    def __init__(self, group: Optional[tuple] = None) -> None:
+        super().__init__()
+        self.group = group
+
+    def assign(self, b: int, n: int) -> np.ndarray:
+        lo, hi = self.group if self.group is not None else (0, n)
+        width = max(hi - lo, 1)
+        out = (lo + (self.cursor + np.arange(b)) % width).astype(np.int32)
+        self.cursor = int((self.cursor + b) % width)
+        return out
+
+    def advance(self, b: int, n: int) -> None:
+        lo, hi = self.group if self.group is not None else (0, n)
+        self.cursor = int((self.cursor + b) % max(hi - lo, 1))
+
+
+class ShufflePartitioner(Partitioner):
+    """Uniform random (ref: ShufflePartitioner). COUNTER-BASED: each
+    call derives a fresh generator from (seed, call index), so routing
+    is a pure function of position in the stream — replay after
+    recovery reproduces it exactly regardless of batch-size history
+    (the reference's Random() is unseeded; determinism is strictly
+    stronger and keeps exactly-once replays byte-identical)."""
+
+    def __init__(self, seed: int = 0) -> None:
+        self._seed = seed
+        self._calls = 0
+
+    def assign(self, b: int, n: int) -> np.ndarray:
+        rng = np.random.default_rng((self._seed, self._calls))
+        self._calls += 1
+        return rng.integers(0, n, b).astype(np.int32)
+
+    def advance(self, b: int, n: int) -> None:
+        self._calls += 1
+
+    def snapshot(self) -> dict:
+        return {"seed": self._seed, "calls": self._calls}
+
+    def restore(self, snap: dict) -> None:
+        self._seed = int(snap["seed"])
+        self._calls = int(snap.get("calls", snap.get("draws", 0)))
+
+
+class BroadcastPartitioner(Partitioner):
+    """Every record to every subtask (ref: BroadcastPartitioner)."""
+
+    broadcast = True
+
+    def assign(self, b: int, n: int) -> np.ndarray:
+        raise RuntimeError(
+            "broadcast replicates; consumers check .broadcast instead "
+            "of calling assign()")
+
+
+class GlobalPartitioner(Partitioner):
+    """Everything to subtask 0 (ref: GlobalPartitioner)."""
+
+    def assign(self, b: int, n: int) -> np.ndarray:
+        return np.zeros(b, np.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class ForwardPartitioner(Partitioner):
+    """Stay on the local subtask (ref: ForwardPartitioner) — the
+    implicit strategy of a chained edge."""
+
+    def assign(self, b: int, n: int) -> np.ndarray:
+        return np.zeros(b, np.int32)
+
+
+def make_partitioner(strategy: str, seed: int = 0) -> Partitioner:
+    """``seed`` decorrelates stacked shuffle exchanges (pass the exec
+    node id); non-random strategies ignore it."""
+    if strategy == "shuffle":
+        return ShufflePartitioner(seed=seed)
+    return {
+        "rebalance": RebalancePartitioner,
+        "rescale": RescalePartitioner,
+        "broadcast": BroadcastPartitioner,
+        "global": GlobalPartitioner,
+        "forward": ForwardPartitioner,
+    }[strategy]()

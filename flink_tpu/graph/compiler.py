@@ -25,6 +25,7 @@ from flink_tpu.graph.transformations import (
     MapTransformation,
     CountWindowAggregateTransformation,
     KeyedProcessTransformation,
+    PartitionTransformation,
     SessionAggregateTransformation,
     WindowAllAggregateTransformation,
     SinkTransformation,
@@ -53,6 +54,8 @@ class ExecNode:
     # join: which input edge is left/right (by upstream node id)
     left_input: Optional[int] = None
     right_input: Optional[int] = None
+    # partition: non-keyed redistribution strategy (exchange boundary)
+    partition_strategy: Optional[str] = None
     name: str = ""
 
 
@@ -141,6 +144,12 @@ def compile_job(
             up = node_for(t.inputs[0])
             n = new_node("window", t.name, window_transform=t,
                          key_field=t.key_field)
+            nodes[up].downstream.append(n.id)
+        elif isinstance(t, PartitionTransformation):
+            # an exchange boundary: always its own node (breaks the
+            # chain — the isChainable rule excludes non-forward edges)
+            up = node_for(t.inputs[0])
+            n = new_node("partition", t.name, partition_strategy=t.strategy)
             nodes[up].downstream.append(n.id)
         elif isinstance(t, KeyedProcessTransformation):
             up = node_for(t.inputs[0])

@@ -89,6 +89,17 @@ class WindowAggregateTransformation(Transformation):
 
 
 @dataclasses.dataclass(eq=False)
+class PartitionTransformation(Transformation):
+    """Non-keyed redistribution (ref: PartitionTransformation.java with
+    the streaming/runtime/partitioner family). ``strategy`` is one of
+    rebalance|rescale|shuffle|broadcast|global|forward — lowered to an
+    exchange boundary that breaks operator chaining; the subtask
+    assignment itself lives in exchange/partitioners.py."""
+
+    strategy: str = "rebalance"
+
+
+@dataclasses.dataclass(eq=False)
 class KeyedProcessTransformation(Transformation):
     """Keyed process function with state + timers (ref: KeyedStream
     .process → KeyedProcessOperator; see ops/process.py)."""

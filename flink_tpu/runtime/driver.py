@@ -56,6 +56,7 @@ class Driver:
             for d in n.downstream:
                 self._upstream[d].append(n.id)
         self._ops: Dict[int, Any] = {}
+        self._partitioners: Dict[int, Any] = {}
         self._out_wm: Dict[int, int] = {nid: LONG_MIN for nid in plan.nodes}
         self._wm_gens: Dict[int, Any] = {}
         self._max_ts: Dict[int, int] = {}
@@ -261,6 +262,8 @@ class Driver:
             "out_wm": dict(self._out_wm),
             "operators": ops,
             "op_versions": versions,
+            "partitioners": {nid: p.snapshot()
+                             for nid, p in self._partitioners.items()},
             # staged-but-uncommitted 2PC sink epochs (prepare ran before
             # this snapshot, so the in-flight epoch is included) — the
             # TwoPhaseCommitSinkFunction pending-transaction-in-state rule
@@ -283,6 +286,13 @@ class Driver:
         self._out_wm.update(payload["out_wm"])
         for nid, snap in payload["operators"].items():
             self._ops[nid].restore_state(snap)
+        from flink_tpu.exchange.partitioners import make_partitioner
+
+        for nid, psnap in payload.get("partitioners", {}).items():
+            n = self.plan.node(nid)
+            p = make_partitioner(n.partition_strategy, seed=nid)
+            p.restore(psnap)
+            self._partitioners[nid] = p
         # v2 incremental restore: adopt the checkpoint's per-op state
         # versions and make it the reuse base — an operator untouched
         # after restore hardlinks its blob at the very next checkpoint
@@ -625,6 +635,22 @@ class Driver:
                 data, ts, valid = fn(data, ts, valid)
             self._push_downstream(nid, (data, ts, valid))
         elif n.kind == "union":
+            self._push_downstream(nid, batch)
+        elif n.kind == "partition":
+            # single local driver = parallelism 1: every strategy is a
+            # pass-through here (identical to the reference at p=1). The
+            # subtask assignment still runs so round-robin cursors and
+            # shuffle streams advance deterministically — the state the
+            # multi-runner scheduler consumes (exchange/partitioners.py)
+            part = self._partitioners.get(nid)
+            if part is None:
+                from flink_tpu.exchange.partitioners import make_partitioner
+
+                # node-id seed: stacked shuffles must not correlate
+                part = self._partitioners[nid] = make_partitioner(
+                    n.partition_strategy, seed=nid)
+            if not part.broadcast:
+                part.advance(len(batch[1]), 1)  # no allocation at p=1
             self._push_downstream(nid, batch)
         elif n.kind == "window_all":
             op = self._ops[nid]
