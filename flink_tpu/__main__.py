@@ -1,0 +1,6 @@
+"""``python -m flink_tpu`` → the CLI frontend (cli.py)."""
+import sys
+
+from flink_tpu.cli import main
+
+sys.exit(main())

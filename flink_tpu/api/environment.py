@@ -65,7 +65,8 @@ class StreamExecutionEnvironment:
         self._transforms.append(t)
 
     # -- execution -------------------------------------------------------
-    def execute(self, job_name: str = "job", cancel=None) -> "JobResult":
+    def execute(self, job_name: str = "job", cancel=None,
+                savepoint_request=None) -> "JobResult":
         """Lower and run to completion (bounded) or until cancelled
         (ref: execute → LocalExecutor → MiniCluster.submitJob). With
         ``cluster.mesh-devices`` set, keyed state is sharded over the
@@ -77,7 +78,8 @@ class StreamExecutionEnvironment:
 
         plan = compile_job(self._transforms, self.config, self._watermark_strategy)
         driver = Driver(plan, self.config, mesh_plan=self.build_mesh_plan())
-        return driver.run(job_name, cancel=cancel)
+        return driver.run(job_name, cancel=cancel,
+                          savepoint_request=savepoint_request)
 
     def build_mesh_plan(self):
         """MeshPlan from ``cluster.mesh-devices`` (None = local

@@ -1,0 +1,136 @@
+"""Command-line frontend — the CliFrontend analogue.
+
+ref: flink-clients/.../client/cli/CliFrontend.java (run / list /
+cancel / savepoint actions against a cluster) and the `flink` shell
+script. Here::
+
+    python -m flink_tpu run --coordinator H:P --entry pkg.mod:build \
+        [--job-id id] [--conf key=value ...]
+    python -m flink_tpu run --local --entry pkg.mod:build [...]
+    python -m flink_tpu list --coordinator H:P
+    python -m flink_tpu status --coordinator H:P JOB_ID
+    python -m flink_tpu cancel --coordinator H:P JOB_ID
+    python -m flink_tpu savepoint --coordinator H:P JOB_ID
+    python -m flink_tpu runners --coordinator H:P
+
+The entry point contract is the job-jar analogue: ``module:function``
+importable on the RUNNER host, taking a StreamExecutionEnvironment and
+building the pipeline on it (see runtime/runner.py).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import uuid
+from typing import List, Optional
+
+
+def _coord_client(spec: str):
+    from flink_tpu.runtime.rpc import RpcClient
+
+    host, _, port = spec.partition(":")
+    if not port:
+        raise SystemExit(f"--coordinator must be HOST:PORT, got {spec!r}")
+    return RpcClient(host or "127.0.0.1", int(port))
+
+
+def _parse_conf(pairs: List[str]) -> dict:
+    conf = {}
+    for p in pairs:
+        k, sep, v = p.partition("=")
+        if not sep:
+            raise SystemExit(f"--conf expects key=value, got {p!r}")
+        # config values are typed by the option registry at load time;
+        # pass numbers through as numbers for convenience
+        try:
+            conf[k] = int(v)
+        except ValueError:
+            try:
+                conf[k] = float(v)
+            except ValueError:
+                conf[k] = v
+    return conf
+
+
+def _run_local(entry: str, conf: dict, job_id: str) -> int:
+    import importlib
+
+    from flink_tpu.api.environment import StreamExecutionEnvironment
+    from flink_tpu.config import Configuration
+
+    mod_name, _, fn_name = entry.partition(":")
+    build = getattr(importlib.import_module(mod_name), fn_name)
+    env = StreamExecutionEnvironment(Configuration(conf))
+    build(env)
+    result = env.execute(job_id)
+    print(json.dumps({"job_id": job_id, "state": "FINISHED",
+                      "records_in": result.metrics.get("records_in"),
+                      "records_out": result.metrics.get("records_out")}))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="flink_tpu",
+                                description="flink_tpu client")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    runp = sub.add_parser("run", help="submit a job")
+    runp.add_argument("--entry", required=True, metavar="MODULE:FUNCTION")
+    runp.add_argument("--coordinator", metavar="HOST:PORT")
+    runp.add_argument("--local", action="store_true",
+                      help="execute in this process (LocalExecutor)")
+    runp.add_argument("--job-id", default=None)
+    runp.add_argument("--conf", action="append", default=[],
+                      metavar="KEY=VALUE")
+
+    for name, help_ in (("list", "list jobs"), ("runners", "list runners")):
+        sp = sub.add_parser(name, help=help_)
+        sp.add_argument("--coordinator", required=True, metavar="HOST:PORT")
+
+    for name, help_ in (("status", "job status"), ("cancel", "cancel job"),
+                        ("savepoint", "trigger a savepoint")):
+        sp = sub.add_parser(name, help=help_)
+        sp.add_argument("--coordinator", required=True, metavar="HOST:PORT")
+        sp.add_argument("job_id")
+
+    args = p.parse_args(argv)
+
+    if args.cmd == "run":
+        job_id = args.job_id or f"job-{uuid.uuid4().hex[:8]}"
+        conf = _parse_conf(args.conf)
+        if args.local:
+            return _run_local(args.entry, conf, job_id)
+        if not args.coordinator:
+            raise SystemExit("run needs --coordinator (or --local)")
+        c = _coord_client(args.coordinator)
+        try:
+            resp = c.call("submit_job", job_id=job_id, entry=args.entry,
+                          config=conf)
+        finally:
+            c.close()
+        print(json.dumps({"job_id": job_id, **resp}))
+        return 0
+
+    c = _coord_client(args.coordinator)
+    try:
+        if args.cmd == "list":
+            resp = c.call("list_jobs")
+        elif args.cmd == "runners":
+            resp = c.call("list_runners")
+        elif args.cmd == "status":
+            resp = c.call("job_status", job_id=args.job_id)
+        elif args.cmd == "cancel":
+            resp = c.call("cancel_job", job_id=args.job_id)
+        elif args.cmd == "savepoint":
+            resp = c.call("trigger_savepoint", job_id=args.job_id)
+        else:  # pragma: no cover
+            raise SystemExit(f"unknown command {args.cmd}")
+    finally:
+        c.close()
+    print(json.dumps(resp))
+    return 0 if resp.get("ok", True) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

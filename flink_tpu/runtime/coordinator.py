@@ -46,6 +46,8 @@ class JobInfo:
     # the pipeline on an env, plus its configuration
     entry: Optional[str] = None
     config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # newest completed savepoint path (reported by the runner)
+    last_savepoint: Optional[str] = None
 
 
 class JobCoordinator(RpcEndpoint):
@@ -179,7 +181,15 @@ class JobCoordinator(RpcEndpoint):
             if j is None:
                 return {"state": "UNKNOWN"}
             return {"state": j.state, "attempts": j.attempts,
-                    "failure": j.failure}
+                    "failure": j.failure,
+                    "last_savepoint": getattr(j, "last_savepoint", None)}
+
+    def _job_runners_locked(self, j: "JobInfo") -> List["RunnerInfo"]:
+        """Reachable gateways of a job's assigned runners (one policy
+        for cancel + savepoint: a runner in a heartbeat blip is still
+        attempted — the RPC itself decides reachability)."""
+        return [r for rid in j.assigned_runners
+                if (r := self.runners.get(rid)) is not None and r.port]
 
     def rpc_cancel_job(self, job_id: str) -> dict:
         targets: List[RunnerInfo] = []
@@ -187,9 +197,7 @@ class JobCoordinator(RpcEndpoint):
             j = self.jobs.get(job_id)
             if j is not None and j.state in ("RUNNING", "RESTARTING"):
                 j.state = "CANCELED"
-                targets = [r for rid in j.assigned_runners
-                           if (r := self.runners.get(rid)) is not None
-                           and r.port]
+                targets = self._job_runners_locked(j)
         for r in targets:
             self._push_cancel_async(r, job_id)
         return {"ok": True}
@@ -263,6 +271,56 @@ class JobCoordinator(RpcEndpoint):
         j.state = "FAILED"
         return {"action": "fail"}
 
+    def rpc_list_jobs(self) -> dict:
+        with self._lock:
+            return {"jobs": [
+                {"job_id": j.job_id, "state": j.state,
+                 "attempts": j.attempts,
+                 "runners": list(j.assigned_runners)}
+                for j in self.jobs.values()]}
+
+    def rpc_trigger_savepoint(self, job_id: str) -> dict:
+        """Dispatch a savepoint request to the job's runner gateway on a
+        worker thread — forwarding must not block the single dispatch
+        thread (heartbeats ride it; same discipline as _deploy_async /
+        _push_cancel_async). The ack means DISPATCHED; completion (and
+        the savepoint path) arrives via rpc_savepoint_complete and shows
+        up in rpc_job_status (ref: CliFrontend savepoint → JobMaster
+        .triggerSavepoint + acknowledgeSavepoint)."""
+        with self._lock:
+            j = self.jobs.get(job_id)
+            if j is None or j.state not in ("RUNNING", "RESTARTING"):
+                return {"ok": False, "reason": "job not running"}
+            targets = self._job_runners_locked(j)
+        if not targets:
+            return {"ok": False, "reason": "no reachable runner"}
+
+        def push() -> None:
+            from flink_tpu.runtime.rpc import RpcClient, RpcError
+
+            for r in targets:
+                try:
+                    c = RpcClient(r.host, r.port, timeout_s=5.0)
+                    try:
+                        resp = c.call("trigger_savepoint", job_id=job_id)
+                    finally:
+                        c.close()
+                    if resp.get("ok"):
+                        return
+                except RpcError:
+                    continue
+
+        threading.Thread(target=push, daemon=True).start()
+        return {"ok": True, "dispatched": True,
+                "runners": [r.runner_id for r in targets]}
+
+    def rpc_savepoint_complete(self, job_id: str, path: str) -> dict:
+        with self._lock:
+            j = self.jobs.get(job_id)
+            if j is not None:
+                j.last_savepoint = path
+        return {"ok": True}
+
     def rpc_list_runners(self) -> dict:
         with self._lock:
             return {rid: {"host": r.host, "n_devices": r.n_devices,
@@ -302,3 +360,28 @@ class JobCoordinator(RpcEndpoint):
 def start_coordinator(config: Optional[Configuration] = None,
                       port: int = 0) -> RpcServer:
     return RpcServer(JobCoordinator(config), port)
+
+
+def main(argv: Optional[list] = None) -> None:
+    """Coordinator process entrypoint (ref: the cluster entrypoints in
+    runtime/entrypoint/*ClusterEntrypoint.java)::
+
+        python -m flink_tpu.runtime.coordinator --port 6123
+    """
+    import argparse
+    import time as _time
+
+    p = argparse.ArgumentParser(description="flink_tpu job coordinator")
+    p.add_argument("--port", type=int, default=6123)
+    args = p.parse_args(argv)
+    server = start_coordinator(port=args.port)
+    print(f"coordinator on :{server.port}", flush=True)
+    try:
+        while True:
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        server.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -356,6 +356,24 @@ class Driver:
         pend.is_savepoint = savepoint
         return pend
 
+    def _maybe_take_savepoint(self) -> None:
+        """Operator-triggered savepoint (CLI `savepoint`): synchronous +
+        retained, at a batch boundary; the completed path is pushed to
+        the requester's on_complete hook (runner → coordinator → CLI
+        status). A request with no checkpoint storage is rejected at the
+        runner, so _coordinator is always set when the flag can be."""
+        req = self._savepoint_request
+        if req is None or not req.is_set():
+            return
+        req.clear()
+        if self._coordinator is None:
+            return  # unreachable via the runner path (validated there)
+        h = self.checkpoint_now(savepoint=True)
+        self.last_savepoint = h.path
+        cb = getattr(req, "on_complete", None)
+        if cb is not None:
+            cb(h.path)
+
     def _complete_pending_checkpoint(self, wait: bool = False):
         """Apply the 2PC commit of a finished background checkpoint on
         the LOOP thread (the asynchronous notifyCheckpointComplete of
@@ -378,11 +396,18 @@ class Driver:
         return handle
 
     # -- run loop --------------------------------------------------------
-    def run(self, job_name: str = "job", cancel=None):
+    def run(self, job_name: str = "job", cancel=None,
+            savepoint_request=None):
         """``cancel``: optional threading.Event checked at every batch
         boundary; when set the run aborts with JobCancelledError through
-        the normal failure cleanup (no output reaches sinks)."""
+        the normal failure cleanup (no output reaches sinks).
+        ``savepoint_request``: optional threading.Event; when set, the
+        loop takes a SAVEPOINT at the next batch boundary (the CLI's
+        `savepoint` command rides this), clears the event, and records
+        the path in ``self.last_savepoint``."""
         self._cancel = cancel
+        self._savepoint_request = savepoint_request
+        self.last_savepoint = None
         import queue
         import threading
 
@@ -572,6 +597,9 @@ class Driver:
                     self._propagate_watermarks()
                 prof["advance_wm"] += time.perf_counter() - t3
                 self._check_drain_error()
+            # operator-triggered savepoint (CLI `savepoint` command):
+            # synchronous + retained, at this batch boundary
+            self._maybe_take_savepoint()
             # async checkpointing: commit any finished background
             # checkpoint (never blocks), then kick off the next one when
             # the interval elapsed and no persistence is in flight
@@ -594,6 +622,9 @@ class Driver:
         with self._push_lock:
             self._propagate_watermarks(final=True)
         self._flush_emits()
+        # a savepoint requested after the last batch boundary must still
+        # land (bounded inputs can finish before the next loop pass)
+        self._maybe_take_savepoint()
         if self._coordinator is not None and interval_ms > 0:
             self.checkpoint_now()  # final epoch commit for 2PC sinks
             # (completes any pending background checkpoint first)

@@ -30,6 +30,22 @@ from typing import Any, Dict, Optional
 from flink_tpu.runtime.rpc import RpcClient, RpcEndpoint, RpcError, RpcServer
 
 
+class SavepointRequest(threading.Event):
+    """Savepoint trigger flag + completion callback: the driver calls
+    ``on_complete(path)`` after the savepoint is durable, and the runner
+    reports the path to the coordinator (the async
+    acknowledgeSavepoint leg of the reference's savepoint flow)."""
+
+    def __init__(self, runner: "TaskRunner", job_id: str) -> None:
+        super().__init__()
+        self._runner = runner
+        self._job_id = job_id
+
+    def on_complete(self, path: str) -> None:
+        self._runner._report("savepoint_complete",
+                             job_id=self._job_id, path=path)
+
+
 class TaskRunner(RpcEndpoint):
     """RPC surface (single dispatch thread): run_job / cancel_job /
     ping. Job execution happens on a worker thread so the RPC endpoint
@@ -127,7 +143,10 @@ class TaskRunner(RpcEndpoint):
                 # which must stay responsive within the deploy timeout
                 old["cancel"].set()
             cancel = threading.Event()
-            rec: Dict[str, Any] = {"cancel": cancel, "attempt": attempt}
+            savepoint = SavepointRequest(self, job_id)
+            rec: Dict[str, Any] = {"cancel": cancel, "attempt": attempt,
+                                   "savepoint": savepoint,
+                                   "config": dict(config or {})}
             t = threading.Thread(
                 target=self._run_job,
                 args=(job_id, entry, dict(config or {}), attempt, cancel,
@@ -145,6 +164,28 @@ class TaskRunner(RpcEndpoint):
                 return {"ok": False, "reason": "unknown job"}
             j["cancel"].set()
         return {"ok": True}
+
+    def rpc_trigger_savepoint(self, job_id: str) -> dict:
+        """Request a savepoint at the job's next batch boundary (ref:
+        the CLI `flink savepoint` → JobMaster.triggerSavepoint path).
+        Rejected up front when the job has no checkpoint storage — a
+        savepoint that could never be written must not report ok. The
+        completed path flows back asynchronously via the coordinator's
+        savepoint_complete (see SavepointRequest)."""
+        from flink_tpu.config import CheckpointingOptions, Configuration
+
+        with self._lock:
+            j = self._jobs.get(job_id)
+            if j is None:
+                return {"ok": False, "reason": "unknown job"}
+            conf = Configuration(j.get("config", {}))
+            if (conf.get(CheckpointingOptions.INTERVAL) <= 0
+                    and not conf.get(CheckpointingOptions.RESTORE)):
+                return {"ok": False,
+                        "reason": "job has no checkpointing configured "
+                                  "(execution.checkpointing.interval)"}
+            j["savepoint"].set()
+        return {"ok": True, "dispatched": True}
 
     # -- execution -------------------------------------------------------
     def _run_job(self, job_id: str, entry: str, config: dict,
@@ -166,7 +207,8 @@ class TaskRunner(RpcEndpoint):
             build = getattr(mod, fn_name)
             env = StreamExecutionEnvironment(Configuration(config))
             build(env)
-            env.execute(job_id, cancel=cancel)
+            env.execute(job_id, cancel=cancel,
+                        savepoint_request=rec.get("savepoint"))
             self._report("finish_job", job_id=job_id)
         except JobCancelledError:
             pass  # the canceller (coordinator) already owns the state
