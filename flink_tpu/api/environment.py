@@ -28,6 +28,16 @@ class StreamExecutionEnvironment:
         self.config = config or Configuration()
         self._transforms: List[Transformation] = []
         self._watermark_strategy = WatermarkStrategy.for_monotonous_timestamps()
+        # plugin loading happens at env creation — the PluginManager
+        # point where filesystem schemes must be ready (ref: FileSystem
+        # .initialize at cluster entrypoint)
+        from flink_tpu.config import CoreOptions
+
+        mods = self.config.get(CoreOptions.PLUGINS)
+        if mods:
+            from flink_tpu.fs import load_plugins
+
+            load_plugins(mods.split(","))
 
     @classmethod
     def get_execution_environment(

@@ -31,9 +31,10 @@ import dataclasses
 import json
 import os
 import pickle
-import shutil
 import time
 from typing import Any, Dict, List, Optional, Tuple
+
+from flink_tpu.fs import FileSystem, get_filesystem
 
 
 @dataclasses.dataclass
@@ -58,19 +59,23 @@ class ReusedOpState:
 
 
 class FsCheckpointStorage:
+    """All storage I/O goes through the FileSystem seam (flink_tpu.fs)
+    — the checkpoint dir may live on any registered scheme (ref:
+    FsCheckpointStorage resolving its path via FileSystem.get)."""
+
     def __init__(self, root: str, job_id: str, retained: int = 3) -> None:
         self.root = root
         self.job_id = job_id
         self.retained = max(1, retained)
+        self.fs: FileSystem = get_filesystem(root)
         self.job_dir = os.path.join(root, job_id)
-        os.makedirs(self.job_dir, exist_ok=True)
+        self.fs.mkdirs(self.job_dir)
 
     def _dir(self, checkpoint_id: int, savepoint: bool) -> str:
         prefix = "savepoint" if savepoint else "chk"
         return os.path.join(self.job_dir, f"{prefix}-{checkpoint_id}")
 
-    @staticmethod
-    def _tmp_dir(d: str) -> str:
+    def _tmp_dir(self, d: str) -> str:
         """Fresh UNIQUE in-progress dir: an abandoned background persist
         from a failed attempt may still be writing when a restarted
         attempt reuses the checkpoint id — distinct tmp dirs mean each
@@ -80,7 +85,7 @@ class FsCheckpointStorage:
         import uuid
 
         tmp = f"{d}.inprogress.{os.getpid()}.{uuid.uuid4().hex[:8]}"
-        os.makedirs(tmp)
+        self.fs.mkdirs(tmp)
         return tmp
 
     def save(self, checkpoint_id: int, payload: Dict[str, Any],
@@ -90,20 +95,20 @@ class FsCheckpointStorage:
         FsCompletedCheckpointStorageLocation)."""
         d = self._dir(checkpoint_id, savepoint)
         tmp = self._tmp_dir(d)
-        with open(os.path.join(tmp, "state.pkl"), "wb") as f:
+        with self.fs.open_write(os.path.join(tmp, "state.pkl")) as f:
             pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
         ts = int(time.time() * 1000)
-        with open(os.path.join(tmp, "MANIFEST.json"), "w", encoding="utf-8") as f:
-            json.dump({
+        with self.fs.open_write(os.path.join(tmp, "MANIFEST.json")) as f:
+            f.write(json.dumps({
                 "checkpoint_id": checkpoint_id,
                 "timestamp_ms": ts,
                 "job_id": self.job_id,
                 "savepoint": savepoint,
                 "format_version": 1,
-            }, f)
-        if os.path.exists(d):
-            shutil.rmtree(d)
-        os.rename(tmp, d)
+            }).encode())
+        if self.fs.exists(d):
+            self.fs.delete(d, recursive=True)
+        self.fs.rename(tmp, d)
         if not savepoint:
             self._retire_old()
         return CheckpointHandle(checkpoint_id, d, ts, savepoint,
@@ -122,26 +127,21 @@ class FsCheckpointStorage:
         op_files: Dict[str, str] = {}
         for nid, blob in op_blobs.items():
             fn = f"op-{nid}.pkl"
-            with open(os.path.join(tmp, fn), "wb") as f:
+            with self.fs.open_write(os.path.join(tmp, fn)) as f:
                 f.write(blob)
             op_files[nid] = fn
             versions[nid] = meta_payload.get(
                 "op_versions", {}).get(nid, -1)
         for nid, ref in op_reuse.items():
             fn = f"op-{nid}.pkl"
-            dst = os.path.join(tmp, fn)
-            try:
-                os.link(ref.file, dst)
-            except OSError:
-                shutil.copyfile(ref.file, dst)
+            self.fs.link_or_copy(ref.file, os.path.join(tmp, fn))
             op_files[nid] = fn
             versions[nid] = ref.version
-        with open(os.path.join(tmp, "meta.pkl"), "wb") as f:
+        with self.fs.open_write(os.path.join(tmp, "meta.pkl")) as f:
             pickle.dump(meta_payload, f, protocol=pickle.HIGHEST_PROTOCOL)
         ts = int(time.time() * 1000)
-        with open(os.path.join(tmp, "MANIFEST.json"), "w",
-                  encoding="utf-8") as f:
-            json.dump({
+        with self.fs.open_write(os.path.join(tmp, "MANIFEST.json")) as f:
+            f.write(json.dumps({
                 "checkpoint_id": checkpoint_id,
                 "timestamp_ms": ts,
                 "job_id": self.job_id,
@@ -149,10 +149,10 @@ class FsCheckpointStorage:
                 "format_version": 2,
                 "ops": {nid: {"file": fn, "version": versions[nid]}
                         for nid, fn in op_files.items()},
-            }, f)
-        if os.path.exists(d):
-            shutil.rmtree(d)
-        os.rename(tmp, d)
+            }).encode())
+        if self.fs.exists(d):
+            self.fs.delete(d, recursive=True)
+        self.fs.rename(tmp, d)
         if not savepoint:
             self._retire_old()
         return CheckpointHandle(checkpoint_id, d, ts, savepoint,
@@ -160,14 +160,14 @@ class FsCheckpointStorage:
 
     def list_complete(self) -> List[CheckpointHandle]:
         out = []
-        for name in os.listdir(self.job_dir):
+        for name in self.fs.listdir(self.job_dir):
             d = os.path.join(self.job_dir, name)
             mf = os.path.join(d, "MANIFEST.json")
-            if not os.path.isfile(mf):
+            if not self.fs.exists(mf):
                 continue
             try:
-                with open(mf, "r", encoding="utf-8") as f:
-                    m = json.load(f)
+                with self.fs.open_read(mf) as f:
+                    m = json.loads(f.read().decode())
                 out.append(CheckpointHandle(
                     m["checkpoint_id"], d, m["timestamp_ms"],
                     m.get("savepoint", False)))
@@ -182,22 +182,23 @@ class FsCheckpointStorage:
     @staticmethod
     def load(handle_or_path) -> Dict[str, Any]:
         path = getattr(handle_or_path, "path", handle_or_path)
+        fs = get_filesystem(path)
         mf_path = os.path.join(path, "MANIFEST.json")
         fmt = 1
         manifest: Dict[str, Any] = {}
-        if os.path.isfile(mf_path):
-            with open(mf_path, "r", encoding="utf-8") as f:
-                manifest = json.load(f)
+        if fs.exists(mf_path):
+            with fs.open_read(mf_path) as f:
+                manifest = json.loads(f.read().decode())
             fmt = manifest.get("format_version", 1)
         if fmt == 1:
-            with open(os.path.join(path, "state.pkl"), "rb") as f:
+            with fs.open_read(os.path.join(path, "state.pkl")) as f:
                 return pickle.load(f)
-        with open(os.path.join(path, "meta.pkl"), "rb") as f:
+        with fs.open_read(os.path.join(path, "meta.pkl")) as f:
             payload = pickle.load(f)
         ops: Dict[Any, Any] = {}
         versions: Dict[Any, int] = {}
         for nid, entry in manifest.get("ops", {}).items():
-            with open(os.path.join(path, entry["file"]), "rb") as f:
+            with fs.open_read(os.path.join(path, entry["file"])) as f:
                 # node ids are ints in the live plan; the manifest's JSON
                 # keys are strings — restore the original type
                 ops[int(nid)] = pickle.load(f)
@@ -210,22 +211,50 @@ class FsCheckpointStorage:
         return payload
 
     def _retire_old(self) -> None:
+        """Best-effort retention: a retire/sweep failure must never fail
+        the checkpoint that just committed (the old shutil path used
+        ignore_errors=True; the seam re-establishes that contract for
+        every backend, not just the local one)."""
         hs = [h for h in self.list_complete() if not h.is_savepoint]
         for h in hs[: -self.retained]:
-            shutil.rmtree(h.path, ignore_errors=True)
+            try:
+                self.fs.delete(h.path, recursive=True)
+            except OSError:
+                pass
         # sweep orphaned in-progress dirs
-        for name in os.listdir(self.job_dir):
+        try:
+            names = self.fs.listdir(self.job_dir)
+        except OSError:
+            names = []
+        for name in names:
             if ".inprogress" in name:
-                shutil.rmtree(os.path.join(self.job_dir, name),
-                              ignore_errors=True)
+                try:
+                    self.fs.delete(os.path.join(self.job_dir, name),
+                                   recursive=True)
+                except OSError:
+                    pass
 
 
 def _dir_size(d: str) -> int:
+    """Best-effort stats walk: a concurrently-retired directory (a
+    restarted attempt's sweep) yields a partial size, never an error —
+    size is telemetry, and the checkpoint already committed."""
+    fs = get_filesystem(d)
     size = 0
-    for root, _, files in os.walk(d):
-        for fn in files:
+    stack = [d]
+    while stack:
+        cur = stack.pop()
+        try:
+            names = fs.listdir(cur)
+        except OSError:
+            continue
+        for name in names:
+            p = os.path.join(cur, name)
             try:
-                size += os.path.getsize(os.path.join(root, fn))
+                if fs.is_dir(p):
+                    stack.append(p)
+                else:
+                    size += fs.size(p)
             except OSError:
                 pass
     return size

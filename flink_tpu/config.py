@@ -222,6 +222,15 @@ class PipelineOptions:
         "flush overrides the deferral immediately.")
 
 
+class CoreOptions:
+    PLUGINS = ConfigOption(
+        "plugins.modules", "",
+        "Comma-separated module names loaded at environment creation; "
+        "each must expose register(registry) extending the FileSystem "
+        "scheme registry (ref: core/plugin/PluginManager + "
+        "FileSystemFactory SPI; see flink_tpu/fs.py).")
+
+
 class StateOptions:
     NUM_KEY_SHARDS = ConfigOption(
         "state.num-key-shards", 128,

@@ -1,0 +1,175 @@
+"""FileSystem abstraction + plugin loader.
+
+ref: flink-core/.../core/fs/FileSystem.java (scheme-keyed registry,
+``FileSystem.get(uri)``) and core/plugin/PluginManager.java (isolated
+plugin loading). The reference resolves ``s3://``, ``hdfs://`` etc. to
+pluggable implementations; checkpoint storage and file sources/sinks go
+through the seam, never through raw ``java.io``.
+
+TPU-first simplification: no classloader isolation (Python modules are
+the plugin unit), but the same two contracts — a small FileSystem
+interface every storage path uses, and a scheme registry that plugins
+extend either programmatically (``register_filesystem``) or by naming
+modules in ``plugins.modules`` config (each module's
+``register(registry)`` hook runs at load, the PluginManager analogue).
+"""
+from __future__ import annotations
+
+import importlib
+import os
+import shutil
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+
+class FileSystem:
+    """Minimal filesystem contract (ref: core/fs/FileSystem.java —
+    subset actually used by checkpoint storage and file sinks)."""
+
+    def open_read(self, path: str):
+        raise NotImplementedError
+
+    def open_write(self, path: str):
+        raise NotImplementedError
+
+    def mkdirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def listdir(self, path: str) -> List[str]:
+        raise NotImplementedError
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        raise NotImplementedError
+
+    def rename(self, src: str, dst: str) -> None:
+        """Atomic within one filesystem — the manifest-last commit
+        primitive checkpoint storage builds on."""
+        raise NotImplementedError
+
+    def link_or_copy(self, src: str, dst: str) -> None:
+        """Hardlink when the backend supports it (incremental checkpoint
+        blob reuse), else copy."""
+        raise NotImplementedError
+
+    def size(self, path: str) -> int:
+        raise NotImplementedError
+
+    def is_dir(self, path: str) -> bool:
+        raise NotImplementedError
+
+
+class LocalFileSystem(FileSystem):
+    """``file://`` / bare paths (ref: core/fs/local/LocalFileSystem)."""
+
+    @staticmethod
+    def _strip(path: str) -> str:
+        return path[len("file://"):] if path.startswith("file://") else path
+
+    def open_read(self, path: str):
+        return open(self._strip(path), "rb")
+
+    def open_write(self, path: str):
+        return open(self._strip(path), "wb")
+
+    def mkdirs(self, path: str) -> None:
+        os.makedirs(self._strip(path), exist_ok=True)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(self._strip(path))
+
+    def listdir(self, path: str) -> List[str]:
+        return os.listdir(self._strip(path))
+
+    def delete(self, path: str, recursive: bool = False) -> None:
+        p = self._strip(path)
+        if os.path.isdir(p) and not os.path.islink(p):
+            if not recursive:
+                raise IsADirectoryError(p)
+            shutil.rmtree(p, ignore_errors=True)
+        elif os.path.exists(p):
+            os.remove(p)
+
+    def rename(self, src: str, dst: str) -> None:
+        os.rename(self._strip(src), self._strip(dst))
+
+    def link_or_copy(self, src: str, dst: str) -> None:
+        try:
+            os.link(self._strip(src), self._strip(dst))
+        except OSError:
+            shutil.copyfile(self._strip(src), self._strip(dst))
+
+    def size(self, path: str) -> int:
+        return os.path.getsize(self._strip(path))
+
+    def is_dir(self, path: str) -> bool:
+        return os.path.isdir(self._strip(path))
+
+
+class FileSystemRegistry:
+    """Scheme → FileSystem factory (ref: FileSystem.FS_FACTORIES +
+    getUnguardedFileSystem). ``get`` resolves a path's scheme; bare
+    paths resolve to the local filesystem."""
+
+    def __init__(self) -> None:
+        self._factories: Dict[str, Callable[[], FileSystem]] = {}
+        self._instances: Dict[str, FileSystem] = {}
+        self.register("file", LocalFileSystem)
+
+    def register(self, scheme: str,
+                 factory: Callable[[], FileSystem]) -> None:
+        self._factories[scheme] = factory
+        self._instances.pop(scheme, None)
+
+    def get(self, path: str) -> FileSystem:
+        scheme, sep, _ = path.partition("://")
+        key = scheme if sep else "file"
+        if key not in self._factories:
+            raise ValueError(
+                f"no filesystem registered for scheme {key!r} "
+                f"(known: {sorted(self._factories)}); load a plugin via "
+                "plugins.modules or register_filesystem()")
+        if key not in self._instances:
+            self._instances[key] = self._factories[key]()
+        return self._instances[key]
+
+    def schemes(self) -> List[str]:
+        return sorted(self._factories)
+
+
+_REGISTRY = FileSystemRegistry()
+
+
+def register_filesystem(scheme: str,
+                        factory: Callable[[], FileSystem]) -> None:
+    """Programmatic plugin registration (ref: FileSystemFactory SPI)."""
+    _REGISTRY.register(scheme, factory)
+
+
+def get_filesystem(path: str) -> FileSystem:
+    return _REGISTRY.get(path)
+
+
+def schemes() -> List[str]:
+    return _REGISTRY.schemes()
+
+
+def load_plugins(modules: Iterable[str]) -> List[str]:
+    """Import each named module and run its ``register(registry)`` hook
+    (ref: PluginManager discovering FileSystemFactory services). Returns
+    the loaded module names; a missing module raises at load time —
+    a silently absent plugin would surface later as an unknown scheme."""
+    loaded = []
+    for name in modules:
+        name = name.strip()
+        if not name:
+            continue
+        mod = importlib.import_module(name)
+        hook = getattr(mod, "register", None)
+        if hook is None:
+            raise ValueError(
+                f"plugin module {name!r} has no register(registry) hook")
+        hook(_REGISTRY)
+        loaded.append(name)
+    return loaded
