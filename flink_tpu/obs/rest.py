@@ -1,0 +1,166 @@
+"""REST API — the coordinator's HTTP face.
+
+ref: flink-runtime/.../rest/RestServerEndpoint.java and the dispatcher
+handlers (JobsOverviewHandler, JobDetailsHandler, JobCancellationHandler,
+SavepointTriggerHandler, TaskManagersHandler). Same resource shapes,
+backed by the coordinator's RPC methods — one control-plane brain, two
+protocols (ref: WebMonitorEndpoint delegating to the DispatcherGateway).
+
+Routes:
+    GET    /overview                      cluster summary
+    GET    /jobs                          job list
+    GET    /jobs/<id>                     job detail (incl. savepoint)
+    PATCH  /jobs/<id>?mode=cancel         cancel
+    POST   /jobs/<id>/savepoints          trigger a savepoint
+    GET    /taskmanagers                  runner list
+    GET    /                              minimal HTML overview (Web UI nod)
+
+Binds loopback by default (same rationale as the metrics endpoint:
+no unauthenticated control surface on all interfaces by accident).
+"""
+from __future__ import annotations
+
+import html as html_mod
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+
+class RestServer:
+    """``target`` is either an RpcServer (preferred: REST calls ride its
+    dispatch queue, honoring the single-dispatch-thread discipline) or a
+    bare endpoint object — which MUST be internally synchronized, since
+    HTTP worker threads then call its rpc_* methods directly
+    (JobCoordinator locks internally and qualifies)."""
+
+    def __init__(self, target: Any, port: int = 0,
+                 bind: str = "127.0.0.1") -> None:
+        if hasattr(target, "dispatch"):
+            self._call = target.dispatch
+        else:
+            self._call = (lambda method, **kw:
+                          getattr(target, "rpc_" + method)(**kw))
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, payload: Dict[str, Any]) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _html(self, body: str) -> None:
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self) -> None:
+                code, payload = outer._route("GET", self.path)
+                if payload is None:
+                    try:
+                        self._html(outer._index_html())
+                    except Exception as e:  # noqa: BLE001 — HTTP boundary
+                        self._send(500, {"error": str(e)})
+                else:
+                    self._send(code, payload)
+
+            def do_PATCH(self) -> None:
+                self._send(*outer._route("PATCH", self.path))
+
+            def do_POST(self) -> None:
+                self._send(*outer._route("POST", self.path))
+
+        self._httpd = ThreadingHTTPServer((bind, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    # -- routing ---------------------------------------------------------
+
+    def _route(self, method: str,
+               path: str) -> Tuple[int, Optional[Dict[str, Any]]]:
+        u = urlparse(path)
+        parts = [p for p in u.path.split("/") if p]
+        q = parse_qs(u.query)
+        try:
+            if method == "GET":
+                if not parts:
+                    return 200, None  # HTML index
+                if parts == ["overview"]:
+                    runners = self._call("list_runners")
+                    jobs = self._call("list_jobs")["jobs"]
+                    by_state: Dict[str, int] = {}
+                    for j in jobs:
+                        by_state[j["state"]] = by_state.get(j["state"], 0) + 1
+                    return 200, {
+                        "taskmanagers": len(runners),
+                        "taskmanagers-alive": sum(
+                            1 for r in runners.values() if r["alive"]),
+                        "jobs": by_state,
+                    }
+                if parts == ["jobs"]:
+                    return 200, self._call("list_jobs")
+                if len(parts) == 2 and parts[0] == "jobs":
+                    st = self._call("job_status", job_id=parts[1])
+                    if st.get("state") == "UNKNOWN":
+                        return 404, {"error": f"no job {parts[1]}"}
+                    return 200, {"job_id": parts[1], **st}
+                if parts == ["taskmanagers"]:
+                    return 200, {"taskmanagers": self._call("list_runners")}
+                return 404, {"error": f"no route {u.path}"}
+            if method == "PATCH" and len(parts) == 2 and parts[0] == "jobs":
+                mode = q.get("mode", ["cancel"])[0]
+                if mode != "cancel":
+                    return 400, {"error": f"unsupported mode {mode!r}"}
+                st = self._call("job_status", job_id=parts[1])
+                if st.get("state") == "UNKNOWN":
+                    return 404, {"error": f"no job {parts[1]}"}
+                return 202, self._call("cancel_job", job_id=parts[1])
+            if (method == "POST" and len(parts) == 3 and parts[0] == "jobs"
+                    and parts[2] == "savepoints"):
+                st = self._call("job_status", job_id=parts[1])
+                if st.get("state") == "UNKNOWN":
+                    return 404, {"error": f"no job {parts[1]}"}
+                resp = self._call("trigger_savepoint", job_id=parts[1])
+                return (202 if resp.get("ok") else 409), resp
+            return 404, {"error": f"no route {method} {u.path}"}
+        except Exception as e:  # noqa: BLE001 — HTTP boundary
+            return 500, {"error": str(e)}
+
+    def _index_html(self) -> str:
+        esc = html_mod.escape
+        jobs = self._call("list_jobs")["jobs"]
+        runners = self._call("list_runners")
+        rows = "".join(
+            f"<tr><td>{esc(str(j['job_id']))}</td><td>{esc(j['state'])}</td>"
+            f"<td>{j['attempts']}</td>"
+            f"<td>{esc(', '.join(map(str, j['runners'])))}</td></tr>"
+            for j in jobs)
+        rrows = "".join(
+            f"<tr><td>{esc(str(rid))}</td>"
+            f"<td>{'alive' if r['alive'] else 'lost'}</td>"
+            f"<td>{r['n_devices']}</td></tr>" for rid, r in runners.items())
+        return (
+            "<html><head><title>flink_tpu</title></head><body>"
+            "<h1>flink_tpu cluster</h1>"
+            "<h2>Jobs</h2><table border=1><tr><th>id</th><th>state</th>"
+            f"<th>attempts</th><th>runners</th></tr>{rows}</table>"
+            "<h2>Runners</h2><table border=1><tr><th>id</th><th>status</th>"
+            f"<th>devices</th></tr>{rrows}</table>"
+            "<p>REST: /overview /jobs /jobs/&lt;id&gt; /taskmanagers</p>"
+            "</body></html>")
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

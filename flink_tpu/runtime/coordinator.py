@@ -373,13 +373,25 @@ def main(argv: Optional[list] = None) -> None:
 
     p = argparse.ArgumentParser(description="flink_tpu job coordinator")
     p.add_argument("--port", type=int, default=6123)
+    p.add_argument("--rest-port", type=int, default=0,
+                   help="HTTP REST/UI port (0 = disabled)")
+    p.add_argument("--rest-bind", default="127.0.0.1")
     args = p.parse_args(argv)
     server = start_coordinator(port=args.port)
+    rest = None
+    if args.rest_port:
+        from flink_tpu.obs.rest import RestServer
+
+        rest = RestServer(server, port=args.rest_port,
+                          bind=args.rest_bind)
+        print(f"rest on :{rest.port}", flush=True)
     print(f"coordinator on :{server.port}", flush=True)
     try:
         while True:
             _time.sleep(3600)
     except KeyboardInterrupt:
+        if rest is not None:
+            rest.close()
         server.close()
 
 

@@ -92,6 +92,20 @@ class RpcServer:
             target=self._server.serve_forever, daemon=True)
         self._serve_thread.start()
 
+    def dispatch(self, method: str, **args: Any) -> Any:
+        """Run an endpoint method ON the dispatch thread and return its
+        result — the seam co-located protocol fronts (REST) use so the
+        single-dispatch-thread discipline holds for every caller, not
+        just TCP clients. Raises RpcError on endpoint faults."""
+        done = threading.Event()
+        box: Dict[str, Any] = {}
+        self._calls.put(({"method": method, "args": args}, box, done))
+        done.wait()
+        resp = box["resp"]
+        if "error" in resp:
+            raise RpcError(resp["error"])
+        return resp["result"]
+
     def _dispatch_loop(self) -> None:
         while True:
             item = self._calls.get()

@@ -1,0 +1,123 @@
+"""REST API (ref: rest/RestServerEndpoint + dispatcher handler tests:
+jobs overview, job detail, cancellation, savepoint trigger)."""
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from flink_tpu.config import Configuration
+from flink_tpu.obs.rest import RestServer
+from flink_tpu.runtime.coordinator import JobCoordinator
+
+
+@pytest.fixture
+def cluster():
+    coord = JobCoordinator(Configuration())
+    rest = RestServer(coord, port=0)
+    yield coord, rest
+    rest.close()
+    coord.close()
+
+
+def get(rest, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{rest.port}{path}") as r:
+        return r.status, json.loads(r.read())
+
+
+def req(rest, method, path):
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{rest.port}{path}", method=method)
+    try:
+        with urllib.request.urlopen(r) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestRest:
+    def test_overview_and_jobs(self, cluster):
+        coord, rest = cluster
+        coord.rpc_register_runner("r1", "127.0.0.1", 8, 0)
+        coord.rpc_submit_job("job-a")
+        code, body = get(rest, "/overview")
+        assert code == 200
+        assert body["taskmanagers"] == 1
+        assert body["jobs"] == {"RUNNING": 1}
+
+        code, body = get(rest, "/jobs")
+        assert [j["job_id"] for j in body["jobs"]] == ["job-a"]
+
+        code, body = get(rest, "/jobs/job-a")
+        assert code == 200 and body["state"] == "RUNNING"
+
+        code, body = get(rest, "/taskmanagers")
+        assert "r1" in body["taskmanagers"]
+
+    def test_unknown_job_404(self, cluster):
+        _, rest = cluster
+        code, body = req(rest, "GET", "/jobs/nope")
+        assert code == 404
+
+    def test_cancel_via_patch(self, cluster):
+        coord, rest = cluster
+        coord.rpc_submit_job("job-b")
+        code, body = req(rest, "PATCH", "/jobs/job-b?mode=cancel")
+        assert code == 202 and body["ok"]
+        assert coord.rpc_job_status("job-b")["state"] == "CANCELED"
+        code, _ = req(rest, "PATCH", "/jobs/job-b?mode=explode")
+        assert code == 400
+
+    def test_savepoint_trigger_conflict_when_not_running(self, cluster):
+        coord, rest = cluster
+        coord.rpc_submit_job("job-c")
+        coord.rpc_cancel_job("job-c")
+        code, body = req(rest, "POST", "/jobs/job-c/savepoints")
+        assert code == 409 and not body["ok"]
+
+    def test_html_index(self, cluster):
+        coord, rest = cluster
+        coord.rpc_submit_job("job-d")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{rest.port}/") as r:
+            html = r.read().decode()
+        assert "job-d" in html and "flink_tpu" in html
+
+    def test_unknown_route_404(self, cluster):
+        _, rest = cluster
+        code, _ = req(rest, "GET", "/nonexistent")
+        assert code == 404
+
+    def test_patch_and_savepoint_unknown_job_404(self, cluster):
+        _, rest = cluster
+        code, _ = req(rest, "PATCH", "/jobs/typo?mode=cancel")
+        assert code == 404
+        code, _ = req(rest, "POST", "/jobs/typo/savepoints")
+        assert code == 404
+
+    def test_html_escapes_job_ids(self, cluster):
+        coord, rest = cluster
+        coord.rpc_submit_job("<script>alert(1)</script>")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{rest.port}/") as r:
+            html = r.read().decode()
+        assert "<script>alert" not in html
+        assert "&lt;script&gt;" in html
+
+    def test_dispatch_through_rpc_server(self):
+        """REST fronted by the RpcServer rides its single dispatch
+        thread (the documented no-locks contract)."""
+        from flink_tpu.runtime.rpc import RpcServer
+
+        coord = JobCoordinator(Configuration())
+        srv = RpcServer(coord)
+        rest = RestServer(srv, port=0)
+        try:
+            coord.rpc_submit_job("via-rpc")
+            code, body = get(rest, "/jobs/via-rpc")
+            assert code == 200 and body["state"] == "RUNNING"
+        finally:
+            rest.close()
+            srv.close()
+            coord.close()
