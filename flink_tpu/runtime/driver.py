@@ -279,11 +279,13 @@ class Driver:
     def _restore(self, payload: Dict[str, Any]) -> None:
         self._positions = {sid: dict(pos)
                            for sid, pos in payload["sources"].items()}
-        for sid, states in payload["wm_gens"].items():
+        # time-state keys may be absent: a state-processor savepoint
+        # with reset_watermarks() restarts event time from scratch
+        for sid, states in payload.get("wm_gens", {}).items():
             for g, s in zip(self._wm_gens[sid], states):
                 g.restore(s)
-        self._max_ts.update(payload["max_ts"])
-        self._out_wm.update(payload["out_wm"])
+        self._max_ts.update(payload.get("max_ts", {}))
+        self._out_wm.update(payload.get("out_wm", {}))
         for nid, snap in payload["operators"].items():
             self._ops[nid].restore_state(snap)
         from flink_tpu.exchange.partitioners import make_partitioner

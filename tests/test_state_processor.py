@@ -1,0 +1,147 @@
+"""State processor API (ref: flink-state-processor-api
+SavepointReader/Writer ITCases: read keyed state out of a savepoint,
+transform it, write a restorable one)."""
+import os
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.api.sinks import CollectSink
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.api.windowing import TumblingEventTimeWindows
+from flink_tpu.config import Configuration
+from flink_tpu.state_processor import SavepointWriter, load_savepoint
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+
+def run_job(tmp_path, restore_path=None, n_batches=4, sink=None):
+    conf = {
+        "state.num-key-shards": 4, "state.slots-per-shard": 32,
+        "pipeline.microbatch-size": 64,
+        "execution.checkpointing.dir": str(tmp_path),
+        "execution.checkpointing.interval": 1,
+    }
+    if restore_path:
+        conf["execution.checkpointing.restore"] = restore_path
+
+    def gen(split, i):
+        if i >= n_batches:
+            return None
+        rng = np.random.default_rng(i)
+        return ({"k": rng.integers(0, 8, 64).astype(np.int64),
+                 "v": rng.integers(1, 9, 64).astype(np.int64)},
+                np.sort(rng.integers(i * 500, i * 500 + 900, 64)).astype(np.int64))
+
+    env = StreamExecutionEnvironment(Configuration(conf))
+    sink = sink if sink is not None else CollectSink()
+    (env.from_source(GeneratorSource(gen),
+                     WatermarkStrategy.for_bounded_out_of_orderness(400))
+     .key_by("k").window(TumblingEventTimeWindows.of(1_000))
+     .sum("v").add_sink(sink))
+    env.execute("sp-job")
+    return sink
+
+
+def latest_chk(tmp_path):
+    from flink_tpu.checkpoint.storage import FsCheckpointStorage
+
+    return FsCheckpointStorage(str(tmp_path), "sp-job").latest().path
+
+
+class TestReader:
+    def test_read_operators_and_keyed_rows(self, tmp_path):
+        run_job(tmp_path)
+        from flink_tpu.checkpoint.storage import FsCheckpointStorage
+
+        st = FsCheckpointStorage(str(tmp_path), "sp-job")
+        # a MID-stream checkpoint still holds live panes (the final one
+        # is post-purge and may be empty)
+        first = st.list_complete()[0]
+        r = load_savepoint(first.path)
+        ops = r.operator_ids()
+        assert len(ops) == 1
+        rows = r.window_keyed_rows(ops[0])
+        assert set(rows) == {"key", "ring_pane", "sums", "maxs", "mins",
+                             "count"}
+        assert len(rows["key"]) > 0
+        assert set(rows["key"].tolist()) <= set(range(8))
+        assert rows["count"].sum() > 0
+        # and the latest checkpoint reports end-of-stream positions
+        assert load_savepoint(
+            st.latest().path).source_positions() == {0: {0: 4}}
+
+    def test_non_window_snapshot_rejected(self, tmp_path):
+        run_job(tmp_path)
+        r = load_savepoint(latest_chk(tmp_path))
+        with pytest.raises(ValueError, match="not a window"):
+            # sources dict is not an operator id; fabricate a bad snap
+            r.payload["operators"]["fake"] = {"x": 1}
+            r.window_keyed_rows("fake")
+
+
+class TestReprocessOnTop:
+    def test_rewind_keeping_state_replays_fully(self, tmp_path):
+        """reset_watermarks() must rewind the OPERATOR clocks too
+        (watermark, fired/cleared horizons), or replayed records sit
+        behind the old end-of-stream watermark and drop as late. With
+        the full reset, a rewound replay over the (already-purged) final
+        state recomputes every window; without operator reset, almost
+        nothing comes out — the review-found failure mode."""
+        s1 = run_job(tmp_path)
+        base = {(int(r["key"]), int(r["window_end"])): float(r["sum_v"])
+                for r in s1.rows}
+
+        r = load_savepoint(latest_chk(tmp_path))
+        sp = (SavepointWriter(r)
+              .set_source_positions({0: {0: 0}})
+              .reset_watermarks()
+              .write(str(tmp_path), "sp-job"))
+        s2 = run_job(tmp_path, restore_path=sp)
+        got = {(int(r["key"]), int(r["window_end"])): float(r["sum_v"])
+               for r in s2.rows}
+        assert got == base  # full recompute, nothing dropped as late
+
+        # contrast: driver-only reset leaves the operator clock at
+        # end-of-stream — the replay drops (late) instead of recomputing
+        r2 = load_savepoint(latest_chk(tmp_path))
+        sp2 = (SavepointWriter(r2)
+               .set_source_positions({0: {0: 0}})
+               .reset_watermarks(include_operators=False)
+               .write(str(tmp_path), "sp-job"))
+        s3 = run_job(tmp_path, restore_path=sp2)
+        assert len(s3.rows) < len(s1.rows)
+
+
+class TestWriterRoundTrip:
+    def test_transform_and_restore(self, tmp_path):
+        """Bootstrap flow: take a mid-stream checkpoint, REWIND its
+        source positions offline, write a savepoint, restore from it —
+        the job replays from the rewritten position and produces the
+        full output again (proves the written savepoint is genuinely
+        restorable)."""
+        s1 = run_job(tmp_path)
+        base = sorted((int(r["key"]), int(r["window_end"]),
+                       float(r["sum_v"])) for r in s1.rows)
+
+        r = load_savepoint(latest_chk(tmp_path))
+        w = SavepointWriter(r)
+        # rewind to the beginning and CLEAR operator state: restore
+        # must recompute everything
+        ops = r.operator_ids()
+        from flink_tpu.ops.window import WindowOperator
+        from flink_tpu.ops import aggregates
+
+        fresh = WindowOperator(
+            TumblingEventTimeWindows.of(1_000), aggregates.sum_of("v"),
+            num_shards=4, slots_per_shard=32, max_out_of_orderness_ms=400)
+        w.transform_operator(ops[0], lambda snap: fresh.snapshot_state())
+        w.set_source_positions({0: {0: 0}})
+        w.reset_watermarks()
+        sp_path = w.write(str(tmp_path), "sp-job")
+        assert os.path.basename(sp_path).startswith("savepoint-")
+
+        s2 = run_job(tmp_path, restore_path=sp_path)
+        got = sorted((int(r["key"]), int(r["window_end"]),
+                      float(r["sum_v"])) for r in s2.rows)
+        assert got == base  # full recompute from rewound positions
