@@ -109,6 +109,22 @@ class DataStream:
         self.env._register(t)
         return KeyedStream(self.env, t)
 
+    def async_io(self, fn: Any, capacity: int = 8,
+                 timeout_ms: int = 60_000, ordered: bool = True,
+                 name: str = "async_io") -> "DataStream":
+        """Async external enrichment (ref: AsyncDataStream.orderedWait /
+        unorderedWait). ``fn`` is an api.functions-style AsyncFunction
+        (invoke_batch) or a plain callable ``(data, ts) -> data'`` doing
+        the external lookup for a whole microbatch; up to ``capacity``
+        batches overlap on a worker pool while ingest continues.
+        ``ordered=False`` releases batches as they complete; watermarks
+        never overtake pending batches either way."""
+        from flink_tpu.graph.transformations import AsyncIOTransformation
+
+        return self._append(AsyncIOTransformation(
+            name, (self.transform,), fn=fn, capacity=capacity,
+            timeout_ms=timeout_ms, ordered=ordered))
+
     # -- non-keyed partitioning (ref: DataStream.{rebalance,rescale,
     # shuffle,broadcast,global} → PartitionTransformation) --------------
     def rebalance(self) -> "DataStream":

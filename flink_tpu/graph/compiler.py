@@ -23,6 +23,7 @@ from flink_tpu.config import Configuration, PipelineOptions, StateOptions
 from flink_tpu.graph.transformations import (
     KeyByTransformation,
     MapTransformation,
+    AsyncIOTransformation,
     CountWindowAggregateTransformation,
     KeyedProcessTransformation,
     PartitionTransformation,
@@ -144,6 +145,10 @@ def compile_job(
             up = node_for(t.inputs[0])
             n = new_node("window", t.name, window_transform=t,
                          key_field=t.key_field)
+            nodes[up].downstream.append(n.id)
+        elif isinstance(t, AsyncIOTransformation):
+            up = node_for(t.inputs[0])
+            n = new_node("async_io", t.name, window_transform=t)
             nodes[up].downstream.append(n.id)
         elif isinstance(t, PartitionTransformation):
             # an exchange boundary: always its own node (breaks the

@@ -89,6 +89,17 @@ class WindowAggregateTransformation(Transformation):
 
 
 @dataclasses.dataclass(eq=False)
+class AsyncIOTransformation(Transformation):
+    """Async external enrichment (ref: AsyncDataStream.orderedWait /
+    unorderedWait -> AsyncWaitOperator; see ops/async_io.py)."""
+
+    fn: Any = None                # AsyncFunction or callable(data, ts)
+    capacity: int = 8
+    timeout_ms: int = 60_000
+    ordered: bool = True
+
+
+@dataclasses.dataclass(eq=False)
 class PartitionTransformation(Transformation):
     """Non-keyed redistribution (ref: PartitionTransformation.java with
     the streaming/runtime/partitioner family). ``strategy`` is one of

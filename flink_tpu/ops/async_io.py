@@ -1,0 +1,166 @@
+"""Async I/O operator — external enrichment without stalling ingest.
+
+ref: streaming/api/operators/async/AsyncWaitOperator.java +
+api/functions/async/AsyncFunction.java (asyncInvoke per record,
+orderedWait/unorderedWait, capacity backpressure, timeout).
+
+TPU-first redesign: the unit of async work is the MICROBATCH, not the
+record — one `fn(data, ts) -> data'` call per batch on a worker pool
+(an external lookup amortized over the whole batch is also how a sane
+client batches its RPCs). Up to ``capacity`` batches are in flight;
+``ordered=True`` releases results in arrival order (orderedWait),
+``ordered=False`` as they complete (unorderedWait). The event-time
+contract of the reference is preserved: a watermark never overtakes
+records it arrived behind — the operator releases watermark w only
+after every batch submitted before w has been emitted. Timeouts fail
+the job loudly (the reference's default timeout behavior)."""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.time.watermarks import LONG_MIN
+
+Batch = Tuple[Dict[str, np.ndarray], np.ndarray, np.ndarray]
+
+
+class AsyncIOOperator:
+    """Driver-facing async enrichment stage."""
+
+    def __init__(self, fn: Callable[..., Dict[str, np.ndarray]],
+                 *, capacity: int = 8, timeout_ms: int = 60_000,
+                 ordered: bool = True, workers: Optional[int] = None) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.fn = fn
+        self.capacity = capacity
+        self.timeout_s = timeout_ms / 1000.0
+        self.ordered = ordered
+        self._pool = ThreadPoolExecutor(
+            max_workers=workers or capacity,
+            thread_name_prefix="async-io")
+        # (future, ts, valid, wm_at_submit, submit_time, seq)
+        self._inflight: collections.deque = collections.deque()
+        self._seq = 0
+        self.watermark = LONG_MIN  # released watermark (never overtakes)
+        self._input_wm = LONG_MIN
+
+    def submit(self, batch: Batch, input_wm: int) -> None:
+        """Called by the driver's push path — NEVER blocks (the caller
+        holds the push lock; a wait here would stall the drain thread's
+        sink deliveries behind the enrichment RPC). The capacity wait
+        happens in ``throttle()``, which the ingest loop calls OUTSIDE
+        the lock — the same discipline as the window operator's
+        external_throttle."""
+        data, ts, valid = batch
+        fut = self._pool.submit(self.fn, dict(data), ts)
+        self._inflight.append(
+            (fut, ts, valid, input_wm, time.monotonic(), self._seq))
+        self._seq += 1
+
+    def throttle(self) -> None:
+        """Capacity backpressure, outside the push lock: block on the
+        oldest still-RUNNING batch while more than ``capacity`` overlap
+        (ref: AsyncWaitOperator's capacity semaphore). Completed batches
+        awaiting ordered release don't count — they cost no worker."""
+        while True:
+            running = [it for it in self._inflight if not it[0].done()]
+            if len(running) <= self.capacity:
+                return
+            self._await(running[0])
+
+    def note_watermark(self, wm: int) -> None:
+        self._input_wm = max(self._input_wm, wm)
+        if not self._inflight:
+            self.watermark = self._input_wm
+
+    def poll(self, drain: bool = False) -> List[Batch]:
+        """Completed batches ready for downstream, honoring order mode;
+        advances the released watermark to the input watermark captured
+        before the OLDEST still-pending batch. ``drain`` blocks until
+        everything in flight completes (end of input / barrier)."""
+        out: List[Batch] = []
+        if drain:
+            for item in list(self._inflight):
+                self._await(item)
+        while self._inflight:
+            if self.ordered:
+                head = self._inflight[0]
+                if not (head[0].done() or drain):
+                    break
+                self._inflight.popleft()
+                out.append(self._finish(head))
+            else:
+                done = [it for it in self._inflight if it[0].done()]
+                if not done:
+                    break
+                for it in done:
+                    self._inflight.remove(it)
+                    out.append(self._finish(it))
+        if self._inflight:
+            # watermark released only up to the oldest pending submit
+            self.watermark = max(
+                self.watermark,
+                min(it[3] for it in self._inflight))
+        else:
+            self.watermark = max(self.watermark, self._input_wm)
+        return out
+
+    def _await(self, item) -> None:
+        fut, _, _, _, t0, _ = item
+        remaining = self.timeout_s - (time.monotonic() - t0)
+        try:
+            fut.result(timeout=max(remaining, 0.001))
+        except TimeoutError:
+            raise TimeoutError(
+                f"async I/O batch exceeded {self.timeout_s * 1000:.0f}ms "
+                "timeout") from None
+
+    def _finish(self, item) -> Batch:
+        fut, ts, valid, _, t0, _ = item
+        self._await(item)
+        data = fut.result()  # re-raises the user fn's exception
+        n = len(ts)
+        for k, v in data.items():
+            if len(np.asarray(v)) != n:
+                raise ValueError(
+                    f"async fn changed batch length for field {k!r}: "
+                    f"{len(np.asarray(v))} != {n} (1:1 enrichment "
+                    "contract)")
+        return (data, ts, valid)
+
+    @property
+    def pending(self) -> int:
+        return len(self._inflight)
+
+    # -- snapshot seam: the driver's checkpoint barrier drains every
+    # in-flight batch downstream BEFORE snapshotting, so this operator
+    # is stateless at snapshot time by construction
+    state_version = 0  # constant: the (empty) snapshot never changes
+
+    def snapshot_state(self):
+        assert not self._inflight, \
+            "checkpoint barrier must drain async I/O first"
+        return {"kind": "async_io"}
+
+    def restore_state(self, snap) -> None:
+        self._inflight.clear()
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
+class AsyncFunction:
+    """User interface (ref: api/functions/async/AsyncFunction.java) —
+    batch form: override ``invoke_batch(data, ts) -> data'`` performing
+    the external lookup for a whole microbatch; return the enriched
+    struct-of-arrays (same length, 1:1)."""
+
+    def invoke_batch(self, data: Dict[str, np.ndarray],
+                     ts: np.ndarray) -> Dict[str, np.ndarray]:
+        raise NotImplementedError

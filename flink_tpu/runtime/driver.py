@@ -170,6 +170,16 @@ class Driver:
                 # ingest loop calls throttle() after releasing it), so
                 # drain deliveries never queue behind a transfer wait
                 self._ops[n.id].external_throttle = True
+            elif n.kind == "async_io":
+                from flink_tpu.ops.async_io import AsyncIOOperator
+
+                t = n.window_transform
+                fn = t.fn
+                call = (fn.invoke_batch
+                        if hasattr(fn, "invoke_batch") else fn)
+                self._ops[n.id] = AsyncIOOperator(
+                    call, capacity=t.capacity, timeout_ms=t.timeout_ms,
+                    ordered=t.ordered)
             elif n.kind == "process":
                 from flink_tpu.ops.process import KeyedProcessOperator
 
@@ -345,6 +355,14 @@ class Driver:
         loop-thread work is the emit flush, sink staging, and the
         snapshot freeze (device leaves are dispatched on-device clones);
         fetching/serializing/writing runs on the checkpoint executor."""
+        # barrier part 1: in-flight async-I/O batches are NOT in the
+        # snapshot (their source positions already advanced) — drain
+        # them downstream first so the checkpoint covers their effects
+        with self._push_lock:
+            for nid, op in self._ops.items():
+                if self.plan.node(nid).kind == "async_io":
+                    for b in op.poll(drain=True):
+                        self._push_downstream(nid, b)
         self._flush_emits()  # barrier: staged epoch must be complete
         sinks = [n.sink for n in self.plan.nodes.values() if n.kind == "sink"]
         pend = self._coordinator.trigger_async(
@@ -488,6 +506,9 @@ class Driver:
                         it.close()
             if self._metrics_server is not None:
                 self._metrics_server.close()
+            for nid, op in self._ops.items():
+                if self.plan.node(nid).kind == "async_io":
+                    op.close()
             raise
         finally:
             if self._ckpt_executor is not None:
@@ -637,6 +658,9 @@ class Driver:
         for n in self.plan.nodes.values():
             if n.kind == "sink":
                 n.sink.close()
+        for nid, op in self._ops.items():
+            if self.plan.node(nid).kind == "async_io":
+                op.close()
         if self._metrics_server is not None:
             self._metrics_server.close()
         for nid, op in self._ops.items():
@@ -669,6 +693,11 @@ class Driver:
             self._push_downstream(nid, (data, ts, valid))
         elif n.kind == "union":
             self._push_downstream(nid, batch)
+        elif n.kind == "async_io":
+            op = self._ops[nid]
+            ups = self._upstream[nid]
+            in_wm = min((self._out_wm[u] for u in ups), default=LONG_MIN)
+            op.submit(batch, in_wm)
         elif n.kind == "partition":
             # single local driver = parallelism 1: every strategy is a
             # pass-through here (identical to the reference at p=1). The
@@ -743,6 +772,15 @@ class Driver:
                     fired = op.advance_watermark(wm)
                     self._emit_fired(nid, fired)
                 self._out_wm[nid] = in_wm
+            elif n.kind == "async_io":
+                op = self._ops[nid]
+                final_in = in_wm == _FINAL
+                if not final_in:
+                    op.note_watermark(in_wm)
+                for b in op.poll(drain=final_in):
+                    self._push_downstream(nid, b)
+                # a watermark must never overtake buffered batches
+                self._out_wm[nid] = _FINAL if final_in else op.watermark
             else:
                 self._out_wm[nid] = in_wm
 
@@ -793,7 +831,7 @@ class Driver:
                 seen.add(d)
                 k = self.plan.node(d).kind
                 if k in ("window", "session", "join", "count_window",
-                         "window_all", "process"):
+                         "window_all", "process", "async_io"):
                     ok = False
                     break
                 stack.extend(self.plan.node(d).downstream)
