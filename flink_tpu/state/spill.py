@@ -30,8 +30,6 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from flink_tpu.ops.aggregates import LaneAggregate
-
 _NEG_INF = np.float32(-np.inf)
 _POS_INF = np.float32(np.inf)
 
@@ -53,7 +51,10 @@ class HostSpillStore:
     (the round-2 session-registry mistake, not repeated here).
     """
 
-    def __init__(self, agg: LaneAggregate):
+    def __init__(self, agg):  # duck-typed LaneAggregate (ops.aggregates)
+        # NOTE: deliberately untyped — the state layer sits BELOW ops in
+        # the layer map (tests/test_architecture.py) and only needs the
+        # lane contract: sum/max/min_width, lift_masked, finalize
         self.agg = agg
         self.panes: Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray,
                                     np.ndarray, np.ndarray]] = {}

@@ -1,0 +1,182 @@
+"""Architecture tests — layering rules enforced as tests (SURVEY §5
+tier 6; ref: flink-architecture-tests' ArchUnit rules: API modules must
+not depend on runtime internals, connectors must not reach into
+runtime, etc.). Imports are the Python dependency unit, so the rules
+check each module's import statements against the layer map (SURVEY
+§2): L0 foundation < L2 state < L3 ops < L4 runtime; api/ is the outer
+user surface that the runtime may load, never the reverse except
+through declared seams."""
+import ast
+import os
+from typing import Dict, List, Set
+
+import pytest
+
+PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "flink_tpu")
+
+
+def imports_of(path: str, mod: str) -> Set[str]:
+    """All imports (top-level AND function-scoped) of module ``mod``,
+    with RELATIVE imports resolved to absolute names — a layer
+    violation written as ``from ..ops import x`` must not slip past."""
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    pkg_parts = mod.split(".")[:-1]
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            out.update(a.name for a in node.names)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:  # relative: resolve against this package
+                base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                name = ".".join(base + ([node.module] if node.module else []))
+                out.add(name)
+            elif node.module:
+                out.add(node.module)
+    return {i for i in out if i.startswith("flink_tpu")}
+
+
+def package_imports() -> Dict[str, Set[str]]:
+    """module name (flink_tpu.x.y) -> flink_tpu imports. Function-scoped
+    (lazy) imports are INCLUDED and indistinguishable from top-level
+    ones — the directional layer rules below are deliberately strict
+    (a lower layer must not reach up even lazily); only the cycle test
+    restricts itself to top-level imports, because laziness is exactly
+    what makes the declared two-way seams safe."""
+    deps = {}
+    for root, _, files in os.walk(PKG):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            rel = os.path.relpath(path, os.path.dirname(PKG))
+            mod = rel[:-3].replace(os.sep, ".")
+            if mod.endswith(".__init__"):
+                mod = mod[: -len(".__init__")]
+            deps[mod] = imports_of(path, mod)
+    return deps
+
+
+def top_levels(imports: Set[str]) -> Set[str]:
+    """flink_tpu.<sub> package of each import."""
+    out = set()
+    for i in imports:
+        parts = i.split(".")
+        if len(parts) >= 2:
+            out.add(parts[1])
+    return out
+
+
+class TestLayering:
+    def test_foundation_imports_no_upper_layer(self):
+        """L0 (config, records, time, fs) must not import ops, runtime,
+        graph, api, checkpoint — the foundation is leaf-only."""
+        deps = package_imports()
+        forbidden = {"ops", "runtime", "graph", "api", "checkpoint",
+                     "nexmark", "exchange", "state"}
+        for mod in ("flink_tpu.config", "flink_tpu.records",
+                    "flink_tpu.fs", "flink_tpu.time.watermarks"):
+            bad = top_levels(deps.get(mod, set())) & forbidden
+            assert not bad, f"{mod} imports upper layers: {bad}"
+
+    def test_state_does_not_import_runtime_or_api(self):
+        """L2 state backends are below the runtime and the user API."""
+        deps = package_imports()
+        for mod, imp in deps.items():
+            if mod.startswith("flink_tpu.state"):
+                bad = top_levels(imp) & {"runtime", "api", "graph",
+                                         "nexmark", "ops"}
+                assert not bad, f"{mod} -> {bad}"
+
+    def test_ops_do_not_import_runtime(self):
+        """L3 operators are driven BY the runtime, never the reverse —
+        an operator importing the driver would invert the layer map."""
+        deps = package_imports()
+        for mod, imp in deps.items():
+            if mod.startswith("flink_tpu.ops"):
+                bad = top_levels(imp) & {"runtime", "nexmark"}
+                assert not bad, f"{mod} -> {bad}"
+
+    def test_exchange_is_below_ops_and_runtime(self):
+        deps = package_imports()
+        for mod, imp in deps.items():
+            if mod.startswith("flink_tpu.exchange"):
+                bad = top_levels(imp) & {"runtime", "api", "graph",
+                                         "ops", "nexmark"}
+                assert not bad, f"{mod} -> {bad}"
+
+    def test_checkpoint_below_runtime(self):
+        """The checkpoint subsystem must not depend on the driver or the
+        user API (the driver calls INTO it)."""
+        deps = package_imports()
+        for mod, imp in deps.items():
+            if mod.startswith("flink_tpu.checkpoint"):
+                bad = top_levels(imp) & {"runtime", "api", "graph",
+                                         "ops", "nexmark"}
+                assert not bad, f"{mod} -> {bad}"
+
+    def test_obs_has_no_data_plane_deps(self):
+        """Metrics/REST observe; they never import the data plane."""
+        deps = package_imports()
+        for mod, imp in deps.items():
+            if mod.startswith("flink_tpu.obs"):
+                bad = top_levels(imp) & {"ops", "state", "exchange",
+                                         "checkpoint", "nexmark"}
+                assert not bad, f"{mod} -> {bad}"
+
+    def test_no_module_level_import_cycles(self):
+        """MODULE-level, top-level-import acyclicity — the property
+        whose violation actually breaks imports. (Subpackage-level
+        "cycles" through declared seams are allowed: ops/graph consume
+        the api.windowing VOCABULARY module, and api.environment ↔
+        runtime.driver link lazily inside functions — both directions
+        are function-scoped by design, which this test proves stays
+        true: only TOP-LEVEL imports count, so a regression to a
+        module-level circular import fails here.)"""
+        g: Dict[str, Set[str]] = {}
+        for root, _, files in os.walk(PKG):
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(root, fn)
+                rel = os.path.relpath(path, os.path.dirname(PKG))
+                mod = rel[:-3].replace(os.sep, ".")
+                if mod.endswith(".__init__"):
+                    mod = mod[: -len(".__init__")]
+                with open(path, "r", encoding="utf-8") as f:
+                    tree = ast.parse(f.read())
+                tops: Set[str] = set()
+                for node in tree.body:  # top level ONLY (lazy excluded)
+                    if isinstance(node, ast.Import):
+                        tops.update(a.name for a in node.names)
+                    elif isinstance(node, ast.ImportFrom) and node.module:
+                        tops.add(node.module)
+                g[mod] = {i for i in tops if i.startswith("flink_tpu")}
+
+        state: Dict[str, bool] = {}
+
+        def visit(n, stack):
+            if n in stack:
+                cycle = stack[stack.index(n):] + [n]
+                pytest.fail(f"module import cycle: {' -> '.join(cycle)}")
+            if state.get(n):
+                return
+            for m in g.get(n, ()):
+                visit(m, stack + [n])
+            state[n] = True
+
+        for n in list(g):
+            visit(n, [])
+
+
+class TestPublicSurface:
+    def test_user_invocable_modules_import_cleanly(self):
+        """Every public entry module imports without side effects beyond
+        registration (the plugin loader runs only on demand)."""
+        import importlib
+
+        for mod in ("flink_tpu.api.environment", "flink_tpu.api.datastream",
+                    "flink_tpu.api.functions", "flink_tpu.cli",
+                    "flink_tpu.state_processor", "flink_tpu.fs"):
+            importlib.import_module(mod)
