@@ -1,0 +1,286 @@
+"""CEP — complex event processing (pattern matching on keyed streams).
+
+ref: flink-libraries/flink-cep (Pattern.begin/next/followedBy/where/
+within → NFACompiler → CepOperator keeping per-key NFA state +
+partial-match buffers in keyed state).
+
+TPU-first redesign: the reference walks one NFA per key per RECORD.
+Here the per-key automaton state is COLUMNS over key slots (current
+stage, window-start ts, per-stage match timestamps), and a microbatch
+is processed by WITHIN-KEY RANK: sort by (key, ts), then step r
+advances EVERY key's automaton on its r-th event of the batch at once —
+the sequential dependence lives only along each key's own event chain,
+so the loop length is the longest per-key run in the batch while each
+step is one vectorized transition over all keys.
+
+Supported semantics (a deterministic, documented subset of the
+reference's full NFA):
+- linear patterns: ``begin(a).next(b)`` (STRICT contiguity — the very
+  next event of that key must match or the partial resets) and
+  ``followed_by`` (RELAXED — non-matching events in between are
+  skipped), with vectorized ``where`` predicates per stage;
+- ``within(ms)``: a partial older than the window resets (the event
+  that broke it may immediately start a new partial);
+- after-match skipping: SKIP_PAST_LAST_EVENT — each event belongs to
+  at most one match, matches never overlap (deterministic; the
+  reference's default NO_SKIP enumerates overlapping matches, which
+  requires the exponential partial-match buffers this design
+  deliberately trades away);
+- one active partial per key (greedy earliest): no simultaneous
+  alternative partials. A failed strict transition re-tests the
+  breaking event against stage 0.
+
+Matches emit one row per completed pattern: key, ``<stage>_ts`` per
+stage, and the match's start/end timestamps.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.state.keyed import KeyDirectory
+from flink_tpu.time.watermarks import LONG_MIN
+
+
+@dataclasses.dataclass(frozen=True)
+class _Stage:
+    name: str
+    where: Optional[Callable[[Dict[str, np.ndarray]], np.ndarray]]
+    strict: bool  # True = next() contiguity; False = followed_by()
+
+
+class Pattern:
+    """Fluent pattern builder (ref: cep/pattern/Pattern.java)."""
+
+    def __init__(self, stages: Tuple[_Stage, ...],
+                 within_ms: Optional[int] = None):
+        self._stages = stages
+        self.within_ms = within_ms
+
+    @classmethod
+    def begin(cls, name: str) -> "Pattern":
+        return cls((_Stage(name, None, strict=False),))
+
+    def where(self, pred: Callable[[Dict[str, np.ndarray]], np.ndarray]) -> "Pattern":
+        """Vectorized predicate over the batch's field arrays → (B,)
+        bool. Applies to the most recent stage."""
+        last = self._stages[-1]
+        return Pattern(self._stages[:-1]
+                       + (_Stage(last.name, pred, last.strict),),
+                       self.within_ms)
+
+    def next(self, name: str) -> "Pattern":
+        """STRICT contiguity: the key's immediately-next event."""
+        return Pattern(self._stages + (_Stage(name, None, strict=True),),
+                       self.within_ms)
+
+    def followed_by(self, name: str) -> "Pattern":
+        """RELAXED contiguity: later event, intervening ones skipped."""
+        return Pattern(self._stages + (_Stage(name, None, strict=False),),
+                       self.within_ms)
+
+    def within(self, ms: int) -> "Pattern":
+        return Pattern(self._stages, int(ms))
+
+    @property
+    def stages(self) -> Tuple[_Stage, ...]:
+        for s in self._stages:
+            if s.where is None:
+                raise ValueError(f"stage {s.name!r} has no where()")
+        return self._stages
+
+
+class CepOperator:
+    """Keyed pattern-matching operator (ref: cep/operator/CepOperator).
+    Driver protocol mirrors KeyedProcessOperator: process_batch ingests,
+    take_fired returns match rows."""
+
+    def __init__(self, pattern: Pattern, *, num_shards: int = 128,
+                 slots_per_shard: int = 1024) -> None:
+        self.pattern = pattern
+        self.stages = pattern.stages
+        self.S = len(self.stages)
+        if self.S < 1:
+            raise ValueError("pattern needs at least one stage")
+        self.directory = KeyDirectory(num_shards, slots_per_shard)
+        cap = num_shards * slots_per_shard
+        self.stage = np.zeros(cap, np.int32)        # next stage to match
+        self.stage_ts = np.zeros((cap, self.S), np.int64)
+        # highest event ts processed per key: the automaton consumes
+        # each key's events in time order WITHIN a batch; an event
+        # arriving in a later batch but timestamped before this frontier
+        # cannot be sequenced (no cross-batch buffering in v1) — it is
+        # dropped WITH accounting (late_records), never silently woven
+        # in out of order (which could emit matches whose stage
+        # timestamps run backward)
+        self._last_ts = np.full(cap, np.iinfo(np.int64).min, np.int64)
+        self.watermark = LONG_MIN
+        self.late_records = 0
+        self.records_dropped_full = 0
+        self.state_version = 0
+        self._matches: List[Dict[str, np.ndarray]] = []
+
+    # -- data plane ------------------------------------------------------
+
+    def process_batch(self, keys, ts, data: Dict[str, np.ndarray],
+                      valid=None) -> None:
+        self.state_version += 1
+        keys = np.asarray(keys, np.int64)
+        ts = np.asarray(ts, np.int64)
+        valid = (np.ones(len(ts), bool) if valid is None
+                 else np.asarray(valid, bool))
+        idx = np.nonzero(valid)[0]
+        if len(idx) == 0:
+            return
+        slots = self.directory.assign(keys[idx])
+        bad = slots < 0
+        if bad.any():
+            self.records_dropped_full += int(bad.sum())
+            idx, slots = idx[~bad], slots[~bad]
+        if len(idx) == 0:
+            return
+
+        # cross-batch order: drop events behind the key's frontier
+        fresh = ts[idx] >= self._last_ts[slots]
+        if not fresh.all():
+            self.late_records += int((~fresh).sum())
+            idx, slots = idx[fresh], slots[fresh]
+            if len(idx) == 0:
+                return
+
+        # pre-evaluate every stage predicate over the whole batch ONCE
+        # (vectorized; the rank loop below only gathers bits)
+        sub = {k: np.asarray(v)[idx] for k, v in data.items()}
+        preds = np.stack([np.asarray(st.where(sub), bool)
+                          for st in self.stages])      # (S, n)
+
+        # order by (key, ts); within-key rank = position in its run
+        order = np.lexsort((ts[idx], keys[idx]))
+        sl = slots[order].astype(np.int64)
+        tt = ts[idx][order]
+        kk = keys[idx][order]
+        pr = preds[:, order]                            # (S, n)
+        run_start = np.empty(len(sl), bool)
+        run_start[0] = True
+        run_start[1:] = kk[1:] != kk[:-1]
+        rank = np.arange(len(sl)) - np.maximum.accumulate(
+            np.where(run_start, np.arange(len(sl)), 0))
+        max_rank = int(rank.max()) + 1
+
+        within = self.pattern.within_ms
+        strict = np.array([s.strict for s in self.stages], bool)
+        for r in range(max_rank):
+            m = rank == r                    # one event per key this step
+            s_r = sl[m]
+            t_r = tt[m]
+            p_r = pr[:, m]                   # (S, k)
+            cur = self.stage[s_r]            # (k,) next stage to match
+
+            # within-window expiry: partial too old resets to stage 0
+            if within is not None:
+                expired = (cur > 0) & (t_r - self.stage_ts[s_r, 0] > within)
+                cur = np.where(expired, 0, cur)
+
+            hit = p_r[np.minimum(cur, self.S - 1), np.arange(len(s_r))]
+            adv = hit                        # advance on match
+            # strict stage missed -> partial dies; the breaking event
+            # re-tests against stage 0
+            miss_strict = ~hit & strict[np.minimum(cur, self.S - 1)] & (cur > 0)
+            restart = miss_strict & p_r[0, np.arange(len(s_r))]
+            new_stage = np.where(adv, cur + 1,
+                                 np.where(miss_strict,
+                                          np.where(restart, 1, 0), cur))
+            # record the matched stage's timestamp
+            st_idx = np.where(adv, cur, 0)
+            write = adv | restart
+            self.stage_ts[s_r[write], st_idx[write]] = t_r[write]
+
+            done = new_stage >= self.S
+            if done.any():
+                d = np.nonzero(done)[0]
+                row = {"key": kk[m][d],
+                       "match_start": self.stage_ts[s_r[d], 0].copy(),
+                       "match_end": t_r[d].copy()}
+                for si, stg in enumerate(self.stages[:-1]):
+                    row[f"{stg.name}_ts"] = self.stage_ts[s_r[d], si].copy()
+                row[f"{self.stages[-1].name}_ts"] = t_r[d].copy()
+                self._matches.append(row)
+                new_stage = np.where(done, 0, new_stage)  # SKIP_PAST_LAST
+
+            self.stage[s_r] = new_stage.astype(np.int32)
+            self._last_ts[s_r] = t_r
+
+    def take_fired(self):
+        from flink_tpu.ops.window import FiredWindows
+
+        if not self._matches:
+            return None
+        parts = self._matches
+        self._matches = []
+        out = {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
+        out["__ts__"] = out["match_end"].astype(np.int64)
+        return FiredWindows(data=out)
+
+    # -- time plane ------------------------------------------------------
+
+    def advance_watermark(self, wm: int):
+        from flink_tpu.ops.window import FiredWindows
+
+        if wm > self.watermark:
+            self.watermark = wm
+        return FiredWindows(data={"__ts__": np.zeros(0, np.int64)})
+
+    def final_watermark(self) -> int:
+        return self.watermark if self.watermark != LONG_MIN else 0
+
+    def quiesce(self) -> None:
+        pass
+
+    def throttle(self) -> None:
+        pass
+
+    # -- snapshot seam ----------------------------------------------------
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        return {
+            "kind": "cep",
+            "directory": self.directory.snapshot(),
+            "stage": self.stage.copy(),
+            "stage_ts": self.stage_ts.copy(),
+            "watermark": self.watermark,
+            "late_records": self.late_records,
+            "records_dropped_full": self.records_dropped_full,
+            "last_ts": self._last_ts.copy(),
+        }
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        self.directory = KeyDirectory.restore(
+            self.directory.num_shards, self.directory.slots_per_shard,
+            snap["directory"],
+            (self.directory.shard_lo, self.directory.shard_hi))
+        self.stage = np.array(snap["stage"])
+        self.stage_ts = np.array(snap["stage_ts"])
+        self.watermark = snap["watermark"]
+        self.late_records = snap["late_records"]
+        self.records_dropped_full = snap["records_dropped_full"]
+        self._last_ts = np.array(snap["last_ts"])
+        self._matches = []
+
+
+class CEP:
+    """Entry point (ref: cep/CEP.java): ``CEP.pattern(keyed_stream,
+    pattern)`` → DataStream of match rows."""
+
+    @staticmethod
+    def pattern(keyed_stream, pattern: Pattern, name: str = "cep"):
+        from flink_tpu.graph.transformations import CepTransformation
+
+        kt = keyed_stream.transform
+        t = CepTransformation(name, (kt,), pattern=pattern,
+                              key_field=kt.key_field)
+        keyed_stream.env._register(t)
+        from flink_tpu.api.datastream import DataStream
+
+        return DataStream(keyed_stream.env, t)

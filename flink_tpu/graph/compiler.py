@@ -24,6 +24,7 @@ from flink_tpu.graph.transformations import (
     KeyByTransformation,
     MapTransformation,
     AsyncIOTransformation,
+    CepTransformation,
     CountWindowAggregateTransformation,
     KeyedProcessTransformation,
     PartitionTransformation,
@@ -155,6 +156,11 @@ def compile_job(
             # chain — the isChainable rule excludes non-forward edges)
             up = node_for(t.inputs[0])
             n = new_node("partition", t.name, partition_strategy=t.strategy)
+            nodes[up].downstream.append(n.id)
+        elif isinstance(t, CepTransformation):
+            up = node_for(t.inputs[0])
+            n = new_node("cep", t.name, window_transform=t,
+                         key_field=t.key_field)
             nodes[up].downstream.append(n.id)
         elif isinstance(t, KeyedProcessTransformation):
             up = node_for(t.inputs[0])

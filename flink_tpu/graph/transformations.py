@@ -111,6 +111,15 @@ class PartitionTransformation(Transformation):
 
 
 @dataclasses.dataclass(eq=False)
+class CepTransformation(Transformation):
+    """Keyed pattern matching (ref: cep/PatternStream → CepOperator;
+    see flink_tpu/cep.py)."""
+
+    pattern: Any = None
+    key_field: str = "key"
+
+
+@dataclasses.dataclass(eq=False)
 class KeyedProcessTransformation(Transformation):
     """Keyed process function with state + timers (ref: KeyedStream
     .process → KeyedProcessOperator; see ops/process.py)."""

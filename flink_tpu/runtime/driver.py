@@ -180,6 +180,13 @@ class Driver:
                 self._ops[n.id] = AsyncIOOperator(
                     call, capacity=t.capacity, timeout_ms=t.timeout_ms,
                     ordered=t.ordered)
+            elif n.kind == "cep":
+                from flink_tpu.cep import CepOperator
+
+                t = n.window_transform
+                self._ops[n.id] = CepOperator(
+                    t.pattern, num_shards=num_shards,
+                    slots_per_shard=slots)
             elif n.kind == "process":
                 from flink_tpu.ops.process import KeyedProcessOperator
 
@@ -719,13 +726,14 @@ class Driver:
             dev_data = {k: v for k, v in data.items()
                         if np.asarray(v).dtype != object}
             op.process_batch(ts, dev_data, valid)
-        elif n.kind in ("window", "session", "count_window", "process"):
+        elif n.kind in ("window", "session", "count_window", "process",
+                        "cep"):
             op = self._ops[nid]
             keys = np.asarray(data[n.key_field], np.int64)
             dev_data = {k: v for k, v in data.items()
                         if np.asarray(v).dtype != object}
             op.process_batch(keys, ts, dev_data, valid)
-            if n.kind in ("count_window", "process"):
+            if n.kind in ("count_window", "process", "cep"):
                 # these emit per-step, not (only) per-watermark
                 fired = op.take_fired()
                 if fired is not None:
@@ -831,7 +839,7 @@ class Driver:
                 seen.add(d)
                 k = self.plan.node(d).kind
                 if k in ("window", "session", "join", "count_window",
-                         "window_all", "process", "async_io"):
+                         "window_all", "process", "async_io", "cep"):
                     ok = False
                     break
                 stack.extend(self.plan.node(d).downstream)

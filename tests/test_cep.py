@@ -1,0 +1,174 @@
+"""CEP pattern matching (ref: flink-cep NFAITCase / CEPITCase patterns:
+strict vs relaxed contiguity, within windows, non-overlapping matches)."""
+import numpy as np
+import pytest
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.api.sinks import CollectSink
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.cep import CEP, CepOperator, Pattern
+from flink_tpu.config import Configuration
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+
+def small_large_pattern(within=None):
+    p = (Pattern.begin("small").where(lambda d: d["amount"] < 10)
+         .followed_by("large").where(lambda d: d["amount"] > 500))
+    return p.within(within) if within else p
+
+
+def feed(op, keys, ts, amounts):
+    op.process_batch(np.asarray(keys, np.int64), np.asarray(ts, np.int64),
+                     {"amount": np.asarray(amounts, np.float64)})
+
+
+def matches(op, a="small", b="large"):
+    f = op.take_fired()
+    if f is None:
+        return []
+    d = dict(f)
+    return sorted(zip(map(int, d["key"]), map(int, d[f"{a}_ts"]),
+                      map(int, d[f"{b}_ts"])))
+
+
+class TestOperator:
+    def test_relaxed_skips_intervening(self):
+        op = CepOperator(small_large_pattern(), num_shards=4,
+                         slots_per_shard=16)
+        # small at 10, noise at 20/30, large at 40 -> one match
+        feed(op, [1, 1, 1, 1], [10, 20, 30, 40], [5, 100, 200, 600])
+        assert matches(op) == [(1, 10, 40)]
+
+    def test_strict_next_requires_adjacency(self):
+        p = (Pattern.begin("a").where(lambda d: d["amount"] < 10)
+             .next("b").where(lambda d: d["amount"] > 500))
+        op = CepOperator(p, num_shards=4, slots_per_shard=16)
+        feed(op, [1, 1, 1], [10, 20, 30], [5, 100, 600])  # 100 breaks it
+        assert matches(op, "a", "b") == []
+        feed(op, [2, 2], [10, 20], [5, 600])              # adjacent: match
+        assert matches(op, "a", "b") == [(2, 10, 20)]
+
+    def test_strict_break_restarts_on_breaking_event(self):
+        p = (Pattern.begin("a").where(lambda d: d["amount"] < 10)
+             .next("b").where(lambda d: d["amount"] > 500))
+        op = CepOperator(p, num_shards=4, slots_per_shard=16)
+        # 5 (a), 3 (breaks strict b BUT matches a -> restart), 600 (b)
+        feed(op, [1, 1, 1], [10, 20, 30], [5, 3, 600])
+        assert matches(op, "a", "b") == [(1, 20, 30)]
+
+    def test_within_expires_partial(self):
+        op = CepOperator(small_large_pattern(within=1000), num_shards=4,
+                         slots_per_shard=16)
+        feed(op, [1, 1], [10, 2000], [5, 600])  # large too late
+        assert matches(op) == []
+        # fresh small then large inside the window
+        feed(op, [1, 1], [3000, 3500], [5, 600])
+        assert matches(op) == [(1, 3000, 3500)]
+
+    def test_skip_past_last_no_overlap(self):
+        op = CepOperator(small_large_pattern(), num_shards=4,
+                         slots_per_shard=16)
+        # s s L L: greedy earliest small matches first large; second
+        # large has no remaining small partial (skip-past-last)
+        feed(op, [1, 1, 1, 1], [10, 20, 30, 40], [5, 6, 600, 700])
+        assert matches(op) == [(1, 10, 30)]
+
+    def test_cross_batch_partials(self):
+        op = CepOperator(small_large_pattern(), num_shards=4,
+                         slots_per_shard=16)
+        feed(op, [7], [100], [5])
+        assert matches(op) == []
+        feed(op, [7], [200], [900])
+        assert matches(op) == [(7, 100, 200)]
+
+    def test_many_keys_vectorized_vs_bruteforce(self):
+        rng = np.random.default_rng(11)
+        K, N = 200, 4000
+        keys = rng.integers(0, K, N)
+        ts = np.arange(N) * 3
+        amounts = np.where(rng.random(N) < 0.2, rng.uniform(0, 9, N),
+                           np.where(rng.random(N) < 0.1,
+                                    rng.uniform(501, 900, N),
+                                    rng.uniform(20, 400, N)))
+        op = CepOperator(small_large_pattern(within=5000), num_shards=8,
+                         slots_per_shard=64)
+        got = []
+        for c in range(0, N, 500):  # ragged batch boundaries
+            feed(op, keys[c:c+500], ts[c:c+500], amounts[c:c+500])
+            got += matches(op)
+
+        # brute force per key, same documented semantics
+        want = []
+        state = {}  # key -> small_ts or None
+        for k, t, a in zip(keys.tolist(), ts.tolist(), amounts.tolist()):
+            st = state.get(k)
+            if st is not None and t - st > 5000:
+                st = None
+            if st is None:
+                if a < 10:
+                    state[k] = t
+            else:
+                if a > 500:
+                    want.append((k, st, t))
+                    state[k] = None
+        assert sorted(got) == sorted(want)
+
+    def test_snapshot_restore_roundtrip(self):
+        def mk():
+            return CepOperator(small_large_pattern(), num_shards=4,
+                               slots_per_shard=16)
+
+        a = mk()
+        feed(a, [1], [10], [5])
+        b = mk()
+        b.restore_state(a.snapshot_state())
+        feed(b, [1], [20], [700])
+        assert matches(b) == [(1, 10, 20)]
+
+
+class TestRegressions:
+    def test_missing_where_raises_at_build(self):
+        p = (Pattern.begin("a").where(lambda d: d["amount"] < 10)
+             .next("b"))  # where() forgotten
+        with pytest.raises(ValueError, match="has no where"):
+            CepOperator(p, num_shards=4, slots_per_shard=16)
+
+    def test_cross_batch_out_of_order_drops_with_accounting(self):
+        """An event timestamped before its key's processed frontier
+        cannot be sequenced (no cross-batch buffering) — it must drop
+        and COUNT, never weave into a backwards match."""
+        op = CepOperator(small_large_pattern(), num_shards=4,
+                         slots_per_shard=16)
+        feed(op, [1], [200], [5])     # small at 200
+        feed(op, [1], [100], [700])   # large BEFORE the frontier: late
+        assert matches(op) == []
+        assert op.late_records == 1
+        feed(op, [1], [300], [700])   # in-order large still matches
+        assert matches(op) == [(1, 200, 300)]
+
+
+class TestCepE2E:
+    def test_pattern_stream_pipeline(self):
+        def gen(split, i):
+            if i >= 3:
+                return None
+            data = [([1, 2, 1], [5.0, 800.0, 3.0]),
+                    ([2, 1, 2], [4.0, 900.0, 2.0]),
+                    ([1, 2, 2], [600.0, 700.0, 100.0])][i]
+            return ({"acct": np.array(data[0], np.int64),
+                     "amount": np.array(data[1], np.float64)},
+                    np.arange(3, dtype=np.int64) + i * 10)
+
+        env = StreamExecutionEnvironment(Configuration(
+            {"pipeline.microbatch-size": 8,
+             "state.num-key-shards": 4, "state.slots-per-shard": 16}))
+        sink = CollectSink()
+        stream = (env.from_source(GeneratorSource(gen),
+                                  WatermarkStrategy.for_monotonous_timestamps())
+                  .key_by("acct"))
+        CEP.pattern(stream, small_large_pattern()).add_sink(sink)
+        env.execute("cep-e2e")
+        got = sorted((int(r["key"]), int(r["small_ts"]), int(r["large_ts"]))
+                     for r in sink.rows)
+        # acct 1: small@0, large@11; acct 2: first small@10, large@21
+        assert got == [(1, 0, 11), (2, 10, 21)]
