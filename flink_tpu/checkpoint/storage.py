@@ -32,6 +32,7 @@ import json
 import os
 import pickle
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 from flink_tpu.fs import FileSystem, get_filesystem
@@ -63,10 +64,15 @@ class FsCheckpointStorage:
     — the checkpoint dir may live on any registered scheme (ref:
     FsCheckpointStorage resolving its path via FileSystem.get)."""
 
-    def __init__(self, root: str, job_id: str, retained: int = 3) -> None:
+    def __init__(self, root: str, job_id: str, retained: int = 3,
+                 compression: str = "none") -> None:
+        if compression not in ("none", "zlib"):
+            raise ValueError(
+                f"compression must be 'none' or 'zlib', got {compression!r}")
         self.root = root
         self.job_id = job_id
         self.retained = max(1, retained)
+        self.compression = compression
         self.fs: FileSystem = get_filesystem(root)
         self.job_dir = os.path.join(root, job_id)
         self.fs.mkdirs(self.job_dir)
@@ -96,7 +102,11 @@ class FsCheckpointStorage:
         d = self._dir(checkpoint_id, savepoint)
         tmp = self._tmp_dir(d)
         with self.fs.open_write(os.path.join(tmp, "state.pkl")) as f:
-            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+            if self.compression == "none":
+                pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+            else:  # buffer only when actually compressing
+                f.write(self._pack(pickle.dumps(
+                    payload, protocol=pickle.HIGHEST_PROTOCOL)))
         ts = int(time.time() * 1000)
         with self.fs.open_write(os.path.join(tmp, "MANIFEST.json")) as f:
             f.write(json.dumps({
@@ -105,6 +115,7 @@ class FsCheckpointStorage:
                 "job_id": self.job_id,
                 "savepoint": savepoint,
                 "format_version": 1,
+                "compression": self.compression,
             }).encode())
         if self.fs.exists(d):
             self.fs.delete(d, recursive=True)
@@ -128,7 +139,7 @@ class FsCheckpointStorage:
         for nid, blob in op_blobs.items():
             fn = f"op-{nid}.pkl"
             with self.fs.open_write(os.path.join(tmp, fn)) as f:
-                f.write(blob)
+                f.write(self._pack(blob))
             op_files[nid] = fn
             versions[nid] = meta_payload.get(
                 "op_versions", {}).get(nid, -1)
@@ -138,7 +149,12 @@ class FsCheckpointStorage:
             op_files[nid] = fn
             versions[nid] = ref.version
         with self.fs.open_write(os.path.join(tmp, "meta.pkl")) as f:
-            pickle.dump(meta_payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+            if self.compression == "none":
+                pickle.dump(meta_payload, f,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+            else:
+                f.write(self._pack(pickle.dumps(
+                    meta_payload, protocol=pickle.HIGHEST_PROTOCOL)))
         ts = int(time.time() * 1000)
         with self.fs.open_write(os.path.join(tmp, "MANIFEST.json")) as f:
             f.write(json.dumps({
@@ -147,6 +163,7 @@ class FsCheckpointStorage:
                 "job_id": self.job_id,
                 "savepoint": savepoint,
                 "format_version": 2,
+                "compression": self.compression,
                 "ops": {nid: {"file": fn, "version": versions[nid]}
                         for nid, fn in op_files.items()},
             }).encode())
@@ -190,25 +207,30 @@ class FsCheckpointStorage:
             with fs.open_read(mf_path) as f:
                 manifest = json.loads(f.read().decode())
             fmt = manifest.get("format_version", 1)
+        comp = manifest.get("compression", "none")
         if fmt == 1:
             with fs.open_read(os.path.join(path, "state.pkl")) as f:
-                return pickle.load(f)
+                return pickle.loads(_unpack(f.read(), comp))
         with fs.open_read(os.path.join(path, "meta.pkl")) as f:
-            payload = pickle.load(f)
+            payload = pickle.loads(_unpack(f.read(), comp))
         ops: Dict[Any, Any] = {}
         versions: Dict[Any, int] = {}
         for nid, entry in manifest.get("ops", {}).items():
             with fs.open_read(os.path.join(path, entry["file"])) as f:
                 # node ids are ints in the live plan; the manifest's JSON
                 # keys are strings — restore the original type
-                ops[int(nid)] = pickle.load(f)
+                ops[int(nid)] = pickle.loads(_unpack(f.read(), comp))
             versions[int(nid)] = entry["version"]
         payload["operators"] = ops
         payload["op_file_versions"] = versions
+        payload["op_file_compression"] = comp
         payload["op_files"] = {
             int(nid): os.path.join(path, e["file"])
             for nid, e in manifest.get("ops", {}).items()}
         return payload
+
+    def _pack(self, raw: bytes) -> bytes:
+        return zlib.compress(raw, 6) if self.compression == "zlib" else raw
 
     def _retire_old(self) -> None:
         """Best-effort retention: a retire/sweep failure must never fail
@@ -258,3 +280,7 @@ def _dir_size(d: str) -> int:
             except OSError:
                 pass
     return size
+
+
+def _unpack(raw: bytes, compression: str) -> bytes:
+    return zlib.decompress(raw) if compression == "zlib" else raw

@@ -261,8 +261,17 @@ class CheckpointingOptions:
         "execution.checkpointing.num-retained", 3,
         "Completed checkpoints kept (ref: state.checkpoints.num-retained).")
     INCREMENTAL = ConfigOption(
-        "execution.checkpointing.incremental", False,
-        "Upload only dirty panes (RocksDB incremental analogue).")
+        "execution.checkpointing.incremental", True,
+        "Reuse (hardlink) the previous checkpoint's blob for operators "
+        "whose state_version is unchanged — the RocksDB shared-SST "
+        "analogue (checkpoint/storage.py format v2). False forces full "
+        "re-serialization every checkpoint.")
+    COMPRESSION = ConfigOption(
+        "execution.checkpointing.compression", "none",
+        "Compress checkpoint payload files: 'none' or 'zlib' (ref: "
+        "execution.checkpointing.snapshot-compression). Applied on the "
+        "background checkpoint executor, never the ingest loop; "
+        "recorded in the manifest so restore self-describes.")
     RESTORE = ConfigOption(
         "execution.checkpointing.restore", "",
         "'' (fresh start), 'latest' (resume from newest complete "

@@ -246,7 +246,8 @@ class Driver:
         storage = FsCheckpointStorage(
             self.config.get(CheckpointingOptions.DIRECTORY),
             job_id=job_name.replace("/", "_"),
-            retained=self.config.get(CheckpointingOptions.RETAINED))
+            retained=self.config.get(CheckpointingOptions.RETAINED),
+            compression=self.config.get(CheckpointingOptions.COMPRESSION))
         return CheckpointCoordinator(storage)
 
     def _snapshot(self, allow_reuse: bool = True) -> Dict[str, Any]:
@@ -256,7 +257,10 @@ class Driver:
         # whose state_version is unchanged since the base (last
         # completed) checkpoint hardlinks that checkpoint's blob instead
         # of re-serializing. Savepoints stay self-contained.
-        base = self._ckpt_base if allow_reuse else None
+        base = (self._ckpt_base
+                if allow_reuse
+                and self.config.get(CheckpointingOptions.INCREMENTAL)
+                else None)
         ops: Dict[Any, Any] = {}
         versions: Dict[str, int] = {}
         for nid, op in self._ops.items():
@@ -316,6 +320,13 @@ class Driver:
         # versions and make it the reuse base — an operator untouched
         # after restore hardlinks its blob at the very next checkpoint
         file_versions = payload.get("op_file_versions")
+        # blob reuse keeps the ORIGINAL bytes; if the restored
+        # checkpoint was written with a different compression than this
+        # run's, hardlinking its blobs under the new manifest would make
+        # later checkpoints undecodable — skip seeding the base
+        if (file_versions and payload.get("op_file_compression", "none")
+                != self.config.get(CheckpointingOptions.COMPRESSION)):
+            file_versions = None
         if file_versions:
             for nid, v in file_versions.items():
                 if nid in self._ops and hasattr(
