@@ -28,9 +28,13 @@ _tried = False
 
 
 def build(force: bool = False) -> bool:
-    """Compile the codec .so (g++ -O3). Returns success."""
+    """Compile the codec .so (g++ -O3). Returns success. A .so older
+    than the source is rebuilt."""
     if os.path.exists(_SO) and not force:
-        return True
+        if not os.path.exists(_SRC):
+            return True  # prebuilt-only deployment: nothing to compare
+        if os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+            return True
     try:
         subprocess.run(
             ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO],
@@ -45,11 +49,28 @@ def _load() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_SO) and not build():
+    # build() is a fast no-op when the .so is fresh; calling it
+    # unconditionally also rebuilds a STALE .so (older than codec.cc) —
+    # loading one would fail symbol binding below
+    if not build():
         return None
-    lib = ctypes.CDLL(_SO)
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
     i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
     f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    try:
+        _bind(lib, i64p, f32p)
+    except AttributeError:
+        # stale prebuilt .so missing newer symbols and no compiler to
+        # rebuild: fall back to numpy rather than crash callers
+        return None
+    _lib = lib
+    return _lib
+
+
+def _bind(lib, i64p, f32p) -> None:
     lib.tokenize_hash.restype = ctypes.c_int64
     lib.tokenize_hash.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, i64p, ctypes.c_int64,
@@ -68,8 +89,20 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.encode_i64_rows.argtypes = [
         i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_char,
         ctypes.c_char_p, ctypes.c_int64]
-    _lib = lib
-    return _lib
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    lib.ht_new.restype = ctypes.c_void_p
+    lib.ht_new.argtypes = [ctypes.c_int64]
+    lib.ht_free.restype = None
+    lib.ht_free.argtypes = [ctypes.c_void_p]
+    lib.ht_count.restype = ctypes.c_int64
+    lib.ht_count.argtypes = [ctypes.c_void_p]
+    lib.ht_lookup.restype = None
+    lib.ht_lookup.argtypes = [
+        ctypes.c_void_p, i64p, ctypes.c_int64, i64p, u8p]
+    lib.ht_insert.restype = None
+    lib.ht_insert.argtypes = [ctypes.c_void_p, i64p, i64p, ctypes.c_int64]
+    lib.hash_keys.restype = None
+    lib.hash_keys.argtypes = [i64p, ctypes.c_int64, i64p]
 
 
 def native_available() -> bool:
@@ -178,3 +211,56 @@ def encode_i64_rows(vals: np.ndarray, delim: str = ",") -> bytes:
                             delim.encode(), buf, cap)
     assert n >= 0
     return buf.raw[:n]
+
+
+def hash_keys_native(keys: np.ndarray) -> Optional[np.ndarray]:
+    """splitmix64-finalize a key batch in C (bit-identical to
+    ``records.hash_keys_numpy``); None when the library is unbuilt."""
+    lib = _load()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys, np.int64)
+    out = np.empty(len(keys), np.int64)
+    lib.hash_keys(keys, len(keys), out)
+    return out
+
+
+class NativeHashTable:
+    """int64 → int64 open-addressing table in C (the KeyDirectory probe
+    loop; ref role: CopyOnWriteStateMap.get/put batched). Interface
+    mirrors ``state.keyed._NumpyHashTable``; construct via
+    ``NativeHashTable.create()`` which returns None when the codec
+    library is unavailable so callers can fall back."""
+
+    def __init__(self, lib, capacity_hint: int) -> None:
+        self._lib = lib
+        self._h = lib.ht_new(capacity_hint)
+
+    @classmethod
+    def create(cls, capacity_hint: int = 1024) -> Optional["NativeHashTable"]:
+        lib = _load()
+        return cls(lib, capacity_hint) if lib is not None else None
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._lib.ht_free(h)
+
+    @property
+    def _count(self) -> int:
+        return int(self._lib.ht_count(self._h))
+
+    def lookup_keys(self, keys: np.ndarray):
+        """(values, found) — hashes computed inline in C."""
+        keys = np.ascontiguousarray(keys, np.int64)
+        vals = np.empty(len(keys), np.int64)
+        found = np.empty(len(keys), np.uint8)
+        self._lib.ht_lookup(self._h, keys, len(keys), vals, found)
+        return vals, found.astype(bool)
+
+    def insert_batch(self, keys: np.ndarray, key_hashes, vals: np.ndarray) -> None:
+        """Insert-or-update; ``key_hashes`` accepted for interface parity
+        with the numpy table (the C side re-derives them)."""
+        keys = np.ascontiguousarray(keys, np.int64)
+        vals = np.ascontiguousarray(vals, np.int64)
+        self._lib.ht_insert(self._h, keys, vals, len(keys))

@@ -76,15 +76,64 @@ def apply_kernel(
     p = jnp.where(valid, packed, 0)
     rows = jnp.where(valid, p // ring, dump_row).astype(jnp.int32)
     ring_ix = (p % ring).astype(jnp.int32)
+    return _scatter_panes(state, rows, ring_ix, valid, data, agg)
 
+
+def _scatter_panes(state, rows, ring_ix, valid, data, agg):
     s_l, mx_l, mn_l = agg.lift_masked(data, valid)
-    new = PaneState(
+    return PaneState(
         sums=state.sums.at[rows, ring_ix].add(s_l),
         maxs=state.maxs.at[rows, ring_ix].max(mx_l),
         mins=state.mins.at[rows, ring_ix].min(mn_l),
         counts=state.counts.at[rows, ring_ix].add(valid.astype(jnp.int32)),
     )
-    return new
+
+
+INVALID_SLOT_U16 = 0xFFFF  # sentinel slot for invalid rows in split uploads
+
+
+def split_decode(sc: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(B,3) uint8 → ((B,) uint16 slot, (B,) uint8 ring column). Bytes
+    0-1 are the little-endian slot id (bitcast, matching numpy
+    ``.view(uint8)`` on the host), byte 2 the ring column. Record-major
+    layout so a shard_map partition along axis 0 keeps records whole."""
+    slot = lax.bitcast_convert_type(sc[:, :2], jnp.uint16)
+    return slot, sc[:, 2]
+
+
+def split_encode(slots: np.ndarray, cols: np.ndarray,
+                 valid: np.ndarray) -> np.ndarray:
+    """Host half of ``split_decode``: (B,) slots + (B,) ring columns →
+    (B,3) uint8 with 0xFFFF marking invalid rows."""
+    n = len(slots)
+    sl = np.where(valid, slots, INVALID_SLOT_U16).astype(np.uint16)
+    sc = np.empty((n, 3), np.uint8)
+    sc[:, :2] = sl.view(np.uint8).reshape(n, 2)
+    sc[:, 2] = cols
+    return sc
+
+
+def apply_kernel_split(
+    state: PaneState,
+    sc: jax.Array,         # (B, 3) uint8: see split_decode
+    data: Dict[str, jax.Array],
+    *,
+    agg: LaneAggregate,
+    dump_row: int,
+) -> PaneState:
+    """``apply_kernel`` with the (slot, ring column) pair shipped as one
+    (B,3) uint8 buffer instead of a packed int32 — 3 bytes/record on the
+    host→device link instead of 4, in ONE transfer (a second buffer
+    costs a second round trip on the tunnel-attached chip; measured
+    708→515 ms/batch at 2^20). The link, not the MXU, is the Q5
+    throughput ceiling (PROFILE.md §4), so bytes-and-trips is the
+    currency; the kernel body is identical — the rows/ring_ix it needs
+    decode in two device ops."""
+    slot, col = split_decode(sc)
+    valid = slot != INVALID_SLOT_U16
+    rows = jnp.where(valid, slot.astype(jnp.int32), dump_row)
+    ring_ix = col.astype(jnp.int32)
+    return _scatter_panes(state, rows, ring_ix, valid, data, agg)
 
 
 def fire_kernel(
@@ -305,6 +354,10 @@ def clear_kernel(state: PaneState, clear_mask: jax.Array) -> PaneState:
 _JIT_APPLY = jax.jit(
     apply_kernel,
     static_argnames=("agg", "ring", "dump_row"),
+    donate_argnums=(0,))
+_JIT_APPLY_SPLIT = jax.jit(
+    apply_kernel_split,
+    static_argnames=("agg", "dump_row"),
     donate_argnums=(0,))
 _JIT_FIRE_PACK = jax.jit(
     fire_pack_kernel,
@@ -647,6 +700,13 @@ class WindowOperator:
             ring=self.plan.ring,
             dump_row=self.layout.slots,
         )
+        # 3-byte/record upload path: eligible while the slot id fits
+        # uint16 (dump row included; 0xFFFF reserved for invalid) and the
+        # ring column fits uint8. Re-checked here after every ring growth.
+        self._split_upload = (
+            self.layout.rows <= INVALID_SLOT_U16 and self.plan.ring <= 256)
+        self._apply_split = functools.partial(
+            _JIT_APPLY_SPLIT, agg=self.agg, dump_row=self.layout.slots)
         self._fire_pack = functools.partial(
             _JIT_FIRE_PACK,
             agg=self.agg,
@@ -755,6 +815,28 @@ class WindowOperator:
             ),
             donate_argnums=(0,),
         )
+
+        def apply_shard_split(state, sc, data):
+            # 3-byte upload (see apply_kernel_split): decode + recombine
+            # to the packed form on device — the host link gets the byte
+            # savings; the ICI exchange keeps its existing layout
+            slot, col = split_decode(sc)
+            packed = jnp.where(
+                slot == INVALID_SLOT_U16,
+                jnp.int32(-1),
+                slot.astype(jnp.int32) * ring_len + col.astype(jnp.int32))
+            return apply_shard(state, packed, data)
+
+        self._apply_sharded_split = jax.jit(
+            jax.shard_map(
+                apply_shard_split, mesh=mp.mesh,
+                in_specs=(state_spec, batch_spec, batch_spec),
+                out_specs=(state_spec, rep),
+            ),
+            donate_argnums=(0,),
+        )
+        # global slot ids must fit uint16 with 0xFFFF reserved
+        self._split_upload = n_dev * spd < INVALID_SLOT_U16 and ring_len <= 256
 
         # compaction capacity is a static shape → one compiled shard_map
         # per pow2 bucket (cached; bucket grows with registered keys)
@@ -943,20 +1025,28 @@ class WindowOperator:
         # pack (slot, ring column) into one narrow array — the only
         # per-record value the device scatter needs (see apply_kernel)
         ring = self.plan.ring
-        packed = slots * ring + panes % ring
-        packed[~valid] = -1
-        # dtype bound uses GLOBAL rows: in mesh mode slots are global
-        # (apply_shard routes by slot // spd), so the max packed value is
-        # n_devices × the local-block bound
-        n_blocks = self.mesh_plan.n_devices if self.mesh_plan else 1
-        dt = np.int32 if (n_blocks * self.layout.rows + 1) * ring < 2**31 else np.int64
-        packed = packed.astype(dt, copy=False)
+        local_split = self.mesh_plan is None and self._split_upload
+        if not local_split:
+            packed = slots * ring + panes % ring
+            packed[~valid] = -1
+            # dtype bound uses GLOBAL rows: in mesh mode slots are global
+            # (apply_shard routes by slot // spd), so the max packed value
+            # is n_devices × the local-block bound
+            n_blocks = self.mesh_plan.n_devices if self.mesh_plan else 1
+            dt = np.int32 if (n_blocks * self.layout.rows + 1) * ring < 2**31 else np.int64
+            packed = packed.astype(dt, copy=False)
         t3 = time.perf_counter()
         self.prof["pb_pack"] += t3 - t2
         if self.mesh_plan is None:
-            self.state = self._apply(
-                self.state, jnp.asarray(packed),
-                {k: jnp.asarray(v) for k, v in data.items()})
+            if local_split:
+                sc = split_encode(slots, (panes % ring).astype(np.uint8), valid)
+                self.state = self._apply_split(
+                    self.state, jnp.asarray(sc),
+                    {k: jnp.asarray(v) for k, v in data.items()})
+            else:
+                self.state = self._apply(
+                    self.state, jnp.asarray(packed),
+                    {k: jnp.asarray(v) for k, v in data.items()})
         else:
             n_dev = self.mesh_plan.n_devices
             ov_total = None
@@ -975,9 +1065,18 @@ class WindowOperator:
                         k: np.concatenate(
                             [v, np.zeros((pad,) + v.shape[1:], v.dtype)])
                         for k, v in dt_chunk.items()}
-                self.state, overflow = self._apply_sharded(
-                    self.state, jnp.asarray(pk),
-                    {k: jnp.asarray(v) for k, v in dt_chunk.items()})
+                if self._split_upload:
+                    pv = pk >= 0
+                    sc = split_encode(
+                        np.where(pv, pk // ring, 0),
+                        np.where(pv, pk % ring, 0).astype(np.uint8), pv)
+                    self.state, overflow = self._apply_sharded_split(
+                        self.state, jnp.asarray(sc),
+                        {k: jnp.asarray(v) for k, v in dt_chunk.items()})
+                else:
+                    self.state, overflow = self._apply_sharded(
+                        self.state, jnp.asarray(pk),
+                        {k: jnp.asarray(v) for k, v in dt_chunk.items()})
                 # LAZY overflow accounting: int(overflow) would block the
                 # pipeline on every step. One device-side sum per PUSH
                 # (not per chunk) so the marker deque stays 1:1 with

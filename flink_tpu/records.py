@@ -187,7 +187,16 @@ def hash_keys_device(keys: jax.Array) -> jax.Array:
 
 def hash_keys_numpy(keys: np.ndarray) -> np.ndarray:
     """Same mix on host — MUST stay bit-identical to hash_keys_device
-    (host routes at ingest; device routes at in-step keyBy)."""
+    (host routes at ingest; device routes at in-step keyBy). Large
+    batches take the C path when the codec library is built (parity
+    asserted in tests); the numpy mix below is the fallback and the
+    reference definition."""
+    if len(keys) >= 4096:
+        from flink_tpu.native_codec import hash_keys_native
+
+        out = hash_keys_native(np.ascontiguousarray(keys, np.int64))
+        if out is not None:
+            return out
     with np.errstate(over="ignore"):
         x = keys.astype(np.uint64)
         x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)

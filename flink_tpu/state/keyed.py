@@ -98,6 +98,9 @@ class _NumpyHashTable:
         self._used = np.zeros(size, dtype=bool)
         self._count = 0
 
+    def lookup_keys(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        return self.lookup(keys, hash_keys_numpy(keys))
+
     def lookup(self, keys: np.ndarray, key_hashes: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """(values, found) for a batch. Vectorized probe: each round
         resolves every query that hits its key or an empty bucket."""
@@ -189,7 +192,12 @@ class KeyDirectory:
         self.slots_per_shard = slots_per_shard
         # shard range owned by this directory (global view: (0, num_shards))
         self.shard_lo, self.shard_hi = shard_range or (0, num_shards)
-        self._table = _NumpyHashTable()
+        # C fast path when the codec library is available (same probe
+        # semantics, same splitmix64 hash — parity-tested); numpy
+        # otherwise. ~90ms → ~10ms per 2^20-record batch.
+        from flink_tpu.native_codec import NativeHashTable
+
+        self._table = NativeHashTable.create() or _NumpyHashTable()
         self._next_free = np.zeros(num_shards, dtype=np.int64)
         n_local = (self.shard_hi - self.shard_lo) * slots_per_shard
         self._rev_keys = np.zeros(n_local, dtype=np.int64)
@@ -210,16 +218,16 @@ class KeyDirectory:
         slots (spill-layer responsibility).
         """
         keys = np.asarray(keys, dtype=np.int64)
-        hashes = hash_keys_numpy(keys)
-        slots, found = self._table.lookup(keys, hashes)
+        slots, found = self._table.lookup_keys(keys)
         if not found.all():
             miss_ix = np.nonzero(~found)[0]
             # allocate + register each distinct new key once, vectorized
             # (key churn is per-batch steady state in rotating-key
-            # workloads like Nexmark; a Python loop here was 60ms/batch)
-            uniq, first, inv = np.unique(
-                keys[miss_ix], return_index=True, return_inverse=True)
-            uh = hashes[miss_ix][first]
+            # workloads like Nexmark; a Python loop here was 60ms/batch);
+            # only the DISTINCT misses are hashed on the Python side —
+            # the hit path's hashes live inside the table lookup
+            uniq, inv = np.unique(keys[miss_ix], return_inverse=True)
+            uh = hash_keys_numpy(uniq)
             alloc = self._alloc_slots(uniq, uh)
             self._table.insert_batch(uniq, uh, alloc)
             slots[miss_ix] = alloc[inv]

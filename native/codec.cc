@@ -15,6 +15,7 @@
 // encoded here and keys hashed in Python MUST route identically.
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 
 extern "C" {
@@ -135,6 +136,109 @@ int64_t encode_i64_rows(const int64_t* vals, int64_t n_rows, int64_t n_cols,
     }
   }
   return w;
+}
+
+// ---------------------------------------------------------------------------
+// int64 -> int64 open-addressing hash table: the key-directory probe loop
+// (ref role: CopyOnWriteStateMap.get/put — the per-record state-map probe —
+// batched and compiled; the numpy fallback in state/keyed.py costs ~90ms
+// per 2^20-record batch, this path ~10ms). The mix MUST stay bit-identical
+// to records.hash_keys_numpy / hash_keys_device: host ingest, device keyBy,
+// and this table all route by the same splitmix64 finalizer.
+
+static inline uint64_t ht_mix(uint64_t x) {
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  x = x ^ (x >> 31);
+  return x & 0x7FFFFFFFFFFFFFFFULL;
+}
+
+struct FtHashTable {
+  int64_t* keys;
+  int64_t* vals;
+  uint8_t* used;
+  uint64_t mask;   // size - 1
+  int64_t count;
+};
+
+static void ht_alloc(FtHashTable* t, uint64_t size) {
+  t->keys = (int64_t*)calloc(size, sizeof(int64_t));
+  t->vals = (int64_t*)calloc(size, sizeof(int64_t));
+  t->used = (uint8_t*)calloc(size, 1);
+  t->mask = size - 1;
+  t->count = 0;
+}
+
+static void ht_grow(FtHashTable* t) {
+  FtHashTable old = *t;
+  ht_alloc(t, (old.mask + 1) * 2);
+  for (uint64_t i = 0; i <= old.mask; ++i) {
+    if (!old.used[i]) continue;
+    uint64_t ix = ht_mix((uint64_t)old.keys[i]) & t->mask;
+    while (t->used[ix]) ix = (ix + 1) & t->mask;
+    t->keys[ix] = old.keys[i];
+    t->vals[ix] = old.vals[i];
+    t->used[ix] = 1;
+    ++t->count;
+  }
+  free(old.keys); free(old.vals); free(old.used);
+}
+
+void* ht_new(int64_t capacity_hint) {
+  uint64_t size = 16;
+  while ((int64_t)size < capacity_hint * 2) size *= 2;
+  FtHashTable* t = (FtHashTable*)malloc(sizeof(FtHashTable));
+  ht_alloc(t, size);
+  return t;
+}
+
+void ht_free(void* h) {
+  FtHashTable* t = (FtHashTable*)h;
+  free(t->keys); free(t->vals); free(t->used); free(t);
+}
+
+int64_t ht_count(void* h) { return ((FtHashTable*)h)->count; }
+
+// Batch lookup; hashes computed inline. out_vals[i] untouched-where-miss
+// semantics are NOT provided: misses write -1 and out_found[i]=0 (vals may
+// legitimately be negative sentinels, so found is a separate byte).
+void ht_lookup(void* h, const int64_t* keys, int64_t n,
+               int64_t* out_vals, uint8_t* out_found) {
+  FtHashTable* t = (FtHashTable*)h;
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t ix = ht_mix((uint64_t)keys[i]) & t->mask;
+    for (;;) {
+      if (!t->used[ix]) { out_vals[i] = -1; out_found[i] = 0; break; }
+      if (t->keys[ix] == keys[i]) {
+        out_vals[i] = t->vals[ix]; out_found[i] = 1; break;
+      }
+      ix = (ix + 1) & t->mask;
+    }
+  }
+}
+
+// Batch insert-or-update (keys need not be distinct; later wins).
+void ht_insert(void* h, const int64_t* keys, const int64_t* vals, int64_t n) {
+  FtHashTable* t = (FtHashTable*)h;
+  for (int64_t i = 0; i < n; ++i) {
+    if ((t->count + 1) * 2 > (int64_t)(t->mask + 1)) ht_grow(t);
+    uint64_t ix = ht_mix((uint64_t)keys[i]) & t->mask;
+    for (;;) {
+      if (!t->used[ix]) {
+        t->keys[ix] = keys[i]; t->vals[ix] = vals[i]; t->used[ix] = 1;
+        ++t->count;
+        break;
+      }
+      if (t->keys[ix] == keys[i]) { t->vals[ix] = vals[i]; break; }
+      ix = (ix + 1) & t->mask;
+    }
+  }
+}
+
+// splitmix64 finalizer over a batch (hash_keys_numpy fast path).
+void hash_keys(const int64_t* keys, int64_t n, int64_t* out) {
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = (int64_t)ht_mix((uint64_t)keys[i]);
 }
 
 }  // extern "C"

@@ -511,3 +511,51 @@ class TestLateLowPaneGrowth:
                 zip(fired["key"], fired["window_end"], fired["sum_v"])}
         assert rows == {(1, (hi_pane + 1) * 1000): 2.0,
                         (2, (lo_pane + 1) * 1000): 9.0}
+
+
+class TestSplitUpload:
+    """The 3-byte/record (uint16 slot + uint8 column) upload encoding
+    must be byte-identical to the packed-int32 path (apply_kernel vs
+    apply_kernel_split), and layouts too large for it must fall back."""
+
+    def _drive(self, op):
+        rng = np.random.default_rng(7)
+        out = []
+        for i in range(4):
+            n = 257
+            keys = rng.integers(0, 50, n)
+            ts = rng.integers(i * 2000, i * 2000 + 4000, n)
+            vals = rng.random(n).astype(np.float32)
+            op.process_batch(keys, ts, {"v": vals})
+            fired = op.advance_watermark(i * 2000)
+            for j in range(len(fired["key"])):
+                out.append(tuple(
+                    round(float(fired[f][j]), 4) if f.startswith("sum")
+                    else int(fired[f][j])
+                    for f in ("key", "window_start", "window_end", "sum_v")))
+        fired = op.advance_watermark(10_000_000)
+        for j in range(len(fired["key"])):
+            out.append(tuple(
+                round(float(fired[f][j]), 4) if f.startswith("sum")
+                else int(fired[f][j])
+                for f in ("key", "window_start", "window_end", "sum_v")))
+        return sorted(out)
+
+    def test_split_matches_packed(self):
+        mk = lambda: WindowOperator(
+            SlidingEventTimeWindows.of(3000, 1000), sum_of("v"),
+            num_shards=8, slots_per_shard=16)
+        op_split = mk()
+        assert op_split._split_upload
+        op_packed = mk()
+        op_packed._split_upload = False
+        assert self._drive(op_split) == self._drive(op_packed)
+
+    def test_oversized_layout_falls_back(self):
+        op = WindowOperator(
+            TumblingEventTimeWindows.of(1000), count(),
+            num_shards=16, slots_per_shard=8192)   # 131072 rows > u16
+        assert not op._split_upload
+        op.process_batch(np.array([1, 2]), np.array([100, 200]), {})
+        fired = op.advance_watermark(5000)
+        assert sorted(int(c) for c in fired["count"]) == [1, 1]
