@@ -76,17 +76,22 @@ class StreamExecutionEnvironment:
 
     # -- execution -------------------------------------------------------
     def execute(self, job_name: str = "job", cancel=None,
-                savepoint_request=None) -> "JobResult":
+                savepoint_request=None, transforms=None) -> "JobResult":
         """Lower and run to completion (bounded) or until cancelled
         (ref: execute → LocalExecutor → MiniCluster.submitJob). With
         ``cluster.mesh-devices`` set, keyed state is sharded over the
         device mesh and the driver runs the distributed step. ``cancel``
         is an optional threading.Event: setting it aborts the job at the
-        next batch boundary with JobCancelledError."""
+        next batch boundary with JobCancelledError. ``transforms``
+        restricts the run to a subset of the registered graph (the Table
+        API executes one query's lineage, not every pipeline ever built
+        on this environment)."""
         from flink_tpu.graph.compiler import compile_job
         from flink_tpu.runtime.driver import Driver
 
-        plan = compile_job(self._transforms, self.config, self._watermark_strategy)
+        plan = compile_job(
+            self._transforms if transforms is None else transforms,
+            self.config, self._watermark_strategy)
         driver = Driver(plan, self.config, mesh_plan=self.build_mesh_plan())
         return driver.run(job_name, cancel=cancel,
                           savepoint_request=savepoint_request)

@@ -1,0 +1,492 @@
+"""SQL frontend — tokenizer + recursive-descent parser + planner for a
+streaming SQL subset, lowering onto the Table API (and through it onto
+the device pane-state runtime).
+
+ref role: flink-sql-parser (Calcite dialect) + flink-table-planner
+(SURVEY §3.8). Deliberately NOT a Calcite port: the supported subset is
+chosen to cover the windowed streaming queries the runtime executes
+natively, and each query plans in one pass with no optimizer — the
+heavy lifting (window slicing, pane state, top-n) already lives in the
+compiled device kernels, so the planner's only job is a faithful
+lowering. Unsupported constructs raise ``SqlError`` with the offending
+token position rather than silently degrading.
+
+Supported grammar (case-insensitive keywords):
+
+    SELECT sel [, sel ...]
+    FROM source
+    [WHERE expr]
+    [GROUP BY ident [, ident ...]]
+    [ORDER BY ident [DESC] LIMIT n | LIMIT n]
+
+    sel    := expr [AS ident] | agg(arg) [AS ident] | *
+    agg    := COUNT(*|col) | SUM(col) | MAX(col) | MIN(col) | AVG(col)
+    source := ident
+            | TABLE(TUMBLE(TABLE ident, DESCRIPTOR(col), interval))
+            | TABLE(HOP(TABLE ident, DESCRIPTOR(col), interval, interval))
+            | TABLE(SESSION(TABLE ident, DESCRIPTOR(col), interval))
+    interval := INTERVAL 'n' {MILLISECOND|SECOND|MINUTE|HOUR|DAY}[S]
+    expr   := OR-expr over AND / NOT / comparisons / + - * / % / ( )
+              with idents, numbers, 'strings'
+
+Window TVFs follow FLIP-145 (the windowing table-valued functions of
+Flink SQL): HOP's interval order is (slide, size), and the TVF adds
+``window_start``/``window_end`` columns which GROUP BY then uses.
+ORDER BY <agg-alias> DESC LIMIT n on a windowed aggregation lowers to
+the fused device top-n (per-window RANK() <= n, Q5's hot-items shape).
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, List, Optional, Tuple
+
+from flink_tpu.table import api as tapi
+from flink_tpu.table.expressions import BinOp, Col, Expression, Lit, UnaryOp
+
+__all__ = ["SqlError", "plan_sql", "parse"]
+
+
+class SqlError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Tokenizer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"\s*(?:"
+    r"(?P<num>\d+(?:\.\d+)?)"
+    r"|(?P<str>'(?:[^']|'')*')"
+    r"|(?P<ident>[A-Za-z_][A-Za-z_0-9]*)"
+    r"|(?P<op><>|<=|>=|!=|=|<|>|\(|\)|,|\*|\+|-|/|%|\.)"
+    r")")
+
+
+@dataclasses.dataclass
+class Tok:
+    kind: str  # num/str/ident/op/kw
+    text: str
+    pos: int
+
+
+_KEYWORDS = {
+    "select", "from", "where", "group", "by", "order", "limit", "desc",
+    "asc", "as", "and", "or", "not", "table", "tumble", "hop", "session",
+    "descriptor", "interval", "having",
+}
+
+
+def _tokenize(sql: str) -> List[Tok]:
+    out: List[Tok] = []
+    i = 0
+    while i < len(sql):
+        m = _TOKEN_RE.match(sql, i)
+        if not m or m.end() == i:
+            if sql[i:].strip():
+                raise SqlError(f"cannot tokenize at position {i}: "
+                               f"{sql[i:i+20]!r}")
+            break
+        i = m.end()
+        if m.lastgroup == "ident":
+            text = m.group("ident")
+            kind = "kw" if text.lower() in _KEYWORDS else "ident"
+            out.append(Tok(kind, text.lower() if kind == "kw" else text,
+                           m.start()))
+        elif m.lastgroup == "num":
+            out.append(Tok("num", m.group("num"), m.start()))
+        elif m.lastgroup == "str":
+            out.append(Tok("str", m.group("str")[1:-1].replace("''", "'"),
+                           m.start()))
+        else:
+            out.append(Tok("op", m.group("op"), m.start()))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class SelectItem:
+    expr: Optional[Expression]        # scalar expression, or None if agg
+    agg: Optional[Tuple[str, Optional[str]]]  # (fn, col) for aggregates
+    alias: Optional[str]
+    star: bool = False
+
+
+@dataclasses.dataclass
+class WindowTvf:
+    kind: str          # tumble/hop/session
+    table: str
+    time_col: str
+    intervals: List[int]  # ms
+
+
+@dataclasses.dataclass
+class Query:
+    items: List[SelectItem]
+    source: Any                 # str table name | WindowTvf
+    where: Optional[Expression]
+    group_by: List[str]
+    order_by: Optional[Tuple[str, bool]]  # (col, desc)
+    limit: Optional[int]
+
+
+class _Parser:
+    def __init__(self, toks: List[Tok]) -> None:
+        self.toks = toks
+        self.i = 0
+
+    # -- plumbing -------------------------------------------------------
+    def peek(self) -> Optional[Tok]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> Tok:
+        t = self.peek()
+        if t is None:
+            raise SqlError("unexpected end of query")
+        self.i += 1
+        return t
+
+    def accept(self, kind: str, text: Optional[str] = None) -> Optional[Tok]:
+        t = self.peek()
+        if t and t.kind == kind and (text is None or t.text == text):
+            self.i += 1
+            return t
+        return None
+
+    def expect(self, kind: str, text: Optional[str] = None) -> Tok:
+        t = self.accept(kind, text)
+        if t is None:
+            got = self.peek()
+            raise SqlError(
+                f"expected {text or kind}, got "
+                f"{(got.text if got else 'end of query')!r}"
+                + (f" at position {got.pos}" if got else ""))
+        return t
+
+    # -- grammar --------------------------------------------------------
+    def query(self) -> Query:
+        self.expect("kw", "select")
+        items = [self.select_item()]
+        while self.accept("op", ","):
+            items.append(self.select_item())
+        self.expect("kw", "from")
+        source = self.source()
+        where = None
+        if self.accept("kw", "where"):
+            where = self.expr()
+        group_by: List[str] = []
+        if self.accept("kw", "group"):
+            self.expect("kw", "by")
+            group_by.append(self.expect("ident").text)
+            while self.accept("op", ","):
+                group_by.append(self.expect("ident").text)
+        if self.accept("kw", "having"):
+            raise SqlError("HAVING is not supported in v1")
+        order_by = None
+        if self.accept("kw", "order"):
+            self.expect("kw", "by")
+            c = self.expect("ident").text
+            desc = bool(self.accept("kw", "desc"))
+            if not desc:
+                self.accept("kw", "asc")
+            order_by = (c, desc)
+        limit = None
+        if self.accept("kw", "limit"):
+            ltok = self.expect("num")
+            if "." in ltok.text:
+                raise SqlError(f"LIMIT must be an integer, got {ltok.text}")
+            limit = int(ltok.text)
+        t = self.peek()
+        if t is not None:
+            raise SqlError(f"unexpected trailing input at position "
+                           f"{t.pos}: {t.text!r}")
+        return Query(items, source, where, group_by, order_by, limit)
+
+    def select_item(self) -> SelectItem:
+        if self.accept("op", "*"):
+            return SelectItem(None, None, None, star=True)
+        t = self.peek()
+        if (t and t.kind == "ident"
+                and t.text.lower() in tapi._AGG_FACTORIES
+                and self.i + 1 < len(self.toks)
+                and self.toks[self.i + 1].text == "("):
+            fn = self.next().text.lower()
+            self.expect("op", "(")
+            if self.accept("op", "*"):
+                arg = None
+                if fn != "count":
+                    raise SqlError(f"{fn}(*) is not valid; only COUNT(*)")
+            else:
+                arg = self.expect("ident").text
+            self.expect("op", ")")
+            alias = self.alias()
+            return SelectItem(None, (fn, arg), alias)
+        e = self.expr()
+        return SelectItem(e, None, self.alias())
+
+    def alias(self) -> Optional[str]:
+        if self.accept("kw", "as"):
+            return self.expect("ident").text
+        t = self.peek()
+        if t and t.kind == "ident":
+            return self.next().text
+        return None
+
+    def source(self):
+        if self.accept("kw", "table"):
+            self.expect("op", "(")
+            kind_tok = self.next()
+            kind = kind_tok.text
+            if kind not in ("tumble", "hop", "session"):
+                raise SqlError(
+                    f"unsupported table function {kind!r} (TUMBLE/HOP/"
+                    "SESSION)")
+            self.expect("op", "(")
+            self.expect("kw", "table")
+            name = self.expect("ident").text
+            self.expect("op", ",")
+            self.expect("kw", "descriptor")
+            self.expect("op", "(")
+            time_col = self.expect("ident").text
+            self.expect("op", ")")
+            intervals = []
+            while self.accept("op", ","):
+                intervals.append(self.interval_ms())
+            self.expect("op", ")")
+            self.expect("op", ")")
+            need = {"tumble": 1, "hop": 2, "session": 1}[kind]
+            if len(intervals) != need:
+                raise SqlError(
+                    f"{kind.upper()} takes {need} interval(s), got "
+                    f"{len(intervals)}")
+            return WindowTvf(kind, name, time_col, intervals)
+        return self.expect("ident").text
+
+    _UNIT_MS = {
+        "millisecond": 1, "second": 1000, "minute": 60_000,
+        "hour": 3_600_000, "day": 86_400_000,
+    }
+
+    def interval_ms(self) -> int:
+        self.expect("kw", "interval")
+        val = self.expect("str").text
+        unit_tok = self.expect("ident")
+        unit = unit_tok.text.lower().rstrip("s")
+        if unit not in self._UNIT_MS:
+            raise SqlError(f"unknown interval unit {unit_tok.text!r}")
+        try:
+            n = float(val)
+        except ValueError:
+            raise SqlError(f"bad interval value {val!r}") from None
+        return int(n * self._UNIT_MS[unit])
+
+    # -- expressions (precedence climbing) ------------------------------
+    def expr(self) -> Expression:
+        return self.or_expr()
+
+    def or_expr(self) -> Expression:
+        e = self.and_expr()
+        while self.accept("kw", "or"):
+            e = BinOp("or", e, self.and_expr())
+        return e
+
+    def and_expr(self) -> Expression:
+        e = self.not_expr()
+        while self.accept("kw", "and"):
+            e = BinOp("and", e, self.not_expr())
+        return e
+
+    def not_expr(self) -> Expression:
+        if self.accept("kw", "not"):
+            return UnaryOp("not", self.not_expr())
+        return self.comparison()
+
+    _CMP = {"=": "==", "<>": "!=", "!=": "!=", "<": "<", "<=": "<=",
+            ">": ">", ">=": ">="}
+
+    def comparison(self) -> Expression:
+        e = self.additive()
+        t = self.peek()
+        if t and t.kind == "op" and t.text in self._CMP:
+            op = self._CMP[self.next().text]
+            return BinOp(op, e, self.additive())
+        return e
+
+    def additive(self) -> Expression:
+        e = self.multiplicative()
+        while True:
+            t = self.peek()
+            if t and t.kind == "op" and t.text in ("+", "-"):
+                e = BinOp(self.next().text, e, self.multiplicative())
+            else:
+                return e
+
+    def multiplicative(self) -> Expression:
+        e = self.unary()
+        while True:
+            t = self.peek()
+            if t and t.kind == "op" and t.text in ("*", "/", "%"):
+                e = BinOp(self.next().text, e, self.unary())
+            else:
+                return e
+
+    def unary(self) -> Expression:
+        if self.accept("op", "-"):
+            return UnaryOp("neg", self.unary())
+        return self.primary()
+
+    def primary(self) -> Expression:
+        t = self.next()
+        if t.kind == "num":
+            return Lit(float(t.text) if "." in t.text else int(t.text))
+        if t.kind == "str":
+            return Lit(t.text)
+        if t.kind == "ident":
+            return Col(t.text)
+        if t.kind == "op" and t.text == "(":
+            e = self.expr()
+            self.expect("op", ")")
+            return e
+        raise SqlError(f"unexpected token {t.text!r} at position {t.pos}")
+
+
+def parse(sql: str) -> Query:
+    return _Parser(_tokenize(sql)).query()
+
+
+# ---------------------------------------------------------------------------
+# Planner: Query AST -> Table pipeline
+# ---------------------------------------------------------------------------
+
+def plan_sql(t_env: "tapi.TableEnvironment", sql: str) -> "tapi.Table":
+    q = parse(sql)
+
+    # resolve source
+    if isinstance(q.source, WindowTvf):
+        base = t_env.table(q.source.table)
+        iv = q.source.intervals
+        if q.source.kind == "tumble":
+            wdef = tapi.Tumble.over_ms(iv[0])
+        elif q.source.kind == "hop":
+            # FLIP-145 HOP argument order: (slide, size)
+            wdef = tapi.Hop.of_ms(size_ms=iv[1], slide_ms=iv[0])
+        else:
+            wdef = tapi.Session.with_gap_ms(iv[0])
+        wdef = wdef.on(q.source.time_col)
+    else:
+        base = t_env.table(q.source)
+        wdef = None
+
+    table = base
+    if q.where is not None:
+        table = table.filter(q.where)
+
+    aggs = [it for it in q.items if it.agg is not None]
+    if aggs:
+        return _plan_aggregate(q, table, wdef)
+
+    # pure projection query
+    if wdef is not None:
+        raise SqlError(
+            "a window TVF source needs aggregate functions in SELECT "
+            "(per-row window column attachment is not in v1)")
+    if q.group_by:
+        raise SqlError(
+            "GROUP BY without aggregate functions in SELECT")
+    if q.order_by or q.limit:
+        raise SqlError(
+            "ORDER BY/LIMIT is only supported over a windowed "
+            "aggregation (per-window top-n)")
+    if any(it.star for it in q.items):
+        if len(q.items) != 1:
+            raise SqlError("SELECT * cannot mix with other columns")
+        return table
+    sels = []
+    for it in q.items:
+        e = it.expr
+        name = it.alias or (e.name if isinstance(e, Col) else None)
+        if name is None:
+            raise SqlError(f"computed column needs AS alias: {e!r}")
+        sels.append(e.alias(name))
+    return table.select(*sels)
+
+
+def _plan_aggregate(q: Query, table: "tapi.Table",
+                    wdef) -> "tapi.Table":
+    if wdef is None:
+        raise SqlError(
+            "aggregate queries need a window TVF source — "
+            "FROM TABLE(TUMBLE/HOP/SESSION(TABLE t, DESCRIPTOR(ts), "
+            "...)) (non-windowed streaming GROUP BY needs retraction "
+            "semantics, not in v1)")
+    group_cols = [g for g in q.group_by
+                  if g not in ("window_start", "window_end")]
+    if len(group_cols) > 1:
+        raise SqlError(
+            f"v1 supports one non-window grouping column; got "
+            f"{group_cols}")
+
+    # build agg calls with output names
+    calls: List[tapi.AggCall] = []
+    plain: List[str] = []
+    for it in q.items:
+        if it.star:
+            raise SqlError("SELECT * cannot mix with aggregates")
+        if it.agg is not None:
+            fn, arg = it.agg
+            default = fn if fn == "count" else f"{fn}_{arg}"
+            calls.append(tapi.AggCall(fn, arg, it.alias or default))
+        else:
+            e = it.expr
+            if not isinstance(e, Col):
+                raise SqlError(
+                    "non-aggregate SELECT items in a grouped query must "
+                    f"be plain grouping columns, got {e!r}")
+            plain.append(it.alias or e.name)
+            if it.alias and it.alias != e.name:
+                raise SqlError(
+                    "aliasing grouping columns is not supported in v1")
+    allowed = set(group_cols) | {"window_start", "window_end"}
+    for p in plain:
+        if p not in allowed:
+            raise SqlError(
+                f"column {p!r} in SELECT is neither grouped nor "
+                "aggregated")
+
+    gt = (table.window(wdef).group_by(*q.group_by)
+          if q.group_by else table.window(wdef).group_by())
+    want = plain + [c.out_name for c in calls]
+
+    # ORDER BY <agg output> DESC LIMIT n -> fused device per-window top-n
+    if q.order_by is not None or q.limit is not None:
+        if q.order_by is None or q.limit is None:
+            raise SqlError("ORDER BY and LIMIT must appear together")
+        by_col, desc = q.order_by
+        if not desc:
+            raise SqlError(
+                "only ORDER BY <agg> DESC LIMIT n (per-window top-n) "
+                "is supported")
+        by_call = next((c for c in calls if c.out_name == by_col), None)
+        if by_call is None:
+            raise SqlError(
+                f"ORDER BY column {by_col!r} must be one of the "
+                f"aggregates {[c.out_name for c in calls]}")
+        if not group_cols:
+            raise SqlError(
+                "ORDER BY ... DESC LIMIT n ranks keys within each "
+                "window and needs a grouping column; a global windowed "
+                "aggregate has one row per window already")
+        agg_stream, pairs, key_out = gt._aggregate_stream(*calls)
+        topped = agg_stream.top(q.limit, by=by_call.runtime_field)
+        return tapi.finish_projection(
+            table.t_env, topped, pairs, key_out, want)
+
+    result = gt.aggregate(*calls)
+    # drop columns not selected (grouping col might be omitted)
+    if set(want) != set(result.schema.columns):
+        result = result.select(*want)
+    return result

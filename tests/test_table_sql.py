@@ -1,0 +1,328 @@
+"""Table/SQL frontend tests: parsing, planning, and golden parity with
+the DataStream API (the two frontends must lower onto the same runtime
+and produce identical results). ref: flink-table-planner's
+plan/runtime tests + Nexmark Q5 SQL shape (SURVEY §3.8)."""
+import numpy as np
+import pytest
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.api.sinks import CollectSink
+from flink_tpu.api.windowing import SlidingEventTimeWindows, TumblingEventTimeWindows
+from flink_tpu.config import Configuration
+from flink_tpu.ops import aggregates
+from flink_tpu.table import (
+    AggCall, Hop, SqlError, TableEnvironment, Tumble, col,
+)
+from flink_tpu.table.sql import parse
+
+
+def _bids(env, n=4000, keys=30, seed=0):
+    rng = np.random.default_rng(seed)
+    ts = np.sort(rng.integers(0, 20_000, n)).astype(np.int64)
+    data = {
+        "auction": rng.integers(0, keys, n).astype(np.int64),
+        "price": rng.integers(1, 500, n).astype(np.float32),
+        "ts": ts,
+    }
+    return env.from_collection(data, ts, batch_size=1000), data
+
+
+def _fresh():
+    env = StreamExecutionEnvironment(Configuration({
+        "state.num-key-shards": 8, "state.slots-per-shard": 32}))
+    return env, TableEnvironment.create(env)
+
+
+def _rowset(rows, fields):
+    return sorted(
+        tuple(round(float(r[f]), 4) for f in fields) for r in rows)
+
+
+class TestParser:
+    def test_basic_shapes(self):
+        q = parse("SELECT a, COUNT(*) AS c FROM t GROUP BY a")
+        assert q.group_by == ["a"]
+        assert q.items[1].agg == ("count", None)
+        assert q.items[1].alias == "c"
+
+    def test_hop_tvf(self):
+        q = parse(
+            "SELECT COUNT(*) FROM TABLE(HOP(TABLE bids, DESCRIPTOR(ts),"
+            " INTERVAL '1' SECOND, INTERVAL '10' SECOND))")
+        assert q.source.kind == "hop"
+        assert q.source.intervals == [1000, 10_000]
+
+    def test_where_expr_precedence(self):
+        q = parse("SELECT a FROM t WHERE a + 1 * 2 > 3 AND b = 'x'")
+        got = q.where.eval({"a": np.array([0, 2]), "b": np.array(["x", "y"])})
+        assert got.tolist() == [False, False]  # 0+2>3 F; b='y' F
+        got = q.where.eval({"a": np.array([2, 9]), "b": np.array(["x", "y"])})
+        assert got.tolist() == [True, False]   # 2+2>3 T & 'x'; b='y' F
+
+    def test_errors(self):
+        with pytest.raises(SqlError):
+            parse("SELECT FROM t")
+        with pytest.raises(SqlError):
+            parse("SELECT a FROM t HAVING a > 1")
+        with pytest.raises(SqlError):
+            parse("SELECT a FROM t; DROP TABLE t")
+        with pytest.raises(SqlError):
+            parse("SELECT SUM(*) FROM t")
+
+
+class TestSqlVsDataStream:
+    def test_q5_sql_matches_datastream(self):
+        # SQL side
+        env, t_env = _fresh()
+        stream, data = _bids(env)
+        t_env.create_temporary_view(
+            "bids", stream, schema=["auction", "price", "ts"],
+            time_attr="ts")
+        res = t_env.sql_query(
+            "SELECT auction, window_end, COUNT(*) AS bid_count "
+            "FROM TABLE(HOP(TABLE bids, DESCRIPTOR(ts), "
+            "INTERVAL '1' SECOND, INTERVAL '4' SECOND)) "
+            "GROUP BY auction, window_start, window_end").execute()
+
+        # DataStream side, same data
+        env2 = StreamExecutionEnvironment(Configuration({
+            "state.num-key-shards": 8, "state.slots-per-shard": 32}))
+        stream2, _ = _bids(env2)
+        sink = CollectSink()
+        (stream2.key_by("auction")
+         .window(SlidingEventTimeWindows.of(4000, 1000))
+         .count().add_sink(sink))
+        env2.execute("ds")
+
+        fields_sql = ("auction", "window_end", "bid_count")
+        fields_ds = ("key", "window_end", "count")
+        assert _rowset(res.rows, fields_sql) == _rowset(sink.rows, fields_ds)
+        assert len(res.rows) > 0
+
+    def test_sql_topn_matches_datastream_top(self):
+        env, t_env = _fresh()
+        stream, _ = _bids(env, seed=3)
+        t_env.create_temporary_view(
+            "bids", stream, schema=["auction", "price", "ts"],
+            time_attr="ts")
+        res = t_env.sql_query(
+            "SELECT auction, window_end, COUNT(*) AS c "
+            "FROM TABLE(HOP(TABLE bids, DESCRIPTOR(ts), "
+            "INTERVAL '1' SECOND, INTERVAL '4' SECOND)) "
+            "GROUP BY auction, window_start, window_end "
+            "ORDER BY c DESC LIMIT 2").execute()
+
+        env2 = StreamExecutionEnvironment(Configuration({
+            "state.num-key-shards": 8, "state.slots-per-shard": 32}))
+        stream2, _ = _bids(env2, seed=3)
+        sink = CollectSink()
+        (stream2.key_by("auction")
+         .window(SlidingEventTimeWindows.of(4000, 1000))
+         .count().top(2, by="count").add_sink(sink))
+        env2.execute("ds-top")
+
+        assert (_rowset(res.rows, ("auction", "window_end", "c"))
+                == _rowset(sink.rows, ("key", "window_end", "count")))
+        assert len(res.rows) > 0
+
+    def test_where_and_sum_tumble(self):
+        env, t_env = _fresh()
+        stream, data = _bids(env, seed=5)
+        t_env.create_temporary_view(
+            "bids", stream, schema=["auction", "price", "ts"],
+            time_attr="ts")
+        res = t_env.sql_query(
+            "SELECT auction, window_end, SUM(price) AS total, "
+            "MAX(price) AS hi "
+            "FROM TABLE(TUMBLE(TABLE bids, DESCRIPTOR(ts), "
+            "INTERVAL '2' SECOND)) "
+            "WHERE price > 250 "
+            "GROUP BY auction, window_start, window_end").execute()
+
+        # numpy golden
+        m = data["price"] > 250
+        golden = {}
+        for a, p, t in zip(data["auction"][m], data["price"][m],
+                           data["ts"][m]):
+            we = (int(t) // 2000 + 1) * 2000
+            key = (int(a), we)
+            s, h = golden.get(key, (0.0, -np.inf))
+            golden[key] = (s + float(p), max(h, float(p)))
+        got = sorted((int(r["auction"]), int(r["window_end"]),
+                      round(float(r["total"]), 2), float(r["hi"]))
+                     for r in res.rows)
+        want = sorted((a, we, round(s, 2), h)
+                      for (a, we), (s, h) in golden.items())
+        assert got == want
+
+
+class TestTableApi:
+    def test_fluent_matches_sql(self):
+        env, t_env = _fresh()
+        stream, _ = _bids(env, seed=7)
+        t = t_env.from_data_stream(
+            stream, schema=["auction", "price", "ts"], time_attr="ts")
+        res = (t.filter(col("price") > 100)
+               .window(Hop.of_ms(4000, 1000))
+               .group_by("auction")
+               .aggregate(AggCall("count", None, "c"))
+               .execute())
+
+        env2, t_env2 = _fresh()
+        stream2, _ = _bids(env2, seed=7)
+        t_env2.create_temporary_view(
+            "bids", stream2, schema=["auction", "price", "ts"],
+            time_attr="ts")
+        res2 = t_env2.sql_query(
+            "SELECT auction, window_end, COUNT(*) AS c "
+            "FROM TABLE(HOP(TABLE bids, DESCRIPTOR(ts), "
+            "INTERVAL '1' SECOND, INTERVAL '4' SECOND)) "
+            "WHERE price > 100 "
+            "GROUP BY auction, window_start, window_end").execute()
+        f = ("auction", "window_end", "c")
+        assert _rowset(res.rows, f) == _rowset(res2.rows, f)
+        assert res.rows
+
+    def test_projection_only_query(self):
+        env, t_env = _fresh()
+        stream, data = _bids(env, n=500, seed=9)
+        t_env.create_temporary_view(
+            "bids", stream, schema=["auction", "price", "ts"],
+            time_attr="ts")
+        res = t_env.sql_query(
+            "SELECT auction, price * 2 AS dbl FROM bids "
+            "WHERE auction < 5").execute()
+        m = data["auction"] < 5
+        assert len(res.rows) == int(m.sum())
+        got = sorted(round(float(r["dbl"]), 2) for r in res.rows)
+        want = sorted(np.round(data["price"][m] * 2, 2).tolist())
+        assert got == want
+
+    def test_global_windowed_aggregate(self):
+        env, t_env = _fresh()
+        stream, data = _bids(env, n=1000, seed=11)
+        t_env.create_temporary_view(
+            "bids", stream, schema=["auction", "price", "ts"],
+            time_attr="ts")
+        res = t_env.sql_query(
+            "SELECT window_end, MAX(price) AS hi "
+            "FROM TABLE(TUMBLE(TABLE bids, DESCRIPTOR(ts), "
+            "INTERVAL '5' SECOND)) "
+            "GROUP BY window_start, window_end").execute()
+        golden = {}
+        for p, t in zip(data["price"], data["ts"]):
+            we = (int(t) // 5000 + 1) * 5000
+            golden[we] = max(golden.get(we, -np.inf), float(p))
+        got = sorted((int(r["window_end"]), float(r["hi"]))
+                     for r in res.rows)
+        assert got == sorted(golden.items())
+
+    def test_plan_errors(self):
+        env, t_env = _fresh()
+        stream, _ = _bids(env, n=100)
+        t_env.create_temporary_view(
+            "bids", stream, schema=["auction", "price", "ts"],
+            time_attr="ts")
+        with pytest.raises(SqlError, match="window"):
+            t_env.sql_query(
+                "SELECT auction, COUNT(*) FROM bids GROUP BY auction")
+        with pytest.raises(SqlError, match="one non-window"):
+            t_env.sql_query(
+                "SELECT COUNT(*) FROM TABLE(TUMBLE(TABLE bids, "
+                "DESCRIPTOR(ts), INTERVAL '1' SECOND)) "
+                "GROUP BY auction, price")
+        with pytest.raises(KeyError, match="nope"):
+            t_env.sql_query("SELECT a FROM nope")
+        with pytest.raises(SqlError, match="DESC"):
+            t_env.sql_query(
+                "SELECT auction, COUNT(*) AS c FROM TABLE(TUMBLE(TABLE "
+                "bids, DESCRIPTOR(ts), INTERVAL '1' SECOND)) "
+                "GROUP BY auction, window_end ORDER BY c LIMIT 2")
+
+
+class TestReviewRegressions:
+    """Regression cases from the round-3 review of this module."""
+
+    def test_second_query_does_not_refire_first_sink(self):
+        env, t_env = _fresh()
+        stream, data = _bids(env, n=500, seed=13)
+        t_env.create_temporary_view(
+            "bids", stream, schema=["auction", "price", "ts"],
+            time_attr="ts")
+        r1 = t_env.sql_query(
+            "SELECT auction FROM bids WHERE price > 400").execute()
+        n1 = len(r1.rows)
+        assert n1 == int((data["price"] > 400).sum())
+        t_env.sql_query("SELECT auction FROM bids WHERE price > 100").execute()
+        assert len(r1.rows) == n1  # first result must not grow
+
+    def test_duplicate_aggregates_fan_out(self):
+        env, t_env = _fresh()
+        stream, data = _bids(env, n=800, seed=15)
+        t_env.create_temporary_view(
+            "bids", stream, schema=["auction", "price", "ts"],
+            time_attr="ts")
+        res = t_env.sql_query(
+            "SELECT auction, SUM(price) AS a, SUM(price) AS b, "
+            "COUNT(*) AS c "
+            "FROM TABLE(TUMBLE(TABLE bids, DESCRIPTOR(ts), "
+            "INTERVAL '5' SECOND)) "
+            "GROUP BY auction, window_start, window_end").execute()
+        assert res.rows
+        for r in res.rows:
+            assert r["a"] == r["b"]
+
+    def test_select_literal_column(self):
+        env, t_env = _fresh()
+        stream, _ = _bids(env, n=200, seed=17)
+        t_env.create_temporary_view(
+            "bids", stream, schema=["auction", "price", "ts"],
+            time_attr="ts")
+        res = t_env.sql_query("SELECT auction, 1 AS one FROM bids").execute()
+        assert len(res.rows) == 200
+        assert all(int(r["one"]) == 1 for r in res.rows)
+
+    def test_window_tvf_without_aggregates_rejected(self):
+        env, t_env = _fresh()
+        stream, _ = _bids(env, n=100)
+        t_env.create_temporary_view(
+            "bids", stream, schema=["auction", "price", "ts"],
+            time_attr="ts")
+        with pytest.raises(SqlError, match="aggregate"):
+            t_env.sql_query(
+                "SELECT * FROM TABLE(TUMBLE(TABLE bids, DESCRIPTOR(ts), "
+                "INTERVAL '2' SECOND))")
+
+    def test_global_topn_rejected(self):
+        env, t_env = _fresh()
+        stream, _ = _bids(env, n=100)
+        t_env.create_temporary_view(
+            "bids", stream, schema=["auction", "price", "ts"],
+            time_attr="ts")
+        with pytest.raises(SqlError, match="grouping column"):
+            t_env.sql_query(
+                "SELECT window_end, COUNT(*) AS c FROM TABLE(TUMBLE("
+                "TABLE bids, DESCRIPTOR(ts), INTERVAL '2' SECOND)) "
+                "GROUP BY window_start, window_end "
+                "ORDER BY c DESC LIMIT 1")
+
+    def test_fractional_limit_rejected(self):
+        with pytest.raises(SqlError, match="integer"):
+            parse("SELECT auction, COUNT(*) AS c FROM TABLE(TUMBLE("
+                  "TABLE bids, DESCRIPTOR(ts), INTERVAL '1' SECOND)) "
+                  "GROUP BY auction ORDER BY c DESC LIMIT 2.5")
+
+    def test_topn_output_pruned_to_select_list(self):
+        env, t_env = _fresh()
+        stream, _ = _bids(env, seed=19)
+        t_env.create_temporary_view(
+            "bids", stream, schema=["auction", "price", "ts"],
+            time_attr="ts")
+        res = t_env.sql_query(
+            "SELECT auction, window_end, COUNT(*) AS c "
+            "FROM TABLE(HOP(TABLE bids, DESCRIPTOR(ts), "
+            "INTERVAL '1' SECOND, INTERVAL '4' SECOND)) "
+            "GROUP BY auction, window_start, window_end "
+            "ORDER BY c DESC LIMIT 1").execute()
+        assert res.rows
+        assert set(res.rows[0]) == {"auction", "window_end", "c"}
