@@ -22,6 +22,7 @@ from typing import Any, Dict, List, Optional
 from flink_tpu.config import ClusterOptions, Configuration
 from flink_tpu.runtime.restart import RestartStrategy, from_config
 from flink_tpu.runtime.rpc import RpcEndpoint, RpcServer
+from flink_tpu.runtime.scheduler import ExecutionGraph, SlotPool
 
 
 @dataclasses.dataclass
@@ -48,6 +49,10 @@ class JobInfo:
     config: Dict[str, Any] = dataclasses.field(default_factory=dict)
     # newest completed savepoint path (reported by the runner)
     last_savepoint: Optional[str] = None
+    # device-slot demand (cluster.mesh-devices; "all" resolves at pick)
+    required_devices: int = 1
+    # physical graph: stages × parallelism, per-attempt execution states
+    egraph: Optional[ExecutionGraph] = None
 
 
 class JobCoordinator(RpcEndpoint):
@@ -60,6 +65,7 @@ class JobCoordinator(RpcEndpoint):
         self.config = config or Configuration()
         self.runners: Dict[str, RunnerInfo] = {}
         self.jobs: Dict[str, JobInfo] = {}
+        self._slots = SlotPool()
         self._strategies: Dict[str, RestartStrategy] = {}
         self._hb_timeout = self.config.get(ClusterOptions.HEARTBEAT_TIMEOUT) / 1000
         self._lock = threading.Lock()  # monitor thread + rpc thread
@@ -70,11 +76,23 @@ class JobCoordinator(RpcEndpoint):
     # -- rpc methods -----------------------------------------------------
     def rpc_register_runner(self, runner_id: str, host: str, n_devices: int,
                             port: int = 0) -> dict:
+        waiting: List[str] = []
         with self._lock:
             self.runners[runner_id] = RunnerInfo(
                 runner_id, host, n_devices, time.time(), port=port)
+            # new capacity: kick jobs parked on WAITING_FOR_RESOURCES
+            # (ref: AdaptiveScheduler WaitingForResources → Executing on
+            # new slots)
+            waiting = self._waiting_locked()
+        for job_id in waiting:
+            self._deploy_async(job_id)
         return {"heartbeat_interval_ms":
                 self.config.get(ClusterOptions.HEARTBEAT_INTERVAL)}
+
+    def _waiting_locked(self) -> List[str]:
+        return [j.job_id for j in self.jobs.values()
+                if j.state == "WAITING_FOR_RESOURCES"
+                and j.entry is not None]
 
     def rpc_heartbeat(self, runner_id: str, metrics: Optional[dict] = None,
                       jobs: Optional[List[str]] = None) -> dict:
@@ -109,12 +127,19 @@ class JobCoordinator(RpcEndpoint):
         descriptor) the plan is PUSHED to a chosen runner's gateway —
         the Dispatcher.submitJob → JobMaster → TaskExecutor.submitTask
         flow; without one it is bookkeeping-only (legacy tests)."""
+        conf = dict(config or {})
+        spec = str(conf.get("cluster.mesh-devices", "") or "").strip()
+        if spec == "all":
+            required = SlotPool.ALL  # whole-runner: resolved at pick
+        else:
+            required = max(1, int(spec)) if spec.isdigit() else 1
         with self._lock:
             alive = [r.runner_id for r in self.runners.values() if r.alive]
             chosen = runners or alive
             job = JobInfo(job_id, state="RUNNING", attempts=1,
                           assigned_runners=chosen, entry=entry,
-                          config=dict(config or {}))
+                          config=conf, required_devices=required,
+                          egraph=ExecutionGraph(job_id, required))
             self.jobs[job_id] = job
             self._strategies[job_id] = from_config(self.config)
         if entry is not None:
@@ -137,19 +162,40 @@ class JobCoordinator(RpcEndpoint):
         with self._lock:
             j = self.jobs.get(job_id)
             if j is None or j.entry is None or j.state not in (
-                    "RUNNING", "RESTARTING"):
+                    "RUNNING", "RESTARTING", "WAITING_FOR_RESOURCES"):
                 return
-            candidates = [r for r in self.runners.values()
-                          if r.alive and r.port]
-            preferred = ([r for r in candidates if r.runner_id not in exclude]
-                         or candidates)
-            if not preferred:
-                j.state = "FAILED"
-                j.failure = "no alive runner to deploy to"
+            # racing capacity kicks (register + finish can each wake the
+            # same WAITING job): a job that is RUNNING with a live
+            # allocation is already deployed — the second kick must not
+            # re-deploy it onto another runner
+            if (j.state == "RUNNING"
+                    and self._slots.allocation(job_id) is not None):
                 return
-            target = preferred[0]
+            # slot allocation: best-fit over free device counts; a retry
+            # releases the previous allocation first (ref:
+            # ExecutionSlotAllocator + FineGrainedSlotManager matching)
+            self._slots.release(job_id)
+            target = self._slots.pick(
+                job_id, j.required_devices,
+                list(self.runners.values()), exclude=exclude)
+            if target is None:
+                # park until capacity registers (ref: AdaptiveScheduler
+                # WaitingForResources); a lost-runner retry with no
+                # fallback runner waits here too instead of failing
+                j.state = "WAITING_FOR_RESOURCES"
+                j.failure = (
+                    f"waiting for a runner with {j.required_devices} "
+                    "free device(s)")
+                return
+            self._slots.allocate(
+                job_id, target.runner_id,
+                target.n_devices if j.required_devices == SlotPool.ALL
+                else j.required_devices)
             j.state = "RUNNING"
+            j.failure = None
             j.assigned_runners = [target.runner_id]
+            if j.egraph is not None:
+                j.egraph.start_attempt(j.attempts, target.runner_id)
             entry, config, attempt = j.entry, dict(j.config), j.attempts
             if attempt > 1:
                 # recovery attempt resumes from the newest checkpoint
@@ -163,6 +209,10 @@ class JobCoordinator(RpcEndpoint):
                 c.close()
             if not resp.get("accepted"):
                 raise RpcError(f"runner rejected job: {resp}")
+            with self._lock:
+                jj = self.jobs.get(job_id)
+                if jj is not None and jj.egraph is not None:
+                    jj.egraph.transition("RUNNING", attempt=attempt)
         except RpcError as e:
             decision: Dict[str, Any] = {}
             with self._lock:
@@ -195,11 +245,19 @@ class JobCoordinator(RpcEndpoint):
         targets: List[RunnerInfo] = []
         with self._lock:
             j = self.jobs.get(job_id)
-            if j is not None and j.state in ("RUNNING", "RESTARTING"):
+            if j is not None and j.state in (
+                    "RUNNING", "RESTARTING", "WAITING_FOR_RESOURCES"):
                 j.state = "CANCELED"
+                self._slots.release(job_id)
+                if j.egraph is not None:
+                    j.egraph.transition("CANCELED")
                 targets = self._job_runners_locked(j)
         for r in targets:
             self._push_cancel_async(r, job_id)
+        with self._lock:
+            waiting = self._waiting_locked()
+        for wid in waiting:
+            self._deploy_async(wid)
         return {"ok": True}
 
     def _push_cancel_async(self, runner: RunnerInfo, job_id: str) -> None:
@@ -227,6 +285,13 @@ class JobCoordinator(RpcEndpoint):
             # ran to completion does not flip CANCELED back to FINISHED
             if j is not None and j.state in ("RUNNING", "RESTARTING"):
                 j.state = "FINISHED"
+                self._slots.release(job_id)
+                if j.egraph is not None:
+                    j.egraph.transition("FINISHED")
+            waiting = self._waiting_locked()
+        # freed capacity is a scheduling event like registration
+        for wid in waiting:
+            self._deploy_async(wid)
         return {"ok": True}
 
     def rpc_report_failure(self, job_id: str, error: str) -> dict:
@@ -261,6 +326,8 @@ class JobCoordinator(RpcEndpoint):
             # an external supervisor, so each report IS a new incident.
             return {"action": "restart-pending", "state": j.state}
         j.failure = error
+        if j.egraph is not None:
+            j.egraph.transition("FAILED", attempt=j.attempts)
         strat = self._strategies.get(j.job_id)
         if strat is not None and strat.can_restart():
             delay = strat.next_delay_ms()
@@ -269,6 +336,7 @@ class JobCoordinator(RpcEndpoint):
             return {"action": "restart", "delay_ms": delay,
                     "restore": "latest"}
         j.state = "FAILED"
+        self._slots.release(j.job_id)
         return {"action": "fail"}
 
     def rpc_list_jobs(self) -> dict:
@@ -313,6 +381,30 @@ class JobCoordinator(RpcEndpoint):
         threading.Thread(target=push, daemon=True).start()
         return {"ok": True, "dispatched": True,
                 "runners": [r.runner_id for r in targets]}
+
+    def rpc_report_plan(self, job_id: str, stages: List[str]) -> dict:
+        """Runner reports its compiled plan's stage names — the
+        coordinator never imports job code, so the physical graph's
+        stages materialize from this report (ref: ExecutionGraph built
+        from the submitted JobGraph; here the 'JobGraph' is compiled
+        runner-side from the entry point)."""
+        with self._lock:
+            j = self.jobs.get(job_id)
+            if j is None or j.egraph is None:
+                return {"ok": False}
+            j.egraph.set_stages(stages)
+        return {"ok": True}
+
+    def rpc_execution_graph(self, job_id: str) -> dict:
+        """Physical-graph detail for REST/CLI (ref: the REST job-detail
+        vertices/subtasks endpoints off ExecutionGraphInfo)."""
+        with self._lock:
+            j = self.jobs.get(job_id)
+            if j is None or j.egraph is None:
+                return {"found": False}
+            snap = j.egraph.snapshot()
+        snap["found"] = True
+        return snap
 
     def rpc_savepoint_complete(self, job_id: str, path: str) -> dict:
         with self._lock:

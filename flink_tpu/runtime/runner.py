@@ -207,6 +207,7 @@ class TaskRunner(RpcEndpoint):
             build = getattr(mod, fn_name)
             env = StreamExecutionEnvironment(Configuration(config))
             build(env)
+            self._report_plan(job_id, env)
             env.execute(job_id, cancel=cancel,
                         savepoint_request=rec.get("savepoint"))
             self._report("finish_job", job_id=job_id)
@@ -221,6 +222,23 @@ class TaskRunner(RpcEndpoint):
                 # already replaced it
                 if self._jobs.get(job_id) is rec:
                     self._jobs.pop(job_id)
+
+    def _report_plan(self, job_id: str, env) -> None:
+        """Report the compiled plan's stages so the coordinator's
+        ExecutionGraph materializes real vertices (graph lowering is
+        pure Python — compiling here costs microseconds and keeps job
+        code off the coordinator)."""
+        try:
+            from flink_tpu.graph.compiler import compile_job
+
+            plan = compile_job(env._transforms, env.config,
+                               env._watermark_strategy)
+            stages = [
+                f"{plan.node(nid).kind}:{plan.node(nid).name or nid}"
+                for nid in plan.topo_order]
+            self._report("report_plan", job_id=job_id, stages=stages)
+        except Exception:  # noqa: BLE001 — reporting is best-effort
+            pass
 
     def _report(self, method: str, **kw: Any) -> None:
         try:

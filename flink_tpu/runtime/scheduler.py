@@ -1,0 +1,162 @@
+"""ExecutionGraph + scheduler — the coordinator's physical-plan layer.
+
+ref: runtime/executiongraph/{ExecutionGraph,ExecutionJobVertex,
+ExecutionVertex,Execution}.java (physical graph: job vertex → per-subtask
+vertex → per-attempt execution), runtime/scheduler/{DefaultScheduler,
+SchedulerBase,ExecutionSlotAllocator}.java (slot allocation + deploy +
+failure routing), runtime/resourcemanager/slotmanager (slot inventory).
+
+TPU-first shape: a job deploys as ONE SPMD program over a device mesh,
+so the physical graph is stages × mesh-devices forming a single
+pipelined region — SPMD lockstep means any failure restarts the whole
+region (the RestartPipelinedRegionFailoverStrategy degenerates to
+restart-all, which is exactly Flink's behavior for an all-pipelined
+job). The decisions that remain real, and live here:
+
+- **slot accounting**: a runner's "slots" are its devices; a job
+  declares ``cluster.mesh-devices`` and must land on a runner with that
+  many free (ref: FineGrainedSlotManager resource matching).
+- **WAITING_FOR_RESOURCES**: a job with no fitting runner queues and
+  deploys the moment capacity registers (ref: AdaptiveScheduler's
+  WaitingForResources state).
+- **attempt tracking**: every (stage, subtask) carries its execution
+  attempts and states for observability (REST/CLI job detail).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["Execution", "ExecutionVertex", "ExecutionGraph", "SlotPool"]
+
+
+@dataclasses.dataclass
+class Execution:
+    """One attempt of one subtask (ref: Execution.java)."""
+    attempt: int
+    runner_id: str
+    state: str = "DEPLOYING"  # DEPLOYING RUNNING FAILED FINISHED CANCELED
+    created_at: float = dataclasses.field(default_factory=time.time)
+
+
+@dataclasses.dataclass
+class ExecutionVertex:
+    """One subtask of one stage (ref: ExecutionVertex.java)."""
+    stage: str
+    subtask: int
+    executions: List[Execution] = dataclasses.field(default_factory=list)
+
+    @property
+    def current(self) -> Optional[Execution]:
+        return self.executions[-1] if self.executions else None
+
+
+class ExecutionGraph:
+    """Physical graph of one job. Stages arrive when the runner reports
+    its compiled plan (the runner compiles — the coordinator never
+    imports job code; ref: ExecutionGraph built from JobGraph, except
+    the JobGraph here lives runner-side as the entry point's pipeline).
+    Until then the graph tracks whole-job executions against a single
+    placeholder stage."""
+
+    def __init__(self, job_id: str, parallelism: int) -> None:
+        self.job_id = job_id
+        self.parallelism = max(1, parallelism)
+        self.stages: List[str] = ["(pending plan)"]
+        self.vertices: List[ExecutionVertex] = []
+        self._materialize()
+
+    def _materialize(self) -> None:
+        prev: Dict[tuple, List[Execution]] = {
+            (v.stage, v.subtask): v.executions for v in self.vertices}
+        self.vertices = [
+            ExecutionVertex(s, i, prev.get((s, i), []))
+            for s in self.stages for i in range(self.parallelism)]
+
+    def set_stages(self, stages: List[str]) -> None:
+        """Runner reported its compiled plan: re-key the placeholder
+        vertices onto real stage names, preserving attempt history of
+        the current deployment (copied onto every stage — one SPMD
+        program IS every stage)."""
+        if not stages or stages == self.stages:
+            return
+        history = self.vertices[0].executions if self.vertices else []
+        self.stages = list(stages)
+        self.vertices = [
+            ExecutionVertex(s, i, [dataclasses.replace(e) for e in history])
+            for s in self.stages for i in range(self.parallelism)]
+
+    def start_attempt(self, attempt: int, runner_id: str) -> None:
+        for v in self.vertices:
+            v.executions.append(Execution(attempt, runner_id))
+
+    def transition(self, state: str, attempt: Optional[int] = None) -> None:
+        """Move every vertex's newest execution (optionally gated on the
+        attempt number — a stale attempt's report must not repaint a
+        newer deployment's states)."""
+        for v in self.vertices:
+            e = v.current
+            if e is not None and (attempt is None or e.attempt == attempt):
+                if e.state not in ("FAILED", "FINISHED", "CANCELED"):
+                    e.state = state
+
+    def snapshot(self) -> dict:
+        return {
+            "job_id": self.job_id,
+            "parallelism": self.parallelism,
+            "stages": list(self.stages),
+            "vertices": [
+                {"stage": v.stage, "subtask": v.subtask,
+                 "attempts": [
+                     {"attempt": e.attempt, "runner": e.runner_id,
+                      "state": e.state} for e in v.executions]}
+                for v in self.vertices],
+        }
+
+
+class SlotPool:
+    """Device-slot accounting across runners (ref: SlotManager's slot
+    inventory + DeclarativeSlotPool). Pure bookkeeping — callers hold
+    the coordinator lock."""
+
+    # sentinel demand: "every device of whichever runner is chosen"
+    # (cluster.mesh-devices: all) — fits only a fully-free runner and
+    # reserves its whole capacity
+    ALL = -1
+
+    def __init__(self) -> None:
+        # job_id -> (runner_id, devices)
+        self._allocations: Dict[str, tuple] = {}
+
+    def free_devices(self, runner_id: str, total: int) -> int:
+        used = sum(d for r, d in self._allocations.values()
+                   if r == runner_id)
+        return total - used
+
+    def allocate(self, job_id: str, runner_id: str, devices: int) -> None:
+        self._allocations[job_id] = (runner_id, devices)
+
+    def release(self, job_id: str) -> None:
+        self._allocations.pop(job_id, None)
+
+    def allocation(self, job_id: str) -> Optional[tuple]:
+        return self._allocations.get(job_id)
+
+    def pick(self, job_id: str, devices: int, runners: List,
+             exclude: Optional[List[str]] = None):
+        """Choose the alive gateway runner with the FEWEST free devices
+        that still fit (best-fit packing leaves big runners open for big
+        jobs). Returns the runner or None (→ WAITING_FOR_RESOURCES)."""
+        exclude = exclude or []
+        fits = []
+        for r in runners:
+            if not (r.alive and r.port) or r.runner_id in exclude:
+                continue
+            need = r.n_devices if devices == self.ALL else devices
+            if self.free_devices(r.runner_id, r.n_devices) >= need:
+                fits.append(r)
+        if not fits:
+            return None
+        return min(fits, key=lambda r: self.free_devices(
+            r.runner_id, r.n_devices))

@@ -86,16 +86,6 @@ _AGG_FACTORIES = {
     "avg": lambda f: aggregates.avg_of(f),
 }
 
-# result column the runtime emits for each aggregate kind on field f
-_AGG_RESULT_FIELD = {
-    "count": lambda f: "count",
-    "sum": lambda f: f"sum_{f}",
-    "max": lambda f: f"max_{f}",
-    "min": lambda f: f"min_{f}",
-    "avg": lambda f: f"avg_{f}",
-}
-
-
 @dataclasses.dataclass(frozen=True)
 class AggCall:
     fn: str                  # count/sum/max/min/avg
@@ -104,7 +94,10 @@ class AggCall:
 
     @property
     def runtime_field(self) -> str:
-        return _AGG_RESULT_FIELD[self.fn](self.field)
+        # the runtime's own default naming is the single source of
+        # truth — ask the built lane rather than mirroring the
+        # aggregates module's f"sum_{field}" conventions here
+        return aggregates.result_fields(self.build())[0]
 
     def build(self) -> aggregates.LaneAggregate:
         if self.fn not in _AGG_FACTORIES:

@@ -397,7 +397,7 @@ def plan_sql(t_env: "tapi.TableEnvironment", sql: str) -> "tapi.Table":
     if q.group_by:
         raise SqlError(
             "GROUP BY without aggregate functions in SELECT")
-    if q.order_by or q.limit:
+    if q.order_by is not None or q.limit is not None:
         raise SqlError(
             "ORDER BY/LIMIT is only supported over a windowed "
             "aggregation (per-window top-n)")
@@ -481,6 +481,12 @@ def _plan_aggregate(q: Query, table: "tapi.Table",
                 "window and needs a grouping column; a global windowed "
                 "aggregate has one row per window already")
         agg_stream, pairs, key_out = gt._aggregate_stream(*calls)
+        if not hasattr(agg_stream, "top"):
+            # session windows aggregate through the merge registry, not
+            # the pane fire path that hosts the fused top-n
+            raise SqlError(
+                "ORDER BY ... DESC LIMIT n is not supported over "
+                "SESSION windows in v1 (TUMBLE/HOP only)")
         topped = agg_stream.top(q.limit, by=by_call.runtime_field)
         return tapi.finish_projection(
             table.t_env, topped, pairs, key_out, want)

@@ -247,3 +247,164 @@ class TestRunnerLossRestartBudget:
             assert c.call("job_status", job_id="j1")["state"] == "FAILED"
         finally:
             srv.close()
+
+
+class TestSchedulerExecutionGraph:
+    """Slot allocation + ExecutionGraph (ref: DefaultScheduler /
+    ExecutionSlotAllocator / ExecutionGraph attempt bookkeeping)."""
+
+    class _FakeRunnerGateway(RpcEndpoint):
+        """Accepts run_job and records deployments (the
+        TestingTaskExecutorGateway pattern)."""
+
+        def __init__(self):
+            self.deployed = []
+
+        def rpc_run_job(self, job_id, entry, config=None, attempt=1):
+            self.deployed.append((job_id, attempt))
+            return {"accepted": True}
+
+        def rpc_cancel_job(self, job_id):
+            return {"ok": True}
+
+    def _register(self, coord_client, gw_port, rid, n_devices):
+        coord_client.call("register_runner", runner_id=rid,
+                          host="127.0.0.1", n_devices=n_devices,
+                          port=gw_port)
+
+    def test_best_fit_slot_allocation(self):
+        srv = start_coordinator(Configuration({}))
+        gw_small = RpcServer(self._FakeRunnerGateway())
+        gw_big = RpcServer(self._FakeRunnerGateway())
+        try:
+            c = RpcClient("127.0.0.1", srv.port)
+            self._register(c, gw_small.port, "small", 2)
+            self._register(c, gw_big.port, "big", 8)
+            # a 2-device job best-fits the SMALL runner, leaving the big
+            # one free for big jobs
+            c.call("submit_job", job_id="j2", entry="x:y",
+                   config={"cluster.mesh-devices": "2"})
+            deadline = time.time() + 5
+            while time.time() < deadline and not gw_small.endpoint.deployed:
+                time.sleep(0.02)
+            assert gw_small.endpoint.deployed == [("j2", 1)]
+            assert not gw_big.endpoint.deployed
+            # an 8-device job only fits the big runner
+            c.call("submit_job", job_id="j8", entry="x:y",
+                   config={"cluster.mesh-devices": "8"})
+            deadline = time.time() + 5
+            while time.time() < deadline and not gw_big.endpoint.deployed:
+                time.sleep(0.02)
+            assert gw_big.endpoint.deployed == [("j8", 1)]
+            c.close()
+        finally:
+            srv.close(); gw_small.close(); gw_big.close()
+
+    def test_waiting_for_resources_then_deploy_on_register(self):
+        srv = start_coordinator(Configuration({}))
+        gw = RpcServer(self._FakeRunnerGateway())
+        try:
+            c = RpcClient("127.0.0.1", srv.port)
+            c.call("submit_job", job_id="j", entry="x:y",
+                   config={"cluster.mesh-devices": "4"})
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                st = c.call("job_status", job_id="j")
+                if st["state"] == "WAITING_FOR_RESOURCES":
+                    break
+                time.sleep(0.02)
+            assert st["state"] == "WAITING_FOR_RESOURCES"
+            # capacity arrives -> deploys
+            self._register(c, gw.port, "r1", 8)
+            deadline = time.time() + 5
+            while time.time() < deadline and not gw.endpoint.deployed:
+                time.sleep(0.02)
+            assert gw.endpoint.deployed == [("j", 1)]
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if c.call("job_status", job_id="j")["state"] == "RUNNING":
+                    break
+                time.sleep(0.02)
+            assert c.call("job_status", job_id="j")["state"] == "RUNNING"
+            c.close()
+        finally:
+            srv.close(); gw.close()
+
+    def test_execution_graph_materializes_from_reported_plan(self):
+        srv = start_coordinator(Configuration({}))
+        gw = RpcServer(self._FakeRunnerGateway())
+        try:
+            c = RpcClient("127.0.0.1", srv.port)
+            self._register(c, gw.port, "r1", 4)
+            c.call("submit_job", job_id="j", entry="x:y",
+                   config={"cluster.mesh-devices": "2"})
+            deadline = time.time() + 5
+            while time.time() < deadline and not gw.endpoint.deployed:
+                time.sleep(0.02)
+            # the runner reports its compiled stages
+            c.call("report_plan", job_id="j",
+                   stages=["source:bids", "window:hot", "sink:out"])
+            eg = c.call("execution_graph", job_id="j")
+            assert eg["found"]
+            assert eg["stages"] == ["source:bids", "window:hot", "sink:out"]
+            assert eg["parallelism"] == 2
+            assert len(eg["vertices"]) == 6  # 3 stages x 2 subtasks
+            states = {a["state"] for v in eg["vertices"]
+                      for a in v["attempts"]}
+            assert states <= {"RUNNING", "DEPLOYING"}
+            runners = {a["runner"] for v in eg["vertices"]
+                       for a in v["attempts"]}
+            assert runners == {"r1"}
+            c.close()
+        finally:
+            srv.close(); gw.close()
+
+    def test_slots_released_on_finish(self):
+        srv = start_coordinator(Configuration({}))
+        gw = RpcServer(self._FakeRunnerGateway())
+        try:
+            c = RpcClient("127.0.0.1", srv.port)
+            self._register(c, gw.port, "r1", 2)
+            c.call("submit_job", job_id="a", entry="x:y",
+                   config={"cluster.mesh-devices": "2"})
+            deadline = time.time() + 5
+            while time.time() < deadline and not gw.endpoint.deployed:
+                time.sleep(0.02)
+            # second 2-device job cannot fit until the first finishes
+            c.call("submit_job", job_id="b", entry="x:y",
+                   config={"cluster.mesh-devices": "2"})
+            time.sleep(0.3)
+            assert c.call("job_status",
+                          job_id="b")["state"] == "WAITING_FOR_RESOURCES"
+            c.call("finish_job", job_id="a")  # freed slots kick the queue
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if ("b", 1) in gw.endpoint.deployed:
+                    break
+                time.sleep(0.02)
+            assert ("b", 1) in gw.endpoint.deployed
+            c.close()
+        finally:
+            srv.close(); gw.close()
+
+    def test_mesh_devices_all_reserves_whole_runner(self):
+        srv = start_coordinator(Configuration({}))
+        gw = RpcServer(self._FakeRunnerGateway())
+        try:
+            c = RpcClient("127.0.0.1", srv.port)
+            self._register(c, gw.port, "r1", 8)
+            c.call("submit_job", job_id="whole", entry="x:y",
+                   config={"cluster.mesh-devices": "all"})
+            deadline = time.time() + 5
+            while time.time() < deadline and not gw.endpoint.deployed:
+                time.sleep(0.02)
+            assert ("whole", 1) in gw.endpoint.deployed
+            # runner is fully reserved: a 1-device job must now wait
+            c.call("submit_job", job_id="one", entry="x:y",
+                   config={"cluster.mesh-devices": "1"})
+            time.sleep(0.3)
+            assert c.call("job_status",
+                          job_id="one")["state"] == "WAITING_FOR_RESOURCES"
+            c.close()
+        finally:
+            srv.close(); gw.close()

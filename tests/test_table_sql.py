@@ -326,3 +326,31 @@ class TestReviewRegressions:
             "ORDER BY c DESC LIMIT 1").execute()
         assert res.rows
         assert set(res.rows[0]) == {"auction", "window_end", "c"}
+
+    def test_session_topn_rejected(self):
+        env, t_env = _fresh()
+        stream, _ = _bids(env, n=100)
+        t_env.create_temporary_view(
+            "bids", stream, schema=["auction", "price", "ts"],
+            time_attr="ts")
+        with pytest.raises(SqlError, match="SESSION"):
+            t_env.sql_query(
+                "SELECT auction, COUNT(*) AS c FROM TABLE(SESSION("
+                "TABLE bids, DESCRIPTOR(ts), INTERVAL '2' SECOND)) "
+                "GROUP BY auction ORDER BY c DESC LIMIT 2")
+
+    def test_limit_zero_on_projection_rejected(self):
+        env, t_env = _fresh()
+        stream, _ = _bids(env, n=100)
+        t_env.create_temporary_view(
+            "bids", stream, schema=["auction", "price", "ts"],
+            time_attr="ts")
+        with pytest.raises(SqlError, match="windowed"):
+            t_env.sql_query("SELECT auction FROM bids LIMIT 0")
+
+    def test_avg_runtime_field_tracks_aggregates_module(self):
+        from flink_tpu.table.api import AggCall
+        from flink_tpu.ops.aggregates import avg_of, result_fields
+
+        assert (AggCall("avg", "price", "x").runtime_field
+                == result_fields(avg_of("price"))[0])
