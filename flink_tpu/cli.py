@@ -83,6 +83,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     runp.add_argument("--job-id", default=None)
     runp.add_argument("--conf", action="append", default=[],
                       metavar="KEY=VALUE")
+    runp.add_argument("--py-file", action="append", default=[],
+                      metavar="PATH",
+                      help="ship this Python file to the runner via the "
+                           "coordinator's blob store (the job-jar "
+                           "analogue); repeatable")
 
     for name, help_ in (("list", "list jobs"), ("runners", "list runners")):
         sp = sub.add_parser(name, help=help_)
@@ -105,8 +110,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             raise SystemExit("run needs --coordinator (or --local)")
         c = _coord_client(args.coordinator)
         try:
+            blobs = []
+            for path in args.py_file:
+                import base64
+                import os
+
+                with open(path, "rb") as f:
+                    data = f.read()
+                r = c.call("put_blob",
+                           data_b64=base64.b64encode(data).decode())
+                blobs.append({"name": os.path.basename(path),
+                              "digest": r["digest"]})
             resp = c.call("submit_job", job_id=job_id, entry=args.entry,
-                          config=conf)
+                          config=conf, py_blobs=blobs)
         finally:
             c.close()
         print(json.dumps({"job_id": job_id, **resp}))

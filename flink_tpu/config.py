@@ -305,3 +305,20 @@ class ClusterOptions:
     RESTART_DELAY = duration_option(
         "restart-strategy.fixed-delay.delay", 1_000,
         "Delay between restarts for fixed-delay strategy.")
+
+
+class HighAvailabilityOptions:
+    HA_DIR = ConfigOption(
+        "high-availability.dir", "",
+        "Shared directory for leader election + the job graph store. "
+        "Empty = HA off. A standby coordinator pointed at the same dir "
+        "takes leadership when the incumbent's lease lapses and "
+        "recovers every non-terminal job from the store (ref: "
+        "runtime/highavailability HighAvailabilityServices + "
+        "JobGraphStore + leader election via ZooKeeper/K8s; here the "
+        "shared filesystem is the consensus substrate).")
+    LEASE_TIMEOUT = duration_option(
+        "high-availability.lease-timeout", 10_000,
+        "Leadership lease: the leader renews within this period; a "
+        "contender may claim a lease older than this (ref: ZooKeeper "
+        "session timeout role).")

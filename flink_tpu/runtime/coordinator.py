@@ -15,6 +15,7 @@ endpoint thread suffices (the RpcEndpoint discipline).
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -51,6 +52,9 @@ class JobInfo:
     last_savepoint: Optional[str] = None
     # device-slot demand (cluster.mesh-devices; "all" resolves at pick)
     required_devices: int = 1
+    # job-code artifacts: [{"name": "mod.py", "digest": sha256}] the
+    # runner fetches from the blob store before importing the entry
+    py_blobs: List[Dict[str, str]] = dataclasses.field(default_factory=list)
     # physical graph: stages × parallelism, per-attempt execution states
     egraph: Optional[ExecutionGraph] = None
 
@@ -62,16 +66,71 @@ class JobCoordinator(RpcEndpoint):
     heartbeats stop (ref: heartbeat.timeout, default 50s)."""
 
     def __init__(self, config: Optional[Configuration] = None) -> None:
+        from flink_tpu.config import HighAvailabilityOptions
+
         self.config = config or Configuration()
         self.runners: Dict[str, RunnerInfo] = {}
         self.jobs: Dict[str, JobInfo] = {}
         self._slots = SlotPool()
         self._strategies: Dict[str, RestartStrategy] = {}
+        # HA job store: non-terminal deployable jobs survive coordinator
+        # loss — a new leader re-deploys them with restore:latest (ref:
+        # JobGraphStore + Dispatcher recovery)
+        self._store = None
+        ha_dir = str(self.config.get(HighAvailabilityOptions.HA_DIR)).strip()
+        # blob store: job-code artifacts, content-addressed (ref:
+        # BlobServer). Under HA it shares the durable HA dir so a new
+        # leader still serves old submissions' code.
+        from flink_tpu.runtime.blob import BlobStore
+
+        self._blobs = BlobStore(
+            os.path.join(ha_dir, "blobs") if ha_dir else None)
+        if ha_dir:
+            from flink_tpu.runtime.ha import JobStore
+
+            self._store = JobStore(ha_dir)
+            self._recover_from_store()
         self._hb_timeout = self.config.get(ClusterOptions.HEARTBEAT_TIMEOUT) / 1000
         self._lock = threading.Lock()  # monitor thread + rpc thread
         self._closed = False
         self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
         self._monitor.start()
+
+    def _recover_from_store(self) -> None:
+        """Resume every non-terminal deployable job from the HA store:
+        parked WAITING_FOR_RESOURCES with a bumped attempt — the moment
+        runners (re-)register with this coordinator, the scheduler
+        deploys them and attempt>1 wires restore:latest (ref:
+        Dispatcher.recoverJobs → JobMaster restore from the
+        CompletedCheckpointStore; checkpoint manifests are already
+        durable under the job's checkpoint dir)."""
+        for rec in self._store.recoverable():
+            job_id = rec["job_id"]
+            attempts = int(rec.get("attempts", 1)) + 1
+            spec = str(rec.get("config", {}).get(
+                "cluster.mesh-devices", "") or "").strip()
+            required = (SlotPool.ALL if spec == "all"
+                        else max(1, int(spec)) if spec.isdigit() else 1)
+            self.jobs[job_id] = JobInfo(
+                job_id, state="WAITING_FOR_RESOURCES", attempts=attempts,
+                entry=rec.get("entry"), config=dict(rec.get("config", {})),
+                failure="recovered by new coordinator; awaiting runners",
+                required_devices=required,
+                py_blobs=list(rec.get("py_blobs", [])),
+                egraph=ExecutionGraph(job_id, required))
+            self._strategies[job_id] = from_config(self.config)
+            self._persist_locked(self.jobs[job_id])
+
+    def _persist_locked(self, j: JobInfo) -> None:
+        """Write-through to the HA job store (caller holds the lock or
+        is in single-threaded init)."""
+        if self._store is None:
+            return
+        if j.entry is None:
+            return  # bookkeeping-only jobs are not recoverable
+        self._store.put(j.job_id, entry=j.entry, config=j.config,
+                        state=j.state, attempts=j.attempts,
+                        py_blobs=j.py_blobs)
 
     # -- rpc methods -----------------------------------------------------
     def rpc_register_runner(self, runner_id: str, host: str, n_devices: int,
@@ -122,7 +181,8 @@ class JobCoordinator(RpcEndpoint):
 
     def rpc_submit_job(self, job_id: str, runners: Optional[List[str]] = None,
                        entry: Optional[str] = None,
-                       config: Optional[dict] = None) -> dict:
+                       config: Optional[dict] = None,
+                       py_blobs: Optional[List[Dict[str, str]]] = None) -> dict:
         """Submit a job. With an ``entry`` (module:function deployment
         descriptor) the plan is PUSHED to a chosen runner's gateway —
         the Dispatcher.submitJob → JobMaster → TaskExecutor.submitTask
@@ -139,9 +199,11 @@ class JobCoordinator(RpcEndpoint):
             job = JobInfo(job_id, state="RUNNING", attempts=1,
                           assigned_runners=chosen, entry=entry,
                           config=conf, required_devices=required,
+                          py_blobs=list(py_blobs or []),
                           egraph=ExecutionGraph(job_id, required))
             self.jobs[job_id] = job
             self._strategies[job_id] = from_config(self.config)
+            self._persist_locked(job)
         if entry is not None:
             self._deploy_async(job_id)
         return {"assigned": chosen}
@@ -187,24 +249,31 @@ class JobCoordinator(RpcEndpoint):
                     f"waiting for a runner with {j.required_devices} "
                     "free device(s)")
                 return
-            self._slots.allocate(
-                job_id, target.runner_id,
-                target.n_devices if j.required_devices == SlotPool.ALL
-                else j.required_devices)
+            resolved = (target.n_devices
+                        if j.required_devices == SlotPool.ALL
+                        else j.required_devices)
+            self._slots.allocate(job_id, target.runner_id, resolved)
+            if j.egraph is not None and j.egraph.parallelism != resolved:
+                # 'all' resolves only now that a runner is chosen — the
+                # physical graph's subtask width follows the allocation
+                j.egraph.set_parallelism(resolved)
             j.state = "RUNNING"
             j.failure = None
             j.assigned_runners = [target.runner_id]
             if j.egraph is not None:
                 j.egraph.start_attempt(j.attempts, target.runner_id)
+            self._persist_locked(j)
             entry, config, attempt = j.entry, dict(j.config), j.attempts
+            blobs = list(j.py_blobs)
             if attempt > 1:
                 # recovery attempt resumes from the newest checkpoint
                 config["execution.checkpointing.restore"] = "latest"
         try:
             c = RpcClient(target.host, target.port, timeout_s=5.0)
             try:
+                extra = {"py_blobs": blobs} if blobs else {}
                 resp = c.call("run_job", job_id=job_id, entry=entry,
-                              config=config, attempt=attempt)
+                              config=config, attempt=attempt, **extra)
             finally:
                 c.close()
             if not resp.get("accepted"):
@@ -229,6 +298,15 @@ class JobCoordinator(RpcEndpoint):
         with self._lock:
             j = self.jobs.get(job_id)
             if j is None:
+                # terminal jobs aren't re-loaded by a new leader, but
+                # their final state is in the store — answer from there
+                # (ref: ExecutionGraphInfoStore serving archived jobs)
+                if self._store is not None:
+                    rec = self._store.get(job_id)
+                    if rec is not None:
+                        return {"state": rec.get("state", "UNKNOWN"),
+                                "attempts": rec.get("attempts", 0),
+                                "failure": None, "archived": True}
                 return {"state": "UNKNOWN"}
             return {"state": j.state, "attempts": j.attempts,
                     "failure": j.failure,
@@ -251,6 +329,7 @@ class JobCoordinator(RpcEndpoint):
                 self._slots.release(job_id)
                 if j.egraph is not None:
                     j.egraph.transition("CANCELED")
+                self._persist_locked(j)
                 targets = self._job_runners_locked(j)
         for r in targets:
             self._push_cancel_async(r, job_id)
@@ -288,6 +367,7 @@ class JobCoordinator(RpcEndpoint):
                 self._slots.release(job_id)
                 if j.egraph is not None:
                     j.egraph.transition("FINISHED")
+                self._persist_locked(j)
             waiting = self._waiting_locked()
         # freed capacity is a scheduling event like registration
         for wid in waiting:
@@ -333,10 +413,12 @@ class JobCoordinator(RpcEndpoint):
             delay = strat.next_delay_ms()
             j.state = "RESTARTING"
             j.attempts += 1
+            self._persist_locked(j)
             return {"action": "restart", "delay_ms": delay,
                     "restore": "latest"}
         j.state = "FAILED"
         self._slots.release(j.job_id)
+        self._persist_locked(j)
         return {"action": "fail"}
 
     def rpc_list_jobs(self) -> dict:
@@ -381,6 +463,24 @@ class JobCoordinator(RpcEndpoint):
         threading.Thread(target=push, daemon=True).start()
         return {"ok": True, "dispatched": True,
                 "runners": [r.runner_id for r in targets]}
+
+    # -- blobs (ref: BlobServer put/get) --------------------------------
+    def rpc_put_blob(self, data_b64: str) -> dict:
+        import base64
+
+        digest = self._blobs.put(base64.b64decode(data_b64))
+        return {"digest": digest}
+
+    def rpc_get_blob(self, digest: str) -> dict:
+        import base64
+
+        data = self._blobs.get(digest)
+        if data is None:
+            return {"found": False}
+        return {"found": True, "data_b64": base64.b64encode(data).decode()}
+
+    def rpc_list_blobs(self) -> dict:
+        return {"digests": self._blobs.list()}
 
     def rpc_report_plan(self, job_id: str, stages: List[str]) -> dict:
         """Runner reports its compiled plan's stage names — the
@@ -468,23 +568,70 @@ def main(argv: Optional[list] = None) -> None:
     p.add_argument("--rest-port", type=int, default=0,
                    help="HTTP REST/UI port (0 = disabled)")
     p.add_argument("--rest-bind", default="127.0.0.1")
+    p.add_argument("--ha-dir", default="",
+                   help="shared HA directory: contend for leadership "
+                        "and recover jobs from its store (standby "
+                        "coordinators block here until elected)")
     args = p.parse_args(argv)
-    server = start_coordinator(port=args.port)
-    rest = None
-    if args.rest_port:
-        from flink_tpu.obs.rest import RestServer
 
-        rest = RestServer(server, port=args.rest_port,
-                          bind=args.rest_bind)
-        print(f"rest on :{rest.port}", flush=True)
-    print(f"coordinator on :{server.port}", flush=True)
+    def serve_forever(server):
+        rest = None
+        if args.rest_port:
+            from flink_tpu.obs.rest import RestServer
+
+            rest = RestServer(server, port=args.rest_port,
+                              bind=args.rest_bind)
+            print(f"rest on :{rest.port}", flush=True)
+        print(f"coordinator on :{server.port}", flush=True)
+        return rest
+
+    if not args.ha_dir:
+        server = start_coordinator(port=args.port)
+        rest = serve_forever(server)
+        try:
+            while True:
+                _time.sleep(3600)
+        except KeyboardInterrupt:
+            if rest is not None:
+                rest.close()
+            server.close()
+        return
+
+    # HA mode: contend → serve while leader → on revoke STOP SERVING
+    # (a stalled leader that lost its lease must not keep accepting
+    # work — split-brain; ref: leadership revocation closing the
+    # Dispatcher's RPC) → re-contend. Jobs re-load from the store on
+    # the next grant, so dropping in-memory state is safe.
+    import threading as _threading
+
+    from flink_tpu.config import HighAvailabilityOptions
+    from flink_tpu.runtime.ha import LeaderElection
+
+    conf = Configuration({"high-availability.dir": args.ha_dir})
+    grant_evt = _threading.Event()
+    revoke_evt = _threading.Event()
+    election = LeaderElection(
+        args.ha_dir, f"127.0.0.1:{args.port}",
+        conf.get(HighAvailabilityOptions.LEASE_TIMEOUT) / 1000)
+    election.on_grant = lambda epoch: grant_evt.set()
+    election.on_revoke = revoke_evt.set
+    election.start()
     try:
         while True:
-            _time.sleep(3600)
+            print("contending for leadership...", flush=True)
+            grant_evt.wait()
+            grant_evt.clear()
+            revoke_evt.clear()
+            print(f"elected leader (epoch {election.epoch})", flush=True)
+            server = start_coordinator(conf, port=args.port)
+            rest = serve_forever(server)
+            revoke_evt.wait()  # leadership lost: stop serving
+            print("leadership revoked; closing", flush=True)
+            if rest is not None:
+                rest.close()
+            server.close()
     except KeyboardInterrupt:
-        if rest is not None:
-            rest.close()
-        server.close()
+        election.close()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,238 @@
+"""High-availability services: leader election + persistent job store.
+
+ref: runtime/highavailability/{HighAvailabilityServices,
+zookeeper/ZooKeeperLeaderElectionHaServices}.java,
+runtime/leaderelection/DefaultLeaderElectionService.java,
+runtime/jobmanager/JobGraphStore (persistent submitted-job metadata),
+runtime/checkpoint/DefaultCompletedCheckpointStore.java.
+
+TPU-first shape: no ZooKeeper/etcd in the image, and the deployment
+already requires a shared filesystem for checkpoints — so the same
+substrate carries consensus: leadership is a lease FILE claimed with
+O_CREAT|O_EXCL (atomic on POSIX) and renewed by mtime; a contender
+steals a lease older than the timeout by rename-replacing it. The job
+store is one JSON file per job, written atomically (tmp + rename) —
+exactly the manifest-last discipline the checkpoint storage uses.
+Completed-checkpoint state needs no separate store: checkpoint
+manifests already live durably under the job's checkpoint dir and
+``restore: latest`` resolves them; the job store only has to carry the
+jobs themselves.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["LeaderElection", "JobStore", "leader_address"]
+
+
+@dataclasses.dataclass
+class LeaderRecord:
+    leader_id: str
+    address: str          # host:port of the leader's RPC gateway
+    epoch: int            # fencing token: increases on every takeover
+    claimed_at: float
+
+
+class LeaderElection:
+    """File-lease leader election on a shared directory.
+
+    ``start()`` spawns the contender thread; ``on_grant(epoch)`` fires
+    when leadership is won, ``on_revoke()`` if the lease is lost (e.g.
+    the renewal thread finds another leader's record — clock skew or a
+    partition where a contender stole the lease). The epoch is the
+    fencing token (ref: FencedRpcEndpoint / leader session id): every
+    takeover increments it, so stale leaders' writes are detectable.
+    """
+
+    def __init__(self, ha_dir: str, address: str,
+                 lease_timeout_s: float = 10.0,
+                 leader_id: Optional[str] = None) -> None:
+        self.ha_dir = ha_dir
+        self.address = address
+        self.leader_id = leader_id or f"coord-{uuid.uuid4().hex[:8]}"
+        self.lease_timeout_s = lease_timeout_s
+        self.is_leader = False
+        self.epoch = 0
+        self.on_grant: Optional[Callable[[int], None]] = None
+        self.on_revoke: Optional[Callable[[], None]] = None
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(ha_dir, exist_ok=True)
+
+    @property
+    def _lease(self) -> str:
+        return os.path.join(self.ha_dir, "leader.lease")
+
+    # -- lease file primitives ------------------------------------------
+    def _read(self) -> Optional[LeaderRecord]:
+        try:
+            with open(self._lease) as f:
+                d = json.load(f)
+            return LeaderRecord(d["leader_id"], d["address"],
+                                int(d["epoch"]), float(d["claimed_at"]))
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def _write(self, rec: LeaderRecord, *, exclusive: bool) -> bool:
+        payload = json.dumps(dataclasses.asdict(rec)).encode()
+        if exclusive:
+            try:
+                fd = os.open(self._lease,
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                return False
+            with os.fdopen(fd, "wb") as f:
+                f.write(payload)
+            return True
+        tmp = self._lease + f".{self.leader_id}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, self._lease)  # atomic steal/renew
+        return True
+
+    def _lease_age(self) -> float:
+        try:
+            return time.time() - os.path.getmtime(self._lease)
+        except OSError:
+            return float("inf")
+
+    # -- contender loop -------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        poll = max(self.lease_timeout_s / 4, 0.05)
+        while not self._closed:
+            if self.is_leader:
+                cur = self._read()
+                if cur is None or cur.leader_id != self.leader_id:
+                    # someone stole the lease (we stalled past timeout)
+                    self.is_leader = False
+                    if self.on_revoke:
+                        self.on_revoke()
+                else:
+                    os.utime(self._lease)  # renew
+            else:
+                cur = self._read()
+                if cur is None:
+                    got = self._write(LeaderRecord(
+                        self.leader_id, self.address, 1, time.time()),
+                        exclusive=True)
+                    if got:
+                        self._granted(1)
+                elif (cur.leader_id != self.leader_id
+                      and self._lease_age() > self.lease_timeout_s):
+                    # stale incumbent: steal with a higher epoch
+                    self._write(LeaderRecord(
+                        self.leader_id, self.address, cur.epoch + 1,
+                        time.time()), exclusive=False)
+                    # confirm we won the replace race
+                    again = self._read()
+                    if again and again.leader_id == self.leader_id:
+                        self._granted(again.epoch)
+            time.sleep(poll)
+
+    def _granted(self, epoch: int) -> None:
+        self.is_leader = True
+        self.epoch = epoch
+        if self.on_grant:
+            self.on_grant(epoch)
+
+    def close(self) -> None:
+        self._closed = True
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        if self.is_leader:
+            try:
+                os.remove(self._lease)  # clean handover
+            except OSError:
+                pass
+
+
+def leader_address(ha_dir: str) -> Optional[str]:
+    """Resolve the current leader's RPC address from the lease file
+    (what CLI/clients use instead of a fixed --coordinator)."""
+    try:
+        with open(os.path.join(ha_dir, "leader.lease")) as f:
+            return json.load(f)["address"]
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+class JobStore:
+    """Durable submitted-job metadata, one JSON per job, atomic writes
+    (ref: JobGraphStore — the job graphs a recovered Dispatcher
+    re-runs). Stored: entry point, config, state, attempts — enough for
+    a new leader to re-deploy with ``restore: latest``."""
+
+    TERMINAL = ("FINISHED", "FAILED", "CANCELED")
+
+    def __init__(self, ha_dir: str) -> None:
+        self.dir = os.path.join(ha_dir, "jobs")
+        self.archive_dir = os.path.join(ha_dir, "jobs-archive")
+        os.makedirs(self.dir, exist_ok=True)
+        os.makedirs(self.archive_dir, exist_ok=True)
+
+    def _path(self, job_id: str) -> str:
+        return os.path.join(self.dir, f"{job_id}.json")
+
+    def _archive_path(self, job_id: str) -> str:
+        return os.path.join(self.archive_dir, f"{job_id}.json")
+
+    def put(self, job_id: str, *, entry: Optional[str], config: Dict,
+            state: str, attempts: int,
+            py_blobs: Optional[List[Dict]] = None) -> None:
+        """Active jobs live in jobs/; a terminal write MOVES the record
+        to jobs-archive/ so leader recovery never scans or parses
+        finished history (ref: JobGraphStore removes terminal graphs;
+        ExecutionGraphInfoStore keeps the archived view)."""
+        terminal = state in self.TERMINAL
+        dst = self._archive_path(job_id) if terminal else self._path(job_id)
+        rec = {"job_id": job_id, "entry": entry, "config": config,
+               "state": state, "attempts": attempts,
+               "py_blobs": list(py_blobs or [])}
+        tmp = dst + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, dst)
+        if terminal:
+            self.remove(job_id)
+
+    def get(self, job_id: str) -> Optional[Dict]:
+        for path in (self._path(job_id), self._archive_path(job_id)):
+            try:
+                with open(path) as f:
+                    return json.load(f)
+            except (OSError, ValueError):
+                continue
+        return None
+
+    def remove(self, job_id: str) -> None:
+        try:
+            os.remove(self._path(job_id))
+        except OSError:
+            pass
+
+    def recoverable(self) -> List[Dict]:
+        """Non-terminal deployable jobs a new leader must resume."""
+        out = []
+        for name in sorted(os.listdir(self.dir)):
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.dir, name)) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if (rec.get("entry")
+                    and rec.get("state") not in (
+                        "FINISHED", "FAILED", "CANCELED")):
+                out.append(rec)
+        return out

@@ -52,9 +52,16 @@ class TaskRunner(RpcEndpoint):
     stays responsive to cancel + health while a job runs."""
 
     def __init__(self, coordinator_host: str, coordinator_port: int,
-                 runner_id: Optional[str] = None) -> None:
+                 runner_id: Optional[str] = None,
+                 ha_dir: Optional[str] = None) -> None:
         self.runner_id = runner_id or f"runner-{uuid.uuid4().hex[:8]}"
-        self._coord = RpcClient(coordinator_host, coordinator_port)
+        self._coord_addr = (coordinator_host, coordinator_port)
+        self._ha_dir = ha_dir
+        # modest timeout: heartbeats are tiny, and a frozen/partitioned
+        # leader must not hold the loop long enough to stall failover
+        # (leader re-resolution waits out 2 of these)
+        self._coord = RpcClient(coordinator_host, coordinator_port,
+                                timeout_s=5.0)
         self._jobs: Dict[str, Dict[str, Any]] = {}  # job_id -> {cancel, thread}
         self._lock = threading.Lock()
         self._closed = False
@@ -84,6 +91,7 @@ class TaskRunner(RpcEndpoint):
         return self._server.port
 
     def _heartbeat_loop(self, interval: float) -> None:
+        misses = 0
         while not self._closed:
             time.sleep(interval)
             try:
@@ -91,6 +99,7 @@ class TaskRunner(RpcEndpoint):
                     running = list(self._jobs)
                 r = self._coord.call("heartbeat", runner_id=self.runner_id,
                                      jobs=running)
+                misses = 0
                 # revocation: jobs the coordinator no longer considers
                 # ours (reassigned after a false-positive loss, or
                 # terminal) must stop producing output here — the
@@ -112,7 +121,40 @@ class TaskRunner(RpcEndpoint):
                         n_devices=len(jax.devices()),
                         port=self._server.port if self._server else 0)
             except RpcError:
-                pass  # transient; next beat retries
+                # transient; next beat retries. In HA mode a coordinator
+                # that stays unreachable has likely lost leadership —
+                # re-resolve the lease and follow the new leader (ref:
+                # TaskExecutor re-connecting after JM leader change)
+                misses += 1
+                if self._ha_dir and misses >= 2:
+                    misses = 0
+                    self._follow_leader()
+
+    def _follow_leader(self) -> None:
+        from flink_tpu.runtime.ha import leader_address
+
+        addr = leader_address(self._ha_dir)
+        if addr is None:
+            return
+        host, _, port = addr.partition(":")
+        if (host, int(port)) == self._coord_addr:
+            return  # same leader; outage was transient
+        try:
+            new = RpcClient(host, int(port), timeout_s=5.0)
+            import jax
+
+            new.call("register_runner", runner_id=self.runner_id,
+                     host="127.0.0.1", n_devices=len(jax.devices()),
+                     port=self._server.port if self._server else 0)
+        except RpcError:
+            return  # new leader not serving yet; retry next beat
+        old = self._coord
+        self._coord_addr = (host, int(port))
+        self._coord = new
+        try:
+            old.close()
+        except OSError:
+            pass
 
     def close(self) -> None:
         self._closed = True
@@ -126,7 +168,8 @@ class TaskRunner(RpcEndpoint):
 
     def rpc_run_job(self, job_id: str, entry: str,
                     config: Optional[dict] = None,
-                    attempt: int = 1) -> dict:
+                    attempt: int = 1,
+                    py_blobs: Optional[list] = None) -> dict:
         """Deploy a job: import ``module:function``, build the pipeline,
         execute. The entry-point contract is the job-jar analogue — the
         job's code must be importable on the runner host (ref:
@@ -146,7 +189,8 @@ class TaskRunner(RpcEndpoint):
             savepoint = SavepointRequest(self, job_id)
             rec: Dict[str, Any] = {"cancel": cancel, "attempt": attempt,
                                    "savepoint": savepoint,
-                                   "config": dict(config or {})}
+                                   "config": dict(config or {}),
+                                   "py_blobs": list(py_blobs or [])}
             t = threading.Thread(
                 target=self._run_job,
                 args=(job_id, entry, dict(config or {}), attempt, cancel,
@@ -201,7 +245,10 @@ class TaskRunner(RpcEndpoint):
             # cancelled) — it stops at its next batch boundary; if it is
             # wedged past this, its cancel flag still discards output
             old["thread"].join(timeout=30.0)
+        jobdir = None
         try:
+            jobdir = self._stage_blobs(job_id, attempt,
+                                       rec.get("py_blobs") or [])
             mod_name, _, fn_name = entry.partition(":")
             mod = importlib.import_module(mod_name)
             build = getattr(mod, fn_name)
@@ -217,11 +264,51 @@ class TaskRunner(RpcEndpoint):
             self._report("report_failure", job_id=job_id,
                          error=traceback.format_exc(limit=5))
         finally:
+            if jobdir is not None:
+                import sys
+
+                try:
+                    sys.path.remove(jobdir)
+                except ValueError:
+                    pass
             with self._lock:
                 # pop only OUR record — a superseding attempt may have
                 # already replaced it
                 if self._jobs.get(job_id) is rec:
                     self._jobs.pop(job_id)
+
+    def _stage_blobs(self, job_id: str, attempt: int,
+                     py_blobs: list) -> Optional[str]:
+        """Fetch job-code artifacts from the coordinator's blob store
+        and stage them into a per-attempt import dir (ref:
+        BlobCacheService + per-job classloader isolation: each attempt
+        gets its own view of the code, so a re-submission with changed
+        code cannot be shadowed by a stale cache entry). EVERY shipped
+        module name is dropped from sys.modules — popping just the entry
+        would leave its shipped imports (helper modules) cached from a
+        prior attempt. Returns the import dir; the caller removes it
+        from sys.path when the job ends. Known limit: sys.path is
+        process-global, so two CONCURRENT jobs shipping the same module
+        name can still cross-import — full isolation needs per-job
+        processes (the per-job classloader analogue)."""
+        if not py_blobs:
+            return None
+        import os
+        import sys
+
+        from flink_tpu.runtime.blob import BlobCache
+
+        if getattr(self, "_blob_cache", None) is None:
+            self._blob_cache = BlobCache(self._coord)
+        jobdir = os.path.join(self._blob_cache.dir,
+                              f"job-{job_id}-a{attempt}")
+        for b in py_blobs:
+            self._blob_cache.materialize(b["digest"], jobdir, b["name"])
+            if b["name"].endswith(".py"):
+                sys.modules.pop(b["name"][:-3], None)
+        if jobdir not in sys.path:
+            sys.path.insert(0, jobdir)
+        return jobdir
 
     def _report_plan(self, job_id: str, env) -> None:
         """Report the compiled plan's stages so the coordinator's
@@ -251,12 +338,27 @@ def main(argv: Optional[list] = None) -> None:
     import argparse
 
     p = argparse.ArgumentParser(description="flink_tpu task runner")
-    p.add_argument("--coordinator", required=True, metavar="HOST:PORT")
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT")
+    p.add_argument("--ha-dir", default=None,
+                   help="resolve the coordinator via the HA leader "
+                        "lease instead of a fixed address")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--runner-id", default=None)
     args = p.parse_args(argv)
-    host, _, port = args.coordinator.partition(":")
-    runner = TaskRunner(host, int(port), runner_id=args.runner_id)
+    addr = args.coordinator
+    if addr is None:
+        if not args.ha_dir:
+            p.error("one of --coordinator or --ha-dir is required")
+        from flink_tpu.runtime.ha import leader_address
+
+        deadline = time.time() + 60
+        while (addr := leader_address(args.ha_dir)) is None:
+            if time.time() > deadline:
+                raise SystemExit("no leader found in --ha-dir within 60s")
+            time.sleep(0.5)
+    host, _, port = addr.partition(":")
+    runner = TaskRunner(host, int(port), runner_id=args.runner_id,
+                        ha_dir=args.ha_dir)
     gateway = runner.start(args.port)
     print(f"runner {runner.runner_id} gateway on :{gateway}", flush=True)
     try:

@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Dict, List, Optional
 
 __all__ = ["Execution", "ExecutionVertex", "ExecutionGraph", "SlotPool"]
 
@@ -68,10 +68,18 @@ class ExecutionGraph:
         self._materialize()
 
     def _materialize(self) -> None:
-        prev: Dict[tuple, List[Execution]] = {
-            (v.stage, v.subtask): v.executions for v in self.vertices}
         self.vertices = [
-            ExecutionVertex(s, i, prev.get((s, i), []))
+            ExecutionVertex(s, i)
+            for s in self.stages for i in range(self.parallelism)]
+
+    def set_parallelism(self, parallelism: int) -> None:
+        """Re-width the graph once a demand of 'all devices' resolves
+        against the chosen runner; current attempt history carries over
+        onto every vertex (one SPMD program is every subtask)."""
+        history = self.vertices[0].executions if self.vertices else []
+        self.parallelism = max(1, parallelism)
+        self.vertices = [
+            ExecutionVertex(s, i, [dataclasses.replace(e) for e in history])
             for s in self.stages for i in range(self.parallelism)]
 
     def set_stages(self, stages: List[str]) -> None:

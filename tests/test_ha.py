@@ -1,0 +1,226 @@
+"""HA services: leader election on a shared directory + job store
+recovery by a replacement coordinator (ref: ZooKeeperLeaderElection /
+JobGraphStore / Dispatcher.recoverJobs)."""
+import time
+
+import pytest
+
+from flink_tpu.config import Configuration
+from flink_tpu.runtime.coordinator import start_coordinator
+from flink_tpu.runtime.ha import JobStore, LeaderElection, leader_address
+from flink_tpu.runtime.rpc import RpcClient, RpcEndpoint, RpcServer
+
+
+class TestLeaderElection:
+    def test_single_winner_and_address(self, tmp_path):
+        d = str(tmp_path)
+        a = LeaderElection(d, "127.0.0.1:1111", lease_timeout_s=0.5)
+        b = LeaderElection(d, "127.0.0.1:2222", lease_timeout_s=0.5)
+        try:
+            a.start(); b.start()
+            deadline = time.time() + 5
+            while time.time() < deadline and not (a.is_leader or b.is_leader):
+                time.sleep(0.02)
+            assert a.is_leader != b.is_leader  # exactly one
+            leader = a if a.is_leader else b
+            assert leader_address(d) == leader.address
+        finally:
+            a.close(); b.close()
+
+    def test_takeover_on_stale_lease(self, tmp_path):
+        d = str(tmp_path)
+        a = LeaderElection(d, "127.0.0.1:1111", lease_timeout_s=0.4)
+        try:
+            a.start()
+            deadline = time.time() + 5
+            while time.time() < deadline and not a.is_leader:
+                time.sleep(0.02)
+            assert a.is_leader
+            epoch1 = a.epoch
+            # incumbent dies WITHOUT cleanup (thread stops renewing)
+            a._closed = True
+            a._thread.join(timeout=2)
+            b = LeaderElection(d, "127.0.0.1:2222", lease_timeout_s=0.4)
+            try:
+                b.start()
+                deadline = time.time() + 5
+                while time.time() < deadline and not b.is_leader:
+                    time.sleep(0.02)
+                assert b.is_leader
+                assert b.epoch > epoch1  # fencing token advanced
+                assert leader_address(d) == "127.0.0.1:2222"
+            finally:
+                b.close()
+        finally:
+            a.close()
+
+    def test_clean_release_hands_over_fast(self, tmp_path):
+        d = str(tmp_path)
+        a = LeaderElection(d, "127.0.0.1:1111", lease_timeout_s=5.0)
+        a.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not a.is_leader:
+            time.sleep(0.02)
+        a.close()  # removes the lease
+        b = LeaderElection(d, "127.0.0.1:2222", lease_timeout_s=5.0)
+        try:
+            b.start()
+            deadline = time.time() + 5
+            while time.time() < deadline and not b.is_leader:
+                time.sleep(0.02)
+            assert b.is_leader  # no need to wait out the 5s timeout
+        finally:
+            b.close()
+
+
+class TestJobStore:
+    def test_roundtrip_and_recoverable_filter(self, tmp_path):
+        s = JobStore(str(tmp_path))
+        s.put("a", entry="m:f", config={"x": 1}, state="RUNNING", attempts=1)
+        s.put("b", entry="m:g", config={}, state="FINISHED", attempts=1)
+        s.put("c", entry=None, config={}, state="RUNNING", attempts=1)
+        assert s.get("a")["config"] == {"x": 1}
+        rec = s.recoverable()
+        assert [r["job_id"] for r in rec] == ["a"]
+        s.remove("a")
+        assert s.recoverable() == []
+
+
+class _FakeRunnerGateway(RpcEndpoint):
+    def __init__(self):
+        self.deployed = []
+
+    def rpc_run_job(self, job_id, entry, config=None, attempt=1):
+        self.deployed.append((job_id, attempt, dict(config or {})))
+        return {"accepted": True}
+
+    def rpc_cancel_job(self, job_id):
+        return {"ok": True}
+
+
+class TestCoordinatorFailover:
+    def test_new_coordinator_recovers_and_redeploys(self, tmp_path):
+        ha = str(tmp_path)
+        conf = Configuration({"high-availability.dir": ha})
+        # coordinator A accepts the job, deploys it, then dies
+        srv_a = start_coordinator(conf)
+        gw = RpcServer(_FakeRunnerGateway())
+        try:
+            c = RpcClient("127.0.0.1", srv_a.port)
+            c.call("register_runner", runner_id="r1", host="127.0.0.1",
+                   n_devices=4, port=gw.port)
+            c.call("submit_job", job_id="j", entry="mod:build",
+                   config={"cluster.mesh-devices": "2"})
+            deadline = time.time() + 5
+            while time.time() < deadline and not gw.endpoint.deployed:
+                time.sleep(0.02)
+            assert gw.endpoint.deployed[0][:2] == ("j", 1)
+            c.close()
+        finally:
+            srv_a.close()
+
+        # coordinator B on the same HA dir: recovers the job, and when
+        # the runner re-registers, re-deploys with restore:latest
+        srv_b = start_coordinator(Configuration({
+            "high-availability.dir": ha}))
+        try:
+            c = RpcClient("127.0.0.1", srv_b.port)
+            st = c.call("job_status", job_id="j")
+            assert st["state"] == "WAITING_FOR_RESOURCES"
+            assert st["attempts"] == 2
+            c.call("register_runner", runner_id="r1", host="127.0.0.1",
+                   n_devices=4, port=gw.port)
+            deadline = time.time() + 5
+            while time.time() < deadline and len(gw.endpoint.deployed) < 2:
+                time.sleep(0.02)
+            job_id, attempt, config = gw.endpoint.deployed[1]
+            assert job_id == "j" and attempt == 2
+            assert config.get("execution.checkpointing.restore") == "latest"
+            # terminal state persists: finishing removes recoverability
+            c.call("finish_job", job_id="j")
+            assert JobStore(ha).recoverable() == []
+            c.close()
+        finally:
+            srv_b.close()
+            gw.close()
+
+
+class TestRevokeAndFollow:
+    def test_revoke_fires_when_lease_stolen(self, tmp_path):
+        d = str(tmp_path)
+        a = LeaderElection(d, "127.0.0.1:1111", lease_timeout_s=0.4)
+        revoked = []
+        a.on_revoke = lambda: revoked.append(True)
+        try:
+            a.start()
+            deadline = time.time() + 5
+            while time.time() < deadline and not a.is_leader:
+                time.sleep(0.02)
+            # simulate a contender stealing the lease out from under A
+            import json as _json
+            import os as _os
+
+            lease = _os.path.join(d, "leader.lease")
+            with open(lease + ".x", "w") as f:
+                _json.dump({"leader_id": "other", "address": "h:1",
+                            "epoch": 9, "claimed_at": time.time()}, f)
+            _os.replace(lease + ".x", lease)
+            deadline = time.time() + 5
+            while time.time() < deadline and not revoked:
+                time.sleep(0.02)
+            assert revoked and not a.is_leader
+        finally:
+            a.close()
+
+    def test_runner_follows_new_leader(self, tmp_path):
+        """Heartbeat misses against a dead leader make the runner
+        re-resolve the lease and register with the new one."""
+        import json as _json
+        import os as _os
+
+        from flink_tpu.runtime.runner import TaskRunner
+
+        ha = str(tmp_path)
+        srv_a = start_coordinator(Configuration({
+            "high-availability.dir": ha, "heartbeat.interval": 100}))
+        # lease file points at A
+        with open(_os.path.join(ha, "leader.lease"), "w") as f:
+            _json.dump({"leader_id": "A",
+                        "address": f"127.0.0.1:{srv_a.port}",
+                        "epoch": 1, "claimed_at": time.time()}, f)
+        runner = TaskRunner("127.0.0.1", srv_a.port, runner_id="fr1",
+                            ha_dir=ha)
+        try:
+            runner.start()
+            assert "fr1" in RpcClient("127.0.0.1", srv_a.port).call(
+                "list_runners")
+            # A dies; B takes over with a new lease
+            srv_a.close()
+            srv_b = start_coordinator(Configuration({
+                "high-availability.dir": ha}))
+            with open(_os.path.join(ha, "leader.lease"), "w") as f:
+                _json.dump({"leader_id": "B",
+                            "address": f"127.0.0.1:{srv_b.port}",
+                            "epoch": 2, "claimed_at": time.time()}, f)
+            try:
+                c = RpcClient("127.0.0.1", srv_b.port)
+                # follow latency: 2 heartbeat misses x 5s client timeout
+                deadline = time.time() + 30
+                while time.time() < deadline:
+                    if "fr1" in c.call("list_runners"):
+                        break
+                    time.sleep(0.2)
+                assert "fr1" in c.call("list_runners")
+                c.close()
+            finally:
+                srv_b.close()
+        finally:
+            runner.close()
+
+    def test_terminal_put_archives(self, tmp_path):
+        s = JobStore(str(tmp_path))
+        s.put("j", entry="m:f", config={}, state="RUNNING", attempts=1)
+        assert [r["job_id"] for r in s.recoverable()] == ["j"]
+        s.put("j", entry="m:f", config={}, state="FINISHED", attempts=1)
+        assert s.recoverable() == []
+        assert s.get("j")["state"] == "FINISHED"  # archived, still readable
