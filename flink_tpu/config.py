@@ -220,6 +220,14 @@ class PipelineOptions:
         "-1 = auto: 0 on CPU hosts (device→host is a memcpy), 100ms on "
         "accelerator backends. A checkpoint barrier or end-of-input "
         "flush overrides the deferral immediately.")
+    TARGET_LATENCY = duration_option(
+        "pipeline.target-latency", 0,
+        "Adaptive microbatch debloater (ref: BufferDebloater — auto-"
+        "size in-flight buffers to hit a latency target): when > 0, the "
+        "driver re-chunks source batches at ingest, halving the chunk "
+        "while recent emit p99 exceeds the target and growing it back "
+        "toward the source batch size while p99 sits under half the "
+        "target. 0 = off (source batch size rules, maximum throughput).")
 
 
 class CoreOptions:

@@ -77,6 +77,16 @@ class Histogram:
         s = self._samples()
         return float(np.quantile(s, q)) if len(s) else 0.0
 
+    def quantile_recent(self, q: float, window: int = 32) -> float:
+        """Quantile over the newest ``window`` samples — control loops
+        (the batch debloater) steer on recent behavior, not the whole
+        reservoir's history."""
+        n = min(self._n, len(self._buf), window)
+        if n == 0:
+            return 0.0
+        ix = (np.arange(self._n - n, self._n)) % len(self._buf)
+        return float(np.quantile(self._buf[ix], q))
+
     @property
     def count(self) -> int:
         return self._n

@@ -118,6 +118,17 @@ class RestServer:
                     return 200, {"job_id": parts[1], **st}
                 if parts == ["taskmanagers"]:
                     return 200, {"taskmanagers": self._call("list_runners")}
+                if parts == ["traces"]:
+                    from flink_tpu.obs.tracing import tracer
+
+                    prefix = q.get("name", [""])[0]
+                    return 200, {"spans": tracer.spans(prefix)}
+                if parts == ["flamegraph"]:
+                    from flink_tpu.obs.tracing import sample_threads
+
+                    seconds = min(float(q.get("seconds", ["1"])[0]), 10.0)
+                    hz = min(float(q.get("hz", ["50"])[0]), 200.0)
+                    return 200, sample_threads(seconds, hz)
                 return 404, {"error": f"no route {u.path}"}
             if method == "PATCH" and len(parts) == 2 and parts[0] == "jobs":
                 mode = q.get("mode", ["cancel"])[0]

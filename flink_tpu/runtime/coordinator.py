@@ -629,6 +629,10 @@ def main(argv: Optional[list] = None) -> None:
             print("leadership revoked; closing", flush=True)
             if rest is not None:
                 rest.close()
+            # close the ENDPOINT too: its monitor thread must stop
+            # writing to the shared job store the new leader now owns
+            # (the split-brain this loop exists to prevent)
+            server.endpoint.close()
             server.close()
     except KeyboardInterrupt:
         election.close()

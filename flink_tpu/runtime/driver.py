@@ -82,6 +82,17 @@ class Driver:
         self._eps_meter = g.meter("records_per_sec")
         self._lat_hist = g.histogram("emit_latency_ms")
         self._wm_lag = g.gauge("watermark_lag_ms")
+        # adaptive microbatch debloater (ref: BufferDebloater): when a
+        # latency target is set, ingest re-chunks source batches; the
+        # chunk halves while recent emit p99 overshoots the target and
+        # regrows while it sits under half of it
+        from flink_tpu.config import PipelineOptions as _PO
+
+        self._debloat_target = float(config.get(_PO.TARGET_LATENCY))
+        self._debloat_chunk: Optional[int] = None
+        self._debloat_min = 4096
+        g.gauge("debloat_chunk",
+                lambda: float(self._debloat_chunk or 0))
         # per-phase wall-time accumulators (seconds) for the ingest loop
         # and drain thread — merged into JobResult as profile.* so perf
         # work is steered by measurement (PROFILE.md), not vibes
@@ -394,6 +405,41 @@ class Driver:
         pend.is_savepoint = savepoint
         return pend
 
+    def _debloat_split(self, data, ts):
+        """Re-chunk one source batch to the debloater's current chunk
+        size (no-op generator when the debloater is off or the batch
+        already fits). Slicing preserves record order, so watermark
+        semantics are untouched — the generators see the same max ts."""
+        n = len(ts)
+        chunk = self._debloat_chunk
+        if self._debloat_target <= 0 or chunk is None or n <= chunk:
+            if self._debloat_target > 0 and self._debloat_chunk is None and n:
+                self._debloat_chunk = n  # seed at the source batch size
+                # (empty first batches — unbounded sources idling — must
+                # not seed a zero chunk)
+            yield data, ts
+            return
+        chunk = max(1, chunk)
+        for lo in range(0, n, chunk):
+            hi = min(lo + chunk, n)
+            yield ({k: v[lo:hi] for k, v in data.items()}, ts[lo:hi])
+
+    def _debloat_adjust(self) -> None:
+        """One control step (ref: BufferDebloater.recalculateBufferSize):
+        recent emit p99 > target → halve the chunk; p99 < target/2 →
+        grow 2x (cap: whatever the source produces — _debloat_split
+        never merges). Needs a few fresh samples to act."""
+        if self._debloat_target <= 0 or self._debloat_chunk is None:
+            return
+        if self._lat_hist.count < 2:
+            return
+        p99 = self._lat_hist.quantile_recent(0.99, window=16)
+        if p99 > self._debloat_target:
+            self._debloat_chunk = max(self._debloat_min,
+                                      self._debloat_chunk // 2)
+        elif p99 < self._debloat_target / 2:
+            self._debloat_chunk *= 2
+
     def _maybe_take_savepoint(self) -> None:
         """Operator-triggered savepoint (CLI `savepoint`): synchronous +
         retained, at a batch boundary; the completed path is pushed to
@@ -600,24 +646,28 @@ class Driver:
                         continue
                     data, ts = nxt
                     ts = np.asarray(ts, np.int64)
-                    valid = np.ones(len(ts), bool)
-                    # yield the transport to a drain fetch in progress
-                    # (see _link_lock): blocks only while one is active
-                    with self._link_lock:
-                        pass
-                    t2 = time.perf_counter()
-                    prof["link_lock_wait"] += t2 - t1
-                    with self._push_lock:
-                        self.metrics["records_in"] += len(ts)
-                        self.metrics["batches"] += 1
-                        self._push_downstream(sid, (dict(data), ts, valid))
-                    # backpressure wait OUTSIDE the lock: the drain
-                    # thread must be able to deliver while ingest blocks
-                    # on the device pipeline
-                    for op in self._ops.values():
-                        if hasattr(op, "throttle"):
-                            op.throttle()
-                    prof["push"] += time.perf_counter() - t2
+                    for data_c, ts_c in self._debloat_split(data, ts):
+                        valid = np.ones(len(ts_c), bool)
+                        # yield the transport to a drain fetch in
+                        # progress (see _link_lock): blocks only while
+                        # one is active
+                        with self._link_lock:
+                            pass
+                        t2 = time.perf_counter()
+                        prof["link_lock_wait"] += t2 - t1
+                        with self._push_lock:
+                            self.metrics["records_in"] += len(ts_c)
+                            self.metrics["batches"] += 1
+                            self._push_downstream(
+                                sid, (dict(data_c), ts_c, valid))
+                        # backpressure wait OUTSIDE the lock: the drain
+                        # thread must be able to deliver while ingest
+                        # blocks on the device pipeline
+                        for op in self._ops.values():
+                            if hasattr(op, "throttle"):
+                                op.throttle()
+                        prof["push"] += time.perf_counter() - t2
+                        t1 = time.perf_counter()
                     self._positions[sid][split_ix] += 1
                     self._eps_meter.mark(len(ts))
                     if len(ts):
@@ -638,6 +688,7 @@ class Driver:
                     self._propagate_watermarks()
                 prof["advance_wm"] += time.perf_counter() - t3
                 self._check_drain_error()
+            self._debloat_adjust()
             # operator-triggered savepoint (CLI `savepoint` command):
             # synchronous + retained, at this batch boundary
             self._maybe_take_savepoint()

@@ -102,6 +102,25 @@ class LeaderElection:
         except OSError:
             return float("inf")
 
+    @property
+    def _hwm_path(self) -> str:
+        return os.path.join(self.ha_dir, "epoch.hwm")
+
+    def _epoch_hwm(self) -> int:
+        try:
+            with open(self._hwm_path) as f:
+                return int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            return 0
+
+    def _record_hwm(self, epoch: int) -> None:
+        if epoch <= self._epoch_hwm():
+            return
+        tmp = self._hwm_path + f".{self.leader_id}.tmp"
+        with open(tmp, "w") as f:
+            f.write(str(epoch))
+        os.replace(tmp, self._hwm_path)
+
     # -- contender loop -------------------------------------------------
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -110,38 +129,54 @@ class LeaderElection:
     def _run(self) -> None:
         poll = max(self.lease_timeout_s / 4, 0.05)
         while not self._closed:
-            if self.is_leader:
-                cur = self._read()
-                if cur is None or cur.leader_id != self.leader_id:
-                    # someone stole the lease (we stalled past timeout)
-                    self.is_leader = False
-                    if self.on_revoke:
-                        self.on_revoke()
-                else:
-                    os.utime(self._lease)  # renew
-            else:
-                cur = self._read()
-                if cur is None:
-                    got = self._write(LeaderRecord(
-                        self.leader_id, self.address, 1, time.time()),
-                        exclusive=True)
-                    if got:
-                        self._granted(1)
-                elif (cur.leader_id != self.leader_id
-                      and self._lease_age() > self.lease_timeout_s):
-                    # stale incumbent: steal with a higher epoch
-                    self._write(LeaderRecord(
-                        self.leader_id, self.address, cur.epoch + 1,
-                        time.time()), exclusive=False)
-                    # confirm we won the replace race
-                    again = self._read()
-                    if again and again.leader_id == self.leader_id:
-                        self._granted(again.epoch)
+            try:
+                self._contend_once()
+            except OSError:
+                # the HA dir is shared storage (NFS-class): transient
+                # ESTALE/EIO must not kill the contender thread — a dead
+                # thread never renews (undetected split-brain) and never
+                # contends again
+                pass
             time.sleep(poll)
+
+    def _contend_once(self) -> None:
+        if self.is_leader:
+            cur = self._read()
+            if cur is None or cur.leader_id != self.leader_id:
+                # someone stole the lease (we stalled past timeout)
+                self.is_leader = False
+                if self.on_revoke:
+                    self.on_revoke()
+            else:
+                os.utime(self._lease)  # renew
+        else:
+            cur = self._read()
+            if cur is None:
+                # the fencing token must never regress: a fresh claim
+                # after a clean handover continues from the recorded
+                # high-water mark, not from 1
+                epoch = self._epoch_hwm() + 1
+                got = self._write(LeaderRecord(
+                    self.leader_id, self.address, epoch, time.time()),
+                    exclusive=True)
+                if got:
+                    self._granted(epoch)
+            elif (cur.leader_id != self.leader_id
+                  and self._lease_age() > self.lease_timeout_s):
+                # stale incumbent: steal with a higher epoch
+                self._write(LeaderRecord(
+                    self.leader_id, self.address,
+                    max(cur.epoch, self._epoch_hwm()) + 1,
+                    time.time()), exclusive=False)
+                # confirm we won the replace race
+                again = self._read()
+                if again and again.leader_id == self.leader_id:
+                    self._granted(again.epoch)
 
     def _granted(self, epoch: int) -> None:
         self.is_leader = True
         self.epoch = epoch
+        self._record_hwm(epoch)
         if self.on_grant:
             self.on_grant(epoch)
 

@@ -151,6 +151,11 @@ class TaskRunner(RpcEndpoint):
         old = self._coord
         self._coord_addr = (host, int(port))
         self._coord = new
+        # the blob cache captured the old client at first fetch — point
+        # it at the new leader (its store shares the durable HA dir)
+        cache = getattr(self, "_blob_cache", None)
+        if cache is not None:
+            cache._coord = new
         try:
             old.close()
         except OSError:

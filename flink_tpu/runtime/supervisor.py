@@ -24,8 +24,11 @@ def run_with_recovery(
     StreamExecutionEnvironment (sources/sinks re-created per attempt —
     the redeploy step). First attempt starts fresh (or per config
     restore); every retry restores from the latest checkpoint."""
+    from flink_tpu.obs.tracing import tracer
+
     strategy = from_config(config)
     attempt_conf = config
+    attempt = 1
     while True:
         env = build_env(attempt_conf)
         try:
@@ -34,6 +37,13 @@ def run_with_recovery(
             if not strategy.can_restart():
                 raise
             delay = strategy.next_delay_ms()
-            sleep_fn(delay / 1000.0)
+            # recovery span: failure → backoff → redeployed (the restore
+            # itself is the 'restore' span inside the next execute; ref:
+            # job recovery spans, SURVEY §6.1)
+            attempt += 1
+            with tracer.span("recovery", job=job_name, attempt=attempt,
+                             delay_ms=delay,
+                             error=f"{type(e).__name__}: {e}"):
+                sleep_fn(delay / 1000.0)
             attempt_conf = Configuration(config.to_dict()).set(
                 "execution.checkpointing.restore", "latest")

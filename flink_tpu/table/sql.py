@@ -41,7 +41,16 @@ import dataclasses
 import re
 from typing import Any, List, Optional, Tuple
 
-from flink_tpu.table import api as tapi
+from flink_tpu.table.api import (
+    _AGG_FACTORIES,
+    AggCall,
+    Hop,
+    Session,
+    Table,
+    TableEnvironment,
+    Tumble,
+    finish_projection,
+)
 from flink_tpu.table.expressions import BinOp, Col, Expression, Lit, UnaryOp
 
 __all__ = ["SqlError", "plan_sql", "parse"]
@@ -211,7 +220,7 @@ class _Parser:
             return SelectItem(None, None, None, star=True)
         t = self.peek()
         if (t and t.kind == "ident"
-                and t.text.lower() in tapi._AGG_FACTORIES
+                and t.text.lower() in _AGG_FACTORIES
                 and self.i + 1 < len(self.toks)
                 and self.toks[self.i + 1].text == "("):
             fn = self.next().text.lower()
@@ -362,7 +371,7 @@ def parse(sql: str) -> Query:
 # Planner: Query AST -> Table pipeline
 # ---------------------------------------------------------------------------
 
-def plan_sql(t_env: "tapi.TableEnvironment", sql: str) -> "tapi.Table":
+def plan_sql(t_env: "TableEnvironment", sql: str) -> "Table":
     q = parse(sql)
 
     # resolve source
@@ -370,12 +379,12 @@ def plan_sql(t_env: "tapi.TableEnvironment", sql: str) -> "tapi.Table":
         base = t_env.table(q.source.table)
         iv = q.source.intervals
         if q.source.kind == "tumble":
-            wdef = tapi.Tumble.over_ms(iv[0])
+            wdef = Tumble.over_ms(iv[0])
         elif q.source.kind == "hop":
             # FLIP-145 HOP argument order: (slide, size)
-            wdef = tapi.Hop.of_ms(size_ms=iv[1], slide_ms=iv[0])
+            wdef = Hop.of_ms(size_ms=iv[1], slide_ms=iv[0])
         else:
-            wdef = tapi.Session.with_gap_ms(iv[0])
+            wdef = Session.with_gap_ms(iv[0])
         wdef = wdef.on(q.source.time_col)
     else:
         base = t_env.table(q.source)
@@ -415,8 +424,8 @@ def plan_sql(t_env: "tapi.TableEnvironment", sql: str) -> "tapi.Table":
     return table.select(*sels)
 
 
-def _plan_aggregate(q: Query, table: "tapi.Table",
-                    wdef) -> "tapi.Table":
+def _plan_aggregate(q: Query, table: "Table",
+                    wdef) -> "Table":
     if wdef is None:
         raise SqlError(
             "aggregate queries need a window TVF source — "
@@ -431,7 +440,7 @@ def _plan_aggregate(q: Query, table: "tapi.Table",
             f"{group_cols}")
 
     # build agg calls with output names
-    calls: List[tapi.AggCall] = []
+    calls: List[AggCall] = []
     plain: List[str] = []
     for it in q.items:
         if it.star:
@@ -439,7 +448,7 @@ def _plan_aggregate(q: Query, table: "tapi.Table",
         if it.agg is not None:
             fn, arg = it.agg
             default = fn if fn == "count" else f"{fn}_{arg}"
-            calls.append(tapi.AggCall(fn, arg, it.alias or default))
+            calls.append(AggCall(fn, arg, it.alias or default))
         else:
             e = it.expr
             if not isinstance(e, Col):
@@ -488,7 +497,7 @@ def _plan_aggregate(q: Query, table: "tapi.Table",
                 "ORDER BY ... DESC LIMIT n is not supported over "
                 "SESSION windows in v1 (TUMBLE/HOP only)")
         topped = agg_stream.top(q.limit, by=by_call.runtime_field)
-        return tapi.finish_projection(
+        return finish_projection(
             table.t_env, topped, pairs, key_out, want)
 
     result = gt.aggregate(*calls)

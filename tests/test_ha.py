@@ -224,3 +224,22 @@ class TestRevokeAndFollow:
         s.put("j", entry="m:f", config={}, state="FINISHED", attempts=1)
         assert s.recoverable() == []
         assert s.get("j")["state"] == "FINISHED"  # archived, still readable
+
+    def test_epoch_never_regresses_after_clean_handover(self, tmp_path):
+        d = str(tmp_path)
+        a = LeaderElection(d, "127.0.0.1:1111", lease_timeout_s=0.3)
+        a.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not a.is_leader:
+            time.sleep(0.02)
+        e1 = a.epoch
+        a.close()  # clean handover (removes lease)
+        b = LeaderElection(d, "127.0.0.1:2222", lease_timeout_s=0.3)
+        try:
+            b.start()
+            deadline = time.time() + 5
+            while time.time() < deadline and not b.is_leader:
+                time.sleep(0.02)
+            assert b.epoch > e1  # fencing token monotone across handover
+        finally:
+            b.close()
