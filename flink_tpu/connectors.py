@@ -1,0 +1,204 @@
+"""File connectors: format-aware FileSource + exactly-once FileSink.
+
+ref: flink-connectors/flink-connector-files — ``FileSource`` (FLIP-27
+splits: one split per file, replayable positions) and ``FileSink``
+(part files staged in-progress, visible on checkpoint commit; the
+rename-on-commit discipline of SURVEY §3.9). Formats plug in via
+``flink_tpu.formats.Format``; paths go through the FileSystem
+abstraction, so any registered scheme works.
+"""
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import os
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.api.sinks import Sink
+from flink_tpu.api.sources import Source
+from flink_tpu.formats import Format
+from flink_tpu.fs import get_filesystem
+
+__all__ = ["FileSource", "FileSink"]
+
+Batch = Tuple[Dict[str, np.ndarray], np.ndarray]
+
+
+@dataclasses.dataclass
+class FileSource(Source):
+    """One split per matching file; positions are batch indices within
+    the split (replay restarts the file and skips already-consumed
+    batches — the same replay contract every source here honors).
+    ``ts_field`` names the event-time column (ms); absent, batches get
+    ingest-time stamps like TextLineSource."""
+
+    path: str                      # file, directory, or glob
+    format: Format
+    ts_field: Optional[str] = None
+    batch_size: int = 65536
+
+    def splits(self) -> List[str]:
+        fs = get_filesystem(self.path)
+        base = self.path
+        if fs.exists(base) and fs.is_dir(base):
+            return sorted(
+                os.path.join(base, f) for f in fs.listdir(base)
+                if not f.startswith("."))
+        if any(ch in base for ch in "*?["):
+            d, pat = os.path.split(base)
+            if not fs.exists(d):
+                return []
+            return sorted(
+                os.path.join(d, f) for f in fs.listdir(d)
+                if fnmatch.fnmatch(f, pat))
+        return [base] if fs.exists(base) else []
+
+    def open_split(self, split: str, start_pos: int = 0) -> Iterator[Batch]:
+        import time as _time
+
+        fs = get_filesystem(split)
+        with fs.open_read(split) as f:
+            raw = f.read()
+        if isinstance(raw, str):
+            raw = raw.encode("utf-8")
+        lines = raw.split(b"\n")
+        if lines and lines[-1] == b"":
+            lines.pop()
+        for index, lo in enumerate(range(0, len(lines), self.batch_size)):
+            if index < start_pos:
+                continue
+            block = b"\n".join(lines[lo:lo + self.batch_size]) + b"\n"
+            data = self.format.deserialize(block)
+            if self.ts_field is not None:
+                ts = np.asarray(data[self.ts_field], np.int64)
+            else:
+                now = np.int64(_time.time() * 1000)
+                ts = np.full(len(next(iter(data.values()), [])),
+                             now, np.int64)
+            yield data, ts
+
+    def bounded(self) -> bool:
+        return True
+
+
+class FileSink(Sink):
+    """Exactly-once, format-serialized part files. Rows buffer in
+    memory per epoch; ``prepare_commit`` writes+fsyncs a staged part
+    file, ``notify_checkpoint_complete`` atomically renames it into
+    ``committed/`` (the transaction point). Rolling: a staged epoch
+    splits into numbered part files every ``rolling_records`` rows, so
+    downstream consumers see bounded files (ref: FileSink's
+    RollingPolicy + the TwoPhaseCommitSinkFunction discipline; same
+    restore/abort contract as FileTransactionalSink — staged rows ride
+    the checkpoint so a cleaned-up attempt can reconstruct them)."""
+
+    def __init__(self, directory: str, format: Format,
+                 rolling_records: int = 1_000_000) -> None:
+        self.dir = directory
+        self.format = format
+        self.rolling_records = max(1, rolling_records)
+        self._fs = get_filesystem(directory)
+        self._staged_dir = os.path.join(directory, "staged")
+        self._committed_dir = os.path.join(directory, "committed")
+        self._fs.mkdirs(self._staged_dir)
+        self._fs.mkdirs(self._committed_dir)
+        self._pending: List[Dict[str, np.ndarray]] = []
+
+    # -- write path ------------------------------------------------------
+    def write(self, batch: Dict[str, np.ndarray]) -> None:
+        cols = {k: np.asarray(v) for k, v in batch.items()
+                if k in self.format.fields}
+        if cols and len(next(iter(cols.values()))):
+            self._pending.append(cols)
+
+    def _concat_pending(self) -> Optional[Dict[str, np.ndarray]]:
+        if not self._pending:
+            return None
+        out = {k: np.concatenate([b[k] for b in self._pending])
+               for k in self._pending[0]}
+        self._pending = []
+        return out
+
+    def _part_name(self, cid: int, part: int) -> str:
+        return f"part-{cid:010d}-{part:04d}"
+
+    def prepare_commit(self, checkpoint_id: int) -> None:
+        data = self._concat_pending()
+        if data is None:
+            return
+        n = len(next(iter(data.values())))
+        part = 0
+        for lo in range(0, n, self.rolling_records):
+            chunk = {k: v[lo:lo + self.rolling_records]
+                     for k, v in data.items()}
+            payload = self.format.serialize(chunk)
+            path = os.path.join(self._staged_dir,
+                                self._part_name(checkpoint_id, part))
+            tmp = path + ".tmp"
+            with self._fs.open_write(tmp) as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            self._fs.rename(tmp, path)
+            part += 1
+
+    # -- commit protocol -------------------------------------------------
+    def _staged_parts(self) -> List[Tuple[int, str]]:
+        out = []
+        for f in self._fs.listdir(self._staged_dir):
+            if f.startswith("part-") and not f.endswith(".tmp"):
+                out.append((int(f.split("-")[1]), f))
+        return sorted(out)
+
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        for cid, name in self._staged_parts():
+            if cid <= checkpoint_id:
+                src = os.path.join(self._staged_dir, name)
+                dst = os.path.join(self._committed_dir, name)
+                if self._fs.exists(dst):
+                    self._fs.delete(src)  # idempotent replayed commit
+                else:
+                    self._fs.rename(src, dst)
+
+    def snapshot_staged(self) -> Any:
+        """Staged part BYTES ride in the checkpoint (same rationale as
+        FileTransactionalSink: an aborted attempt may have deleted the
+        staged files; the covering checkpoint must reconstruct them)."""
+        parts = {}
+        for cid, name in self._staged_parts():
+            with self._fs.open_read(
+                    os.path.join(self._staged_dir, name)) as f:
+                raw = f.read()
+            parts[name] = raw if isinstance(raw, bytes) else raw.encode()
+        return {"parts": parts}
+
+    def restore_staged(self, staged: Any, checkpoint_id: int) -> None:
+        self._pending = []
+        for name, payload in (staged or {}).get("parts", {}).items():
+            path = os.path.join(self._staged_dir, name)
+            if self._fs.exists(path):
+                continue
+            tmp = path + ".tmp"
+            with self._fs.open_write(tmp) as f:
+                f.write(payload)
+            self._fs.rename(tmp, path)
+
+    def abort_uncommitted(self) -> None:
+        """Crash before the covering checkpoint: staged parts of the
+        dead attempt must never become visible."""
+        for _, name in self._staged_parts():
+            self._fs.delete(os.path.join(self._staged_dir, name))
+        self._pending = []
+
+    # -- reading back (tests / consumers) -------------------------------
+    def committed_batches(self) -> List[Dict[str, np.ndarray]]:
+        out = []
+        for name in sorted(self._fs.listdir(self._committed_dir)):
+            with self._fs.open_read(
+                    os.path.join(self._committed_dir, name)) as f:
+                raw = f.read()
+            out.append(self.format.deserialize(
+                raw if isinstance(raw, bytes) else raw.encode()))
+        return out

@@ -1,0 +1,151 @@
+"""Record formats: bytes ⇄ columnar batches.
+
+ref: flink-formats/* (csv/json (de)serialization schemas —
+``DeserializationSchema``/``SerializationSchema``, SURVEY §3.9) and the
+format half of flink-connector-files. TPU-first shape: a format's unit
+of work is a COLUMN BATCH, not a record — deserialization parses a
+whole block of lines into fixed-dtype numpy columns in one pass (the
+native C codec when every column is i64/f32 — SURVEY §3.10 item 2),
+because per-record Python objects never touch the device path.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["Format", "CsvFormat", "JsonLinesFormat"]
+
+Batch = Dict[str, np.ndarray]
+
+
+class Format:
+    """(De)serialization schema seam. ``fields`` names the columns in
+    order; deserialize parses a text block; serialize renders a batch
+    back to bytes (the sink half)."""
+
+    fields: Tuple[str, ...]
+
+    def deserialize(self, data: bytes) -> Batch:  # pragma: no cover
+        raise NotImplementedError
+
+    def serialize(self, batch: Batch) -> bytes:  # pragma: no cover
+        raise NotImplementedError
+
+
+_DTYPES = {"i64": np.int64, "f32": np.float32, "str": object}
+
+
+@dataclasses.dataclass(frozen=True)
+class CsvFormat(Format):
+    """Delimited text ⇄ typed columns. ``schema`` is an ordered mapping
+    of column name → 'i64' | 'f32' | 'str'. All-i64 and all-f32 schemas
+    take the native single-pass parser; mixed schemas parse per column
+    in numpy (ref: flink-formats/flink-csv CsvRowDataDeserializationSchema)."""
+
+    schema: Tuple[Tuple[str, str], ...]
+    delimiter: str = ","
+
+    def __init__(self, schema, delimiter: str = ",") -> None:
+        object.__setattr__(self, "schema",
+                           tuple((n, t) for n, t in schema))
+        object.__setattr__(self, "delimiter", delimiter)
+        for _, t in self.schema:
+            if t not in _DTYPES:
+                raise ValueError(f"unknown column type {t!r} "
+                                 f"(i64/f32/str)")
+
+    @property
+    def fields(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.schema)
+
+    def deserialize(self, data: bytes) -> Batch:
+        from flink_tpu import native_codec
+
+        types = [t for _, t in self.schema]
+        names = [n for n, _ in self.schema]
+        ncols = len(names)
+        if all(t == "i64" for t in types):
+            table = native_codec.parse_i64_table(
+                data, ncols, delim=self.delimiter)
+            return {n: table[:, i].copy() for i, n in enumerate(names)}
+        if all(t == "f32" for t in types):
+            table = native_codec.parse_f32_table(
+                data, ncols, delim=self.delimiter)
+            return {n: table[:, i].copy() for i, n in enumerate(names)}
+        rows = [ln.split(self.delimiter)
+                for ln in data.decode("utf-8").splitlines() if ln]
+        out: Batch = {}
+        for i, (n, t) in enumerate(self.schema):
+            col = [r[i] if i < len(r) else "" for r in rows]
+            if t == "i64":
+                out[n] = np.array([int(c or 0) for c in col], np.int64)
+            elif t == "f32":
+                out[n] = np.array([float(c or 0) for c in col], np.float32)
+            else:
+                out[n] = np.array(col, dtype=object)
+        return out
+
+    def serialize(self, batch: Batch) -> bytes:
+        from flink_tpu import native_codec
+
+        names = self.fields
+        n = len(batch[names[0]]) if names else 0
+        types = [t for _, t in self.schema]
+        if all(t == "i64" for t in types):
+            table = np.stack(
+                [np.asarray(batch[c], np.int64) for c in names], axis=1)
+            return native_codec.encode_i64_rows(table, self.delimiter)
+        cols = [batch[c] for c in names]
+        lines = []
+        for i in range(n):
+            lines.append(self.delimiter.join(
+                str(col[i]) for col in cols))
+        return ("\n".join(lines) + ("\n" if lines else "")).encode()
+
+
+@dataclasses.dataclass(frozen=True)
+class JsonLinesFormat(Format):
+    """One JSON object per line ⇄ columns (ref: flink-formats/
+    flink-json JsonRowDataDeserializationSchema). ``schema`` as in
+    CsvFormat; missing keys fill the type's zero."""
+
+    schema: Tuple[Tuple[str, str], ...]
+
+    def __init__(self, schema) -> None:
+        object.__setattr__(self, "schema",
+                           tuple((n, t) for n, t in schema))
+
+    @property
+    def fields(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.schema)
+
+    def deserialize(self, data: bytes) -> Batch:
+        objs = [json.loads(ln) for ln in data.splitlines() if ln.strip()]
+        out: Batch = {}
+        for n, t in self.schema:
+            if t == "i64":
+                out[n] = np.array([int(o.get(n, 0)) for o in objs],
+                                  np.int64)
+            elif t == "f32":
+                out[n] = np.array([float(o.get(n, 0.0)) for o in objs],
+                                  np.float32)
+            else:
+                out[n] = np.array([str(o.get(n, "")) for o in objs],
+                                  dtype=object)
+        return out
+
+    def serialize(self, batch: Batch) -> bytes:
+        names = self.fields
+        n = len(batch[names[0]]) if names else 0
+        lines = []
+        for i in range(n):
+            row = {}
+            for name, t in self.schema:
+                v = batch[name][i]
+                row[name] = (int(v) if t == "i64"
+                             else float(v) if t == "f32" else str(v))
+            lines.append(json.dumps(row))
+        return ("\n".join(lines) + ("\n" if lines else "")).encode()

@@ -126,8 +126,9 @@ class RestServer:
                 if parts == ["flamegraph"]:
                     from flink_tpu.obs.tracing import sample_threads
 
-                    seconds = min(float(q.get("seconds", ["1"])[0]), 10.0)
-                    hz = min(float(q.get("hz", ["50"])[0]), 200.0)
+                    seconds = min(max(
+                        float(q.get("seconds", ["1"])[0]), 0.05), 10.0)
+                    hz = min(max(float(q.get("hz", ["50"])[0]), 1.0), 200.0)
                     return 200, sample_threads(seconds, hz)
                 return 404, {"error": f"no route {u.path}"}
             if method == "PATCH" and len(parts) == 2 and parts[0] == "jobs":

@@ -76,6 +76,11 @@ class BlobCache:
         self.dir = cache_dir or tempfile.mkdtemp(prefix="flink_tpu_blobcache_")
         os.makedirs(self.dir, exist_ok=True)
 
+    def rebind(self, coord_client) -> None:
+        """Point the cache at a new coordinator (leader failover) —
+        cached digests stay valid, only the fetch channel moves."""
+        self._coord = coord_client
+
     def fetch(self, digest: str) -> str:
         """Return a local path holding the blob's bytes (stored by
         digest — never by filename, so two versions of "job.py" cannot

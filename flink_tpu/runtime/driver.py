@@ -91,6 +91,7 @@ class Driver:
         self._debloat_target = float(config.get(_PO.TARGET_LATENCY))
         self._debloat_chunk: Optional[int] = None
         self._debloat_min = 4096
+        self._debloat_seen = 0  # histogram count at last control step
         g.gauge("debloat_chunk",
                 lambda: float(self._debloat_chunk or 0))
         # per-phase wall-time accumulators (seconds) for the ingest loop
@@ -431,8 +432,13 @@ class Driver:
         never merges). Needs a few fresh samples to act."""
         if self._debloat_target <= 0 or self._debloat_chunk is None:
             return
-        if self._lat_hist.count < 2:
+        # act only on FRESH samples: the ingest loop passes far more
+        # often than windows fire, and re-halving on the same stale
+        # window would pin the chunk at the floor after one slow burst
+        c = self._lat_hist.count
+        if c - self._debloat_seen < 2:
             return
+        self._debloat_seen = c
         p99 = self._lat_hist.quantile_recent(0.99, window=16)
         if p99 > self._debloat_target:
             self._debloat_chunk = max(self._debloat_min,
@@ -720,6 +726,23 @@ class Driver:
         if self._coordinator is not None and interval_ms > 0:
             self.checkpoint_now()  # final epoch commit for 2PC sinks
             # (completes any pending background checkpoint first)
+        else:
+            # bounded job WITHOUT checkpointing: transactional sinks
+            # still owe a final commit — end of input is the terminal
+            # barrier and the run either completes whole or replays
+            # whole, so commit-at-end preserves exactly-once (ref:
+            # StreamTask.endInput → final checkpoint committing
+            # pending transactions even with checkpointing disabled).
+            # The epoch id must not collide with ANY earlier run's ids
+            # in a reused sink directory (a replayed id silently drops
+            # this run's staged output as "already committed") — a ms
+            # timestamp is unique across runs and above any
+            # coordinator-numbered epoch.
+            final_epoch = int(time.time() * 1000)
+            for n in self.plan.nodes.values():
+                if n.kind == "sink" and hasattr(n.sink, "prepare_commit"):
+                    n.sink.prepare_commit(final_epoch)
+                    n.sink.notify_checkpoint_complete(final_epoch)
         self._emit_q.put(None)
         drain.join()
         self._emit_q = None

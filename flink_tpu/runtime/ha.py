@@ -110,8 +110,13 @@ class LeaderElection:
         try:
             with open(self._hwm_path) as f:
                 return int(f.read().strip() or 0)
-        except (OSError, ValueError):
-            return 0
+        except FileNotFoundError:
+            return 0  # genuinely never recorded
+        except ValueError:
+            return 0  # torn/garbage content: best effort
+        # any OTHER OSError (shared-fs ESTALE/EIO) propagates: claiming
+        # with a guessed epoch of 0 could REGRESS the fencing token —
+        # _run's guard retries the whole contention pass instead
 
     def _record_hwm(self, epoch: int) -> None:
         if epoch <= self._epoch_hwm():

@@ -155,7 +155,7 @@ class TaskRunner(RpcEndpoint):
         # it at the new leader (its store shares the durable HA dir)
         cache = getattr(self, "_blob_cache", None)
         if cache is not None:
-            cache._coord = new
+            cache.rebind(new)
         try:
             old.close()
         except OSError:

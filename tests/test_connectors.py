@@ -1,0 +1,231 @@
+"""Formats (csv/jsonlines) + file connectors: replayable FileSource,
+exactly-once FileSink with rolling parts, end-to-end incl. crash/resume
+(ref: flink-formats/* + flink-connector-files, SURVEY §3.9)."""
+import os
+
+import numpy as np
+import pytest
+
+from flink_tpu.config import Configuration
+from flink_tpu.connectors import FileSink, FileSource
+from flink_tpu.formats import CsvFormat, JsonLinesFormat
+
+
+class TestCsvFormat:
+    def test_i64_roundtrip_native(self):
+        f = CsvFormat([("a", "i64"), ("b", "i64")])
+        batch = {"a": np.array([1, -2, 3], np.int64),
+                 "b": np.array([10, 20, 30], np.int64)}
+        data = f.serialize(batch)
+        back = f.deserialize(data)
+        assert np.array_equal(back["a"], batch["a"])
+        assert np.array_equal(back["b"], batch["b"])
+
+    def test_f32_and_mixed(self):
+        f = CsvFormat([("x", "f32"), ("y", "f32")])
+        batch = {"x": np.array([1.5, 2.25], np.float32),
+                 "y": np.array([-0.5, 3.0], np.float32)}
+        back = f.deserialize(f.serialize(batch))
+        assert np.allclose(back["x"], batch["x"])
+        m = CsvFormat([("k", "i64"), ("name", "str"), ("v", "f32")])
+        back = m.deserialize(b"7,alpha,1.5\n8,beta,2.5\n")
+        assert back["k"].tolist() == [7, 8]
+        assert back["name"].tolist() == ["alpha", "beta"]
+        assert np.allclose(back["v"], [1.5, 2.5])
+
+    def test_bad_type_rejected(self):
+        with pytest.raises(ValueError, match="unknown column type"):
+            CsvFormat([("a", "u8")])
+
+
+class TestJsonLinesFormat:
+    def test_roundtrip_and_missing_keys(self):
+        f = JsonLinesFormat([("k", "i64"), ("v", "f32"), ("s", "str")])
+        batch = {"k": np.array([1, 2], np.int64),
+                 "v": np.array([0.5, 1.5], np.float32),
+                 "s": np.array(["x", "y"], dtype=object)}
+        back = f.deserialize(f.serialize(batch))
+        assert back["k"].tolist() == [1, 2]
+        assert back["s"].tolist() == ["x", "y"]
+        sparse = f.deserialize(b'{"k": 9}\n')
+        assert sparse["k"].tolist() == [9]
+        assert sparse["v"].tolist() == [0.0]
+
+
+class TestFileSource:
+    def _write(self, path, rows):
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(",".join(str(x) for x in r) + "\n")
+
+    def test_glob_splits_and_event_time(self, tmp_path):
+        self._write(tmp_path / "a.csv", [(1, 100), (2, 200)])
+        self._write(tmp_path / "b.csv", [(3, 300)])
+        src = FileSource(str(tmp_path / "*.csv"),
+                         CsvFormat([("k", "i64"), ("ts", "i64")]),
+                         ts_field="ts")
+        splits = src.splits()
+        assert [os.path.basename(s) for s in splits] == ["a.csv", "b.csv"]
+        batches = list(src.open_split(splits[0]))
+        assert len(batches) == 1
+        data, ts = batches[0]
+        assert data["k"].tolist() == [1, 2]
+        assert ts.tolist() == [100, 200]
+
+    def test_replay_position_skips_consumed_batches(self, tmp_path):
+        rows = [(i, i * 10) for i in range(10)]
+        self._write(tmp_path / "x.csv", rows)
+        src = FileSource(str(tmp_path / "x.csv"),
+                         CsvFormat([("k", "i64"), ("ts", "i64")]),
+                         ts_field="ts", batch_size=4)
+        all_batches = list(src.open_split(str(tmp_path / "x.csv")))
+        assert [len(t) for _, t in all_batches] == [4, 4, 2]
+        resumed = list(src.open_split(str(tmp_path / "x.csv"), start_pos=2))
+        assert len(resumed) == 1
+        assert resumed[0][0]["k"].tolist() == [8, 9]
+
+    def test_directory_source(self, tmp_path):
+        d = tmp_path / "input"
+        d.mkdir()
+        self._write(d / "0001", [(5, 1)])
+        src = FileSource(str(d), CsvFormat([("k", "i64"), ("ts", "i64")]))
+        assert len(src.splits()) == 1
+
+
+class TestFileSink:
+    def test_rolling_parts_and_commit(self, tmp_path):
+        f = CsvFormat([("k", "i64"), ("c", "i64")])
+        sink = FileSink(str(tmp_path), f, rolling_records=2)
+        sink.write({"k": np.arange(5, dtype=np.int64),
+                    "c": np.arange(5, dtype=np.int64) * 10})
+        sink.prepare_commit(1)
+        staged = os.listdir(tmp_path / "staged")
+        assert len(staged) == 3  # 2+2+1 rows
+        assert os.listdir(tmp_path / "committed") == []
+        sink.notify_checkpoint_complete(1)
+        assert os.listdir(tmp_path / "staged") == []
+        got = sink.committed_batches()
+        ks = np.concatenate([b["k"] for b in got])
+        assert sorted(ks.tolist()) == [0, 1, 2, 3, 4]
+
+    def test_abort_discards_staged(self, tmp_path):
+        f = CsvFormat([("k", "i64")])
+        sink = FileSink(str(tmp_path), f)
+        sink.write({"k": np.array([1, 2], np.int64)})
+        sink.prepare_commit(1)
+        sink.abort_uncommitted()
+        assert os.listdir(tmp_path / "staged") == []
+        sink.notify_checkpoint_complete(1)
+        assert sink.committed_batches() == []
+
+    def test_snapshot_restore_reconstructs_staged(self, tmp_path):
+        f = CsvFormat([("k", "i64")])
+        sink = FileSink(str(tmp_path), f)
+        sink.write({"k": np.array([7], np.int64)})
+        sink.prepare_commit(3)
+        snap = sink.snapshot_staged()
+        sink.abort_uncommitted()  # crash cleanup deleted the files
+        sink2 = FileSink(str(tmp_path), f)
+        sink2.restore_staged(snap, 3)
+        sink2.notify_checkpoint_complete(3)
+        got = sink2.committed_batches()
+        assert len(got) == 1 and got[0]["k"].tolist() == [7]
+
+
+class TestEndToEnd:
+    def test_csv_in_window_csv_out(self, tmp_path):
+        rng = np.random.default_rng(0)
+        n = 5000
+        ts = np.sort(rng.integers(0, 10_000, n))
+        keys = rng.integers(0, 8, n)
+        inp = tmp_path / "in.csv"
+        with open(inp, "w") as f:
+            for k, t in zip(keys, ts):
+                f.write(f"{k},{t}\n")
+
+        from flink_tpu.api.environment import StreamExecutionEnvironment
+        from flink_tpu.api.windowing import TumblingEventTimeWindows
+        from flink_tpu.time.watermarks import WatermarkStrategy
+
+        env = StreamExecutionEnvironment(Configuration({
+            "state.num-key-shards": 8, "state.slots-per-shard": 16}))
+        src = FileSource(str(inp), CsvFormat([("k", "i64"), ("ts", "i64")]),
+                         ts_field="ts", batch_size=1000)
+        out_fmt = CsvFormat([("key", "i64"), ("window_end", "i64"),
+                             ("count", "i64")])
+        sink = FileSink(str(tmp_path / "out"), out_fmt)
+        (env.from_source(src, WatermarkStrategy.for_bounded_out_of_orderness(0))
+         .key_by("k").window(TumblingEventTimeWindows.of(1000)).count()
+         .add_sink(sink))
+        env.execute("files")
+
+        golden = {}
+        for k, t in zip(keys, ts):
+            golden[(int(k), (int(t) // 1000 + 1) * 1000)] = golden.get(
+                (int(k), (int(t) // 1000 + 1) * 1000), 0) + 1
+        got = {}
+        for b in sink.committed_batches():
+            for k, e, c in zip(b["key"], b["window_end"], b["count"]):
+                got[(int(k), int(e))] = got.get((int(k), int(e)), 0) + int(c)
+        assert got == golden
+
+    def test_exactly_once_across_crash(self, tmp_path):
+        """Flaky source + FileSink: after supervised recovery the
+        committed files hold each window exactly once."""
+        from flink_tpu.api.environment import StreamExecutionEnvironment
+        from flink_tpu.api.sources import GeneratorSource
+        from flink_tpu.api.windowing import TumblingEventTimeWindows
+        from flink_tpu.runtime.supervisor import run_with_recovery
+        from flink_tpu.time.watermarks import WatermarkStrategy
+
+        out_fmt = CsvFormat([("key", "i64"), ("window_end", "i64"),
+                             ("count", "i64")])
+        sink = FileSink(str(tmp_path / "out"), out_fmt)
+        crashes = {"left": 1}
+
+        def gen(split, i):
+            if i >= 6:
+                return None
+            if i == 4 and crashes["left"] > 0:
+                crashes["left"] -= 1
+                raise RuntimeError("flaky")
+            rng = np.random.default_rng(i)
+            return ({"k": rng.integers(0, 4, 64).astype(np.int64)},
+                    np.sort(rng.integers(i * 500, i * 500 + 900, 64)).astype(np.int64))
+
+        conf = Configuration({
+            "state.num-key-shards": 4, "state.slots-per-shard": 32,
+            "pipeline.microbatch-size": 64,
+            "execution.checkpointing.dir": str(tmp_path / "ckpt"),
+            "execution.checkpointing.interval": 1,
+            "restart-strategy.type": "fixed-delay",
+            "restart-strategy.fixed-delay.attempts": 2,
+            "restart-strategy.fixed-delay.delay": 1,
+        })
+
+        def build(c):
+            env = StreamExecutionEnvironment(c)
+            (env.from_source(
+                GeneratorSource(gen),
+                WatermarkStrategy.for_bounded_out_of_orderness(900))
+             .key_by("k").window(TumblingEventTimeWindows.of(500)).count()
+             .add_sink(sink))
+            return env
+
+        run_with_recovery(build, conf, "files-recovery")
+
+        golden = {}
+        for i in range(6):
+            rng = np.random.default_rng(i)
+            ks = rng.integers(0, 4, 64)
+            tss = np.sort(rng.integers(i * 500, i * 500 + 900, 64))
+            for k, t in zip(ks, tss):
+                we = (int(t) // 500 + 1) * 500
+                golden[(int(k), we)] = golden.get((int(k), we), 0) + 1
+        got = {}
+        for b in sink.committed_batches():
+            for k, e, c in zip(b["key"], b["window_end"], b["count"]):
+                key = (int(k), int(e))
+                assert key not in got, f"duplicate window {key}"
+                got[key] = int(c)
+        assert got == golden
