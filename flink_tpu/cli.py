@@ -99,6 +99,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         sp.add_argument("--coordinator", required=True, metavar="HOST:PORT")
         sp.add_argument("job_id")
 
+    rs = sub.add_parser("rescale",
+                        help="savepoint + restart the job at a new "
+                             "device width")
+    rs.add_argument("--coordinator", required=True, metavar="HOST:PORT")
+    rs.add_argument("--devices", type=int, required=True)
+    rs.add_argument("job_id")
+
     args = p.parse_args(argv)
 
     if args.cmd == "run":
@@ -140,6 +147,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             resp = c.call("cancel_job", job_id=args.job_id)
         elif args.cmd == "savepoint":
             resp = c.call("trigger_savepoint", job_id=args.job_id)
+        elif args.cmd == "rescale":
+            resp = c.call("rescale_job", job_id=args.job_id,
+                          devices=args.devices)
         else:  # pragma: no cover
             raise SystemExit(f"unknown command {args.cmd}")
     finally:

@@ -297,6 +297,15 @@ class ClusterOptions:
         "its 'subtask'); keyed exchanges ride XLA all_to_all over the "
         "mesh axis (ref: parallelism.default + slot assignment, "
         "KeyGroupRangeAssignment).")
+    EXCHANGE_IMPL = ConfigOption(
+        "exchange.impl", "all-to-all",
+        "Keyed-exchange collective pattern (the Shuffle SPI seam, ref: "
+        "runtime/shuffle ShuffleMaster/ShuffleEnvironment): "
+        "'all-to-all' = one fused lax.all_to_all (bandwidth-optimal on "
+        "a fully-connected ICI axis); 'ring' = N-1 lax.ppermute "
+        "neighbor hops (ring-only topologies / per-hop overlap). "
+        "Third-party implementations register via "
+        "exchange.spi.register_shuffle.")
     HEARTBEAT_INTERVAL = duration_option(
         "heartbeat.interval", 10_000,
         "Runner→coordinator heartbeat period (ref: heartbeat.interval=10s).")
@@ -313,6 +322,17 @@ class ClusterOptions:
     RESTART_DELAY = duration_option(
         "restart-strategy.fixed-delay.delay", 1_000,
         "Delay between restarts for fixed-delay strategy.")
+
+
+class MemoryOptions:
+    HBM_BUDGET = ConfigOption(
+        "memory.hbm-budget", 0,
+        "Plan-time HBM budget in BYTES for device-resident operator "
+        "state (pane tensors, emit rings). Dense static layouts make "
+        "the footprint computable before the first step — a job that "
+        "cannot fit fails at build with a per-operator breakdown "
+        "instead of an XLA allocator error mid-run (ref: MemoryManager "
+        "managed-memory budgeting). 0 = unlimited.")
 
 
 class HighAvailabilityOptions:

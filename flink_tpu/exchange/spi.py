@@ -1,0 +1,94 @@
+"""Shuffle SPI: pluggable keyed-exchange implementations.
+
+ref: runtime/shuffle/{ShuffleMaster,ShuffleEnvironment}.java — the seam
+upstream uses to swap the exchange layer (Netty vs remote shuffle
+services) without touching operators. Here the seam swaps the ICI
+collective pattern the compiled step uses for the keyBy repartition:
+
+- ``all-to-all`` (default): one ``lax.all_to_all`` of the padded
+  destination buckets — one fused collective, the bandwidth-optimal
+  pattern on a fully-connected ICI axis (SURVEY §3.6 TPU mapping).
+- ``ring``: N-1 ``lax.ppermute`` hops, each device forwarding its
+  bucket block around the ring and keeping the row addressed to it.
+  More steps but strictly neighbor traffic — the pattern for meshes
+  where only ring links are provisioned (or when overlapping compute
+  with per-hop communication matters more than latency).
+
+Both implement the same contract as ``keyby_exchange``: identical
+inputs → identical received records (order within the received block
+differs only by source layout, which the pane scatter is insensitive
+to). Parity is pinned by tests on the virtual mesh.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from flink_tpu.exchange.keyby import bucket_by_destination, keyby_exchange
+from flink_tpu.parallel.mesh import AXIS
+
+Arrays = Dict[str, jax.Array]
+ShuffleFn = Callable[..., Tuple[Arrays, jax.Array, jax.Array]]
+
+
+def all_to_all_shuffle(dest, valid, payload, *, n_devices, capacity,
+                       axis_name: str = AXIS):
+    return keyby_exchange(dest, valid, payload, n_devices=n_devices,
+                          capacity=capacity, axis_name=axis_name)
+
+
+def ring_shuffle(dest, valid, payload, *, n_devices, capacity,
+                 axis_name: str = AXIS):
+    """bucket → N ppermute hops around the ring → flatten.
+
+    Invariant maintained per hop ``s``: the block each device holds
+    came from device ``(my - s) % N``; extracting row ``my`` of it
+    yields that source's records addressed to me. After N hops every
+    (source, me) bucket has been captured, laid out row-per-source —
+    the same layout ``all_to_all``'s transpose produces, so consumers
+    are agnostic to the implementation."""
+    buckets, bv, overflow = bucket_by_destination(
+        dest, valid, payload, n_dest=n_devices, capacity=capacity)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n_devices) for i in range(n_devices)]
+
+    names = sorted(buckets)
+    out0 = {n: jnp.zeros_like(buckets[n]) for n in names}
+    outv0 = jnp.zeros_like(bv)
+
+    def body(s, carry):
+        cur, curv, out, outv = carry
+        src = (my - s) % n_devices
+        out = {n: out[n].at[src].set(cur[n][my]) for n in names}
+        outv = outv.at[src].set(curv[my])
+        cur = {n: lax.ppermute(cur[n], axis_name, perm) for n in names}
+        curv = lax.ppermute(curv, axis_name, perm)
+        return cur, curv, out, outv
+
+    _, _, out, outv = lax.fori_loop(
+        0, n_devices, body, (buckets, bv, out0, outv0))
+    recv = {n: out[n].reshape(-1) for n in names}
+    return recv, outv.reshape(-1), overflow
+
+
+_IMPLS: Dict[str, ShuffleFn] = {
+    "all-to-all": all_to_all_shuffle,
+    "ring": ring_shuffle,
+}
+
+
+def get_shuffle(name: str) -> ShuffleFn:
+    if name not in _IMPLS:
+        raise ValueError(
+            f"unknown exchange implementation {name!r}; "
+            f"available: {sorted(_IMPLS)}")
+    return _IMPLS[name]
+
+
+def register_shuffle(name: str, fn: ShuffleFn) -> None:
+    """The SPI hook: third-party exchange implementations register here
+    (ref: ShuffleServiceFactory discovery)."""
+    _IMPLS[name] = fn

@@ -1,0 +1,64 @@
+"""Memory accounting: plan-time HBM budgeting + live usage gauges.
+
+ref: flink-core MemorySegment / runtime/memory/MemoryManager.java —
+upstream pre-budgets managed memory per slot and fails task deployment
+when a declared budget can't be met, instead of letting operators OOM
+mid-job. The TPU analogue: device state is DENSE and statically shaped
+(pane tensors, emit rings), so its HBM footprint is computable at plan
+time from the layouts alone — a job that cannot fit fails at build with
+the per-operator breakdown, not at step 400 with an XLA allocator
+error. Host-side usage (spill store, prefetch buffers) is dynamic and
+surfaces as gauges.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+__all__ = ["MemoryBudget", "OperatorFootprint", "InsufficientMemoryError"]
+
+
+class InsufficientMemoryError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class OperatorFootprint:
+    name: str
+    hbm_bytes: int
+    detail: str = ""
+
+
+class MemoryBudget:
+    """Collects per-operator static HBM footprints and checks them
+    against a configured budget (0 = unlimited)."""
+
+    def __init__(self, hbm_budget_bytes: int = 0) -> None:
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self.footprints: List[OperatorFootprint] = []
+
+    def register(self, name: str, hbm_bytes: int, detail: str = "") -> None:
+        self.footprints.append(OperatorFootprint(name, hbm_bytes, detail))
+
+    @property
+    def hbm_total(self) -> int:
+        return sum(f.hbm_bytes for f in self.footprints)
+
+    def check(self) -> None:
+        if self.hbm_budget_bytes <= 0:
+            return
+        total = self.hbm_total
+        if total > self.hbm_budget_bytes:
+            lines = "\n".join(
+                f"  {f.name}: {f.hbm_bytes:,} B  {f.detail}"
+                for f in sorted(self.footprints,
+                                key=lambda f: -f.hbm_bytes))
+            raise InsufficientMemoryError(
+                f"planned device state {total:,} B exceeds the "
+                f"memory.hbm-budget of {self.hbm_budget_bytes:,} B:\n"
+                f"{lines}\n"
+                "Reduce state.num-key-shards/slots-per-shard, shorten "
+                "windows (fewer ring panes), or raise the budget.")
+
+    def breakdown(self) -> List[Dict]:
+        return [dataclasses.asdict(f) for f in self.footprints]

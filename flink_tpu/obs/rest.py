@@ -133,12 +133,22 @@ class RestServer:
                 return 404, {"error": f"no route {u.path}"}
             if method == "PATCH" and len(parts) == 2 and parts[0] == "jobs":
                 mode = q.get("mode", ["cancel"])[0]
-                if mode != "cancel":
-                    return 400, {"error": f"unsupported mode {mode!r}"}
                 st = self._call("job_status", job_id=parts[1])
                 if st.get("state") == "UNKNOWN":
                     return 404, {"error": f"no job {parts[1]}"}
-                return 202, self._call("cancel_job", job_id=parts[1])
+                if mode == "cancel":
+                    return 202, self._call("cancel_job", job_id=parts[1])
+                if mode == "rescale":
+                    # ref: the REST rescale endpoint (PATCH with a new
+                    # parallelism) driving the AdaptiveScheduler
+                    try:
+                        devices = int(q.get("devices", [""])[0])
+                    except ValueError:
+                        return 400, {"error": "rescale needs devices=N"}
+                    resp = self._call("rescale_job", job_id=parts[1],
+                                      devices=devices)
+                    return (202 if resp.get("ok") else 409), resp
+                return 400, {"error": f"unsupported mode {mode!r}"}
             if (method == "POST" and len(parts) == 3 and parts[0] == "jobs"
                     and parts[2] == "savepoints"):
                 st = self._call("job_status", job_id=parts[1])

@@ -601,10 +601,12 @@ class WindowOperator:
         exchange_capacity: Optional[int] = None,
         top_n: Optional[Tuple[str, int]] = None,
         spill: bool = False,
+        exchange_impl: str = "all-to-all",
     ) -> None:
         self.assigner = assigner
         self.agg = agg
         self.mesh_plan = mesh_plan
+        self.exchange_impl = exchange_impl
         if exchange_capacity is not None and exchange_capacity < 0:
             raise ValueError(
                 f"exchange_capacity must be >= 0, got {exchange_capacity}")
@@ -765,8 +767,9 @@ class WindowOperator:
         all_to_all over the mesh (keyBy repartition on ICI) → local pane
         scatter. Fire/clear are embarrassingly parallel over row blocks.
         """
-        from flink_tpu.exchange.keyby import keyby_exchange
+        from flink_tpu.exchange.spi import get_shuffle
 
+        keyby_exchange = get_shuffle(self.exchange_impl)
         mp = self.mesh_plan
         agg = self.agg
         plan = self.plan
@@ -1094,6 +1097,17 @@ class WindowOperator:
         self._inflight.append(self.state.counts[0, 0])
         if not self.external_throttle:
             self.throttle()
+
+    def hbm_bytes(self) -> int:
+        """Static device-state footprint: pane tensors (all devices
+        when sharded) + the emit ring (memory.hbm-budget accounting)."""
+        n_dev = self.mesh_plan.n_devices if self.mesh_plan else 1
+        state = self.layout.bytes() * n_dev
+        ring = 0
+        if self._topn is not None:
+            cols = 3 + len(self._result_fields())
+            ring = (self.EMIT_RING_ROWS + 2) * cols * 4 * n_dev
+        return state + ring
 
     def throttle(self) -> None:
         """Apply ingest backpressure: block on the oldest outstanding

@@ -55,6 +55,11 @@ class JobInfo:
     # job-code artifacts: [{"name": "mod.py", "digest": sha256}] the
     # runner fetches from the blob store before importing the entry
     py_blobs: List[Dict[str, str]] = dataclasses.field(default_factory=list)
+    # live-rescale handshake (ref: AdaptiveScheduler + REST rescale):
+    # target width while the pre-rescale savepoint is in flight, and the
+    # one-shot restore path the next deploy consumes
+    pending_rescale: Optional[int] = None
+    restore_path: Optional[str] = None
     # physical graph: stages × parallelism, per-attempt execution states
     egraph: Optional[ExecutionGraph] = None
 
@@ -265,7 +270,12 @@ class JobCoordinator(RpcEndpoint):
             self._persist_locked(j)
             entry, config, attempt = j.entry, dict(j.config), j.attempts
             blobs = list(j.py_blobs)
-            if attempt > 1:
+            if j.restore_path:
+                # one-shot explicit restore (rescale savepoint); a later
+                # crash-recovery falls back to 'latest' as usual
+                config["execution.checkpointing.restore"] = j.restore_path
+                j.restore_path = None
+            elif attempt > 1:
                 # recovery attempt resumes from the newest checkpoint
                 config["execution.checkpointing.restore"] = "latest"
         try:
@@ -326,6 +336,7 @@ class JobCoordinator(RpcEndpoint):
             if j is not None and j.state in (
                     "RUNNING", "RESTARTING", "WAITING_FOR_RESOURCES"):
                 j.state = "CANCELED"
+                j.pending_rescale = None
                 self._slots.release(job_id)
                 if j.egraph is not None:
                     j.egraph.transition("CANCELED")
@@ -339,16 +350,20 @@ class JobCoordinator(RpcEndpoint):
             self._deploy_async(wid)
         return {"ok": True}
 
-    def _push_cancel_async(self, runner: RunnerInfo, job_id: str) -> None:
+    def _push_cancel_async(self, runner: RunnerInfo, job_id: str,
+                           attempt: Optional[int] = None) -> None:
         """Tell the runner's gateway to stop the job now (heartbeat
-        revocation is the backstop if this push is lost)."""
+        revocation is the backstop if this push is lost). ``attempt``
+        fences the cancel to one attempt — a rescale's stop must not
+        race ahead and kill the redeployed attempt on the same runner."""
         from flink_tpu.runtime.rpc import RpcClient, RpcError
 
         def push() -> None:
             try:
                 c = RpcClient(runner.host, runner.port, timeout_s=5.0)
                 try:
-                    c.call("cancel_job", job_id=job_id)
+                    kw = {"attempt": attempt} if attempt is not None else {}
+                    c.call("cancel_job", job_id=job_id, **kw)
                 finally:
                     c.close()
             except RpcError:
@@ -364,6 +379,7 @@ class JobCoordinator(RpcEndpoint):
             # ran to completion does not flip CANCELED back to FINISHED
             if j is not None and j.state in ("RUNNING", "RESTARTING"):
                 j.state = "FINISHED"
+                j.pending_rescale = None
                 self._slots.release(job_id)
                 if j.egraph is not None:
                     j.egraph.transition("FINISHED")
@@ -397,6 +413,10 @@ class JobCoordinator(RpcEndpoint):
         CANCELED/FINISHED/FAILED job."""
         if j.state in ("CANCELED", "FINISHED", "FAILED"):
             return {"action": "none", "state": j.state}
+        # an armed-but-unfinished rescale dies with the attempt: the
+        # recovery deploy keeps the old width, and a routine savepoint
+        # days later must not consume a stale rescale request
+        j.pending_rescale = None
         if j.state == "RESTARTING" and j.entry is not None:
             # one incident, one restart (coordinator-DEPLOYED jobs only —
             # _deploy owns the RESTARTING→RUNNING transition): the
@@ -459,6 +479,14 @@ class JobCoordinator(RpcEndpoint):
                         return
                 except RpcError:
                     continue
+            # NO runner accepted (e.g. checkpointing not configured):
+            # savepoint_complete will never arrive — a rescale armed on
+            # this savepoint must disarm, or it blocks all future
+            # rescales and fires on some unrelated later savepoint
+            with self._lock:
+                jj = self.jobs.get(job_id)
+                if jj is not None:
+                    jj.pending_rescale = None
 
         threading.Thread(target=push, daemon=True).start()
         return {"ok": True, "dispatched": True,
@@ -507,11 +535,66 @@ class JobCoordinator(RpcEndpoint):
         return snap
 
     def rpc_savepoint_complete(self, job_id: str, path: str) -> dict:
+        rescale_targets: List[RunnerInfo] = []
         with self._lock:
             j = self.jobs.get(job_id)
-            if j is not None:
-                j.last_savepoint = path
+            if j is None:
+                return {"ok": True}
+            j.last_savepoint = path
+            if j.pending_rescale is not None and j.state == "RUNNING":
+                # rescale phase 2: savepoint durable → stop the old
+                # width, redeploy at the new one restoring from it
+                # (ref: AdaptiveScheduler rescale = savepoint + restart
+                # with re-split key-group ranges; the reshard happens in
+                # the state restore path)
+                new = j.pending_rescale
+                j.pending_rescale = None
+                j.required_devices = new
+                j.config["cluster.mesh-devices"] = str(new)
+                j.restore_path = path
+                j.state = "RESTARTING"
+                old_attempt = j.attempts
+                j.attempts += 1
+                self._slots.release(job_id)
+                if j.egraph is not None:
+                    j.egraph.set_parallelism(max(1, new))
+                rescale_targets = self._job_runners_locked(j)
+                self._persist_locked(j)
+                redeploy = True
+            else:
+                redeploy = False
+        for r in rescale_targets:
+            # fenced to the OLD attempt: the redeploy may land on the
+            # same runner before this cancel does
+            self._push_cancel_async(r, job_id, attempt=old_attempt)
+        if redeploy:
+            self._deploy_async(job_id, delay_s=0.2)
         return {"ok": True}
+
+    def rpc_rescale_job(self, job_id: str, devices: int) -> dict:
+        """Live rescale: savepoint → stop → restore at the new width
+        (ref: the REST rescale endpoint / reactive mode). The ack means
+        the rescale is DISPATCHED; progress shows in job_status (state
+        RESTARTING once the savepoint lands, RUNNING at the new width
+        after redeploy)."""
+        if devices < 1:
+            return {"ok": False, "reason": "devices must be >= 1"}
+        with self._lock:
+            j = self.jobs.get(job_id)
+            if j is None or j.entry is None or j.state != "RUNNING":
+                return {"ok": False,
+                        "reason": "job not running (or not deployable)"}
+            if j.pending_rescale is not None:
+                return {"ok": False, "reason": "rescale already in flight"}
+            j.pending_rescale = devices
+        resp = self.rpc_trigger_savepoint(job_id)
+        if not resp.get("ok"):
+            with self._lock:
+                jj = self.jobs.get(job_id)
+                if jj is not None:
+                    jj.pending_rescale = None
+            return resp
+        return {"ok": True, "dispatched": True, "devices": devices}
 
     def rpc_list_runners(self) -> dict:
         with self._lock:

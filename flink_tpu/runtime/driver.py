@@ -24,6 +24,7 @@ import numpy as np
 
 from flink_tpu.config import (
     CheckpointingOptions,
+    ClusterOptions,
     Configuration,
     PipelineOptions,
     StateOptions,
@@ -137,6 +138,27 @@ class Driver:
         # time; the expensive materialization stays outside the lock)
         self._push_lock = threading.Lock()
         self._build_ops()
+        # plan-time HBM budgeting: dense static layouts make the device
+        # footprint computable BEFORE the first step — fail at build
+        # with a breakdown, not mid-run in the XLA allocator (ref:
+        # MemoryManager managed-memory budgets; memory.hbm-budget)
+        from flink_tpu.config import MemoryOptions
+        from flink_tpu.memory import MemoryBudget
+
+        self.memory = MemoryBudget(int(config.get(MemoryOptions.HBM_BUDGET)))
+        for nid, op in self._ops.items():
+            if hasattr(op, "hbm_bytes"):
+                n = self.plan.node(nid)
+                self.memory.register(
+                    f"{n.kind}:{n.name or nid}", op.hbm_bytes(),
+                    detail=f"layout={getattr(op, 'layout', None)}")
+        self.memory.check()
+        g2 = self.registry.group("memory")
+        g2.gauge("hbm_state_bytes", lambda: float(self.memory.hbm_total))
+        g2.gauge("host_spill_bytes", lambda: float(sum(
+            getattr(getattr(op, "_spill", None), "bytes_used", lambda: 0)()
+            for op in self._ops.values()
+            if getattr(op, "_spill", None) is not None)))
 
     # -- construction ----------------------------------------------------
     def _build_ops(self) -> None:
@@ -176,6 +198,7 @@ class Driver:
                     top_n=t.top_n,
                     exchange_capacity=xcap,
                     spill=(backend == "spill"),
+                    exchange_impl=self.config.get(ClusterOptions.EXCHANGE_IMPL),
                 )
                 self._ops[n.id].max_inflight_steps = inflight
                 # backpressure blocks happen OUTSIDE the push lock (the

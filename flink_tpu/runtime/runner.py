@@ -206,11 +206,18 @@ class TaskRunner(RpcEndpoint):
             t.start()
         return {"accepted": True, "runner_id": self.runner_id}
 
-    def rpc_cancel_job(self, job_id: str) -> dict:
+    def rpc_cancel_job(self, job_id: str,
+                       attempt: Optional[int] = None) -> dict:
+        """``attempt`` is a fencing token: a cancel aimed at attempt N
+        must not kill attempt N+1 that superseded it on this runner
+        (the rescale stop→redeploy race; ref: execution attempt ids
+        fencing cancelTask). None = cancel whatever runs (user cancel)."""
         with self._lock:
             j = self._jobs.get(job_id)
             if j is None:
                 return {"ok": False, "reason": "unknown job"}
+            if attempt is not None and j["attempt"] != attempt:
+                return {"ok": False, "reason": "attempt superseded"}
             j["cancel"].set()
         return {"ok": True}
 

@@ -218,6 +218,13 @@ class HostSpillStore:
         for p in [p for p in self.panes if p < dead_pane]:
             del self.panes[p]
 
+    def bytes_used(self) -> int:
+        """Host memory held by spilled panes (memory.host_spill_bytes).
+        Called from the metrics scrape thread while ingest mutates the
+        dict — list() snapshots the values atomically under the GIL."""
+        return sum(sum(a.nbytes for a in arrs)
+                   for arrs in list(self.panes.values()))
+
     @property
     def key_count(self) -> int:
         if not self.panes:

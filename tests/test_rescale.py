@@ -1,0 +1,186 @@
+"""Live rescale: savepoint → stop → restore at a new mesh width, driven
+through the coordinator against a REAL runner process (ref:
+AdaptiveScheduler / reactive mode + the REST rescale endpoint;
+key-group re-assignment happens in the reshard-on-restore path)."""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from flink_tpu.api.sinks import FileTransactionalSink
+from flink_tpu.config import Configuration
+from flink_tpu.runtime.coordinator import JobCoordinator
+from flink_tpu.runtime.rpc import RpcServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def spawn_mesh_runner(coord_port: int, runner_id: str,
+                      devices: int = 8) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + os.path.join(REPO, "tests")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devices}")
+    return subprocess.Popen(
+        [sys.executable, "-m", "flink_tpu.runtime.runner",
+         "--coordinator", f"127.0.0.1:{coord_port}",
+         "--runner-id", runner_id],
+        env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+def wait_until(pred, timeout=120.0, interval=0.2, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_live_rescale_exactly_once(tmp_path):
+    import runner_job
+
+    coord = JobCoordinator(Configuration({
+        "heartbeat.interval": 500,
+        "restart-strategy.type": "fixed-delay",
+        "restart-strategy.fixed-delay.attempts": 3,
+        "restart-strategy.fixed-delay.delay": 200,
+    }))
+    srv = RpcServer(coord)
+    runner = None
+    n_batches = 60
+    try:
+        runner = spawn_mesh_runner(srv.port, "mesh-r1")
+        wait_until(lambda: "mesh-r1" in coord.runners, what="registration")
+
+        sink_dir = str(tmp_path / "sink")
+        coord.rpc_submit_job(
+            "rescale-job", entry="runner_job:build",
+            config={
+                "cluster.mesh-devices": "2",
+                "state.num-key-shards": 8,
+                "state.slots-per-shard": 16,
+                "pipeline.microbatch-size": 64,
+                "execution.checkpointing.dir": str(tmp_path / "ckpt"),
+                "execution.checkpointing.interval": 500,
+                "test.n-batches": str(n_batches),
+                "test.batch-sleep-ms": "200",
+                "test.sink-dir": sink_dir,
+            })
+        wait_until(lambda: coord.rpc_job_status("rescale-job")["state"]
+                   == "RUNNING", what="deploy")
+        # let it make checkpointed progress at width 2
+        time.sleep(4.0)
+
+        resp = coord.rpc_rescale_job("rescale-job", devices=4)
+        assert resp["ok"], resp
+
+        # the rescale lands: attempt 2 at the new width
+        wait_until(lambda: coord.rpc_job_status("rescale-job")["attempts"]
+                   >= 2, what="rescale redeploy")
+        wait_until(lambda: coord.rpc_job_status("rescale-job")["state"]
+                   == "FINISHED", what="job finish")
+
+        eg = coord.rpc_execution_graph("rescale-job")
+        assert eg["parallelism"] == 4  # physical graph re-widened
+
+        # exactly-once across the rescale boundary
+        got = {}
+        for r in FileTransactionalSink.committed_rows(sink_dir):
+            k = (int(r["key"]), int(r["window_start"]))
+            assert k not in got, f"duplicate window {k}"
+            got[k] = int(r["count"])
+        assert got == runner_job.golden_counts(n_batches)
+    finally:
+        if runner is not None:
+            runner.terminate()
+            runner.wait(timeout=15)
+        srv.close()
+        coord.close()
+
+
+class TestRescaleLifecycle:
+    """Rescale arming must not leak (review regressions)."""
+
+    def _mk(self):
+        from flink_tpu.runtime.rpc import RpcEndpoint
+
+        class Gw(RpcEndpoint):
+            def __init__(self):
+                self.deployed = []
+                self.savepoint_ok = True
+                self.cancels = []
+
+            def rpc_run_job(self, job_id, entry, config=None, attempt=1,
+                            py_blobs=None):
+                self.deployed.append((job_id, attempt))
+                return {"accepted": True}
+
+            def rpc_cancel_job(self, job_id, attempt=None):
+                self.cancels.append((job_id, attempt))
+                return {"ok": True}
+
+            def rpc_trigger_savepoint(self, job_id):
+                return {"ok": self.savepoint_ok}
+
+        return Gw()
+
+    def test_rejected_savepoint_disarms_rescale(self):
+        gw = self._mk()
+        gw.savepoint_ok = False  # job has no checkpointing configured
+        gwsrv = RpcServer(gw)
+        coord = JobCoordinator(Configuration({}))
+        srv = RpcServer(coord)
+        try:
+            coord.rpc_register_runner("r1", "127.0.0.1", 8, port=gwsrv.port)
+            coord.rpc_submit_job("j", entry="x:y",
+                                 config={"cluster.mesh-devices": "2"})
+            wait_until(lambda: gw.deployed, what="deploy")
+            resp = coord.rpc_rescale_job("j", devices=4)
+            assert resp["ok"]  # dispatched — rejection is async
+            wait_until(lambda: coord.jobs["j"].pending_rescale is None,
+                       what="disarm after rejected savepoint")
+            # a new rescale is possible again (not 'already in flight')
+            gw.savepoint_ok = True
+            assert coord.rpc_rescale_job("j", devices=4)["ok"]
+        finally:
+            srv.close(); gwsrv.close(); coord.close()
+
+    def test_failure_disarms_pending_rescale(self):
+        gw = self._mk()
+        gwsrv = RpcServer(gw)
+        coord = JobCoordinator(Configuration({}))
+        srv = RpcServer(coord)
+        try:
+            coord.rpc_register_runner("r1", "127.0.0.1", 8, port=gwsrv.port)
+            coord.rpc_submit_job("j", entry="x:y", config={})
+            wait_until(lambda: gw.deployed, what="deploy")
+            coord.jobs["j"].pending_rescale = 4  # armed, savepoint pending
+            coord.rpc_report_failure("j", "task crashed")
+            assert coord.jobs["j"].pending_rescale is None
+        finally:
+            srv.close(); gwsrv.close(); coord.close()
+
+    def test_rescale_cancel_is_attempt_fenced(self):
+        gw = self._mk()
+        gwsrv = RpcServer(gw)
+        coord = JobCoordinator(Configuration({}))
+        srv = RpcServer(coord)
+        try:
+            coord.rpc_register_runner("r1", "127.0.0.1", 8, port=gwsrv.port)
+            coord.rpc_submit_job("j", entry="x:y",
+                                 config={"cluster.mesh-devices": "2"})
+            wait_until(lambda: gw.deployed, what="deploy")
+            coord.rpc_rescale_job("j", devices=4)
+            coord.rpc_savepoint_complete("j", "/sp/path")
+            wait_until(lambda: len(gw.deployed) >= 2, what="redeploy")
+            wait_until(lambda: gw.cancels, what="cancel push")
+            # the cancel carried the OLD attempt as its fence
+            assert gw.cancels[0] == ("j", 1)
+            assert gw.deployed[1] == ("j", 2)
+        finally:
+            srv.close(); gwsrv.close(); coord.close()
