@@ -327,12 +327,14 @@ class ClusterOptions:
 class MemoryOptions:
     HBM_BUDGET = ConfigOption(
         "memory.hbm-budget", 0,
-        "Plan-time HBM budget in BYTES for device-resident operator "
-        "state (pane tensors, emit rings). Dense static layouts make "
-        "the footprint computable before the first step — a job that "
-        "cannot fit fails at build with a per-operator breakdown "
-        "instead of an XLA allocator error mid-run (ref: MemoryManager "
-        "managed-memory budgeting). 0 = unlimited.")
+        "Plan-time PER-DEVICE HBM budget in BYTES for device-resident "
+        "operator state (pane tensors, emit rings). HBM is a per-chip "
+        "resource and state shards one block per device, so the check "
+        "is per-device and independent of mesh width. Dense static "
+        "layouts make the footprint computable before the first step — "
+        "a job that cannot fit fails at build with a per-operator "
+        "breakdown instead of an XLA allocator error mid-run (ref: "
+        "MemoryManager managed-memory budgeting). 0 = unlimited.")
 
 
 class HighAvailabilityOptions:

@@ -1099,14 +1099,16 @@ class WindowOperator:
             self.throttle()
 
     def hbm_bytes(self) -> int:
-        """Static device-state footprint: pane tensors (all devices
-        when sharded) + the emit ring (memory.hbm-budget accounting)."""
-        n_dev = self.mesh_plan.n_devices if self.mesh_plan else 1
-        state = self.layout.bytes() * n_dev
+        """Static device-state footprint PER DEVICE: pane tensors +
+        emit ring. HBM is a per-chip resource — state shards one layout
+        block per device, so widening the mesh leaves the per-chip
+        footprint constant and the memory.hbm-budget check must not
+        scale with fleet size."""
+        state = self.layout.bytes()
         ring = 0
         if self._topn is not None:
             cols = 3 + len(self._result_fields())
-            ring = (self.EMIT_RING_ROWS + 2) * cols * 4 * n_dev
+            ring = (self.EMIT_RING_ROWS + 2) * cols * 4
         return state + ring
 
     def throttle(self) -> None:

@@ -56,9 +56,12 @@ class JobInfo:
     # runner fetches from the blob store before importing the entry
     py_blobs: List[Dict[str, str]] = dataclasses.field(default_factory=list)
     # live-rescale handshake (ref: AdaptiveScheduler + REST rescale):
-    # target width while the pre-rescale savepoint is in flight, and the
-    # one-shot restore path the next deploy consumes
+    # target width while the pre-rescale savepoint is in flight, the
+    # token identifying THAT savepoint (an unrelated savepoint's
+    # completion must not consume the rescale), and the one-shot
+    # restore path the next deploy consumes
     pending_rescale: Optional[int] = None
+    rescale_token: Optional[str] = None
     restore_path: Optional[str] = None
     # physical graph: stages × parallelism, per-attempt execution states
     egraph: Optional[ExecutionGraph] = None
@@ -337,6 +340,7 @@ class JobCoordinator(RpcEndpoint):
                     "RUNNING", "RESTARTING", "WAITING_FOR_RESOURCES"):
                 j.state = "CANCELED"
                 j.pending_rescale = None
+                j.rescale_token = None
                 self._slots.release(job_id)
                 if j.egraph is not None:
                     j.egraph.transition("CANCELED")
@@ -380,6 +384,7 @@ class JobCoordinator(RpcEndpoint):
             if j is not None and j.state in ("RUNNING", "RESTARTING"):
                 j.state = "FINISHED"
                 j.pending_rescale = None
+                j.rescale_token = None
                 self._slots.release(job_id)
                 if j.egraph is not None:
                     j.egraph.transition("FINISHED")
@@ -417,6 +422,7 @@ class JobCoordinator(RpcEndpoint):
         # recovery deploy keeps the old width, and a routine savepoint
         # days later must not consume a stale rescale request
         j.pending_rescale = None
+        j.rescale_token = None
         if j.state == "RESTARTING" and j.entry is not None:
             # one incident, one restart (coordinator-DEPLOYED jobs only —
             # _deploy owns the RESTARTING→RUNNING transition): the
@@ -449,7 +455,8 @@ class JobCoordinator(RpcEndpoint):
                  "runners": list(j.assigned_runners)}
                 for j in self.jobs.values()]}
 
-    def rpc_trigger_savepoint(self, job_id: str) -> dict:
+    def rpc_trigger_savepoint(self, job_id: str, stop: bool = False,
+                              token: Optional[str] = None) -> dict:
         """Dispatch a savepoint request to the job's runner gateway on a
         worker thread — forwarding must not block the single dispatch
         thread (heartbeats ride it; same discipline as _deploy_async /
@@ -472,7 +479,8 @@ class JobCoordinator(RpcEndpoint):
                 try:
                     c = RpcClient(r.host, r.port, timeout_s=5.0)
                     try:
-                        resp = c.call("trigger_savepoint", job_id=job_id)
+                        resp = c.call("trigger_savepoint", job_id=job_id,
+                                      stop=stop, token=token)
                     finally:
                         c.close()
                     if resp.get("ok"):
@@ -480,13 +488,17 @@ class JobCoordinator(RpcEndpoint):
                 except RpcError:
                     continue
             # NO runner accepted (e.g. checkpointing not configured):
-            # savepoint_complete will never arrive — a rescale armed on
-            # this savepoint must disarm, or it blocks all future
-            # rescales and fires on some unrelated later savepoint
+            # savepoint_complete will never arrive. Disarm ONLY when
+            # this push WAS the rescale's own savepoint (token match) —
+            # an unrelated routine savepoint failing must not kill an
+            # in-flight rescale
+            if token is None:
+                return
             with self._lock:
                 jj = self.jobs.get(job_id)
-                if jj is not None:
+                if jj is not None and jj.rescale_token == token:
                     jj.pending_rescale = None
+                    jj.rescale_token = None
 
         threading.Thread(target=push, daemon=True).start()
         return {"ok": True, "dispatched": True,
@@ -534,14 +546,16 @@ class JobCoordinator(RpcEndpoint):
         snap["found"] = True
         return snap
 
-    def rpc_savepoint_complete(self, job_id: str, path: str) -> dict:
+    def rpc_savepoint_complete(self, job_id: str, path: str,
+                               token: Optional[str] = None) -> dict:
         rescale_targets: List[RunnerInfo] = []
         with self._lock:
             j = self.jobs.get(job_id)
             if j is None:
                 return {"ok": True}
             j.last_savepoint = path
-            if j.pending_rescale is not None and j.state == "RUNNING":
+            if (j.pending_rescale is not None and j.state == "RUNNING"
+                    and token is not None and token == j.rescale_token):
                 # rescale phase 2: savepoint durable → stop the old
                 # width, redeploy at the new one restoring from it
                 # (ref: AdaptiveScheduler rescale = savepoint + restart
@@ -549,6 +563,7 @@ class JobCoordinator(RpcEndpoint):
                 # the state restore path)
                 new = j.pending_rescale
                 j.pending_rescale = None
+                j.rescale_token = None
                 j.required_devices = new
                 j.config["cluster.mesh-devices"] = str(new)
                 j.restore_path = path
@@ -586,13 +601,21 @@ class JobCoordinator(RpcEndpoint):
                         "reason": "job not running (or not deployable)"}
             if j.pending_rescale is not None:
                 return {"ok": False, "reason": "rescale already in flight"}
+            import uuid as _uuid
+
+            token = f"rescale-{_uuid.uuid4().hex[:12]}"
             j.pending_rescale = devices
-        resp = self.rpc_trigger_savepoint(job_id)
+            j.rescale_token = token
+        # stop-with-savepoint (ref: `flink stop --savepoint`): the old
+        # attempt halts the moment the savepoint is durable, so it
+        # cannot keep committing past the state the new width restores
+        resp = self.rpc_trigger_savepoint(job_id, stop=True, token=token)
         if not resp.get("ok"):
             with self._lock:
                 jj = self.jobs.get(job_id)
-                if jj is not None:
+                if jj is not None and jj.rescale_token == token:
                     jj.pending_rescale = None
+                    jj.rescale_token = None
             return resp
         return {"ok": True, "dispatched": True, "devices": devices}
 

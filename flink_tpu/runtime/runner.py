@@ -34,16 +34,32 @@ class SavepointRequest(threading.Event):
     """Savepoint trigger flag + completion callback: the driver calls
     ``on_complete(path)`` after the savepoint is durable, and the runner
     reports the path to the coordinator (the async
-    acknowledgeSavepoint leg of the reference's savepoint flow)."""
+    acknowledgeSavepoint leg of the reference's savepoint flow).
+
+    ``stop_after`` = stop-with-savepoint (ref: `flink stop
+    --savepoint`): the job's cancel flag is set the moment the
+    savepoint is durable, so the old attempt cannot keep committing
+    past the savepoint it just took (the rescale handoff). ``token``
+    identifies WHICH request this was — the coordinator matches it so
+    an unrelated savepoint's completion can never be mistaken for the
+    rescale's."""
 
     def __init__(self, runner: "TaskRunner", job_id: str) -> None:
         super().__init__()
         self._runner = runner
         self._job_id = job_id
+        self.stop_after = False
+        self.token: Optional[str] = None
 
     def on_complete(self, path: str) -> None:
+        if self.stop_after:
+            with self._runner._lock:
+                j = self._runner._jobs.get(self._job_id)
+                if j is not None:
+                    j["cancel"].set()
         self._runner._report("savepoint_complete",
-                             job_id=self._job_id, path=path)
+                             job_id=self._job_id, path=path,
+                             token=self.token)
 
 
 class TaskRunner(RpcEndpoint):
@@ -221,7 +237,8 @@ class TaskRunner(RpcEndpoint):
             j["cancel"].set()
         return {"ok": True}
 
-    def rpc_trigger_savepoint(self, job_id: str) -> dict:
+    def rpc_trigger_savepoint(self, job_id: str, stop: bool = False,
+                              token: Optional[str] = None) -> dict:
         """Request a savepoint at the job's next batch boundary (ref:
         the CLI `flink savepoint` → JobMaster.triggerSavepoint path).
         Rejected up front when the job has no checkpoint storage — a
@@ -240,6 +257,8 @@ class TaskRunner(RpcEndpoint):
                 return {"ok": False,
                         "reason": "job has no checkpointing configured "
                                   "(execution.checkpointing.interval)"}
+            j["savepoint"].stop_after = stop
+            j["savepoint"].token = token
             j["savepoint"].set()
         return {"ok": True, "dispatched": True}
 

@@ -1,0 +1,22 @@
+"""Micro-benchmark suite smoke: every metric runs at toy size and
+emits a parseable line (tier-7 analogue, SURVEY §5; BASELINE.md list)."""
+import json
+
+import bench_micro
+
+
+def test_all_micro_benchmarks_emit(capsys):
+    bench_micro.bench_state_update(batch=1 << 12, iters=2)
+    bench_micro.bench_all_to_all(iters=2)
+    bench_micro.bench_codec(mb=1)
+    bench_micro.bench_fire_flush(iters=2)
+    bench_micro.bench_checkpoint()
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    metrics = {ln["metric"] for ln in lines}
+    assert {"state_update_ops_per_sec", "keyby_exchange_gbps",
+            "ingest_codec_mb_per_sec", "window_fire_flush_ms",
+            "checkpoint_bytes_per_sec",
+            "checkpoint_resume_ms"} <= metrics
+    for ln in lines:
+        assert "value" in ln and "unit" in ln

@@ -124,7 +124,9 @@ class TestRescaleLifecycle:
                 self.cancels.append((job_id, attempt))
                 return {"ok": True}
 
-            def rpc_trigger_savepoint(self, job_id):
+            def rpc_trigger_savepoint(self, job_id, stop=False, token=None):
+                self.savepoints = getattr(self, "savepoints", [])
+                self.savepoints.append((job_id, stop, token))
                 return {"ok": self.savepoint_ok}
 
         return Gw()
@@ -176,11 +178,35 @@ class TestRescaleLifecycle:
                                  config={"cluster.mesh-devices": "2"})
             wait_until(lambda: gw.deployed, what="deploy")
             coord.rpc_rescale_job("j", devices=4)
-            coord.rpc_savepoint_complete("j", "/sp/path")
+            wait_until(lambda: getattr(gw, "savepoints", []),
+                       what="savepoint dispatch")
+            job_id, stop, token = gw.savepoints[0]
+            assert stop  # stop-with-savepoint: old attempt halts at SP
+            # completion must carry the rescale's token to be consumed
+            coord.rpc_savepoint_complete("j", "/sp/path", token=token)
             wait_until(lambda: len(gw.deployed) >= 2, what="redeploy")
             wait_until(lambda: gw.cancels, what="cancel push")
             # the cancel carried the OLD attempt as its fence
             assert gw.cancels[0] == ("j", 1)
             assert gw.deployed[1] == ("j", 2)
+        finally:
+            srv.close(); gwsrv.close(); coord.close()
+
+    def test_unrelated_savepoint_does_not_consume_rescale(self):
+        gw = self._mk()
+        gwsrv = RpcServer(gw)
+        coord = JobCoordinator(Configuration({}))
+        srv = RpcServer(coord)
+        try:
+            coord.rpc_register_runner("r1", "127.0.0.1", 8, port=gwsrv.port)
+            coord.rpc_submit_job("j", entry="x:y", config={})
+            wait_until(lambda: gw.deployed, what="deploy")
+            coord.rpc_rescale_job("j", devices=4)
+            # a ROUTINE savepoint (no token) completes while the rescale
+            # savepoint is still in flight: it must not fire the rescale
+            coord.rpc_savepoint_complete("j", "/routine/sp")
+            assert coord.jobs["j"].pending_rescale == 4  # still armed
+            assert len(gw.deployed) == 1  # no redeploy
+            assert coord.jobs["j"].last_savepoint == "/routine/sp"
         finally:
             srv.close(); gwsrv.close(); coord.close()
