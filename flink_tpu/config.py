@@ -324,6 +324,18 @@ class ClusterOptions:
         "Delay between restarts for fixed-delay strategy.")
 
 
+class SourceOptions:
+    ENUMERATION = ConfigOption(
+        "source.enumeration", "local",
+        "Split ownership: 'local' = this process reads every split "
+        "(single-runner execution); 'coordinator' = ask the job "
+        "coordinator's split enumerator for this runner's share, so "
+        "multiple runners of one job divide the source without overlap "
+        "(ref: FLIP-27 SplitEnumerator on the JobMaster / "
+        "SourceCoordinator). Requires cluster.coordinator/job-id/"
+        "runner-id, which the runner injects on deploy.")
+
+
 class MemoryOptions:
     HBM_BUDGET = ConfigOption(
         "memory.hbm-budget", 0,

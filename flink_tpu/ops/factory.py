@@ -1,0 +1,88 @@
+"""Operator factory SPI — the pluggable seam between compiled plan
+nodes and runtime operator implementations.
+
+ref: streaming/api/operators/{StreamOperatorFactory,
+OneInputStreamOperatorFactory,SimpleOperatorFactory}.java — the
+north-star SPI (SURVEY §2): upstream swaps the hot-path implementation
+(e.g. a different window operator) by registering a factory, without
+touching the user API or the graph compiler. Here the registry maps a
+plan-node KIND to a factory; the Driver consults it FIRST, so a
+registered factory overrides the built-in construction for that kind —
+swap the device kernels behind ``.window().aggregate()`` and every
+pipeline picks it up unchanged.
+
+A factory receives the ``ExecNode`` and an ``OperatorBuildContext``
+(config-derived knobs + mesh plan) and returns the operator instance.
+The built-in window operator registers here too, so the seam is the
+REAL construction path, not a bypass for third parties only.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["OperatorBuildContext", "register_operator_factory",
+           "lookup_operator_factory", "unregister_operator_factory"]
+
+
+@dataclasses.dataclass(frozen=True)
+class OperatorBuildContext:
+    """Everything a factory may need, pre-resolved from Configuration
+    (factories must not re-read raw config — one resolution point)."""
+
+    config: Any
+    mesh_plan: Optional[Any]
+    num_shards: int
+    slots_per_shard: int
+    max_inflight_steps: int
+    exchange_capacity: Optional[int]
+    backend: str
+    exchange_impl: str
+    max_out_of_orderness_ms: int
+
+
+OperatorFactory = Callable[[Any, OperatorBuildContext], Any]
+
+_FACTORIES: Dict[str, OperatorFactory] = {}
+
+
+def register_operator_factory(kind: str, factory: OperatorFactory) -> None:
+    _FACTORIES[kind] = factory
+
+
+def unregister_operator_factory(kind: str) -> None:
+    _FACTORIES.pop(kind, None)
+
+
+def lookup_operator_factory(kind: str) -> Optional[OperatorFactory]:
+    return _FACTORIES.get(kind)
+
+
+# -- built-in factories (the default hot path registers through its own
+# seam; ref: SimpleOperatorFactory wrapping the built-in operators) ----
+
+def _window_factory(node, ctx: OperatorBuildContext):
+    from flink_tpu.ops.window import WindowOperator
+
+    t = node.window_transform
+    op = WindowOperator(
+        t.assigner, t.aggregate,
+        num_shards=ctx.num_shards,
+        slots_per_shard=ctx.slots_per_shard,
+        allowed_lateness_ms=t.allowed_lateness_ms,
+        max_out_of_orderness_ms=max(ctx.max_out_of_orderness_ms, 0),
+        mesh_plan=ctx.mesh_plan,
+        top_n=t.top_n,
+        exchange_capacity=ctx.exchange_capacity,
+        spill=(ctx.backend == "spill"),
+        exchange_impl=ctx.exchange_impl,
+    )
+    op.max_inflight_steps = ctx.max_inflight_steps
+    # backpressure blocks happen OUTSIDE the push lock (the ingest loop
+    # calls throttle() after releasing it), so drain deliveries never
+    # queue behind a transfer wait
+    op.external_throttle = True
+    return op
+
+
+register_operator_factory("window", _window_factory)

@@ -376,9 +376,16 @@ class JobCoordinator(RpcEndpoint):
         t = threading.Thread(target=push, daemon=True)
         t.start()
 
-    def rpc_finish_job(self, job_id: str) -> dict:
+    def rpc_finish_job(self, job_id: str,
+                       attempt: Optional[int] = None) -> dict:
         with self._lock:
             j = self.jobs.get(job_id)
+            # attempt fencing: a zombie attempt finishing late must not
+            # terminate the CURRENT attempt (ref: Execution attempt ids
+            # gating updateTaskExecutionState)
+            if (j is not None and attempt is not None
+                    and attempt != j.attempts):
+                return {"ok": False, "reason": "stale attempt"}
             # terminal states stand: a runner that missed its cancel and
             # ran to completion does not flip CANCELED back to FINISHED
             if j is not None and j.state in ("RUNNING", "RESTARTING"):
@@ -395,7 +402,8 @@ class JobCoordinator(RpcEndpoint):
             self._deploy_async(wid)
         return {"ok": True}
 
-    def rpc_report_failure(self, job_id: str, error: str) -> dict:
+    def rpc_report_failure(self, job_id: str, error: str,
+                           attempt: Optional[int] = None) -> dict:
         """Task failure → restart decision (ref: DefaultScheduler.
         updateTaskExecutionState → ExecutionFailureHandler →
         RestartBackoffTimeStrategy). Deployable jobs are re-deployed by
@@ -404,6 +412,11 @@ class JobCoordinator(RpcEndpoint):
             j = self.jobs.get(job_id)
             if j is None:
                 return {"action": "unknown-job"}
+            if attempt is not None and attempt != j.attempts:
+                # a stale attempt's crash is not the CURRENT attempt's
+                # problem — burning a restart-budget slot for it would
+                # punish a healthy successor
+                return {"action": "stale-attempt"}
             decision = self._route_failure(j, error)
             deployable = j.entry is not None
         if deployable and decision.get("action") == "restart":
@@ -521,6 +534,31 @@ class JobCoordinator(RpcEndpoint):
 
     def rpc_list_blobs(self) -> dict:
         return {"digests": self._blobs.list()}
+
+    def rpc_enumerate_splits(self, job_id: str, source_id: int,
+                             n_splits: int, runner_id: str) -> dict:
+        """Split enumerator (ref: FLIP-27 SplitEnumerator /
+        SourceCoordinator on the JM): deterministic contiguous shares
+        by the runner's position among the job's assigned runners —
+        every runner computes a disjoint slice and the union covers all
+        splits. A runner not assigned to the job gets none (a zombie
+        attempt must not re-read splits its successor owns)."""
+        with self._lock:
+            j = self.jobs.get(job_id)
+            if j is None or runner_id not in j.assigned_runners:
+                # ERROR, not an empty share: a zombie attempt handed []
+                # would run to completion instantly and report finish —
+                # failing its enumeration kills it through the normal
+                # failure routing instead (fencing)
+                raise RuntimeError(
+                    f"runner {runner_id} is not assigned to {job_id} "
+                    "(stale attempt)")
+            runners = list(j.assigned_runners)
+        k = len(runners)
+        p = runners.index(runner_id)
+        lo = p * n_splits // k
+        hi = (p + 1) * n_splits // k
+        return {"splits": list(range(lo, hi))}
 
     def rpc_report_plan(self, job_id: str, stages: List[str]) -> dict:
         """Runner reports its compiled plan's stage names — the

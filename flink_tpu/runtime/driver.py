@@ -162,8 +162,6 @@ class Driver:
 
     # -- construction ----------------------------------------------------
     def _build_ops(self) -> None:
-        from flink_tpu.ops.window import WindowOperator
-
         num_shards = self.config.get(StateOptions.NUM_KEY_SHARDS)
         slots = self.config.get(StateOptions.SLOTS_PER_SHARD)
         inflight = self.config.get(PipelineOptions.MAX_INFLIGHT_STEPS)
@@ -186,25 +184,27 @@ class Driver:
                 ooos.append(n.watermark_strategy.max_out_of_orderness_ms)
         wm = dataclasses.replace(self.plan.watermark_strategy,
                                  max_out_of_orderness_ms=max(ooos))
+        # operator factory SPI (ref: OneInputStreamOperatorFactory): a
+        # registered factory for a kind owns its construction — the
+        # built-in window operator goes through its own registered
+        # factory, third parties override by registering theirs
+        from flink_tpu.ops.factory import (
+            OperatorBuildContext,
+            lookup_operator_factory,
+        )
+
+        ctx = OperatorBuildContext(
+            config=self.config, mesh_plan=self.mesh_plan,
+            num_shards=num_shards, slots_per_shard=slots,
+            max_inflight_steps=inflight, exchange_capacity=xcap,
+            backend=backend,
+            exchange_impl=self.config.get(ClusterOptions.EXCHANGE_IMPL),
+            max_out_of_orderness_ms=wm.max_out_of_orderness_ms,
+        )
         for n in self.plan.nodes.values():
-            if n.kind == "window":
-                t = n.window_transform
-                self._ops[n.id] = WindowOperator(
-                    t.assigner, t.aggregate,
-                    num_shards=num_shards, slots_per_shard=slots,
-                    allowed_lateness_ms=t.allowed_lateness_ms,
-                    max_out_of_orderness_ms=max(wm.max_out_of_orderness_ms, 0),
-                    mesh_plan=self.mesh_plan,
-                    top_n=t.top_n,
-                    exchange_capacity=xcap,
-                    spill=(backend == "spill"),
-                    exchange_impl=self.config.get(ClusterOptions.EXCHANGE_IMPL),
-                )
-                self._ops[n.id].max_inflight_steps = inflight
-                # backpressure blocks happen OUTSIDE the push lock (the
-                # ingest loop calls throttle() after releasing it), so
-                # drain deliveries never queue behind a transfer wait
-                self._ops[n.id].external_throttle = True
+            factory = lookup_operator_factory(n.kind)
+            if factory is not None:
+                self._ops[n.id] = factory(n, ctx)
             elif n.kind == "async_io":
                 from flink_tpu.ops.async_io import AsyncIOOperator
 
@@ -429,6 +429,42 @@ class Driver:
         pend.is_savepoint = savepoint
         return pend
 
+    def _enumerate_owned(self, sid: int, n_splits: int) -> List[int]:
+        """Which split indices THIS runner reads (ref: FLIP-27
+        SplitEnumerator on the JM assigning splits to readers — SURVEY
+        §3.3 source runtime). 'local' (default) = all splits (single-
+        process execution); 'coordinator' = ask the job coordinator for
+        this runner's share, so multiple runners of one job divide the
+        source without overlap."""
+        from flink_tpu.config import SourceOptions
+
+        mode = self.config.get(SourceOptions.ENUMERATION)
+        if mode == "local" or n_splits == 0:
+            return list(range(n_splits))
+        if mode != "coordinator":
+            raise ValueError(
+                f"source.enumeration must be 'local' or 'coordinator', "
+                f"got {mode!r}")
+        from flink_tpu.runtime.rpc import RpcClient
+
+        addr = str(self.config.get_raw("cluster.coordinator", "")).strip()
+        job_id = str(self.config.get_raw("cluster.job-id", "")).strip()
+        runner_id = str(self.config.get_raw("cluster.runner-id", "")).strip()
+        if not (addr and job_id and runner_id):
+            raise ValueError(
+                "source.enumeration=coordinator needs cluster.coordinator"
+                ", cluster.job-id and cluster.runner-id (the runner "
+                "injects them on deploy)")
+        host, _, port = addr.partition(":")
+        c = RpcClient(host, int(port), timeout_s=10.0)
+        try:
+            resp = c.call("enumerate_splits", job_id=job_id,
+                          source_id=sid, n_splits=n_splits,
+                          runner_id=runner_id)
+        finally:
+            c.close()
+        return [int(i) for i in resp["splits"]]
+
     def _debloat_split(self, data, ts):
         """Re-chunk one source batch to the debloater's current chunk
         size (no-op generator when the debloater is off or the batch
@@ -594,7 +630,7 @@ class Driver:
             # unblock + join prefetch feeders: one blocked thread and
             # `depth` buffered batches would leak per split per attempt
             for its in getattr(self, "_srcs", {}).values():
-                for it in its:
+                for it in its.values():
                     if isinstance(it, _Prefetcher):
                         it.close()
             if self._metrics_server is not None:
@@ -644,20 +680,28 @@ class Driver:
 
         # registered on self INCREMENTALLY so prefetchers opened before a
         # mid-construction open_split failure are reachable from run()'s
-        # failure cleanup
+        # failure cleanup. Keyed by GLOBAL split index: with
+        # coordinator-side enumeration this runner opens only the
+        # indices the enumerator assigned it, but positions/watermark
+        # state stay globally indexed (checkpoints are runner-agnostic).
         srcs = self._srcs = {}
+        self._owned_splits: Dict[int, List[int]] = {}
         prefetch = self.config.get(PipelineOptions.SOURCE_PREFETCH)
         for sid in self.plan.sources:
             n = self.plan.node(sid)
-            lst = srcs[sid] = []
-            for i, s in enumerate(n.source.splits()):
-                it = n.source.open_split(s, self._positions[sid].get(i, 0))
-                lst.append(_Prefetcher(it, depth=prefetch)
-                           if prefetch > 0 else it)
+            splits = n.source.splits()
+            owned = self._enumerate_owned(sid, len(splits))
+            self._owned_splits[sid] = owned
+            d = srcs[sid] = {}
+            for i in owned:
+                it = n.source.open_split(splits[i],
+                                         self._positions[sid].get(i, 0))
+                d[i] = (_Prefetcher(it, depth=prefetch)
+                        if prefetch > 0 else it)
 
         last_chk = time.time()
         prof = self.prof
-        active = {sid: list(range(len(its))) for sid, its in srcs.items()}
+        active = {sid: sorted(its) for sid, its in srcs.items()}
         while any(active.values()):
             for sid, splits_alive in list(active.items()):
                 if not splits_alive:
@@ -705,13 +749,17 @@ class Driver:
                         self._wm_gens[sid][split_ix].on_batch(mx)
                         self._wm_lag.set(mx - self._out_wm[sid])
                 # exhausted splits stop holding the watermark back
-                # (ref: idle-channel handling in the valve)
-                gens = [g for i, g in enumerate(self._wm_gens[sid])
-                        if i in splits_alive]
+                # (ref: idle-channel handling in the valve). Combines run
+                # over OWNED splits only — an enumerator-assigned subset
+                # must not let never-advancing foreign splits pin the
+                # watermark at the floor.
+                gens = [self._wm_gens[sid][i] for i in splits_alive]
+                owned = self._owned_splits.get(sid) or []
                 if gens:
                     self._out_wm[sid] = min(g.current() for g in gens)
-                elif self._wm_gens[sid]:
-                    self._out_wm[sid] = min(g.current() for g in self._wm_gens[sid])
+                elif owned:
+                    self._out_wm[sid] = min(
+                        self._wm_gens[sid][i].current() for i in owned)
                 t3 = time.perf_counter()
                 with self._push_lock:
                     self._propagate_watermarks()

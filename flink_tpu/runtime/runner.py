@@ -52,14 +52,19 @@ class SavepointRequest(threading.Event):
         self.token: Optional[str] = None
 
     def on_complete(self, path: str) -> None:
-        if self.stop_after:
+        # report FIRST, stop only if the report was delivered: stopping
+        # on a lost report would leave the job halted here but RUNNING
+        # forever on the coordinator (no redeploy, no failure routing) —
+        # better to keep running at the old width and let the operator
+        # retry the rescale
+        delivered = self._runner._report("savepoint_complete",
+                                         job_id=self._job_id, path=path,
+                                         token=self.token)
+        if self.stop_after and delivered:
             with self._runner._lock:
                 j = self._runner._jobs.get(self._job_id)
                 if j is not None:
                     j["cancel"].set()
-        self._runner._report("savepoint_complete",
-                             job_id=self._job_id, path=path,
-                             token=self.token)
 
 
 class TaskRunner(RpcEndpoint):
@@ -257,6 +262,11 @@ class TaskRunner(RpcEndpoint):
                 return {"ok": False,
                         "reason": "job has no checkpointing configured "
                                   "(execution.checkpointing.interval)"}
+            if j["savepoint"].is_set():
+                # a pending request's stop/token must not be overwritten
+                # (a routine savepoint racing a rescale's would strip the
+                # rescale token and strand it armed forever)
+                return {"ok": False, "reason": "savepoint already pending"}
             j["savepoint"].stop_after = stop
             j["savepoint"].token = token
             j["savepoint"].set()
@@ -283,16 +293,24 @@ class TaskRunner(RpcEndpoint):
             mod_name, _, fn_name = entry.partition(":")
             mod = importlib.import_module(mod_name)
             build = getattr(mod, fn_name)
+            # identity injection: the driver's coordinator-side split
+            # enumeration (source.enumeration=coordinator) needs to know
+            # which runner it is and where the enumerator lives
+            config.setdefault("cluster.job-id", job_id)
+            config.setdefault("cluster.runner-id", self.runner_id)
+            config.setdefault(
+                "cluster.coordinator",
+                f"{self._coord_addr[0]}:{self._coord_addr[1]}")
             env = StreamExecutionEnvironment(Configuration(config))
             build(env)
             self._report_plan(job_id, env)
             env.execute(job_id, cancel=cancel,
                         savepoint_request=rec.get("savepoint"))
-            self._report("finish_job", job_id=job_id)
+            self._report("finish_job", job_id=job_id, attempt=attempt)
         except JobCancelledError:
             pass  # the canceller (coordinator) already owns the state
         except BaseException:  # noqa: BLE001 — every fault goes upstream
-            self._report("report_failure", job_id=job_id,
+            self._report("report_failure", job_id=job_id, attempt=attempt,
                          error=traceback.format_exc(limit=5))
         finally:
             if jobdir is not None:
@@ -358,11 +376,12 @@ class TaskRunner(RpcEndpoint):
         except Exception:  # noqa: BLE001 — reporting is best-effort
             pass
 
-    def _report(self, method: str, **kw: Any) -> None:
+    def _report(self, method: str, **kw: Any) -> bool:
         try:
             self._coord.call(method, **kw)
+            return True
         except RpcError:
-            pass  # coordinator down: its own recovery re-syncs state
+            return False  # coordinator down: its recovery re-syncs state
 
 
 def main(argv: Optional[list] = None) -> None:
