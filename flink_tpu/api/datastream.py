@@ -31,6 +31,7 @@ from flink_tpu.graph.transformations import (
     Transformation,
     UnionTransformation,
     WindowAggregateTransformation,
+    BroadcastConnectTransformation,
     WindowJoinTransformation,
 )
 from flink_tpu.ops.aggregates import LaneAggregate
@@ -164,6 +165,14 @@ class DataStream:
     def join(self, other: "DataStream") -> "JoinBuilder":
         """ref: DataStream.join → JoinedStreams (where/equalTo/window)."""
         return JoinBuilder(self, other)
+
+    def connect(self, broadcast: "DataStream") -> "BroadcastConnectedStream":
+        """Connect THIS (data) stream with a low-volume CONTROL stream
+        whose elements replicate into broadcast state (ref: DataStream
+        .connect(BroadcastStream) → BroadcastConnectedStream; the
+        broadcast state pattern). ``.process(fn)`` with a
+        BroadcastProcessFunction completes the pair."""
+        return BroadcastConnectedStream(self, broadcast)
 
     # -- sinks -----------------------------------------------------------
     def add_sink(self, sink: Any, name: str = "sink") -> "DataStream":
@@ -447,3 +456,19 @@ class WindowedJoin:
             mode=mode)
         env._register(t)
         return DataStream(env, t)
+
+
+class BroadcastConnectedStream:
+    """ref: BroadcastConnectedStream — the (data, control) pair awaiting
+    its BroadcastProcessFunction."""
+
+    def __init__(self, data: DataStream, control: DataStream) -> None:
+        self._data = data
+        self._control = control
+
+    def process(self, fn: Any,
+                name: str = "broadcast_connect") -> DataStream:
+        t = BroadcastConnectTransformation(
+            name, (self._data.transform, self._control.transform), fn=fn)
+        self._data.env._register(t)
+        return DataStream(self._data.env, t)

@@ -21,6 +21,7 @@ import numpy as np
 
 from flink_tpu.config import Configuration, PipelineOptions, StateOptions
 from flink_tpu.graph.transformations import (
+    BroadcastConnectTransformation,
     KeyByTransformation,
     MapTransformation,
     AsyncIOTransformation,
@@ -185,6 +186,14 @@ def compile_job(
             lup = node_for(t.inputs[0])
             rup = node_for(t.inputs[1])
             n = new_node("join", t.name, window_transform=t,
+                         left_input=lup, right_input=rup)
+            nodes[lup].downstream.append(n.id)
+            nodes[rup].downstream.append(n.id)
+        elif isinstance(t, BroadcastConnectTransformation):
+            # left = data stream, right = control (broadcast) stream
+            lup = node_for(t.inputs[0])
+            rup = node_for(t.inputs[1])
+            n = new_node("broadcast_connect", t.name, window_transform=t,
                          left_input=lup, right_input=rup)
             nodes[lup].downstream.append(n.id)
             nodes[rup].downstream.append(n.id)

@@ -187,3 +187,12 @@ class SinkTransformation(Transformation):
 @dataclasses.dataclass(eq=False)
 class UnionTransformation(Transformation):
     """ref: UnionTransformation.java — merge same-schema streams."""
+
+
+@dataclasses.dataclass(eq=False)
+class BroadcastConnectTransformation(Transformation):
+    """Two-input broadcast connect: inputs = (data stream, control
+    stream); the control side replicates into broadcast state (ref:
+    BroadcastConnectedStream + CoBroadcastWithNonKeyedOperator)."""
+
+    fn: Any = None

@@ -63,6 +63,10 @@ class JobInfo:
     pending_rescale: Optional[int] = None
     rescale_token: Optional[str] = None
     restore_path: Optional[str] = None
+    # per-runner completion of the CURRENT attempt: the job finishes
+    # when every assigned runner reports done (an empty-split-share
+    # runner finishing early must not end the whole job)
+    finished_runners: List[str] = dataclasses.field(default_factory=list)
     # physical graph: stages × parallelism, per-attempt execution states
     egraph: Optional[ExecutionGraph] = None
 
@@ -268,6 +272,7 @@ class JobCoordinator(RpcEndpoint):
             j.state = "RUNNING"
             j.failure = None
             j.assigned_runners = [target.runner_id]
+            j.finished_runners = []
             if j.egraph is not None:
                 j.egraph.start_attempt(j.attempts, target.runner_id)
             self._persist_locked(j)
@@ -377,7 +382,8 @@ class JobCoordinator(RpcEndpoint):
         t.start()
 
     def rpc_finish_job(self, job_id: str,
-                       attempt: Optional[int] = None) -> dict:
+                       attempt: Optional[int] = None,
+                       runner_id: Optional[str] = None) -> dict:
         with self._lock:
             j = self.jobs.get(job_id)
             # attempt fencing: a zombie attempt finishing late must not
@@ -386,6 +392,16 @@ class JobCoordinator(RpcEndpoint):
             if (j is not None and attempt is not None
                     and attempt != j.attempts):
                 return {"ok": False, "reason": "stale attempt"}
+            # multi-runner jobs: one runner done ≠ job done — wait for
+            # every assigned runner (a runner with an empty split share
+            # finishes instantly; the peers are still reading)
+            if (j is not None and runner_id is not None
+                    and len(j.assigned_runners) > 1):
+                if runner_id not in j.finished_runners:
+                    j.finished_runners.append(runner_id)
+                if set(j.assigned_runners) - set(j.finished_runners):
+                    return {"ok": True, "pending_runners": sorted(
+                        set(j.assigned_runners) - set(j.finished_runners))}
             # terminal states stand: a runner that missed its cancel and
             # ran to completion does not flip CANCELED back to FINISHED
             if j is not None and j.state in ("RUNNING", "RESTARTING"):
@@ -556,9 +572,11 @@ class JobCoordinator(RpcEndpoint):
             runners = list(j.assigned_runners)
         k = len(runners)
         p = runners.index(runner_id)
-        lo = p * n_splits // k
-        hi = (p + 1) * n_splits // k
-        return {"splits": list(range(lo, hi))}
+        # strided shares: imbalance <= 1 split; with fewer splits than
+        # runners some runners legitimately own none of THIS source
+        # (the per-runner finish tracking in rpc_finish_job keeps an
+        # empty-share runner's completion from ending the whole job)
+        return {"splits": list(range(p, n_splits, k))}
 
     def rpc_report_plan(self, job_id: str, stages: List[str]) -> dict:
         """Runner reports its compiled plan's stage names — the

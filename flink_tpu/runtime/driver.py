@@ -257,6 +257,11 @@ class Driver:
                     num_shards=num_shards, slots_per_shard=slots,
                     max_out_of_orderness_ms=max(wm.max_out_of_orderness_ms, 0),
                 )
+            elif n.kind == "broadcast_connect":
+                from flink_tpu.ops.broadcast import BroadcastConnectOperator
+
+                self._ops[n.id] = BroadcastConnectOperator(
+                    n.window_transform.fn)
             elif n.kind == "join":
                 from flink_tpu.ops.join import WindowJoinOperator
 
@@ -514,6 +519,13 @@ class Driver:
         req = self._savepoint_request
         if req is None or not req.is_set():
             return
+        # snapshot the request's identity BEFORE clearing: the moment
+        # the event clears, a new trigger may overwrite stop_after/token
+        # on the shared request object while the (long, synchronous)
+        # savepoint write runs — completion must report the values of
+        # the request it actually served
+        stop_after = getattr(req, "stop_after", False)
+        token = getattr(req, "token", None)
         req.clear()
         if self._coordinator is None:
             return  # unreachable via the runner path (validated there)
@@ -521,7 +533,10 @@ class Driver:
         self.last_savepoint = h.path
         cb = getattr(req, "on_complete", None)
         if cb is not None:
-            cb(h.path)
+            try:
+                cb(h.path, stop_after=stop_after, token=token)
+            except TypeError:
+                cb(h.path)  # simple callbacks (tests) take path only
 
     def _complete_pending_checkpoint(self, wait: bool = False):
         """Apply the 2PC commit of a finished background checkpoint on
@@ -692,6 +707,11 @@ class Driver:
             splits = n.source.splits()
             owned = self._enumerate_owned(sid, len(splits))
             self._owned_splits[sid] = owned
+            if not owned:
+                # this runner owns nothing of the source: exhausted from
+                # birth — its watermark must not pin downstream at the
+                # floor while peers' shares flow
+                self._out_wm[sid] = _FINAL
             d = srcs[sid] = {}
             for i in owned:
                 it = n.source.open_split(splits[i],
@@ -903,6 +923,15 @@ class Driver:
             else:
                 keys = np.asarray(data[t.right_key], np.int64)
                 op.process_right(keys, ts, data, valid)
+        elif n.kind == "broadcast_connect":
+            op = self._ops[nid]
+            if from_node == n.right_input:
+                op.process_broadcast(ts, data, valid)
+            else:
+                op.process_main(ts, data, valid)
+            fired = op.take_fired()
+            if fired is not None:
+                self._emit_fired(nid, fired)
         elif n.kind == "sink":
             compact = {k: v[valid] for k, v in data.items()}
             nrec = int(valid.sum())
@@ -995,7 +1024,8 @@ class Driver:
                 seen.add(d)
                 k = self.plan.node(d).kind
                 if k in ("window", "session", "join", "count_window",
-                         "window_all", "process", "async_io", "cep"):
+                         "window_all", "process", "async_io", "cep",
+                         "broadcast_connect"):
                     ok = False
                     break
                 stack.extend(self.plan.node(d).downstream)

@@ -51,7 +51,16 @@ class SavepointRequest(threading.Event):
         self.stop_after = False
         self.token: Optional[str] = None
 
-    def on_complete(self, path: str) -> None:
+    def on_complete(self, path: str,
+                    stop_after: Optional[bool] = None,
+                    token: Optional[str] = None) -> None:
+        # the driver passes the (stop_after, token) it captured at
+        # request PICKUP — the instance attributes may already belong to
+        # a newer request by completion time
+        if stop_after is None:
+            stop_after = self.stop_after
+        if token is None:
+            token = self.token
         # report FIRST, stop only if the report was delivered: stopping
         # on a lost report would leave the job halted here but RUNNING
         # forever on the coordinator (no redeploy, no failure routing) —
@@ -59,8 +68,8 @@ class SavepointRequest(threading.Event):
         # retry the rescale
         delivered = self._runner._report("savepoint_complete",
                                          job_id=self._job_id, path=path,
-                                         token=self.token)
-        if self.stop_after and delivered:
+                                         token=token)
+        if stop_after and delivered:
             with self._runner._lock:
                 j = self._runner._jobs.get(self._job_id)
                 if j is not None:
@@ -306,7 +315,8 @@ class TaskRunner(RpcEndpoint):
             self._report_plan(job_id, env)
             env.execute(job_id, cancel=cancel,
                         savepoint_request=rec.get("savepoint"))
-            self._report("finish_job", job_id=job_id, attempt=attempt)
+            self._report("finish_job", job_id=job_id, attempt=attempt,
+                         runner_id=self.runner_id)
         except JobCancelledError:
             pass  # the canceller (coordinator) already owns the state
         except BaseException:  # noqa: BLE001 — every fault goes upstream

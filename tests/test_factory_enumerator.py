@@ -123,3 +123,27 @@ class TestSplitEnumerator:
         finally:
             srv.close()
             coord.close()
+
+    def test_more_runners_than_splits_still_finishes_correctly(self):
+        """An assigned runner with an empty share must not end the job
+        while peers still read: finish requires ALL runners."""
+        coord = JobCoordinator(Configuration({}))
+        try:
+            for r in ("a", "b", "c"):
+                coord.rpc_register_runner(r, "h", 1)
+            coord.rpc_submit_job("j", runners=["a", "b", "c"])
+            shares = {r: coord.rpc_enumerate_splits("j", 0, 2, r)["splits"]
+                      for r in ("a", "b", "c")}
+            all_ix = sorted(i for s in shares.values() for i in s)
+            assert all_ix == [0, 1]
+            empty = [r for r, s in shares.items() if not s]
+            assert empty  # someone owns nothing
+            # the empty-share runner finishing does NOT end the job
+            resp = coord.rpc_finish_job("j", runner_id=empty[0])
+            assert resp.get("pending_runners")
+            assert coord.rpc_job_status("j")["state"] == "RUNNING"
+            for r in ("a", "b", "c"):
+                coord.rpc_finish_job("j", runner_id=r)
+            assert coord.rpc_job_status("j")["state"] == "FINISHED"
+        finally:
+            coord.close()
