@@ -21,7 +21,7 @@ from flink_tpu.api.sources import Source
 from flink_tpu.formats import Format
 from flink_tpu.fs import get_filesystem
 
-__all__ = ["FileSource", "FileSink"]
+__all__ = ["FileSource", "FileSink", "SocketSource"]
 
 Batch = Tuple[Dict[str, np.ndarray], np.ndarray]
 
@@ -202,3 +202,141 @@ class FileSink(Sink):
             out.append(self.format.deserialize(
                 raw if isinstance(raw, bytes) else raw.encode()))
         return out
+
+
+class SocketSource(Source):
+    """Line-framed TCP ingest source (ref: socketTextStream +
+    SocketSourceFunction; transport per SURVEY §3.10 item 3 — the C
+    reader in native/codec.cc, with a pure-Python fallback). The source
+    LISTENS; a producer connects and streams newline-separated records;
+    the stream ends when the producer disconnects.
+
+    Like the reference's socket source, this is NOT replayable: a
+    restore cannot re-read a socket, so exactly-once holds only from
+    ingest onward (``open_split`` ignores ``start_pos``). Timestamps
+    come from ``ts_field`` when the format provides it, else ingest
+    time."""
+
+    def __init__(self, port: int = 0, format: Optional[Format] = None,
+                 ts_field: Optional[str] = None,
+                 block_bytes: int = 1 << 20,
+                 poll_ms: int = 100) -> None:
+        self.format = format
+        self.ts_field = ts_field
+        self.block_bytes = block_bytes
+        self.poll_ms = poll_ms
+        from flink_tpu.native_codec import NativeSocketReader
+
+        self._reader = NativeSocketReader.create(port)
+        if self._reader is None:
+            self._reader = _PySocketReader(port)
+        self.port = self._reader.port
+
+    def splits(self) -> List[str]:
+        return ["socket"]
+
+    def bounded(self) -> bool:
+        return True  # ends when the producer disconnects
+
+    def _empty_batch(self):
+        """Zero-length but SCHEMA-TYPED columns: downstream chains index
+        columns on every batch, so an idle tick must present the same
+        shape as a data batch."""
+        if self.format is not None:
+            return self.format.deserialize(b"")
+        return {"line": np.array([], dtype=object)}
+
+    def open_split(self, split: str, start_pos: int = 0):
+        import time as _time
+
+        # wait for a producer — yielding an empty batch per poll hands
+        # control back to the driver between next() calls, so cancel /
+        # stop-with-savepoint work while nobody has connected yet
+        while self._reader.accept(self.poll_ms) == 0:
+            yield self._empty_batch(), np.zeros(0, np.int64)
+        while True:
+            block = self._reader.read_block(self.block_bytes, self.poll_ms)
+            if block is None:
+                break  # producer disconnected
+            if not block:
+                # timeout with no complete line: emit an empty batch so
+                # the driver keeps its loop (watermarks/checkpoints)
+                # alive on an idle socket
+                yield self._empty_batch(), np.zeros(0, np.int64)
+                continue
+            if self.format is not None:
+                data = self.format.deserialize(block)
+            else:
+                lines = block.decode("utf-8", "replace").splitlines()
+                data = {"line": np.array(lines, dtype=object)}
+            n = len(next(iter(data.values()), []))
+            if self.ts_field is not None and self.ts_field in data:
+                ts = np.asarray(data[self.ts_field], np.int64)
+            else:
+                ts = np.full(n, np.int64(_time.time() * 1000))
+            yield data, ts
+        self._reader.close()
+
+
+class _PySocketReader:
+    """Pure-Python fallback matching NativeSocketReader's contract."""
+
+    def __init__(self, port: int = 0) -> None:
+        import socket
+
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", port))
+        self._srv.listen(1)
+        self._conn = None
+        self._carry = b""
+
+    @property
+    def port(self) -> int:
+        return self._srv.getsockname()[1]
+
+    def accept(self, timeout_ms: int = 100) -> int:
+        import socket
+
+        if self._conn is not None:
+            return 1
+        self._srv.settimeout(timeout_ms / 1000)
+        try:
+            self._conn, _ = self._srv.accept()
+        except socket.timeout:
+            return 0
+        return 1
+
+    def read_block(self, cap: int = 1 << 20,
+                   timeout_ms: int = 100) -> Optional[bytes]:
+        import socket
+
+        self._conn.settimeout(timeout_ms / 1000)
+        buf = self._carry
+        while True:
+            nl = buf.rfind(b"\n")
+            if nl >= 0 and (len(buf) >= cap or nl + 1 >= cap):
+                self._carry = buf[nl + 1:]
+                return buf[:nl + 1]
+            if nl < 0 and len(buf) >= cap:
+                # single line longer than cap: same loud contract as the
+                # native reader (never buffer unboundedly)
+                raise IOError(
+                    f"socket reader error (a line exceeded {cap} bytes)")
+            try:
+                chunk = self._conn.recv(max(cap - len(buf), 1))
+            except socket.timeout:
+                if nl >= 0:
+                    self._carry = buf[nl + 1:]
+                    return buf[:nl + 1]
+                self._carry = buf
+                return b""
+            if not chunk:
+                self._carry = b""
+                return buf[:nl + 1] if nl >= 0 else None
+            buf += chunk
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+        self._srv.close()

@@ -103,6 +103,17 @@ def _bind(lib, i64p, f32p) -> None:
     lib.ht_insert.argtypes = [ctypes.c_void_p, i64p, i64p, ctypes.c_int64]
     lib.hash_keys.restype = None
     lib.hash_keys.argtypes = [i64p, ctypes.c_int64, i64p]
+    lib.sr_listen.restype = ctypes.c_void_p
+    lib.sr_listen.argtypes = [ctypes.c_int]
+    lib.sr_port.restype = ctypes.c_int
+    lib.sr_port.argtypes = [ctypes.c_void_p]
+    lib.sr_accept.restype = ctypes.c_int
+    lib.sr_accept.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.sr_read_block.restype = ctypes.c_int64
+    lib.sr_read_block.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int]
+    lib.sr_close.restype = None
+    lib.sr_close.argtypes = [ctypes.c_void_p]
 
 
 def native_available() -> bool:
@@ -264,3 +275,56 @@ class NativeHashTable:
         keys = np.ascontiguousarray(keys, np.int64)
         vals = np.ascontiguousarray(vals, np.int64)
         self._lib.ht_insert(self._h, keys, vals, len(keys))
+
+
+class NativeSocketReader:
+    """Line-framed TCP ingest socket in C (SURVEY §3.10 item 3 — the
+    Netty-native-transport analogue feeding the codec). One listener,
+    one connection; ``read_block`` returns byte blocks that END at a
+    newline, ready for the table parsers. ``create()`` returns None
+    when the library is unavailable (callers fall back to the pure-
+    Python reader)."""
+
+    def __init__(self, lib, handle) -> None:
+        self._lib = lib
+        self._h = handle
+
+    @classmethod
+    def create(cls, port: int = 0) -> Optional["NativeSocketReader"]:
+        lib = _load()
+        if lib is None:
+            return None
+        h = lib.sr_listen(port)
+        return cls(lib, h) if h else None
+
+    @property
+    def port(self) -> int:
+        return int(self._lib.sr_port(self._h))
+
+    def accept(self, timeout_ms: int = 100) -> int:
+        """1 = connected, 0 = timeout, -1 = error."""
+        return int(self._lib.sr_accept(self._h, timeout_ms))
+
+    def read_block(self, cap: int = 1 << 20,
+                   timeout_ms: int = 100) -> Optional[bytes]:
+        """Complete-line block (bytes), b'' on timeout, None on EOF.
+        Raises on transport errors / oversized lines. The scratch
+        buffer is reused across calls — idle polls (b'' every
+        ``timeout_ms``) must not allocate+zero a megabyte each."""
+        buf = getattr(self, "_buf", None)
+        if buf is None or len(buf) < cap:
+            buf = self._buf = ctypes.create_string_buffer(cap)
+        n = int(self._lib.sr_read_block(self._h, buf, cap, timeout_ms))
+        if n > 0:
+            return buf.raw[:n]
+        if n == 0:
+            return b""
+        if n == -1:
+            return None
+        raise IOError("socket reader error (closed early or a line "
+                      f"exceeded {cap} bytes)")
+
+    def close(self) -> None:
+        h, self._h = self._h, None
+        if h:
+            self._lib.sr_close(h)

@@ -468,6 +468,7 @@ class JobCoordinator(RpcEndpoint):
             delay = strat.next_delay_ms()
             j.state = "RESTARTING"
             j.attempts += 1
+            j.finished_runners = []  # the new attempt starts from zero
             self._persist_locked(j)
             return {"action": "restart", "delay_ms": delay,
                     "restore": "latest"}
@@ -626,6 +627,7 @@ class JobCoordinator(RpcEndpoint):
                 j.state = "RESTARTING"
                 old_attempt = j.attempts
                 j.attempts += 1
+                j.finished_runners = []
                 self._slots.release(job_id)
                 if j.egraph is not None:
                     j.egraph.set_parallelism(max(1, new))

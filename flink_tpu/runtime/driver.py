@@ -533,9 +533,21 @@ class Driver:
         self.last_savepoint = h.path
         cb = getattr(req, "on_complete", None)
         if cb is not None:
+            # arity by signature, NOT by catching TypeError — a TypeError
+            # raised INSIDE the callback must not trigger a second,
+            # wrongly-argumented invocation (double savepoint report)
+            import inspect
+
             try:
+                params = inspect.signature(cb).parameters
+                rich = ("stop_after" in params
+                        or any(p.kind == p.VAR_KEYWORD
+                               for p in params.values()))
+            except (TypeError, ValueError):
+                rich = False
+            if rich:
                 cb(h.path, stop_after=stop_after, token=token)
-            except TypeError:
+            else:
                 cb(h.path)  # simple callbacks (tests) take path only
 
     def _complete_pending_checkpoint(self, wait: bool = False):

@@ -242,3 +242,139 @@ void hash_keys(const int64_t* keys, int64_t n, int64_t* out) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Host ingest socket reader (SURVEY §3.10 item 3: the Netty-native-
+// transport analogue — a C socket layer feeding the codec above).
+// One TCP listener, one connection at a time, line-framed text records;
+// reads return blocks that END at a newline so the caller can hand the
+// bytes straight to parse_i64_table/parse_f32_table without reassembly.
+// poll()-based timeouts keep the Python caller cancellable.
+
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+extern "C" {
+
+struct SockReader {
+  int listen_fd;
+  int conn_fd;
+  // carry: bytes after the last newline of the previous read
+  char* carry;
+  int64_t carry_len;
+  int64_t carry_cap;
+};
+
+void* sr_listen(int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(fd, 1) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  SockReader* r = (SockReader*)calloc(1, sizeof(SockReader));
+  r->listen_fd = fd;
+  r->conn_fd = -1;
+  r->carry_cap = 1 << 16;
+  r->carry = (char*)malloc(r->carry_cap);
+  return r;
+}
+
+int sr_port(void* h) {
+  SockReader* r = (SockReader*)h;
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (getsockname(r->listen_fd, (sockaddr*)&addr, &len) != 0) return -1;
+  return ntohs(addr.sin_port);
+}
+
+// 1 = connected, 0 = timeout, -1 = error
+int sr_accept(void* h, int timeout_ms) {
+  SockReader* r = (SockReader*)h;
+  if (r->conn_fd >= 0) return 1;
+  pollfd p{r->listen_fd, POLLIN, 0};
+  int rc = poll(&p, 1, timeout_ms);
+  if (rc == 0) return 0;
+  if (rc < 0) return -1;
+  r->conn_fd = accept(r->listen_fd, nullptr, nullptr);
+  if (r->conn_fd < 0) return -1;
+  int one = 1;
+  setsockopt(r->conn_fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return 1;
+}
+
+static int64_t sr_last_newline(const char* buf, int64_t n) {
+  for (int64_t i = n - 1; i >= 0; --i)
+    if (buf[i] == '\n') return i;
+  return -1;
+}
+
+// Flush out[0..nl] as the block; out[nl+1..have) goes back onto the
+// FRONT of the carry (it precedes anything already carried).
+static int64_t sr_flush(SockReader* r, char* out, int64_t have,
+                        int64_t nl) {
+  int64_t tail = have - (nl + 1);
+  if (tail > 0) {
+    if (r->carry_len + tail > r->carry_cap) {
+      r->carry_cap = (r->carry_len + tail) * 2;
+      r->carry = (char*)realloc(r->carry, r->carry_cap);
+    }
+    memmove(r->carry + tail, r->carry, r->carry_len);
+    memcpy(r->carry, out + nl + 1, tail);
+    r->carry_len += tail;
+  }
+  return nl + 1;
+}
+
+// Read COMPLETE lines into out (<= cap bytes, ending at a newline).
+// Returns bytes written; 0 = timeout (no complete line yet);
+// -1 = connection closed (an unterminated tail at EOF is not a
+// record under line framing and is discarded); -2 = error
+// (including a single line longer than cap).
+int64_t sr_read_block(void* h, char* out, int64_t cap, int timeout_ms) {
+  SockReader* r = (SockReader*)h;
+  if (r->conn_fd < 0) return -2;
+  int64_t have = r->carry_len < cap ? r->carry_len : cap;
+  memcpy(out, r->carry, have);
+  memmove(r->carry, r->carry + have, r->carry_len - have);
+  r->carry_len -= have;
+  for (;;) {
+    int64_t nl = sr_last_newline(out, have);
+    if (nl >= 0 && (have == cap || r->carry_len > 0))
+      return sr_flush(r, out, have, nl);  // buffer full / carry pending
+    if (have == cap)
+      return -2;  // full buffer, no newline: oversized line
+    pollfd p{r->conn_fd, POLLIN, 0};
+    int rc = poll(&p, 1, timeout_ms);
+    if (rc == 0)
+      return nl >= 0 ? sr_flush(r, out, have, nl) : 0;
+    if (rc < 0) return -2;
+    int64_t n = read(r->conn_fd, out + have, cap - have);
+    if (n == 0) {
+      int64_t nl2 = sr_last_newline(out, have);
+      return nl2 >= 0 ? nl2 + 1 : -1;  // EOF
+    }
+    if (n < 0) return -2;
+    have += n;
+  }
+}
+
+void sr_close(void* h) {
+  SockReader* r = (SockReader*)h;
+  if (r->conn_fd >= 0) close(r->conn_fd);
+  close(r->listen_fd);
+  free(r->carry);
+  free(r);
+}
+
+}  // extern "C"
