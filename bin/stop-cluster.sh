@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+set -euo pipefail
+PIDDIR="${FLINK_TPU_PID_DIR:-/tmp/flink-tpu}"
+for role in runner coordinator; do
+  if [[ -f "$PIDDIR/$role.pid" ]]; then
+    kill "$(cat "$PIDDIR/$role.pid")" 2>/dev/null || true
+    rm -f "$PIDDIR/$role.pid"
+    echo "stopped $role"
+  fi
+done
