@@ -1,0 +1,40 @@
+"""Host-side completion waiting for device values.
+
+``jax.block_until_ready`` on some PJRT backends — measured on the
+remote-attached TPU this framework targets — parks the waiting thread
+on a coarse completion-poll quantum (~50ms per wait) whenever the value
+is not yet ready; the same is true for an unannounced ``np.asarray``
+device→host fetch (~90ms fixed). A cooperative ``is_ready()`` spin with
+a short sleep observes completion at millisecond granularity instead
+(measured 1.4ms vs 56ms per throttled step on the same pipeline).
+
+Every hot-path wait in the runtime goes through ``ready_wait``; cold
+paths (tests, shutdown) may keep ``block_until_ready``.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+
+# 2ms: well under the per-microbatch budget, far over the ~0.4us cost
+# of an is_ready() probe
+POLL_S = 0.002
+
+
+def ready_wait(x, poll_s: float = POLL_S):
+    """Wait until every array leaf of ``x`` is ready, without parking
+    the thread on the backend's coarse blocking-wait quantum. Returns
+    ``x`` for chaining."""
+    for leaf in jax.tree_util.tree_leaves(x):
+        is_ready = getattr(leaf, "is_ready", None)
+        if is_ready is None:
+            continue
+        try:
+            while not is_ready():
+                time.sleep(poll_s)
+        except RuntimeError:
+            # deleted/donated buffers surface here; the caller's next
+            # use raises the real error with context
+            return x
+    return x

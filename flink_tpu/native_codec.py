@@ -114,6 +114,12 @@ def _bind(lib, i64p, f32p) -> None:
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int]
     lib.sr_close.restype = None
     lib.sr_close.argtypes = [ctypes.c_void_p]
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    lib.preagg_combine.restype = ctypes.c_int64
+    lib.preagg_combine.argtypes = [
+        ctypes.c_int64, i64p, i64p, u8p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, f64p, i32p, f64p, i32p, i32p, f32p, ctypes.c_int64]
 
 
 def native_available() -> bool:
@@ -328,3 +334,54 @@ class NativeSocketReader:
         h, self._h = self._h, None
         if h:
             self._lib.sr_close(h)
+
+
+class PreaggWorkspace:
+    """Caller-owned zeroed workspaces for ``preagg_combine`` (see
+    native/codec.cc): kept across batches so steady state never pays a
+    full-domain clear — the C side resets only touched entries."""
+
+    def __init__(self, domain: int, nlanes: int) -> None:
+        self.domain = domain
+        self.nlanes = nlanes
+        self.hist = np.zeros(domain, np.int32)
+        self.lane_acc = np.zeros(max(domain * nlanes, 1), np.float64)
+
+    def rezero(self) -> None:
+        self.hist[:] = 0
+        self.lane_acc[:] = 0.0
+
+
+def preagg_combine_native(
+    slots: np.ndarray, panes: np.ndarray, valid: np.ndarray,
+    lane_data: List[np.ndarray], ring: int, ws: PreaggWorkspace,
+    cap: int,
+) -> Optional[Tuple[np.ndarray, np.ndarray, List[np.ndarray]]]:
+    """C fast path of the window operator's host combine. Returns
+    (pairs, counts, lanes) or None (library unavailable / cap
+    overflow — fall back to the numpy path)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(slots)
+    nl = ws.nlanes
+    out_pairs = np.empty(cap, np.int32)
+    out_counts = np.empty(cap, np.int32)
+    out_lanes = np.empty((cap, nl) if nl else (1, 1), np.float32)
+    if nl:
+        lanes = np.ascontiguousarray(
+            np.stack([np.asarray(a, np.float64) for a in lane_data]))
+    else:
+        lanes = np.zeros(1, np.float64)
+    npairs = lib.preagg_combine(
+        n, np.ascontiguousarray(slots, np.int64),
+        np.ascontiguousarray(panes, np.int64),
+        np.ascontiguousarray(valid).view(np.uint8), ring, ws.domain,
+        nl, lanes.reshape(-1) if nl else lanes,
+        ws.hist, ws.lane_acc, out_pairs, out_counts,
+        out_lanes.reshape(-1), cap)
+    if npairs < 0:
+        ws.rezero()
+        return None
+    return (out_pairs[:npairs], out_counts[:npairs],
+            [out_lanes[:npairs, i].copy() for i in range(nl)])

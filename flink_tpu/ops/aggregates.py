@@ -55,6 +55,14 @@ class LaneAggregate:
     # throughput ceiling, so unused lanes must never ride it (count()
     # uploads nothing but the packed slot ids). None = unknown: keep all.
     fields: Optional[Tuple[str, ...]] = None
+    # When every sum lane is the IDENTITY lift of one record field
+    # (lane i == f32(data[sum_fields[i]])), the host can pre-combine a
+    # microbatch per (key, pane) pair with np.bincount before upload —
+    # the mini-batch local-aggregation trick (ref: table/runtime
+    # mini-batch agg, SURVEY §3.8) that shrinks both the host→device
+    # bytes and the device scatter from records to distinct pairs.
+    # None = lift is opaque; the operator must ship raw records.
+    sum_fields: Optional[Tuple[str, ...]] = None
 
     def lift_masked(self, data: Arrays, valid: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
         """Lift a batch, mapping invalid rows to identity elements.
@@ -118,7 +126,8 @@ def count(result_field: str = "count") -> LaneAggregate:
     def finalize(sums, maxs, mins, counts):
         return {result_field: counts}
 
-    return LaneAggregate(0, 0, 0, lift, finalize, name="count", fields=())
+    return LaneAggregate(0, 0, 0, lift, finalize, name="count", fields=(),
+                         sum_fields=())
 
 
 @_cached
@@ -134,7 +143,7 @@ def sum_of(field: str, result_field: Optional[str] = None) -> LaneAggregate:
         return {out: sums[..., 0]}
 
     return LaneAggregate(1, 0, 0, lift, finalize, name=f"sum({field})",
-                         fields=(field,))
+                         fields=(field,), sum_fields=(field,))
 
 
 @_cached
@@ -183,7 +192,7 @@ def avg_of(field: str, result_field: Optional[str] = None) -> LaneAggregate:
         return {out: sums[..., 0] / c}
 
     return LaneAggregate(1, 0, 0, lift, finalize, name=f"avg({field})",
-                         fields=(field,))
+                         fields=(field,), sum_fields=(field,))
 
 
 @_cached
@@ -229,9 +238,15 @@ def multi(*aggs: LaneAggregate) -> LaneAggregate:
             comp_fields = None
             break
         comp_fields = tuple(dict.fromkeys(comp_fields + a.fields))
+    comp_sum: Optional[Tuple[str, ...]] = ()
+    for a in aggs:
+        if a.sum_fields is None:
+            comp_sum = None
+            break
+        comp_sum = comp_sum + a.sum_fields
     return LaneAggregate(sw, mw, nw, lift, finalize,
                          name="+".join(a.name for a in aggs),
-                         fields=comp_fields)
+                         fields=comp_fields, sum_fields=comp_sum)
 
 
 # ---------------------------------------------------------------------------

@@ -230,7 +230,8 @@ class CountWindowOperator:
         return self.watermark
 
     def quiesce(self) -> None:
-        jax.block_until_ready(self.state[3])
+        from flink_tpu.hostsync import ready_wait
+        ready_wait(self.state[3])
 
     def throttle(self) -> None:  # driver-loop protocol compatibility
         pass

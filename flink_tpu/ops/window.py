@@ -45,6 +45,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from flink_tpu.api.windowing import WindowAssigner
+from flink_tpu.hostsync import ready_wait
 from flink_tpu.ops.aggregates import LaneAggregate
 from flink_tpu.parallel.mesh import AXIS, MeshPlan
 from flink_tpu.state.keyed import KeyDirectory, PaneState, PaneStateLayout, init_state
@@ -82,9 +83,12 @@ def apply_kernel(
 def _scatter_panes(state, rows, ring_ix, valid, data, agg):
     s_l, mx_l, mn_l = agg.lift_masked(data, valid)
     return PaneState(
-        sums=state.sums.at[rows, ring_ix].add(s_l),
-        maxs=state.maxs.at[rows, ring_ix].max(mx_l),
-        mins=state.mins.at[rows, ring_ix].min(mn_l),
+        sums=(state.sums.at[rows, ring_ix].add(s_l)
+              if state.sums is not None else None),
+        maxs=(state.maxs.at[rows, ring_ix].max(mx_l)
+              if state.maxs is not None else None),
+        mins=(state.mins.at[rows, ring_ix].min(mn_l)
+              if state.mins is not None else None),
         counts=state.counts.at[rows, ring_ix].add(valid.astype(jnp.int32)),
     )
 
@@ -136,6 +140,114 @@ def apply_kernel_split(
     return _scatter_panes(state, rows, ring_ix, valid, data, agg)
 
 
+def apply_preagg_u16_kernel(
+    state: PaneState,
+    buf: jax.Array,        # (P, 3) uint16: [pair lo16, pair hi16, count]
+    *,
+    ring: int,
+    dump_row: int,
+) -> PaneState:
+    """Fold a HOST-PRE-AGGREGATED microbatch in: the host combined the
+    batch per (slot, ring column) pair with np.bincount (the mini-batch
+    local-aggregation trick, ref: table/runtime mini-batch agg), so the
+    upload carries one (pair id, count) triple per DISTINCT pair —
+    ~6 bytes × (keys × panes touched) instead of 3 bytes × records.
+    For Q5's 2^20-record batches over 10k keys that is ~0.6 B/record on
+    a link that is the pipeline ceiling, and the device scatter shrinks
+    by the same records/pairs ratio. Count-only shape (sum lanes ride
+    the i32 variant). Sentinel pair 0xFFFFFFFF marks padding."""
+    b = buf.astype(jnp.int32)
+    pair = b[:, 0] | (b[:, 1] << 16)   # sentinel decodes to -1
+    ok = pair >= 0
+    p = jnp.where(ok, pair, 0)
+    rows = jnp.where(ok, p // ring, dump_row).astype(jnp.int32)
+    cols = (p % ring).astype(jnp.int32)
+    cnt = jnp.where(ok, b[:, 2], 0)
+    return PaneState(sums=state.sums, maxs=state.maxs, mins=state.mins,
+                     counts=state.counts.at[rows, cols].add(cnt))
+
+
+def apply_preagg_i32_kernel(
+    state: PaneState,
+    buf: jax.Array,        # (P, 2 + sum_width) int32:
+                           # [pair, count, f32-bitcast sum lanes...]
+    *,
+    sum_width: int,
+    ring: int,
+    dump_row: int,
+) -> PaneState:
+    """``apply_preagg_u16_kernel`` with per-pair pre-combined SUM lanes
+    (sum/avg aggregates whose lanes are identity lifts — see
+    LaneAggregate.sum_fields). Pair < 0 marks padding."""
+    pair = buf[:, 0]
+    ok = pair >= 0
+    p = jnp.where(ok, pair, 0)
+    rows = jnp.where(ok, p // ring, dump_row).astype(jnp.int32)
+    cols = (p % ring).astype(jnp.int32)
+    cnt = jnp.where(ok, buf[:, 1], 0)
+    counts = state.counts.at[rows, cols].add(cnt)
+    sums = state.sums
+    if sum_width:
+        lanes = lax.bitcast_convert_type(buf[:, 2:2 + sum_width], jnp.float32)
+        lanes = jnp.where(ok[:, None], lanes, 0.0)
+        sums = sums.at[rows, cols].add(lanes)
+    return PaneState(sums=sums, maxs=state.maxs, mins=state.mins,
+                     counts=counts)
+
+
+def preagg_combine(
+    slots: np.ndarray, cols: np.ndarray, valid: np.ndarray,
+    data: Dict[str, np.ndarray], sum_fields: Tuple[str, ...],
+    *, ring: int, domain: int,
+) -> Tuple[np.ndarray, np.ndarray, List[np.ndarray]]:
+    """Host half: combine one batch per (slot, ring column) pair.
+    Returns (pair ids, counts, per-lane pre-summed f32 arrays)."""
+    pk = (slots[valid] * ring + cols[valid]).astype(np.int32)
+    # compact to observed pairs (O(nv log nv)) — a dense
+    # minlength=domain histogram would allocate and zero O(domain)
+    # per batch, which at the 2^23 eligibility bound dwarfs the h2d
+    # bytes this path exists to save
+    pairs, inv, cnts = np.unique(pk, return_inverse=True,
+                                 return_counts=True)
+    lanes = []
+    for f in sum_fields:
+        acc = np.zeros(len(pairs), np.float64)
+        np.add.at(acc, inv, np.asarray(data[f], np.float64)[valid])
+        lanes.append(acc.astype(np.float32))
+    return pairs, cnts, lanes
+
+
+def preagg_encode_u16(pairs: np.ndarray, cnts: np.ndarray,
+                      cap: int) -> np.ndarray:
+    """(pairs, counts) → one (cap, 3) uint16 buffer (ONE h2d transfer;
+    a second buffer pays a second round trip). Padding rows carry the
+    0xFFFF/0xFFFF sentinel pair."""
+    n = len(pairs)
+    buf = np.empty((cap, 3), np.uint16)
+    pu = pairs.astype(np.uint32)
+    buf[:n, 0] = pu & 0xFFFF
+    buf[:n, 1] = pu >> 16
+    buf[:n, 2] = cnts.astype(np.uint16)
+    buf[n:] = 0xFFFF
+    return buf
+
+
+def preagg_encode_i32(pairs: np.ndarray, cnts: np.ndarray,
+                      lanes: List[np.ndarray], cap: int) -> np.ndarray:
+    """(pairs, counts, sum lanes) → one (cap, 2+W) int32 buffer with
+    f32 lanes bitcast into the int columns. Padding pair = -1."""
+    n = len(pairs)
+    buf = np.empty((cap, 2 + len(lanes)), np.int32)
+    buf[:n, 0] = pairs
+    buf[n:, 0] = -1
+    buf[:n, 1] = cnts
+    buf[n:, 1] = 0
+    for i, ln in enumerate(lanes):
+        buf[:n, 2 + i] = ln.view(np.int32)
+        buf[n:, 2 + i] = 0
+    return buf
+
+
 def fire_kernel(
     state: PaneState,
     end_panes: jax.Array,  # (W,) int64 global pane ids (window end, exclusive)
@@ -163,9 +275,19 @@ def fire_kernel(
     live = (want >= pane_lo) & (want <= pane_hi)                           # (W, ppw)
     m3 = live[None, :, :, None]
     m2 = live[None, :, :]
-    sums = jnp.sum(jnp.where(m3, state.sums[:, ring_ix, :], 0.0), axis=2)   # (rows, W, sw)
-    maxs = jnp.max(jnp.where(m3, state.maxs[:, ring_ix, :], -jnp.inf), axis=2)
-    mins = jnp.min(jnp.where(m3, state.mins[:, ring_ix, :], jnp.inf), axis=2)
+    rows_n = state.counts.shape[0]
+    W = end_panes.shape[0]
+
+    def lane_red(arr, red, identity):
+        # None lanes (zero declared width) reduce to a zero-width
+        # INTERNAL value — never a runtime buffer, so free
+        if arr is None:
+            return jnp.zeros((rows_n, W, 0), jnp.float32)
+        return red(jnp.where(m3, arr[:, ring_ix, :], identity), axis=2)
+
+    sums = lane_red(state.sums, jnp.sum, 0.0)                               # (rows, W, sw)
+    maxs = lane_red(state.maxs, jnp.max, -jnp.inf)
+    mins = lane_red(state.mins, jnp.min, jnp.inf)
     counts = jnp.sum(jnp.where(m2, state.counts[:, ring_ix], 0), axis=2)    # (rows, W)
     counts = jnp.where(w_valid[None, :], counts, 0)
     return sums, maxs, mins, counts
@@ -334,14 +456,25 @@ def ring_append_topn_kernel(
 
 
 def clear_kernel(state: PaneState, clear_mask: jax.Array) -> PaneState:
-    """Reset ring columns selected by clear_mask (ring,) to identities
-    (ref role: WindowOperator.clearAllState / registerCleanupTimer)."""
-    m3 = clear_mask[None, :, None]
-    m2 = clear_mask[None, :]
+    """Reset ring columns selected by clear_mask to identities (ref
+    role: WindowOperator.clearAllState / registerCleanupTimer).
+
+    ``clear_mask`` is int32, padded to >=64 elements: uploads under
+    ~100 bytes hit a pathological fixed stall (~67ms/step measured) on
+    the remote-attached transport, and the ring is often 16 columns.
+    Only the first ``ring`` entries are meaningful."""
+    ring = state.counts.shape[1]
+    cm = clear_mask[:ring] != 0
+    m3 = cm[None, :, None]
+    m2 = cm[None, :]
+
+    def cl(arr, fill):
+        return None if arr is None else jnp.where(m3, fill, arr)
+
     return PaneState(
-        sums=jnp.where(m3, 0.0, state.sums),
-        maxs=jnp.where(m3, -jnp.inf, state.maxs),
-        mins=jnp.where(m3, jnp.inf, state.mins),
+        sums=cl(state.sums, 0.0),
+        maxs=cl(state.maxs, -jnp.inf),
+        mins=cl(state.mins, jnp.inf),
         counts=jnp.where(m2, 0, state.counts),
     )
 
@@ -358,6 +491,14 @@ _JIT_APPLY = jax.jit(
 _JIT_APPLY_SPLIT = jax.jit(
     apply_kernel_split,
     static_argnames=("agg", "dump_row"),
+    donate_argnums=(0,))
+_JIT_PREAGG_U16 = jax.jit(
+    apply_preagg_u16_kernel,
+    static_argnames=("ring", "dump_row"),
+    donate_argnums=(0,))
+_JIT_PREAGG_I32 = jax.jit(
+    apply_preagg_i32_kernel,
+    static_argnames=("sum_width", "ring", "dump_row"),
     donate_argnums=(0,))
 _JIT_FIRE_PACK = jax.jit(
     fire_pack_kernel,
@@ -381,6 +522,8 @@ def ring_remap_kernel(state: PaneState, src: jax.Array,
     process, not once per growth event."""
 
     def cols(arr, fill):
+        if arr is None:
+            return None
         g = arr[:, src]
         m = keep[None, :, None] if g.ndim == 3 else keep[None, :]
         return jnp.where(m, g, fill)
@@ -402,6 +545,13 @@ _JIT_RING_REMAP = jax.jit(ring_remap_kernel)
 # each packed buffer bounded — device→host bandwidth is the emit ceiling
 # and chunked buffers still fetch together in one round trip
 MAX_FIRE_CHUNK = 4
+# the ring/top-n path appends in HBM (no per-fire fetch buffer), so it
+# takes a steady advance's whole window list in ONE dispatch
+MAX_FIRE_CHUNK_RING = 16
+# fire params are sentinel-padded to at least this many window ends:
+# sub-100-byte uploads hit the transport's tiny-transfer stall (see
+# clear_kernel), and the padding costs only masked lanes in the kernel
+MIN_FIRE_PAD = 16
 
 
 def _next_pow2(n: int) -> int:
@@ -624,7 +774,31 @@ class WindowOperator:
         self._emit_ring: Optional[jax.Array] = None
         self._ring_drained = 0
         self._ring_anchor: Optional[int] = None
-        self.EMIT_RING_ROWS = 8192
+        # recent ANNOUNCED ring versions as (version_no, array):
+        # copy_to_host_async is issued at fire dispatch, and the ring is
+        # never donated, so every version stays valid. A periodic drain
+        # fetches the newest version whose copy already landed instead
+        # of parking on the latest one's still-running compute; rows it
+        # misses are monotone-counter rows the next poll picks up. A
+        # barrier drain passes min_no (its fire's version) so it can
+        # never read a version older than the rows it must deliver.
+        self._ring_versions: collections.deque = collections.deque(maxlen=4)
+        self._ring_version_no = 0
+        # device→host copies are expensive stream ops on the measured
+        # transport (~1MB/s effective for announced copies): announce
+        # the ring at a TIME/FILL cadence, not per fire. The drain's
+        # periodic poll reads only announced-and-landed versions, so
+        # cadence bounds d2h cost without losing rows; the fill bound
+        # (conservative per-fire append estimate) forces an announce
+        # before the ring could wrap un-polled.
+        self.emit_announce_interval_s = 0.05
+        self._last_announce = 0.0
+        self._rows_bound_since_announce = 0
+        # 2048 rows ≈ 33KB: large against the tens of rows a steady
+        # advance appends between polls, small against the ~1MB/s
+        # effective cost of each announced device→host ring copy
+        # (overflow is detected, loud, and names this knob)
+        self.EMIT_RING_ROWS = 2048
         # bounded in-flight dispatch (credit-based flow control
         # analogue): ingest blocks on the oldest outstanding step once
         # this many are in flight, keeping the transport queue shallow
@@ -645,7 +819,10 @@ class WindowOperator:
         # drain would re-rank against the wrong fires). They queue here
         # and the drain merges them atomically with its ring poll.
         self._pending_ring_extras = collections.deque()
-        self._ring_lock = threading.Lock()
+        # RLock: the spill+top-n sync path holds it across
+        # _fire_ends → drain_ring, and _fire_ends' announce block
+        # takes it again (ingest vs drain-thread deque race)
+        self._ring_lock = threading.RLock()
         self.plan = WindowPlan.plan(
             assigner,
             allowed_lateness_ms=allowed_lateness_ms,
@@ -709,6 +886,23 @@ class WindowOperator:
             self.layout.rows <= INVALID_SLOT_U16 and self.plan.ring <= 256)
         self._apply_split = functools.partial(
             _JIT_APPLY_SPLIT, agg=self.agg, dump_row=self.layout.slots)
+        # host pre-aggregation path: eligible when every accumulator
+        # lane is a host-combinable sum (LaneAggregate.sum_fields) and
+        # the (slot, ring column) pair domain keeps the host bincount
+        # cheap. The per-batch choice (pairs vs records bytes) is
+        # dynamic — see _preagg_dispatch.
+        self._preagg_lanes = None
+        self._preagg_ws = None  # lazy; domain changes on ring growth
+        if (self.agg.max_width == 0 and self.agg.min_width == 0
+                and self.agg.sum_fields is not None
+                and len(self.agg.sum_fields) == self.agg.sum_width
+                and self.layout.slots * self.plan.ring <= (1 << 23)):
+            self._preagg_lanes = self.agg.sum_fields
+        self._preagg_u16 = functools.partial(
+            _JIT_PREAGG_U16, ring=self.plan.ring, dump_row=self.layout.slots)
+        self._preagg_i32 = functools.partial(
+            _JIT_PREAGG_I32, sum_width=self.agg.sum_width,
+            ring=self.plan.ring, dump_row=self.layout.slots)
         self._fire_pack = functools.partial(
             _JIT_FIRE_PACK,
             agg=self.agg,
@@ -753,10 +947,16 @@ class WindowOperator:
 
         @functools.partial(jax.jit, out_shardings=sharding)
         def init():
+            def lane(width, fill):
+                if width == 0:
+                    return None
+                return jnp.full((total_rows, self.layout.ring, width),
+                                fill, jnp.float32)
+
             return PaneState(
-                sums=jnp.zeros((total_rows, self.layout.ring, self.layout.sum_width), jnp.float32),
-                maxs=jnp.full((total_rows, self.layout.ring, self.layout.max_width), -jnp.inf, jnp.float32),
-                mins=jnp.full((total_rows, self.layout.ring, self.layout.min_width), jnp.inf, jnp.float32),
+                sums=lane(self.layout.sum_width, 0.0),
+                maxs=lane(self.layout.max_width, -jnp.inf),
+                mins=lane(self.layout.min_width, jnp.inf),
                 counts=jnp.zeros((total_rows, self.layout.ring), jnp.int32),
             )
 
@@ -1018,6 +1218,13 @@ class WindowOperator:
                 self.records_dropped_full += int(bad.sum())
             valid = valid & ~bad & ~full
         t2 = time.perf_counter()
+        if self.mesh_plan is None and self._preagg_dispatch(
+                slots, panes, valid, data):
+            self.prof["pb_preagg"] += time.perf_counter() - t2
+            self._inflight.append(self.state.counts[0, 0])
+            if not self.external_throttle:
+                self.throttle()
+            return
         from flink_tpu.records import device_cast
         # upload ONLY the lanes the aggregate reads: the host→device link
         # (not the MXU) is the throughput ceiling on a remote-attached
@@ -1098,6 +1305,76 @@ class WindowOperator:
         if not self.external_throttle:
             self.throttle()
 
+    def _preagg_dispatch(
+        self,
+        slots: np.ndarray,
+        panes: np.ndarray,
+        valid: np.ndarray,
+        data: Dict[str, np.ndarray],
+    ) -> bool:
+        """Try the host-pre-aggregated upload: combine the batch per
+        (slot, ring column) pair on the host and ship one small pair
+        buffer instead of per-record ids. Dispatches and returns True
+        when the pair buffer is decisively smaller than the per-record
+        upload (the link is the pipeline ceiling — PROFILE.md); False
+        falls through to the per-record paths unchanged."""
+        lanes_f = self._preagg_lanes
+        if lanes_f is None:
+            return False
+        nv = int(valid.sum())
+        if nv == 0:
+            return False
+        ring = self.plan.ring
+        pv = panes[valid]
+        span = int(pv.max() - pv.min()) + 1
+        nk = self.directory.num_keys()
+        bound = min(nv, max(nk, 1) * min(span, ring))
+        bpp = 6 if not lanes_f else 4 * (2 + len(lanes_f))
+        cap = _next_pow2(max(bound, 256))
+        # decisive-win gate vs the 3 B/record split upload; high-
+        # cardinality batches keep the per-record path
+        if bpp * cap > 2 * len(panes):
+            return False
+        tc = time.perf_counter()
+        domain = self.layout.slots * ring
+        native = None
+        if cap <= (1 << 21):
+            from flink_tpu.native_codec import (
+                PreaggWorkspace, preagg_combine_native)
+            if (self._preagg_ws is None
+                    or self._preagg_ws.domain != domain
+                    or self._preagg_ws.nlanes != len(lanes_f)):
+                self._preagg_ws = PreaggWorkspace(domain, len(lanes_f))
+            native = preagg_combine_native(
+                slots, panes, valid, [data[f] for f in lanes_f],
+                ring, self._preagg_ws, cap)
+        if native is not None:
+            pairs, cnts, lanes = native
+        else:
+            pairs, cnts, lanes = preagg_combine(
+                slots, panes % ring, valid, data, lanes_f,
+                ring=ring, domain=domain)
+        te = time.perf_counter()
+        self.prof["preagg_combine"] += te - tc
+        cap = _next_pow2(max(len(pairs), 256))
+        if not lanes and (len(cnts) == 0 or int(cnts.max()) <= 0xFFFF):
+            buf = preagg_encode_u16(pairs, cnts, cap)
+            th = time.perf_counter()
+            dbuf = jnp.asarray(buf)
+            td = time.perf_counter()
+            self.state = self._preagg_u16(self.state, dbuf)
+        else:
+            buf = preagg_encode_i32(pairs, cnts, lanes, cap)
+            th = time.perf_counter()
+            dbuf = jnp.asarray(buf)
+            td = time.perf_counter()
+            self.state = self._preagg_i32(self.state, dbuf)
+        tz = time.perf_counter()
+        self.prof["preagg_encode"] += th - te
+        self.prof["preagg_h2d"] += td - th
+        self.prof["preagg_disp"] += tz - td
+        return True
+
     def hbm_bytes(self) -> int:
         """Static device-state footprint PER DEVICE: pane tensors +
         emit ring. HBM is a per-chip resource — state shards one layout
@@ -1120,7 +1397,7 @@ class WindowOperator:
         the drain thread's deliveries behind it (emit latency)."""
         t0 = time.perf_counter()
         while len(self._inflight) > self.max_inflight_steps:
-            jax.block_until_ready(self._inflight.popleft())
+            ready_wait(self._inflight.popleft())
         # overflow markers older than the steps just retired are ready
         # (int() is a cheap host read); draining to the same bound keeps
         # the deque finite in jobs that never checkpoint
@@ -1133,8 +1410,8 @@ class WindowOperator:
         dispatch onto an idle device — their emit latency then measures
         fire+fetch, not the whole tail of the ingest pipeline."""
         while self._inflight:
-            jax.block_until_ready(self._inflight.popleft())
-        jax.block_until_ready(self.state.counts)
+            ready_wait(self._inflight.popleft())
+        ready_wait(self.state.counts)
         self._resolve_overflow()
 
     def _resolve_overflow(self, bound: int = 0) -> None:
@@ -1305,12 +1582,13 @@ class WindowOperator:
                 lo = new_dead  # nothing written yet — nothing to clear
             hi = new_dead
             if hi > lo:
+                # padded i32 mask — see clear_kernel's transfer note
+                mask = np.zeros(max(self.plan.ring, 64), dtype=np.int32)
                 if hi - lo >= self.plan.ring:
-                    mask = np.ones(self.plan.ring, dtype=bool)
+                    mask[:self.plan.ring] = 1
                 else:
                     ring_positions = np.arange(lo, hi) % self.plan.ring
-                    mask = np.zeros(self.plan.ring, dtype=bool)
-                    mask[ring_positions] = True
+                    mask[ring_positions] = 1
                 self.state = self._clear(self.state, jnp.asarray(mask))
             self._cleared_below = new_dead
             if self._spill is not None:
@@ -1334,15 +1612,17 @@ class WindowOperator:
         # steady-state kernels instead of compiling a one-off giant one
         used = self._used_mask_device()
         packs = []
-        for c0 in range(0, len(ends), MAX_FIRE_CHUNK):
-            chunk = ends[c0:c0 + MAX_FIRE_CHUNK]
+        step = MAX_FIRE_CHUNK_RING if self._topn is not None else MAX_FIRE_CHUNK
+        for c0 in range(0, len(ends), step):
+            chunk = ends[c0:c0 + step]
             W = len(chunk)
             Wp = 1
             while Wp < W:
                 Wp *= 2
             if self._topn is not None and self._ring_anchor is None:
                 self._ring_anchor = lo
-            ends_padded = chunk + [int(_END_SENTINEL)] * (Wp - W)
+            ends_padded = chunk + [int(_END_SENTINEL)] * (
+                max(Wp, MIN_FIRE_PAD) - W)
             params = jnp.asarray(np.asarray(
                 [lo, hi, self._ring_anchor or 0] + ends_padded, dtype=np.int64))
             if self._topn is not None:
@@ -1359,10 +1639,29 @@ class WindowOperator:
                 buf.copy_to_host_async()
                 packs.append((lo, buf))
         if self._topn is not None:
-            # same trick for the emit ring — the drain's poll becomes a
-            # local read of the async copy issued at fire-dispatch time
-            self._emit_ring.copy_to_host_async()
-            return FiredWindows(op=self, ring=True)
+            # announce (start the device→host copy of) the ring on a
+            # time/fill cadence — per-fire announces would put one
+            # expensive d2h op per batch on the stream. Under the ring
+            # lock: the drain thread iterates _ring_versions (RLock —
+            # the spill+top-n sync caller already holds it).
+            with self._ring_lock:
+                self._ring_version_no += 1
+                # conservative per-advance append bound: every window ×
+                # n winners × the tie headroom factor the sel_cap uses
+                self._rows_bound_since_announce += (
+                    len(ends) * self._topn[1] * 8)
+                now = time.perf_counter()
+                if (now - self._last_announce
+                        >= self.emit_announce_interval_s
+                        or self._rows_bound_since_announce
+                        >= self.EMIT_RING_ROWS // 2):
+                    self._emit_ring.copy_to_host_async()
+                    self._ring_versions.append(
+                        (self._ring_version_no, self._emit_ring))
+                    self._last_announce = now
+                    self._rows_bound_since_announce = 0
+                return FiredWindows(op=self, ring=True,
+                                    ring_no=self._ring_version_no)
         return FiredWindows(op=self, packs=packs)
 
     def _result_fields(self) -> List[str]:
@@ -1440,11 +1739,16 @@ class WindowOperator:
                 self._emit_ring = jnp.zeros(shape, jnp.int32)
         return self._emit_ring
 
-    def drain_ring(self) -> Dict[str, np.ndarray]:
+    def drain_ring(self, min_no: Optional[int] = None) -> Dict[str, np.ndarray]:
         """Fetch the emit ring ONCE and decode every row appended since
         the previous drain (the host-side poll of the device emit
         buffer). Overflow — more appends than the ring holds between
-        polls — is detected from the monotone counter and raises."""
+        polls — is detected from the monotone counter and raises.
+
+        ``min_no``: the oldest ring version this drain may read (a
+        barrier passes its fire's version so its rows are guaranteed
+        present; None = latest). The fetch prefers the newest version
+        whose announced copy already landed — see _ring_versions."""
         with self._ring_lock:
             # pop pending host-spill extras together with the ring read:
             # the appender holds the same lock across (ring dispatch,
@@ -1457,7 +1761,42 @@ class WindowOperator:
                 arr = None
             else:
                 tdr = time.perf_counter()
-                arr = np.asarray(self._emit_ring)    # ONE round trip
+                # fetch the newest ANNOUNCED version whose async copy
+                # already landed — never park behind the in-flight
+                # compute of a just-dispatched fire — among versions
+                # >= min_no (a barrier's rows must be present).
+                need = (self._ring_version_no if min_no is None
+                        else min_no)
+                acceptable = [(no, arr_) for no, arr_ in
+                              self._ring_versions if no >= need]
+                target = None
+                for no, cand in reversed(acceptable):
+                    if cand.is_ready():
+                        target = cand
+                        break
+                else:
+                    if acceptable:
+                        target = acceptable[0][1]  # oldest OK = soonest
+                if target is None:
+                    if min_no == 0:
+                        # opportunistic poll with nothing announced yet
+                        # (or announce cadence not due): fetch nothing;
+                        # the next poll gets it
+                        arr = None
+                    else:
+                        # barrier needs a version newer than any
+                        # announced copy: announce the live ring now so
+                        # the fetch is a landed-copy read, not an
+                        # unannounced round trip
+                        target = self._emit_ring
+                        target.copy_to_host_async()
+                        self._ring_versions.append(
+                            (self._ring_version_no, target))
+                        self._last_announce = time.perf_counter()
+                        self._rows_bound_since_announce = 0
+                if target is not None:
+                    ready_wait(target)
+                    arr = np.asarray(target)         # ONE round trip
                 self.prof["drain_fetch"] += time.perf_counter() - tdr
                 self.prof["drain_fetches"] += 1
         if arr is None:
@@ -1660,6 +1999,7 @@ class WindowOperator:
         self._emit_ring = None
         self._ring_drained = 0
         self._ring_anchor = None
+        self._ring_versions.clear()
 
 
 def _reblock_panes(panes: PaneState, old_dev: int, new_dev: int) -> PaneState:
@@ -1686,9 +2026,9 @@ def _reblock_panes(panes: PaneState, old_dev: int, new_dev: int) -> PaneState:
         return np.concatenate(out)
 
     return PaneState(
-        sums=reblock(panes.sums, 0.0),
-        maxs=reblock(panes.maxs, -np.inf),
-        mins=reblock(panes.mins, np.inf),
+        sums=None if panes.sums is None else reblock(panes.sums, 0.0),
+        maxs=None if panes.maxs is None else reblock(panes.maxs, -np.inf),
+        mins=None if panes.mins is None else reblock(panes.mins, np.inf),
         counts=reblock(panes.counts, 0),
     )
 
@@ -1708,12 +2048,14 @@ class FiredWindows(Mapping):
     one per fire is the emit-path latency floor — batch them)."""
 
     def __init__(self, data: Optional[Dict[str, np.ndarray]] = None,
-                 fetch=None, op=None, packs=None, ring: bool = False):
+                 fetch=None, op=None, packs=None, ring: bool = False,
+                 ring_no: int = 0):
         self._data = data
         self._fetch = fetch
         self._op = op
         self._packs = packs
         self._ring = ring
+        self._ring_no = ring_no
         # host-spill rows fired alongside this batch (disjoint keys);
         # merged in at materialization, reranked if a top-n is active
         self._extra: Optional[Dict[str, np.ndarray]] = None
@@ -1738,7 +2080,8 @@ class FiredWindows(Mapping):
         return self._data
 
     @staticmethod
-    def materialize_many(fireds: List["FiredWindows"]) -> None:
+    def materialize_many(fireds: List["FiredWindows"],
+                         barrier: bool = False) -> None:
         """Fetch every pending buffer across ``fireds`` in as few
         device→host round trips as possible, then decode each.
 
@@ -1751,19 +2094,30 @@ class FiredWindows(Mapping):
         # ring-mode entries: ONE ring poll per operator serves every
         # pending marker of that operator (later markers read empty —
         # the first drain already took the appended rows)
+        # A periodic drain fetches whatever announced ring version has
+        # already landed (min_no=0) — rows still in flight are simply
+        # picked up by the next poll, so it NEVER parks behind a
+        # just-dispatched fire's compute. A barrier drain (checkpoint
+        # flush, end of job) pins each op's newest marker version so
+        # every enqueued row is guaranteed fetched.
+        need: Dict[int, int] = {}
+        for f in fireds:
+            if f._data is None and f._ring:
+                cur = need.get(id(f._op), 0)
+                need[id(f._op)] = (max(cur, f._ring_no) if barrier else 0)
         ring_ops = {}
         for f in fireds:
             if f._data is None and f._ring:
                 op = f._op
                 if id(op) not in ring_ops:
-                    ring_ops[id(op)] = op.drain_ring()
+                    ring_ops[id(op)] = op.drain_ring(min_no=need[id(op)])
                     f._data = ring_ops[id(op)]
                 else:
                     f._data = op._empty().materialize()
                 f._op = None
         for f in fireds:
             if f._data is None and f._packs is not None:
-                bufs = [np.asarray(b) for _, b in f._packs]
+                bufs = [np.asarray(ready_wait(b)) for _, b in f._packs]
                 f._data = f._op._decode_packs(f._packs, bufs)
                 f._packs = f._op = None
 

@@ -1078,10 +1078,17 @@ class Driver:
             # a later attempt may reuse them (exactly-once would break)
             batch = ([] if discard[0]
                      else [i for i in items if i is not None])
+            # barrier batches (job end, checkpoint flush) must fetch
+            # every enqueued row; periodic ones fetch whatever announced
+            # ring copy has landed and leave the rest to the next poll.
+            # Read the flag BEFORE materializing: _flush_emits closes
+            # the set-after-read race with a second pinned-marker pass.
+            barrier = stop or self._flush_req.is_set()
             try:
                 tm0 = time.perf_counter()
                 with self._link_lock:
-                    FiredWindows.materialize_many([f for _, f, _ in batch])
+                    FiredWindows.materialize_many(
+                        [f for _, f, _ in batch], barrier=barrier)
                 self.prof["drain_link_held"] += time.perf_counter() - tm0
                 with self._push_lock:
                     # re-check under the push lock: the run may have
@@ -1122,6 +1129,23 @@ class Driver:
             self._flush_req.set()
             try:
                 self._emit_q.join()
+                # a drain batch already in flight when the flag was set
+                # may have materialized as a periodic (non-barrier)
+                # poll, leaving announced-but-unfetched ring rows on
+                # device. Requeue one marker per ring operator pinned at
+                # its CURRENT version; the flag is still set, so this
+                # second pass drains everything.
+                from flink_tpu.ops.window import FiredWindows
+                extra = False
+                for nid, op in self._ops.items():
+                    no = getattr(op, "_ring_version_no", 0)
+                    if no and getattr(op, "_emit_ring", None) is not None:
+                        self._emit_q.put(
+                            (nid, FiredWindows(op=op, ring=True, ring_no=no),
+                             time.time()))
+                        extra = True
+                if extra:
+                    self._emit_q.join()
             finally:
                 self._flush_req.clear()
         self._check_drain_error()

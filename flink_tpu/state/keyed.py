@@ -59,11 +59,18 @@ class PaneStateLayout:
 @dataclasses.dataclass
 class PaneState:
     """Device-resident accumulator tensors. counts is always present (it
-    is the COUNT lane, the trigger-count source, and the non-empty mask)."""
+    is the COUNT lane, the trigger-count source, and the non-empty mask).
 
-    sums: jax.Array   # (rows, ring, sum_width) f32
-    maxs: jax.Array   # (rows, ring, max_width) f32
-    mins: jax.Array   # (rows, ring, min_width) f32
+    Zero-width lane families are ``None``, NOT zero-size arrays: None is
+    an empty pytree, so jit in/out carries no buffer for them. A
+    zero-size runtime buffer is not free on every backend — on the
+    remote-attached TPU each one added ~27ms of per-step stream stall
+    (measured round 4: count-only apply 84.6ms/step with three (rows,
+    ring, 0) lanes vs 3.3ms without)."""
+
+    sums: Optional[jax.Array]   # (rows, ring, sum_width) f32, None if width 0
+    maxs: Optional[jax.Array]   # (rows, ring, max_width) f32, None if width 0
+    mins: Optional[jax.Array]   # (rows, ring, min_width) f32, None if width 0
     counts: jax.Array  # (rows, ring) i32
 
     def tree_flatten(self):
@@ -75,10 +82,15 @@ class PaneState:
 
 
 def init_state(layout: PaneStateLayout) -> PaneState:
+    def lane(width: int, fill: float) -> Optional[jax.Array]:
+        if width == 0:
+            return None
+        return jnp.full((layout.rows, layout.ring, width), fill, jnp.float32)
+
     return PaneState(
-        sums=jnp.zeros((layout.rows, layout.ring, layout.sum_width), jnp.float32),
-        maxs=jnp.full((layout.rows, layout.ring, layout.max_width), -jnp.inf, jnp.float32),
-        mins=jnp.full((layout.rows, layout.ring, layout.min_width), jnp.inf, jnp.float32),
+        sums=lane(layout.sum_width, 0.0),
+        maxs=lane(layout.max_width, -float("inf")),
+        mins=lane(layout.min_width, float("inf")),
         counts=jnp.zeros((layout.rows, layout.ring), jnp.int32),
     )
 

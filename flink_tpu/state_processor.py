@@ -74,8 +74,13 @@ class SavepointReader:
             out_keys.append(rev_keys[gslot])
             out_panes.append(ring_ix)
             for name in ("sums", "maxs", "mins"):
-                arr = np.asarray(getattr(panes, name))[block]
-                out[name].append(arr[slot_ix[used], ring_ix])
+                lane = getattr(panes, name)
+                if lane is None:  # zero-width lane family (see PaneState)
+                    out[name].append(
+                        np.zeros((int(used.sum()), 0), np.float32))
+                else:
+                    arr = np.asarray(lane)[block]
+                    out[name].append(arr[slot_ix[used], ring_ix])
             out["counts"].append(c[slot_ix[used], ring_ix])
         return {
             "key": np.concatenate(out_keys) if out_keys else np.zeros(0, np.int64),

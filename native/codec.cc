@@ -377,4 +377,46 @@ void sr_close(void* h) {
   free(r);
 }
 
+// Host pre-aggregation combine (mini-batch local aggregation, the
+// window operator's upload shrinker): histogram one microbatch per
+// (slot, ring-column) pair, with optional f64-accumulated sum lanes
+// per pair. ``hist`` (domain i32) and ``lane_acc`` (domain*nlanes f64)
+// are caller-owned workspaces that must be ZERO on entry; every touched
+// entry is reset before returning, so steady-state calls never pay a
+// full-domain clear. ``lanes`` is lane-major: lanes[l*n + i].
+// Returns the distinct-pair count, or -1 when it exceeds ``cap`` — in
+// that case recording stopped at cap and the workspaces are left DIRTY:
+// the caller must re-zero them before the next call.
+int64_t preagg_combine(int64_t n, const int64_t* slots, const int64_t* panes,
+                       const uint8_t* valid, int64_t ring, int64_t domain,
+                       int64_t nlanes, const double* lanes,
+                       int32_t* hist, double* lane_acc,
+                       int32_t* out_pairs, int32_t* out_counts,
+                       float* out_lanes, int64_t cap) {
+  int64_t np_ = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (!valid[i]) continue;
+    int64_t pm = panes[i] % ring;
+    if (pm < 0) pm += ring;
+    int64_t p = slots[i] * ring + pm;  // caller guarantees p < domain
+    if (hist[p] == 0) {
+      if (np_ >= cap) return -1;  // workspaces dirty; caller re-zeros
+      out_pairs[np_++] = (int32_t)p;
+    }
+    hist[p] += 1;
+    for (int64_t l = 0; l < nlanes; ++l)
+      lane_acc[p * nlanes + l] += lanes[l * n + i];
+  }
+  for (int64_t j = 0; j < np_; ++j) {
+    int64_t p = out_pairs[j];
+    out_counts[j] = hist[p];
+    hist[p] = 0;
+    for (int64_t l = 0; l < nlanes; ++l) {
+      out_lanes[j * nlanes + l] = (float)lane_acc[p * nlanes + l];
+      lane_acc[p * nlanes + l] = 0.0;
+    }
+  }
+  return np_;
+}
+
 }  // extern "C"

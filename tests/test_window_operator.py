@@ -559,3 +559,58 @@ class TestSplitUpload:
         op.process_batch(np.array([1, 2]), np.array([100, 200]), {})
         fired = op.advance_watermark(5000)
         assert sorted(int(c) for c in fired["count"]) == [1, 1]
+
+
+class TestHostPreaggregation:
+    """The host combiner path (LaneAggregate.sum_fields): batches big
+    enough to pass the decisive-win gate must produce results identical
+    to the per-record upload path."""
+
+    def _run(self, agg, golden_agg, field_vals, result_field,
+             expect_preagg=True):
+        assigner = SlidingEventTimeWindows.of(10_000, 1_000)
+        rng = np.random.default_rng(3)
+        B = 4096
+        events, wms = [], []
+        t = 0
+        for i in range(4):
+            keys = rng.integers(0, 40, B)
+            ts = t + rng.integers(0, 3000, B)
+            vals = field_vals(rng, B)
+            events.append(list(zip(keys.tolist(), ts.tolist(), vals.tolist())))
+            t += 3000
+            wms.append(t - 1000)
+        wms[-1] = t + 20_000
+        op, ours, golden = run_pair(
+            assigner, agg, events, wms, golden_agg=golden_agg)
+        took_preagg = op.prof.get("pb_preagg", 0) > 0
+        assert took_preagg == expect_preagg
+        # f32 lane accumulation order differs between the paths; compare
+        # with an f32-level tolerance, not digit-exact
+        gold = {(int(k), int(ws), int(we)): res
+                for k, ws, we, vals, res in golden}
+        assert len(ours) == len(gold)
+        for r in ours:
+            key = (int(r["key"]), int(r["window_start"]), int(r["window_end"]))
+            assert abs(float(r[result_field]) - gold[key]) < 1e-3 * max(
+                1.0, abs(gold[key]))
+
+    def test_count_preagg_matches_golden(self):
+        self._run(count(), len, lambda rng, b: np.ones(b), "count")
+
+    def test_sum_lane_preagg_matches_golden(self):
+        self._run(sum_of("v"), sum,
+                  lambda rng, b: rng.integers(0, 100, b).astype(np.float64),
+                  "sum_v")
+
+    def test_avg_lane_preagg_matches_golden(self):
+        self._run(avg_of("v"), lambda vs: sum(vs) / len(vs),
+                  lambda rng, b: rng.integers(0, 100, b).astype(np.float64),
+                  "avg_v")
+
+    def test_max_lane_falls_through(self):
+        # max lanes are not host-combinable: sum_fields is None, the
+        # operator must keep the per-record path and stay correct
+        self._run(max_of("v"), max,
+                  lambda rng, b: rng.integers(0, 100, b).astype(np.float64),
+                  "max_v", expect_preagg=False)
