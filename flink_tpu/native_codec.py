@@ -120,6 +120,10 @@ def _bind(lib, i64p, f32p) -> None:
     lib.preagg_combine.argtypes = [
         ctypes.c_int64, i64p, i64p, u8p, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_int64, f64p, i32p, f64p, i32p, i32p, f32p, ctypes.c_int64]
+    lib.nexmark_bids.restype = None
+    lib.nexmark_bids.argtypes = [
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, i64p, i64p, f32p]
 
 
 def native_available() -> bool:
@@ -385,3 +389,20 @@ def preagg_combine_native(
         return None
     return (out_pairs[:npairs], out_counts[:npairs],
             [out_lanes[:npairs, i].copy() for i in range(nl)])
+
+
+def nexmark_bids_native(
+    seed: int, n: int, hot_ratio: int, n_hot: int,
+    n_auctions: int, n_people: int,
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """C fast path of the Nexmark bid generator (auction, bidder,
+    price). None when the library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    auction = np.empty(n, np.int64)
+    bidder = np.empty(n, np.int64)
+    price = np.empty(n, np.float32)
+    lib.nexmark_bids(seed, n, hot_ratio, n_hot, n_auctions, n_people,
+                     auction, bidder, price)
+    return auction, bidder, price

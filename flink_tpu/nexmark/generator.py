@@ -61,10 +61,22 @@ def bid_stream(cfg: NexmarkConfig) -> GeneratorSource:
         if i >= cfg.n_batches:
             return None
         ids, ts = _event_ids(cfg, int(split), i)
-        rng = np.random.default_rng((int(split) << 20) | i)
         b = cfg.batch_size
-        hot = rng.integers(0, cfg.hot_ratio, b) == 0
         n_hot = max(1, cfg.num_active_auctions // HOT_AUCTION_RATIO)
+        # C fast path: on the single-core bench host the numpy RNG body
+        # costs ~116ms per 2^20 batch (the log-normal price dominates) —
+        # more than the whole rest of the pipeline. Same distributions,
+        # different (still deterministic) stream.
+        from flink_tpu.native_codec import nexmark_bids_native
+        native = nexmark_bids_native(
+            (int(split) << 20) | i, b, cfg.hot_ratio, n_hot,
+            cfg.num_active_auctions, cfg.num_active_people)
+        if native is not None:
+            auction, bidder, price = native
+            return ({"auction": auction, "bidder": bidder,
+                     "price": price}, ts)
+        rng = np.random.default_rng((int(split) << 20) | i)
+        hot = rng.integers(0, cfg.hot_ratio, b) == 0
         auction = np.where(
             hot,
             rng.integers(0, n_hot, b),

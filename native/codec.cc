@@ -17,6 +17,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <cmath>
 
 extern "C" {
 
@@ -375,6 +376,56 @@ void sr_close(void* h) {
   close(r->listen_fd);
   free(r->carry);
   free(r);
+}
+
+// NEXMark bid-batch generator (the benchmark workload's native
+// data-loader; ref role: the optimized Java generator in the external
+// nexmark/nexmark repo). splitmix64 PRNG, log-normal prices via a
+// 4-uniform Irwin-Hall normal approximation + expf. Deterministic in
+// (seed) — the replayable-source contract. On the single-core bench
+// host this replaces ~116ms/batch of numpy RNG with ~10ms of C.
+static inline uint64_t smx(uint64_t* s) {
+  uint64_t z = (*s += 0x9E3779B97F4A7C15ULL);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+// Schraudolph-style fast e^x: the synthetic price distribution needs
+// shape, not ulps (|rel err| < ~4%); real expf costs ~40ms per 2^20
+// batch on the single-core bench host, this ~2ms.
+static inline float fast_exp(float x) {
+  union { float f; int32_t i; } u;
+  u.i = (int32_t)(12102203.0f * x + 1064866805.0f);
+  return u.f;
+}
+
+void nexmark_bids(int64_t seed, int64_t n, int64_t hot_ratio, int64_t n_hot,
+                  int64_t n_auctions, int64_t n_people,
+                  int64_t* auction, int64_t* bidder, float* price) {
+  // counter-based (stateless per index): no serial PRNG dependency
+  // chain, so the loop pipelines/vectorizes
+  const uint64_t G = 0x9E3779B97F4A7C15ULL;
+  const uint64_t b1 = (uint64_t)seed * 0xD1342543DE82EF95ULL + 1;
+  const uint64_t b2 = b1 ^ 0x94D049BB133111EBULL;
+  const float inv16 = 1.0f / 65536.0f;
+  const uint64_t na = (uint64_t)n_auctions, nh = (uint64_t)n_hot,
+                 np_ = (uint64_t)n_people;
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t c1 = b1 + (uint64_t)i * G, c2 = b2 + (uint64_t)i * G;
+    uint64_t r1 = smx(&c1), r2 = smx(&c2);
+    // multiply-shift range reduction instead of % (uniform enough for
+    // a workload generator, ~10x cheaper than div)
+    int hot = (int)((r1 & 0xFF) % (uint64_t)hot_ratio) == 0;
+    uint64_t a32 = (r1 >> 8) & 0xFFFFFFFFULL;
+    auction[i] = (int64_t)((a32 * (hot ? nh : na)) >> 32);
+    bidder[i] = (int64_t)((((r1 >> 40) & 0xFFFFFFULL) * np_) >> 24);
+    // Irwin-Hall(4) ~ N(2, 1/3) from four u16 lanes -> N(6, 1) -> exp
+    float u = ((uint16_t)r2 + (uint16_t)(r2 >> 16) +
+               (uint16_t)(r2 >> 32) + (uint16_t)(r2 >> 48)) * inv16;
+    float z = (u - 2.0f) * 1.7320508f;
+    price[i] = fast_exp(6.0f + z);
+  }
 }
 
 // Host pre-aggregation combine (mini-batch local aggregation, the
