@@ -124,6 +124,12 @@ def _bind(lib, i64p, f32p) -> None:
     lib.nexmark_bids.argtypes = [
         ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_int64, ctypes.c_int64, i64p, i64p, f32p]
+    lib.ingest_combine.restype = ctypes.c_int64
+    lib.ingest_combine.argtypes = [
+        ctypes.c_int64, i64p, i64p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        i32p, i32p, i32p, ctypes.c_int64, i64p, u8p, ctypes.c_int64,
+        ctypes.c_int64]
 
 
 def native_available() -> bool:
@@ -406,3 +412,31 @@ def nexmark_bids_native(
     lib.nexmark_bids(seed, n, hot_ratio, n_hot, n_auctions, n_people,
                      auction, bidder, price)
     return auction, bidder, price
+
+
+def ingest_combine_native(
+    ts: np.ndarray, slots: np.ndarray, pane_ms: int, offset_ms: int,
+    ring: int, ws: PreaggWorkspace, cap: int, dead_below: int,
+    refire_below: int, bitmap_bits: int,
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
+    """Fused window-ingest pass (see codec.cc ingest_combine). Returns
+    (pairs, counts, stats[6], refire_bitmap) or None (unavailable /
+    cap overflow — caller falls back to the numpy path)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(ts)
+    out_pairs = np.empty(cap, np.int32)
+    out_counts = np.empty(cap, np.int32)
+    stats = np.zeros(6, np.int64)
+    bitmap = np.zeros(max((bitmap_bits + 7) // 8, 1), np.uint8)
+    npairs = lib.ingest_combine(
+        n, np.ascontiguousarray(ts, np.int64),
+        np.ascontiguousarray(slots, np.int64),
+        pane_ms, offset_ms, ring, ws.domain, dead_below, refire_below,
+        ws.hist, out_pairs, out_counts, cap, stats, bitmap,
+        dead_below, len(bitmap))
+    if npairs < 0:
+        ws.rezero()
+        return None
+    return out_pairs[:npairs], out_counts[:npairs], stats, bitmap

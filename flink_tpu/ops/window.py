@@ -182,9 +182,16 @@ def apply_preagg_u32_kernel(
     CPU, so every byte shaved is host budget returned to the pipeline.
     Padding entries are 0xFFFFFFFF (pair 0xFFFFF, beyond the strict
     domain < 2^20 the eligibility gate enforces)."""
+    return _apply_preagg_u32_core(state, buf, ring=ring, dump_row=dump_row)
+
+
+def _apply_preagg_u32_core(state, buf, *, ring, dump_row):
     pair = lax.shift_right_logical(buf, jnp.int32(12))  # bit pattern, not sign
     cnt = buf & jnp.int32(0xFFF)
-    ok = pair < dump_row * ring              # pair < slots * ring
+    # real entries always have count < 0xFFF (host gate); the padding
+    # word 0xFFFFFFFF decodes to count 0xFFF — so the count field alone
+    # distinguishes padding even when the pair domain fills 2^20
+    ok = (cnt != 0xFFF) & (pair < dump_row * ring)
     p = jnp.where(ok, pair, 0)
     rows = jnp.where(ok, p // ring, dump_row).astype(jnp.int32)
     cols = (p % ring).astype(jnp.int32)
@@ -462,6 +469,16 @@ def ring_append_topn_kernel(
     from the counter, never silent. ref role: RecordWriter's buffer ring
     + PipelinedSubpartition, collapsed into device memory."""
     pane_lo, pane_hi, anchor, end_panes, w_valid = _unpack_fire_params(params)
+    return _ring_append_topn_core(
+        state, emit_ring, pane_lo, pane_hi, anchor, end_panes, w_valid,
+        used_mask, agg=agg, panes_per_window=panes_per_window, ring=ring,
+        sel_cap=sel_cap, by=by, topn=topn)
+
+
+def _ring_append_topn_core(
+    state, emit_ring, pane_lo, pane_hi, anchor, end_panes, w_valid,
+    used_mask, *, agg, panes_per_window, ring, sel_cap, by, topn,
+):
     sums, maxs, mins, counts = fire_kernel(
         state, end_panes, w_valid, pane_lo, pane_hi,
         panes_per_window=panes_per_window, ring=ring)
@@ -479,6 +496,76 @@ def ring_append_topn_kernel(
         emit_ring, sums, maxs, mins, counts, nz, v, thresh,
         end_panes, anchor, agg=agg, sel_cap=sel_cap,
         row_offset=jnp.int32(0))
+
+
+# fused-step header layout, in i32 words:
+# [0:2]=pane_lo i64, [2:4]=pane_hi i64, [4:6]=anchor i64,
+# [6]=unused, [7]=clear-mask bits (ring<=32), [8:24]=window-end deltas
+# vs pane_lo (sentinel INT32_MIN = padding), [24:]=zero pad — the
+# header upload must stay ABOVE the transport's tiny-transfer stall
+# threshold (~100 bytes measured), so 64 words = 256 bytes
+FUSED_HDR = 64
+_DELTA_SENTINEL = -(2**30)
+
+
+def fused_step_kernel(
+    state: PaneState,
+    emit_ring: jax.Array,
+    buf: jax.Array,        # (FUSED_HDR + P,) int32: header + u32 pairs
+    used_mask: jax.Array,
+    *,
+    agg: LaneAggregate,
+    panes_per_window: int,
+    ring: int,
+    sel_cap: int,
+    by: str,
+    topn: int,
+    dump_row: int,
+) -> Tuple[PaneState, jax.Array]:
+    """ONE device dispatch per microbatch: pre-aggregated apply +
+    watermark fire (top-n ring append) + pane clear, with the fire
+    parameters riding in the SAME upload as the pair list. On the
+    measured transport each executable launch and each transfer carries
+    tens of ms of in-situ overhead — the fusion collapses per-batch
+    stream traffic to one upload + one launch (+ the cadenced ring
+    announce); an A/B against a split header + stash-time pair upload
+    measured WORSE (two transfer ops beat one combined even with
+    overlap). ref: 4.B/4.D hot paths, dispatched as one program."""
+    hdr = buf[:FUSED_HDR]
+    pairs = buf[FUSED_HDR:]
+
+    def i64_at(i):
+        return lax.bitcast_convert_type(
+            hdr[i:i + 2].reshape(1, 2), jnp.int64)[0]
+
+    pane_lo = i64_at(0)
+    pane_hi = i64_at(2)
+    anchor = i64_at(4)
+    clear_word = hdr[7]
+    deltas = hdr[8:8 + MIN_FIRE_PAD]
+    w_valid = deltas > _DELTA_SENTINEL
+    end_panes = jnp.where(w_valid, pane_lo + deltas.astype(jnp.int64),
+                          _END_SENTINEL)
+    state = _apply_preagg_u32_core(
+        state, pairs, ring=ring, dump_row=dump_row)
+    emit_ring = _ring_append_topn_core(
+        state, emit_ring, pane_lo, pane_hi, anchor, end_panes, w_valid,
+        used_mask, agg=agg, panes_per_window=panes_per_window, ring=ring,
+        sel_cap=sel_cap, by=by, topn=topn)
+    cm = (lax.shift_right_logical(
+        clear_word, jnp.arange(min(ring, 32), dtype=jnp.int32))
+        & jnp.int32(1)) != 0
+    if ring > 32:
+        cm = jnp.concatenate([cm, jnp.zeros(ring - 32, bool)])
+    state = clear_kernel(state, cm.astype(jnp.int32))
+    return state, emit_ring
+
+
+_JIT_FUSED_STEP = jax.jit(
+    fused_step_kernel,
+    static_argnames=("agg", "panes_per_window", "ring", "sel_cap", "by",
+                     "topn", "dump_row"),
+    donate_argnums=(0,))
 
 
 def clear_kernel(state: PaneState, clear_mask: jax.Array) -> PaneState:
@@ -849,6 +936,10 @@ class WindowOperator:
         # drain would re-rank against the wrong fires). They queue here
         # and the drain merges them atomically with its ring poll.
         self._pending_ring_extras = collections.deque()
+        # fused-lane pending upload (header space + u32 pairs), applied
+        # by the next advance's single fused dispatch (see
+        # fused_step_kernel) or flushed by _flush_stash
+        self._stash_u32: Optional[np.ndarray] = None
         # RLock: the spill+top-n sync path holds it across
         # _fire_ends → drain_ring, and _fire_ends' announce block
         # takes it again (ingest vs drain-thread deque race)
@@ -951,6 +1042,20 @@ class WindowOperator:
                 by=by,
                 topn=n,
             )
+            # one-dispatch-per-batch path (apply + fire + clear fused;
+            # see fused_step_kernel) — ring must fit the 32-bit clear
+            # word in the header
+            self._fused_step = (functools.partial(
+                _JIT_FUSED_STEP,
+                agg=self.agg,
+                panes_per_window=self.plan.panes_per_window,
+                ring=self.plan.ring,
+                by=by,
+                topn=n,
+                dump_row=self.layout.slots,
+            ) if self.plan.ring <= 32 else None)
+        else:
+            self._fused_step = None
         self._clear = _JIT_CLEAR
 
     def _topn_cap(self, w: int) -> int:
@@ -1177,6 +1282,16 @@ class WindowOperator:
         dropped (side output; ref: WindowOperator sideOutput/
         numLateRecordsDropped) and late-within-lateness rows mark their
         windows for re-firing."""
+        # count-only fused fast lane: ONE native scan does panes, late
+        # masking, drop accounting, min/max, refire candidates, and the
+        # pre-agg histogram (the numpy path below makes ~6 full-array
+        # passes — real milliseconds on the single-core bench host)
+        if (self.mesh_plan is None
+                and self._spill is None and self._preagg_lanes == ()
+                and (valid is None or bool(np.all(valid)))
+                and self._process_batch_fused(keys, ts)):
+            return
+        self._flush_stash()
         t0 = time.perf_counter()
         self.state_version += 1
         keys = np.asarray(keys, dtype=np.int64)
@@ -1337,6 +1452,122 @@ class WindowOperator:
         if not self.external_throttle:
             self.throttle()
 
+    def _process_batch_fused(self, keys: np.ndarray, ts: np.ndarray) -> bool:
+        """Count-only ingest via codec.cc ingest_combine. Returns False
+        (no state touched beyond the key directory) when the native lib
+        is missing, the batch looks high-cardinality (pairs would not
+        beat per-record bytes), or the refire span is degenerate — the
+        caller then runs the general path."""
+        from flink_tpu.native_codec import (
+            PreaggWorkspace, ingest_combine_native)
+        t0 = time.perf_counter()
+        keys = np.asarray(keys, dtype=np.int64)
+        ts = np.asarray(ts, dtype=np.int64)
+        n = len(ts)
+        ring = self.plan.ring
+        t1 = time.perf_counter()
+        slots = self.directory.assign(keys)
+        self.prof["pb_assign"] += time.perf_counter() - t1
+        nk = self.directory.num_keys()
+        cap = _next_pow2(max(min(n, max(nk, 1) * ring), 256))
+        if 4 * cap > 2 * n or cap > (1 << 21):
+            return False
+        dead = self._cleared_below
+        refire_below = (self._fired_below_end
+                        if self._fired_below_end is not None
+                        else np.iinfo(np.int64).min)
+        bits = 0
+        if refire_below > dead:
+            span = refire_below - dead
+            if span > (1 << 20):
+                return False  # degenerate lateness span: general path
+            bits = int(span)
+        prev_min, prev_max = self._min_pane_seen, self._max_pane_seen
+        t_scan = time.perf_counter()
+        for _attempt in (0, 1):
+            domain = self.layout.slots * self.plan.ring
+            if (self._preagg_ws is None or self._preagg_ws.domain != domain
+                    or self._preagg_ws.nlanes != 0):
+                self._preagg_ws = PreaggWorkspace(domain, 0)
+            res = ingest_combine_native(
+                ts, slots, self.plan.pane_ms, self.plan.offset_ms,
+                self.plan.ring, self._preagg_ws, cap, dead, refire_below,
+                bits)
+            if res is None:
+                return False
+            pairs, cnts, stats, bitmap = res
+            n_valid, n_late, n_bad, pmin, pmax, n_refire = (
+                int(x) for x in stats)
+            if n_valid == 0:
+                break
+            if self._min_pane_seen is None or pmin < self._min_pane_seen:
+                self._min_pane_seen = pmin
+            if self._max_pane_seen is None or pmax > self._max_pane_seen:
+                self._max_pane_seen = pmax
+            live_lo = max(dead, self._min_pane_seen)
+            live_hi = self._max_pane_seen
+            if live_hi - live_lo >= self.plan.ring and _attempt == 0:
+                # ring too small for the live span: grow (remapping only
+                # panes applied BEFORE this batch) and redo the scan —
+                # its histogram columns were taken mod the old ring
+                self._grow_ring(live_hi - live_lo + 1, prev_min, prev_max)
+                continue
+            break
+        self.state_version += 1
+        self.prof["preagg_combine"] += time.perf_counter() - t_scan
+        self.late_records += n_late
+        if n_bad:
+            self.records_dropped_full += n_bad
+        if n_refire:
+            late_panes = (np.flatnonzero(
+                np.unpackbits(bitmap, bitorder="little")) + dead)
+            self._refire.update(self.plan.late_refire_ends(
+                late_panes, self._fired_below_end, self.watermark))
+        if n_valid == 0:
+            return True
+        tc = time.perf_counter()
+        domain = self.layout.slots * self.plan.ring
+        cap = _next_pow2(max(len(pairs), 256))
+        cmax = 0 if len(cnts) == 0 else int(cnts.max())
+        if cmax < 0xFFF and domain <= (1 << 20):
+            # u32 pack with fused-step header space reserved up front:
+            # the pending advance fills it and dispatches apply+fire+
+            # clear as ONE program with ONE upload
+            buf = np.full(FUSED_HDR + cap, -1, np.int32)
+            buf[FUSED_HDR:FUSED_HDR + len(pairs)] = (
+                pairs.astype(np.int64) << 12
+                | cnts.astype(np.int64)).astype(np.uint32).view(np.int32)
+            if self._fused_step is not None and self._stash_u32 is None:
+                self._stash_u32 = buf
+                self.prof["pb_preagg"] += time.perf_counter() - tc
+                return True
+            self.state = self._preagg_u32(
+                self.state, jnp.asarray(buf[FUSED_HDR:]))
+        elif cmax <= 0xFFFF:
+            buf = preagg_encode_u16(pairs, cnts, cap)
+            self.state = self._preagg_u16(self.state, jnp.asarray(buf))
+        else:
+            buf = preagg_encode_i32(pairs, cnts, [], cap)
+            self.state = self._preagg_i32(self.state, jnp.asarray(buf))
+        self.prof["pb_preagg"] += time.perf_counter() - tc
+        self._inflight.append(self.state.counts[0, 0])
+        if not self.external_throttle:
+            self.throttle()
+        return True
+
+    def _flush_stash(self) -> None:
+        """Dispatch a pending fused-lane pair buffer as a plain apply —
+        every consumer of up-to-date state (non-fused advances, fire
+        chunking, snapshots, quiesce, ring growth, the general ingest
+        path) calls this first."""
+        buf = self._stash_u32
+        if buf is None:
+            return
+        self._stash_u32 = None
+        self.state = self._preagg_u32(
+            self.state, jnp.asarray(buf[FUSED_HDR:]))
+        self._inflight.append(self.state.counts[0, 0])
+
     def _preagg_dispatch(
         self,
         slots: np.ndarray,
@@ -1390,7 +1621,7 @@ class WindowOperator:
         self.prof["preagg_combine"] += te - tc
         cap = _next_pow2(max(len(pairs), 256))
         cmax = 0 if len(cnts) == 0 else int(cnts.max())
-        if not lanes and cmax < 0xFFF and domain < (1 << 20):
+        if not lanes and cmax < 0xFFF and domain <= (1 << 20):
             # tightest: one u32 per pair (pair<<12 | count)
             buf = np.full(cap, -1, np.int32)
             buf[:len(pairs)] = (pairs.astype(np.int64) << 12
@@ -1452,6 +1683,7 @@ class WindowOperator:
         calls this before the FINAL watermark advance so the flush fires
         dispatch onto an idle device — their emit latency then measures
         fire+fetch, not the whole tail of the ingest pipeline."""
+        self._flush_stash()
         while self._inflight:
             ready_wait(self._inflight.popleft())
         ready_wait(self.state.counts)
@@ -1534,6 +1766,7 @@ class WindowOperator:
         that triggered the grow) — remapping beyond them would copy
         whatever live pane aliases those old ring columns into the new
         columns, duplicating data into phantom windows."""
+        self._flush_stash()  # stashed pairs are encoded in OLD ring columns
         old_ring = self.plan.ring
         new_ring = _next_pow2(need + 4)
         lo = self._cleared_below
@@ -1585,6 +1818,15 @@ class WindowOperator:
         if self._fired_below_end is None or frontier > self._fired_below_end:
             self._fired_below_end = frontier
         self._refire.clear()
+        # fused path: the pending ingest stash + these fires + the purge
+        # ride ONE device dispatch with ONE upload
+        if (self._stash_u32 is not None and self._fused_step is not None
+                and self._spill is None and self.mesh_plan is None):
+            out = self._advance_fused(wm, ends)
+            if out is not None:
+                self.prof["aw_dispatch"] += time.perf_counter() - taw
+                return out
+        self._flush_stash()
         # host-store keys fire on the SAME ends list (incl. refires) —
         # disjoint key sets, so rows simply ride along
         extra = (self._spill.fire(
@@ -1639,6 +1881,85 @@ class WindowOperator:
         self.prof["aw_dispatch"] += time.perf_counter() - taw
         return out
 
+    def _advance_fused(self, wm: int, ends: List[int]) -> Optional["FiredWindows"]:
+        """One-dispatch advance: apply the stashed pair upload, fire up
+        to MIN_FIRE_PAD window ends, and purge dead panes in a single
+        fused program (see fused_step_kernel). Returns None when the
+        fire list overflows the fused window slots — the caller then
+        flushes the stash and takes the chunked path."""
+        ppw = self.plan.panes_per_window
+        if self._max_pane_seen is None:
+            ends_f: List[int] = []
+            lo = self._cleared_below
+        else:
+            lo = max(self._cleared_below, self._min_pane_seen)
+            hi = self._max_pane_seen
+            ends_f = [e for e in ends if e > lo and e - ppw <= hi]
+        if len(ends_f) > MIN_FIRE_PAD:
+            return None
+        ring = self.plan.ring
+        # purge decision (mirrors the non-fused tail): mask bits ride
+        # the header's clear word
+        new_dead = self.plan.first_dead_pane(wm)
+        clear_word = 0
+        cleared_after = self._cleared_below
+        if new_dead > self._cleared_below:
+            clo = self._cleared_below
+            if self._min_pane_seen is not None:
+                clo = max(clo, self._min_pane_seen)
+            else:
+                clo = new_dead
+            if new_dead > clo:
+                if new_dead - clo >= ring:
+                    clear_word = (1 << ring) - 1
+                else:
+                    for p in range(clo, new_dead):
+                        clear_word |= 1 << (p % ring)
+            cleared_after = new_dead
+        if self._ring_anchor is None:
+            self._ring_anchor = lo
+        hi_v = self._max_pane_seen if self._max_pane_seen is not None else lo - 1
+        used = self._used_mask_device()
+        buf = self._stash_u32
+        self._stash_u32 = None
+        buf[:6] = np.array([lo, hi_v, self._ring_anchor],
+                           np.int64).view(np.int32)
+        buf[6] = 0
+        buf[7] = np.array([clear_word], np.uint32).view(np.int32)[0]
+        deltas = np.full(MIN_FIRE_PAD, _DELTA_SENTINEL, np.int64)
+        if ends_f:
+            deltas[:len(ends_f)] = np.asarray(ends_f, np.int64) - lo
+        buf[8:8 + MIN_FIRE_PAD] = deltas.astype(np.int32)
+        self.state, self._emit_ring = self._fused_step(
+            self.state, self._ensure_ring(), jnp.asarray(buf), used,
+            sel_cap=self._topn_cap(MIN_FIRE_PAD))
+        # the NON-donated emit-ring output doubles as the completion
+        # marker — no extra gather launch, and it survives the next
+        # step's donation of the state buffers
+        self._inflight.append(self._emit_ring)
+        self._cleared_below = cleared_after
+        return self._ring_after_fire(len(ends_f))
+
+    def _ring_after_fire(self, n_ends: int) -> "FiredWindows":
+        """Post-fire ring bookkeeping shared by the fused and chunked
+        top-n paths: version bump + cadenced announce (see
+        _ring_versions)."""
+        with self._ring_lock:
+            self._ring_version_no += 1
+            self._rows_bound_since_announce += max(n_ends, 0) * (
+                self._topn[1] * 8)
+            now = time.perf_counter()
+            if (now - self._last_announce >= self.emit_announce_interval_s
+                    or self._rows_bound_since_announce
+                    >= self.EMIT_RING_ROWS // 2):
+                self._emit_ring.copy_to_host_async()
+                self._ring_versions.append(
+                    (self._ring_version_no, self._emit_ring))
+                self._last_announce = now
+                self._rows_bound_since_announce = 0
+            return FiredWindows(op=self, ring=True,
+                                ring_no=self._ring_version_no)
+
     def _fire_ends(self, ends: List[int]) -> "FiredWindows":
         if not ends or self._max_pane_seen is None:
             return self._empty()
@@ -1682,29 +2003,7 @@ class WindowOperator:
                 buf.copy_to_host_async()
                 packs.append((lo, buf))
         if self._topn is not None:
-            # announce (start the device→host copy of) the ring on a
-            # time/fill cadence — per-fire announces would put one
-            # expensive d2h op per batch on the stream. Under the ring
-            # lock: the drain thread iterates _ring_versions (RLock —
-            # the spill+top-n sync caller already holds it).
-            with self._ring_lock:
-                self._ring_version_no += 1
-                # conservative per-advance append bound: every window ×
-                # n winners × the tie headroom factor the sel_cap uses
-                self._rows_bound_since_announce += (
-                    len(ends) * self._topn[1] * 8)
-                now = time.perf_counter()
-                if (now - self._last_announce
-                        >= self.emit_announce_interval_s
-                        or self._rows_bound_since_announce
-                        >= self.EMIT_RING_ROWS // 2):
-                    self._emit_ring.copy_to_host_async()
-                    self._ring_versions.append(
-                        (self._ring_version_no, self._emit_ring))
-                    self._last_announce = now
-                    self._rows_bound_since_announce = 0
-                return FiredWindows(op=self, ring=True,
-                                    ring_no=self._ring_version_no)
+            return self._ring_after_fire(len(ends))
         return FiredWindows(op=self, packs=packs)
 
     def _result_fields(self) -> List[str]:
@@ -1964,6 +2263,7 @@ class WindowOperator:
         return self._spill.records_spilled if self._spill is not None else 0
 
     def snapshot_state(self) -> Dict[str, Any]:
+        self._flush_stash()  # the snapshot must include stashed records
         self._resolve_overflow()  # a checkpoint must not hide pending loss
         return {
             "spill": (self._spill.snapshot()
@@ -2043,6 +2343,9 @@ class WindowOperator:
         self._ring_drained = 0
         self._ring_anchor = None
         self._ring_versions.clear()
+        # a stash from the pre-restore attempt belongs to a replayed
+        # stream position — never apply it to restored state
+        self._stash_u32 = None
 
 
 def _reblock_panes(panes: PaneState, old_dev: int, new_dev: int) -> PaneState:
