@@ -166,11 +166,104 @@ def run_q8(batch_size: int, n_batches: int) -> float:
     return 2 * batch_size * n_batches / el
 
 
+def run_wordcount(batch_size: int, n_batches: int) -> float:
+    """BASELINE.json config #0: streaming WordCount, 1s tumbling count
+    window. The source generates pre-tokenized word-id batches (the C
+    tokenizer's output shape — `bench_micro.py` measures the raw
+    tokenizer at ~450 MB/s separately); zipf-ish skew over a 30k-word
+    vocabulary. Returns events(words)/sec."""
+    from flink_tpu.api.environment import StreamExecutionEnvironment
+    from flink_tpu.api.sources import GeneratorSource
+    from flink_tpu.api.windowing import TumblingEventTimeWindows
+    from flink_tpu.config import Configuration
+    from flink_tpu.time.watermarks import WatermarkStrategy
+
+    vocab = 30_000
+
+    def gen(split, i):
+        if i >= n_batches:
+            return None
+        rng = np.random.default_rng(i)
+        # zipf-ish: squared uniform concentrates mass on low ids
+        u = rng.random(batch_size)
+        words = (u * u * vocab).astype(np.int64)
+        ts = (i * batch_size + np.arange(batch_size, dtype=np.int64)) // 100
+        return ({"word": words}, ts)
+
+    env = StreamExecutionEnvironment(Configuration({
+        "state.num-key-shards": 128, "state.slots-per-shard": 512,
+        "pipeline.microbatch-size": batch_size,
+        "pipeline.max-inflight-steps": 1,
+    }))
+    n, sink = _counting_sink()
+    (env.from_source(GeneratorSource(gen),
+                     WatermarkStrategy.for_bounded_out_of_orderness(0))
+        .key_by("word")
+        .window(TumblingEventTimeWindows.of(1000))
+        .count()
+        .add_sink(sink))
+    t0 = time.perf_counter()
+    env.execute("wordcount")
+    el = time.perf_counter() - t0
+    assert n[0] > 0, "wordcount emitted nothing"
+    return batch_size * n_batches / el
+
+
+def run_sessions(batch_size: int, n_batches: int) -> float:
+    """BASELINE.json config #4 shape: session-window clickstream
+    aggregation with event time + allowed lateness (the Criteo-style
+    workload: many users, bursty activity separated by gaps). Returns
+    events/sec."""
+    from flink_tpu.api.environment import StreamExecutionEnvironment
+    from flink_tpu.api.sources import GeneratorSource
+    from flink_tpu.api.windowing import EventTimeSessionWindows
+    from flink_tpu.config import Configuration
+    from flink_tpu.time.watermarks import WatermarkStrategy
+
+    users = 50_000
+
+    def gen(split, i):
+        if i >= n_batches:
+            return None
+        rng = np.random.default_rng(i)
+        user = rng.integers(0, users, batch_size).astype(np.int64)
+        base = i * batch_size // 100
+        # bursty: activity clustered inside 1s bursts, 2% of records
+        # arrive up to 3s late (inside the allowed lateness)
+        ts = base + rng.integers(0, 1000, batch_size)
+        late = rng.random(batch_size) < 0.02
+        ts = np.where(late, np.maximum(ts - 3000, 0), ts).astype(np.int64)
+        return ({"user": user}, ts)
+
+    env = StreamExecutionEnvironment(Configuration({
+        "state.num-key-shards": 128, "state.slots-per-shard": 512,
+        "pipeline.microbatch-size": batch_size,
+        "pipeline.max-inflight-steps": 1,
+    }))
+    n, sink = _counting_sink()
+    (env.from_source(GeneratorSource(gen),
+                     WatermarkStrategy.for_bounded_out_of_orderness(1000))
+        .key_by("user")
+        .window(EventTimeSessionWindows.with_gap(500))
+        .allowed_lateness(5_000)
+        .count()
+        .add_sink(sink))
+    t0 = time.perf_counter()
+    env.execute("sessions")
+    el = time.perf_counter() - t0
+    assert n[0] > 0, "sessions emitted nothing"
+    return batch_size * n_batches / el
+
+
 def suite() -> None:
-    """Full bench suite (`python bench.py --suite`): Q5 headline plus
-    Q7/Q8 — one JSON line per query (BASELINE.md's query list; the
-    driver's graded metric remains the default Q5 single line)."""
+    """Full bench suite (`python bench.py --suite`): every implemented
+    BASELINE.json config — one JSON line per config (the driver's
+    graded metric remains the default Q5 single line)."""
     batch = 1 << 18
+    run_wordcount(batch, 4)  # warmup
+    eps0 = run_wordcount(batch, 24)
+    print(json.dumps({"metric": "wordcount_tumbling_1s_events_per_sec",
+                      "value": round(eps0), "unit": "events/sec/chip"}))
     run_q7(batch, 4)  # warmup
     eps7 = run_q7(batch, 24)
     print(json.dumps({"metric": "nexmark_q7_highest_bid_events_per_sec",
@@ -179,6 +272,10 @@ def suite() -> None:
     eps8 = run_q8(batch, 24)
     print(json.dumps({"metric": "nexmark_q8_new_users_events_per_sec",
                       "value": round(eps8), "unit": "events/sec/chip"}))
+    run_sessions(batch, 4)  # warmup
+    eps4 = run_sessions(batch, 24)
+    print(json.dumps({"metric": "session_clickstream_events_per_sec",
+                      "value": round(eps4), "unit": "events/sec/chip"}))
     main()  # Q5 headline last (its line is the one the driver records)
 
 

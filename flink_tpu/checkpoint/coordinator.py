@@ -171,12 +171,15 @@ class CheckpointCoordinator:
                     else:
                         blobs: Dict[str, bytes] = {}
                         reuse: Dict[str, ReusedOpState] = {}
+                        from flink_tpu.checkpoint import blobformat
+
                         for nid, snap in ops.items():
                             if isinstance(snap, ReusedOpState):
                                 reuse[str(nid)] = snap
                             else:
-                                blobs[str(nid)] = pickle.dumps(
-                                    snap, protocol=pickle.HIGHEST_PROTOCOL)
+                                # self-describing v3 blob, not pickle
+                                # (schema evolution; SURVEY §3.1)
+                                blobs[str(nid)] = blobformat.encode(snap)
                         h = self.storage.save_v2(
                             cid, mat, blobs, reuse, savepoint=savepoint)
                     psp.set("bytes", getattr(h, "size_bytes", None))

@@ -23,7 +23,15 @@ not re-serialized: its blob is HARDLINKED from the base checkpoint's
 file (falling back to copy), so an idle operator costs zero bytes of
 new serialization and the link survives the base's retirement (inode
 refcount — exactly how RocksDB incremental checkpoints share SSTs).
-v1 single-pickle checkpoints remain loadable.
+
+Format v3 keeps v2's directory layout (files named *.blob) but every
+payload is the SELF-DESCRIBING binary format of
+``checkpoint/blobformat.py`` (JSON-schema'd tree + raw array section)
+instead of pickle — restorable across code changes and readable from
+non-Python tooling (ref: TypeSerializerSnapshot's schema-evolution
+role, SURVEY §3.1). v1/v2 pickle checkpoints remain loadable, and a v3
+incremental checkpoint may hardlink op blobs written by a v2 base —
+the loader dispatches per blob on the magic bytes.
 """
 from __future__ import annotations
 
@@ -45,6 +53,10 @@ class CheckpointHandle:
     timestamp_ms: int
     is_savepoint: bool = False
     size_bytes: int = -1  # filled by save/save_v2 (background thread)
+    # op blob file names as written (save_v2 only): the incremental
+    # reuse base must reference the ACTUAL names — a reused blob keeps
+    # its lineage's extension across format upgrades
+    op_files: Optional[Dict[str, str]] = None
 
 
 @dataclasses.dataclass
@@ -99,14 +111,12 @@ class FsCheckpointStorage:
         """Write snapshot; manifest lands last so readers only ever see
         complete checkpoints (the atomic-rename pattern of
         FsCompletedCheckpointStorageLocation)."""
+        from flink_tpu.checkpoint import blobformat
+
         d = self._dir(checkpoint_id, savepoint)
         tmp = self._tmp_dir(d)
-        with self.fs.open_write(os.path.join(tmp, "state.pkl")) as f:
-            if self.compression == "none":
-                pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
-            else:  # buffer only when actually compressing
-                f.write(self._pack(pickle.dumps(
-                    payload, protocol=pickle.HIGHEST_PROTOCOL)))
+        with self.fs.open_write(os.path.join(tmp, "state.blob")) as f:
+            f.write(self._pack(blobformat.encode(payload)))
         ts = int(time.time() * 1000)
         with self.fs.open_write(os.path.join(tmp, "MANIFEST.json")) as f:
             f.write(json.dumps({
@@ -114,7 +124,8 @@ class FsCheckpointStorage:
                 "timestamp_ms": ts,
                 "job_id": self.job_id,
                 "savepoint": savepoint,
-                "format_version": 1,
+                "format_version": 3,
+                "layout": "single",
                 "compression": self.compression,
             }).encode())
         if self.fs.exists(d):
@@ -132,29 +143,28 @@ class FsCheckpointStorage:
         """Incremental format: per-operator blob files; unchanged
         operators hardlink the base checkpoint's blob. Manifest lands
         last, exactly like v1."""
+        from flink_tpu.checkpoint import blobformat
+
         d = self._dir(checkpoint_id, savepoint)
         tmp = self._tmp_dir(d)
         versions: Dict[str, int] = {}
         op_files: Dict[str, str] = {}
         for nid, blob in op_blobs.items():
-            fn = f"op-{nid}.pkl"
+            fn = f"op-{nid}.blob"
             with self.fs.open_write(os.path.join(tmp, fn)) as f:
                 f.write(self._pack(blob))
             op_files[nid] = fn
             versions[nid] = meta_payload.get(
                 "op_versions", {}).get(nid, -1)
         for nid, ref in op_reuse.items():
-            fn = f"op-{nid}.pkl"
+            # reuse keeps the BASE's file name (it may be a v2 .pkl
+            # pickle blob — the loader dispatches on magic bytes)
+            fn = f"op-{nid}{os.path.splitext(ref.file)[1]}"
             self.fs.link_or_copy(ref.file, os.path.join(tmp, fn))
             op_files[nid] = fn
             versions[nid] = ref.version
-        with self.fs.open_write(os.path.join(tmp, "meta.pkl")) as f:
-            if self.compression == "none":
-                pickle.dump(meta_payload, f,
-                            protocol=pickle.HIGHEST_PROTOCOL)
-            else:
-                f.write(self._pack(pickle.dumps(
-                    meta_payload, protocol=pickle.HIGHEST_PROTOCOL)))
+        with self.fs.open_write(os.path.join(tmp, "meta.blob")) as f:
+            f.write(self._pack(blobformat.encode(meta_payload)))
         ts = int(time.time() * 1000)
         with self.fs.open_write(os.path.join(tmp, "MANIFEST.json")) as f:
             f.write(json.dumps({
@@ -162,7 +172,7 @@ class FsCheckpointStorage:
                 "timestamp_ms": ts,
                 "job_id": self.job_id,
                 "savepoint": savepoint,
-                "format_version": 2,
+                "format_version": 3,
                 "compression": self.compression,
                 "ops": {nid: {"file": fn, "version": versions[nid]}
                         for nid, fn in op_files.items()},
@@ -173,7 +183,8 @@ class FsCheckpointStorage:
         if not savepoint:
             self._retire_old()
         return CheckpointHandle(checkpoint_id, d, ts, savepoint,
-                                size_bytes=_dir_size(d))
+                                size_bytes=_dir_size(d),
+                                op_files=dict(op_files))
 
     def list_complete(self) -> List[CheckpointHandle]:
         out = []
@@ -208,18 +219,22 @@ class FsCheckpointStorage:
                 manifest = json.loads(f.read().decode())
             fmt = manifest.get("format_version", 1)
         comp = manifest.get("compression", "none")
-        if fmt == 1:
-            with fs.open_read(os.path.join(path, "state.pkl")) as f:
-                return pickle.loads(_unpack(f.read(), comp))
-        with fs.open_read(os.path.join(path, "meta.pkl")) as f:
-            payload = pickle.loads(_unpack(f.read(), comp))
+        if fmt == 1 or manifest.get("layout") == "single":
+            name = "state.blob" if fmt >= 3 else "state.pkl"
+            with fs.open_read(os.path.join(path, name)) as f:
+                return _decode_blob(_unpack(f.read(), comp))
+        meta_name = "meta.blob" if fmt >= 3 else "meta.pkl"
+        with fs.open_read(os.path.join(path, meta_name)) as f:
+            payload = _decode_blob(_unpack(f.read(), comp))
         ops: Dict[Any, Any] = {}
         versions: Dict[Any, int] = {}
         for nid, entry in manifest.get("ops", {}).items():
             with fs.open_read(os.path.join(path, entry["file"])) as f:
                 # node ids are ints in the live plan; the manifest's JSON
-                # keys are strings — restore the original type
-                ops[int(nid)] = pickle.loads(_unpack(f.read(), comp))
+                # keys are strings — restore the original type. Blob
+                # contents dispatch on magic bytes: a v3 checkpoint may
+                # hardlink a v2 base's pickle blob and vice versa.
+                ops[int(nid)] = _decode_blob(_unpack(f.read(), comp))
             versions[int(nid)] = entry["version"]
         payload["operators"] = ops
         payload["op_file_versions"] = versions
@@ -284,3 +299,14 @@ def _dir_size(d: str) -> int:
 
 def _unpack(raw: bytes, compression: str) -> bytes:
     return zlib.decompress(raw) if compression == "zlib" else raw
+
+
+def _decode_blob(raw: bytes) -> Any:
+    """Per-blob format dispatch on the magic bytes: v3 self-describing
+    blobs decode via blobformat; anything else is a legacy v1/v2 pickle
+    payload (still loadable — restore-across-upgrade)."""
+    from flink_tpu.checkpoint import blobformat
+
+    if blobformat.is_v3(raw):
+        return blobformat.decode(raw)
+    return pickle.loads(raw)

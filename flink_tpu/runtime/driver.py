@@ -564,9 +564,11 @@ class Driver:
         handle = p.complete()
         self._ckpt_pending = None
         if not p.is_savepoint:
+            names = handle.op_files or {}
             self._ckpt_base = {
-                "files": {nid: _os.path.join(handle.path, f"op-{nid}.pkl")
-                          for nid in self._ops},
+                "files": {nid: _os.path.join(
+                    handle.path, names.get(str(nid), f"op-{nid}.blob"))
+                    for nid in self._ops},
                 "versions": dict(p.frozen_versions),
             }
         return handle

@@ -170,8 +170,8 @@ class SavepointWriter:
         payload["checkpoint_id"] = cid
         ops = payload.pop("operators")
         st = FsCheckpointStorage(root, job_id)
-        blobs = {str(nid): pickle.dumps(snap,
-                                        protocol=pickle.HIGHEST_PROTOCOL)
+        from flink_tpu.checkpoint import blobformat
+        blobs = {str(nid): blobformat.encode(snap)
                  for nid, snap in ops.items()}
         h = st.save_v2(cid, payload, blobs, {}, savepoint=True)
         return h.path

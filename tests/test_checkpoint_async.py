@@ -112,11 +112,11 @@ class TestIncrementalReuse:
         job_dir = os.path.join(str(tmp_path), "inc-job")
         chks = sorted(d for d in os.listdir(job_dir) if d.startswith("chk-"))
         assert len(chks) >= 2
-        # format v2 layout everywhere
+        # format v3 layout everywhere
         for c in chks:
             mf = json.load(open(os.path.join(job_dir, c, "MANIFEST.json")))
-            assert mf["format_version"] == 2
-            assert os.path.exists(os.path.join(job_dir, c, "meta.pkl"))
+            assert mf["format_version"] == 3
+            assert os.path.exists(os.path.join(job_dir, c, "meta.blob"))
 
     def test_idle_op_blob_is_hardlinked(self, tmp_path):
         """Direct storage check: save_v2 with a ReusedOpState must link
@@ -126,10 +126,10 @@ class TestIncrementalReuse:
         st = FsCheckpointStorage(str(tmp_path), "j")
         blob = pickle.dumps({"state": np.arange(1000)})
         h1 = st.save_v2(1, {"op_versions": {"5": 3}}, {"5": blob}, {})
-        f1 = os.path.join(h1.path, "op-5.pkl")
+        f1 = os.path.join(h1.path, "op-5.blob")
         h2 = st.save_v2(2, {"op_versions": {"5": 3}}, {},
                         {"5": ReusedOpState(f1, 3)})
-        f2 = os.path.join(h2.path, "op-5.pkl")
+        f2 = os.path.join(h2.path, "op-5.blob")
         assert os.path.samefile(f1, f2)          # same inode — zero bytes
         # retiring the base keeps the reused blob readable
         st.retained = 1
