@@ -277,9 +277,27 @@ class WindowedStream(_AggregateShortcuts):
         unsupported combinations must raise, never be silently ignored
         (ref: WindowedStream.trigger contract)."""
         from flink_tpu.api.windowing import (
-            CountTrigger, EventTimeTrigger, PurgingTrigger)
+            CountTrigger, EventTimeTrigger, ProcessingTimeTrigger,
+            PurgingTrigger)
 
+        proc_assigner = bool(getattr(self.assigner, "is_processing_time",
+                                     False))
+        if proc_assigner and self._lateness:
+            raise NotImplementedError(
+                "allowed lateness is an event-time concept; processing-"
+                "time windows cannot see late records (ref: "
+                "WindowedStream.allowedLateness is event-time only)")
         t = self._trigger
+        if isinstance(t, ProcessingTimeTrigger):
+            if proc_assigner:
+                return  # the proc-time assigners' default trigger
+            raise NotImplementedError(
+                "ProcessingTimeTrigger requires a processing-time window "
+                "assigner (Tumbling/SlidingProcessingTimeWindows)")
+        if proc_assigner and isinstance(t, EventTimeTrigger):
+            raise NotImplementedError(
+                "EventTimeTrigger on processing-time windows is not "
+                "supported — the window's time axis is the clock")
         if t is None or isinstance(t, EventTimeTrigger):
             return
         if isinstance(t, PurgingTrigger) and isinstance(

@@ -158,6 +158,78 @@ class SlidingEventTimeWindows(WindowAssigner):
 
 
 @dataclasses.dataclass(frozen=True)
+class TumblingProcessingTimeWindows(WindowAssigner):
+    """Tumbling windows over PROCESSING time (ref: assigners/
+    TumblingProcessingTimeWindows.java). Records are assigned by the
+    operator's clock at ingest, and firing is driven by the same clock
+    advancing between steps — the pane machinery is identical to the
+    event-time assigners, with arrival time as the time axis (so there
+    is no lateness and no out-of-orderness by construction)."""
+
+    size: int
+    offset: int = 0
+    is_event_time = False
+    is_processing_time = True
+
+    @classmethod
+    def of(cls, size_ms: int, offset_ms: int = 0) -> "TumblingProcessingTimeWindows":
+        return cls(size_ms, offset_ms)
+
+    @property
+    def pane_ms(self) -> int:
+        return self.size
+
+    @property
+    def size_ms(self) -> int:
+        return self.size
+
+    @property
+    def slide_ms(self) -> int:
+        return self.size
+
+    @property
+    def offset_ms(self) -> int:
+        return self.offset
+
+
+@dataclasses.dataclass(frozen=True)
+class SlidingProcessingTimeWindows(WindowAssigner):
+    """ref: assigners/SlidingProcessingTimeWindows.java — pane-lowered
+    like SlidingEventTimeWindows, over the processing-time axis."""
+
+    size: int
+    slide: int
+    offset: int = 0
+    is_event_time = False
+    is_processing_time = True
+
+    @classmethod
+    def of(cls, size_ms: int, slide_ms: int,
+           offset_ms: int = 0) -> "SlidingProcessingTimeWindows":
+        return cls(size_ms, slide_ms, offset_ms)
+
+    def __post_init__(self) -> None:
+        if self.size <= 0 or self.slide <= 0:
+            raise ValueError("size and slide must be positive")
+
+    @property
+    def pane_ms(self) -> int:
+        return math.gcd(self.size, self.slide)
+
+    @property
+    def size_ms(self) -> int:
+        return self.size
+
+    @property
+    def slide_ms(self) -> int:
+        return self.slide
+
+    @property
+    def offset_ms(self) -> int:
+        return self.offset
+
+
+@dataclasses.dataclass(frozen=True)
 class EventTimeSessionWindows(WindowAssigner):
     """Gap-merged sessions (ref: assigners/EventTimeSessionWindows.java,
     runtime merge logic in MergingWindowSet.java). Dynamic merging cannot
@@ -235,6 +307,25 @@ class EventTimeTrigger(Trigger):
 
     def fires_on_watermark(self) -> bool:
         return True
+
+
+class ProcessingTimeTrigger(Trigger):
+    """FIRE when the processing-time clock passes window.max_timestamp
+    (ref: triggers/ProcessingTimeTrigger.java). The default trigger of
+    the processing-time assigners; evaluated as the same vectorized
+    fire mask as EventTimeTrigger, over the clock instead of the
+    watermark."""
+
+    @classmethod
+    def create(cls) -> "ProcessingTimeTrigger":
+        return cls()
+
+    def on_processing_time(self, time: int, window: TimeWindow) -> str:
+        return (TriggerResult.FIRE if time >= window.max_timestamp()
+                else TriggerResult.CONTINUE)
+
+    def fires_on_watermark(self) -> bool:
+        return False
 
 
 @dataclasses.dataclass(frozen=True)

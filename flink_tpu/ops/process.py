@@ -133,6 +133,9 @@ class ProcessContext:
         self.slots: np.ndarray = np.zeros(0, np.int64)
         self.timestamps: np.ndarray = np.zeros(0, np.int64)
         self.data: Dict[str, np.ndarray] = {}
+        # which timer family invoked on_timer: "event" | "processing"
+        # (ref: OnTimerContext.timeDomain())
+        self.time_domain: str = "event"
 
     @property
     def watermark(self) -> int:
@@ -160,6 +163,25 @@ class ProcessContext:
                                  slots: Optional[np.ndarray] = None) -> None:
         self._op.timers.delete_batch(
             self.slots if slots is None else slots, np.asarray(ts))
+
+    def register_processing_time_timers(
+            self, ts: np.ndarray,
+            slots: Optional[np.ndarray] = None) -> None:
+        """Per-key timers on the WALL clock (ref: TimerService.
+        registerProcessingTimeTimer — the proc-time half of
+        InternalTimerServiceImpl). Fired by the runtime's clock advance
+        between steps; resolution is one microbatch."""
+        self._op.proc_timers.register_batch(
+            self.slots if slots is None else slots, np.asarray(ts))
+
+    def delete_processing_time_timers(
+            self, ts: np.ndarray,
+            slots: Optional[np.ndarray] = None) -> None:
+        self._op.proc_timers.delete_batch(
+            self.slots if slots is None else slots, np.asarray(ts))
+
+    def current_processing_time(self) -> int:
+        return self._op.clock.now_ms()
 
     # -- output ----------------------------------------------------------
 
@@ -195,10 +217,14 @@ class KeyedProcessOperator:
 
     def __init__(self, fn: Any, *, num_shards: int = 128,
                  slots_per_shard: int = 1024) -> None:
+        from flink_tpu.time.clock import SystemProcessingTimeService
+
         self.fn = fn
         self.directory = KeyDirectory(num_shards, slots_per_shard)
         self.capacity = num_shards * slots_per_shard
         self.timers = TimerService()
+        self.proc_timers = TimerService()
+        self.clock = SystemProcessingTimeService()
         self.watermark = LONG_MIN
         self.late_records = 0
         self.records_dropped_full = 0
@@ -264,7 +290,31 @@ class KeyedProcessOperator:
                 ctx.keys = self.directory.key_of_slots(due_slots)
                 ctx.timestamps = due_ts
                 ctx.data = {}
+                ctx.time_domain = "event"
                 self.fn.on_timer(ctx)
+        return FiredWindows(data=self._drain_emitted())
+
+    def advance_processing_time_timers(self, fire_all: bool = False):
+        """Fire processing-time timers the clock has passed (the
+        proc-time half of InternalTimerServiceImpl.advanceWatermark;
+        driven by the runtime between steps). ``fire_all`` implements
+        drain semantics at end of input. Returns a FiredWindows batch
+        or None when nothing fired."""
+        from flink_tpu.ops.window import FiredWindows
+
+        horizon = (np.iinfo(np.int64).max - 1 if fire_all
+                   else self.clock.now_ms())
+        due_slots, due_ts = self.proc_timers.due(horizon)
+        if not len(due_slots):
+            return None
+        self.state_version += 1
+        ctx = self.ctx
+        ctx.slots = due_slots
+        ctx.keys = self.directory.key_of_slots(due_slots)
+        ctx.timestamps = due_ts
+        ctx.data = {}
+        ctx.time_domain = "processing"
+        self.fn.on_timer(ctx)
         return FiredWindows(data=self._drain_emitted())
 
     def take_fired(self):
@@ -312,6 +362,7 @@ class KeyedProcessOperator:
             "kind": "process",
             "directory": self.directory.snapshot(),
             "timers": self.timers.snapshot(),
+            "proc_timers": self.proc_timers.snapshot(),
             "watermark": self.watermark,
             "late_records": self.late_records,
             "records_dropped_full": self.records_dropped_full,
@@ -327,6 +378,8 @@ class KeyedProcessOperator:
             snap["directory"],
             (self.directory.shard_lo, self.directory.shard_hi))
         self.timers.restore(snap["timers"])
+        if snap.get("proc_timers") is not None:
+            self.proc_timers.restore(snap["proc_timers"])
         self.watermark = snap["watermark"]
         self.late_records = snap["late_records"]
         self.records_dropped_full = snap["records_dropped_full"]

@@ -874,6 +874,23 @@ class WindowOperator:
         self.agg = agg
         self.mesh_plan = mesh_plan
         self.exchange_impl = exchange_impl
+        # processing-time mode (ref: TumblingProcessingTimeWindows +
+        # ProcessingTimeTrigger + the proc-time half of the timer
+        # service): records are stamped with the operator clock at
+        # ingest and fires ride advance_processing_time — the SAME pane
+        # machinery with the clock as the time axis. No lateness, no
+        # out-of-orderness, by construction.
+        self.uses_processing_time = bool(
+            getattr(assigner, "is_processing_time", False))
+        self.clock = None
+        if self.uses_processing_time:
+            from flink_tpu.time.clock import SystemProcessingTimeService
+            self.clock = SystemProcessingTimeService()
+            if allowed_lateness_ms:
+                raise ValueError(
+                    "allowed lateness is event-time-only; processing-"
+                    "time windows cannot see late records")
+            max_out_of_orderness_ms = 0
         if exchange_capacity is not None and exchange_capacity < 0:
             raise ValueError(
                 f"exchange_capacity must be >= 0, got {exchange_capacity}")
@@ -1282,6 +1299,10 @@ class WindowOperator:
         dropped (side output; ref: WindowOperator sideOutput/
         numLateRecordsDropped) and late-within-lateness rows mark their
         windows for re-firing."""
+        if self.uses_processing_time:
+            # the record's time axis IS the clock at ingest
+            ts = np.full(len(np.asarray(ts)), self.clock.now_ms(),
+                         np.int64)
         # count-only fused fast lane: ONE native scan does panes, late
         # masking, drop accounting, min/max, refire candidates, and the
         # pre-agg histogram (the numpy path below makes ~6 full-array
@@ -1793,6 +1814,12 @@ class WindowOperator:
             self._build_sharded_kernels()
 
     # -- time path -------------------------------------------------------
+    def advance_processing_time(self) -> "FiredWindows":
+        """Fire windows the processing-time clock has passed (the
+        batched ProcessingTimeTrigger). Driven by the runtime between
+        steps; tests drive a ManualProcessingTimeService directly."""
+        return self.advance_watermark(self.clock.now_ms() - 1)
+
     def advance_watermark(self, wm: int) -> "FiredWindows":
         """Advance event time; fire newly-complete windows plus pending
         re-fires; purge dead panes. Returns the fired-window batch

@@ -972,12 +972,32 @@ class Driver:
             if n.kind in ("window", "session", "join", "window_all",
                           "process"):
                 op = self._ops[nid]
+                if getattr(op, "uses_processing_time", False):
+                    # proc-time windows: the clock, not the event
+                    # watermark, drives fires; end of input drains
+                    # (fires everything seen — the stop-with-drain
+                    # semantics of the reference)
+                    if in_wm == _FINAL or final:
+                        fired = op.advance_watermark(op.final_watermark())
+                    else:
+                        fired = op.advance_processing_time()
+                    self._emit_fired(nid, fired)
+                    self._out_wm[nid] = in_wm
+                    continue
                 wm = in_wm
                 if in_wm == _FINAL:
                     wm = op.final_watermark()
                 if wm > op.watermark or final:
                     fired = op.advance_watermark(wm)
                     self._emit_fired(nid, fired)
+                # processing-time TIMERS (KeyedProcessFunction) fire on
+                # the clock alongside the event-time advance
+                adv_proc = getattr(op, "advance_processing_time_timers",
+                                   None)
+                if adv_proc is not None:
+                    fired2 = adv_proc(fire_all=(in_wm == _FINAL or final))
+                    if fired2 is not None:
+                        self._emit_fired(nid, fired2)
                 self._out_wm[nid] = in_wm
             elif n.kind == "async_io":
                 op = self._ops[nid]

@@ -1,0 +1,46 @@
+"""Processing-time services (ref: the processing-time half of
+streaming/runtime/tasks/ProcessingTimeService + the
+TestProcessingTimeService harness fake).
+
+The reference schedules per-timer callbacks on a timer thread; here
+processing time is a CLOCK READ between microbatch steps — the driver
+advances every processing-time operator after each batch (and on the
+idle tick), which fires whole panes/timer cohorts vectorized. Timer
+resolution is therefore one microbatch, the same batching tradeoff
+CountTrigger documents.
+"""
+from __future__ import annotations
+
+import time
+
+
+class ProcessingTimeService:
+    """Clock seam: operators read now_ms(); tests inject a manual one
+    (ref: TestProcessingTimeService)."""
+
+    def now_ms(self) -> int:
+        raise NotImplementedError
+
+
+class SystemProcessingTimeService(ProcessingTimeService):
+    def now_ms(self) -> int:
+        return int(time.time() * 1000)
+
+
+class ManualProcessingTimeService(ProcessingTimeService):
+    """Deterministic clock for harness tests: time moves only via
+    advance_to/advance_by."""
+
+    def __init__(self, start_ms: int = 0) -> None:
+        self._now = start_ms
+
+    def now_ms(self) -> int:
+        return self._now
+
+    def advance_to(self, ms: int) -> None:
+        if ms < self._now:
+            raise ValueError(f"clock moved backwards: {ms} < {self._now}")
+        self._now = ms
+
+    def advance_by(self, delta_ms: int) -> None:
+        self.advance_to(self._now + delta_ms)

@@ -1,0 +1,231 @@
+"""Processing-time windows, triggers, and timers with MOCK time
+(ref: WindowOperatorTest's processing-time cases driven by
+TestProcessingTimeService; SURVEY §3.2 windowing + §3.3 timer
+service)."""
+import numpy as np
+import pytest
+
+from flink_tpu.api.windowing import (
+    ProcessingTimeTrigger, SlidingProcessingTimeWindows,
+    TumblingProcessingTimeWindows)
+from flink_tpu.ops.aggregates import count, sum_of
+from flink_tpu.ops.window import WindowOperator
+from flink_tpu.time.clock import ManualProcessingTimeService
+
+
+def mk_op(assigner, agg=None, **kw):
+    op = WindowOperator(assigner, agg or count(), num_shards=4,
+                        slots_per_shard=64, **kw)
+    clock = ManualProcessingTimeService(0)
+    op.clock = clock
+    return op, clock
+
+
+def rows(fired):
+    return sorted((int(k), int(ws), int(we), int(c)) for k, ws, we, c in zip(
+        fired["key"], fired["window_start"], fired["window_end"],
+        fired["count"]))
+
+
+class TestTumblingProcessingTime:
+    def test_assign_by_clock_and_fire_on_clock(self):
+        op, clock = mk_op(TumblingProcessingTimeWindows.of(1000))
+        clock.advance_to(100)
+        # event timestamps are IGNORED: the clock stamps arrival
+        op.process_batch(np.array([1, 1, 2]), np.array([99999, 0, 5]), {})
+        clock.advance_to(900)
+        op.process_batch(np.array([1]), np.array([0]), {})
+        # clock still inside the window: nothing fires
+        assert len(op.advance_processing_time()["key"]) == 0
+        clock.advance_to(1000)  # window [0,1000) complete at t=1000
+        f = op.advance_processing_time()
+        assert rows(f) == [(1, 0, 1000, 3), (2, 0, 1000, 1)]
+        # next window
+        op.process_batch(np.array([2]), np.array([0]), {})
+        clock.advance_to(2500)
+        f = op.advance_processing_time()
+        assert rows(f) == [(2, 1000, 2000, 1)]
+
+    def test_no_late_records_by_construction(self):
+        op, clock = mk_op(TumblingProcessingTimeWindows.of(1000))
+        clock.advance_to(5000)
+        op.advance_processing_time()
+        # records arriving now go in the CURRENT window regardless of
+        # their event timestamps — nothing can be late
+        op.process_batch(np.array([7]), np.array([0]), {})
+        clock.advance_to(6000)
+        f = op.advance_processing_time()
+        assert rows(f) == [(7, 5000, 6000, 1)]
+        assert op.late_records == 0
+
+    def test_lateness_rejected(self):
+        with pytest.raises(ValueError, match="lateness"):
+            WindowOperator(TumblingProcessingTimeWindows.of(1000), count(),
+                           num_shards=4, slots_per_shard=8,
+                           allowed_lateness_ms=100)
+
+
+class TestSlidingProcessingTime:
+    def test_sliding_panes_over_clock(self):
+        op, clock = mk_op(SlidingProcessingTimeWindows.of(2000, 1000),
+                          sum_of("v"))
+        clock.advance_to(500)
+        op.process_batch(np.array([1]), np.array([0]),
+                         {"v": np.array([10.0])})
+        clock.advance_to(1500)
+        op.process_batch(np.array([1]), np.array([0]),
+                         {"v": np.array([5.0])})
+        clock.advance_to(2000)
+        f = op.advance_processing_time()
+        got = sorted((int(k), int(ws), float(s)) for k, ws, s in zip(
+            f["key"], f["window_start"], f["sum_v"]))
+        # windows ending <= 2000: [-1000,1000) holds the t=500 record,
+        # [0,2000) holds both
+        assert got == [(1, -1000, 10.0), (1, 0, 15.0)]
+
+    def test_trigger_object_semantics(self):
+        from flink_tpu.api.windowing import TimeWindow, TriggerResult
+
+        t = ProcessingTimeTrigger.create()
+        w = TimeWindow(0, 1000)
+        assert t.on_processing_time(998, w) == TriggerResult.CONTINUE
+        assert t.on_processing_time(999, w) == TriggerResult.FIRE
+        assert not t.fires_on_watermark()
+
+
+class TestApiValidation:
+    def _ws(self, assigner):
+        from flink_tpu.api.environment import StreamExecutionEnvironment
+        from flink_tpu.config import Configuration
+
+        env = StreamExecutionEnvironment(Configuration({}))
+        s = env.from_collection({"k": np.array([1])},
+                                np.array([0], np.int64))
+        return s.key_by("k").window(assigner)
+
+    def test_proc_trigger_on_event_windows_rejected(self):
+        from flink_tpu.api.windowing import TumblingEventTimeWindows
+
+        ws = self._ws(TumblingEventTimeWindows.of(1000))
+        with pytest.raises(NotImplementedError, match="ProcessingTime"):
+            ws.trigger(ProcessingTimeTrigger.create()).count()
+
+    def test_event_trigger_on_proc_windows_rejected(self):
+        from flink_tpu.api.windowing import EventTimeTrigger
+
+        ws = self._ws(TumblingProcessingTimeWindows.of(1000))
+        with pytest.raises(NotImplementedError, match="EventTimeTrigger"):
+            ws.trigger(EventTimeTrigger.create()).count()
+
+    def test_lateness_on_proc_windows_rejected(self):
+        ws = self._ws(TumblingProcessingTimeWindows.of(1000))
+        with pytest.raises(NotImplementedError, match="lateness"):
+            ws.allowed_lateness(10).count()
+
+
+class TestProcessingTimeTimers:
+    def test_register_and_fire_with_mock_clock(self):
+        from flink_tpu.ops.process import KeyedProcessOperator
+
+        fired = []
+
+        class Fn:
+            def process_batch(self, ctx):
+                ctx.register_processing_time_timers(
+                    np.full(len(ctx.slots), ctx.current_processing_time()
+                            + 1000, np.int64))
+
+            def on_timer(self, ctx):
+                fired.append((ctx.time_domain, ctx.keys.copy(),
+                              ctx.timestamps.copy()))
+                ctx.emit({"k": ctx.keys}, ts=ctx.timestamps)
+
+        op = KeyedProcessOperator(Fn(), num_shards=4, slots_per_shard=16)
+        clock = ManualProcessingTimeService(100)
+        op.clock = clock
+        op.process_batch(np.array([5, 6]), np.array([0, 0]), {})
+        assert op.advance_processing_time_timers() is None  # not due
+        clock.advance_to(1100)
+        out = op.advance_processing_time_timers()
+        assert out is not None
+        assert sorted(np.asarray(out["k"]).tolist()) == [5, 6]
+        assert fired[0][0] == "processing"
+        assert list(fired[0][2]) == [1100, 1100]
+
+    def test_event_and_processing_timers_coexist(self):
+        from flink_tpu.ops.process import KeyedProcessOperator
+
+        domains = []
+
+        class Fn:
+            def process_batch(self, ctx):
+                ctx.register_event_time_timers(
+                    np.full(len(ctx.slots), 500, np.int64))
+                ctx.register_processing_time_timers(
+                    np.full(len(ctx.slots), 800, np.int64))
+
+            def on_timer(self, ctx):
+                domains.append(ctx.time_domain)
+                ctx.emit({"k": ctx.keys}, ts=ctx.timestamps)
+
+        op = KeyedProcessOperator(Fn(), num_shards=4, slots_per_shard=16)
+        clock = ManualProcessingTimeService(0)
+        op.clock = clock
+        op.process_batch(np.array([1]), np.array([0]), {})
+        op.advance_watermark(600)          # event timer fires
+        clock.advance_to(900)
+        op.advance_processing_time_timers()  # proc timer fires
+        assert domains == ["event", "processing"]
+
+    def test_proc_timers_survive_snapshot_restore(self):
+        from flink_tpu.ops.process import KeyedProcessOperator
+
+        class Fn:
+            def __init__(self):
+                self.fired = []
+
+            def process_batch(self, ctx):
+                ctx.register_processing_time_timers(
+                    np.full(len(ctx.slots), 700, np.int64))
+
+            def on_timer(self, ctx):
+                self.fired.append(ctx.keys.copy())
+                ctx.emit({"k": ctx.keys}, ts=ctx.timestamps)
+
+        f1 = Fn()
+        op = KeyedProcessOperator(f1, num_shards=4, slots_per_shard=16)
+        op.clock = ManualProcessingTimeService(0)
+        op.process_batch(np.array([9]), np.array([0]), {})
+        snap = op.snapshot_state()
+        f2 = Fn()
+        op2 = KeyedProcessOperator(f2, num_shards=4, slots_per_shard=16)
+        clock2 = ManualProcessingTimeService(1000)
+        op2.clock = clock2
+        op2.restore_state(snap)
+        out = op2.advance_processing_time_timers()
+        assert out is not None and f2.fired and list(f2.fired[0]) == [9]
+
+
+class TestEndToEndProcTime:
+    def test_pipeline_with_proc_windows(self):
+        """Full driver path: proc-time windows fire via the runtime's
+        clock advance; end of input drains everything."""
+        from flink_tpu.api.environment import StreamExecutionEnvironment
+        from flink_tpu.config import Configuration
+
+        env = StreamExecutionEnvironment(Configuration({
+            "state.num-key-shards": 4, "state.slots-per-shard": 32,
+            "pipeline.microbatch-size": 64}))
+        keys = np.arange(100, dtype=np.int64) % 5
+        ts = np.zeros(100, np.int64)  # event time irrelevant
+        sink = (env.from_collection({"k": keys}, ts)
+                .key_by("k")
+                .window(TumblingProcessingTimeWindows.of(50))
+                .count()
+                .collect())
+        env.execute("proc-job")
+        got = {}
+        for r in sink.rows:
+            got[int(r["key"])] = got.get(int(r["key"]), 0) + int(r["count"])
+        # the drain at end of input must deliver every record exactly once
+        assert got == {k: 20 for k in range(5)}
