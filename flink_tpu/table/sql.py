@@ -14,10 +14,15 @@ token position rather than silently degrading.
 Supported grammar (case-insensitive keywords):
 
     SELECT sel [, sel ...]
-    FROM source
+    FROM source [[AS] ident]
+         [JOIN source [[AS] ident] ON eq [AND eq ...]]
     [WHERE expr]
     [GROUP BY ident [, ident ...]]
     [ORDER BY ident [DESC] LIMIT n | LIMIT n]
+
+    eq     := [ident.]ident = [ident.]ident     (JOIN: one cross-side
+              key equality; window_start/window_end equalities allowed
+              and tautological under the shared window spec)
 
     sel    := expr [AS ident] | agg(arg) [AS ident] | *
     agg    := COUNT(*|col) | SUM(col) | MAX(col) | MIN(col) | AVG(col)
@@ -83,7 +88,7 @@ class Tok:
 _KEYWORDS = {
     "select", "from", "where", "group", "by", "order", "limit", "desc",
     "asc", "as", "and", "or", "not", "table", "tumble", "hop", "session",
-    "descriptor", "interval", "having",
+    "descriptor", "interval", "having", "join", "on",
 }
 
 
@@ -134,9 +139,22 @@ class WindowTvf:
 
 
 @dataclasses.dataclass
+class JoinSource:
+    """FROM <tvf> [AS a] JOIN <tvf> [AS b] ON conjunction-of-equalities
+    (FLIP-145 window join shape). Each condition is a pair of
+    (qualifier-or-None, column) references."""
+
+    left: Any
+    left_alias: Optional[str]
+    right: Any
+    right_alias: Optional[str]
+    conds: List[Tuple[Tuple[Optional[str], str], Tuple[Optional[str], str]]]
+
+
+@dataclasses.dataclass
 class Query:
     items: List[SelectItem]
-    source: Any                 # str table name | WindowTvf
+    source: Any                 # str table name | WindowTvf | JoinSource
     where: Optional[Expression]
     group_by: List[str]
     order_by: Optional[Tuple[str, bool]]  # (col, desc)
@@ -184,6 +202,16 @@ class _Parser:
             items.append(self.select_item())
         self.expect("kw", "from")
         source = self.source()
+        left_alias = self.alias()
+        if self.accept("kw", "join"):
+            right = self.source()
+            right_alias = self.alias()
+            self.expect("kw", "on")
+            conds = [self.join_eq()]
+            while self.accept("kw", "and"):
+                conds.append(self.join_eq())
+            source = JoinSource(source, left_alias, right, right_alias,
+                                conds)
         where = None
         if self.accept("kw", "where"):
             where = self.expr()
@@ -236,6 +264,18 @@ class _Parser:
             return SelectItem(None, (fn, arg), alias)
         e = self.expr()
         return SelectItem(e, None, self.alias())
+
+    def join_eq(self) -> Tuple[Tuple[Optional[str], str],
+                               Tuple[Optional[str], str]]:
+        a = self.qualified_ref()
+        self.expect("op", "=")
+        return (a, self.qualified_ref())
+
+    def qualified_ref(self) -> Tuple[Optional[str], str]:
+        n1 = self.expect("ident").text
+        if self.accept("op", "."):
+            return (n1, self.expect("ident").text)
+        return (None, n1)
 
     def alias(self) -> Optional[str]:
         if self.accept("kw", "as"):
@@ -355,6 +395,10 @@ class _Parser:
         if t.kind == "str":
             return Lit(t.text)
         if t.kind == "ident":
+            if self.accept("op", "."):
+                # qualified reference (join queries): kept as a dotted
+                # Col name; the join planner resolves the qualifier
+                return Col(f"{t.text}.{self.expect('ident').text}")
             return Col(t.text)
         if t.kind == "op" and t.text == "(":
             e = self.expr()
@@ -373,6 +417,9 @@ def parse(sql: str) -> Query:
 
 def plan_sql(t_env: "TableEnvironment", sql: str) -> "Table":
     q = parse(sql)
+
+    if isinstance(q.source, JoinSource):
+        return _plan_join(t_env, q)
 
     # resolve source
     if isinstance(q.source, WindowTvf):
@@ -422,6 +469,139 @@ def plan_sql(t_env: "TableEnvironment", sql: str) -> "Table":
             raise SqlError(f"computed column needs AS alias: {e!r}")
         sels.append(e.alias(name))
     return table.select(*sels)
+
+
+def _plan_join(t_env: "TableEnvironment", q: Query) -> "Table":
+    """Windowed equi-join (FLIP-145 window join): both sides are the
+    SAME window TVF, ON carries exactly one cross-side key equality
+    (plus optional window_start/window_end equalities, which the shared
+    window spec makes tautological). Lowers onto the DataStream windowed
+    join (ops/join.py, Q8's exact-pairs operator). Everything outside
+    this shape raises SqlError naming what is unsupported."""
+    from flink_tpu.api.windowing import (
+        SlidingEventTimeWindows, TumblingEventTimeWindows)
+    from flink_tpu.table.api import Table, TableSchema
+
+    src: JoinSource = q.source
+    if q.group_by or any(it.agg for it in q.items):
+        raise SqlError(
+            "aggregation over a JOIN is not supported in v1 — join "
+            "first into a view, then aggregate")
+    if q.order_by is not None or q.limit is not None:
+        raise SqlError("ORDER BY/LIMIT over a JOIN is not supported")
+    if not isinstance(src.left, WindowTvf) or not isinstance(
+            src.right, WindowTvf):
+        raise SqlError(
+            "streaming JOIN requires a window TVF on BOTH sides "
+            "(an unbounded join has unbounded state); wrap each input "
+            "in TABLE(TUMBLE(...)/HOP(...))")
+    l, r = src.left, src.right
+    if l.kind == "session" or r.kind == "session":
+        raise SqlError("SESSION window JOIN is not supported")
+    if (l.kind, l.intervals) != (r.kind, r.intervals):
+        raise SqlError(
+            f"JOIN sides must share one window spec, got "
+            f"{l.kind.upper()}{l.intervals} vs {r.kind.upper()}"
+            f"{r.intervals}")
+    lt = t_env.table(l.table)
+    rt = t_env.table(r.table)
+    lname = src.left_alias or l.table
+    rname = src.right_alias or r.table
+    if lname == rname:
+        raise SqlError(f"ambiguous join side name {lname!r} — alias one")
+
+    def side_of(ref: Tuple[Optional[str], str], ctx: str) -> str:
+        qual, col = ref
+        if qual == lname:
+            return "L"
+        if qual == rname:
+            return "R"
+        if qual is not None:
+            raise SqlError(f"unknown qualifier {qual!r} in {ctx}")
+        in_l = col in lt.schema.columns
+        in_r = col in rt.schema.columns
+        if in_l and in_r:
+            raise SqlError(
+                f"column {col!r} in {ctx} is ambiguous — qualify it "
+                f"with {lname!r} or {rname!r}")
+        if in_l:
+            return "L"
+        if in_r:
+            return "R"
+        raise SqlError(f"unknown column {col!r} in {ctx}")
+
+    key_pairs = []
+    for a, b in src.conds:
+        if a[1] in ("window_start", "window_end") and a[1] == b[1]:
+            continue  # tautological under the shared window spec
+        sa, sb = side_of(a, "ON"), side_of(b, "ON")
+        if sa == sb:
+            raise SqlError(
+                "ON condition must compare columns across the two "
+                f"sides, got both from one side: {a[1]} = {b[1]}")
+        key_pairs.append((a[1], b[1]) if sa == "L" else (b[1], a[1]))
+    if len(key_pairs) != 1:
+        raise SqlError(
+            f"exactly one cross-side key equality is supported, got "
+            f"{len(key_pairs)}")
+    lk, rk = key_pairs[0]
+    lt.schema.check(lk)
+    rt.schema.check(rk)
+
+    # selected fields decide what each side carries through the join
+    out_names: List[str] = []
+    l_fields: List[str] = []
+    r_fields: List[str] = []
+    plan: List[Tuple[str, str]] = []  # (runtime field, output name)
+    for it in q.items:
+        if it.star:
+            raise SqlError(
+                "SELECT * over a JOIN is not supported — name the "
+                "columns (output schema would be ambiguous)")
+        if not isinstance(it.expr, Col):
+            raise SqlError(
+                "JOIN SELECT items must be plain columns in v1")
+        name = it.expr.name
+        qual, col = (name.split(".", 1) if "." in name else (None, name))
+        out = it.alias or col
+        if col in ("window_start", "window_end") or (
+                qual is None and col in (lk, rk) and lk == rk):
+            plan.append((col if col.startswith("window_") else "key", out))
+            out_names.append(out)
+            continue
+        side = side_of((qual, col), "SELECT")
+        if side == "L":
+            if col == lk:
+                plan.append(("key", out))
+            else:
+                l_fields.append(col)
+                plan.append((f"left_{col}", out))
+        else:
+            if col == rk:
+                plan.append(("key", out))
+            else:
+                r_fields.append(col)
+                plan.append((f"right_{col}", out))
+        out_names.append(out)
+
+    joined = (lt.stream.join(rt.stream)
+              .where(lk).equal_to(rk)
+              .window(TumblingEventTimeWindows.of(l.intervals[0])
+                      if l.kind == "tumble"
+                      else SlidingEventTimeWindows.of(
+                          l.intervals[1], l.intervals[0]))
+              .apply(left_fields=tuple(dict.fromkeys(l_fields)),
+                     right_fields=tuple(dict.fromkeys(r_fields)),
+                     name="sql_window_join"))
+
+    def project(data):
+        return {out: data[fieldname] for fieldname, out in plan}
+
+    out_stream = joined.map(project, name="sql_join_project")
+    table = Table(t_env, out_stream, TableSchema(tuple(out_names)))
+    if q.where is not None:
+        table = table.filter(q.where)
+    return table
 
 
 def _plan_aggregate(q: Query, table: "Table",

@@ -354,3 +354,112 @@ class TestReviewRegressions:
 
         assert (AggCall("avg", "price", "x").runtime_field
                 == result_fields(avg_of("price"))[0])
+
+
+class TestSqlJoin:
+    """SQL windowed equi-join lowering onto ops/join.py (Q8's shape),
+    golden-equal to the DataStream pipeline."""
+
+    def _streams(self, env, n=3000, seed=3):
+        rng = np.random.default_rng(seed)
+        ts_p = np.sort(rng.integers(0, 12_000, n)).astype(np.int64)
+        persons = {
+            "person": rng.integers(0, 50, n).astype(np.int64),
+            "state_id": rng.integers(0, 5, n).astype(np.int64),
+            "ts": ts_p,
+        }
+        ts_a = np.sort(rng.integers(0, 12_000, n)).astype(np.int64)
+        auctions = {
+            "seller": rng.integers(0, 50, n).astype(np.int64),
+            "reserve": rng.integers(1, 100, n).astype(np.int64),
+            "ts2": ts_a,
+        }
+        p = env.from_collection(persons, ts_p, batch_size=500)
+        a = env.from_collection(auctions, ts_a, batch_size=500)
+        return p, a, persons, auctions
+
+    def test_join_golden_vs_datastream(self):
+        # SQL side
+        env, te = _fresh()
+        p, a, _, _ = self._streams(env)
+        te.create_temporary_view("P", p, ["person", "state_id", "ts"])
+        te.create_temporary_view("A", a, ["seller", "reserve", "ts2"])
+        t = te.sql_query(
+            "SELECT P.person AS who, window_end, P.state_id, A.reserve "
+            "FROM TABLE(TUMBLE(TABLE P, DESCRIPTOR(ts), "
+            "INTERVAL '1' SECOND)) "
+            "JOIN TABLE(TUMBLE(TABLE A, DESCRIPTOR(ts2), "
+            "INTERVAL '1' SECOND)) "
+            "ON P.person = A.seller")
+        rows = t.execute("sql-join").collect()
+        got = _rowset(rows, ("who", "window_end", "state_id", "reserve"))
+
+        # DataStream side (Q8 wiring)
+        env2 = StreamExecutionEnvironment(Configuration({
+            "state.num-key-shards": 8, "state.slots-per-shard": 32}))
+        p2, a2, _, _ = self._streams(env2)
+        sink = CollectSink()
+        (p2.join(a2).where("person").equal_to("seller")
+         .window(TumblingEventTimeWindows.of(1000))
+         .apply(left_fields=("state_id",), right_fields=("reserve",))
+         .add_sink(sink))
+        env2.execute("ds-join")
+        want = sorted(
+            (round(float(r["key"]), 4), round(float(r["window_end"]), 4),
+             round(float(r["left_state_id"]), 4),
+             round(float(r["right_reserve"]), 4))
+            for r in sink.rows)
+        assert len(got) > 0
+        assert got == want
+
+    def test_join_where_on_output(self):
+        env, te = _fresh()
+        p, a, _, _ = self._streams(env, n=800)
+        te.create_temporary_view("P", p, ["person", "state_id", "ts"])
+        te.create_temporary_view("A", a, ["seller", "reserve", "ts2"])
+        t = te.sql_query(
+            "SELECT P.person AS who, A.reserve "
+            "FROM TABLE(TUMBLE(TABLE P, DESCRIPTOR(ts), "
+            "INTERVAL '1' SECOND)) "
+            "JOIN TABLE(TUMBLE(TABLE A, DESCRIPTOR(ts2), "
+            "INTERVAL '1' SECOND)) "
+            "ON P.person = A.seller WHERE reserve > 50")
+        rows = t.execute("sql-join-where").collect()
+        assert rows and all(float(r["reserve"]) > 50 for r in rows)
+
+    def test_window_equalities_accepted(self):
+        q = parse(
+            "SELECT P.person FROM TABLE(TUMBLE(TABLE P, DESCRIPTOR(ts),"
+            " INTERVAL '1' SECOND)) JOIN TABLE(TUMBLE(TABLE A,"
+            " DESCRIPTOR(ts2), INTERVAL '1' SECOND)) ON"
+            " P.person = A.seller AND window_start = window_start"
+            " AND window_end = window_end")
+        assert len(q.source.conds) == 3
+
+    @pytest.mark.parametrize("sql,msg", [
+        ("SELECT x FROM a JOIN b ON a.x = b.y",
+         "window TVF on BOTH sides"),
+        ("SELECT x FROM TABLE(TUMBLE(TABLE a, DESCRIPTOR(ts), INTERVAL"
+         " '1' SECOND)) JOIN TABLE(TUMBLE(TABLE b, DESCRIPTOR(ts),"
+         " INTERVAL '2' SECOND)) ON a.x = b.y",
+         "share one window spec"),
+        ("SELECT x FROM TABLE(SESSION(TABLE a, DESCRIPTOR(ts), INTERVAL"
+         " '1' SECOND)) JOIN TABLE(SESSION(TABLE b, DESCRIPTOR(ts),"
+         " INTERVAL '1' SECOND)) ON a.x = b.y",
+         "SESSION window JOIN"),
+        ("SELECT COUNT(*) FROM TABLE(TUMBLE(TABLE a, DESCRIPTOR(ts),"
+         " INTERVAL '1' SECOND)) JOIN TABLE(TUMBLE(TABLE b,"
+         " DESCRIPTOR(ts), INTERVAL '1' SECOND)) ON a.x = b.y",
+         "aggregation over a JOIN"),
+        ("SELECT x FROM TABLE(TUMBLE(TABLE a, DESCRIPTOR(ts), INTERVAL"
+         " '1' SECOND)) JOIN TABLE(TUMBLE(TABLE b, DESCRIPTOR(ts),"
+         " INTERVAL '1' SECOND)) ON a.x = b.y AND a.z = b.w",
+         "exactly one cross-side key equality"),
+    ])
+    def test_unsupported_join_shapes_raise(self, sql, msg):
+        env, te = _fresh()
+        s1, s2, _, _ = self._streams(env, n=50)
+        te.create_temporary_view("a", s1, ["x", "z", "ts"])
+        te.create_temporary_view("b", s2, ["y", "w", "ts"])
+        with pytest.raises(SqlError, match=msg):
+            te.sql_query(sql)
