@@ -40,7 +40,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from flink_tpu.state.keyed import KeyDirectory
+from flink_tpu.state.keyed import KeyDirectory, account_full_drop
 from flink_tpu.time.watermarks import LONG_MIN
 
 
@@ -137,7 +137,7 @@ class CepOperator:
         slots = self.directory.assign(keys[idx])
         bad = slots < 0
         if bad.any():
-            self.records_dropped_full += int(bad.sum())
+            account_full_drop(self, int(bad.sum()))
             idx, slots = idx[~bad], slots[~bad]
         if len(idx) == 0:
             return

@@ -255,6 +255,14 @@ class StateOptions:
         "Keyed state backend: 'hbm' (dense pane tensors, the "
         "HeapKeyedStateBackend analogue) or 'spill' (host offload, the "
         "RocksDB analogue).")
+    ALLOW_DROPS = ConfigOption(
+        "state.allow-drops", False,
+        "When a key-directory shard fills under state.backend='hbm', "
+        "the DEFAULT is to FAIL the job loudly (the reference degrades "
+        "but never drops — RocksDB's role, SURVEY §3.4). Set true to "
+        "instead drop overflow keys' records with accounting "
+        "(records_dropped_full), or use state.backend='spill' for "
+        "exact host-side degradation.")
 
 
 class CheckpointingOptions:

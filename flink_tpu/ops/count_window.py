@@ -33,7 +33,7 @@ from jax import lax
 
 from flink_tpu.ops.aggregates import LaneAggregate
 from flink_tpu.ops.window import FiredWindows, _next_pow2
-from flink_tpu.state.keyed import KeyDirectory
+from flink_tpu.state.keyed import KeyDirectory, account_full_drop
 from flink_tpu.time.watermarks import LONG_MIN
 
 # GlobalWindow.maxTimestamp() analogue — a finite sentinel end for the
@@ -156,7 +156,7 @@ class CountWindowOperator:
         slots = self.directory.assign(keys)
         bad = valid & (slots < 0)
         if bad.any():
-            self.records_dropped_full += int(bad.sum())
+            account_full_drop(self, int(bad.sum()))
             valid = valid & ~bad
         slots = np.where(valid, slots, self.R).astype(np.int32)
         if self.agg.fields is not None:

@@ -29,7 +29,7 @@ import numpy as np
 from flink_tpu.state.api import (
     ListStateDescriptor, ListStateVector, MapStateDescriptor,
     MapStateVector, ValueStateDescriptor, ValueStateVector)
-from flink_tpu.state.keyed import KeyDirectory
+from flink_tpu.state.keyed import KeyDirectory, account_full_drop
 from flink_tpu.time.watermarks import LONG_MIN
 
 
@@ -263,7 +263,7 @@ class KeyedProcessOperator:
         slots = self.directory.assign(keys[idx])
         bad = slots < 0
         if bad.any():
-            self.records_dropped_full += int(bad.sum())
+            account_full_drop(self, int(bad.sum()))
             idx = idx[~bad]
             slots = slots[~bad]
         if len(idx) == 0:

@@ -48,7 +48,8 @@ from flink_tpu.api.windowing import WindowAssigner
 from flink_tpu.hostsync import ready_wait
 from flink_tpu.ops.aggregates import LaneAggregate
 from flink_tpu.parallel.mesh import AXIS, MeshPlan
-from flink_tpu.state.keyed import KeyDirectory, PaneState, PaneStateLayout, init_state
+from flink_tpu.state.keyed import (
+    KeyDirectory, PaneState, PaneStateLayout, account_full_drop, init_state)
 from flink_tpu.state.spill import HostSpillStore
 from flink_tpu.time.watermarks import LONG_MIN
 
@@ -1380,10 +1381,11 @@ class WindowOperator:
             # remaining negatives: shard-full without a spill store, or
             # misrouted (-1: key outside this operator's shard_range —
             # a routing error the spill store must NOT absorb, or the
-            # key would aggregate on two workers at once). Drop WITH
-            # accounting — loud, not silently wrong.
+            # key would aggregate on two workers at once). Default
+            # policy FAILS the job; state.allow-drops=true drops with
+            # accounting (see account_full_drop).
             if bad.any():
-                self.records_dropped_full += int(bad.sum())
+                account_full_drop(self, int(bad.sum()))
             valid = valid & ~bad & ~full
         t2 = time.perf_counter()
         if self.mesh_plan is None and self._preagg_dispatch(
@@ -1538,7 +1540,7 @@ class WindowOperator:
         self.prof["preagg_combine"] += time.perf_counter() - t_scan
         self.late_records += n_late
         if n_bad:
-            self.records_dropped_full += n_bad
+            account_full_drop(self, n_bad)
         if n_refire:
             late_panes = (np.flatnonzero(
                 np.unpackbits(bitmap, bitorder="little")) + dead)

@@ -201,6 +201,7 @@ class Driver:
             exchange_impl=self.config.get(ClusterOptions.EXCHANGE_IMPL),
             max_out_of_orderness_ms=wm.max_out_of_orderness_ms,
         )
+        allow_drops = bool(self.config.get(StateOptions.ALLOW_DROPS))
         for n in self.plan.nodes.values():
             factory = lookup_operator_factory(n.kind)
             if factory is not None:
@@ -273,6 +274,10 @@ class Driver:
                     max_out_of_orderness_ms=max(wm.max_out_of_orderness_ms, 0),
                     mode=getattr(t, "mode", "pairs"),
                 )
+        # default-safe state policy: full-directory drops FAIL the job
+        # unless explicitly allowed (see state.keyed.account_full_drop)
+        for op in self._ops.values():
+            op.allow_drops = allow_drops
 
     # -- checkpointing ---------------------------------------------------
     def _setup_checkpointing(self, job_name: str):

@@ -305,3 +305,23 @@ class KeyDirectory:
         if len(used):
             d._table.insert_batch(keys, hash_keys_numpy(keys), used)
         return d
+
+
+def account_full_drop(op, n: int) -> None:
+    """Key-directory overflow policy (ref: the RocksDB role — the
+    reference DEGRADES on state growth, it never drops, SURVEY §3.4).
+    The default refuses to lose data: a full shard FAILS the job with
+    the remediation options; ``state.allow-drops=true`` opts into
+    dropping with accounting (the records_dropped_full gauge stays)."""
+    if n <= 0:
+        return
+    if not getattr(op, "allow_drops", False):
+        raise RuntimeError(
+            f"key directory shard full: {n} record(s) have no state "
+            "slot (state.num-key-shards x state.slots-per-shard "
+            "exceeded, or keys routed outside this worker's shard "
+            "range). The default policy never drops data - use "
+            "state.backend='spill' for exact host-side degradation, "
+            "raise the slot budget, or set state.allow-drops=true to "
+            "drop with accounting (records_dropped_full).")
+    op.records_dropped_full += n

@@ -88,10 +88,22 @@ class TestSpillGolden:
         assert rows_of(roomy) == rows_of(tiny)
         assert res.metrics["records_spilled"] > 0
 
-    def test_hbm_backend_still_drops_loudly(self):
-        """Contrast: default 'hbm' backend at tiny capacity counts the
-        overflow instead of spilling — loud, documented degradation."""
-        _, res = run_pipeline(make_env(4),
+    def test_hbm_backend_default_refuses_to_drop(self):
+        """Default-safe policy: the 'hbm' backend at tiny capacity FAILS
+        the job (the reference degrades, never drops — SURVEY §3.4)
+        unless drops are explicitly allowed."""
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="key directory shard full"):
+            run_pipeline(make_env(4),
+                         lambda s: s.count(),
+                         TumblingEventTimeWindows.of(1_000))
+
+    def test_hbm_backend_drops_with_accounting_when_allowed(self):
+        """state.allow-drops=true restores counted degradation — loud
+        (records_dropped_full gauge), never silent."""
+        env = make_env(4, extra={"state.allow-drops": True})
+        _, res = run_pipeline(env,
                               lambda s: s.count(),
                               TumblingEventTimeWindows.of(1_000))
         assert res.metrics["records_dropped_full"] > 0
@@ -231,6 +243,7 @@ class TestCoalescedDrainTopN:
             TumblingEventTimeWindows.of(1_000), aggregates.count(),
             num_shards=4, slots_per_shard=8, max_out_of_orderness_ms=0,
             shard_range=(0, 2), spill=True)
+        op.allow_drops = True  # this test asserts the counted-drop path
         op.process_batch(np.array([inside, outside], np.int64),
                          np.array([100, 100], np.int64), {})
         assert op.records_dropped_full == 1
