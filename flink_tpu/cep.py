@@ -49,6 +49,9 @@ class _Stage:
     name: str
     where: Optional[Callable[[Dict[str, np.ndarray]], np.ndarray]]
     strict: bool  # True = next() contiguity; False = followed_by()
+    times: int = 1        # expand into this many copies (times(n))
+    loop: bool = False    # oneOrMore: greedy unbounded repetition
+    optional: bool = False  # may be skipped when the NEXT stage matches
 
 
 class Pattern:
@@ -84,12 +87,102 @@ class Pattern:
     def within(self, ms: int) -> "Pattern":
         return Pattern(self._stages, int(ms))
 
+    # -- quantifiers (ref: cep/pattern/Quantifier.java) -----------------
+
+    def times(self, n: int) -> "Pattern":
+        """The most recent stage must occur exactly ``n`` times.
+        Repetitions inherit the stage's contiguity (next → strict
+        consecutive runs; followed_by → gaps allowed) and expand into
+        ``n`` engine stages at build time, so the vectorized rank-step
+        engine runs them unchanged. Match rows carry
+        ``<name>_1_ts .. <name>_n_ts``."""
+        if n < 1:
+            raise ValueError(f"times({n}): n must be >= 1")
+        last = self._stages[-1]
+        if last.loop or last.optional:
+            raise ValueError(
+                f"stage {last.name!r} already has a quantifier")
+        return Pattern(self._stages[:-1]
+                       + (dataclasses.replace(last, times=n),),
+                       self.within_ms)
+
+    def one_or_more(self) -> "Pattern":
+        """GREEDY unbounded repetition of the most recent stage
+        (ref: Pattern.oneOrMore, greedy + relaxed internal contiguity).
+        Deterministic subset: the loop absorbs every matching event
+        until an event matches the FOLLOWING stage (which terminates
+        the match), so the pattern must continue past it — a trailing
+        oneOrMore would need the reference's exponential partial-match
+        buffers to decide when to emit. Match rows carry
+        ``<name>_ts`` (first), ``<name>_last_ts`` and ``<name>_count``."""
+        last = self._stages[-1]
+        if last.strict:
+            raise ValueError(
+                "one_or_more() requires relaxed contiguity — use "
+                "followed_by(), not next(), for the repeated stage")
+        if last.times != 1 or last.optional:
+            raise ValueError(
+                f"stage {last.name!r} already has a quantifier")
+        return Pattern(self._stages[:-1]
+                       + (dataclasses.replace(last, loop=True),),
+                       self.within_ms)
+
+    def optional(self) -> "Pattern":
+        """The most recent stage may be absent: when an event matches
+        the FOLLOWING stage while this one is pending, the automaton
+        skips it (ref: Pattern.optional). Its ``<name>_ts`` column is
+        -1 in matches where it was skipped."""
+        last = self._stages[-1]
+        if last.loop or last.times != 1:
+            raise ValueError(
+                f"stage {last.name!r} already has a quantifier")
+        return Pattern(self._stages[:-1]
+                       + (dataclasses.replace(last, optional=True),),
+                       self.within_ms)
+
     @property
     def stages(self) -> Tuple[_Stage, ...]:
+        """Quantifier-EXPANDED engine stages + validation."""
         for s in self._stages:
             if s.where is None:
                 raise ValueError(f"stage {s.name!r} has no where()")
-        return self._stages
+        out: List[_Stage] = []
+        for i, s in enumerate(self._stages):
+            is_last = i == len(self._stages) - 1
+            if s.loop and is_last:
+                raise ValueError(
+                    "a trailing one_or_more() cannot decide when the "
+                    "match ends in the deterministic engine — add a "
+                    "terminating stage after it")
+            if s.optional and is_last:
+                raise ValueError(
+                    "a trailing optional() stage is not supported — "
+                    "the match would be ambiguous (with-or-without)")
+            if s.optional and i == 0:
+                raise ValueError(
+                    "optional() on the first stage is not supported — "
+                    "the match start would be undefined when skipped")
+            if (s.loop or s.optional) and not is_last \
+                    and self._stages[i + 1].strict:
+                raise ValueError(
+                    f"stage after quantified {s.name!r} must use "
+                    "followed_by() (strict next() after a variable-"
+                    "length stage is ambiguous)")
+            if s.times == 1:
+                out.append(s)
+            else:
+                for rep in range(1, s.times + 1):
+                    out.append(dataclasses.replace(
+                        s, name=f"{s.name}_{rep}", times=1,
+                        # first repetition keeps the stage's contiguity
+                        # vs its predecessor; the rest repeat with the
+                        # stage's own contiguity between repetitions
+                        strict=s.strict))
+        if sum(1 for s in out if s.loop) > 1:
+            raise ValueError(
+                "at most one one_or_more() stage per pattern (the "
+                "engine keeps a single loop counter per key)")
+        return tuple(out)
 
 
 class CepOperator:
@@ -108,6 +201,14 @@ class CepOperator:
         cap = num_shards * slots_per_shard
         self.stage = np.zeros(cap, np.int32)        # next stage to match
         self.stage_ts = np.zeros((cap, self.S), np.int64)
+        # quantifier flags over EXPANDED stages + loop state (at most
+        # one one_or_more stage per pattern — validated at build)
+        self._is_loop = np.array([s.loop for s in self.stages], bool)
+        self._is_opt = np.array([s.optional for s in self.stages], bool)
+        self._loop_idx = (int(np.nonzero(self._is_loop)[0][0])
+                          if self._is_loop.any() else -1)
+        self.loop_cnt = np.zeros(cap, np.int32)
+        self.loop_last = np.zeros(cap, np.int64)
         # highest event ts processed per key: the automaton consumes
         # each key's events in time order WITHIN a batch; an event
         # arriving in a later batch but timestamped before this frontier
@@ -171,31 +272,72 @@ class CepOperator:
 
         within = self.pattern.within_ms
         strict = np.array([s.strict for s in self.stages], bool)
+        is_loop, is_opt = self._is_loop, self._is_opt
         for r in range(max_rank):
             m = rank == r                    # one event per key this step
             s_r = sl[m]
             t_r = tt[m]
             p_r = pr[:, m]                   # (S, k)
+            k = len(s_r)
+            ar = np.arange(k)
             cur = self.stage[s_r]            # (k,) next stage to match
 
             # within-window expiry: partial too old resets to stage 0
             if within is not None:
                 expired = (cur > 0) & (t_r - self.stage_ts[s_r, 0] > within)
                 cur = np.where(expired, 0, cur)
+                if self._loop_idx >= 0:
+                    self.loop_cnt[s_r[expired]] = 0
 
-            hit = p_r[np.minimum(cur, self.S - 1), np.arange(len(s_r))]
-            adv = hit                        # advance on match
-            # strict stage missed -> partial dies; the breaking event
-            # re-tests against stage 0
-            miss_strict = ~hit & strict[np.minimum(cur, self.S - 1)] & (cur > 0)
-            restart = miss_strict & p_r[0, np.arange(len(s_r))]
-            new_stage = np.where(adv, cur + 1,
-                                 np.where(miss_strict,
-                                          np.where(restart, 1, 0), cur))
-            # record the matched stage's timestamp
-            st_idx = np.where(adv, cur, 0)
-            write = adv | restart
-            self.stage_ts[s_r[write], st_idx[write]] = t_r[write]
+            curc = np.minimum(cur, self.S - 1)
+            hit_cur = p_r[curc, ar]
+            nxtc = np.minimum(cur + 1, self.S - 1)
+            has_next = cur + 1 < self.S
+            hit_next = p_r[nxtc, ar] & has_next
+            lp = is_loop[curc] & (cur < self.S)
+            op_ = is_opt[curc] & (cur < self.S)
+            in_loop = lp & (self.loop_cnt[s_r] > 0)
+
+            # decision precedence (greedy loop first):
+            # A. loop enter/continue: stay, count, track first/last ts
+            a_loop = lp & hit_cur
+            # B. loop exit: the FOLLOWING stage's event terminates it
+            b_exit = in_loop & ~hit_cur & hit_next
+            # C. optional skip: next stage's event while optional pends
+            c_skip = op_ & ~hit_cur & hit_next
+            # D. plain advance
+            d_adv = ~lp & ~c_skip & hit_cur
+            # E. strict miss -> partial dies (breaking event re-tests
+            #    stage 0)
+            miss_strict = (~a_loop & ~b_exit & ~c_skip & ~d_adv
+                           & ~hit_cur & strict[curc] & (cur > 0))
+            restart = miss_strict & p_r[0, ar]
+
+            new_stage = np.where(
+                a_loop, cur,
+                np.where(b_exit | c_skip, cur + 2,
+                         np.where(d_adv, cur + 1,
+                                  np.where(miss_strict,
+                                           np.where(restart, 1, 0),
+                                           cur))))
+
+            # timestamp bookkeeping
+            enter_loop = a_loop & ~in_loop
+            if self._loop_idx >= 0:
+                self.loop_cnt[s_r[enter_loop]] = 1
+                cont = a_loop & in_loop
+                self.loop_cnt[s_r[cont]] += 1
+                self.loop_last[s_r[a_loop]] = t_r[a_loop]
+            # first occurrence of a stage writes its ts: plain advances
+            # at cur, loop entries at cur, exits/skips at cur+1
+            w_cur = d_adv | enter_loop | restart
+            st_cur = np.where(restart, 0, cur)
+            self.stage_ts[s_r[w_cur], st_cur[w_cur]] = t_r[w_cur]
+            w_nxt = b_exit | c_skip
+            self.stage_ts[s_r[w_nxt], np.minimum(cur[w_nxt] + 1,
+                                                 self.S - 1)] = t_r[w_nxt]
+            # a skipped optional stage reads -1 in the match row
+            self.stage_ts[s_r[c_skip], curc[c_skip]] = -1
 
             done = new_stage >= self.S
             if done.any():
@@ -203,9 +345,13 @@ class CepOperator:
                 row = {"key": kk[m][d],
                        "match_start": self.stage_ts[s_r[d], 0].copy(),
                        "match_end": t_r[d].copy()}
-                for si, stg in enumerate(self.stages[:-1]):
+                for si, stg in enumerate(self.stages):
                     row[f"{stg.name}_ts"] = self.stage_ts[s_r[d], si].copy()
-                row[f"{self.stages[-1].name}_ts"] = t_r[d].copy()
+                if self._loop_idx >= 0:
+                    ln = self.stages[self._loop_idx].name
+                    row[f"{ln}_last_ts"] = self.loop_last[s_r[d]].copy()
+                    row[f"{ln}_count"] = self.loop_cnt[s_r[d]].copy()
+                    self.loop_cnt[s_r[d]] = 0
                 self._matches.append(row)
                 new_stage = np.where(done, 0, new_stage)  # SKIP_PAST_LAST
 
@@ -249,6 +395,8 @@ class CepOperator:
             "directory": self.directory.snapshot(),
             "stage": self.stage.copy(),
             "stage_ts": self.stage_ts.copy(),
+            "loop_cnt": self.loop_cnt.copy(),
+            "loop_last": self.loop_last.copy(),
             "watermark": self.watermark,
             "late_records": self.late_records,
             "records_dropped_full": self.records_dropped_full,
@@ -262,6 +410,9 @@ class CepOperator:
             (self.directory.shard_lo, self.directory.shard_hi))
         self.stage = np.array(snap["stage"])
         self.stage_ts = np.array(snap["stage_ts"])
+        if snap.get("loop_cnt") is not None:
+            self.loop_cnt = np.array(snap["loop_cnt"])
+            self.loop_last = np.array(snap["loop_last"])
         self.watermark = snap["watermark"]
         self.late_records = snap["late_records"]
         self.records_dropped_full = snap["records_dropped_full"]

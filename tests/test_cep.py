@@ -172,3 +172,196 @@ class TestCepE2E:
                      for r in sink.rows)
         # acct 1: small@0, large@11; acct 2: first small@10, large@21
         assert got == [(1, 0, 11), (2, 10, 21)]
+
+
+# ---------------------------------------------------------------------------
+# Quantifiers: times(n), one_or_more, optional — property-tested against
+# a SCALAR oracle implementing the same documented semantics
+# (greedy loop, SKIP_PAST_LAST_EVENT, one partial per key).
+# ---------------------------------------------------------------------------
+
+def scalar_oracle(stages, within, events):
+    """Per-key scalar engine over EXPANDED stages: the independent
+    reference the vectorized rank-step engine is checked against.
+    events: list of (key, ts, {field: value}) in arrival order.
+    Returns list of (key, match_start, match_end)."""
+    S = len(stages)
+    st = {}
+    out = []
+    by_key = {}
+    for k, t, d in events:
+        by_key.setdefault(k, []).append((t, d))
+    for k, evs in by_key.items():
+        evs.sort(key=lambda e: e[0])
+        cur, ts0, cnt = 0, None, 0
+        stage_ts = [None] * S
+        for t, d in evs:
+            def hit(i):
+                return bool(stages[i].where(
+                    {f: np.asarray([v]) for f, v in d.items()})[0])
+
+            if within is not None and cur > 0 and \
+                    t - stage_ts[0] > within:
+                cur, cnt = 0, 0
+            lp = stages[min(cur, S - 1)].loop and cur < S
+            op_ = stages[min(cur, S - 1)].optional and cur < S
+            in_loop = lp and cnt > 0
+            h = hit(min(cur, S - 1)) if cur < S else False
+            hn = hit(cur + 1) if cur + 1 < S else False
+            if lp and h:                       # A: loop enter/continue
+                if cnt == 0:
+                    stage_ts[cur] = t
+                cnt += 1
+            elif in_loop and not h and hn:     # B: loop exit
+                stage_ts[cur + 1] = t
+                cur += 2
+            elif op_ and not h and hn:         # C: optional skip
+                stage_ts[cur] = -1
+                stage_ts[cur + 1] = t
+                cur += 2
+            elif not lp and h:                 # D: plain advance
+                stage_ts[cur] = t
+                cur += 1
+            elif not h and stages[min(cur, S - 1)].strict and cur > 0:
+                if hit(0):                     # E: strict restart
+                    stage_ts[0] = t
+                    cur = 1
+                else:
+                    cur = 0
+            if cur >= S:
+                out.append((k, stage_ts[0], t))
+                cur, cnt = 0, 0
+    return sorted(out)
+
+
+def run_op(pattern, events):
+    op = CepOperator(pattern, num_shards=8, slots_per_shard=64)
+    keys = np.asarray([e[0] for e in events], np.int64)
+    ts = np.asarray([e[1] for e in events], np.int64)
+    fields = {f: np.asarray([e[2][f] for e in events])
+              for f in events[0][2]}
+    op.process_batch(keys, ts, fields)
+    f = op.take_fired()
+    if f is None:
+        return [], op
+    d = dict(f)
+    return sorted(zip(map(int, d["key"]), map(int, d["match_start"]),
+                      map(int, d["match_end"]))), op
+
+
+class TestQuantifiers:
+    def test_times_expands_and_matches(self):
+        # small followed by exactly 2 larges
+        p = (Pattern.begin("small").where(lambda d: d["amount"] < 10)
+             .followed_by("large").where(lambda d: d["amount"] > 500)
+             .times(2))
+        events = [(1, 0, {"amount": 5}), (1, 10, {"amount": 600}),
+                  (1, 20, {"amount": 700}), (1, 30, {"amount": 800})]
+        got, op = run_op(p, events)
+        assert got == [(1, 0, 20)]
+        f_names = [s.name for s in p.stages]
+        assert f_names == ["small", "large_1", "large_2"]
+
+    def test_times_strict_consecutive(self):
+        p = (Pattern.begin("a").where(lambda d: d["v"] == 1)
+             .next("b").where(lambda d: d["v"] == 2).times(2))
+        ok = [(1, 0, {"v": 1}), (1, 1, {"v": 2}), (1, 2, {"v": 2})]
+        got, _ = run_op(p, ok)
+        assert got == [(1, 0, 2)]
+        broken = [(2, 0, {"v": 1}), (2, 1, {"v": 2}), (2, 2, {"v": 9}),
+                  (2, 3, {"v": 2})]
+        got, _ = run_op(p, broken)
+        assert got == []
+
+    def test_one_or_more_greedy_counts(self):
+        p = (Pattern.begin("up").where(lambda d: d["v"] > 0)
+             .one_or_more()
+             .followed_by("down").where(lambda d: d["v"] < 0))
+        events = [(1, 0, {"v": 1}), (1, 10, {"v": 2}), (1, 20, {"v": 3}),
+                  (1, 30, {"v": -1})]
+        op = CepOperator(p, num_shards=4, slots_per_shard=16)
+        feed_events(op, events)
+        f = dict(op.take_fired())
+        assert list(map(int, f["up_count"])) == [3]
+        assert list(map(int, f["up_ts"])) == [0]
+        assert list(map(int, f["up_last_ts"])) == [20]
+        assert list(map(int, f["down_ts"])) == [30]
+
+    def test_optional_present_and_absent(self):
+        p = (Pattern.begin("a").where(lambda d: d["v"] == 1)
+             .followed_by("b").where(lambda d: d["v"] == 2).optional()
+             .followed_by("c").where(lambda d: d["v"] == 3))
+        present = [(1, 0, {"v": 1}), (1, 1, {"v": 2}), (1, 2, {"v": 3})]
+        op = CepOperator(p, num_shards=4, slots_per_shard=16)
+        feed_events(op, present)
+        f = dict(op.take_fired())
+        assert list(map(int, f["b_ts"])) == [1]
+        absent = [(2, 0, {"v": 1}), (2, 1, {"v": 3})]
+        op2 = CepOperator(p, num_shards=4, slots_per_shard=16)
+        feed_events(op2, absent)
+        f2 = dict(op2.take_fired())
+        assert list(map(int, f2["b_ts"])) == [-1]
+        assert list(map(int, f2["c_ts"])) == [1]
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+    def test_property_vs_scalar_oracle(self, seed):
+        """Random event streams over random quantified patterns: the
+        vectorized engine must agree with the scalar oracle exactly."""
+        rng = np.random.default_rng(seed)
+        variant = seed % 3
+        if variant == 0:
+            p = (Pattern.begin("a").where(lambda d: d["v"] < 3)
+                 .followed_by("b").where(lambda d: d["v"] >= 7).times(2)
+                 .within(50))
+        elif variant == 1:
+            p = (Pattern.begin("a").where(lambda d: d["v"] < 3)
+                 .one_or_more()
+                 .followed_by("b").where(lambda d: d["v"] >= 7))
+        else:
+            p = (Pattern.begin("a").where(lambda d: d["v"] < 3)
+                 .followed_by("b").where(lambda d: (d["v"] >= 3)
+                                         & (d["v"] < 5)).optional()
+                 .followed_by("c").where(lambda d: d["v"] >= 7))
+        n = 400
+        events = [(int(k), int(t), {"v": int(v)})
+                  for k, t, v in zip(rng.integers(0, 12, n),
+                                     np.sort(rng.integers(0, 3000, n)),
+                                     rng.integers(0, 10, n))]
+        # unique (key, ts) pairs: both engines sequence per key by ts
+        seen = set()
+        events = [e for e in events
+                  if (e[0], e[1]) not in seen
+                  and not seen.add((e[0], e[1]))]
+        got, _ = run_op(p, events)
+        want = scalar_oracle(p.stages, p.within_ms, events)
+        assert got == want
+
+    @pytest.mark.parametrize("build,msg", [
+        (lambda: Pattern.begin("a").where(lambda d: d["v"] > 0)
+         .one_or_more().stages, "trailing one_or_more"),
+        (lambda: (Pattern.begin("a").where(lambda d: d["v"] > 0)
+                  .followed_by("b").where(lambda d: d["v"] < 0)
+                  .optional()).stages, "trailing optional"),
+        (lambda: (Pattern.begin("a").where(lambda d: d["v"] > 0)
+                  .optional()
+                  .followed_by("b").where(lambda d: d["v"] < 0)).stages,
+         "first stage"),
+        (lambda: (Pattern.begin("a").where(lambda d: d["v"] > 0)
+                  .one_or_more()
+                  .next("b").where(lambda d: d["v"] < 0)).stages,
+         "followed_by"),
+        (lambda: Pattern.begin("a").where(lambda d: d["v"] > 0)
+         .next("b").where(lambda d: d["v"] < 0).one_or_more(),
+         "relaxed contiguity"),
+    ])
+    def test_invalid_quantifier_shapes_raise(self, build, msg):
+        with pytest.raises(ValueError, match=msg):
+            build()
+
+
+def feed_events(op, events):
+    keys = np.asarray([e[0] for e in events], np.int64)
+    ts = np.asarray([e[1] for e in events], np.int64)
+    fields = {f: np.asarray([e[2][f] for e in events])
+              for f in events[0][2]}
+    op.process_batch(keys, ts, fields)
