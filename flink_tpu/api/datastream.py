@@ -263,6 +263,7 @@ class WindowedStream(_AggregateShortcuts):
         self.assigner = assigner
         self._lateness = 0
         self._trigger: Optional[Trigger] = None
+        self._evictor = None
 
     def allowed_lateness(self, ms: int) -> "WindowedStream":
         self._lateness = ms
@@ -271,6 +272,51 @@ class WindowedStream(_AggregateShortcuts):
     def trigger(self, trigger: Trigger) -> "WindowedStream":
         self._trigger = trigger
         return self
+
+    def evictor(self, evictor) -> "WindowedStream":
+        """ref: WindowedStream.evictor — routes the window onto the
+        element-buffer operator (ops/evicting_window.py): eviction
+        needs the window's elements at fire time, which the incremental
+        pane kernels never materialize (the reference pays the same
+        price — EvictingWindowOperator switches to ListState)."""
+        self._evictor = evictor
+        return self
+
+    def _element_path(self) -> bool:
+        """True when this window must run on the element-buffer
+        operator: an evictor is set, or the trigger is outside the
+        vectorized families (user Trigger subclasses, CountTrigger on
+        time windows — exact per-element semantics)."""
+        from flink_tpu.api.windowing import (
+            EventTimeTrigger, ProcessingTimeTrigger, PurgingTrigger)
+
+        if getattr(self, "_evictor", None) is not None:
+            return True
+        t = self._trigger
+        if t is None or isinstance(t, (EventTimeTrigger,
+                                       ProcessingTimeTrigger)):
+            return False
+        if isinstance(t, PurgingTrigger) and isinstance(
+                t.inner, EventTimeTrigger) and self._lateness == 0:
+            return False
+        return True
+
+    def apply(self, window_fn, name: str = "evicting_window") -> DataStream:
+        """Element-path window function: ``window_fn(elements)`` sees
+        the window's surviving elements (field arrays + ``__ts__``)
+        and returns the result row's fields (ref: WindowFunction.apply
+        over the evicted iterable)."""
+        kt = self.keyed.transform
+        assert isinstance(kt, KeyByTransformation)
+        from flink_tpu.graph.transformations import (
+            EvictingWindowTransformation)
+
+        t = EvictingWindowTransformation(
+            name, (kt,), assigner=self.assigner, window_fn=window_fn,
+            trigger=self._trigger, evictor=getattr(self, "_evictor", None),
+            allowed_lateness_ms=self._lateness, key_field=kt.key_field)
+        self.keyed.env._register(t)
+        return DataStream(self.keyed.env, t)
 
     def _check_trigger(self) -> None:
         """Validate the trigger/window combination at build time —
@@ -328,6 +374,8 @@ class WindowedStream(_AggregateShortcuts):
         """ref: WindowedStream.aggregate(AggregateFunction) — but taking
         the lane-lowered form directly; ``lower_aggregate`` adapts
         reference-style AggregateFunction classes."""
+        if self._element_path():
+            return self.apply(_element_window_fn(agg), name=name)
         self._check_trigger()
         kt = self.keyed.transform
         assert isinstance(kt, KeyByTransformation)
@@ -338,6 +386,27 @@ class WindowedStream(_AggregateShortcuts):
         self.keyed.env._register(t)
         return WindowedAggregateStream(self.keyed.env, t)
 
+
+
+def _element_window_fn(agg: LaneAggregate):
+    """Adapt a LaneAggregate to the element-path window-function
+    contract: reduce the surviving elements' lifted lanes and finalize.
+    Host-side per (key, window) — the compatibility path's cost."""
+    import numpy as np
+
+    def fn(elements):
+        data = {k: v for k, v in elements.items() if k != "__ts__"}
+        n = len(elements["__ts__"])
+        import jax.numpy as jnp
+
+        s, mx, mn = agg.lift_masked(
+            {k: jnp.asarray(np.asarray(v)) for k, v in data.items()},
+            jnp.ones(n, bool))
+        res = agg.finalize(jnp.sum(s, axis=0), jnp.max(mx, axis=0),
+                           jnp.min(mn, axis=0), jnp.asarray(n, jnp.int32))
+        return {k: np.asarray(v) for k, v in res.items()}
+
+    return fn
 
 
 class AllWindowedStream(_AggregateShortcuts):

@@ -21,6 +21,7 @@ import numpy as np
 
 from flink_tpu.config import Configuration, PipelineOptions, StateOptions
 from flink_tpu.graph.transformations import (
+    EvictingWindowTransformation,
     BroadcastConnectTransformation,
     KeyByTransformation,
     MapTransformation,
@@ -146,6 +147,11 @@ def compile_job(
         elif isinstance(t, WindowAggregateTransformation):
             up = node_for(t.inputs[0])
             n = new_node("window", t.name, window_transform=t,
+                         key_field=t.key_field)
+            nodes[up].downstream.append(n.id)
+        elif isinstance(t, EvictingWindowTransformation):
+            up = node_for(t.inputs[0])
+            n = new_node("evicting_window", t.name, window_transform=t,
                          key_field=t.key_field)
             nodes[up].downstream.append(n.id)
         elif isinstance(t, AsyncIOTransformation):

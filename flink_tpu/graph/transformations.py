@@ -89,6 +89,21 @@ class WindowAggregateTransformation(Transformation):
 
 
 @dataclasses.dataclass(eq=False)
+class EvictingWindowTransformation(Transformation):
+    """Keyed window with an evictor and/or a custom user trigger — the
+    element-buffer path (ref: WindowedStream.evictor/trigger →
+    EvictingWindowOperator; see ops/evicting_window.py for why this
+    cannot ride the pane kernels)."""
+
+    assigner: Optional[WindowAssigner] = None
+    window_fn: Any = None        # fn(elements dict incl __ts__) -> row dict
+    trigger: Optional[Trigger] = None
+    evictor: Any = None
+    allowed_lateness_ms: int = 0
+    key_field: str = "key"
+
+
+@dataclasses.dataclass(eq=False)
 class AsyncIOTransformation(Transformation):
     """Async external enrichment (ref: AsyncDataStream.orderedWait /
     unorderedWait -> AsyncWaitOperator; see ops/async_io.py)."""

@@ -34,6 +34,9 @@ class RunnerInfo:
     last_heartbeat: float
     alive: bool = True
     port: int = 0  # runner gateway (0 = bookkeeping-only registration)
+    # scale-in drain: no NEW allocations land here; existing jobs
+    # stop-with-savepoint and redeploy elsewhere (rpc_drain_runner)
+    draining: bool = False
 
 
 @dataclasses.dataclass
@@ -63,6 +66,8 @@ class JobInfo:
     pending_rescale: Optional[int] = None
     rescale_token: Optional[str] = None
     restore_path: Optional[str] = None
+    # scale-in drain: runner the post-savepoint redeploy must avoid
+    drain_exclude: Optional[str] = None
     # per-runner completion of the CURRENT attempt: the job finishes
     # when every assigned runner reports done (an empty-split-share
     # runner finishing early must not end the whole job)
@@ -84,6 +89,11 @@ class JobCoordinator(RpcEndpoint):
         self.runners: Dict[str, RunnerInfo] = {}
         self.jobs: Dict[str, JobInfo] = {}
         self._slots = SlotPool()
+        # active-resource seam (ref: ActiveResourceManager): unmet slot
+        # demand is pushed here; standalone mode just records it
+        from flink_tpu.runtime.provisioner import StandaloneProvisioner
+
+        self.provisioner = StandaloneProvisioner()
         self._strategies: Dict[str, RestartStrategy] = {}
         # HA job store: non-terminal deployable jobs survive coordinator
         # loss — a new leader re-deploys them with restore:latest (ref:
@@ -247,20 +257,37 @@ class JobCoordinator(RpcEndpoint):
                 return
             # slot allocation: best-fit over free device counts; a retry
             # releases the previous allocation first (ref:
-            # ExecutionSlotAllocator + FineGrainedSlotManager matching)
+            # ExecutionSlotAllocator + FineGrainedSlotManager matching).
+            # Draining runners and a drain's source runner never
+            # receive the allocation.
             self._slots.release(job_id)
+            full_exclude = list(exclude) + [
+                r.runner_id for r in self.runners.values() if r.draining]
+            if j.drain_exclude:
+                full_exclude.append(j.drain_exclude)
             target = self._slots.pick(
                 job_id, j.required_devices,
-                list(self.runners.values()), exclude=exclude)
+                list(self.runners.values()), exclude=full_exclude)
             if target is None:
                 # park until capacity registers (ref: AdaptiveScheduler
                 # WaitingForResources); a lost-runner retry with no
-                # fallback runner waits here too instead of failing
+                # fallback runner waits here too instead of failing.
+                # Unmet demand reaches the provisioner seam (ref:
+                # ActiveResourceManager requesting new workers).
                 j.state = "WAITING_FOR_RESOURCES"
                 j.failure = (
                     f"waiting for a runner with {j.required_devices} "
                     "free device(s)")
+                demands = [
+                    {"job_id": w, "required_devices":
+                     self.jobs[w].required_devices}
+                    for w in self._waiting_locked()]
+                prov = self.provisioner
+                threading.Thread(
+                    target=lambda: prov.request_capacity(demands),
+                    daemon=True).start()
                 return
+            j.drain_exclude = None
             resolved = (target.n_devices
                         if j.required_devices == SlotPool.ALL
                         else j.required_devices)
@@ -676,6 +703,51 @@ class JobCoordinator(RpcEndpoint):
                     jj.rescale_token = None
             return resp
         return {"ok": True, "dispatched": True, "devices": devices}
+
+    def rpc_drain_runner(self, runner_id: str) -> dict:
+        """Scale-in drain (ref: ActiveResourceManager releasing a
+        TaskManager): mark the runner unschedulable, then move every
+        job it hosts elsewhere via stop-with-savepoint → redeploy
+        (state travels through the savepoint; the rescale handshake is
+        reused with the SAME width and the drained runner excluded
+        from the reallocation). Once job_status shows the jobs RUNNING
+        elsewhere the machine can be removed."""
+        import uuid as _uuid
+
+        with self._lock:
+            r = self.runners.get(runner_id)
+            if r is None:
+                return {"ok": False, "reason": "unknown runner"}
+            r.draining = True
+            victims = []
+            for job_id, alloc in list(self._slots._allocations.items()):
+                if alloc[0] != runner_id:
+                    continue
+                j = self.jobs.get(job_id)
+                if j is None or j.entry is None or j.state != "RUNNING":
+                    continue
+                if j.pending_rescale is not None:
+                    continue  # an in-flight rescale already moves it
+                token = f"drain-{_uuid.uuid4().hex[:12]}"
+                j.pending_rescale = j.required_devices  # same width
+                j.rescale_token = token
+                j.drain_exclude = runner_id
+                victims.append((job_id, token))
+        dispatched = []
+        for job_id, token in victims:
+            resp = self.rpc_trigger_savepoint(job_id, stop=True,
+                                              token=token)
+            if resp.get("ok"):
+                dispatched.append(job_id)
+            else:
+                with self._lock:
+                    jj = self.jobs.get(job_id)
+                    if jj is not None and jj.rescale_token == token:
+                        jj.pending_rescale = None
+                        jj.rescale_token = None
+                        jj.drain_exclude = None
+        return {"ok": True, "draining": runner_id,
+                "moving_jobs": dispatched}
 
     def rpc_list_runners(self) -> dict:
         with self._lock:

@@ -258,6 +258,15 @@ class Driver:
                     num_shards=num_shards, slots_per_shard=slots,
                     max_out_of_orderness_ms=max(wm.max_out_of_orderness_ms, 0),
                 )
+            elif n.kind == "evicting_window":
+                from flink_tpu.ops.evicting_window import (
+                    EvictingWindowOperator)
+
+                t = n.window_transform
+                self._ops[n.id] = EvictingWindowOperator(
+                    t.assigner, t.window_fn, trigger=t.trigger,
+                    evictor=t.evictor,
+                    allowed_lateness_ms=t.allowed_lateness_ms)
             elif n.kind == "broadcast_connect":
                 from flink_tpu.ops.broadcast import BroadcastConnectOperator
 
@@ -922,13 +931,14 @@ class Driver:
                         if np.asarray(v).dtype != object}
             op.process_batch(ts, dev_data, valid)
         elif n.kind in ("window", "session", "count_window", "process",
-                        "cep"):
+                        "cep", "evicting_window"):
             op = self._ops[nid]
             keys = np.asarray(data[n.key_field], np.int64)
             dev_data = {k: v for k, v in data.items()
                         if np.asarray(v).dtype != object}
             op.process_batch(keys, ts, dev_data, valid)
-            if n.kind in ("count_window", "process", "cep"):
+            if n.kind in ("count_window", "process", "cep",
+                          "evicting_window"):
                 # these emit per-step, not (only) per-watermark
                 fired = op.take_fired()
                 if fired is not None:
@@ -975,7 +985,7 @@ class Driver:
             # (fires ride process_batch), so advancing it would only
             # queue guaranteed-empty fires through the drain
             if n.kind in ("window", "session", "join", "window_all",
-                          "process"):
+                          "process", "evicting_window"):
                 op = self._ops[nid]
                 if getattr(op, "uses_processing_time", False):
                     # proc-time windows: the clock, not the event

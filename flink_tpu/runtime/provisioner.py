@@ -1,0 +1,74 @@
+"""Active resource provisioning seam.
+
+ref: runtime/resourcemanager/active/ActiveResourceManager.java — the
+reference's active mode REQUESTS new TaskManagers from YARN/K8s when
+slot demand outstrips supply and RELEASES idle ones. Here the
+coordinator owns the slot inventory (scheduler.SlotPool); this seam is
+how unmet demand reaches whatever actually provisions machines:
+
+- ``request_capacity(demands)`` fires whenever a job parks in
+  WAITING_FOR_RESOURCES, with one entry per waiting job
+  ({job_id, required_devices}). Implementations scale the runner
+  fleet out; the coordinator deploys automatically when the new
+  runner registers (the existing capacity-kick path).
+- Scale-IN goes through ``JobCoordinator.rpc_drain_runner``: jobs on
+  the drained runner stop-with-savepoint and redeploy elsewhere with
+  their state; once the runner holds nothing, the provisioner may
+  remove the machine.
+
+The default is the recording no-op (standalone mode — capacity is
+whatever registers, ref StandaloneResourceManager); the kubectl stub
+shows the k8s wiring without assuming a cluster exists in CI.
+"""
+from __future__ import annotations
+
+import subprocess
+from typing import Any, Dict, List
+
+
+class Provisioner:
+    def request_capacity(self, demands: List[Dict[str, Any]]) -> None:
+        raise NotImplementedError
+
+
+class StandaloneProvisioner(Provisioner):
+    """No active provisioning (ref: StandaloneResourceManager): demand
+    is recorded for observability; capacity arrives when someone starts
+    a runner."""
+
+    def __init__(self) -> None:
+        self.requests: List[List[Dict[str, Any]]] = []
+
+    def request_capacity(self, demands: List[Dict[str, Any]]) -> None:
+        self.requests.append(list(demands))
+
+
+class KubectlScaleProvisioner(Provisioner):
+    """Scale-out stub for the kubernetes deployment
+    (deploy/kubernetes.yaml runs runners as a scalable workload):
+    translates unmet demand into a ``kubectl scale`` call. ``dry_run``
+    (default) only records the command — CI has no cluster; the
+    deployment docs show the live wiring."""
+
+    def __init__(self, workload: str = "deployment/flink-tpu-runner",
+                 namespace: str = "default", max_replicas: int = 32,
+                 dry_run: bool = True) -> None:
+        self.workload = workload
+        self.namespace = namespace
+        self.max_replicas = max_replicas
+        self.dry_run = dry_run
+        self.commands: List[List[str]] = []
+        self._target = 0
+
+    def request_capacity(self, demands: List[Dict[str, Any]]) -> None:
+        want = sum(max(1, int(d.get("required_devices", 1)))
+                   for d in demands)
+        target = min(self.max_replicas, max(self._target, want))
+        if target <= self._target:
+            return
+        self._target = target
+        cmd = ["kubectl", "-n", self.namespace, "scale", self.workload,
+               f"--replicas={target}"]
+        self.commands.append(cmd)
+        if not self.dry_run:
+            subprocess.run(cmd, check=False, capture_output=True)

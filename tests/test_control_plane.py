@@ -408,3 +408,96 @@ class TestSchedulerExecutionGraph:
             c.close()
         finally:
             srv.close(); gw.close()
+
+
+class TestActiveProvisioning:
+    """Provisioner seam + scale-in drain (ref: ActiveResourceManager,
+    SURVEY §3.5)."""
+
+    class _GW(RpcEndpoint):
+        def __init__(self):
+            self.deployed = []
+            self.savepoints = []
+
+        def rpc_run_job(self, job_id, entry, config=None, attempt=1):
+            self.deployed.append((job_id, attempt))
+            return {"accepted": True}
+
+        def rpc_cancel_job(self, job_id, attempt=None):
+            return {"ok": True}
+
+        def rpc_trigger_savepoint(self, job_id, stop=False, token=None):
+            self.savepoints.append((job_id, stop, token))
+            return {"ok": True}
+
+    def _register(self, c, port, rid, n):
+        c.call("register_runner", runner_id=rid, host="127.0.0.1",
+               n_devices=n, port=port)
+
+    def test_unmet_demand_reaches_provisioner(self):
+        from flink_tpu.runtime.provisioner import KubectlScaleProvisioner
+
+        srv = start_coordinator(Configuration({}))
+        prov = KubectlScaleProvisioner(dry_run=True)
+        srv.endpoint.provisioner = prov
+        try:
+            c = RpcClient("127.0.0.1", srv.port)
+            c.call("submit_job", job_id="jw", entry="x:y",
+                   config={"cluster.mesh-devices": "4"})
+            deadline = time.time() + 5
+            while time.time() < deadline and not prov.commands:
+                time.sleep(0.02)
+            assert prov.commands, "provisioner never saw the demand"
+            assert prov.commands[0][:2] == ["kubectl", "-n"]
+            assert any("--replicas=" in a for a in prov.commands[0])
+            c.close()
+        finally:
+            srv.close()
+
+    def test_drain_moves_job_via_stop_with_savepoint(self):
+        """Drain r1: its job stop-with-savepoints; on savepoint
+        completion it redeploys on r2 (never back on the draining
+        runner) restoring from the savepoint."""
+        srv = start_coordinator(Configuration({}))
+        gw1, gw2 = RpcServer(self._GW()), RpcServer(self._GW())
+        try:
+            c = RpcClient("127.0.0.1", srv.port)
+            self._register(c, gw1.port, "r1", 4)
+            c.call("submit_job", job_id="jd", entry="x:y",
+                   config={"cluster.mesh-devices": "2"})
+            deadline = time.time() + 5
+            while time.time() < deadline and not gw1.endpoint.deployed:
+                time.sleep(0.02)
+            assert gw1.endpoint.deployed == [("jd", 1)]
+            # second runner appears; drain the first
+            self._register(c, gw2.port, "r2", 4)
+            resp = c.call("drain_runner", runner_id="r1")
+            assert resp["ok"] and resp["moving_jobs"] == ["jd"]
+            deadline = time.time() + 5
+            while time.time() < deadline and not gw1.endpoint.savepoints:
+                time.sleep(0.02)
+            jid, stop, token = gw1.endpoint.savepoints[0]
+            assert (jid, stop) == ("jd", True) and token.startswith("drain-")
+            # the runner reports the savepoint durable -> redeploy on r2
+            c.call("savepoint_complete", job_id="jd",
+                   path="/tmp/sp-jd", token=token)
+            deadline = time.time() + 5
+            while time.time() < deadline and not gw2.endpoint.deployed:
+                time.sleep(0.02)
+            assert gw2.endpoint.deployed == [("jd", 2)]
+            assert not gw1.endpoint.deployed[1:], \
+                "job must not redeploy on the draining runner"
+            st = c.call("job_status", job_id="jd")
+            assert st["state"] in ("RESTARTING", "RUNNING")
+            # a drained runner receives no NEW jobs either
+            c.call("submit_job", job_id="jn", entry="x:y",
+                   config={"cluster.mesh-devices": "2"})
+            deadline = time.time() + 5
+            while time.time() < deadline and \
+                    ("jn", 1) not in gw2.endpoint.deployed:
+                time.sleep(0.02)
+            assert ("jn", 1) in gw2.endpoint.deployed
+            assert all(j != "jn" for j, _ in gw1.endpoint.deployed)
+            c.close()
+        finally:
+            srv.close(); gw1.close(); gw2.close()
