@@ -122,8 +122,15 @@ class StreamExecutionEnvironment:
             devices = devices[:n]
         if len(devices) == 1:
             return None  # a 1-device mesh is just local execution
+        num_shards = self.config.get(StateOptions.NUM_KEY_SHARDS)
+        nproc = int(self.config.get(ClusterOptions.NUM_PROCESSES))
+        if nproc > 1:
+            # cross-host: this process's LOCAL mesh covers only its
+            # shard span (records arrive pre-routed through the DCN
+            # exchange; the key directory keeps the global shard space)
+            num_shards = num_shards // nproc
         return make_mesh_plan(
-            self.config.get(StateOptions.NUM_KEY_SHARDS),
+            num_shards,
             self.config.get(StateOptions.SLOTS_PER_SHARD),
             devices)
 

@@ -305,6 +305,25 @@ class ClusterOptions:
         "its 'subtask'); keyed exchanges ride XLA all_to_all over the "
         "mesh axis (ref: parallelism.default + slot assignment, "
         "KeyGroupRangeAssignment).")
+    NUM_PROCESSES = ConfigOption(
+        "cluster.num-processes", 1,
+        "Host-process count of ONE job (the cross-host data plane, ref "
+        "SURVEY §3.6): each process owns num-key-shards/N contiguous "
+        "key shards; keyed records route to their owner through the "
+        "per-step DCN all-to-all (exchange/dcn.py), whose rendezvous "
+        "also carries the global watermark, termination, and "
+        "checkpoint-alignment consensus.")
+    PROCESS_ID = ConfigOption(
+        "cluster.process-id", 0,
+        "This process's index in [0, cluster.num-processes).")
+    DCN_PEERS = ConfigOption(
+        "cluster.dcn-peers", "",
+        "Comma-separated host:port of every process's exchange "
+        "listener, indexed by process id (the coordinator fills this "
+        "at deploy via the dcn rendezvous; tests set it directly).")
+    DCN_PORT = ConfigOption(
+        "cluster.dcn-port", 0,
+        "This process's exchange listen port (0 = ephemeral).")
     EXCHANGE_IMPL = ConfigOption(
         "exchange.impl", "all-to-all",
         "Keyed-exchange collective pattern (the Shuffle SPI seam, ref: "

@@ -1,0 +1,145 @@
+"""Cross-host data plane: the synchronous per-step all-to-all exchange.
+
+ref: the reference's data network stack (runtime/io/network/* — Netty
+streams between TaskManagers, credit-based flow control, ~50k LoC,
+SURVEY §3.6). TPU-first redesign: the exchange is a per-microbatch
+RENDEZVOUS, not a stream. Each process owns a contiguous key-shard
+range; every step, each process routes its ingested records to their
+owners and the N-way exchange synchronizes the step across the fleet.
+That barrier replaces three of the reference's hardest subsystems at
+once:
+
+- flow control: a slow process backpressures everyone at the next
+  rendezvous (credit windows collapse into step cadence, SURVEY §3.6's
+  TPU mapping);
+- watermark propagation: each frame piggybacks the sender's source
+  watermark; every process computes the identical global min — no
+  in-band watermark records;
+- checkpoint alignment: a snapshot at a step boundary has NO in-flight
+  records anywhere (the exchange is drained by construction), so the
+  Chandy-Lamport barrier machinery is unnecessary — process-local
+  snapshots taken at the same step compose into a consistent global
+  one.
+
+Framing: 8-byte big-endian length + a checkpoint/blobformat payload
+(self-describing arrays — the same codec checkpoints use). Sockets are
+one per direction per pair (process i accepts from every j, and dials
+every j), identified by a one-byte hello carrying the sender id.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from flink_tpu.checkpoint import blobformat
+
+
+class DcnExchange:
+    """N-process synchronous all-to-all (one instance per process per
+    job). ``port`` is ready after construction; ``connect`` blocks
+    until the full mesh is up."""
+
+    def __init__(self, process_id: int, n_processes: int,
+                 listen_port: int = 0) -> None:
+        self.pid = process_id
+        self.n = n_processes
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", listen_port))
+        self._srv.listen(n_processes)
+        self.port = self._srv.getsockname()[1]
+        self._in: Dict[int, socket.socket] = {}
+        self._out: Dict[int, socket.socket] = {}
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while len(self._in) < self.n - 1:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sender = conn.recv(1)[0]
+            self._in[sender] = conn
+
+    def connect(self, peers: List[str], timeout_s: float = 30.0) -> None:
+        """``peers[j]`` = "host:port" of process j's listener (the entry
+        for self is ignored). Dials every peer and waits until every
+        inbound connection arrived."""
+        deadline = time.time() + timeout_s
+        for j, addr in enumerate(peers):
+            if j == self.pid:
+                continue
+            host, _, port = addr.partition(":")
+            while True:
+                try:
+                    s = socket.create_connection((host, int(port)),
+                                                 timeout=2.0)
+                    break
+                except OSError:
+                    if time.time() > deadline:
+                        raise TimeoutError(
+                            f"p{self.pid}: cannot reach peer {j} at {addr}")
+                    time.sleep(0.05)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.sendall(bytes([self.pid]))
+            self._out[j] = s
+        while len(self._in) < self.n - 1:
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"p{self.pid}: only {len(self._in)} of "
+                    f"{self.n - 1} inbound peers connected")
+            time.sleep(0.02)
+
+    def exchange(self, shares: Dict[int, Any],
+                 meta: Dict[str, Any]) -> Tuple[List[Any], List[Dict]]:
+        """One rendezvous: send ``shares[j]`` + ``meta`` to each peer j,
+        receive each peer's share-for-me + meta. Returns
+        (payloads_by_process, metas_by_process); the self entries are
+        ``shares.get(pid)`` and ``meta``. Blocks until every peer's
+        frame arrives — the step barrier."""
+        for j, s in self._out.items():
+            raw = blobformat.encode(
+                {"data": shares.get(j), "meta": meta})
+            s.sendall(struct.pack(">Q", len(raw)) + raw)
+        payloads: List[Any] = [None] * self.n
+        metas: List[Dict] = [dict() for _ in range(self.n)]
+        payloads[self.pid] = shares.get(self.pid)
+        metas[self.pid] = meta
+        for j, s in self._in.items():
+            frame = blobformat.decode(_read_frame(s))
+            payloads[j] = frame["data"]
+            metas[j] = frame["meta"]
+        return payloads, metas
+
+    def close(self) -> None:
+        for s in list(self._out.values()) + list(self._in.values()):
+            try:
+                s.close()
+            except OSError:
+                pass
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+def _read_frame(s: socket.socket) -> bytes:
+    hdr = _read_exact(s, 8)
+    n = struct.unpack(">Q", hdr)[0]
+    return _read_exact(s, n)
+
+
+def _read_exact(s: socket.socket, n: int) -> bytes:
+    out = bytearray()
+    while len(out) < n:
+        chunk = s.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        out += chunk
+    return bytes(out)

@@ -39,6 +39,9 @@ class OperatorBuildContext:
     backend: str
     exchange_impl: str
     max_out_of_orderness_ms: int
+    # cross-host jobs: this process's contiguous key-shard span (the
+    # key-group range of its "subtask"); None = whole shard space
+    shard_range: Optional[Any] = None
 
 
 OperatorFactory = Callable[[Any, OperatorBuildContext], Any]
@@ -72,6 +75,7 @@ def _window_factory(node, ctx: OperatorBuildContext):
         allowed_lateness_ms=t.allowed_lateness_ms,
         max_out_of_orderness_ms=max(ctx.max_out_of_orderness_ms, 0),
         mesh_plan=ctx.mesh_plan,
+        shard_range=ctx.shard_range,
         top_n=t.top_n,
         exchange_capacity=ctx.exchange_capacity,
         spill=(ctx.backend == "spill"),

@@ -968,9 +968,20 @@ class WindowOperator:
             max_out_of_orderness_ms=max_out_of_orderness_ms,
         )
         if mesh_plan is not None:
-            num_shards = mesh_plan.num_shards
             slots_per_shard = mesh_plan.slots_per_shard
-            shard_range = None  # directory is global; devices own row blocks
+            if shard_range is None:
+                # single-host mesh: the directory covers every shard;
+                # devices own contiguous row blocks of it
+                num_shards = mesh_plan.num_shards
+            elif shard_range[1] - shard_range[0] != mesh_plan.num_shards:
+                # cross-host: the LOCAL mesh spans exactly this
+                # process's shard range; the directory keeps the GLOBAL
+                # shard space so misrouted keys are detected (-1), and
+                # its LOCAL slot ids line up with the mesh row blocks
+                raise ValueError(
+                    f"local mesh covers {mesh_plan.num_shards} shards "
+                    f"but this process's range {shard_range} spans "
+                    f"{shard_range[1] - shard_range[0]}")
         self.directory = KeyDirectory(num_shards, slots_per_shard, shard_range)
         per_block_slots = (
             mesh_plan.slots_per_device if mesh_plan else self.directory.local_slots)

@@ -193,6 +193,14 @@ class Driver:
             lookup_operator_factory,
         )
 
+        # cross-host jobs: each process owns a contiguous shard span
+        # (records arrive pre-routed through the DCN exchange)
+        shard_range = None
+        nproc = int(self.config.get(ClusterOptions.NUM_PROCESSES))
+        if nproc > 1:
+            pid = int(self.config.get(ClusterOptions.PROCESS_ID))
+            spp = num_shards // nproc
+            shard_range = (pid * spp, (pid + 1) * spp)
         ctx = OperatorBuildContext(
             config=self.config, mesh_plan=self.mesh_plan,
             num_shards=num_shards, slots_per_shard=slots,
@@ -200,6 +208,7 @@ class Driver:
             backend=backend,
             exchange_impl=self.config.get(ClusterOptions.EXCHANGE_IMPL),
             max_out_of_orderness_ms=wm.max_out_of_orderness_ms,
+            shard_range=shard_range,
         )
         allow_drops = bool(self.config.get(StateOptions.ALLOW_DROPS))
         for n in self.plan.nodes.values():
@@ -297,6 +306,13 @@ class Driver:
         restore = self.config.get(CheckpointingOptions.RESTORE)
         if interval <= 0 and not restore:
             return None
+        nproc = int(self.config.get(ClusterOptions.NUM_PROCESSES))
+        if nproc > 1:
+            # cross-host jobs: each process snapshots ITS shard span
+            # under its own directory; the ids align because the
+            # checkpoint decision rides the step rendezvous
+            pid = int(self.config.get(ClusterOptions.PROCESS_ID))
+            job_name = f"{job_name}-p{pid}"
         storage = FsCheckpointStorage(
             self.config.get(CheckpointingOptions.DIRECTORY),
             job_id=job_name.replace("/", "_"),
@@ -448,6 +464,189 @@ class Driver:
         pend.is_savepoint = savepoint
         return pend
 
+    # -- cross-host data plane (SURVEY §3.6: the DCN exchange) -----------
+
+    def _dcn_connect(self):
+        """Build + connect this process's exchange endpoint and validate
+        the v1 topology constraints (one source, one keyed window
+        stage, shards divisible by the process count)."""
+        from flink_tpu.exchange.dcn import DcnExchange
+
+        cfg = self.config
+        n = int(cfg.get(ClusterOptions.NUM_PROCESSES))
+        pid = int(cfg.get(ClusterOptions.PROCESS_ID))
+        peers = [p.strip() for p in
+                 str(cfg.get(ClusterOptions.DCN_PEERS)).split(",")
+                 if p.strip()]
+        if len(peers) != n:
+            raise ValueError(
+                f"cluster.dcn-peers must list {n} host:port entries, "
+                f"got {len(peers)}")
+        if len(self.plan.sources) != 1:
+            raise NotImplementedError(
+                "cross-process jobs support exactly one source in v1")
+        keyed = [nd for nd in self.plan.nodes.values()
+                 if nd.kind == "window"]
+        if len(keyed) != 1:
+            raise NotImplementedError(
+                "cross-process jobs support exactly one keyed window "
+                "stage in v1")
+        num_shards = int(cfg.get(StateOptions.NUM_KEY_SHARDS))
+        if num_shards % n:
+            raise ValueError(
+                f"state.num-key-shards ({num_shards}) must divide by "
+                f"cluster.num-processes ({n}) — shards are the rescale "
+                "unit (the key-group contract)")
+        lat = keyed[0].window_transform.allowed_lateness_ms
+        if lat:
+            raise NotImplementedError(
+                "allowed lateness across processes needs a refire "
+                "consensus the v1 exchange does not carry")
+        ex = DcnExchange(pid, n,
+                         listen_port=int(cfg.get(ClusterOptions.DCN_PORT)))
+        ex.connect(peers)
+        self._dcn_key_field = keyed[0].key_field
+        self._dcn_shards = num_shards
+        return ex
+
+    def _dcn_negotiated_restore(self):
+        """Agree on ONE checkpoint id across processes (the min of
+        everyone's latest) and load it; None when any process has no
+        checkpoint — everyone then replays from scratch together."""
+        latest = self._coordinator.storage.latest()
+        my_id = latest.checkpoint_id if latest is not None else -1
+        _, metas = self._dcn.exchange({}, {"latest": int(my_id)})
+        common = min(int(m["latest"]) for m in metas)
+        if common < 0:
+            return None
+        from flink_tpu.checkpoint.storage import FsCheckpointStorage
+
+        for h in self._coordinator.storage.list_complete():
+            if h.checkpoint_id == common and not h.is_savepoint:
+                payload = FsCheckpointStorage.load(h)
+                self._coordinator.resume_numbering(payload)
+                return payload
+        raise RuntimeError(
+            f"negotiated checkpoint id {common} is missing locally — "
+            "retention removed it; raise state.checkpoints.num-retained")
+
+    def _ingest_loop_dcn(self, srcs, interval_ms: int) -> None:
+        """The cross-host step loop: ingest a local batch, route records
+        to their shard owners, RENDEZVOUS (the step barrier carrying
+        watermark / termination / checkpoint consensus), then run the
+        local pipeline on this process's share. See exchange/dcn.py for
+        why the rendezvous replaces flow control, in-band watermarks,
+        and barrier alignment."""
+        from flink_tpu.records import hash_keys_numpy
+
+        cfg = self.config
+        n = int(cfg.get(ClusterOptions.NUM_PROCESSES))
+        pid = int(cfg.get(ClusterOptions.PROCESS_ID))
+        spp = self._dcn_shards // n
+        key_field = self._dcn_key_field
+        (sid,) = list(self.plan.sources)
+        d = srcs[sid]
+        order = sorted(d)
+        last_chk = time.time()
+        ex = self._dcn
+        pending = None          # persisted-but-uncommitted checkpoint
+        pending_id = -1
+        persisted_id = -1       # newest id THIS process holds durably
+        while True:
+            batch = None
+            batch_ix = None
+            while order:
+                ix = order[0]
+                nxt = next(d[ix], None)
+                if nxt is None:
+                    order.pop(0)
+                    continue
+                batch = nxt
+                batch_ix = ix
+                self._positions[sid][ix] += 1
+                break
+            shares: Dict[int, Any] = {}
+            if batch is not None:
+                data, ts = batch
+                ts = np.asarray(ts, np.int64)
+                if len(ts):
+                    mx = int(ts.max())
+                    self._max_ts[sid] = max(self._max_ts[sid], mx)
+                    self._wm_gens[sid][batch_ix].on_batch(mx)
+                keys = np.asarray(data[key_field], np.int64)
+                dest = (hash_keys_numpy(keys) % self._dcn_shards) // spp
+                for j in range(n):
+                    m = dest == j
+                    if m.any():
+                        shares[j] = {
+                            "data": {k: np.asarray(v)[m]
+                                     for k, v in data.items()},
+                            "ts": ts[m]}
+            local_wm = (min(self._wm_gens[sid][i].current() for i in order)
+                        if order else _FINAL)
+            want_ckpt = (pid == 0 and self._coordinator is not None
+                         and interval_ms > 0
+                         and (time.time() - last_chk) * 1000 >= interval_ms)
+            meta = {"wm": int(local_wm), "done": batch is None,
+                    "ckpt": bool(want_ckpt),
+                    # 2PC phase-2 ack: the id this process has DURABLY
+                    # persisted (commit waits until everyone has it —
+                    # the reference's all-acks-then-notifyComplete rule,
+                    # 4.C, carried on the rendezvous instead of RPC)
+                    "persisted": int(persisted_id)}
+            payloads, metas = ex.exchange(shares, meta)
+            parts = [p for p in payloads if p is not None
+                     and len(p["ts"])]
+            if parts:
+                md = {k: np.concatenate([p["data"][k] for p in parts])
+                      for k in parts[0]["data"]}
+                mts = np.concatenate([p["ts"] for p in parts])
+                valid = np.ones(len(mts), bool)
+                with self._push_lock:
+                    self.metrics["records_in"] += len(mts)
+                    self.metrics["batches"] += 1
+                    self._push_downstream(sid, (md, mts, valid))
+                for op in self._ops.values():
+                    if hasattr(op, "throttle"):
+                        op.throttle()
+                self._eps_meter.mark(len(mts))
+            # identical global watermark on every process: min of the
+            # piggybacked locals (exhausted processes report _FINAL so
+            # they stop pinning the clock)
+            gwm = min(int(m["wm"]) for m in metas)
+            if gwm != _FINAL and gwm > self._out_wm[sid]:
+                self._out_wm[sid] = gwm
+            with self._push_lock:
+                self._propagate_watermarks()
+            self._check_drain_error()
+            # commit the PREVIOUS checkpoint once every process acked
+            # durability (phase 2): only then may 2PC sinks publish
+            if (pending is not None
+                    and all(int(m.get("persisted", -1)) >= pending_id
+                            for m in metas)):
+                pending.complete()
+                self._ckpt_pending = None
+                pending = None
+            # checkpoint consensus: process 0's clock decides, the flag
+            # rides the rendezvous, so EVERY process snapshots at this
+            # same step boundary — a globally consistent cut with no
+            # in-flight records (SURVEY §6.4's step-barrier insight)
+            if any(bool(m.get("ckpt")) for m in metas):
+                if self._coordinator is not None and pending is None:
+                    pending = self._begin_checkpoint()
+                    self._ckpt_pending = pending
+                    pending.future.result()  # durable before acking
+                    pending_id = pending.checkpoint_id
+                    persisted_id = pending_id
+                last_chk = time.time()
+            if all(bool(m["done"]) for m in metas):
+                if pending is not None:
+                    # end of input doubles as the final barrier: every
+                    # process reached it, so the last cut is global
+                    pending.complete()
+                    self._ckpt_pending = None
+                return
+
     def _enumerate_owned(self, sid: int, n_splits: int) -> List[int]:
         """Which split indices THIS runner reads (ref: FLIP-27
         SplitEnumerator on the JM assigning splits to readers — SURVEY
@@ -458,6 +657,13 @@ class Driver:
         from flink_tpu.config import SourceOptions
 
         mode = self.config.get(SourceOptions.ENUMERATION)
+        nproc = int(self.config.get(ClusterOptions.NUM_PROCESSES))
+        if mode == "local" and nproc > 1:
+            # cross-host job without a coordinator-side enumerator:
+            # deterministic strided shares (the same disjointness rule
+            # rpc_enumerate_splits uses)
+            pid = int(self.config.get(ClusterOptions.PROCESS_ID))
+            return list(range(pid, n_splits, nproc))
         if mode == "local" or n_splits == 0:
             return list(range(n_splits))
         if mode != "coordinator":
@@ -704,10 +910,21 @@ class Driver:
             self._max_ts[sid] = LONG_MIN
             self._positions[sid] = {i: 0 for i in range(len(n.source.splits()))}
 
+        # cross-host data plane: bring the DCN exchange up BEFORE
+        # restore — the restore id is negotiated across processes (a
+        # crash can leave one process a checkpoint ahead; replaying
+        # from mismatched ids would double-count the laggard's records
+        # in the leader's shard ranges)
+        self._dcn = None
+        if int(self.config.get(ClusterOptions.NUM_PROCESSES)) > 1:
+            self._dcn = self._dcn_connect()
+
         if restore:
             from flink_tpu.checkpoint.storage import FsCheckpointStorage
 
-            if restore == "latest":
+            if self._dcn is not None and restore == "latest":
+                payload = self._dcn_negotiated_restore()
+            elif restore == "latest":
                 payload = self._coordinator.restore_latest()
             else:
                 payload = FsCheckpointStorage.load(restore)
@@ -749,7 +966,15 @@ class Driver:
 
         last_chk = time.time()
         prof = self.prof
-        active = {sid: sorted(its) for sid, its in srcs.items()}
+        if self._dcn is not None:
+            try:
+                self._ingest_loop_dcn(srcs, interval_ms)
+            finally:
+                self._dcn.close()
+                self._dcn = None
+            active = {}
+        else:
+            active = {sid: sorted(its) for sid, its in srcs.items()}
         while any(active.values()):
             for sid, splits_alive in list(active.items()):
                 if not splits_alive:

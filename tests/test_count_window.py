@@ -126,7 +126,13 @@ class TestCountWindowE2E:
 
 
 class TestTriggerValidation:
-    def test_count_trigger_on_time_window_raises(self):
+    def test_count_trigger_on_time_window_routes_to_element_path(self):
+        """Previously raised; now runs with exact per-element semantics
+        on the element-buffer operator (see tests/test_evicting_window
+        for the behavioral coverage)."""
+        from flink_tpu.graph.transformations import (
+            EvictingWindowTransformation)
+
         env = make_env()
         s = (env.from_source(
             GeneratorSource(single_record_source([1], [1])),
@@ -134,8 +140,8 @@ class TestTriggerValidation:
             .key_by("k")
             .window(TumblingEventTimeWindows.of(1_000))
             .trigger(CountTrigger.of(5)))
-        with pytest.raises(NotImplementedError, match="count_window"):
-            s.count()
+        out = s.count()
+        assert isinstance(out.transform, EvictingWindowTransformation)
 
     def test_purging_event_time_ok_without_lateness(self):
         env = make_env()
@@ -151,7 +157,14 @@ class TestTriggerValidation:
         env.execute("purging-ok")
         assert sum(int(r["count"]) for r in sink.rows) == 2
 
-    def test_purging_event_time_with_lateness_raises(self):
+    def test_purging_event_time_with_lateness_routes_to_element_path(self):
+        """Previously refused (the pane backend cannot express
+        fresh-state re-fires); the element-buffer operator CAN — a late
+        record after a purge re-fires with only the fresh contents,
+        which is exactly the reference's PurgingTrigger semantics."""
+        from flink_tpu.graph.transformations import (
+            EvictingWindowTransformation)
+
         env = make_env()
         s = (env.from_source(
             GeneratorSource(single_record_source([1], [1])),
@@ -160,8 +173,8 @@ class TestTriggerValidation:
             .window(TumblingEventTimeWindows.of(1_000))
             .allowed_lateness(5_000)
             .trigger(PurgingTrigger.of(EventTimeTrigger.create())))
-        with pytest.raises(NotImplementedError, match="lateness"):
-            s.count()
+        out = s.count()
+        assert isinstance(out.transform, EvictingWindowTransformation)
 
 
 class TestCountWindowOperator:

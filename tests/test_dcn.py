@@ -1,0 +1,269 @@
+"""Cross-host data plane (tier-5): one job spanning MULTIPLE runner
+processes through the per-step DCN all-to-all (exchange/dcn.py), with
+checkpoint/restore. ref: SURVEY §3.6 data network stack (the
+TaskManager-to-TaskManager plane) + §5.4 MiniCluster ITCases."""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu.checkpoint import blobformat
+from flink_tpu.exchange.dcn import DcnExchange
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestExchange:
+    def test_three_process_rendezvous(self):
+        """In-process smoke of the N-way exchange: 3 endpoints in
+        threads, each routes a share to each peer and all metas
+        propagate."""
+        import threading
+
+        n = 3
+        exs = [DcnExchange(i, n) for i in range(n)]
+        peers = [f"127.0.0.1:{e.port}" for e in exs]
+        results = [None] * n
+
+        def run(i):
+            exs[i].connect(peers)
+            shares = {j: {"data": {"v": np.array([i * 10 + j])},
+                          "ts": np.array([j])} for j in range(n)}
+            payloads, metas = exs[i].exchange(shares, {"wm": 100 + i})
+            results[i] = (payloads, metas)
+
+        ths = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=30)
+        for i in range(n):
+            payloads, metas = results[i]
+            # process i received j*10+i from every j
+            got = sorted(int(p["data"]["v"][0]) for p in payloads)
+            assert got == sorted(j * 10 + i for j in range(n))
+            assert sorted(m["wm"] for m in metas) == [100, 101, 102]
+        for e in exs:
+            e.close()
+
+
+WORKER = r"""
+import os, sys, json
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import numpy as np
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.api.windowing import SlidingEventTimeWindows
+from flink_tpu.config import Configuration
+from flink_tpu.connectors import FileSink
+from flink_tpu.formats import CsvFormat
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+pid = int(sys.argv[1]); n = int(sys.argv[2])
+peers = sys.argv[3]; my_port = int(sys.argv[4])
+out_path = sys.argv[5]
+crash_at = int(sys.argv[6]) if len(sys.argv) > 6 else -1
+restore = len(sys.argv) > 7 and sys.argv[7] == "restore"
+
+N_BATCHES = 24
+B = 512
+
+def gen(split, i):
+    if i >= N_BATCHES:
+        return None
+    rng = np.random.default_rng(1000 * int(split) + i)
+    base = i * 1000
+    keys = rng.integers(0, 64, B).astype(np.int64)
+    ts = base + rng.integers(0, 1000, B).astype(np.int64)
+    return ({{"auction": keys}}, ts)
+
+# durable exactly-once sink: committed part files survive the crash
+# (the in-memory sink pattern only works for in-process attempts)
+sink = FileSink(out_path + f"/sink-p{{pid}}",
+                CsvFormat([("key", "i64"), ("window_end", "i64"),
+                           ("count", "i64")]))
+
+conf = {{
+    "state.num-key-shards": 8, "state.slots-per-shard": 32,
+    "pipeline.microbatch-size": B,
+    "cluster.num-processes": n, "cluster.process-id": pid,
+    "cluster.dcn-peers": peers, "cluster.dcn-port": my_port,
+    "execution.checkpointing.interval": 1,
+    "execution.checkpointing.dir": out_path + "/ckpt",
+}}
+mesh = os.environ.get("FLINK_TPU_MESH_DEVICES", "")
+if mesh:
+    conf["cluster.mesh-devices"] = mesh
+if restore:
+    conf["execution.checkpointing.restore"] = "latest"
+if crash_at >= 0:
+    # crash injection: die after N source batches via a poisoned source
+    real_gen = gen
+    def gen(split, i, _g=real_gen):
+        if i == crash_at:
+            os._exit(43)
+        return _g(split, i)
+
+env = StreamExecutionEnvironment(Configuration(conf))
+src = GeneratorSource(gen, n_splits=2)
+(env.from_source(src,
+                 WatermarkStrategy.for_bounded_out_of_orderness(1000))
+ .key_by("auction")
+ .window(SlidingEventTimeWindows.of(4000, 2000))
+ .count()
+ .add_sink(sink))
+env.execute("dcnq5")
+print("WORKER_DONE", flush=True)
+"""
+
+
+def _spawn(tmp, pid, n, peers, port, crash_at=-1, restore=False,
+           mesh_devices=0):
+    script = tmp / f"worker-{pid}.py"
+    script.write_text(WORKER.format(repo=REPO))
+    args = [sys.executable, str(script), str(pid), str(n), peers,
+            str(port), str(tmp), str(crash_at)]
+    if restore:
+        args.append("restore")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    if mesh_devices:
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count="
+                            f"{mesh_devices}").strip()
+        env["FLINK_TPU_MESH_DEVICES"] = str(mesh_devices)
+    return subprocess.Popen(args, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, env=env)
+
+
+def _golden(tmp):
+    """Single-process run of the same job → expected rows."""
+    import jax
+
+    from flink_tpu.api.environment import StreamExecutionEnvironment
+    from flink_tpu.api.sinks import FnSink
+    from flink_tpu.api.sources import GeneratorSource
+    from flink_tpu.api.windowing import SlidingEventTimeWindows
+    from flink_tpu.config import Configuration
+    from flink_tpu.time.watermarks import WatermarkStrategy
+
+    N_BATCHES, B = 24, 512
+
+    def gen(split, i):
+        if i >= N_BATCHES:
+            return None
+        rng = np.random.default_rng(1000 * int(split) + i)
+        base = i * 1000
+        keys = rng.integers(0, 64, B).astype(np.int64)
+        ts = base + rng.integers(0, 1000, B).astype(np.int64)
+        return ({"auction": keys}, ts)
+
+    rows = []
+
+    def sink(b):
+        if b:
+            for k, w, c in zip(np.asarray(b["key"]),
+                               np.asarray(b["window_end"]),
+                               np.asarray(b["count"])):
+                rows.append((int(k), int(w), int(c)))
+
+    env = StreamExecutionEnvironment(Configuration({
+        "state.num-key-shards": 8, "state.slots-per-shard": 32,
+        "pipeline.microbatch-size": 512}))
+    (env.from_source(GeneratorSource(gen, n_splits=2),
+                     WatermarkStrategy.for_bounded_out_of_orderness(1000))
+     .key_by("auction")
+     .window(SlidingEventTimeWindows.of(4000, 2000))
+     .count()
+     .add_sink(FnSink(sink)))
+    env.execute("golden")
+    return sorted(rows)
+
+
+def _free_ports(n):
+    import socket
+
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _collect(tmp, n):
+    rows = []
+    for pid in range(n):
+        cd = tmp / f"sink-p{pid}" / "committed"
+        assert cd.exists(), f"process {pid} committed nothing"
+        for part in sorted(os.listdir(cd)):
+            for line in (cd / part).read_text().splitlines():
+                k, w, c = line.split(",")
+                rows.append((int(k), int(w), int(c)))
+    return sorted(rows)
+
+
+class TestTier5TwoProcessQ5:
+    def test_two_process_q5_matches_single_process(self, tmp_path):
+        """Q5-shaped job over 2 processes: the union of both processes'
+        emitted rows must equal the single-process run exactly (each
+        key fires on exactly one process — its shard owner)."""
+        golden = _golden(tmp_path / "g")
+        ports = _free_ports(2)
+        peers = ",".join(f"127.0.0.1:{p}" for p in ports)
+        ps = [_spawn(tmp_path, i, 2, peers, ports[i]) for i in range(2)]
+        outs = [p.communicate(timeout=300)[0].decode() for p in ps]
+        for i, p in enumerate(ps):
+            assert p.returncode == 0, f"p{i} failed:\n{outs[i][-3000:]}"
+        assert _collect(tmp_path, 2) == golden
+
+    def test_two_process_crash_restore_exactly_once(self, tmp_path):
+        """One process crashes mid-run; BOTH restart with
+        restore=latest (negotiated common checkpoint id) and the final
+        output union still equals the golden run exactly — the
+        step-rendezvous checkpoint cut is globally consistent."""
+        golden = _golden(tmp_path / "g")
+        ports = _free_ports(2)
+        peers = ",".join(f"127.0.0.1:{p}" for p in ports)
+        # attempt 1: p1 crashes after 10 source batches; p0 dies on the
+        # broken exchange
+        ps = [_spawn(tmp_path, 0, 2, peers, ports[0]),
+              _spawn(tmp_path, 1, 2, peers, ports[1], crash_at=10)]
+        for p in ps:
+            p.communicate(timeout=300)
+        assert ps[1].returncode == 43
+        assert ps[0].returncode != 0
+        # attempt 2: fresh ports, negotiated restore
+        ports2 = _free_ports(2)
+        peers2 = ",".join(f"127.0.0.1:{p}" for p in ports2)
+        ps = [_spawn(tmp_path, i, 2, peers2, ports2[i], restore=True)
+              for i in range(2)]
+        outs = [p.communicate(timeout=300)[0].decode() for p in ps]
+        for i, p in enumerate(ps):
+            assert p.returncode == 0, f"p{i} failed:\n{outs[i][-3000:]}"
+        assert _collect(tmp_path, 2) == golden
+
+
+    def test_two_process_local_mesh_q5(self, tmp_path):
+        """The full tier-5 shape: 2 runner processes x 4 virtual
+        devices each — records cross PROCESSES via the DCN exchange and
+        cross each process's local DEVICES via the in-step keyBy
+        all_to_all; output still equals the single-process run."""
+        golden = _golden(tmp_path / "g")
+        ports = _free_ports(2)
+        peers = ",".join(f"127.0.0.1:{p}" for p in ports)
+        ps = [_spawn(tmp_path, i, 2, peers, ports[i], mesh_devices=4)
+              for i in range(2)]
+        outs = [p.communicate(timeout=600)[0].decode() for p in ps]
+        for i, p in enumerate(ps):
+            assert p.returncode == 0, f"p{i} failed:\n{outs[i][-3000:]}"
+        assert _collect(tmp_path, 2) == golden
