@@ -94,6 +94,9 @@ class JobCoordinator(RpcEndpoint):
         from flink_tpu.runtime.provisioner import StandaloneProvisioner
 
         self.provisioner = StandaloneProvisioner()
+        # (job_id, attempt) -> {process_id: "host:port"} — the DCN
+        # exchange rendezvous for cross-host jobs
+        self._dcn_table: Dict[tuple, Dict[int, str]] = {}
         self._strategies: Dict[str, RestartStrategy] = {}
         # HA job store: non-terminal deployable jobs survive coordinator
         # loss — a new leader re-deploys them with restore:latest (ref:
@@ -265,9 +268,27 @@ class JobCoordinator(RpcEndpoint):
                 r.runner_id for r in self.runners.values() if r.draining]
             if j.drain_exclude:
                 full_exclude.append(j.drain_exclude)
-            target = self._slots.pick(
-                job_id, j.required_devices,
-                list(self.runners.values()), exclude=full_exclude)
+            nproc = max(1, int(j.config.get("cluster.num-processes", 1)))
+            if nproc > 1:
+                # cross-host job: one DISTINCT runner per process, each
+                # with the per-process device demand; all-or-nothing
+                targets = []
+                ex2 = list(full_exclude)
+                for _ in range(nproc):
+                    t = self._slots.pick(
+                        job_id + f"#p{len(targets)}", j.required_devices,
+                        list(self.runners.values()), exclude=ex2)
+                    if t is None:
+                        targets = None
+                        break
+                    targets.append(t)
+                    ex2.append(t.runner_id)
+                target = targets[0] if targets else None
+            else:
+                targets = None
+                target = self._slots.pick(
+                    job_id, j.required_devices,
+                    list(self.runners.values()), exclude=full_exclude)
             if target is None:
                 # park until capacity registers (ref: AdaptiveScheduler
                 # WaitingForResources); a lost-runner retry with no
@@ -291,14 +312,21 @@ class JobCoordinator(RpcEndpoint):
             resolved = (target.n_devices
                         if j.required_devices == SlotPool.ALL
                         else j.required_devices)
-            self._slots.allocate(job_id, target.runner_id, resolved)
+            if targets is not None:
+                self._slots.allocate_multi(
+                    job_id, [(t.runner_id, resolved) for t in targets])
+                self._dcn_table.pop((job_id, j.attempts), None)
+            else:
+                self._slots.allocate(job_id, target.runner_id, resolved)
             if j.egraph is not None and j.egraph.parallelism != resolved:
                 # 'all' resolves only now that a runner is chosen — the
                 # physical graph's subtask width follows the allocation
                 j.egraph.set_parallelism(resolved)
             j.state = "RUNNING"
             j.failure = None
-            j.assigned_runners = [target.runner_id]
+            j.assigned_runners = ([t.runner_id for t in targets]
+                                  if targets is not None
+                                  else [target.runner_id])
             j.finished_runners = []
             if j.egraph is not None:
                 j.egraph.start_attempt(j.attempts, target.runner_id)
@@ -314,15 +342,25 @@ class JobCoordinator(RpcEndpoint):
                 # recovery attempt resumes from the newest checkpoint
                 config["execution.checkpointing.restore"] = "latest"
         try:
-            c = RpcClient(target.host, target.port, timeout_s=5.0)
-            try:
-                extra = {"py_blobs": blobs} if blobs else {}
-                resp = c.call("run_job", job_id=job_id, entry=entry,
-                              config=config, attempt=attempt, **extra)
-            finally:
-                c.close()
-            if not resp.get("accepted"):
-                raise RpcError(f"runner rejected job: {resp}")
+            extra = {"py_blobs": blobs} if blobs else {}
+            push_targets = targets if targets is not None else [target]
+            for i, t in enumerate(push_targets):
+                pconf = dict(config)
+                if targets is not None:
+                    # per-process identity; the exchange ports
+                    # rendezvous through rpc_dcn_register/peers
+                    pconf["cluster.process-id"] = i
+                    pconf["cluster.dcn-rendezvous"] = "coordinator"
+                    pconf["cluster.attempt"] = attempt
+                    pconf.setdefault("source.enumeration", "local")
+                c = RpcClient(t.host, t.port, timeout_s=5.0)
+                try:
+                    resp = c.call("run_job", job_id=job_id, entry=entry,
+                                  config=pconf, attempt=attempt, **extra)
+                finally:
+                    c.close()
+                if not resp.get("accepted"):
+                    raise RpcError(f"runner rejected job: {resp}")
             with self._lock:
                 jj = self.jobs.get(job_id)
                 if jj is not None and jj.egraph is not None:
@@ -703,6 +741,26 @@ class JobCoordinator(RpcEndpoint):
                     jj.rescale_token = None
             return resp
         return {"ok": True, "dispatched": True, "devices": devices}
+
+    def rpc_dcn_register(self, job_id: str, attempt: int, process_id: int,
+                         host: str, port: int) -> dict:
+        """DCN exchange rendezvous (cross-host jobs): each process
+        reports its ephemeral listener; peers poll rpc_dcn_peers until
+        the table is complete. Keyed by attempt so a restarted job's
+        stale registrations can never mix into the new fleet."""
+        with self._lock:
+            tbl = self._dcn_table.setdefault((job_id, int(attempt)), {})
+            tbl[int(process_id)] = f"{host}:{int(port)}"
+        return {"ok": True}
+
+    def rpc_dcn_peers(self, job_id: str, attempt: int,
+                      n_processes: int) -> dict:
+        with self._lock:
+            tbl = dict(self._dcn_table.get((job_id, int(attempt)), {}))
+        if len(tbl) < int(n_processes):
+            return {"ready": False}
+        return {"ready": True,
+                "peers": [tbl[i] for i in range(int(n_processes))]}
 
     def rpc_drain_runner(self, runner_id: str) -> dict:
         """Scale-in drain (ref: ActiveResourceManager releasing a

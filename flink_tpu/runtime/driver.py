@@ -478,7 +478,9 @@ class Driver:
         peers = [p.strip() for p in
                  str(cfg.get(ClusterOptions.DCN_PEERS)).split(",")
                  if p.strip()]
-        if len(peers) != n:
+        rendezvous = (not peers and str(cfg.get_raw(
+            "cluster.dcn-rendezvous", "")).strip() == "coordinator")
+        if not rendezvous and len(peers) != n:
             raise ValueError(
                 f"cluster.dcn-peers must list {n} host:port entries, "
                 f"got {len(peers)}")
@@ -504,6 +506,36 @@ class Driver:
                 "consensus the v1 exchange does not carry")
         ex = DcnExchange(pid, n,
                          listen_port=int(cfg.get(ClusterOptions.DCN_PORT)))
+        if rendezvous:
+            # coordinator-deployed job: publish this process's listener
+            # and poll until the whole fleet registered (ref: the
+            # reference's TaskManagers learning partition locations
+            # from the JobMaster's deployment descriptors)
+            from flink_tpu.runtime.rpc import RpcClient
+
+            addr = str(cfg.get_raw("cluster.coordinator", "")).strip()
+            job_id = str(cfg.get_raw("cluster.job-id", "job")).strip()
+            attempt = int(cfg.get_raw("cluster.attempt", 1))
+            dcn_host = str(cfg.get_raw("cluster.dcn-host",
+                                       "127.0.0.1")).strip()
+            host, _, port = addr.partition(":")
+            c = RpcClient(host, int(port), timeout_s=5.0)
+            try:
+                c.call("dcn_register", job_id=job_id, attempt=attempt,
+                       process_id=pid, host=dcn_host, port=ex.port)
+                deadline = time.time() + 60.0
+                while True:
+                    resp = c.call("dcn_peers", job_id=job_id,
+                                  attempt=attempt, n_processes=n)
+                    if resp.get("ready"):
+                        peers = resp["peers"]
+                        break
+                    if time.time() > deadline:
+                        raise TimeoutError(
+                            "DCN rendezvous incomplete after 60s")
+                    time.sleep(0.1)
+            finally:
+                c.close()
         ex.connect(peers)
         self._dcn_key_field = keyed[0].key_field
         self._dcn_shards = num_shards

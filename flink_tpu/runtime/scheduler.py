@@ -134,22 +134,41 @@ class SlotPool:
     ALL = -1
 
     def __init__(self) -> None:
-        # job_id -> (runner_id, devices)
+        # job_id -> (runner_id, devices); a cross-host job additionally
+        # holds one entry per extra process in _multi
         self._allocations: Dict[str, tuple] = {}
+        self._multi: Dict[str, List[tuple]] = {}
 
     def free_devices(self, runner_id: str, total: int) -> int:
         used = sum(d for r, d in self._allocations.values()
                    if r == runner_id)
+        used += sum(d for allocs in self._multi.values()
+                    for r, d in allocs if r == runner_id)
         return total - used
 
     def allocate(self, job_id: str, runner_id: str, devices: int) -> None:
         self._allocations[job_id] = (runner_id, devices)
 
+    def allocate_multi(self, job_id: str,
+                       allocs: List[tuple]) -> None:
+        """Cross-host job: one (runner, devices) entry per process.
+        ``allocation`` reports the head entry for single-target
+        callers; ``allocations`` reports them all."""
+        self._allocations[job_id] = allocs[0]
+        self._multi[job_id] = list(allocs[1:])
+
     def release(self, job_id: str) -> None:
         self._allocations.pop(job_id, None)
+        self._multi.pop(job_id, None)
 
     def allocation(self, job_id: str) -> Optional[tuple]:
         return self._allocations.get(job_id)
+
+    def allocations(self, job_id: str) -> List[tuple]:
+        head = self._allocations.get(job_id)
+        if head is None:
+            return []
+        return [head] + self._multi.get(job_id, [])
 
     def pick(self, job_id: str, devices: int, runners: List,
              exclude: Optional[List[str]] = None):

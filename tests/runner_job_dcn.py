@@ -1,0 +1,53 @@
+"""Deployable CROSS-HOST job for the coordinator-deploy tier-5 test:
+one job spanning two runner processes through the DCN exchange. Same
+"job jar" contract as runner_job.py; each process commits its shard
+span's output under its own sink directory (epoch ids align across the
+fleet — the checkpoint decision rides the step rendezvous — so a
+shared directory would collide part names)."""
+import numpy as np
+
+from flink_tpu.api.sinks import FileTransactionalSink
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.api.windowing import TumblingEventTimeWindows
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+N_KEYS = 40
+BATCH = 128
+
+
+def batch_of(split: int, i: int):
+    rng = np.random.default_rng(77 + 1000 * split + i)
+    keys = rng.integers(0, N_KEYS, BATCH).astype(np.int64)
+    ts = np.sort(rng.integers(i * 500, i * 500 + 1000, BATCH)).astype(np.int64)
+    return keys, ts
+
+
+def golden_counts(n_batches: int):
+    expect = {}
+    for split in (0, 1):
+        for i in range(n_batches):
+            keys, ts = batch_of(split, i)
+            for k, t in zip(keys, ts):
+                kk = (int(k), (int(t) // 1000) * 1000)
+                expect[kk] = expect.get(kk, 0) + 1
+    return expect
+
+
+def build(env):
+    n_batches = int(env.config.get_raw("test.n-batches", 20))
+    sink_dir = env.config.get_raw("test.sink-dir")
+    assert sink_dir, "test.sink-dir must be set"
+    pid = int(env.config.get_raw("cluster.process-id", 0))
+
+    def gen(split, i):
+        if i >= n_batches:
+            return None
+        keys, ts = batch_of(int(split), i)
+        return {"k": keys}, ts
+
+    (env.from_source(GeneratorSource(gen, n_splits=2),
+                     WatermarkStrategy.for_bounded_out_of_orderness(1000))
+        .key_by("k")
+        .window(TumblingEventTimeWindows.of(1000))
+        .count()
+        .add_sink(FileTransactionalSink(f"{sink_dir}-p{pid}")))

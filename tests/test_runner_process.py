@@ -206,3 +206,55 @@ def test_cancel_job_stops_runner_and_state_sticks(tmp_path):
             proc.kill()
         coord.close()
         srv.close()
+
+
+def test_coordinator_deploys_one_job_across_two_runners(tmp_path):
+    """Tier-5 (SURVEY §3.6): ONE submitted job spans TWO runner
+    processes — the coordinator allocates a distinct runner per
+    process, the DCN exchange ports rendezvous through
+    rpc_dcn_register/peers, keyed records cross processes, and the
+    union of both processes' committed output equals the golden run."""
+    import runner_job_dcn
+
+    coord = JobCoordinator(Configuration({
+        "heartbeat.interval": "200ms",
+        "heartbeat.timeout": "5000ms",
+    }))
+    srv = RpcServer(coord)
+    procs = {}
+    try:
+        procs["r1"] = spawn_runner(srv.port, "r1")
+        procs["r2"] = spawn_runner(srv.port, "r2")
+        wait_until(lambda: len(coord.runners) == 2, 90,
+                   what="both runners registered")
+        n_batches = 16
+        sink_dir = str(tmp_path / "sink")
+        coord.rpc_submit_job(
+            "dcn-job",
+            entry="runner_job_dcn:build",
+            config={
+                "test.n-batches": n_batches,
+                "test.sink-dir": sink_dir,
+                "cluster.num-processes": 2,
+                "execution.checkpointing.dir": str(tmp_path / "chk"),
+                "execution.checkpointing.interval": "300ms",
+                "state.num-key-shards": 8,
+                "state.slots-per-shard": 32,
+            })
+        wait_until(lambda: coord.jobs["dcn-job"].state == "FINISHED",
+                   180, what="cross-runner job FINISHED")
+        assert sorted(coord.jobs["dcn-job"].assigned_runners) == [
+            "r1", "r2"]
+        got = {}
+        for pid in (0, 1):
+            for r in FileTransactionalSink.committed_rows(
+                    f"{sink_dir}-p{pid}"):
+                kk = (int(r["key"]), int(r["window_start"]))
+                assert kk not in got, f"duplicate emission for {kk}"
+                got[kk] = int(r["count"])
+        assert got == runner_job_dcn.golden_counts(n_batches)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        srv.close()
