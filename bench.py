@@ -59,9 +59,6 @@ def run_q5(batch_size: int, n_batches: int, *, shards: int, slots: int) -> dict:
         "state.num-key-shards": shards,
         "state.slots-per-shard": slots,
         "pipeline.microbatch-size": batch_size,
-        # single-core host + relay-served transfers: a shallow pipeline
-        # avoids client dispatch contention (measured: depth 1 beats 3)
-        "pipeline.max-inflight-steps": 1,
     }))
     emitted, sink = _counting_sink()
     q5_hot_items(env, bid_stream(cfg), sink,
