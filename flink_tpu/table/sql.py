@@ -18,6 +18,7 @@ Supported grammar (case-insensitive keywords):
          [JOIN source [[AS] ident] ON eq [AND eq ...]]
     [WHERE expr]
     [GROUP BY ident [, ident ...]]
+    [HAVING expr]
     [ORDER BY ident [DESC] LIMIT n | LIMIT n]
 
     eq     := [ident.]ident = [ident.]ident     (JOIN: one cross-side
@@ -25,7 +26,7 @@ Supported grammar (case-insensitive keywords):
               and tautological under the shared window spec)
 
     sel    := expr [AS ident] | agg(arg) [AS ident] | *
-    agg    := COUNT(*|col) | SUM(col) | MAX(col) | MIN(col) | AVG(col)
+    agg    := COUNT(*|col) | {SUM|MAX|MIN|AVG}(col-or-expression)
     source := ident
             | TABLE(TUMBLE(TABLE ident, DESCRIPTOR(col), interval))
             | TABLE(HOP(TABLE ident, DESCRIPTOR(col), interval, interval))
@@ -157,6 +158,7 @@ class Query:
     source: Any                 # str table name | WindowTvf | JoinSource
     where: Optional[Expression]
     group_by: List[str]
+    having: Optional[Expression]
     order_by: Optional[Tuple[str, bool]]  # (col, desc)
     limit: Optional[int]
 
@@ -221,8 +223,9 @@ class _Parser:
             group_by.append(self.expect("ident").text)
             while self.accept("op", ","):
                 group_by.append(self.expect("ident").text)
+        having = None
         if self.accept("kw", "having"):
-            raise SqlError("HAVING is not supported in v1")
+            having = self.expr()
         order_by = None
         if self.accept("kw", "order"):
             self.expect("kw", "by")
@@ -241,7 +244,8 @@ class _Parser:
         if t is not None:
             raise SqlError(f"unexpected trailing input at position "
                            f"{t.pos}: {t.text!r}")
-        return Query(items, source, where, group_by, order_by, limit)
+        return Query(items, source, where, group_by, having, order_by,
+                     limit)
 
     def select_item(self) -> SelectItem:
         if self.accept("op", "*"):
@@ -258,7 +262,11 @@ class _Parser:
                 if fn != "count":
                     raise SqlError(f"{fn}(*) is not valid; only COUNT(*)")
             else:
-                arg = self.expect("ident").text
+                # full expression argument: SUM(a * b), AVG(p + t), ...
+                # plain columns stay strings; expressions lower through
+                # a derived pre-projection in the planner
+                e = self.expr()
+                arg = e.name if isinstance(e, Col) else e
             self.expect("op", ")")
             alias = self.alias()
             return SelectItem(None, (fn, arg), alias)
@@ -446,6 +454,8 @@ def plan_sql(t_env: "TableEnvironment", sql: str) -> "Table":
         return _plan_aggregate(q, table, wdef)
 
     # pure projection query
+    if q.having is not None:
+        raise SqlError("HAVING without aggregate functions in SELECT")
     if wdef is not None:
         raise SqlError(
             "a window TVF source needs aggregate functions in SELECT "
@@ -619,14 +629,25 @@ def _plan_aggregate(q: Query, table: "Table",
             f"v1 supports one non-window grouping column; got "
             f"{group_cols}")
 
-    # build agg calls with output names
+    # build agg calls with output names; EXPRESSION arguments
+    # (SUM(a*b), AVG(p+t), ...) lower through a derived pre-projection
+    # computed before the window aggregation — the streaming equivalent
+    # of the planner's calc-before-agg rewrite
     calls: List[AggCall] = []
     plain: List[str] = []
+    derived: List[Tuple[str, Expression]] = []
     for it in q.items:
         if it.star:
             raise SqlError("SELECT * cannot mix with aggregates")
         if it.agg is not None:
             fn, arg = it.agg
+            if arg is not None and not isinstance(arg, str):
+                if it.alias is None:
+                    raise SqlError(
+                        f"{fn.upper()}(<expression>) needs an AS alias")
+                name = f"__agg_expr_{len(derived)}"
+                derived.append((name, arg))
+                arg = name
             default = fn if fn == "count" else f"{fn}_{arg}"
             calls.append(AggCall(fn, arg, it.alias or default))
         else:
@@ -646,6 +667,18 @@ def _plan_aggregate(q: Query, table: "Table",
                 f"column {p!r} in SELECT is neither grouped nor "
                 "aggregated")
 
+    if derived:
+        # keep the grouping columns, the time attribute, and every
+        # plain aggregate argument alongside the derived columns
+        keep = list(dict.fromkeys(
+            group_cols
+            + [q.source.time_col]
+            + [c.field for c in calls
+               if isinstance(c.field, str)
+               and not c.field.startswith("__agg_expr_")]))
+        sels = [Col(k).alias(k) for k in keep]
+        sels += [e.alias(name) for name, e in derived]
+        table = table.select(*sels)
     gt = (table.window(wdef).group_by(*q.group_by)
           if q.group_by else table.window(wdef).group_by())
     want = plain + [c.out_name for c in calls]
@@ -677,10 +710,22 @@ def _plan_aggregate(q: Query, table: "Table",
                 "ORDER BY ... DESC LIMIT n is not supported over "
                 "SESSION windows in v1 (TUMBLE/HOP only)")
         topped = agg_stream.top(q.limit, by=by_call.runtime_field)
-        return finish_projection(
+        out = finish_projection(
             table.t_env, topped, pairs, key_out, want)
+        if q.having is not None:
+            for f in q.having.fields():
+                if f not in out.schema.columns:
+                    raise SqlError(
+                        f"HAVING references {f!r}, which the top-n "
+                        "output does not carry — select it")
+            out = out.filter(q.having)
+        return out
 
     result = gt.aggregate(*calls)
+    # HAVING filters the AGGREGATED rows (it may reference aggregate
+    # aliases and grouping columns — the full pre-projection schema)
+    if q.having is not None:
+        result = result.filter(q.having)
     # drop columns not selected (grouping col might be omitted)
     if set(want) != set(result.schema.columns):
         result = result.select(*want)
