@@ -306,6 +306,7 @@ class WindowedStream(_AggregateShortcuts):
         the window's surviving elements (field arrays + ``__ts__``)
         and returns the result row's fields (ref: WindowFunction.apply
         over the evicted iterable)."""
+        self._check_element_path()
         kt = self.keyed.transform
         assert isinstance(kt, KeyByTransformation)
         from flink_tpu.graph.transformations import (
@@ -317,6 +318,30 @@ class WindowedStream(_AggregateShortcuts):
             allowed_lateness_ms=self._lateness, key_field=kt.key_field)
         self.keyed.env._register(t)
         return DataStream(self.keyed.env, t)
+
+    def _check_element_path(self) -> None:
+        """Validate combinations BEFORE building an element-buffer
+        operator: that operator assigns windows by event timestamps and
+        fires on the event watermark, so a processing-time assigner or
+        ProcessingTimeTrigger here would silently produce wrong results
+        (the pane path's _check_trigger rejects these; the element path
+        must too)."""
+        from flink_tpu.api.windowing import (
+            ProcessingTimeTrigger, PurgingTrigger)
+
+        if bool(getattr(self.assigner, "is_processing_time", False)):
+            raise NotImplementedError(
+                "processing-time window assigners are not supported on "
+                "the element-buffer (evictor/custom-trigger) path — it "
+                "assigns and fires on event time; use an event-time "
+                "assigner or drop the evictor/custom trigger")
+        t = self._trigger
+        inner = t.inner if isinstance(t, PurgingTrigger) else t
+        if isinstance(inner, ProcessingTimeTrigger):
+            raise NotImplementedError(
+                "ProcessingTimeTrigger is not supported on the element-"
+                "buffer (evictor/custom-trigger) path — fires are "
+                "driven by the event watermark there")
 
     def _check_trigger(self) -> None:
         """Validate the trigger/window combination at build time —

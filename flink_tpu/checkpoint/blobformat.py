@@ -55,6 +55,17 @@ class _Encoder:
         if isinstance(v, np.generic):
             return {"__np__": [str(v.dtype), v.item()]}
         if isinstance(v, np.ndarray):
+            # object-dtype arrays (e.g. user state holding strings from
+            # a dtype=object source column) have no raw-byte form —
+            # np.frombuffer can't decode them, so the array section
+            # would produce an unrestorable checkpoint. Route them
+            # through the counted pickle escape hatch instead.
+            if v.dtype.hasobject:
+                import pickle
+
+                self.pickle_escapes += 1
+                return {"__pickle__": base64.b64encode(pickle.dumps(
+                    v, protocol=pickle.HIGHEST_PROTOCOL)).decode()}
             # ascontiguousarray promotes 0-d to (1,) — restore the shape
             self.arrays.append(np.ascontiguousarray(v).reshape(v.shape))
             return {"__nd__": len(self.arrays) - 1}
@@ -159,15 +170,20 @@ def _key(k: Any) -> Any:
     return k
 
 
+def read_header(raw: bytes) -> Tuple[Dict[str, Any], int]:
+    """Parse just the JSON header without touching the array section.
+    Returns (header, array_section_base_offset)."""
+    if len(raw) < len(MAGIC) + 4 or raw[:len(MAGIC)] != MAGIC:
+        raise ValueError("not a FTCKPT3 blob (bad magic)")
+    hstart = len(MAGIC) + 4
+    hlen = struct.unpack("<I", raw[len(MAGIC):hstart])[0]
+    return json.loads(raw[hstart:hstart + hlen].decode()), hstart + hlen
+
+
 def decode(raw: bytes) -> Any:
     """v3 bytes → payload tree (arrays are read-only views when the
     input buffer allows zero-copy)."""
-    if raw[:len(MAGIC)] != MAGIC:
-        raise ValueError("not a FTCKPT3 blob (bad magic)")
-    hlen = struct.unpack("<I", raw[len(MAGIC):len(MAGIC) + 4])[0]
-    hstart = len(MAGIC) + 4
-    header = json.loads(raw[hstart:hstart + hlen].decode())
-    base = hstart + hlen
+    header, base = read_header(raw)
     arrays: List[np.ndarray] = []
     for spec in header["arrays"]:
         off = base + spec["offset"]

@@ -324,6 +324,12 @@ class ClusterOptions:
     DCN_PORT = ConfigOption(
         "cluster.dcn-port", 0,
         "This process's exchange listen port (0 = ephemeral).")
+    DCN_BIND = ConfigOption(
+        "cluster.dcn-bind", "auto",
+        "Address the exchange listener binds. 'auto' (default) stays "
+        "on 127.0.0.1 unless the configured peers (cluster.dcn-peers / "
+        "cluster.dcn-host) are off-host, then widens to 0.0.0.0; set "
+        "an explicit address to override.")
     EXCHANGE_IMPL = ConfigOption(
         "exchange.impl", "all-to-all",
         "Keyed-exchange collective pattern (the Shuffle SPI seam, ref: "

@@ -43,12 +43,17 @@ class DcnExchange:
     until the full mesh is up."""
 
     def __init__(self, process_id: int, n_processes: int,
-                 listen_port: int = 0) -> None:
+                 listen_port: int = 0,
+                 bind_host: str = "127.0.0.1") -> None:
         self.pid = process_id
         self.n = n_processes
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._srv.bind(("127.0.0.1", listen_port))
+        # loopback by DEFAULT (frames decode through blobformat, whose
+        # pickle escape makes an open listener an RCE surface); the
+        # driver widens to 0.0.0.0 only when the configured peers are
+        # actually off-host (cluster.dcn-bind overrides either way)
+        self._srv.bind((bind_host, listen_port))
         self._srv.listen(n_processes)
         self.port = self._srv.getsockname()[1]
         self._in: Dict[int, socket.socket] = {}
@@ -64,8 +69,13 @@ class DcnExchange:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            sender = conn.recv(1)[0]
-            self._in[sender] = conn
+            # a connect-and-close probe (port scan) must not kill the
+            # accept thread — the real peer's dial is still coming
+            hello = conn.recv(1)
+            if not hello or hello[0] >= self.n:
+                conn.close()
+                continue
+            self._in[hello[0]] = conn
 
     def connect(self, peers: List[str], timeout_s: float = 30.0) -> None:
         """``peers[j]`` = "host:port" of process j's listener (the entry

@@ -149,11 +149,20 @@ class EvictingWindowOperator:
                 if r in (TriggerResult.FIRE, TriggerResult.FIRE_AND_PURGE):
                     self._fire(k, w, buf,
                                purge=(r == TriggerResult.FIRE_AND_PURGE))
-                # late-within-lateness on an already-fired window:
-                # default event-time semantics re-fire immediately
-                elif (buf.fired and self.watermark >= w.end - 1
-                        and isinstance(self.trigger, EventTimeTrigger)):
-                    self._fire(k, w, buf, purge=False)
+                # Late-within-lateness: the watermark already passed
+                # w.end-1, so advance_watermark's pass over this window
+                # is behind us (or the window didn't exist yet). Any
+                # watermark-family trigger (EventTimeTrigger, or a
+                # PurgingTrigger wrapping one) must (re-)fire NOW —
+                # regardless of whether the window fired before.
+                elif (self.watermark >= w.end - 1
+                        and self.trigger.fires_on_watermark()):
+                    rl = self.trigger.on_event_time(self.watermark, w)
+                    if rl in (TriggerResult.FIRE,
+                              TriggerResult.FIRE_AND_PURGE):
+                        self._fire(
+                            k, w, buf,
+                            purge=(rl == TriggerResult.FIRE_AND_PURGE))
 
     def _fire(self, key: int, w: TimeWindow, buf: _Buf,
               purge: bool) -> None:

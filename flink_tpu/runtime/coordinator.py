@@ -778,8 +778,11 @@ class JobCoordinator(RpcEndpoint):
                 return {"ok": False, "reason": "unknown runner"}
             r.draining = True
             victims = []
-            for job_id, alloc in list(self._slots._allocations.items()):
-                if alloc[0] != runner_id:
+            for job_id in list(self._slots._allocations):
+                # a cross-host job may touch the drained runner through
+                # ANY of its process allocations, not just the head
+                if all(r != runner_id
+                       for r, _ in self._slots.allocations(job_id)):
                     continue
                 j = self.jobs.get(job_id)
                 if j is None or j.entry is None or j.state != "RUNNING":

@@ -506,8 +506,19 @@ class Driver:
             raise NotImplementedError(
                 "allowed lateness across processes needs a refire "
                 "consensus the v1 exchange does not carry")
+        bind = str(cfg.get(ClusterOptions.DCN_BIND)).strip()
+        if bind == "auto":
+            # widen past loopback only when the configured topology is
+            # actually cross-machine (see ClusterOptions.DCN_BIND)
+            local = ("", "127.0.0.1", "localhost")
+            hosts = [p.rpartition(":")[0].strip() for p in str(
+                cfg.get(ClusterOptions.DCN_PEERS)).split(",") if p.strip()]
+            hosts.append(str(cfg.get_raw("cluster.dcn-host", "")).strip())
+            bind = ("0.0.0.0" if any(h and h not in local for h in hosts)
+                    else "127.0.0.1")
         ex = DcnExchange(pid, n,
-                         listen_port=int(cfg.get(ClusterOptions.DCN_PORT)))
+                         listen_port=int(cfg.get(ClusterOptions.DCN_PORT)),
+                         bind_host=bind)
         if rendezvous:
             # coordinator-deployed job: publish this process's listener
             # and poll until the whole fleet registered (ref: the

@@ -41,6 +41,22 @@ class TestBlobFormat:
         assert out["empty"].shape == (0, 4)
         assert out["scalar0d"] == 7 and out["scalar0d"].shape == ()
 
+    def test_object_dtype_array_round_trips_via_pickle_escape(self):
+        # A dtype=object array (user ValueState holding strings — the
+        # line-source shape) must NOT enter the raw array section: its
+        # buffer holds pointers, so decode would be garbage. It routes
+        # through the counted pickle escape and round-trips exactly.
+        payload = {"lines": np.array(["a", "bb", None], dtype=object),
+                   "num": np.arange(3, dtype=np.int64)}
+        blob = blobformat.encode(payload)
+        header, _ = blobformat.read_header(blob)
+        assert header["pickle_escapes"] == 1
+        assert len(header["arrays"]) == 1  # only the int64 array
+        out = blobformat.decode(blob)
+        assert out["lines"].dtype == object
+        assert list(out["lines"]) == ["a", "bb", None]
+        np.testing.assert_array_equal(out["num"], payload["num"])
+
     def test_panestate_and_none_lanes(self):
         st = PaneState(sums=None, maxs=None, mins=None,
                        counts=np.arange(12, dtype=np.int32).reshape(3, 4))

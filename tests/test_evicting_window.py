@@ -6,7 +6,7 @@ import pytest
 
 from flink_tpu.api.environment import StreamExecutionEnvironment
 from flink_tpu.api.windowing import (
-    CountTrigger, TimeWindow, Trigger, TriggerResult,
+    CountTrigger, EventTimeTrigger, TimeWindow, Trigger, TriggerResult,
     TumblingEventTimeWindows)
 from flink_tpu.config import Configuration
 from flink_tpu.ops.aggregates import avg_of, count, max_of
@@ -98,6 +98,59 @@ class TestCustomTriggers:
         assert len(f["key"]) == 0  # the trigger held the fire
 
 
+class TestLateness:
+    """Late-within-lateness semantics on the element path (ref:
+    WindowOperator allowedLateness: a late-but-not-dropped element
+    re-evaluates the trigger against the CURRENT watermark)."""
+
+    def test_late_created_window_still_fires(self):
+        # Watermark passes w.end-1 BEFORE the window's first element
+        # arrives; with lateness the element must still produce a fire
+        # (advance_watermark's prev < w.end-1 <= wm pass is behind us).
+        op = EvictingWindowOperator(
+            TumblingEventTimeWindows.of(1000), count_fn,
+            allowed_lateness_ms=5000)
+        op.advance_watermark(2500)  # [0,1000) is past, within lateness
+        op.process_batch(np.array([7]), np.array([500]), {})
+        f = dict(op.take_fired())
+        assert [int(k) for k in f["key"]] == [7]
+        assert [int(c) for c in f["count"]] == [1]
+
+    def test_late_refire_after_purge_has_fresh_contents_only(self):
+        from flink_tpu.api.windowing import PurgingTrigger
+        op = EvictingWindowOperator(
+            TumblingEventTimeWindows.of(1000), count_fn,
+            trigger=PurgingTrigger.of(EventTimeTrigger.create()),
+            allowed_lateness_ms=5000)
+        op.process_batch(np.array([3, 3]), np.array([100, 200]), {})
+        f = dict(op.advance_watermark(1500))
+        assert [int(c) for c in f["count"]] == [2]  # on-time fire+purge
+        # late element within lateness: re-fires with ONLY itself
+        op.process_batch(np.array([3]), np.array([300]), {})
+        f = dict(op.take_fired())
+        assert [int(c) for c in f["count"]] == [1]
+
+    def test_late_refire_without_purge_accumulates(self):
+        op = EvictingWindowOperator(
+            TumblingEventTimeWindows.of(1000), count_fn,
+            allowed_lateness_ms=5000)
+        op.process_batch(np.array([3, 3]), np.array([100, 200]), {})
+        f = dict(op.advance_watermark(1500))
+        assert [int(c) for c in f["count"]] == [2]
+        op.process_batch(np.array([3]), np.array([300]), {})
+        f = dict(op.take_fired())
+        assert [int(c) for c in f["count"]] == [3]  # full contents
+
+    def test_past_lateness_horizon_still_dropped(self):
+        op = EvictingWindowOperator(
+            TumblingEventTimeWindows.of(1000), count_fn,
+            allowed_lateness_ms=500)
+        op.advance_watermark(2500)  # [0,1000) past end-1+500=1499
+        op.process_batch(np.array([7]), np.array([500]), {})
+        assert op.take_fired() is None
+        assert op.late_records == 1
+
+
 class TestPipelineRouting:
     def _run(self, configure):
         env = env_()
@@ -141,6 +194,33 @@ class TestPipelineRouting:
                 max_of("v")))
         got = {int(r["key"]): float(r["max_v"]) for r in rows}
         assert got == {1: 5.0, 2: 11.0}
+
+    def test_evictor_with_processing_time_assigner_rejected(self):
+        # The element path assigns/fires on EVENT time; a proc-time
+        # assigner here would silently window by event timestamps.
+        from flink_tpu.api.windowing import TumblingProcessingTimeWindows
+        env = env_()
+        s = (env.from_collection(
+                {"k": np.array([1], np.int64),
+                 "v": np.array([1.0])}, np.array([10], np.int64))
+             .key_by("k")
+             .window(TumblingProcessingTimeWindows.of(1000))
+             .evictor(CountEvictor.of(3)))
+        with pytest.raises(NotImplementedError, match="element-buffer"):
+            s.count()
+
+    def test_evictor_with_processing_time_trigger_rejected(self):
+        from flink_tpu.api.windowing import ProcessingTimeTrigger
+        env = env_()
+        s = (env.from_collection(
+                {"k": np.array([1], np.int64),
+                 "v": np.array([1.0])}, np.array([10], np.int64))
+             .key_by("k")
+             .window(TumblingEventTimeWindows.of(1000))
+             .trigger(ProcessingTimeTrigger.create())
+             .evictor(CountEvictor.of(3)))
+        with pytest.raises(NotImplementedError, match="element-buffer"):
+            s.count()
 
 
 class TestSnapshotRestore:

@@ -63,11 +63,21 @@ class TestParser:
         with pytest.raises(SqlError):
             parse("SELECT FROM t")
         with pytest.raises(SqlError):
-            parse("SELECT a FROM t HAVING a > 1")
-        with pytest.raises(SqlError):
             parse("SELECT a FROM t; DROP TABLE t")
         with pytest.raises(SqlError):
             parse("SELECT SUM(*) FROM t")
+
+    def test_having_without_aggregate_rejected_at_plan_time(self):
+        # HAVING parses fine; the semantic check happens when the query
+        # is planned against a real source table.
+        q = parse("SELECT a FROM t HAVING a > 1")
+        assert q.having is not None
+        env, t_env = _fresh()
+        stream, _ = _bids(env)
+        t_env.create_temporary_view(
+            "t", stream, schema=["a", "price", "ts"], time_attr="ts")
+        with pytest.raises(SqlError, match="HAVING"):
+            t_env.sql_query("SELECT a FROM t HAVING a > 1")
 
 
 class TestSqlVsDataStream:
