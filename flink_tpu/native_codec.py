@@ -130,6 +130,18 @@ def _bind(lib, i64p, f32p) -> None:
         ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         i32p, i32p, i32p, ctypes.c_int64, i64p, u8p, ctypes.c_int64,
         ctypes.c_int64]
+    lib.ingest_fused_scan.restype = ctypes.c_int64
+    lib.ingest_fused_scan.argtypes = [
+        ctypes.c_int64, i64p, i64p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        i32p, i32p, ctypes.c_int64, ctypes.c_int64, i64p, u8p,
+        ctypes.c_int64, ctypes.c_int64, i64p, ctypes.c_int64]
+    lib.ingest_fused_finalize_u32.restype = None
+    lib.ingest_fused_finalize_u32.argtypes = [
+        ctypes.c_int64, i32p, i32p, i32p, ctypes.c_int64, ctypes.c_int64]
+    lib.ingest_fused_finalize_pairs.restype = None
+    lib.ingest_fused_finalize_pairs.argtypes = [
+        ctypes.c_int64, i32p, i32p, i32p]
 
 
 def native_available() -> bool:
@@ -412,6 +424,86 @@ def nexmark_bids_native(
     lib.nexmark_bids(seed, n, hot_ratio, n_hot, n_auctions, n_people,
                      auction, bidder, price)
     return auction, bidder, price
+
+
+class IngestFusedResult:
+    """Output of one fully-fused ingest over a batch (see codec.cc
+    ingest_fused_scan): running pair list + accumulated stats, with the
+    finalize step deferred so a miss-registration re-scan can continue
+    the same workspace."""
+
+    __slots__ = ("npairs", "out_pairs", "stats", "bitmap")
+
+    def __init__(self, npairs, out_pairs, stats, bitmap):
+        self.npairs = npairs
+        self.out_pairs = out_pairs
+        self.stats = stats
+        self.bitmap = bitmap
+
+
+def ingest_fused_scan_native(
+    keys: np.ndarray, ts: np.ndarray, table: "NativeHashTable",
+    pane_ms: int, offset_ms: int, ring: int, ws: "PreaggWorkspace",
+    cap: int, dead_below: int, refire_below: int, bitmap_bits: int,
+    *, cont: Optional["IngestFusedResult"] = None, miss_cap: int = 0,
+) -> Optional[Tuple["IngestFusedResult", np.ndarray]]:
+    """One fused probe+ingest scan (codec.cc ingest_fused_scan).
+    Returns (result, miss_indices) or None (unavailable / cap
+    overflow — the workspace was re-zeroed; caller falls back). Pass
+    ``cont`` to continue a previous scan's pair list and stats (the
+    miss-registration second pass)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(ts)
+    if cont is None:
+        out_pairs = np.empty(cap, np.int32)
+        stats = np.zeros(8, np.int64)
+        stats[3] = np.iinfo(np.int64).max   # pmin seed
+        stats[4] = np.iinfo(np.int64).min   # pmax seed
+        bitmap = np.zeros(max((bitmap_bits + 7) // 8, 1), np.uint8)
+        np_in = 0
+    else:
+        out_pairs, stats, bitmap = cont.out_pairs, cont.stats, cont.bitmap
+        np_in = cont.npairs
+    miss_cap = max(miss_cap, 1)
+    out_miss = np.empty(miss_cap, np.int64)
+    stats[6] = 0  # miss list restarts each scan
+    npairs = lib.ingest_fused_scan(
+        n, np.ascontiguousarray(keys, np.int64),
+        np.ascontiguousarray(ts, np.int64), table._h,
+        pane_ms, offset_ms, ring, dead_below, refire_below,
+        ws.hist, out_pairs, np_in, cap, stats, bitmap,
+        dead_below, len(bitmap), out_miss, miss_cap)
+    if npairs < 0:
+        ws.rezero()
+        return None
+    res = IngestFusedResult(int(npairs), out_pairs, stats, bitmap)
+    return res, out_miss[:int(stats[6])]
+
+
+def ingest_fused_finalize_u32_native(
+    res: "IngestFusedResult", ws: "PreaggWorkspace", hdr: int,
+    cap_out: int) -> np.ndarray:
+    """Emit the packed u32 upload buffer (hdr -1 region + pair<<12|count
+    + -1 padding) straight from C, resetting the workspace."""
+    lib = _load()
+    buf = np.empty(hdr + cap_out, np.int32)
+    lib.ingest_fused_finalize_u32(
+        res.npairs, ws.hist, res.out_pairs, buf, hdr, cap_out)
+    return buf
+
+
+def ingest_fused_finalize_pairs_native(
+    res: "IngestFusedResult", ws: "PreaggWorkspace",
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Extract (pairs, counts) and reset the workspace — the path for
+    counts too large for the 12-bit u32 pack."""
+    lib = _load()
+    counts = np.empty(max(res.npairs, 1), np.int32)
+    lib.ingest_fused_finalize_pairs(
+        res.npairs, ws.hist, res.out_pairs, counts)
+    return res.out_pairs[:res.npairs], counts[:res.npairs]
 
 
 def ingest_combine_native(

@@ -1487,21 +1487,26 @@ class WindowOperator:
             self.throttle()
 
     def _process_batch_fused(self, keys: np.ndarray, ts: np.ndarray) -> bool:
-        """Count-only ingest via codec.cc ingest_combine. Returns False
-        (no state touched beyond the key directory) when the native lib
-        is missing, the batch looks high-cardinality (pairs would not
-        beat per-record bytes), or the refire span is degenerate — the
-        caller then runs the general path."""
+        """Count-only ingest via codec.cc ingest_fused_scan: ONE C pass
+        does the key→slot directory probe AND the pane/late/refire/
+        histogram scan (the separate assign pass wrote+reread an 8 MB
+        slots array per 2^20 batch — PROFILE.md §7.4 lever a), and the
+        finalize emits the packed u32 upload buffer straight from C.
+        Returns False (no pane state touched; at most new keys
+        registered in the directory, which assign would do anyway) when
+        the native lib is missing, the batch looks high-cardinality, or
+        the refire span is degenerate — the caller then runs the
+        general path."""
         from flink_tpu.native_codec import (
-            PreaggWorkspace, ingest_combine_native)
-        t0 = time.perf_counter()
+            NativeHashTable, PreaggWorkspace,
+            ingest_fused_finalize_pairs_native,
+            ingest_fused_finalize_u32_native, ingest_fused_scan_native)
+        if not isinstance(self.directory._table, NativeHashTable):
+            return False
         keys = np.asarray(keys, dtype=np.int64)
         ts = np.asarray(ts, dtype=np.int64)
         n = len(ts)
         ring = self.plan.ring
-        t1 = time.perf_counter()
-        slots = self.directory.assign(keys)
-        self.prof["pb_assign"] += time.perf_counter() - t1
         nk = self.directory.num_keys()
         cap = _next_pow2(max(min(n, max(nk, 1) * ring), 256))
         if 4 * cap > 2 * n or cap > (1 << 21):
@@ -1523,15 +1528,33 @@ class WindowOperator:
             if (self._preagg_ws is None or self._preagg_ws.domain != domain
                     or self._preagg_ws.nlanes != 0):
                 self._preagg_ws = PreaggWorkspace(domain, 0)
-            res = ingest_combine_native(
-                ts, slots, self.plan.pane_ms, self.plan.offset_ms,
-                self.plan.ring, self._preagg_ws, cap, dead, refire_below,
-                bits)
-            if res is None:
+            scan = ingest_fused_scan_native(
+                keys, ts, self.directory._table, self.plan.pane_ms,
+                self.plan.offset_ms, self.plan.ring, self._preagg_ws,
+                cap, dead, refire_below, bits, miss_cap=n)
+            if scan is None:
                 return False
-            pairs, cnts, stats, bitmap = res
-            n_valid, n_late, n_bad, pmin, pmax, n_refire = (
-                int(x) for x in stats)
+            res, miss_ix = scan
+            if len(miss_ix):
+                # new keys this batch: allocate + insert (no second
+                # lookup — the probe already proved absence), then
+                # continue the SAME scan over just the missed records
+                t1 = time.perf_counter()
+                self.directory.register_misses(keys[miss_ix])
+                self.prof["pb_assign"] += time.perf_counter() - t1
+                scan = ingest_fused_scan_native(
+                    keys[miss_ix], ts[miss_ix], self.directory._table,
+                    self.plan.pane_ms, self.plan.offset_ms,
+                    self.plan.ring, self._preagg_ws, cap, dead,
+                    refire_below, bits, cont=res, miss_cap=1)
+                if scan is None:
+                    return False
+                res, miss2 = scan
+                if len(miss2):  # can't happen post-registration
+                    self._preagg_ws.rezero()
+                    return False
+            (n_valid, n_late, n_bad, pmin, pmax, n_refire, _nmiss,
+             cmax) = (int(x) for x in res.stats)
             if n_valid == 0:
                 break
             if self._min_pane_seen is None or pmin < self._min_pane_seen:
@@ -1544,6 +1567,7 @@ class WindowOperator:
                 # ring too small for the live span: grow (remapping only
                 # panes applied BEFORE this batch) and redo the scan —
                 # its histogram columns were taken mod the old ring
+                self._preagg_ws.rezero()
                 self._grow_ring(live_hi - live_lo + 1, prev_min, prev_max)
                 continue
             break
@@ -1554,35 +1578,35 @@ class WindowOperator:
             account_full_drop(self, n_bad)
         if n_refire:
             late_panes = (np.flatnonzero(
-                np.unpackbits(bitmap, bitorder="little")) + dead)
+                np.unpackbits(res.bitmap, bitorder="little")) + dead)
             self._refire.update(self.plan.late_refire_ends(
                 late_panes, self._fired_below_end, self.watermark))
         if n_valid == 0:
             return True
         tc = time.perf_counter()
         domain = self.layout.slots * self.plan.ring
-        cap = _next_pow2(max(len(pairs), 256))
-        cmax = 0 if len(cnts) == 0 else int(cnts.max())
+        cap = _next_pow2(max(res.npairs, 256))
         if cmax < 0xFFF and domain <= (1 << 20):
-            # u32 pack with fused-step header space reserved up front:
-            # the pending advance fills it and dispatches apply+fire+
-            # clear as ONE program with ONE upload
-            buf = np.full(FUSED_HDR + cap, -1, np.int32)
-            buf[FUSED_HDR:FUSED_HDR + len(pairs)] = (
-                pairs.astype(np.int64) << 12
-                | cnts.astype(np.int64)).astype(np.uint32).view(np.int32)
+            # u32 pack emitted straight from C, with fused-step header
+            # space reserved up front: the pending advance fills it and
+            # dispatches apply+fire+clear as ONE program with ONE upload
+            buf = ingest_fused_finalize_u32_native(
+                res, self._preagg_ws, FUSED_HDR, cap)
             if self._fused_step is not None and self._stash_u32 is None:
                 self._stash_u32 = buf
                 self.prof["pb_preagg"] += time.perf_counter() - tc
                 return True
             self.state = self._preagg_u32(
                 self.state, jnp.asarray(buf[FUSED_HDR:]))
-        elif cmax <= 0xFFFF:
-            buf = preagg_encode_u16(pairs, cnts, cap)
-            self.state = self._preagg_u16(self.state, jnp.asarray(buf))
         else:
-            buf = preagg_encode_i32(pairs, cnts, [], cap)
-            self.state = self._preagg_i32(self.state, jnp.asarray(buf))
+            pairs, cnts = ingest_fused_finalize_pairs_native(
+                res, self._preagg_ws)
+            if cmax <= 0xFFFF:
+                buf = preagg_encode_u16(pairs, cnts, cap)
+                self.state = self._preagg_u16(self.state, jnp.asarray(buf))
+            else:
+                buf = preagg_encode_i32(pairs, cnts, [], cap)
+                self.state = self._preagg_i32(self.state, jnp.asarray(buf))
         self.prof["pb_preagg"] += time.perf_counter() - tc
         self._inflight.append(self.state.counts[0, 0])
         if not self.external_throttle:

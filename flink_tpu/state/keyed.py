@@ -245,6 +245,15 @@ class KeyDirectory:
             slots[miss_ix] = alloc[inv]
         return slots
 
+    def register_misses(self, miss_keys: np.ndarray) -> None:
+        """Register keys KNOWN to be absent (the fused C scan already
+        probed them — codec.cc ingest_fused_scan): allocate + insert
+        without repeating the lookup pass."""
+        uniq = np.unique(np.asarray(miss_keys, np.int64))
+        uh = hash_keys_numpy(uniq)
+        alloc = self._alloc_slots(uniq, uh)
+        self._table.insert_batch(uniq, uh, alloc)
+
     def _alloc_slots(self, keys: np.ndarray, hashes: np.ndarray) -> np.ndarray:
         """Assign shard-local slots to a batch of DISTINCT new keys:
         group by shard, hand out contiguous indices from each shard's

@@ -527,4 +527,113 @@ int64_t ingest_combine(
   return np_;
 }
 
+// Fully-fused count-only ingest: key->slot directory probe (the open-
+// addressing table above) + event-time pane + late/refire accounting +
+// (slot, ring-column) histogram in ONE scan over (keys, ts) — the
+// separate ht_lookup pass wrote and re-read an 8 MB slots array per
+// 2^20 batch on the single-core bench host (~12ms); folding the probe
+// into the scan removes that traffic entirely (PROFILE.md §7.4 lever a).
+//
+// Records whose key is NOT in the table are skipped and their indices
+// written to out_miss (caller registers the new keys, then re-invokes
+// over the miss subset with np_in continuing — at steady state with a
+// bounded key domain the miss list is empty). Keys mapped to a
+// NEGATIVE slot (directory FULL sentinel) count into n_bad exactly as
+// the unfused path did.
+//
+// stats accumulate ACROSS calls: [n_valid, n_late, n_bad, pmin, pmax,
+// n_refire, n_miss, cmax]; the caller seeds pmin=INT64_MAX,
+// pmax=INT64_MIN, rest 0. Returns the running distinct-pair count, or
+// -1 on pair-cap overflow / -2 on miss-cap overflow (workspace left
+// dirty; caller re-zeros and falls back).
+int64_t ingest_fused_scan(
+    int64_t n, const int64_t* keys, const int64_t* ts, void* ht,
+    int64_t pane_ms, int64_t offset_ms, int64_t ring,
+    int64_t dead_below, int64_t refire_below,
+    int32_t* hist, int32_t* out_pairs, int64_t np_in, int64_t cap,
+    int64_t* stats, uint8_t* refire_bitmap, int64_t bitmap_base,
+    int64_t bitmap_len, int64_t* out_miss, int64_t miss_cap) {
+  FtHashTable* t = (FtHashTable*)ht;
+  int64_t np_ = np_in, n_valid = 0, n_late = 0, n_bad = 0;
+  int64_t n_refire = 0, n_miss = stats[6];
+  int64_t pmin = stats[3], pmax = stats[4], cmax = stats[7];
+  for (int64_t i = 0; i < n; ++i) {
+    // probe first: an unknown key must reach the miss list even when
+    // its record is late (registration is not drop-sensitive)
+    uint64_t ix = ht_mix((uint64_t)keys[i]) & t->mask;
+    int64_t slot;
+    for (;;) {
+      if (!t->used[ix]) { slot = INT64_MIN; break; }  // miss
+      if (t->keys[ix] == keys[i]) { slot = t->vals[ix]; break; }
+      ix = (ix + 1) & t->mask;
+    }
+    if (slot == INT64_MIN) {
+      if (n_miss >= miss_cap) return -2;
+      out_miss[n_miss++] = i;
+      continue;
+    }
+    int64_t tt = ts[i] - offset_ms;
+    int64_t pane = tt / pane_ms - ((tt % pane_ms) < 0 ? 1 : 0);
+    if (pane < dead_below) { ++n_late; continue; }
+    if (slot < 0) { ++n_bad; continue; }
+    ++n_valid;
+    if (pane < pmin) pmin = pane;
+    if (pane > pmax) pmax = pane;
+    if (pane < refire_below) {
+      int64_t off = pane - bitmap_base;
+      if (off >= 0 && off < bitmap_len * 8) {
+        refire_bitmap[off >> 3] |= (uint8_t)(1u << (off & 7));
+        ++n_refire;
+      }
+    }
+    int64_t col = pane % ring;
+    if (col < 0) col += ring;
+    int64_t p = slot * ring + col;
+    if (hist[p] == 0) {
+      if (np_ >= cap) return -1;
+      out_pairs[np_++] = (int32_t)p;
+    }
+    if (++hist[p] > cmax) cmax = hist[p];
+  }
+  stats[0] += n_valid;
+  stats[1] += n_late;
+  stats[2] += n_bad;
+  stats[3] = pmin;
+  stats[4] = pmax;
+  stats[5] += n_refire;
+  stats[6] = n_miss;
+  stats[7] = cmax;
+  return np_;
+}
+
+// Finalize a fused scan into the packed u32 upload buffer the device
+// kernel consumes: out_u32[hdr + j] = (pair << 12) | count for the np_
+// recorded pairs, -1 padding elsewhere (header region included — the
+// pending advance fills it before dispatch). Resets every touched hist
+// entry, so steady-state calls never pay a full-domain clear.
+// Precondition: every count < 0xFFF (the caller checked stats[7]).
+void ingest_fused_finalize_u32(
+    int64_t np_, int32_t* hist, const int32_t* out_pairs,
+    int32_t* out_u32, int64_t hdr, int64_t cap_out) {
+  for (int64_t j = 0; j < hdr; ++j) out_u32[j] = -1;
+  for (int64_t j = 0; j < np_; ++j) {
+    int32_t p = out_pairs[j];
+    out_u32[hdr + j] = (int32_t)(((uint32_t)p << 12) | (uint32_t)hist[p]);
+    hist[p] = 0;
+  }
+  for (int64_t j = hdr + np_; j < hdr + cap_out; ++j) out_u32[j] = -1;
+}
+
+// Finalize into separate (pairs, counts) arrays — the fallback when a
+// count overflows the u32 pack's 12-bit field (u16/i32 encode paths).
+void ingest_fused_finalize_pairs(
+    int64_t np_, int32_t* hist, const int32_t* out_pairs,
+    int32_t* out_counts) {
+  for (int64_t j = 0; j < np_; ++j) {
+    int32_t p = out_pairs[j];
+    out_counts[j] = hist[p];
+    hist[p] = 0;
+  }
+}
+
 }  // extern "C"
