@@ -57,6 +57,58 @@ class CollectionSource(Source):
 
 
 @dataclasses.dataclass
+class DeviceGeneratorSource(Source):
+    """Generator source whose batches can be synthesized ON the
+    accelerator, chained directly into the consuming window operator's
+    step program (the operator-chaining principle — ref: chained
+    operators elide serialization, StreamingJobGraphGenerator chaining;
+    flink-connector-datagen as the embedded-source role — taken to its
+    TPU conclusion: the 'exchange' between source and operator is
+    device registers, not even host memory).
+
+    Contract: ``device_keys_ts(batch_index)`` (jax-traceable, i64
+    scalar → (keys, ts) device arrays) and ``keys_ts_host(i)`` (numpy)
+    must be BIT-EXACT for the same index — the host copy repairs
+    device-side key-table misses and replays after restore.
+    ``gen(split, i)`` materializes the full field set for consumers the
+    chain can't host (non-count aggregates, multi-op fan-out, DCN).
+    ``ts_bounds(i)`` returns the batch's exact (min_ts, max_ts) so the
+    driver can run the watermark clock without touching the device."""
+
+    gen: Callable[[str, int], Optional[Batch]]
+    device_keys_ts: Callable = None
+    keys_ts_host: Callable = None
+    ts_bounds: Callable = None
+    key_field: str = "key"
+    batch_size: int = 8192
+    n_batches: int = 0
+    is_bounded: bool = True
+    # bounded key domain [0, key_domain): REQUIRED for device chaining —
+    # on device, key→slot must be a pure function (dense identity; see
+    # KeyDirectory.register_dense), because table probes measured
+    # pathological there. Records outside the domain are repaired
+    # host-side. Dictionary-encoded keys (this framework's string
+    # convention) fit naturally; None disables the device chain.
+    key_domain: Optional[int] = None
+
+    def splits(self) -> List[str]:
+        return ["0"]  # device chaining is single-split by construction
+
+    def open_split(self, split: str, start_pos: int = 0) -> Iterator[Batch]:
+        i = start_pos
+        while True:
+            b = self.gen(split, i)
+            if b is None:
+                return
+            yield b
+            i += 1
+
+    @property
+    def bounded(self) -> bool:
+        return self.is_bounded
+
+
+@dataclasses.dataclass
 class GeneratorSource(Source):
     """Rate-unbounded generator source (ref: flink-connector-datagen
     DataGeneratorSource). ``gen(split, batch_index)`` returns a batch or

@@ -89,6 +89,89 @@ def bid_stream(cfg: NexmarkConfig) -> GeneratorSource:
     return GeneratorSource(gen, n_splits=cfg.n_splits)
 
 
+@dataclasses.dataclass(frozen=True)
+class _NexmarkDeviceBidGen:
+    """jnp-traceable bid generator, bit-identical to codec.cc smx().
+    A frozen dataclass (hash/eq by parameters) so it is a STABLE jit
+    static argument: two sources with the same shape share the compiled
+    devgen step across jobs — the warmup-shares-compilation contract."""
+
+    batch_size: int
+    events_per_ms: int
+    hot_ratio: int
+    n_hot: int
+    n_auctions: int
+
+    def __call__(self, batch_index):
+        import jax.numpy as jnp
+
+        b = self.batch_size
+        # counter-based splitmix64, bit-identical to codec.cc smx()
+        # (single split: the C seed for batch i is just i)
+        G = jnp.uint64(0x9E3779B97F4A7C15)
+        base = (batch_index.astype(jnp.uint64)
+                * jnp.uint64(0xD1342543DE82EF95) + jnp.uint64(1))
+        idx = jnp.arange(b, dtype=jnp.uint64)
+        z = base + idx * G + G  # smx advances the counter BEFORE mixing
+        z = (z ^ (z >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+        r1 = z ^ (z >> jnp.uint64(31))
+        hot = ((r1 & jnp.uint64(0xFF))
+               % jnp.uint64(self.hot_ratio)) == 0
+        a32 = (r1 >> jnp.uint64(8)) & jnp.uint64(0xFFFFFFFF)
+        auction = jnp.where(
+            hot, (a32 * jnp.uint64(self.n_hot)) >> jnp.uint64(32),
+            (a32 * jnp.uint64(self.n_auctions))
+            >> jnp.uint64(32)).astype(jnp.int64)
+        ids = (batch_index * b + jnp.arange(b, dtype=jnp.int64))
+        ts = ids // self.events_per_ms
+        return auction, ts
+
+
+def bid_stream_device(cfg: NexmarkConfig) -> "DeviceGeneratorSource":
+    """Device-resident bid generator (Q5/Q7 input): the same
+    counter-based splitmix64 stream as ``native/codec.cc nexmark_bids``,
+    expressed in jnp so the consuming operator's step program can
+    synthesize the batch ON the accelerator (see
+    ops/window.py devgen_step_kernel). ``device_keys_ts`` is BIT-EXACT
+    with the C generator's auction lane — verified by
+    tests/test_devgen.py — so the host can repair key-table misses and
+    replay after restore from the identical stream."""
+    from flink_tpu.api.sources import DeviceGeneratorSource
+
+    if cfg.n_splits != 1:
+        # the device formula and ts_bounds assume the single-split id
+        # base i*batch_size; _event_ids interleaves splits — mixing the
+        # two would break the bit-exact miss-repair contract
+        raise ValueError("bid_stream_device requires n_splits == 1")
+    host = bid_stream(cfg)
+    b = cfg.batch_size
+    n_hot = max(1, cfg.num_active_auctions // HOT_AUCTION_RATIO)
+    device_keys_ts = _NexmarkDeviceBidGen(
+        batch_size=b, events_per_ms=cfg.events_per_ms,
+        hot_ratio=cfg.hot_ratio, n_hot=n_hot,
+        n_auctions=cfg.num_active_auctions)
+
+    def keys_ts_host(i: int):
+        from flink_tpu.native_codec import nexmark_bids_native
+
+        native = nexmark_bids_native(
+            i, b, cfg.hot_ratio, n_hot, cfg.num_active_auctions,
+            cfg.num_active_people)
+        ids, ts = _event_ids(cfg, 0, i)
+        return native[0], ts
+
+    def ts_bounds(i: int):
+        base = i * b
+        return base // cfg.events_per_ms, (base + b - 1) // cfg.events_per_ms
+
+    return DeviceGeneratorSource(
+        gen=host.gen, device_keys_ts=device_keys_ts,
+        keys_ts_host=keys_ts_host, ts_bounds=ts_bounds,
+        key_field="auction", batch_size=b, n_batches=cfg.n_batches,
+        key_domain=cfg.num_active_auctions)
+
+
 def person_stream(cfg: NexmarkConfig) -> GeneratorSource:
     """New-person events (Q8 left input): fields person, state_id."""
 

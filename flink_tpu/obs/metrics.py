@@ -170,7 +170,9 @@ class MetricRegistry:
                 out[key] = m.rate
             elif isinstance(m, Histogram):
                 out[key + ".p50"] = m.quantile(0.5)
+                out[key + ".p90"] = m.quantile(0.9)
                 out[key + ".p99"] = m.quantile(0.99)
+                out[key + ".max"] = m.quantile(1.0)
                 out[key + ".mean"] = m.mean
                 out[key + ".count"] = m.count
         return out

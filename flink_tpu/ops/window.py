@@ -305,24 +305,43 @@ def fire_kernel(
     """
     ppw = panes_per_window
     want = end_panes[:, None] - ppw + jnp.arange(ppw)[None, :]            # (W, ppw) global panes
-    ring_ix = (want % ring).astype(jnp.int32)
     live = (want >= pane_lo) & (want <= pane_hi)                           # (W, ppw)
-    m3 = live[None, :, :, None]
-    m2 = live[None, :, :]
     rows_n = state.counts.shape[0]
     W = end_panes.shape[0]
+    # (W, ring) column-selection mask instead of a per-(window, pane)
+    # GATHER: arr[:, ring_ix] gathers rows × W × ppw elements, which XLA
+    # lowers at ~20ms per million on TPU (measured — the single hottest
+    # op of the fire path); the mask form is a broadcast + reduce the
+    # fuser streams at memory bandwidth. Within [pane_lo, pane_hi] at
+    # most one live pane occupies a column (the ingest ring guard), so
+    # a window's reduction over its live COLUMNS equals the reduction
+    # over its live panes.
+    colmask = jnp.any(
+        ((want % ring)[:, :, None] == jnp.arange(ring)[None, None, :])
+        & live[:, :, None], axis=1)                                        # (W, ring)
 
     def lane_red(arr, red, identity):
         # None lanes (zero declared width) reduce to a zero-width
         # INTERNAL value — never a runtime buffer, so free
         if arr is None:
             return jnp.zeros((rows_n, W, 0), jnp.float32)
-        return red(jnp.where(m3, arr[:, ring_ix, :], identity), axis=2)
+        m = colmask[None, :, :, None]
+        return red(jnp.where(m, arr[:, None, :, :], identity), axis=2)
 
-    sums = lane_red(state.sums, jnp.sum, 0.0)                               # (rows, W, sw)
+    # SUM lanes ride matmuls over the column mask — the MXU does the
+    # window reduction without materializing the (rows, W, ring)
+    # broadcast the mask-reduce form needs (33 MB per fire at Q5 shape).
+    # f64 keeps integer counts exact across the full i32 range.
+    sel_t = colmask.astype(jnp.float64).T                                  # (ring, W)
+    if state.sums is None:
+        sums = jnp.zeros((rows_n, W, 0), jnp.float32)
+    else:
+        sums = jnp.einsum("rcs,cw->rws", state.sums.astype(jnp.float64),
+                          sel_t).astype(jnp.float32)
     maxs = lane_red(state.maxs, jnp.max, -jnp.inf)
     mins = lane_red(state.mins, jnp.min, jnp.inf)
-    counts = jnp.sum(jnp.where(m2, state.counts[:, ring_ix], 0), axis=2)    # (rows, W)
+    counts = (state.counts.astype(jnp.float64)
+              @ sel_t).astype(state.counts.dtype)                          # (rows, W)
     counts = jnp.where(w_valid[None, :], counts, 0)
     return sums, maxs, mins, counts
 
@@ -418,7 +437,15 @@ def _topn_select_append(
     sel = nz & (v >= thresh[None, :])
     flat = sel.reshape(-1)
     K = rows * W
-    idx = jnp.nonzero(flat, size=sel_cap, fill_value=K)[0]
+    # compact via a stable ARGSORT of the negated mask instead of
+    # jnp.nonzero: sorts run ~0.2ms per million on TPU while nonzero's
+    # lowering measured ~40ms per fire; the first sel_cap positions are
+    # exactly the selected indices in row-major order
+    m = min(K, sel_cap)
+    idx = jnp.argsort(~flat, stable=True)[:m]
+    idx = jnp.where(flat[idx], idx, K)
+    if m < sel_cap:  # tiny grids: pad to the fixed selection shape
+        idx = jnp.concatenate([idx, jnp.full(sel_cap - m, K, idx.dtype)])
     row = jnp.minimum(idx // W, rows - 1).astype(jnp.int32)
     wi = (idx % W).astype(jnp.int32)
     total_sel = jnp.sum(flat).astype(jnp.int32)
@@ -501,11 +528,13 @@ def _ring_append_topn_core(
 
 # fused-step header layout, in i32 words:
 # [0:2]=pane_lo i64, [2:4]=pane_hi i64, [4:6]=anchor i64,
-# [6]=unused, [7]=clear-mask bits (ring<=32), [8:24]=window-end deltas
-# vs pane_lo (sentinel INT32_MIN = padding), [24:]=zero pad — the
+# [6]=unused, [7]=clear-mask bits (ring<=32), [8:8+MIN_FIRE_PAD]=window-
+# end deltas vs pane_lo (sentinel INT32_MIN = padding), then at
+# DEVGEN_HDR_OFF the device-generator params (batch index, dead_below,
+# refire_below as i64), zero pad to FUSED_HDR — the
 # header upload must stay ABOVE the transport's tiny-transfer stall
-# threshold (~100 bytes measured), so 64 words = 256 bytes
-FUSED_HDR = 64
+# threshold (~100 bytes measured); 128 words = 512 bytes
+FUSED_HDR = 128
 _DELTA_SENTINEL = -(2**30)
 
 
@@ -534,21 +563,31 @@ def fused_step_kernel(
     overlap). ref: 4.B/4.D hot paths, dispatched as one program."""
     hdr = buf[:FUSED_HDR]
     pairs = buf[FUSED_HDR:]
+    state = _apply_preagg_u32_core(
+        state, pairs, ring=ring, dump_row=dump_row)
+    return _fused_fire_clear(
+        state, emit_ring, hdr, used_mask, agg=agg,
+        panes_per_window=panes_per_window, ring=ring, sel_cap=sel_cap,
+        by=by, topn=topn)
 
-    def i64_at(i):
-        return lax.bitcast_convert_type(
-            hdr[i:i + 2].reshape(1, 2), jnp.int64)[0]
 
-    pane_lo = i64_at(0)
-    pane_hi = i64_at(2)
-    anchor = i64_at(4)
+def _hdr_i64(hdr: jax.Array, i: int) -> jax.Array:
+    return lax.bitcast_convert_type(
+        hdr[i:i + 2].reshape(1, 2), jnp.int64)[0]
+
+
+def _fused_fire_clear(state, emit_ring, hdr, used_mask, *, agg,
+                      panes_per_window, ring, sel_cap, by, topn):
+    """Shared fire + clear tail of the one-dispatch step kernels: the
+    fire parameters and the purge mask ride the FUSED_HDR header."""
+    pane_lo = _hdr_i64(hdr, 0)
+    pane_hi = _hdr_i64(hdr, 2)
+    anchor = _hdr_i64(hdr, 4)
     clear_word = hdr[7]
     deltas = hdr[8:8 + MIN_FIRE_PAD]
     w_valid = deltas > _DELTA_SENTINEL
     end_panes = jnp.where(w_valid, pane_lo + deltas.astype(jnp.int64),
                           _END_SENTINEL)
-    state = _apply_preagg_u32_core(
-        state, pairs, ring=ring, dump_row=dump_row)
     emit_ring = _ring_append_topn_core(
         state, emit_ring, pane_lo, pane_hi, anchor, end_panes, w_valid,
         used_mask, agg=agg, panes_per_window=panes_per_window, ring=ring,
@@ -562,10 +601,109 @@ def fused_step_kernel(
     return state, emit_ring
 
 
+# refire-candidate bitmap span of the device-generator step (panes
+# above dead_below); configs whose lateness span exceeds this fall back
+# to the host ingest path
+DEVGEN_REFIRE_BITS = 2048
+
+
+def devgen_step_kernel(
+    state: PaneState,
+    emit_ring: jax.Array,
+    buf: jax.Array,        # (FUSED_HDR,) int32 header ONLY — no pairs
+    used_mask: jax.Array,
+    *,
+    gen,                   # traceable (batch_index i64) -> (keys, ts)
+    key_domain: int,       # keys [0, key_domain) map to slot == key
+    agg: LaneAggregate,
+    panes_per_window: int,
+    ring: int,
+    sel_cap: int,
+    by: str,
+    topn: int,
+    dump_row: int,
+    pane_ms: int,
+    offset_ms: int,
+) -> Tuple[PaneState, jax.Array, jax.Array]:
+    """Device-chained generator ingest: ONE dispatch synthesizes the
+    microbatch ON DEVICE, maps keys to slots, segment-sums the panes,
+    fires and clears — zero per-record host work and zero record bytes
+    on the link. This is the chained-source pattern taken to its TPU
+    conclusion (ref: operator chaining elides serialization between
+    chained operators — SURVEY §3.2; flink-connector-datagen as the
+    embedded source): the source lives INSIDE the window operator's
+    step program.
+
+    Key→slot is the DENSE IDENTITY map over the source's declared
+    bounded key domain (KeyDirectory.register_dense): slot must be a
+    pure function of key on device because every alternative measured
+    pathological on this hardware — XLA lowers large gathers at ~20ms
+    per million elements and a 1M-index scatter in SECONDS, while
+    sort/cumsum/segment primitives run ~0.2ms per million. Records
+    outside the domain are EXCLUDED from the apply and counted in the
+    stats output; the host re-synthesizes the batch bit-exactly (the
+    generator contract), registers the new keys, and applies just those
+    records through the pair path. The third output is an int32 stats
+    vector: [n_valid, n_late, n_miss, 0, n_refire, pad...8] ++
+    refire-candidate bitmap over panes [dead_below, dead_below +
+    DEVGEN_REFIRE_BITS)."""
+    hdr = buf[:FUSED_HDR]
+    batch_index = _hdr_i64(hdr, DEVGEN_HDR_OFF)
+    dead_below = _hdr_i64(hdr, DEVGEN_HDR_OFF + 2)
+    refire_below = _hdr_i64(hdr, DEVGEN_HDR_OFF + 4)
+    keys, ts = gen(batch_index)
+    hit = (keys >= 0) & (keys < key_domain)
+    slot = jnp.where(hit, keys, jnp.int64(0))
+    pane = (ts - offset_ms) // pane_ms           # floor div
+    late = hit & (pane < dead_below)
+    miss = ~hit
+    valid = hit & ~late
+    col = pane % ring                            # sign of divisor: >= 0
+    # flat segment-sum, NOT a 2D scatter: XLA lowers a 1M-index
+    # scatter-add serially on TPU (measured seconds/step) while
+    # segment_sum over the flat pane domain runs ~0.2ms per million
+    n_rows = state.counts.shape[0]               # layout slots + dump
+    flat = jnp.where(valid, slot * ring + col,
+                     jnp.int64(dump_row * ring)).astype(jnp.int32)
+    inc = jax.ops.segment_sum(
+        jnp.ones(flat.shape[0], state.counts.dtype), flat,
+        num_segments=n_rows * ring)
+    state = PaneState(sums=state.sums, maxs=state.maxs, mins=state.mins,
+                      counts=state.counts + inc.reshape(n_rows, ring))
+    refire = valid & (pane < refire_below)
+    roff = jnp.where(refire, pane - dead_below,
+                     DEVGEN_REFIRE_BITS).astype(jnp.int32)
+    rbm = jax.ops.segment_sum(
+        jnp.ones_like(roff), roff,
+        num_segments=DEVGEN_REFIRE_BITS + 1)[:DEVGEN_REFIRE_BITS]
+    stats = jnp.concatenate([
+        jnp.stack([valid.sum(), late.sum(), miss.sum(), 0,
+                   refire.sum(), 0, 0, 0]).astype(jnp.int32),
+        (rbm > 0).astype(jnp.int32)])
+    # materialize the ingest before the fire reads it: without the
+    # barrier XLA fuses the segment_sum into the fire path's many
+    # reads of counts and re-evaluates it per read (measured 170ms vs
+    # 0.2ms for the ingest alone)
+    state = PaneState(
+        sums=state.sums, maxs=state.maxs, mins=state.mins,
+        counts=lax.optimization_barrier(state.counts))
+    state, emit_ring = _fused_fire_clear(
+        state, emit_ring, hdr, used_mask, agg=agg,
+        panes_per_window=panes_per_window, ring=ring, sel_cap=sel_cap,
+        by=by, topn=topn)
+    return state, emit_ring, stats
+
+
 _JIT_FUSED_STEP = jax.jit(
     fused_step_kernel,
     static_argnames=("agg", "panes_per_window", "ring", "sel_cap", "by",
                      "topn", "dump_row"),
+    donate_argnums=(0,))
+_JIT_DEVGEN_STEP = jax.jit(
+    devgen_step_kernel,
+    static_argnames=("gen", "key_domain", "agg", "panes_per_window",
+                     "ring", "sel_cap", "by", "topn", "dump_row",
+                     "pane_ms", "offset_ms"),
     donate_argnums=(0,))
 
 
@@ -669,7 +807,11 @@ MAX_FIRE_CHUNK_RING = 16
 # fire params are sentinel-padded to at least this many window ends:
 # sub-100-byte uploads hit the transport's tiny-transfer stall (see
 # clear_kernel), and the padding costs only masked lanes in the kernel
-MIN_FIRE_PAD = 16
+MIN_FIRE_PAD = 64
+# devgen header params (batch_index, dead_below, refire_below as i64)
+# start right after the fire-delta region; must stay inside FUSED_HDR
+DEVGEN_HDR_OFF = 8 + MIN_FIRE_PAD
+assert DEVGEN_HDR_OFF + 6 <= FUSED_HDR
 
 
 def _next_pow2(n: int) -> int:
@@ -958,6 +1100,12 @@ class WindowOperator:
         # by the next advance's single fused dispatch (see
         # fused_step_kernel) or flushed by _flush_stash
         self._stash_u32: Optional[np.ndarray] = None
+        # device-chained generator source (see devgen_step_kernel):
+        # spec, the pending batch index, and in-flight per-step stats
+        # awaiting reconciliation
+        self._devgen_spec = None
+        self._stash_devgen: Optional[Tuple[int, int, int]] = None
+        self._devstats_pending: collections.deque = collections.deque()
         # RLock: the spill+top-n sync path holds it across
         # _fire_ends → drain_ring, and _fire_ends' announce block
         # takes it again (ingest vs drain-thread deque race)
@@ -1741,6 +1889,9 @@ class WindowOperator:
         calls this before the FINAL watermark advance so the flush fires
         dispatch onto an idle device — their emit latency then measures
         fire+fetch, not the whole tail of the ingest pipeline."""
+        self._flush_devgen()
+        if self._devstats_pending:
+            self._reconcile_devstats()
         self._flush_stash()
         while self._inflight:
             ready_wait(self._inflight.popleft())
@@ -1825,6 +1976,7 @@ class WindowOperator:
         whatever live pane aliases those old ring columns into the new
         columns, duplicating data into phantom windows."""
         self._flush_stash()  # stashed pairs are encoded in OLD ring columns
+        self._flush_devgen()  # pending device batch: same ring contract
         old_ring = self.plan.ring
         new_ring = _next_pow2(need + 4)
         lo = self._cleared_below
@@ -1866,6 +2018,12 @@ class WindowOperator:
         if wm < self.watermark or (wm == self.watermark and not self._refire):
             return self._empty()
         taw = time.perf_counter()
+        # device-generated steps whose stats have landed: fold them in
+        # (late accounting, refire scheduling, miss repair) BEFORE this
+        # advance enumerates its fire list; never park behind in-flight
+        # compute unless the backlog exceeds the repair deadline
+        if self._devstats_pending:
+            self._reconcile_devstats(force=False)
         self.state_version += 1
         prev = self.watermark
         self.watermark = wm
@@ -1882,6 +2040,16 @@ class WindowOperator:
         if self._fired_below_end is None or frontier > self._fired_below_end:
             self._fired_below_end = frontier
         self._refire.clear()
+        # device-generated path: the pending batch index + these fires
+        # + the purge ride ONE dispatch whose only upload is the header
+        if self._stash_devgen is not None:
+            if self._stash_u32 is not None:
+                self._flush_stash()  # miss repair stashed host pairs
+            out = self._advance_fused_devgen(wm, ends)
+            if out is not None:
+                self.prof["aw_dispatch"] += time.perf_counter() - taw
+                return out
+            self._flush_devgen()  # fire list overflowed: chunked path
         # fused path: the pending ingest stash + these fires + the purge
         # ride ONE device dispatch with ONE upload
         if (self._stash_u32 is not None and self._fused_step is not None
@@ -1945,12 +2113,12 @@ class WindowOperator:
         self.prof["aw_dispatch"] += time.perf_counter() - taw
         return out
 
-    def _advance_fused(self, wm: int, ends: List[int]) -> Optional["FiredWindows"]:
-        """One-dispatch advance: apply the stashed pair upload, fire up
-        to MIN_FIRE_PAD window ends, and purge dead panes in a single
-        fused program (see fused_step_kernel). Returns None when the
-        fire list overflows the fused window slots — the caller then
-        flushes the stash and takes the chunked path."""
+    def _fused_fill_header(self, wm: int, ends: List[int],
+                           buf: np.ndarray) -> Optional[Tuple[List[int], int]]:
+        """Fill the 64-word fused-step header in place: pane bounds,
+        ring anchor, clear word, fire-end deltas. Returns
+        (fired_ends, cleared_below_after) or None when the fire list
+        overflows the fused window slots."""
         ppw = self.plan.panes_per_window
         if self._max_pane_seen is None:
             ends_f: List[int] = []
@@ -1983,9 +2151,6 @@ class WindowOperator:
         if self._ring_anchor is None:
             self._ring_anchor = lo
         hi_v = self._max_pane_seen if self._max_pane_seen is not None else lo - 1
-        used = self._used_mask_device()
-        buf = self._stash_u32
-        self._stash_u32 = None
         buf[:6] = np.array([lo, hi_v, self._ring_anchor],
                            np.int64).view(np.int32)
         buf[6] = 0
@@ -1994,6 +2159,21 @@ class WindowOperator:
         if ends_f:
             deltas[:len(ends_f)] = np.asarray(ends_f, np.int64) - lo
         buf[8:8 + MIN_FIRE_PAD] = deltas.astype(np.int32)
+        return ends_f, cleared_after
+
+    def _advance_fused(self, wm: int, ends: List[int]) -> Optional["FiredWindows"]:
+        """One-dispatch advance: apply the stashed pair upload, fire up
+        to MIN_FIRE_PAD window ends, and purge dead panes in a single
+        fused program (see fused_step_kernel). Returns None when the
+        fire list overflows the fused window slots — the caller then
+        flushes the stash and takes the chunked path."""
+        buf = self._stash_u32
+        hdr = self._fused_fill_header(wm, ends, buf)
+        if hdr is None:
+            return None
+        ends_f, cleared_after = hdr
+        self._stash_u32 = None
+        used = self._used_mask_device()
         self.state, self._emit_ring = self._fused_step(
             self.state, self._ensure_ring(), jnp.asarray(buf), used,
             sel_cap=self._topn_cap(MIN_FIRE_PAD))
@@ -2003,6 +2183,200 @@ class WindowOperator:
         self._inflight.append(self._emit_ring)
         self._cleared_below = cleared_after
         return self._ring_after_fire(len(ends_f))
+
+    # -- device-chained generator ingest (see devgen_step_kernel) --------
+
+    def attach_device_source(self, spec) -> bool:
+        """Chain a DeviceGeneratorSource into this operator's step
+        program: batches are synthesized on device and never cross the
+        link. Requires the source to declare a bounded key domain — the
+        directory pre-registers it densely so slot == key is a pure
+        function on device (see devgen_step_kernel). Returns False when
+        this operator configuration can't host it — the driver then
+        materializes batches normally."""
+        from flink_tpu.native_codec import NativeHashTable
+
+        if (self._fused_step is None or self._topn is None
+                or self._preagg_lanes != () or self._spill is not None
+                or self.mesh_plan is not None
+                or self.uses_processing_time):
+            return False
+        if not isinstance(self.directory._table, NativeHashTable):
+            return False  # the miss-repair path needs the C probe
+        d = getattr(spec, "key_domain", None)
+        if d is None or d <= 0 or d > self.layout.slots:
+            return False
+        if self.directory.num_keys() == 0:
+            self.directory.register_dense(d)
+        else:
+            # restored/pre-populated directory: the dense identity must
+            # already hold for the WHOLE domain — a strict prefix would
+            # leave slots [num_keys, d) writable by the device kernel
+            # yet unregistered and unclaimed by the allocator
+            if self.directory.num_keys() < d:
+                return False
+            probe = np.arange(d, dtype=np.int64)
+            vals, found = self.directory._table.lookup_keys(probe)
+            if not (found.all() and (vals == probe).all()):
+                return False
+        self._devgen_spec = spec
+        return True
+
+    def process_batch_device(self, batch_index: int) -> bool:
+        """Accept one device-generated batch: validate the gates,
+        pre-grow the ring from the HOST-KNOWN ts bounds (exact — the
+        generator contract is deterministic in the batch index), and
+        stash the index for the next advance's single dispatch.
+        Returns False when a gate closed; the caller falls back to host
+        materialization for this batch."""
+        spec = self._devgen_spec
+        if spec is None or self.plan.ring > 32:
+            return False
+        dead = self._cleared_below
+        refire_below = (self._fired_below_end
+                        if self._fired_below_end is not None
+                        else np.iinfo(np.int64).min)
+        if (refire_below > dead
+                and refire_below - dead > DEVGEN_REFIRE_BITS):
+            return False
+        ts_min, ts_max = spec.ts_bounds(batch_index)
+        pane_ms, off = self.plan.pane_ms, self.plan.offset_ms
+        pmin = (int(ts_min) - off) // pane_ms
+        pmax = (int(ts_max) - off) // pane_ms
+        if pmax < dead:
+            return False  # whole batch past lateness: host path accounts
+        # a pending batch must dispatch against the CURRENT ring layout
+        # before any growth remap below
+        self._flush_devgen()
+        eff_min = max(pmin, dead)
+        prev_min, prev_max = self._min_pane_seen, self._max_pane_seen
+        new_min = eff_min if prev_min is None else min(prev_min, eff_min)
+        new_max = pmax if prev_max is None else max(prev_max, pmax)
+        if new_max - max(dead, new_min) >= self.plan.ring:
+            self._grow_ring(new_max - max(dead, new_min) + 1,
+                            prev_min, prev_max)
+            if self.plan.ring > 32:
+                return False  # outgrew the clear word: host path
+        self.state_version += 1
+        self._min_pane_seen = new_min
+        self._max_pane_seen = new_max
+        self._stash_devgen = (int(batch_index), int(dead),
+                              int(refire_below))
+        if not self.external_throttle:
+            self.throttle()
+        return True
+
+    def _dispatch_devgen(self, buf: np.ndarray, batch_index: int,
+                         dead: int) -> None:
+        by, n = self._topn
+        step = functools.partial(
+            _JIT_DEVGEN_STEP, gen=self._devgen_spec.device_keys_ts,
+            key_domain=int(self._devgen_spec.key_domain),
+            agg=self.agg, panes_per_window=self.plan.panes_per_window,
+            ring=self.plan.ring, by=by, topn=n,
+            dump_row=self.layout.slots, pane_ms=self.plan.pane_ms,
+            offset_ms=self.plan.offset_ms)
+        used = self._used_mask_device()
+        self.state, self._emit_ring, stats = step(
+            self.state, self._ensure_ring(), jnp.asarray(buf), used,
+            sel_cap=self._topn_cap(MIN_FIRE_PAD))
+        if hasattr(stats, "copy_to_host_async"):
+            stats.copy_to_host_async()
+        self._devstats_pending.append((batch_index, dead, stats))
+        self._inflight.append(self._emit_ring)
+
+    def _advance_fused_devgen(self, wm: int,
+                              ends: List[int]) -> Optional["FiredWindows"]:
+        """One-dispatch advance over a device-generated batch:
+        generate + probe + apply + fire + purge in a single program
+        whose only upload is the 512-byte header."""
+        buf = np.zeros(FUSED_HDR, np.int32)
+        hdr = self._fused_fill_header(wm, ends, buf)
+        if hdr is None:
+            return None
+        ends_f, cleared_after = hdr
+        batch_index, dead, refire_below = self._stash_devgen
+        self._stash_devgen = None
+        buf[DEVGEN_HDR_OFF:DEVGEN_HDR_OFF + 6] = np.array(
+            [batch_index, dead, refire_below], np.int64).view(np.int32)
+        self._dispatch_devgen(buf, batch_index, dead)
+        self._cleared_below = cleared_after
+        return self._ring_after_fire(len(ends_f))
+
+    def _flush_devgen(self) -> None:
+        """Dispatch a pending device-generated batch as a fire-less
+        step — every consumer of up-to-date state calls this (snapshots,
+        quiesce, ring growth, the chunked advance path)."""
+        if self._stash_devgen is None:
+            return
+        batch_index, dead, refire_below = self._stash_devgen
+        self._stash_devgen = None
+        lo = (self._cleared_below if self._min_pane_seen is None
+              else max(self._cleared_below, self._min_pane_seen))
+        if self._ring_anchor is None:
+            self._ring_anchor = lo
+        hi_v = (self._max_pane_seen if self._max_pane_seen is not None
+                else lo - 1)
+        buf = np.zeros(FUSED_HDR, np.int32)
+        buf[:6] = np.array([lo, hi_v, self._ring_anchor],
+                           np.int64).view(np.int32)
+        buf[8:8 + MIN_FIRE_PAD] = np.full(MIN_FIRE_PAD, _DELTA_SENTINEL,
+                                          np.int64).astype(np.int32)
+        buf[DEVGEN_HDR_OFF:DEVGEN_HDR_OFF + 6] = np.array(
+            [batch_index, dead, refire_below], np.int64).view(np.int32)
+        self._dispatch_devgen(buf, batch_index, dead)
+
+    # how many un-reconciled device steps may accumulate before an
+    # advance force-blocks on the oldest one's stats: at steady state
+    # the copies land while later batches dispatch, so reconciliation
+    # is a local read; the bound keeps miss repair well inside the
+    # pane ring's lifetime
+    DEVSTATS_MAX_LAG = 2
+
+    def _reconcile_devstats(self, force: bool = True) -> None:
+        """Fold landed device-step stats into host accounting: late
+        drops, directory-FULL drops, refire scheduling — and repair
+        MISSES by re-synthesizing the batch bit-exactly on the host,
+        registering the new keys, and applying just the missed records
+        through the normal ingest path (their windows, if already
+        fired, re-fire with corrected contents — the panes are still
+        alive because reconciliation is bounded to DEVSTATS_MAX_LAG
+        advances after the dispatch, well inside the ring's lifetime).
+
+        ``force=False`` consumes only entries whose announced copy has
+        LANDED (never parks behind in-flight compute — the same rule as
+        the emit-ring drain), except that entries older than
+        DEVSTATS_MAX_LAG block regardless."""
+        while self._devstats_pending:
+            if (not force
+                    and len(self._devstats_pending) <= self.DEVSTATS_MAX_LAG
+                    and not self._devstats_pending[0][2].is_ready()):
+                return
+            batch_index, dead, stats = self._devstats_pending.popleft()
+            arr = np.asarray(stats)
+            n_valid, n_late, n_miss, _unused, n_refire = (
+                int(x) for x in arr[:5])
+            self.late_records += n_late
+            if n_refire:
+                rbm = arr[8:8 + DEVGEN_REFIRE_BITS]
+                late_panes = np.flatnonzero(rbm) + dead
+                self._refire.update(self.plan.late_refire_ends(
+                    late_panes, self._fired_below_end, self.watermark))
+            if n_miss:
+                keys, ts = self._devgen_spec.keys_ts_host(batch_index)
+                out = (keys < 0) | (keys >= self._devgen_spec.key_domain)
+                vals, found = self.directory._table.lookup_keys(
+                    np.ascontiguousarray(keys[out], np.int64))
+                # out-of-domain keys the directory already rejected as
+                # FULL stay dropped — account them loudly (the
+                # default-safe policy); the rest re-apply normally and
+                # register through the ordinary allocation path
+                n_full = int((found & (vals < 0)).sum())
+                if n_full:
+                    account_full_drop(self, n_full)
+                redo = ~(found & (vals < 0))
+                if redo.any():
+                    self.process_batch(keys[out][redo], ts[out][redo], {})
 
     def _ring_after_fire(self, n_ends: int) -> "FiredWindows":
         """Post-fire ring bookkeeping shared by the fused and chunked
@@ -2327,7 +2701,13 @@ class WindowOperator:
         return self._spill.records_spilled if self._spill is not None else 0
 
     def snapshot_state(self) -> Dict[str, Any]:
-        self._flush_stash()  # the snapshot must include stashed records
+        # the snapshot must include stashed records AND every pending
+        # device-step's reconciliation (miss repair may stash pairs,
+        # hence the order: flush devgen → reconcile → flush pairs)
+        self._flush_devgen()
+        if self._devstats_pending:
+            self._reconcile_devstats()
+        self._flush_stash()
         self._resolve_overflow()  # a checkpoint must not hide pending loss
         return {
             "spill": (self._spill.snapshot()
@@ -2389,6 +2769,9 @@ class WindowOperator:
         self._refire = set(snap["refire"])
         self.late_records = snap["late_records"]
         self.records_dropped_full = snap.get("records_dropped_full", 0)
+        # pre-restore device steps are from a dead timeline
+        self._stash_devgen = None
+        self._devstats_pending.clear()
         snap_spill = snap.get("spill")
         if self._spill is not None and snap_spill is not None:
             self._spill.restore(snap_spill)

@@ -692,6 +692,29 @@ class Driver:
                     self._ckpt_pending = None
                 return
 
+    def _maybe_chain_device_source(self, sid: int, n) -> None:
+        """Chain a DeviceGeneratorSource into its consuming window
+        operator when the topology allows it: single downstream window
+        node keyed on the source's key field, single process, and an
+        operator config the devgen kernel can host (the operator's own
+        gate). Any miss falls back to normal host materialization."""
+        from flink_tpu.api.sources import DeviceGeneratorSource
+
+        src = n.source
+        if (not isinstance(src, DeviceGeneratorSource)
+                or src.device_keys_ts is None or self._dcn is not None
+                or len(n.downstream) != 1):
+            return
+        wid = n.downstream[0]
+        wn = self.plan.node(wid)
+        if (wn.kind != "window"
+                or getattr(wn, "key_field", None) != src.key_field):
+            return
+        op = self._ops.get(wid)
+        if op is not None and hasattr(op, "attach_device_source") \
+                and op.attach_device_source(src):
+            self._dev_chains[sid] = wid
+
     def _enumerate_owned(self, sid: int, n_splits: int) -> List[int]:
         """Which split indices THIS runner reads (ref: FLIP-27
         SplitEnumerator on the JM assigning splits to readers — SURVEY
@@ -991,9 +1014,14 @@ class Driver:
         # state stay globally indexed (checkpoints are runner-agnostic).
         srcs = self._srcs = {}
         self._owned_splits: Dict[int, List[int]] = {}
+        # device-chained generator sources: source synthesized inside
+        # the window operator's step program (see DeviceGeneratorSource
+        # + ops/window.py devgen_step_kernel); maps sid -> window nid
+        self._dev_chains: Dict[int, int] = {}
         prefetch = self.config.get(PipelineOptions.SOURCE_PREFETCH)
         for sid in self.plan.sources:
             n = self.plan.node(sid)
+            self._maybe_chain_device_source(sid, n)
             splits = n.source.splits()
             owned = self._enumerate_owned(sid, len(splits))
             self._owned_splits[sid] = owned
@@ -1004,6 +1032,12 @@ class Driver:
                 self._out_wm[sid] = _FINAL
             d = srcs[sid] = {}
             for i in owned:
+                if sid in self._dev_chains:
+                    # no materialization, no feeder thread: the
+                    # iterator yields per-batch metadata markers only
+                    d[i] = _dev_batch_markers(
+                        n.source, self._positions[sid].get(i, 0))
+                    continue
                 it = n.source.open_split(splits[i],
                                          self._positions[sid].get(i, 0))
                 d[i] = (_Prefetcher(it, depth=prefetch)
@@ -1035,6 +1069,35 @@ class Driver:
                     if nxt is None:
                         splits_alive.remove(split_ix)
                         continue
+                    if isinstance(nxt, _DevBatch):
+                        op = self._ops[self._dev_chains[sid]]
+                        with self._link_lock:
+                            pass
+                        t2 = time.perf_counter()
+                        prof["link_lock_wait"] += t2 - t1
+                        with self._push_lock:
+                            ok = op.process_batch_device(nxt.index)
+                            if ok:
+                                self.metrics["records_in"] += nxt.n
+                                self.metrics["batches"] += 1
+                        if ok:
+                            for op2 in self._ops.values():
+                                if hasattr(op2, "throttle"):
+                                    op2.throttle()
+                            prof["push"] += time.perf_counter() - t2
+                            self._positions[sid][split_ix] += 1
+                            self._eps_meter.mark(nxt.n)
+                            mx = nxt.ts_max
+                            self._max_ts[sid] = max(self._max_ts[sid], mx)
+                            self._wm_gens[sid][split_ix].on_batch(mx)
+                            self._wm_lag.set(mx - self._out_wm[sid])
+                            self._check_drain_error()
+                            continue
+                        # a devgen gate closed for this batch (ring
+                        # outgrew the header, oversized lateness span):
+                        # materialize it on the host and push normally
+                        nxt = self.plan.node(sid).source.gen(
+                            "0", nxt.index)
                     data, ts = nxt
                     ts = np.asarray(ts, np.int64)
                     for data_c, ts_c in self._debloat_split(data, ts):
@@ -1456,6 +1519,27 @@ class Driver:
             finally:
                 self._flush_req.clear()
         self._check_drain_error()
+
+
+class _DevBatch:
+    """Per-batch metadata marker of a device-chained generator source:
+    the batch itself is synthesized on the accelerator; the host loop
+    only needs its index, record count, and exact ts bounds (for the
+    watermark clock and metrics)."""
+
+    __slots__ = ("index", "ts_min", "ts_max", "n")
+
+    def __init__(self, index: int, ts_min: int, ts_max: int, n: int):
+        self.index = index
+        self.ts_min = ts_min
+        self.ts_max = ts_max
+        self.n = n
+
+
+def _dev_batch_markers(src, start: int):
+    for i in range(start, src.n_batches):
+        tmin, tmax = src.ts_bounds(i)
+        yield _DevBatch(i, tmin, tmax, src.batch_size)
 
 
 class _Prefetcher:
