@@ -281,21 +281,23 @@ def suite() -> None:
     """Full bench suite (`python bench.py --suite`): every implemented
     BASELINE.json config — one JSON line per config (the driver's
     graded metric remains the default Q5 single line)."""
-    batch = 1 << 18
-    run_wordcount(batch, 4)  # warmup
-    eps0 = run_wordcount(batch, 24)
+    # per-config batch sizes: each workload's sweet spot on this
+    # transport (PROFILE.md §8.2 — bigger batches amortize per-step
+    # relay overheads until a config-specific ceiling)
+    run_wordcount(1 << 20, 4)  # warmup
+    eps0 = run_wordcount(1 << 20, 24)
     print(json.dumps({"metric": "wordcount_tumbling_1s_events_per_sec",
                       "value": round(eps0), "unit": "events/sec/chip"}))
-    run_q7(batch, 4)  # warmup
-    eps7 = run_q7(batch, 24)
+    run_q7(1 << 18, 4)  # warmup
+    eps7 = run_q7(1 << 18, 24)
     print(json.dumps({"metric": "nexmark_q7_highest_bid_events_per_sec",
                       "value": round(eps7), "unit": "events/sec/chip"}))
-    run_q8(batch, 4)  # warmup
-    eps8 = run_q8(batch, 24)
+    run_q8(1 << 18, 4)  # warmup
+    eps8 = run_q8(1 << 18, 24)
     print(json.dumps({"metric": "nexmark_q8_new_users_events_per_sec",
                       "value": round(eps8), "unit": "events/sec/chip"}))
-    run_sessions(batch, 4)  # warmup
-    eps4 = run_sessions(batch, 24)
+    run_sessions(1 << 20, 4)  # warmup
+    eps4 = run_sessions(1 << 20, 12)
     print(json.dumps({"metric": "session_clickstream_events_per_sec",
                       "value": round(eps4), "unit": "events/sec/chip"}))
     main()  # Q5 headline last (its line is the one the driver records)

@@ -370,6 +370,7 @@ def fire_pack_kernel(
     panes_per_window: int,
     ring: int,
     out_cap: int,
+    packed2: bool = False,
 ) -> jax.Array:
     """fire + select + finalize + COMPACT entirely on device, packed
     into ONE int32 buffer so the host pays exactly one transfer per
@@ -393,14 +394,32 @@ def fire_pack_kernel(
     nz = (counts > 0) & used_mask[:, None] & w_valid[None, :]
     flat = nz.reshape(-1)
     k = rows * W
-    idx = jnp.nonzero(flat, size=out_cap, fill_value=k)[0]
+    # stable-argsort compaction instead of jnp.nonzero — identical
+    # semantics (selected indices in row-major order, k-padded), but
+    # sorts run ~0.2ms/M on TPU while nonzero's lowering measured ~40ms
+    m = min(k, out_cap)
+    idx = jnp.argsort(~flat, stable=True)[:m]
+    idx = jnp.where(flat[idx], idx, k)
+    if m < out_cap:
+        idx = jnp.concatenate([idx, jnp.full(out_cap - m, k, idx.dtype)])
     row = jnp.minimum(idx // W, rows - 1).astype(jnp.int32)
     wi = (idx % W).astype(jnp.int32)
     sel_counts = jnp.where(idx < k, counts[row, wi], 0)
     res = agg.finalize(sums[row, wi], maxs[row, wi], mins[row, wi], sel_counts)
     end_delta = (end_panes[wi] - pane_lo).astype(jnp.int32)
-    cols = [row, end_delta, sel_counts.astype(jnp.int32)]
-    for name in sorted(res):
+    if packed2:
+        # count-only 2-column layout: (row << 8 | delta, count) — 8
+        # bytes/row instead of 12; valid when the op's static shape
+        # bounds fit (slots < 2^23, delta < 2^8 — see _fire_packed2).
+        # Egress bytes are the WordCount-family wall, and the transfer
+        # cost is pure host/link budget on the remote-attached chip.
+        cols = [(row << 8) | end_delta, sel_counts.astype(jnp.int32)]
+    else:
+        cols = [row, end_delta, sel_counts.astype(jnp.int32)]
+    for name in ([] if packed2 else sorted(res)):
+        if name == "count":
+            continue  # column 2 already carries it — for count-only
+            # aggregates (WordCount) this is 25% of the egress bytes
         v = res[name].reshape(out_cap)
         if jnp.issubdtype(v.dtype, jnp.integer):
             # integer result lanes (counts) stay exact i32; float lanes
@@ -455,6 +474,8 @@ def _topn_select_append(
     end_delta = (end_panes[wi] - anchor).astype(jnp.int32)
     cols = [row + row_offset, end_delta, sel_counts.astype(jnp.int32)]
     for name in sorted(res_sel):
+        if name == "count":
+            continue  # column 2 already carries it (see fire_pack_kernel)
         u = res_sel[name].reshape(sel_cap)
         if jnp.issubdtype(u.dtype, jnp.integer):
             cols.append(u.astype(jnp.int32))
@@ -758,7 +779,8 @@ _JIT_PREAGG_I32 = jax.jit(
     donate_argnums=(0,))
 _JIT_FIRE_PACK = jax.jit(
     fire_pack_kernel,
-    static_argnames=("agg", "panes_per_window", "ring", "out_cap"))
+    static_argnames=("agg", "panes_per_window", "ring", "out_cap",
+                     "packed2"))
 # NOTE: emit_ring is NOT donated — the drain thread may be fetching the
 # previous ring array concurrently with the next append dispatch, and
 # donation would delete the buffer under that read. The append copies
@@ -1208,6 +1230,7 @@ class WindowOperator:
             agg=self.agg,
             panes_per_window=self.plan.panes_per_window,
             ring=self.plan.ring,
+            packed2=self._fire_packed2(),
         )
         if self._topn is not None:
             by, n = self._topn
@@ -1864,7 +1887,7 @@ class WindowOperator:
         state = self.layout.bytes()
         ring = 0
         if self._topn is not None:
-            cols = 3 + len(self._result_fields())
+            cols = 3 + len(self._pack_fields())
             ring = (self.EMIT_RING_ROWS + 2) * cols * 4
         return state + ring
 
@@ -2444,6 +2467,23 @@ class WindowOperator:
             return self._ring_after_fire(len(ends))
         return FiredWindows(op=self, packs=packs)
 
+    def _fire_packed2(self) -> bool:
+        """Static gate of the 2-column packed fire layout (local
+        path): count-only aggregate, slot ids < 2^23, end deltas < 2^8
+        (delta <= live ring span + panes_per_window). All plan facts —
+        never data-dependent."""
+        return (self.mesh_plan is None and not self._pack_fields()
+                and self.layout.slots < (1 << 23)
+                and self.plan.ring + self.plan.panes_per_window
+                < (1 << 8))
+
+    def _pack_fields(self) -> List[str]:
+        """Result lanes as stored in packed buffers / the emit ring —
+        the result fields MINUS 'count', which always rides the exact
+        i32 column 2 (storing it twice was 25% of WordCount's egress
+        bytes)."""
+        return [f for f in self._result_fields() if f != "count"]
+
     def _result_fields(self) -> List[str]:
         """Sorted result-lane field names — the packed buffer's column
         order past [row, end_delta, count]. MUST mirror
@@ -2465,8 +2505,11 @@ class WindowOperator:
 
     def _decode_packs(self, packs, bufs) -> Dict[str, np.ndarray]:
         """Host-side decode of fetched fire buffers (bitcast lanes,
-        slot → key, pane → window times)."""
-        fields = self._result_fields()
+        slot → key, pane → window times). Each buffer's layout is read
+        from ITS OWN width — decode is lazy (drain thread), and a ring
+        growth between fire dispatch and materialization can flip the
+        op's packed2 gate while 2-column packs are still in flight."""
+        pack_fields = self._pack_fields()
         segs = []  # (buffer_body_slice, lo)
         for (lo, _), buf in zip(packs, bufs):
             if self.mesh_plan is None:
@@ -2480,34 +2523,49 @@ class WindowOperator:
                     n = int(block[0, 0])
                     self._check_fire_cap(n, blk - 1)
                     segs.append((block[1:1 + n], lo))
-        if segs:
-            body = np.concatenate([s for s, _ in segs])
-            lo_col = np.concatenate(
-                [np.full(len(s), lo, np.int64) for s, lo in segs])
+        rows_l, ep_l, cnt_l, lane_l = [], [], [], []
+        for body, lo in segs:
+            if body.shape[1] == 2:   # packed2: (row << 8 | delta, count)
+                rows_l.append(body[:, 0] >> 8)
+                ep_l.append(lo + (body[:, 0] & 0xFF).astype(np.int64))
+                cnt_l.append(body[:, 1])
+                # packed2 is gated to count-only aggs: no extra lanes
+            else:
+                rows_l.append(body[:, 0])
+                ep_l.append(lo + body[:, 1].astype(np.int64))
+                cnt_l.append(body[:, 2])
+                lane_l.append(body[:, 3:])
+        if rows_l:
+            rows = np.concatenate(rows_l)
+            end_pane = np.concatenate(ep_l)
+            count = np.concatenate(cnt_l)
         else:
-            body = np.zeros((0, 3 + len(fields)), np.int32)
-            lo_col = np.zeros(0, np.int64)
-        rows = body[:, 0]
-        end_pane = lo_col + body[:, 1]
+            rows = np.zeros(0, np.int32)
+            end_pane = np.zeros(0, np.int64)
+            count = np.zeros(0, np.int32)
         window_end = end_pane * self.plan.pane_ms + self.plan.offset_ms
         out: Dict[str, np.ndarray] = {
             "key": self.directory.key_of_slots(self._slot_of_rows(rows)),
             "window_start": window_end - self.plan.size_ms,
             "window_end": window_end,
-            "count": body[:, 2],
+            "count": count,
         }
-        for i, k in enumerate(fields):
-            if k == "count":
-                continue  # the exact i32 column beats the bitcast lane
-            col = np.ascontiguousarray(body[:, 3 + i])
-            out[k] = col if self._res_is_int[k] else col.view(np.float32)
+        # "count" rides an exact i32 column; the pack carries only the
+        # OTHER result lanes (see fire_pack_kernel)
+        if pack_fields:
+            lanes = (np.concatenate(lane_l) if lane_l
+                     else np.zeros((0, len(pack_fields)), np.int32))
+            for i, k in enumerate(pack_fields):
+                col = np.ascontiguousarray(lanes[:, i])
+                out[k] = (col if self._res_is_int[k]
+                          else col.view(np.float32))
         return out
 
     def _ensure_ring(self) -> jax.Array:
         """Lazily allocate the device emit ring: row 0 = monotone counter
         head, rows 1..cap = data, last row = scatter dump."""
         if self._emit_ring is None:
-            C = 3 + len(self._result_fields())
+            C = 3 + len(self._pack_fields())
             shape = (self.EMIT_RING_ROWS + 2, C)
             if self.mesh_plan is not None:
                 n_dev = self.mesh_plan.n_devices
@@ -2616,7 +2674,7 @@ class WindowOperator:
                 self._ring_drained = total
             else:
                 self._ring_drained_blocks[d] = total
-        fields = self._result_fields()
+        fields = self._pack_fields()
         if bodies:
             body = np.concatenate(bodies)
         else:
@@ -2631,8 +2689,6 @@ class WindowOperator:
             "count": body[:, 2],
         }
         for i, k in enumerate(fields):
-            if k == "count":
-                continue
             col = np.ascontiguousarray(body[:, 3 + i])
             out[k] = col if self._res_is_int[k] else col.view(np.float32)
         if extras:
