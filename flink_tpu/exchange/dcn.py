@@ -44,9 +44,18 @@ class DcnExchange:
 
     def __init__(self, process_id: int, n_processes: int,
                  listen_port: int = 0,
-                 bind_host: str = "127.0.0.1") -> None:
+                 bind_host: str = "127.0.0.1",
+                 attempt: int = 0) -> None:
         self.pid = process_id
         self.n = n_processes
+        # attempt-epoch fence: the connect handshake carries the
+        # dialer's attempt id and the accept loop rejects mismatches,
+        # so a stale process from a previous attempt can never join the
+        # rendezvous — with coordinator deploys the attempt is baked
+        # into the rendezvous key too; this fence is what protects the
+        # STATIC cluster.dcn-peers mode (ref: Flink fences RPCs with
+        # the fencing token / leader epoch)
+        self.attempt = attempt
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         # loopback by DEFAULT (frames decode through blobformat, whose
@@ -71,11 +80,17 @@ class DcnExchange:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             # a connect-and-close probe (port scan) must not kill the
             # accept thread — the real peer's dial is still coming
-            hello = conn.recv(1)
-            if not hello or hello[0] >= self.n:
+            try:
+                hello = _read_exact(conn, 5)
+            except ConnectionError:
                 conn.close()
                 continue
-            self._in[hello[0]] = conn
+            sender = hello[0]
+            peer_attempt = struct.unpack(">I", hello[1:5])[0]
+            if sender >= self.n or peer_attempt != self.attempt:
+                conn.close()  # stale attempt or bogus peer: fenced out
+                continue
+            self._in[sender] = conn
 
     def connect(self, peers: List[str], timeout_s: float = 30.0) -> None:
         """``peers[j]`` = "host:port" of process j's listener (the entry
@@ -97,7 +112,8 @@ class DcnExchange:
                             f"p{self.pid}: cannot reach peer {j} at {addr}")
                     time.sleep(0.05)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            s.sendall(bytes([self.pid]))
+            s.sendall(bytes([self.pid])
+                      + struct.pack(">I", self.attempt))
             self._out[j] = s
         while len(self._in) < self.n - 1:
             if time.time() > deadline:

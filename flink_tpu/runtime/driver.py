@@ -518,7 +518,8 @@ class Driver:
                     else "127.0.0.1")
         ex = DcnExchange(pid, n,
                          listen_port=int(cfg.get(ClusterOptions.DCN_PORT)),
-                         bind_host=bind)
+                         bind_host=bind,
+                         attempt=int(cfg.get_raw("cluster.attempt", 1)))
         if rendezvous:
             # coordinator-deployed job: publish this process's listener
             # and poll until the whole fleet registered (ref: the

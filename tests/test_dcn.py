@@ -212,6 +212,73 @@ def _collect(tmp, n):
     return sorted(rows)
 
 
+class TestAttemptFencing:
+    def test_stale_attempt_peer_rejected(self):
+        """A process from a PREVIOUS attempt dialing a new attempt's
+        listener must be fenced out at the handshake (the static
+        cluster.dcn-peers mode has no coordinator rendezvous key to
+        protect it — the attempt epoch in the hello is the fence)."""
+        import socket as _socket
+        import struct as _struct
+        import threading
+
+        n = 2
+        fresh = [DcnExchange(i, n, attempt=2) for i in range(n)]
+        peers = [f"127.0.0.1:{e.port}" for e in fresh]
+
+        # stale dialer (attempt 1) connects first and must NOT occupy
+        # peer slot 1
+        stale = _socket.create_connection(("127.0.0.1", fresh[0].port))
+        stale.sendall(bytes([1]) + _struct.pack(">I", 1))
+        time.sleep(0.1)
+
+        done = []
+
+        def run(i):
+            fresh[i].connect(peers, timeout_s=10)
+            payloads, metas = fresh[i].exchange(
+                {}, {"from": i, "attempt": 2})
+            done.append((i, [m.get("from") for m in metas]))
+
+        ths = [threading.Thread(target=run, args=(i,))
+               for i in range(n)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=20)
+        assert len(done) == 2
+        for i, froms in sorted(done):
+            assert froms == [0, 1]  # the REAL peers, not the stale one
+        # the stale connection was closed by the fence
+        stale.settimeout(2)
+        assert stale.recv(1) == b""
+        for e in fresh:
+            e.close()
+        stale.close()
+
+    def test_same_attempt_connects(self):
+        import threading
+
+        n = 2
+        exs = [DcnExchange(i, n, attempt=7) for i in range(n)]
+        peers = [f"127.0.0.1:{e.port}" for e in exs]
+        out = []
+
+        def run(i):
+            exs[i].connect(peers, timeout_s=10)
+            p, m = exs[i].exchange({}, {"pid": i})
+            out.append([mm.get("pid") for mm in m])
+
+        ths = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=20)
+        assert out == [[0, 1], [0, 1]]
+        for e in exs:
+            e.close()
+
+
 class TestTier5TwoProcessQ5:
     def test_two_process_q5_matches_single_process(self, tmp_path):
         """Q5-shaped job over 2 processes: the union of both processes'
