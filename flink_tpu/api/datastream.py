@@ -212,6 +212,24 @@ class KeyedStream(DataStream):
         for the documented batching semantics."""
         return CountWindowedStream(self, size, purge=True)
 
+    def running_aggregate(self, agg,
+                          name: str = "running_agg") -> "DataStream":
+        """Unwindowed keyed running aggregation emitting an UPSERT
+        stream: each microbatch emits updated (key, aggregates) rows
+        for every key it touched, each row replacing the previous one
+        for its key (ref: table-runtime GroupAggFunction — the
+        retract/changelog model degenerated to upserts for insert-only
+        input; see ops/global_agg.py). Materialize latest-by-key with
+        ``UpsertSink``."""
+        from flink_tpu.graph.transformations import (
+            GlobalAggregateTransformation)
+
+        kt = self.transform
+        t = GlobalAggregateTransformation(
+            name, (kt,), aggregate=agg, key_field=kt.key_field)
+        self.env._register(t)
+        return DataStream(self.env, t)
+
     def process(self, fn: Any, name: str = "keyed_process") -> "DataStream":
         """General keyed processing with state + timers (ref: KeyedStream
         .process(KeyedProcessFunction)). ``fn`` implements

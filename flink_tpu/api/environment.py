@@ -93,6 +93,9 @@ class StreamExecutionEnvironment:
             self._transforms if transforms is None else transforms,
             self.config, self._watermark_strategy)
         driver = Driver(plan, self.config, mesh_plan=self.build_mesh_plan())
+        # live-metrics seam: the cluster runner reads this driver's
+        # counters for heartbeat-carried job metrics (web UI gauges)
+        self._driver = driver
         return driver.run(job_name, cancel=cancel,
                           savepoint_request=savepoint_request)
 

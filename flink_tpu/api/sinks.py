@@ -12,7 +12,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -92,6 +92,26 @@ class FnSink(Sink):
 
     def write(self, batch: Dict[str, np.ndarray]) -> None:
         self.fn(batch)
+
+
+@dataclasses.dataclass
+class UpsertSink(Sink):
+    """Materialize an UPSERT stream as latest-row-by-key (ref: the
+    upsert-kafka/table sink contract for changelog streams without
+    DELETEs — each arriving row replaces the previous row with the
+    same key tuple). ``view()`` returns the current table."""
+
+    key_fields: Tuple[str, ...] = ("key",)
+    state: Dict[Any, Dict[str, Any]] = dataclasses.field(
+        default_factory=dict)
+
+    def write(self, batch: Dict[str, np.ndarray]) -> None:
+        for row in rows_of(batch):
+            k = tuple(row[f] for f in self.key_fields)
+            self.state[k] = row
+
+    def view(self) -> List[Dict[str, Any]]:
+        return list(self.state.values())
 
 
 @dataclasses.dataclass

@@ -21,14 +21,16 @@ reference's full NFA):
   skipped), with vectorized ``where`` predicates per stage;
 - ``within(ms)``: a partial older than the window resets (the event
   that broke it may immediately start a new partial);
-- after-match skipping: SKIP_PAST_LAST_EVENT — each event belongs to
-  at most one match, matches never overlap (deterministic; the
-  reference's default NO_SKIP enumerates overlapping matches, which
-  requires the exponential partial-match buffers this design
-  deliberately trades away);
-- one active partial per key (greedy earliest): no simultaneous
-  alternative partials. A failed strict transition re-tests the
-  breaking event against stage 0.
+- after-match skipping: SKIP_PAST_LAST_EVENT (default — each event
+  belongs to at most one match, matches never overlap) or
+  ``after_match("NO_SKIP")`` — overlapping matches enumerated from a
+  BOUNDED per-key partial buffer (``max_partials`` columns, loud
+  overflow; linear patterns only — quantified patterns with NO_SKIP
+  would need the reference's exponential SharedBuffer branch
+  enumeration and are refused at build);
+- default mode keeps one active partial per key (greedy earliest): no
+  simultaneous alternative partials. A failed strict transition
+  re-tests the breaking event against stage 0.
 
 Matches emit one row per completed pattern: key, ``<stage>_ts`` per
 stage, and the match's start/end timestamps.
@@ -58,9 +60,11 @@ class Pattern:
     """Fluent pattern builder (ref: cep/pattern/Pattern.java)."""
 
     def __init__(self, stages: Tuple[_Stage, ...],
-                 within_ms: Optional[int] = None):
+                 within_ms: Optional[int] = None,
+                 after_match_mode: str = "SKIP_PAST_LAST_EVENT"):
         self._stages = stages
         self.within_ms = within_ms
+        self.after_match_mode = after_match_mode
 
     @classmethod
     def begin(cls, name: str) -> "Pattern":
@@ -72,20 +76,35 @@ class Pattern:
         last = self._stages[-1]
         return Pattern(self._stages[:-1]
                        + (_Stage(last.name, pred, last.strict),),
-                       self.within_ms)
+                       self.within_ms, self.after_match_mode)
 
     def next(self, name: str) -> "Pattern":
         """STRICT contiguity: the key's immediately-next event."""
         return Pattern(self._stages + (_Stage(name, None, strict=True),),
-                       self.within_ms)
+                       self.within_ms, self.after_match_mode)
 
     def followed_by(self, name: str) -> "Pattern":
         """RELAXED contiguity: later event, intervening ones skipped."""
         return Pattern(self._stages + (_Stage(name, None, strict=False),),
-                       self.within_ms)
+                       self.within_ms, self.after_match_mode)
 
     def within(self, ms: int) -> "Pattern":
-        return Pattern(self._stages, int(ms))
+        return Pattern(self._stages, int(ms), self.after_match_mode)
+
+    def after_match(self, mode: str) -> "Pattern":
+        """After-match skip strategy (ref: cep/nfa/aftermatch/
+        AfterMatchSkipStrategy): SKIP_PAST_LAST_EVENT (default —
+        deterministic, each event in at most one match) or NO_SKIP
+        (the reference default — overlapping matches enumerated from a
+        BOUNDED per-key partial buffer, cap + loud overflow; linear
+        patterns only — quantifiers with NO_SKIP are refused at build
+        because the branch enumeration is exactly the exponential
+        SharedBuffer this design trades away)."""
+        if mode not in ("SKIP_PAST_LAST_EVENT", "NO_SKIP"):
+            raise ValueError(
+                f"after_match mode {mode!r}: supported modes are "
+                "SKIP_PAST_LAST_EVENT and NO_SKIP")
+        return Pattern(self._stages, self.within_ms, mode)
 
     # -- quantifiers (ref: cep/pattern/Quantifier.java) -----------------
 
@@ -104,7 +123,7 @@ class Pattern:
                 f"stage {last.name!r} already has a quantifier")
         return Pattern(self._stages[:-1]
                        + (dataclasses.replace(last, times=n),),
-                       self.within_ms)
+                       self.within_ms, self.after_match_mode)
 
     def one_or_more(self) -> "Pattern":
         """GREEDY unbounded repetition of the most recent stage
@@ -125,7 +144,7 @@ class Pattern:
                 f"stage {last.name!r} already has a quantifier")
         return Pattern(self._stages[:-1]
                        + (dataclasses.replace(last, loop=True),),
-                       self.within_ms)
+                       self.within_ms, self.after_match_mode)
 
     def optional(self) -> "Pattern":
         """The most recent stage may be absent: when an event matches
@@ -138,7 +157,7 @@ class Pattern:
                 f"stage {last.name!r} already has a quantifier")
         return Pattern(self._stages[:-1]
                        + (dataclasses.replace(last, optional=True),),
-                       self.within_ms)
+                       self.within_ms, self.after_match_mode)
 
     @property
     def stages(self) -> Tuple[_Stage, ...]:
@@ -222,6 +241,24 @@ class CepOperator:
         self.records_dropped_full = 0
         self.state_version = 0
         self._matches: List[Dict[str, np.ndarray]] = []
+        # NO_SKIP: a BOUNDED partial-match buffer per key — the
+        # SharedBuffer role (ref: cep/nfa/sharedbuffer) capped at
+        # ``max_partials`` columns with loud overflow. Linear patterns
+        # only: quantifiers would need branch enumeration (the
+        # exponential part this design refuses).
+        self.no_skip = pattern.after_match_mode == "NO_SKIP"
+        self.max_partials = 8
+        if self.no_skip:
+            if self._is_loop.any() or self._is_opt.any():
+                raise NotImplementedError(
+                    "after_match('NO_SKIP') supports linear patterns "
+                    "(next/followed_by/times) only; one_or_more and "
+                    "optional need the exponential branch enumeration "
+                    "of the reference's SharedBuffer — use the default "
+                    "SKIP_PAST_LAST_EVENT for quantified patterns")
+            P = self.max_partials
+            self.p_stage = np.full((cap, P), -1, np.int8)
+            self.p_ts = np.zeros((cap, P, self.S), np.int64)
 
     # -- data plane ------------------------------------------------------
 
@@ -269,6 +306,10 @@ class CepOperator:
         rank = np.arange(len(sl)) - np.maximum.accumulate(
             np.where(run_start, np.arange(len(sl)), 0))
         max_rank = int(rank.max()) + 1
+
+        if self.no_skip:
+            self._steps_no_skip(sl, tt, kk, pr, rank, max_rank)
+            return
 
         within = self.pattern.within_ms
         strict = np.array([s.strict for s in self.stages], bool)
@@ -358,6 +399,77 @@ class CepOperator:
             self.stage[s_r] = new_stage.astype(np.int32)
             self._last_ts[s_r] = t_r
 
+    def _steps_no_skip(self, sl, tt, kk, pr, rank, max_rank) -> None:
+        """NO_SKIP rank-step engine: every key advances ALL its live
+        partials on each event at once (vectorized over keys × the
+        bounded partial axis), and an event matching stage 0 also
+        SPAWNS a fresh partial — overlapping matches enumerate across
+        partials. Per partial the take is greedy (the operator's
+        documented determinism trade); across partials the overlap
+        semantics match the reference's NO_SKIP for linear patterns."""
+        S, P = self.S, self.max_partials
+        within = self.pattern.within_ms
+        strict = np.array([s.strict for s in self.stages], bool)
+        for r in range(max_rank):
+            m = rank == r
+            s_r = sl[m]
+            t_r = tt[m]
+            p_r = pr[:, m]                     # (S, k)
+            k = len(s_r)
+            ar = np.arange(k)
+            st = self.p_stage[s_r].astype(np.int32)   # (k, P)
+            act = st >= 0
+            if within is not None and act.any():
+                exp = act & (t_r[:, None] - self.p_ts[s_r, :, 0] > within)
+                st = np.where(exp, -1, st)
+                act = st >= 0
+            stc = np.clip(st, 0, S - 1)
+            hit = p_r.T[ar[:, None], stc] & act       # (k, P)
+            died = act & ~hit & strict[stc] & (st > 0)
+            adv = act & hit
+            ii, pp = np.nonzero(adv)
+            if len(ii):
+                self.p_ts[s_r[ii], pp, stc[ii, pp]] = t_r[ii]
+            st = np.where(adv, st + 1, np.where(died, -1, st))
+            compl = st >= S
+            if compl.any():
+                ci, cp = np.nonzero(compl)
+                row = {"key": kk[m][ci],
+                       "match_start": self.p_ts[s_r[ci], cp, 0].copy(),
+                       "match_end": t_r[ci].copy()}
+                for si, stg in enumerate(self.stages):
+                    row[f"{stg.name}_ts"] = self.p_ts[
+                        s_r[ci], cp, si].copy()
+                self._matches.append(row)
+                st = np.where(compl, -1, st)
+            # spawn: stage-0 match starts a NEW partial (even when the
+            # same event extended others — the overlap contract)
+            want = p_r[0]
+            if want.any():
+                free = st < 0
+                has_free = free.any(axis=1)
+                over = want & ~has_free
+                if over.any():
+                    raise RuntimeError(
+                        f"CEP NO_SKIP partial-buffer overflow: a key "
+                        f"exceeded {P} simultaneous partial matches "
+                        "(cep max_partials); narrow the begin-stage "
+                        "predicate, add within(), or use "
+                        "SKIP_PAST_LAST_EVENT")
+                ff = np.argmax(free, axis=1)
+                wi = np.nonzero(want)[0]
+                if S == 1:
+                    self._matches.append({
+                        "key": kk[m][wi],
+                        "match_start": t_r[wi].copy(),
+                        "match_end": t_r[wi].copy(),
+                        f"{self.stages[0].name}_ts": t_r[wi].copy()})
+                else:
+                    st[wi, ff[wi]] = 1
+                    self.p_ts[s_r[wi], ff[wi], 0] = t_r[wi]
+            self.p_stage[s_r] = st.astype(np.int8)
+            self._last_ts[s_r] = t_r
+
     def take_fired(self):
         from flink_tpu.ops.window import FiredWindows
 
@@ -401,6 +513,8 @@ class CepOperator:
             "late_records": self.late_records,
             "records_dropped_full": self.records_dropped_full,
             "last_ts": self._last_ts.copy(),
+            "p_stage": (self.p_stage.copy() if self.no_skip else None),
+            "p_ts": (self.p_ts.copy() if self.no_skip else None),
         }
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
@@ -417,6 +531,9 @@ class CepOperator:
         self.late_records = snap["late_records"]
         self.records_dropped_full = snap["records_dropped_full"]
         self._last_ts = np.array(snap["last_ts"])
+        if self.no_skip and snap.get("p_stage") is not None:
+            self.p_stage = np.array(snap["p_stage"])
+            self.p_ts = np.array(snap["p_ts"])
         self._matches = []
 
 

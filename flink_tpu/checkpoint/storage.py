@@ -71,13 +71,18 @@ class ReusedOpState:
     version: int
 
 
+class StaleCheckpointWriter(RuntimeError):
+    """A deposed leader's writer tried to persist after a successor
+    (higher epoch) already wrote — the write was fenced off."""
+
+
 class FsCheckpointStorage:
     """All storage I/O goes through the FileSystem seam (flink_tpu.fs)
     — the checkpoint dir may live on any registered scheme (ref:
     FsCheckpointStorage resolving its path via FileSystem.get)."""
 
     def __init__(self, root: str, job_id: str, retained: int = 3,
-                 compression: str = "none") -> None:
+                 compression: str = "none", epoch: int = 0) -> None:
         if compression not in ("none", "zlib"):
             raise ValueError(
                 f"compression must be 'none' or 'zlib', got {compression!r}")
@@ -85,9 +90,41 @@ class FsCheckpointStorage:
         self.job_id = job_id
         self.retained = max(1, retained)
         self.compression = compression
+        # leader-epoch fence (ref: the HA fencing token on RPCs, applied
+        # to STORAGE writes): a deposed leader's in-flight persist must
+        # not clobber a successor's checkpoints. Manifests record the
+        # writer's epoch; any write aborts when the store already holds
+        # a manifest from a HIGHER epoch. 0 = unfenced single-writer
+        # (local driver without HA).
+        self.epoch = epoch
         self.fs: FileSystem = get_filesystem(root)
         self.job_dir = os.path.join(root, job_id)
         self.fs.mkdirs(self.job_dir)
+
+    def set_epoch(self, epoch: int) -> None:
+        """Adopt the leader epoch granted by the election (coordinator
+        HA); all subsequent writes carry and check it."""
+        self.epoch = epoch
+
+    def _check_fence(self) -> None:
+        """Abort the write when ANY completed manifest carries a higher
+        epoch — this writer has been deposed and its snapshot belongs
+        to a dead timeline. Check-then-rename is not atomic; the lease
+        interval bounds the race the same way it bounds RPC fencing."""
+        if self.epoch == 0:
+            return
+        for h in self.list_complete():
+            try:
+                with self.fs.open_read(
+                        os.path.join(h.path, "MANIFEST.json")) as f:
+                    m = json.loads(f.read().decode())
+            except Exception:
+                continue
+            if int(m.get("epoch", 0)) > self.epoch:
+                raise StaleCheckpointWriter(
+                    f"checkpoint write fenced: store holds epoch "
+                    f"{m.get('epoch')} > this writer's {self.epoch} "
+                    f"(deposed leader finishing late)")
 
     def _dir(self, checkpoint_id: int, savepoint: bool) -> str:
         prefix = "savepoint" if savepoint else "chk"
@@ -127,7 +164,13 @@ class FsCheckpointStorage:
                 "format_version": 3,
                 "layout": "single",
                 "compression": self.compression,
+                "epoch": self.epoch,
             }).encode())
+        try:
+            self._check_fence()
+        except StaleCheckpointWriter:
+            self.fs.delete(tmp, recursive=True)
+            raise
         if self.fs.exists(d):
             self.fs.delete(d, recursive=True)
         self.fs.rename(tmp, d)
@@ -176,7 +219,13 @@ class FsCheckpointStorage:
                 "compression": self.compression,
                 "ops": {nid: {"file": fn, "version": versions[nid]}
                         for nid, fn in op_files.items()},
+                "epoch": self.epoch,
             }).encode())
+        try:
+            self._check_fence()
+        except StaleCheckpointWriter:
+            self.fs.delete(tmp, recursive=True)
+            raise
         if self.fs.exists(d):
             self.fs.delete(d, recursive=True)
         self.fs.rename(tmp, d)
@@ -189,6 +238,12 @@ class FsCheckpointStorage:
     def list_complete(self) -> List[CheckpointHandle]:
         out = []
         for name in self.fs.listdir(self.job_dir):
+            if ".inprogress." in name:
+                # an unrenamed writer dir is NOT complete even though
+                # its manifest file exists inside (manifest-last only
+                # holds for the FINAL name; a fenced/abandoned writer
+                # leaves its tmp behind)
+                continue
             d = os.path.join(self.job_dir, name)
             mf = os.path.join(d, "MANIFEST.json")
             if not self.fs.exists(mf):

@@ -28,6 +28,7 @@ from flink_tpu.graph.transformations import (
     AsyncIOTransformation,
     CepTransformation,
     CountWindowAggregateTransformation,
+    GlobalAggregateTransformation,
     KeyedProcessTransformation,
     PartitionTransformation,
     SessionAggregateTransformation,
@@ -181,6 +182,11 @@ def compile_job(
         elif isinstance(t, CountWindowAggregateTransformation):
             up = node_for(t.inputs[0])
             n = new_node("count_window", t.name, window_transform=t,
+                         key_field=t.key_field)
+            nodes[up].downstream.append(n.id)
+        elif isinstance(t, GlobalAggregateTransformation):
+            up = node_for(t.inputs[0])
+            n = new_node("global_agg", t.name, window_transform=t,
                          key_field=t.key_field)
             nodes[up].downstream.append(n.id)
         elif isinstance(t, SessionAggregateTransformation):

@@ -167,6 +167,17 @@ class CountWindowAggregateTransformation(Transformation):
 
 
 @dataclasses.dataclass(eq=False)
+class GlobalAggregateTransformation(Transformation):
+    """Unwindowed keyed running aggregation emitting an upsert stream
+    (ref: table-runtime GroupAggFunction / retract-changelog semantics
+    degenerated to upserts for insert-only input — see
+    ops/global_agg.py)."""
+
+    aggregate: Optional[LaneAggregate] = None
+    key_field: str = "key"
+
+
+@dataclasses.dataclass(eq=False)
 class WindowJoinTransformation(Transformation):
     """Two-input tumbling-window equi-join (ref: streaming/api/datastream/
     JoinedStreams.java lowered onto WindowOperator with a union state;

@@ -116,6 +116,17 @@ class RestServer:
                     if st.get("state") == "UNKNOWN":
                         return 404, {"error": f"no job {parts[1]}"}
                     return 200, {"job_id": parts[1], **st}
+                if (len(parts) == 3 and parts[0] == "jobs"
+                        and parts[2] == "graph"):
+                    # physical DAG + live metrics for the web UI (ref:
+                    # the REST job vertices/backpressure endpoints)
+                    g = self._call("execution_graph", job_id=parts[1])
+                    if not g.get("found"):
+                        return 404, {"error": f"no job {parts[1]}"}
+                    st = self._call("job_status", job_id=parts[1])
+                    g["state"] = st.get("state")
+                    g["metrics"] = st.get("metrics")
+                    return 200, g
                 if parts == ["taskmanagers"]:
                     return 200, {"taskmanagers": self._call("list_runners")}
                 if parts == ["traces"]:
@@ -161,28 +172,95 @@ class RestServer:
             return 500, {"error": str(e)}
 
     def _index_html(self) -> str:
-        esc = html_mod.escape
-        jobs = self._call("list_jobs")["jobs"]
-        runners = self._call("list_runners")
-        rows = "".join(
-            f"<tr><td>{esc(str(j['job_id']))}</td><td>{esc(j['state'])}</td>"
-            f"<td>{j['attempts']}</td>"
-            f"<td>{esc(', '.join(map(str, j['runners'])))}</td></tr>"
-            for j in jobs)
-        rrows = "".join(
-            f"<tr><td>{esc(str(rid))}</td>"
-            f"<td>{'alive' if r['alive'] else 'lost'}</td>"
-            f"<td>{r['n_devices']}</td></tr>" for rid, r in runners.items())
-        return (
-            "<html><head><title>flink_tpu</title></head><body>"
-            "<h1>flink_tpu cluster</h1>"
-            "<h2>Jobs</h2><table border=1><tr><th>id</th><th>state</th>"
-            f"<th>attempts</th><th>runners</th></tr>{rows}</table>"
-            "<h2>Runners</h2><table border=1><tr><th>id</th><th>status</th>"
-            f"<th>devices</th></tr>{rrows}</table>"
-            "<p>REST: /overview /jobs /jobs/&lt;id&gt; /taskmanagers</p>"
-            "</body></html>")
+        """The web UI: one static page, no framework, no build step —
+        JS fetches /jobs, /jobs/<id>/graph and /taskmanagers every 2s
+        and renders the job DAG (stage chain with per-stage execution
+        state), throughput/backpressure gauges, and checkpoint history
+        (ref: the Flink web dashboard job graph + backpressure tab,
+        rendered from the same REST the CLI uses)."""
+        return _UI_HTML
 
     def close(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+
+
+_UI_HTML = """<!DOCTYPE html>
+<html><head><title>flink_tpu</title><style>
+body{font-family:system-ui,sans-serif;margin:24px;background:#fafafa}
+h1{font-size:20px} h2{font-size:15px;margin:18px 0 6px}
+table{border-collapse:collapse;font-size:13px}
+td,th{border:1px solid #ccc;padding:3px 8px;text-align:left}
+.dag{display:flex;align-items:center;flex-wrap:wrap;margin:6px 0}
+.stage{border:1.5px solid #555;border-radius:6px;padding:6px 10px;
+  margin:3px;background:#fff;font-size:12px;min-width:110px}
+.stage .nm{font-weight:600}
+.arrow{margin:0 4px;color:#888;font-size:16px}
+.RUNNING{border-color:#2a7} .FAILED{border-color:#c33}
+.FINISHED{border-color:#57c} .CANCELED{border-color:#999}
+.gauge{display:inline-block;width:120px;height:10px;background:#eee;
+  border-radius:5px;overflow:hidden;vertical-align:middle}
+.gauge i{display:block;height:100%;background:#e80}
+.kv{font-size:12px;color:#333;margin:2px 0}
+</style></head><body>
+<h1>flink_tpu cluster</h1>
+<div id="jobs"></div>
+<h2>Runners</h2><div id="runners"></div>
+<p style="font-size:11px;color:#777">REST: /overview /jobs
+/jobs/&lt;id&gt; /jobs/&lt;id&gt;/graph /taskmanagers — refreshes every 2s</p>
+<script>
+async function j(u){const r=await fetch(u);return r.json()}
+function esc(x){return String(x).replace(/[&<>"']/g,
+  c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]))}
+function fmtB(n){if(n<0||n==null)return"-";
+  return n>1e6?(n/1e6).toFixed(1)+" MB":(n/1e3).toFixed(0)+" KB"}
+async function tick(){
+  const jobs=(await j("/jobs")).jobs||[];
+  let html="";
+  for(const jb of jobs){
+    const g=await j("/jobs/"+encodeURIComponent(jb.job_id)+"/graph");
+    const m=g.metrics||{};
+    html+="<h2>job "+esc(jb.job_id)+" — "+esc(g.state||jb.state)+
+      " (attempt "+jb.attempts+")</h2>";
+    const stages=(g.vertices||[]).reduce((a,v)=>{
+      (a[v.stage]=a[v.stage]||[]).push(v);return a},{});
+    const names=g.stages||Object.keys(stages);
+    html+='<div class="dag">';
+    names.forEach((s,i)=>{
+      const vs=stages[s]||[];
+      const at=vs.length?(vs[0].attempts||vs[0].executions||[]):[];
+      const st=at.length?at[at.length-1].state:"?";
+      html+='<div class="stage '+esc(st)+'"><div class="nm">'+esc(s)+
+        '</div><div>'+vs.length+"&times; "+esc(st)+'</div></div>';
+      if(i<names.length-1)html+='<span class="arrow">&#8594;</span>';
+    });
+    html+="</div>";
+    if(m&&m.eps!=null){
+      const bp=Math.min(100,Math.round(m.backpressure_pct||0));
+      html+='<div class="kv">throughput: <b>'+
+        (m.eps>1e6?(m.eps/1e6).toFixed(2)+"M":Math.round(m.eps))+
+        ' rec/s</b> &nbsp; records in/out: '+m.records_in+"/"+
+        m.records_out+' &nbsp; watermark lag: '+
+        Math.round(m.wm_lag_ms||0)+'ms</div>';
+      html+='<div class="kv">backpressure: <span class="gauge">'+
+        '<i style="width:'+bp+'%"></i></span> '+bp+"%</div>";
+      if(m.checkpoints&&m.checkpoints.length){
+        html+="<table><tr><th>checkpoint</th><th>time</th>"+
+          "<th>size</th></tr>"+m.checkpoints.map(c=>
+          "<tr><td>chk-"+c.id+"</td><td>"+
+          new Date(c.ts).toLocaleTimeString()+"</td><td>"+
+          fmtB(c.bytes)+"</td></tr>").join("")+"</table>";
+      }
+    }
+  }
+  if(!jobs.length)html="<p>no jobs</p>";
+  document.getElementById("jobs").innerHTML=html;
+  const rs=(await j("/taskmanagers")).taskmanagers||{};
+  document.getElementById("runners").innerHTML=
+    "<table><tr><th>id</th><th>status</th><th>devices</th></tr>"+
+    Object.entries(rs).map(([id,r])=>"<tr><td>"+esc(id)+"</td><td>"+
+      (r.alive?"alive":"lost")+"</td><td>"+r.n_devices+
+      "</td></tr>").join("")+"</table>";
+}
+tick();setInterval(tick,2000);
+</script></body></html>"""

@@ -1,0 +1,173 @@
+"""Unwindowed keyed running aggregation — the UPSERT/changelog path.
+
+ref: table/runtime aggregate/GroupAggFunction + the retract/changelog
+stream model (SURVEY §3.8): `SELECT k, agg FROM t GROUP BY k` with no
+window emits an ever-updating result per key. For INSERT-ONLY input
+(the streaming source contract here) the changelog degenerates to an
+UPSERT stream — each emitted row REPLACES the previous row for its
+key, and no DELETE/retraction records are needed. Sinks consume it
+either raw (`FnSink` sees every upsert — the kafka-upsert shape) or
+materialized (`UpsertSink` keeps latest-by-key).
+
+TPU-first shape: per-key accumulators live in flat host arrays behind
+the same KeyDirectory slot map the pane backend uses; a batch folds in
+with one argsort + reduceat per lane (no per-record Python), and the
+upserts emitted per microbatch are exactly the keys the batch touched
+— the mini-batch aggregation emission model (ref: table-runtime
+MiniBatchGroupAggFunction).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from flink_tpu.ops.window import FiredWindows, account_full_drop
+from flink_tpu.state.keyed import KeyDirectory
+from flink_tpu.time.watermarks import LONG_MIN
+
+
+class GlobalAggregateOperator:
+    """Driver-protocol operator: per-step upsert emission via
+    ``take_fired`` (the count_window/process emission pattern)."""
+
+    def __init__(self, agg, *, num_shards: int,
+                 slots_per_shard: int) -> None:
+        self.agg = agg
+        self.directory = KeyDirectory(num_shards, slots_per_shard)
+        n = self.directory.local_slots
+        self.counts = np.zeros(n, np.int64)
+        self.sums = np.zeros((n, agg.sum_width), np.float64)
+        self.maxs = np.full((n, agg.max_width), -np.inf, np.float32)
+        self.mins = np.full((n, agg.min_width), np.inf, np.float32)
+        self.watermark = LONG_MIN
+        self.late_records = 0          # unwindowed: nothing is late
+        self.records_dropped_full = 0
+        self.allow_drops = False
+        self.state_version = 0
+        self._touched: Optional[np.ndarray] = None
+
+    # -- data plane ------------------------------------------------------
+
+    def process_batch(self, keys, ts, data: Dict[str, np.ndarray],
+                      valid=None) -> None:
+        self.state_version += 1
+        keys = np.asarray(keys, np.int64)
+        valid = (np.ones(len(keys), bool) if valid is None
+                 else np.asarray(valid, bool))
+        if not valid.any():
+            return
+        keys = keys[valid]
+        data = {k: np.asarray(v)[valid] for k, v in data.items()}
+        slots = self.directory.assign(keys)
+        bad = slots < 0
+        if bad.any():
+            account_full_drop(self, int(bad.sum()))
+            keys, slots = keys[~bad], slots[~bad]
+            data = {k: v[~bad] for k, v in data.items()}
+            if not len(keys):
+                return
+        order = np.argsort(slots, kind="stable")
+        so = slots[order]
+        bnd = np.empty(len(so), bool)
+        bnd[0] = True
+        bnd[1:] = so[1:] != so[:-1]
+        starts = np.nonzero(bnd)[0]
+        uslots = so[starts]
+        self.counts[uslots] += np.add.reduceat(
+            np.ones(len(so), np.int64), starts)
+        if self.agg.sum_width or self.agg.max_width or self.agg.min_width:
+            s_l, mx_l, mn_l = self.agg.lift_masked(
+                {k: v[order] for k, v in data.items()},
+                np.ones(len(so), bool))
+            s_l, mx_l, mn_l = (np.asarray(s_l), np.asarray(mx_l),
+                               np.asarray(mn_l))
+            if self.agg.sum_width:
+                self.sums[uslots] += np.add.reduceat(s_l, starts, axis=0)
+            if self.agg.max_width:
+                self.maxs[uslots] = np.maximum(
+                    self.maxs[uslots],
+                    np.maximum.reduceat(mx_l, starts, axis=0))
+            if self.agg.min_width:
+                self.mins[uslots] = np.minimum(
+                    self.mins[uslots],
+                    np.minimum.reduceat(mn_l, starts, axis=0))
+        self._touched = (uslots if self._touched is None
+                         else np.union1d(self._touched, uslots))
+
+    def take_fired(self) -> Optional["FiredWindows"]:
+        """Emit the upsert rows for every key this step touched."""
+        if self._touched is None or not len(self._touched):
+            self._touched = None
+            return None
+        sl = self._touched
+        self._touched = None
+        res = self.agg.finalize(
+            self.sums[sl].astype(np.float32), self.maxs[sl],
+            self.mins[sl], self.counts[sl])
+        out: Dict[str, np.ndarray] = {
+            "key": self.directory.key_of_slots(sl)}
+        out["count"] = self.counts[sl]
+        for k, v in res.items():
+            out[k] = np.asarray(v)
+        # upserts carry the emission-time watermark as their timestamp
+        # (the process-function emission contract, driver _emit_fired)
+        wm = self.watermark if self.watermark != LONG_MIN else 0
+        out["__ts__"] = np.full(len(sl), wm, np.int64)
+        return FiredWindows(data=out)
+
+    # -- time plane ------------------------------------------------------
+
+    def advance_watermark(self, wm: int):
+        if wm > self.watermark:
+            self.watermark = wm
+        return FiredWindows(data=dict(self._empty()))
+
+    def _empty(self) -> Dict[str, np.ndarray]:
+        res = self.agg.finalize(
+            np.zeros((0, self.agg.sum_width), np.float32),
+            np.zeros((0, self.agg.max_width), np.float32),
+            np.zeros((0, self.agg.min_width), np.float32),
+            np.zeros(0, np.int64))
+        out = {"key": np.zeros(0, np.int64),
+               "count": np.zeros(0, np.int64)}
+        for k, v in res.items():
+            out[k] = np.asarray(v)
+        return out
+
+    def final_watermark(self) -> int:
+        return self.watermark if self.watermark != LONG_MIN else 0
+
+    def quiesce(self) -> None:
+        pass
+
+    def throttle(self) -> None:
+        pass
+
+    # -- snapshot seam ---------------------------------------------------
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        return {
+            "kind": "global_agg",
+            "directory": self.directory.snapshot(),
+            "counts": self.counts.copy(),
+            "sums": self.sums.copy(),
+            "maxs": self.maxs.copy(),
+            "mins": self.mins.copy(),
+            "watermark": self.watermark,
+            "records_dropped_full": self.records_dropped_full,
+        }
+
+    def restore_state(self, snap: Dict[str, Any]) -> None:
+        self.directory = KeyDirectory.restore(
+            self.directory.num_shards, self.directory.slots_per_shard,
+            snap["directory"],
+            (self.directory.shard_lo, self.directory.shard_hi))
+        self.counts = np.asarray(snap["counts"]).copy()
+        self.sums = np.asarray(snap["sums"]).copy()
+        self.maxs = np.asarray(snap["maxs"]).copy()
+        self.mins = np.asarray(snap["mins"]).copy()
+        self.watermark = snap["watermark"]
+        self.records_dropped_full = snap.get("records_dropped_full", 0)
+        self._touched = None

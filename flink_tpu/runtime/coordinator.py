@@ -74,6 +74,8 @@ class JobInfo:
     finished_runners: List[str] = dataclasses.field(default_factory=list)
     # physical graph: stages × parallelism, per-attempt execution states
     egraph: Optional[ExecutionGraph] = None
+    # newest heartbeat-carried driver metrics (web UI gauges)
+    last_metrics: Optional[Dict[str, Any]] = None
 
 
 class JobCoordinator(RpcEndpoint):
@@ -193,6 +195,15 @@ class JobCoordinator(RpcEndpoint):
                 return {"known": False}  # re-register (coordinator restarted)
             r.last_heartbeat = time.time()
             r.alive = True
+            for jid, m in (metrics or {}).items():
+                jm = self.jobs.get(jid)
+                # same zombie fence as the revocation below: a runner
+                # this job is no longer assigned to must not repaint
+                # the live attempt's metrics
+                if (jm is not None and jid in (jobs or [])
+                        and runner_id in jm.assigned_runners):
+                    jm.last_metrics = {**m, "runner": runner_id,
+                                       "stamp": time.time()}
             for job_id in jobs or []:
                 j = self.jobs.get(job_id)
                 # RESTARTING revokes too: the coordinator already
@@ -346,12 +357,15 @@ class JobCoordinator(RpcEndpoint):
             push_targets = targets if targets is not None else [target]
             for i, t in enumerate(push_targets):
                 pconf = dict(config)
+                # the attempt epoch fences the driver's checkpoint
+                # STORAGE writes (FsCheckpointStorage._check_fence):
+                # every deploy carries it, not just cross-host ones
+                pconf["cluster.attempt"] = attempt
                 if targets is not None:
                     # per-process identity; the exchange ports
                     # rendezvous through rpc_dcn_register/peers
                     pconf["cluster.process-id"] = i
                     pconf["cluster.dcn-rendezvous"] = "coordinator"
-                    pconf["cluster.attempt"] = attempt
                     pconf.setdefault("source.enumeration", "local")
                 c = RpcClient(t.host, t.port, timeout_s=5.0)
                 try:
@@ -393,7 +407,8 @@ class JobCoordinator(RpcEndpoint):
                 return {"state": "UNKNOWN"}
             return {"state": j.state, "attempts": j.attempts,
                     "failure": j.failure,
-                    "last_savepoint": getattr(j, "last_savepoint", None)}
+                    "last_savepoint": getattr(j, "last_savepoint", None),
+                    "metrics": getattr(j, "last_metrics", None)}
 
     def _job_runners_locked(self, j: "JobInfo") -> List["RunnerInfo"]:
         """Reachable gateways of a job's assigned runners (one policy

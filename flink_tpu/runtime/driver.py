@@ -259,6 +259,17 @@ class Driver:
                 self._ops[n.id] = CountWindowOperator(
                     t.aggregate, t.size, purge=t.purge,
                     num_shards=num_shards, slots_per_shard=slots)
+            elif n.kind == "global_agg":
+                from flink_tpu.ops.global_agg import GlobalAggregateOperator
+
+                if self.mesh_plan is not None:
+                    raise NotImplementedError(
+                        "unwindowed aggregation on a device mesh is not "
+                        "yet supported; run without cluster.mesh-devices")
+                t = n.window_transform
+                self._ops[n.id] = GlobalAggregateOperator(
+                    t.aggregate, num_shards=num_shards,
+                    slots_per_shard=slots)
             elif n.kind == "session":
                 from flink_tpu.ops.session import SessionOperator
 
@@ -319,7 +330,12 @@ class Driver:
             self.config.get(CheckpointingOptions.DIRECTORY),
             job_id=job_name.replace("/", "_"),
             retained=self.config.get(CheckpointingOptions.RETAINED),
-            compression=self.config.get(CheckpointingOptions.COMPRESSION))
+            compression=self.config.get(CheckpointingOptions.COMPRESSION),
+            # coordinator-deployed attempts fence storage writes on the
+            # attempt epoch: a deposed attempt's in-flight persist must
+            # not clobber its successor's checkpoints (see
+            # FsCheckpointStorage._check_fence); 0 = local unfenced
+            epoch=int(self.config.get_raw("cluster.attempt", 0)))
         return CheckpointCoordinator(storage)
 
     def _snapshot(self, allow_reuse: bool = True) -> Dict[str, Any]:
@@ -1225,6 +1241,36 @@ class Driver:
         return JobResult(job_name, final)
 
     # -- data plane ------------------------------------------------------
+    def live_metrics(self) -> Dict[str, Any]:
+        """Racy-read live counters for the heartbeat-carried job
+        metrics (cluster web UI gauges; ref: the TaskManager metric
+        report feeding the REST vertices/backpressure endpoints)."""
+        tw = sum(getattr(op, "prof", {}).get("pb_throttle_wait", 0.0)
+                 for op in self._ops.values())
+        now = time.perf_counter()
+        last_t, last_w = getattr(self, "_lm_prev", (now - 1e-9, tw))
+        self._lm_prev = (now, tw)
+        # DELTA busy fraction since the previous sample — a cumulative
+        # counter over heartbeat age would peg at 100% forever
+        bp = max(0.0, min(1.0, (tw - last_w) / max(now - last_t, 1e-9)))
+        out: Dict[str, Any] = {
+            "records_in": int(self.metrics.get("records_in", 0)),
+            "records_out": int(self.metrics.get("records_out", 0)),
+            "fired_windows": int(self.metrics.get("fired_windows", 0)),
+            "eps": round(self._eps_meter.rate, 1),
+            "wm_lag_ms": float(getattr(self._wm_lag, "value", 0.0) or 0),
+            "backpressure_pct": round(100 * bp),
+        }
+        if self._coordinator is not None:
+            # in-memory stats, NOT a storage listing: this runs on the
+            # heartbeat thread every beat — filesystem I/O here could
+            # stall liveness on a slow checkpoint store
+            out["checkpoints"] = [
+                {"id": st.checkpoint_id, "ts": st.trigger_ts_ms,
+                 "bytes": st.size_bytes}
+                for st in self._coordinator.stats[-3:]]
+        return out
+
     def _push_downstream(self, nid: int, batch: Batch) -> None:
         for d in self.plan.node(nid).downstream:
             self._push(d, batch, from_node=nid)
@@ -1265,14 +1311,14 @@ class Driver:
                         if np.asarray(v).dtype != object}
             op.process_batch(ts, dev_data, valid)
         elif n.kind in ("window", "session", "count_window", "process",
-                        "cep", "evicting_window"):
+                        "cep", "evicting_window", "global_agg"):
             op = self._ops[nid]
             keys = np.asarray(data[n.key_field], np.int64)
             dev_data = {k: v for k, v in data.items()
                         if np.asarray(v).dtype != object}
             op.process_batch(keys, ts, dev_data, valid)
             if n.kind in ("count_window", "process", "cep",
-                          "evicting_window"):
+                          "evicting_window", "global_agg"):
                 # these emit per-step, not (only) per-watermark
                 fired = op.take_fired()
                 if fired is not None:

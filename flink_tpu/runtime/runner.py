@@ -127,8 +127,17 @@ class TaskRunner(RpcEndpoint):
             try:
                 with self._lock:
                     running = list(self._jobs)
+                    recs = dict(self._jobs)
+                metrics = {}
+                for jid, jrec in recs.items():
+                    drv = getattr(jrec.get("env"), "_driver", None)
+                    if drv is not None:
+                        try:
+                            metrics[jid] = drv.live_metrics()
+                        except Exception:  # noqa: BLE001 racy reads
+                            pass
                 r = self._coord.call("heartbeat", runner_id=self.runner_id,
-                                     jobs=running)
+                                     jobs=running, metrics=metrics)
                 misses = 0
                 # revocation: jobs the coordinator no longer considers
                 # ours (reassigned after a false-positive loss, or
@@ -312,6 +321,7 @@ class TaskRunner(RpcEndpoint):
                 f"{self._coord_addr[0]}:{self._coord_addr[1]}")
             env = StreamExecutionEnvironment(Configuration(config))
             build(env)
+            rec["env"] = env  # live-metrics seam for heartbeats
             self._report_plan(job_id, env)
             env.execute(job_id, cancel=cancel,
                         savepoint_request=rec.get("savepoint"))

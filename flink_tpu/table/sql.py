@@ -614,16 +614,52 @@ def _plan_join(t_env: "TableEnvironment", q: Query) -> "Table":
     return table
 
 
+def _plan_running_aggregate(q: Query, table: "Table", group_cols,
+                            calls, plain) -> "Table":
+    """`SELECT k, agg FROM t GROUP BY k` with NO window TVF: the
+    canonical streaming-SQL shape emitting updates. Lowers onto
+    KeyedStream.running_aggregate (ops/global_agg.py) — an UPSERT
+    stream where each row replaces the previous result for its key
+    (ref: table-runtime GroupAggFunction; retract/changelog semantics
+    degenerate to upserts for insert-only input). Materialize with
+    ``UpsertSink(key_fields=...)``."""
+    from flink_tpu.ops import aggregates
+    from flink_tpu.table.api import finish_projection
+
+    if q.order_by is not None or q.limit is not None:
+        raise SqlError(
+            "ORDER BY/LIMIT over an unwindowed aggregation would need "
+            "a continuously re-ranked changelog; use a window TVF")
+    if q.having is not None:
+        raise SqlError(
+            "HAVING over an unwindowed aggregation needs DELETE "
+            "records (a key can leave the predicate); filter the "
+            "upsert view at the sink, or use a window TVF")
+    if len(group_cols) != 1:
+        raise SqlError(
+            "an unwindowed aggregate needs exactly one grouping "
+            f"column in v1; got {group_cols}")
+    uniq = {}
+    for c in calls:
+        uniq.setdefault((c.fn, c.field), c)
+    lanes = [c.build() for c in uniq.values()]
+    lane = lanes[0] if len(lanes) == 1 else aggregates.multi(*lanes)
+    key = group_cols[0]
+    agg_stream = table.stream.key_by(key).running_aggregate(lane)
+    pairs = [(c.runtime_field, c.out_name) for c in calls]
+    want = plain + [c.out_name for c in calls]
+    return finish_projection(table.t_env, agg_stream, pairs,
+                             key if key in plain else None, want)
+
+
 def _plan_aggregate(q: Query, table: "Table",
                     wdef) -> "Table":
-    if wdef is None:
-        raise SqlError(
-            "aggregate queries need a window TVF source — "
-            "FROM TABLE(TUMBLE/HOP/SESSION(TABLE t, DESCRIPTOR(ts), "
-            "...)) (non-windowed streaming GROUP BY needs retraction "
-            "semantics, not in v1)")
     group_cols = [g for g in q.group_by
                   if g not in ("window_start", "window_end")]
+    if wdef is None and any(
+            g in ("window_start", "window_end") for g in q.group_by):
+        raise SqlError(
+            "window_start/window_end grouping needs a window TVF source")
     if len(group_cols) > 1:
         raise SqlError(
             f"v1 supports one non-window grouping column; got "
@@ -672,13 +708,16 @@ def _plan_aggregate(q: Query, table: "Table",
         # plain aggregate argument alongside the derived columns
         keep = list(dict.fromkeys(
             group_cols
-            + [q.source.time_col]
+            + ([q.source.time_col] if wdef is not None else [])
             + [c.field for c in calls
                if isinstance(c.field, str)
                and not c.field.startswith("__agg_expr_")]))
         sels = [Col(k).alias(k) for k in keep]
         sels += [e.alias(name) for name, e in derived]
         table = table.select(*sels)
+    if wdef is None:
+        return _plan_running_aggregate(q, table, group_cols, calls,
+                                       plain)
     gt = (table.window(wdef).group_by(*q.group_by)
           if q.group_by else table.window(wdef).group_by())
     want = plain + [c.out_name for c in calls]

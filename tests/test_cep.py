@@ -365,3 +365,131 @@ def feed_events(op, events):
     fields = {f: np.asarray([e[2][f] for e in events])
               for f in events[0][2]}
     op.process_batch(keys, ts, fields)
+
+
+class TestNoSkip:
+    """after_match('NO_SKIP'): overlapping-match enumeration from the
+    bounded per-key partial buffer (ref: AfterMatchSkipStrategy.noSkip
+    + the SharedBuffer role, capped with loud overflow)."""
+
+    @staticmethod
+    def _run(pattern, keys, ts, fields=None):
+        op = CepOperator(pattern, num_shards=4, slots_per_shard=64)
+        op.process_batch(np.asarray(keys, np.int64),
+                         np.asarray(ts, np.int64), fields or {})
+        f = op.take_fired()
+        if f is None:
+            return []
+        d = dict(f)
+        return sorted(zip([int(x) for x in d["key"]],
+                          [int(x) for x in d["match_start"]],
+                          [int(x) for x in d["match_end"]]))
+
+    @staticmethod
+    def _oracle(stages, keys, ts, fields, within=None):
+        """Independent scalar enumeration of the SAME semantics:
+        per-key partial list; every event advances each live partial
+        (greedy take; strict miss kills), and a stage-0 match spawns a
+        new partial."""
+        from collections import defaultdict
+        parts = defaultdict(list)  # key -> list of [stage, [ts...]]
+        out = []
+        order = np.lexsort((ts, keys))
+        for i in order:
+            k, t = int(keys[i]), int(ts[i])
+            ev = {f: v[i] for f, v in fields.items()}
+            hits = [bool(np.asarray(st.where(
+                {f: np.asarray([v]) for f, v in ev.items()}))[0])
+                for st in stages]
+            nxt = []
+            for stage_i, tss in parts[k]:
+                if within is not None and t - tss[0] > within:
+                    continue  # expired partial dies
+                if hits[stage_i]:
+                    tss = tss + [t]
+                    if stage_i + 1 == len(stages):
+                        out.append((k, tss[0], t))
+                        continue
+                    nxt.append([stage_i + 1, tss])
+                elif stages[stage_i].strict:
+                    continue  # strict miss kills the partial
+                else:
+                    nxt.append([stage_i, tss])
+            if hits[0]:
+                if len(stages) == 1:
+                    out.append((k, t, t))
+                else:
+                    nxt.append([1, [t]])
+            parts[k] = nxt
+        return sorted(out)
+
+    def test_overlapping_matches_enumerated(self):
+        # a a b with followed_by: BOTH partials complete on b
+        p = (Pattern.begin("a").where(lambda d: d["v"] == 0)
+             .followed_by("b").where(lambda d: d["v"] == 1)
+             .after_match("NO_SKIP"))
+        got = self._run(p, [1, 1, 1], [10, 20, 30],
+                        {"v": np.array([0, 0, 1])})
+        assert got == [(1, 10, 30), (1, 20, 30)]
+
+    def test_strict_kills_only_that_partial(self):
+        # a1 a2 b with next(): a1's partial dies on a2; a2's completes
+        p = (Pattern.begin("a").where(lambda d: d["v"] == 0)
+             .next("b").where(lambda d: d["v"] == 1)
+             .after_match("NO_SKIP"))
+        got = self._run(p, [1, 1, 1], [10, 20, 30],
+                        {"v": np.array([0, 0, 1])})
+        assert got == [(1, 20, 30)]
+
+    def test_property_vs_oracle(self):
+        rng = np.random.default_rng(11)
+        p = (Pattern.begin("a").where(lambda d: d["v"] % 3 == 0)
+             .followed_by("b").where(lambda d: d["v"] % 3 == 1)
+             .followed_by("c").where(lambda d: d["v"] % 3 == 2)
+             .within(40)
+             .after_match("NO_SKIP"))
+        keys = rng.integers(0, 5, 200)
+        ts = np.sort(rng.integers(0, 400, 200))
+        v = rng.integers(0, 9, 200)
+        got = self._run(p, keys, ts, {"v": v})
+        want = self._oracle(p.stages, keys, ts, {"v": v}, within=40)
+        assert got == want
+        assert len(got) > 0
+
+    def test_overflow_is_loud(self):
+        p = (Pattern.begin("a").where(lambda d: d["v"] >= 0)
+             .followed_by("b").where(lambda d: d["v"] < 0)
+             .after_match("NO_SKIP"))
+        op = CepOperator(p, num_shards=4, slots_per_shard=64)
+        with pytest.raises(RuntimeError, match="partial-buffer overflow"):
+            # 9 consecutive stage-0 matches with no completions > cap 8
+            op.process_batch(np.ones(9, np.int64),
+                             np.arange(9, dtype=np.int64),
+                             {"v": np.zeros(9, np.int64)})
+
+    def test_quantifiers_refused(self):
+        p = (Pattern.begin("a").where(lambda d: d["v"] == 0)
+             .followed_by("b").where(lambda d: d["v"] == 1).one_or_more()
+             .followed_by("c").where(lambda d: d["v"] == 2)
+             .after_match("NO_SKIP"))
+        with pytest.raises(NotImplementedError, match="NO_SKIP"):
+            CepOperator(p, num_shards=4, slots_per_shard=64)
+
+    def test_snapshot_restore_carries_partials(self):
+        p = (Pattern.begin("a").where(lambda d: d["v"] == 0)
+             .followed_by("b").where(lambda d: d["v"] == 1)
+             .after_match("NO_SKIP"))
+
+        def mk():
+            return CepOperator(p, num_shards=4, slots_per_shard=64)
+
+        a = mk()
+        a.process_batch(np.array([1, 1]), np.array([10, 20]),
+                        {"v": np.array([0, 0])})
+        snap = a.snapshot_state()
+        b = mk()
+        b.restore_state(snap)
+        b.process_batch(np.array([1]), np.array([30]),
+                        {"v": np.array([1])})
+        d = dict(b.take_fired())
+        assert sorted(int(x) for x in d["match_start"]) == [10, 20]

@@ -1,0 +1,189 @@
+"""Unwindowed keyed running aggregation — the upsert/changelog path
+(ops/global_agg.py; ref: table-runtime GroupAggFunction + the
+retract/changelog stream model, SURVEY §3.8, degenerated to upserts
+for insert-only input)."""
+import numpy as np
+import pytest
+
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.api.sinks import FnSink, UpsertSink
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.config import Configuration
+from flink_tpu.ops import aggregates
+from flink_tpu.table.api import TableEnvironment
+from flink_tpu.table.sql import SqlError
+
+
+def _env(extra=None):
+    return StreamExecutionEnvironment(Configuration({
+        "state.num-key-shards": 8, "state.slots-per-shard": 64,
+        "pipeline.microbatch-size": 100, **(extra or {})}))
+
+
+def _data(n=1000, nk=20, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, nk, n).astype(np.int64),
+            rng.random(n).astype(np.float32),
+            np.arange(n, dtype=np.int64))
+
+
+def _oracle(k, v):
+    out = {}
+    for kk, vv in zip(k, v):
+        c, s, mx = out.get(int(kk), (0, 0.0, -np.inf))
+        out[int(kk)] = (c + 1, s + float(vv), max(mx, float(vv)))
+    return out
+
+
+class TestSqlUnwindowed:
+    def test_group_by_without_window_upserts(self):
+        env = _env()
+        t_env = TableEnvironment.create(env)
+        k, v, ts = _data()
+        stream = env.from_collection({"k": k, "v": v}, ts, batch_size=100)
+        t_env.create_temporary_view(
+            "t", stream, schema=["k", "v", "ts"], time_attr="ts")
+        tbl = t_env.sql_query(
+            "SELECT k, COUNT(*) AS c, SUM(v) AS sv, MAX(v) AS mv "
+            "FROM t GROUP BY k")
+        sink = UpsertSink(key_fields=("k",))
+        tbl.stream.add_sink(sink)
+        env.execute("running-sql")
+        want = _oracle(k, v)
+        got = {int(r["k"]): (int(r["c"]), float(r["sv"]), float(r["mv"]))
+               for r in sink.view()}
+        assert set(got) == set(want)
+        for kk in want:
+            assert got[kk][0] == want[kk][0]
+            assert got[kk][1] == pytest.approx(want[kk][1], rel=1e-3)
+            assert got[kk][2] == pytest.approx(want[kk][2], rel=1e-5)
+
+    def test_upsert_stream_supersedes(self):
+        # the RAW stream carries multiple rows per key; the LAST row
+        # per key equals the final aggregate — the upsert contract
+        env = _env()
+        t_env = TableEnvironment.create(env)
+        k, v, ts = _data()
+        stream = env.from_collection({"k": k, "v": v}, ts, batch_size=100)
+        t_env.create_temporary_view(
+            "t", stream, schema=["k", "v", "ts"], time_attr="ts")
+        tbl = t_env.sql_query("SELECT k, COUNT(*) AS c FROM t GROUP BY k")
+        rows = []
+        tbl.stream.add_sink(FnSink(rows.append))
+        env.execute("upserts")
+        seen = {}
+        total_rows = 0
+        for b in rows:
+            for kk, c in zip(b["k"], b["c"]):
+                seen[int(kk)] = int(c)
+                total_rows += 1
+        want = _oracle(k, v)
+        assert total_rows > len(want)  # genuinely a changelog
+        assert seen == {kk: c for kk, (c, _, _) in want.items()}
+
+    def test_refusals(self):
+        env = _env()
+        t_env = TableEnvironment.create(env)
+        k, v, ts = _data()
+        stream = env.from_collection({"k": k, "v": v}, ts, batch_size=100)
+        t_env.create_temporary_view(
+            "t", stream, schema=["k", "v", "ts"], time_attr="ts")
+        with pytest.raises(SqlError, match="HAVING"):
+            t_env.sql_query(
+                "SELECT k, COUNT(*) AS c FROM t GROUP BY k HAVING c > 2")
+        with pytest.raises(SqlError, match="ORDER BY"):
+            t_env.sql_query(
+                "SELECT k, COUNT(*) AS c FROM t GROUP BY k "
+                "ORDER BY c DESC LIMIT 3")
+
+
+class TestDataStreamRunning:
+    def test_running_aggregate_api(self):
+        env = _env()
+        k, v, ts = _data(seed=3)
+        sink = UpsertSink(key_fields=("key",))
+        (env.from_collection({"k": k, "v": v}, ts, batch_size=100)
+            .key_by("k")
+            .running_aggregate(aggregates.multi(
+                aggregates.count(), aggregates.min_of("v")))
+            .add_sink(sink))
+        env.execute("running-ds")
+        want = {}
+        for kk, vv in zip(k, v):
+            c, mn = want.get(int(kk), (0, np.inf))
+            want[int(kk)] = (c + 1, min(mn, float(vv)))
+        got = {int(r["key"]): (int(r["count"]), float(r["min_v"]))
+               for r in sink.view()}
+        assert set(got) == set(want)
+        for kk in want:
+            assert got[kk][0] == want[kk][0]
+            assert got[kk][1] == pytest.approx(want[kk][1], rel=1e-5)
+
+
+class TestExactlyOnceRestore:
+    def test_crash_resume_final_view_exact(self, tmp_path):
+        n_batches, B, nk = 10, 256, 16
+        all_k, all_v = [], []
+
+        def gen(split, i):
+            if i >= n_batches:
+                return None
+            r = np.random.default_rng(40 + i)
+            kk = r.integers(0, nk, B).astype(np.int64)
+            vv = r.random(B).astype(np.float32)
+            return ({"k": kk, "v": vv},
+                    (i * B + np.arange(B)).astype(np.int64))
+
+        # oracle over the whole stream
+        for i in range(n_batches):
+            r = np.random.default_rng(40 + i)
+            all_k.append(r.integers(0, nk, B).astype(np.int64))
+            all_v.append(r.random(B).astype(np.float32))
+        want = _oracle(np.concatenate(all_k), np.concatenate(all_v))
+
+        base = {
+            "state.num-key-shards": 8, "state.slots-per-shard": 64,
+            "pipeline.microbatch-size": B,
+            "state.checkpoints.dir": str(tmp_path / "ck"),
+        }
+
+        class Boom(Exception):
+            pass
+
+        sink = UpsertSink(key_fields=("key",))
+        seen = [0]
+
+        def poison(b):
+            sink.write(b)
+            seen[0] += 1
+            if seen[0] == 4:
+                raise Boom()
+
+        env = StreamExecutionEnvironment(Configuration({
+            **base, "execution.checkpointing.interval": "1ms"}))
+        (env.from_source(GeneratorSource(gen),
+                         WatermarkStrategy.for_monotonous_timestamps())
+            .key_by("k")
+            .running_aggregate(aggregates.multi(
+                aggregates.count(), aggregates.sum_of("v")))
+            .add_sink(FnSink(poison)))
+        with pytest.raises(Exception):
+            env.execute("crash")
+
+        env2 = StreamExecutionEnvironment(Configuration({
+            **base, "execution.checkpointing.restore": "latest"}))
+        (env2.from_source(GeneratorSource(gen),
+                          WatermarkStrategy.for_monotonous_timestamps())
+             .key_by("k")
+             .running_aggregate(aggregates.multi(
+                 aggregates.count(), aggregates.sum_of("v")))
+             .add_sink(FnSink(sink.write)))
+        env2.execute("resume")
+        got = {int(r["key"]): (int(r["count"]), float(r["sum_v"]))
+               for r in sink.view()}
+        assert set(got) == set(want)
+        for kk in want:
+            assert got[kk][0] == want[kk][0], kk
+            assert got[kk][1] == pytest.approx(want[kk][1], rel=1e-3)

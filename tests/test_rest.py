@@ -77,12 +77,17 @@ class TestRest:
         assert code == 409 and not body["ok"]
 
     def test_html_index(self, cluster):
+        # client-rendered dashboard: the page ships the fetch/render
+        # logic; the DATA arrives from the JSON routes it polls
         coord, rest = cluster
         coord.rpc_submit_job("job-d")
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{rest.port}/") as r:
             html = r.read().decode()
-        assert "job-d" in html and "flink_tpu" in html
+        assert "flink_tpu" in html and "/graph" in html
+        code, jobs = req(rest, "GET", "/jobs")
+        assert code == 200
+        assert any(j["job_id"] == "job-d" for j in jobs["jobs"])
 
     def test_unknown_route_404(self, cluster):
         _, rest = cluster
@@ -102,8 +107,11 @@ class TestRest:
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{rest.port}/") as r:
             html = r.read().decode()
+        # the page never embeds job ids server-side; every client-side
+        # interpolation routes through the esc() helper
         assert "<script>alert" not in html
-        assert "&lt;script&gt;" in html
+        assert "function esc(" in html
+        assert "esc(jb.job_id)" in html
 
     def test_dispatch_through_rpc_server(self):
         """REST fronted by the RpcServer rides its single dispatch
@@ -121,3 +129,19 @@ class TestRest:
             rest.close()
             srv.close()
             coord.close()
+
+
+class TestJobGraphRoute:
+    def test_graph_route_serves_dag_and_metrics(self, cluster):
+        coord, rest = cluster
+        coord.rpc_submit_job("job-g")
+        coord.rpc_report_plan("job-g", ["source", "window", "sink"])
+        coord.jobs["job-g"].last_metrics = {
+            "eps": 123.0, "records_in": 10, "records_out": 5,
+            "wm_lag_ms": 7, "backpressure_s": 0.1,
+            "checkpoints": [{"id": 1, "ts": 0, "bytes": 100}]}
+        code, g = req(rest, "GET", "/jobs/job-g/graph")
+        assert code == 200
+        assert g["stages"] == ["source", "window", "sink"]
+        assert g["metrics"]["eps"] == 123.0
+        assert g["metrics"]["checkpoints"][0]["id"] == 1

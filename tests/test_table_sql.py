@@ -233,9 +233,11 @@ class TestTableApi:
         t_env.create_temporary_view(
             "bids", stream, schema=["auction", "price", "ts"],
             time_attr="ts")
-        with pytest.raises(SqlError, match="window"):
-            t_env.sql_query(
-                "SELECT auction, COUNT(*) FROM bids GROUP BY auction")
+        # unwindowed GROUP BY now PLANS (the upsert/changelog path —
+        # tests/test_global_agg.py covers its semantics)
+        t = t_env.sql_query(
+            "SELECT auction, COUNT(*) AS c FROM bids GROUP BY auction")
+        assert t.schema.columns == ("auction", "c")
         with pytest.raises(SqlError, match="one non-window"):
             t_env.sql_query(
                 "SELECT COUNT(*) FROM TABLE(TUMBLE(TABLE bids, "
