@@ -169,7 +169,8 @@ def bid_stream_device(cfg: NexmarkConfig) -> "DeviceGeneratorSource":
         gen=host.gen, device_keys_ts=device_keys_ts,
         keys_ts_host=keys_ts_host, ts_bounds=ts_bounds,
         key_field="auction", batch_size=b, n_batches=cfg.n_batches,
-        key_domain=cfg.num_active_auctions)
+        # multiply-shift range reduction: auction < n_auctions ALWAYS
+        key_domain=cfg.num_active_auctions, keys_bounded=True)
 
 
 def person_stream(cfg: NexmarkConfig) -> GeneratorSource:

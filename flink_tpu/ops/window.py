@@ -1126,7 +1126,7 @@ class WindowOperator:
         # spec, the pending batch index, and in-flight per-step stats
         # awaiting reconciliation
         self._devgen_spec = None
-        self._stash_devgen: Optional[Tuple[int, int, int]] = None
+        self._stash_devgen: Optional[Tuple[int, int, int, bool]] = None
         self._devstats_pending: collections.deque = collections.deque()
         # RLock: the spill+top-n sync path holds it across
         # _fire_ends → drain_ring, and _fire_ends' announce block
@@ -2283,14 +2283,21 @@ class WindowOperator:
         self.state_version += 1
         self._min_pane_seen = new_min
         self._max_pane_seen = new_max
+        # stats are needed only when something in them could be
+        # nonzero: an unproven key bound (misses), panes below the
+        # dead frontier (late accounting), or panes below the fired
+        # frontier (refire candidates) — at steady state a monotone
+        # source clears all three and the round trip is skipped
+        need_stats = (not getattr(spec, "keys_bounded", False)
+                      or pmin < dead or pmin < refire_below)
         self._stash_devgen = (int(batch_index), int(dead),
-                              int(refire_below))
+                              int(refire_below), bool(need_stats))
         if not self.external_throttle:
             self.throttle()
         return True
 
     def _dispatch_devgen(self, buf: np.ndarray, batch_index: int,
-                         dead: int) -> None:
+                         dead: int, need_stats: bool = True) -> None:
         by, n = self._topn
         step = functools.partial(
             _JIT_DEVGEN_STEP, gen=self._devgen_spec.device_keys_ts,
@@ -2303,9 +2310,15 @@ class WindowOperator:
         self.state, self._emit_ring, stats = step(
             self.state, self._ensure_ring(), jnp.asarray(buf), used,
             sel_cap=self._topn_cap(MIN_FIRE_PAD))
-        if hasattr(stats, "copy_to_host_async"):
-            stats.copy_to_host_async()
-        self._devstats_pending.append((batch_index, dead, stats))
+        if need_stats:
+            # the stats lane rides home asynchronously and reconciles
+            # at a later advance; when the spec PROVES the bound and
+            # the batch's pane range rules out late/refire work, the
+            # whole round trip is skipped (every per-step transfer is
+            # ~tens of ms of in-situ relay service)
+            if hasattr(stats, "copy_to_host_async"):
+                stats.copy_to_host_async()
+            self._devstats_pending.append((batch_index, dead, stats))
         self._inflight.append(self._emit_ring)
 
     def _advance_fused_devgen(self, wm: int,
@@ -2318,11 +2331,11 @@ class WindowOperator:
         if hdr is None:
             return None
         ends_f, cleared_after = hdr
-        batch_index, dead, refire_below = self._stash_devgen
+        batch_index, dead, refire_below, need_stats = self._stash_devgen
         self._stash_devgen = None
         buf[DEVGEN_HDR_OFF:DEVGEN_HDR_OFF + 6] = np.array(
             [batch_index, dead, refire_below], np.int64).view(np.int32)
-        self._dispatch_devgen(buf, batch_index, dead)
+        self._dispatch_devgen(buf, batch_index, dead, need_stats)
         self._cleared_below = cleared_after
         return self._ring_after_fire(len(ends_f))
 
@@ -2332,7 +2345,7 @@ class WindowOperator:
         quiesce, ring growth, the chunked advance path)."""
         if self._stash_devgen is None:
             return
-        batch_index, dead, refire_below = self._stash_devgen
+        batch_index, dead, refire_below, need_stats = self._stash_devgen
         self._stash_devgen = None
         lo = (self._cleared_below if self._min_pane_seen is None
               else max(self._cleared_below, self._min_pane_seen))
@@ -2347,7 +2360,7 @@ class WindowOperator:
                                           np.int64).astype(np.int32)
         buf[DEVGEN_HDR_OFF:DEVGEN_HDR_OFF + 6] = np.array(
             [batch_index, dead, refire_below], np.int64).view(np.int32)
-        self._dispatch_devgen(buf, batch_index, dead)
+        self._dispatch_devgen(buf, batch_index, dead, need_stats)
 
     # how many un-reconciled device steps may accumulate before an
     # advance force-blocks on the oldest one's stats: at steady state
