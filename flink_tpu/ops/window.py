@@ -604,7 +604,8 @@ def _fused_fire_clear(state, emit_ring, hdr, used_mask, *, agg,
     pane_lo = _hdr_i64(hdr, 0)
     pane_hi = _hdr_i64(hdr, 2)
     anchor = _hdr_i64(hdr, 4)
-    clear_word = hdr[7]
+    clear_lo = hdr[7]
+    clear_hi = hdr[6]
     deltas = hdr[8:8 + MIN_FIRE_PAD]
     w_valid = deltas > _DELTA_SENTINEL
     end_panes = jnp.where(w_valid, pane_lo + deltas.astype(jnp.int64),
@@ -613,11 +614,19 @@ def _fused_fire_clear(state, emit_ring, hdr, used_mask, *, agg,
         state, emit_ring, pane_lo, pane_hi, anchor, end_panes, w_valid,
         used_mask, agg=agg, panes_per_window=panes_per_window, ring=ring,
         sel_cap=sel_cap, by=by, topn=topn)
+    # 64-bit clear mask split over header words [7] (columns 0-31)
+    # and [6] (columns 32-63) — rings up to 64 stay on the one-dispatch
+    # fused paths (a 2^22-record batch's event span outgrows 32)
     cm = (lax.shift_right_logical(
-        clear_word, jnp.arange(min(ring, 32), dtype=jnp.int32))
+        clear_lo, jnp.arange(min(ring, 32), dtype=jnp.int32))
         & jnp.int32(1)) != 0
     if ring > 32:
-        cm = jnp.concatenate([cm, jnp.zeros(ring - 32, bool)])
+        cm_hi = (lax.shift_right_logical(
+            clear_hi, jnp.arange(min(ring - 32, 32), dtype=jnp.int32))
+            & jnp.int32(1)) != 0
+        cm = jnp.concatenate([cm, cm_hi])
+    if ring > 64:
+        cm = jnp.concatenate([cm, jnp.zeros(ring - 64, bool)])
     state = clear_kernel(state, cm.astype(jnp.int32))
     return state, emit_ring
 
@@ -1243,7 +1252,7 @@ class WindowOperator:
                 topn=n,
             )
             # one-dispatch-per-batch path (apply + fire + clear fused;
-            # see fused_step_kernel) — ring must fit the 32-bit clear
+            # see fused_step_kernel) — ring must fit the 64-bit clear
             # word in the header
             self._fused_step = (functools.partial(
                 _JIT_FUSED_STEP,
@@ -1253,7 +1262,7 @@ class WindowOperator:
                 by=by,
                 topn=n,
                 dump_row=self.layout.slots,
-            ) if self.plan.ring <= 32 else None)
+            ) if self.plan.ring <= 64 else None)
         else:
             self._fused_step = None
         self._clear = _JIT_CLEAR
@@ -2138,7 +2147,7 @@ class WindowOperator:
 
     def _fused_fill_header(self, wm: int, ends: List[int],
                            buf: np.ndarray) -> Optional[Tuple[List[int], int]]:
-        """Fill the 64-word fused-step header in place: pane bounds,
+        """Fill the FUSED_HDR-word fused-step header in place: pane bounds,
         ring anchor, clear word, fire-end deltas. Returns
         (fired_ends, cleared_below_after) or None when the fire list
         overflows the fused window slots."""
@@ -2176,8 +2185,8 @@ class WindowOperator:
         hi_v = self._max_pane_seen if self._max_pane_seen is not None else lo - 1
         buf[:6] = np.array([lo, hi_v, self._ring_anchor],
                            np.int64).view(np.int32)
-        buf[6] = 0
-        buf[7] = np.array([clear_word], np.uint32).view(np.int32)[0]
+        cw = np.array([clear_word], np.uint64).view(np.int32)
+        buf[7], buf[6] = cw[0], cw[1]
         deltas = np.full(MIN_FIRE_PAD, _DELTA_SENTINEL, np.int64)
         if ends_f:
             deltas[:len(ends_f)] = np.asarray(ends_f, np.int64) - lo
@@ -2253,7 +2262,7 @@ class WindowOperator:
         Returns False when a gate closed; the caller falls back to host
         materialization for this batch."""
         spec = self._devgen_spec
-        if spec is None or self.plan.ring > 32:
+        if spec is None or self.plan.ring > 64:
             return False
         dead = self._cleared_below
         refire_below = (self._fired_below_end
@@ -2278,8 +2287,8 @@ class WindowOperator:
         if new_max - max(dead, new_min) >= self.plan.ring:
             self._grow_ring(new_max - max(dead, new_min) + 1,
                             prev_min, prev_max)
-            if self.plan.ring > 32:
-                return False  # outgrew the clear word: host path
+            if self.plan.ring > 64:
+                return False  # outgrew the clear words: host path
         self.state_version += 1
         self._min_pane_seen = new_min
         self._max_pane_seen = new_max
