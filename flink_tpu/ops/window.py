@@ -331,17 +331,33 @@ def fire_kernel(
     # SUM lanes ride matmuls over the column mask — the MXU does the
     # window reduction without materializing the (rows, W, ring)
     # broadcast the mask-reduce form needs (33 MB per fire at Q5 shape).
-    # f64 keeps integer counts exact across the full i32 range.
-    sel_t = colmask.astype(jnp.float64).T                                  # (ring, W)
+    # Counts split into 16-bit halves and take TWO f32 matmuls (each
+    # product < 2^22, exact in f32) recombined in i32 — exact over the
+    # full i32 range; a single f64 matmul is EMULATED on TPU and
+    # measured ~45ms per fire at the 2^22-batch shape vs ~2ms for this.
+    sel_t = colmask.astype(jnp.float32).T                                  # (ring, W)
     if state.sums is None:
         sums = jnp.zeros((rows_n, W, 0), jnp.float32)
     else:
-        sums = jnp.einsum("rcs,cw->rws", state.sums.astype(jnp.float64),
-                          sel_t).astype(jnp.float32)
+        # f32 matmul accumulates the same f32 lane data the mask-reduce
+        # form summed — identical precision class
+        sums = jnp.einsum("rcs,cw->rws", state.sums, sel_t)
     maxs = lane_red(state.maxs, jnp.max, -jnp.inf)
     mins = lane_red(state.mins, jnp.min, jnp.inf)
-    counts = (state.counts.astype(jnp.float64)
-              @ sel_t).astype(state.counts.dtype)                          # (rows, W)
+    if ring <= 256:
+        # exactness: the contraction runs over the RING axis only, so
+        # each f32 accumulation has <= ring terms < 2^16 -> sums
+        # < ring * 2^16 <= 2^24, inside f32's exact-integer range
+        c_lo = (state.counts & 0xFFFF).astype(jnp.float32) @ sel_t
+        c_hi = (state.counts >> 16).astype(jnp.float32) @ sel_t
+        counts = (c_lo.astype(state.counts.dtype)
+                  + (c_hi.astype(state.counts.dtype) << 16))               # (rows, W)
+    else:
+        # degenerate giant rings: pure-integer mask reduce (exact at
+        # any width; the fused one-dispatch paths never reach here)
+        counts = jnp.sum(
+            jnp.where(colmask[None, :, :], state.counts[:, None, :], 0),
+            axis=2)
     counts = jnp.where(w_valid[None, :], counts, 0)
     return sums, maxs, mins, counts
 
