@@ -406,7 +406,37 @@ class CepOperator:
         SPAWNS a fresh partial — overlapping matches enumerate across
         partials. Per partial the take is greedy (the operator's
         documented determinism trade); across partials the overlap
-        semantics match the reference's NO_SKIP for linear patterns."""
+        semantics match the reference's NO_SKIP for linear patterns.
+
+        BATCH ATOMICITY: the partial-buffer overflow error must leave
+        the operator exactly as it was before the batch — earlier rank
+        steps have already advanced partials and appended matches by the
+        time a later rank overflows, and a caller that catches the error
+        (to fail over through restore, or to drop the batch) must not
+        observe half-applied state or double-emitted matches on retry.
+        The touched rows (only the batch's key slots) are snapshotted on
+        entry and rolled back on the error path — an exact guarantee a
+        pre-scan cannot give, since slot liberation (expiry, completion,
+        strict death) during the batch feeds back into overflow. One
+        deliberate residue: key-directory slots assigned for the batch's
+        new keys (in process_batch, before this point) stay assigned —
+        the key→slot mapping is idempotent and carries no match state,
+        the slot is reused if the key returns, and a restore-from-
+        checkpoint rebuilds the directory anyway; only keys never seen
+        again leave an empty slot behind."""
+        touched = np.unique(sl)
+        bak = (self.p_stage[touched].copy(), self.p_ts[touched].copy(),
+               self._last_ts[touched].copy(), len(self._matches))
+        try:
+            self._steps_no_skip_inner(sl, tt, kk, pr, rank, max_rank)
+        except Exception:
+            self.p_stage[touched], self.p_ts[touched] = bak[0], bak[1]
+            self._last_ts[touched] = bak[2]
+            del self._matches[bak[3]:]
+            raise
+
+    def _steps_no_skip_inner(self, sl, tt, kk, pr, rank,
+                             max_rank) -> None:
         S, P = self.S, self.max_partials
         within = self.pattern.within_ms
         strict = np.array([s.strict for s in self.stages], bool)

@@ -22,6 +22,8 @@ Tags (JSON objects with one reserved key):
     {"__kdict__": [[k, v], ...]}      dict with non-string keys
     {"__np__": [dtype, value]}        numpy scalar
     {"__bytes__": base64}             bytes
+    {"__strs__": [shape, [str, ...]]} all-string object-dtype array
+                                      (text columns; no pickle needed)
     {"__panestate__": {...}}          state.keyed.PaneState
     {"__pickle__": base64}            escape hatch for foreign objects
                                       (framework snapshots produce none
@@ -55,12 +57,18 @@ class _Encoder:
         if isinstance(v, np.generic):
             return {"__np__": [str(v.dtype), v.item()]}
         if isinstance(v, np.ndarray):
-            # object-dtype arrays (e.g. user state holding strings from
-            # a dtype=object source column) have no raw-byte form —
-            # np.frombuffer can't decode them, so the array section
-            # would produce an unrestorable checkpoint. Route them
-            # through the counted pickle escape hatch instead.
+            # object-dtype arrays have no raw-byte form — np.frombuffer
+            # can't decode them, so the array section would produce an
+            # unrestorable checkpoint. ALL-STRING object arrays (the
+            # common case: text columns from socket/file sources) get a
+            # native JSON tag, so they stay readable by foreign tooling
+            # AND cross the pickle-rejecting DCN decoder
+            # (allow_pickle=False); anything else still takes the
+            # counted pickle escape hatch.
             if v.dtype.hasobject:
+                flat = v.ravel()
+                if all(isinstance(x, str) for x in flat):
+                    return {"__strs__": [list(v.shape), list(flat)]}
                 import pickle
 
                 self.pickle_escapes += 1
@@ -131,8 +139,10 @@ def encode(payload: Any) -> bytes:
 
 
 class _Decoder:
-    def __init__(self, arrays: List[np.ndarray]) -> None:
+    def __init__(self, arrays: List[np.ndarray],
+                 allow_pickle: bool = True) -> None:
         self.arrays = arrays
+        self.allow_pickle = allow_pickle
 
     def dec(self, v: Any) -> Any:
         if isinstance(v, list):
@@ -157,7 +167,20 @@ class _Decoder:
             f = {k: self.dec(x) for k, x in v["__panestate__"].items()}
             return PaneState(sums=f.get("sums"), maxs=f.get("maxs"),
                              mins=f.get("mins"), counts=f.get("counts"))
+        if "__strs__" in v:
+            shape, items = v["__strs__"]
+            a = np.empty(len(items), dtype=object)
+            a[:] = items
+            return a.reshape(shape)
         if "__pickle__" in v:
+            if not self.allow_pickle:
+                # network-facing decoders (the DCN exchange) must never
+                # unpickle: an attacker-controlled __pickle__ tag is
+                # arbitrary code execution on load
+                raise ValueError(
+                    "__pickle__ escape rejected (allow_pickle=False): "
+                    "payload carries a foreign object where only "
+                    "framework-built arrays are expected")
             import pickle
 
             return pickle.loads(base64.b64decode(v["__pickle__"]))
@@ -180,9 +203,11 @@ def read_header(raw: bytes) -> Tuple[Dict[str, Any], int]:
     return json.loads(raw[hstart:hstart + hlen].decode()), hstart + hlen
 
 
-def decode(raw: bytes) -> Any:
+def decode(raw: bytes, allow_pickle: bool = True) -> Any:
     """v3 bytes → payload tree (arrays are read-only views when the
-    input buffer allows zero-copy)."""
+    input buffer allows zero-copy). ``allow_pickle=False`` rejects the
+    ``__pickle__`` escape — required for any decoder fed from the
+    network (see exchange/dcn.py)."""
     header, base = read_header(raw)
     arrays: List[np.ndarray] = []
     for spec in header["arrays"]:
@@ -192,7 +217,7 @@ def decode(raw: bytes) -> Any:
                           if spec["shape"] else 1,
                           offset=off).reshape(spec["shape"])
         arrays.append(a)
-    return _Decoder(arrays).dec(header["tree"])
+    return _Decoder(arrays, allow_pickle=allow_pickle).dec(header["tree"])
 
 
 def is_v3(raw: bytes) -> bool:

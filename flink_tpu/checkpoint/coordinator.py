@@ -164,6 +164,13 @@ class CheckpointCoordinator:
             psp = tracer.span("checkpoint.persist", checkpoint_id=cid)
             try:
                 with psp:
+                    # the async-upload fault seam: a raise here fails the
+                    # persistence future exactly like a dead background
+                    # uploader — the loop thread sees it at complete()
+                    from flink_tpu import faults
+
+                    faults.fire("checkpoint.upload", exc=OSError,
+                                checkpoint_id=cid)
                     mat = materialize_snapshot(payload)
                     ops = mat.pop("operators", None)
                     if ops is None:

@@ -43,6 +43,7 @@ import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
+from flink_tpu import faults
 from flink_tpu.fs import FileSystem, get_filesystem
 
 
@@ -52,6 +53,9 @@ class CheckpointHandle:
     path: str
     timestamp_ms: int
     is_savepoint: bool = False
+    # writer's leader epoch (manifest + dir-name qualified when > 0):
+    # among same-id checkpoints the highest epoch is the live timeline
+    epoch: int = 0
     size_bytes: int = -1  # filled by save/save_v2 (background thread)
     # op blob file names as written (save_v2 only): the incremental
     # reuse base must reference the ACTUAL names — a reused blob keeps
@@ -114,20 +118,25 @@ class FsCheckpointStorage:
         if self.epoch == 0:
             return
         for h in self.list_complete():
-            try:
-                with self.fs.open_read(
-                        os.path.join(h.path, "MANIFEST.json")) as f:
-                    m = json.loads(f.read().decode())
-            except Exception:
-                continue
-            if int(m.get("epoch", 0)) > self.epoch:
+            # handles carry the manifest's epoch — no second read
+            if h.epoch > self.epoch:
                 raise StaleCheckpointWriter(
                     f"checkpoint write fenced: store holds epoch "
-                    f"{m.get('epoch')} > this writer's {self.epoch} "
+                    f"{h.epoch} > this writer's {self.epoch} "
                     f"(deposed leader finishing late)")
 
     def _dir(self, checkpoint_id: int, savepoint: bool) -> str:
         prefix = "savepoint" if savepoint else "chk"
+        # epoch-QUALIFIED final name under HA fencing: a deposed leader
+        # renaming late lands on chk-<id>.e<oldEpoch>, a DIFFERENT path
+        # from the successor's chk-<id>.e<newEpoch> — a stale writer can
+        # never delete-and-replace a higher-epoch directory, closing the
+        # check-then-rename window _check_fence alone leaves open.
+        # latest()/list_complete pick the highest (id, epoch). Unfenced
+        # local runs (epoch 0) keep the plain layout.
+        if self.epoch and not savepoint:
+            return os.path.join(
+                self.job_dir, f"{prefix}-{checkpoint_id}.e{self.epoch}")
         return os.path.join(self.job_dir, f"{prefix}-{checkpoint_id}")
 
     def _tmp_dir(self, d: str) -> str:
@@ -152,6 +161,10 @@ class FsCheckpointStorage:
 
         d = self._dir(checkpoint_id, savepoint)
         tmp = self._tmp_dir(d)
+        faults.fire("checkpoint.storage.stall", exc=OSError,
+                    checkpoint_id=checkpoint_id)
+        faults.fire("checkpoint.storage.write", exc=OSError,
+                    checkpoint_id=checkpoint_id)
         with self.fs.open_write(os.path.join(tmp, "state.blob")) as f:
             f.write(self._pack(blobformat.encode(payload)))
         ts = int(time.time() * 1000)
@@ -166,18 +179,25 @@ class FsCheckpointStorage:
                 "compression": self.compression,
                 "epoch": self.epoch,
             }).encode())
+        faults.fire("checkpoint.storage.fsync", exc=OSError,
+                    checkpoint_id=checkpoint_id)
         try:
             self._check_fence()
         except StaleCheckpointWriter:
             self.fs.delete(tmp, recursive=True)
             raise
+        # a rename fault here is the TORN-manifest scenario: the tmp dir
+        # is fully written (manifest included) but never reaches its
+        # final name — list_complete must keep ignoring it
+        faults.fire("checkpoint.storage.rename", exc=OSError,
+                    checkpoint_id=checkpoint_id)
         if self.fs.exists(d):
             self.fs.delete(d, recursive=True)
         self.fs.rename(tmp, d)
         if not savepoint:
             self._retire_old()
         return CheckpointHandle(checkpoint_id, d, ts, savepoint,
-                                size_bytes=_dir_size(d))
+                                epoch=self.epoch, size_bytes=_dir_size(d))
 
     def save_v2(self, checkpoint_id: int, meta_payload: Dict[str, Any],
                 op_blobs: Dict[str, bytes],
@@ -190,6 +210,10 @@ class FsCheckpointStorage:
 
         d = self._dir(checkpoint_id, savepoint)
         tmp = self._tmp_dir(d)
+        faults.fire("checkpoint.storage.stall", exc=OSError,
+                    checkpoint_id=checkpoint_id)
+        faults.fire("checkpoint.storage.write", exc=OSError,
+                    checkpoint_id=checkpoint_id)
         versions: Dict[str, int] = {}
         op_files: Dict[str, str] = {}
         for nid, blob in op_blobs.items():
@@ -221,18 +245,22 @@ class FsCheckpointStorage:
                         for nid, fn in op_files.items()},
                 "epoch": self.epoch,
             }).encode())
+        faults.fire("checkpoint.storage.fsync", exc=OSError,
+                    checkpoint_id=checkpoint_id)
         try:
             self._check_fence()
         except StaleCheckpointWriter:
             self.fs.delete(tmp, recursive=True)
             raise
+        faults.fire("checkpoint.storage.rename", exc=OSError,
+                    checkpoint_id=checkpoint_id)
         if self.fs.exists(d):
             self.fs.delete(d, recursive=True)
         self.fs.rename(tmp, d)
         if not savepoint:
             self._retire_old()
         return CheckpointHandle(checkpoint_id, d, ts, savepoint,
-                                size_bytes=_dir_size(d),
+                                epoch=self.epoch, size_bytes=_dir_size(d),
                                 op_files=dict(op_files))
 
     def list_complete(self) -> List[CheckpointHandle]:
@@ -253,10 +281,18 @@ class FsCheckpointStorage:
                     m = json.loads(f.read().decode())
                 out.append(CheckpointHandle(
                     m["checkpoint_id"], d, m["timestamp_ms"],
-                    m.get("savepoint", False)))
+                    m.get("savepoint", False),
+                    epoch=int(m.get("epoch", 0))))
             except (json.JSONDecodeError, KeyError):
                 continue
-        return sorted(out, key=lambda h: h.checkpoint_id)
+        # (epoch, id) order — EPOCH FIRST: the epoch is the leadership
+        # fencing token, so the newest timeline outranks any id from a
+        # dead one. A deposed leader's late chk-9.e1 must not eclipse
+        # the successor's chk-6..8.e2 (restoring the dead timeline
+        # would rewind sources past output the live timeline's 2PC
+        # sinks already committed); it also sorts FIRST here, so
+        # retention retires it before anything live.
+        return sorted(out, key=lambda h: (h.epoch, h.checkpoint_id))
 
     def latest(self) -> Optional[CheckpointHandle]:
         hs = [h for h in self.list_complete() if not h.is_savepoint]

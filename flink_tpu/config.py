@@ -293,6 +293,18 @@ class CheckpointingOptions:
         "'' (fresh start), 'latest' (resume from newest complete "
         "checkpoint), or a checkpoint/savepoint directory path (ref: "
         "execution.savepoint.path).")
+    TOLERABLE_FAILURES = ConfigOption(
+        "execution.checkpointing.tolerable-failures", 0,
+        "Consecutive PERIODIC checkpoint persist/commit failures the "
+        "job rides out before failing over (ref: execution.checkpointing"
+        ".tolerable-failed-checkpoints, default 0 = any failure fails "
+        "the job). A tolerated epoch stays staged in its 2PC sinks and "
+        "commits with the next successful checkpoint — exactly-once is "
+        "unaffected. Savepoints and the final end-of-input checkpoint "
+        "are never tolerated. Single-process driver only: the "
+        "cross-host (DCN) step loop treats any checkpoint failure as "
+        "an attempt failure — its rendezvous-consensus cut has no "
+        "per-process skip, so recovery goes through restore.")
 
 
 class ClusterOptions:
@@ -324,6 +336,14 @@ class ClusterOptions:
     DCN_PORT = ConfigOption(
         "cluster.dcn-port", 0,
         "This process's exchange listen port (0 = ephemeral).")
+    DCN_SECRET = ConfigOption(
+        "cluster.dcn-secret", "",
+        "Per-job shared secret authenticating the DCN exchange "
+        "handshake (HMAC over the hello; exchange/dcn.py). The "
+        "coordinator mints one per attempt and ships it in the deploy "
+        "config; static cluster.dcn-peers deployments set it "
+        "themselves. Empty = unauthenticated (single-host loopback "
+        "only).")
     DCN_BIND = ConfigOption(
         "cluster.dcn-bind", "auto",
         "Address the exchange listener binds. 'auto' (default) stays "

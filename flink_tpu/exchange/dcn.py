@@ -24,17 +24,35 @@ once:
 Framing: 8-byte big-endian length + a checkpoint/blobformat payload
 (self-describing arrays — the same codec checkpoints use). Sockets are
 one per direction per pair (process i accepts from every j, and dials
-every j), identified by a one-byte hello carrying the sender id.
+every j), identified by a short hello carrying the sender id.
+
+Admission control: the hello is [sender:1][attempt:4][auth_flag:1];
+with a ``secret`` configured (``cluster.dcn-secret`` — the coordinator
+mints one per attempt and ships it in the deploy config) the flag is 1
+and an HMAC-SHA256 over the 6 hello bytes follows. A keyed listener
+closes any connection whose flag or MAC doesn't match; an UNKEYED
+listener likewise closes a keyed dialer (asymmetric secret rollout
+fails loudly at the handshake instead of parsing MAC bytes as a frame
+header). So a reachable port is no longer an open door on the
+cross-host deployments that widen past loopback. Independently, frames
+decode with the blobformat ``__pickle__`` escape REJECTED — exchange
+payloads are framework-built numeric arrays and never need the pickle
+path, which otherwise hands remote code execution to anyone who can
+produce a frame.
 """
 from __future__ import annotations
 
+import hmac as _hmac
 import socket
 import struct
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from flink_tpu import faults
 from flink_tpu.checkpoint import blobformat
+
+_MAC_LEN = 32  # HMAC-SHA256 digest appended to the hello when keyed
 
 
 class DcnExchange:
@@ -45,9 +63,14 @@ class DcnExchange:
     def __init__(self, process_id: int, n_processes: int,
                  listen_port: int = 0,
                  bind_host: str = "127.0.0.1",
-                 attempt: int = 0) -> None:
+                 attempt: int = 0,
+                 secret: Optional[str] = None) -> None:
         self.pid = process_id
         self.n = n_processes
+        # per-job shared secret (cluster.dcn-secret): hellos must carry
+        # a matching HMAC or the accept loop drops the connection
+        self._secret = (secret.encode() if isinstance(secret, str)
+                        else secret) or None
         # attempt-epoch fence: the connect handshake carries the
         # dialer's attempt id and the accept loop rejects mismatches,
         # so a stale process from a previous attempt can never join the
@@ -79,11 +102,28 @@ class DcnExchange:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             # a connect-and-close probe (port scan) must not kill the
-            # accept thread — the real peer's dial is still coming
+            # accept thread — the real peer's dial is still coming; a
+            # connection that stalls mid-hello is cut by the timeout so
+            # it cannot park the accept loop forever either
             try:
-                hello = _read_exact(conn, 5)
-            except ConnectionError:
+                faults.fire("dcn.accept", exc=ConnectionError)
+                conn.settimeout(10.0)
+                hello = _read_exact(conn, 6)
+                peer_keyed = hello[5] == 1
+                # drain the MAC whenever the dialer sent one, keyed or
+                # not — leftover MAC bytes must never be parsed as a
+                # frame header later
+                mac = _read_exact(conn, _MAC_LEN) if peer_keyed else b""
+                conn.settimeout(None)
+            except (ConnectionError, socket.timeout, OSError):
                 conn.close()
+                continue
+            if peer_keyed != bool(self._secret):
+                conn.close()  # asymmetric secret config: fenced out
+                continue
+            if self._secret and not _hmac.compare_digest(
+                    mac, _hmac.new(self._secret, hello, "sha256").digest()):
+                conn.close()  # unauthenticated hello: rejected
                 continue
             sender = hello[0]
             peer_attempt = struct.unpack(">I", hello[1:5])[0]
@@ -112,8 +152,11 @@ class DcnExchange:
                             f"p{self.pid}: cannot reach peer {j} at {addr}")
                     time.sleep(0.05)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            s.sendall(bytes([self.pid])
-                      + struct.pack(">I", self.attempt))
+            hello = (bytes([self.pid]) + struct.pack(">I", self.attempt)
+                     + (b"\x01" if self._secret else b"\x00"))
+            if self._secret:
+                hello += _hmac.new(self._secret, hello, "sha256").digest()
+            s.sendall(hello)
             self._out[j] = s
         while len(self._in) < self.n - 1:
             if time.time() > deadline:
@@ -130,6 +173,7 @@ class DcnExchange:
         ``shares.get(pid)`` and ``meta``. Blocks until every peer's
         frame arrives — the step barrier."""
         for j, s in self._out.items():
+            faults.fire("dcn.send", exc=ConnectionError, peer=j)
             raw = blobformat.encode(
                 {"data": shares.get(j), "meta": meta})
             s.sendall(struct.pack(">Q", len(raw)) + raw)
@@ -138,7 +182,10 @@ class DcnExchange:
         payloads[self.pid] = shares.get(self.pid)
         metas[self.pid] = meta
         for j, s in self._in.items():
-            frame = blobformat.decode(_read_frame(s))
+            faults.fire("dcn.recv", exc=ConnectionError, peer=j)
+            # allow_pickle=False: a hostile frame carrying a __pickle__
+            # escape fails loudly instead of deserializing foreign code
+            frame = blobformat.decode(_read_frame(s), allow_pickle=False)
             payloads[j] = frame["data"]
             metas[j] = frame["meta"]
         return payloads, metas

@@ -1,0 +1,336 @@
+"""Deterministic fault injection — named fault points at recovery seams.
+
+ref: the role of Flink's chaos/ITCase failure harnesses (the throwing
+mappers of flink-tests checkpointing ITCases, the unstable-environment
+knobs of ``TestingUtils``) generalized into a first-class subsystem: the
+recovery machinery (run_with_recovery, restart strategies, 2PC sinks,
+epoch-fenced storage) is only trustworthy if something *exercises* it
+under failure, deterministically, in CI.
+
+Design
+------
+A **fault point** is a named call site at a recovery-critical seam —
+``faults.fire("checkpoint.storage.rename", exc=OSError)`` — compiled
+into the production code. With no plan active the call is one module
+attribute read and a ``None`` check: zero measurable overhead on any
+hot path (and no point sits inside a per-record loop anyway).
+
+A **FaultPlan** decides, per invocation of a point, whether to inject:
+
+- ``raise``  — raise the site's declared exception type (``exc=``),
+  message-tagged ``injected fault at <point>`` so tests and humans can
+  tell injected faults from real ones;
+- ``drop``   — raise ``ConnectionError`` (transport loss mid-call);
+- ``delay``  — sleep ``delay_ms`` then continue (storage stall, slow
+  network);
+- ``crash``  — ``os._exit(137)``: process death, for subprocess chaos
+  only (an in-process test uses raise/drop, which exercise the same
+  recovery paths without killing the test runner).
+
+Determinism: every decision is a pure function of (seed, point name,
+per-point invocation index). Each point gets its own counter and its
+own PRNG stream seeded by ``f"{seed}:{point}"``, so schedules at one
+point are independent of thread interleavings at other points — same
+seed, same per-point call sequence → same injection schedule. Rules may
+also be exact (``after``/``count``) for schedule-exact CI slices.
+
+Configuration (the ``faults.*`` namespace)::
+
+    faults.seed:   1234
+    faults.inject: checkpoint.storage.write=raise@0.1; dcn.send=drop x1 +3
+
+Rule grammar: ``point=kind`` with optional ``@prob``, ``xCOUNT``
+(max injections), ``+AFTER`` (skip the first AFTER invocations) and
+``~DELAY_MS`` (for ``delay``); rules separated by ``;``. The point may
+be an ``fnmatch`` glob (``checkpoint.*``).
+
+Observability: every injection is recorded as a ``fault`` span on the
+process-global tracer (obs/tracing.py) AND counted in this module's
+process-global ``registry`` (``faults.<point>.<kind>`` counters), so a
+recovery trace always shows what was injected; the supervisor counts
+every restart in the same registry (``recovery.attempts``).
+
+Scope: the active plan is PROCESS-global, like the tracer — fault
+points are shared seams (RPC, storage, heartbeat), so injection cannot
+be attributed to one job from inside the seam. Do not co-schedule a
+chaos job and a production job on the same runner process: the plan
+fires for both, and a later fault-free deploy uninstalls a
+config-installed plan (see ``install_from_config``). Chaos runs get
+their own runner, exactly like they get their own cluster in any other
+chaos harness.
+
+Instrumented points (the stack's recovery-critical seams):
+
+    checkpoint.storage.stall / .write / .fsync / .rename   storage.py
+    checkpoint.upload                                      coordinator.py
+    rpc.client.send / rpc.client.recv / rpc.server.dispatch  rpc.py
+    dcn.accept / dcn.send / dcn.recv                       dcn.py
+    runner.heartbeat                                       runner.py
+    coordinator.deploy                                     coordinator.py
+    supervisor.restart                                     supervisor.py
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import fnmatch
+import random
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from flink_tpu.config import ConfigOption
+from flink_tpu.obs.metrics import MetricRegistry
+
+FAULT_SEED = ConfigOption(
+    "faults.seed", 0,
+    "Seed of the fault plan's per-point PRNG streams; the same seed "
+    "with the same per-point invocation sequence reproduces the exact "
+    "injection schedule (print it on chaos failures for replay).")
+
+FAULT_INJECT = ConfigOption(
+    "faults.inject", "",
+    "Fault rules, ';'-separated: 'point=kind [@prob] [xCOUNT] [+AFTER] "
+    "[~DELAY_MS]'. kind: raise|drop|delay|crash. Empty = no injection "
+    "(production default). See flink_tpu/faults.py for the point list.")
+
+# process-global fault/recovery metrics — chaos tests assert every
+# injection and every recovery attempt is visible here and on the tracer
+registry = MetricRegistry()
+
+_INJECTED_TAG = "injected fault at "
+
+
+def is_injected(exc: BaseException) -> bool:
+    """True when an exception was raised by a fault point (the message
+    tag survives str()/re-wrapping in error reports)."""
+    return _INJECTED_TAG in str(exc)
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One injection rule; ``point`` may be an fnmatch glob."""
+
+    point: str
+    kind: str = "raise"           # raise | drop | delay | crash
+    probability: float = 1.0
+    count: int = -1               # max injections by this rule; -1 = inf
+    after: int = 0                # skip the first N invocations
+    delay_ms: float = 0.0
+    injected: int = 0             # runtime: injections so far
+
+    def __post_init__(self) -> None:
+        if self.kind not in ("raise", "drop", "delay", "crash"):
+            raise ValueError(
+                f"fault kind must be raise|drop|delay|crash, "
+                f"got {self.kind!r}")
+
+
+class FaultPlan:
+    """Seed-driven injection schedule over named fault points.
+
+    Build programmatically (``plan.rule(...)`` chains) or from config
+    (``FaultPlan.from_spec``); activate process-globally with the
+    context manager::
+
+        with FaultPlan(seed=7).rule("checkpoint.storage.write",
+                                    "raise", count=1).activate():
+            run_with_recovery(build, conf)
+
+    ``plan.log`` records every injection as (point, kind, seq) — the
+    replayable schedule a failing chaos test prints with its seed.
+    """
+
+    def __init__(self, seed: int = 0,
+                 rules: Optional[List[FaultRule]] = None,
+                 spec: str = "") -> None:
+        self.seed = int(seed)
+        self.rules: List[FaultRule] = list(rules or [])
+        self.spec = spec
+        self.log: List[Tuple[str, str, int]] = []
+        self._counts: Dict[str, int] = {}
+        self._rngs: Dict[str, random.Random] = {}
+        self._lock = threading.RLock()
+
+    def rule(self, point: str, kind: str = "raise", p: float = 1.0,
+             count: int = -1, after: int = 0,
+             delay_ms: float = 0.0) -> "FaultPlan":
+        self.rules.append(FaultRule(point, kind, p, count, after, delay_ms))
+        return self
+
+    _HEAD_RE = re.compile(
+        r"(?P<point>[\w.\-*?\[\]]+)\s*=\s*(?P<kind>raise|drop|delay|crash)")
+    _MOD_RE = re.compile(r"\s*(?P<op>[@x+~])\s*(?P<val>[\d.]+)")
+
+    @classmethod
+    def from_spec(cls, spec: str, seed: int = 0) -> "FaultPlan":
+        plan = cls(seed=seed, spec=spec)
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            head = cls._HEAD_RE.match(part)
+            mods: Dict[str, float] = {}
+            pos = head.end() if head else 0
+            while head and pos < len(part):
+                m = cls._MOD_RE.match(part, pos)
+                if m is None:
+                    head = None
+                    break
+                mods[m["op"]] = float(m["val"])
+                pos = m.end()
+            if head is None:
+                raise ValueError(
+                    f"bad faults.inject rule {part!r} (grammar: "
+                    "'point=kind [@prob] [xCOUNT] [+AFTER] [~DELAY_MS]', "
+                    "modifiers in any order)")
+            plan.rule(head["point"], head["kind"],
+                      p=mods.get("@", 1.0),
+                      count=int(mods.get("x", -1)),
+                      after=int(mods.get("+", 0)),
+                      delay_ms=mods.get("~", 0.0))
+        return plan
+
+    def decide(self, point: str) -> Optional[Tuple[FaultRule, int]]:
+        """One invocation of ``point``: the matching rule to apply (and
+        the invocation index), or None. Thread-safe; deterministic per
+        (seed, point, invocation index)."""
+        with self._lock:
+            n = self._counts.get(point, 0)
+            self._counts[point] = n + 1
+            for r in self.rules:
+                if not fnmatch.fnmatchcase(point, r.point):
+                    continue
+                if n < r.after:
+                    continue
+                if 0 <= r.count <= r.injected:
+                    continue
+                if r.probability < 1.0:
+                    rng = self._rngs.get(point)
+                    if rng is None:
+                        rng = self._rngs[point] = random.Random(
+                            f"{self.seed}:{point}")
+                    if rng.random() >= r.probability:
+                        continue
+                r.injected += 1
+                self.log.append((point, r.kind, n))
+                return r, n
+            return None
+
+    @contextlib.contextmanager
+    def activate(self):
+        """Install as the process-global plan for the with-block (tests);
+        nesting restores the previous plan on exit."""
+        global _active
+        prev = _active
+        _active = self
+        try:
+            yield self
+        finally:
+            _active = prev
+
+
+_active: Optional[FaultPlan] = None
+_active_from_config = False
+_counter_lock = threading.Lock()
+_counters: Dict[Tuple[str, str], Any] = {}
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _active
+
+
+def install_from_config(config) -> Optional[FaultPlan]:
+    """Install the config's fault plan process-globally (the deploy/CLI
+    path — tests prefer ``plan.activate()``). Idempotent for an
+    identical (spec, seed): counters must persist across recovery
+    attempts or count-limited rules would re-fire forever and the job
+    could never complete. An EMPTY spec uninstalls a previously
+    config-installed plan — a chaos job's schedule must not leak into
+    the next, fault-free job sharing the runner process (a test's
+    context-managed plan is left alone)."""
+    global _active, _active_from_config
+    spec = str(config.get(FAULT_INJECT) or "").strip()
+    if not spec:
+        if _active_from_config:
+            _active = None
+            _active_from_config = False
+        return None
+    seed = int(config.get(FAULT_SEED))
+    if (_active is not None and _active.spec == spec
+            and _active.seed == seed):
+        return _active
+    _active = FaultPlan.from_spec(spec, seed=seed)
+    _active_from_config = True
+    return _active
+
+
+def clear() -> None:
+    """Drop the process-global plan (teardown safety)."""
+    global _active, _active_from_config
+    _active = None
+    _active_from_config = False
+
+
+def fire(point: str, exc: type = RuntimeError, **attrs: Any) -> None:
+    """A fault point. ``exc`` is the exception type a ``raise`` rule
+    uses — the site declares what a real failure there would look like
+    (OSError for storage, ConnectionError for transports) so injected
+    faults travel the production error paths."""
+    plan = _active
+    if plan is None:
+        return
+    hit = plan.decide(point)
+    if hit is None:
+        return
+    rule, seq = hit
+    _record(point, rule.kind, seq, attrs)
+    if rule.kind == "delay":
+        time.sleep(rule.delay_ms / 1000.0)
+        return
+    if rule.kind == "crash":
+        import os
+
+        os._exit(137)
+    base = ConnectionError if rule.kind == "drop" else exc
+    raise base(f"{_INJECTED_TAG}{point} "
+               f"(kind={rule.kind}, seq={seq}, seed={plan.seed})")
+
+
+def _record(point: str, kind: str, seq: int,
+            attrs: Dict[str, Any]) -> None:
+    from flink_tpu.obs.tracing import tracer
+
+    with tracer.span("fault", point=point, kind=kind, seq=seq, **attrs):
+        pass
+    key = (point, kind)
+    c = _counters.get(key)
+    if c is None:
+        with _counter_lock:
+            c = _counters.get(key)
+            if c is None:
+                c = registry.group("faults", point).counter(kind)
+                _counters[key] = c
+    c.inc()
+
+
+_recovery_counter = None
+
+
+def record_recovery(job: str) -> None:
+    """Count one supervised restart in the process-global registry (the
+    metrics half of 'every recovery attempt is visible'; the tracing
+    half is the supervisor's ``recovery`` span)."""
+    global _recovery_counter
+    if _recovery_counter is None:
+        with _counter_lock:
+            if _recovery_counter is None:
+                _recovery_counter = registry.group(
+                    "recovery").counter("attempts")
+    _recovery_counter.inc()
+
+
+def snapshot() -> Dict[str, Any]:
+    """Flat view of the fault/recovery counters (test assertions)."""
+    return registry.snapshot()

@@ -331,10 +331,7 @@ def fire_kernel(
     # SUM lanes ride matmuls over the column mask — the MXU does the
     # window reduction without materializing the (rows, W, ring)
     # broadcast the mask-reduce form needs (33 MB per fire at Q5 shape).
-    # Counts split into 16-bit halves and take TWO f32 matmuls (each
-    # product < 2^22, exact in f32) recombined in i32 — exact over the
-    # full i32 range; a single f64 matmul is EMULATED on TPU and
-    # measured ~45ms per fire at the 2^22-batch shape vs ~2ms for this.
+    # Counts take the ring-axis prefix-sum path below instead.
     sel_t = colmask.astype(jnp.float32).T                                  # (ring, W)
     if state.sums is None:
         sums = jnp.zeros((rows_n, W, 0), jnp.float32)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import secrets
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -355,7 +356,17 @@ class JobCoordinator(RpcEndpoint):
         try:
             extra = {"py_blobs": blobs} if blobs else {}
             push_targets = targets if targets is not None else [target]
+            # per-attempt exchange secret for cross-host jobs: every
+            # process of THIS attempt shares it, nothing else does — the
+            # DCN hello HMAC (exchange/dcn.py) rejects everyone else,
+            # closing the open-listener RCE on 0.0.0.0 deployments
+            dcn_secret = (secrets.token_hex(16)
+                          if targets is not None else None)
+            # the runner the failure handler blames/excludes must be the
+            # one whose push actually failed, not the primary
+            deploy_target = target
             for i, t in enumerate(push_targets):
+                deploy_target = t
                 pconf = dict(config)
                 # the attempt epoch fences the driver's checkpoint
                 # STORAGE writes (FsCheckpointStorage._check_fence):
@@ -366,11 +377,23 @@ class JobCoordinator(RpcEndpoint):
                     # rendezvous through rpc_dcn_register/peers
                     pconf["cluster.process-id"] = i
                     pconf["cluster.dcn-rendezvous"] = "coordinator"
+                    pconf["cluster.dcn-secret"] = dcn_secret
                     pconf.setdefault("source.enumeration", "local")
+                from flink_tpu import faults
+
+                faults.fire("coordinator.deploy", exc=RpcError,
+                            job=job_id, runner=t.runner_id)
                 c = RpcClient(t.host, t.port, timeout_s=5.0)
                 try:
+                    # per-push token: a TRANSPORT retry of this call
+                    # re-sends the same token (the runner absorbs the
+                    # duplicate even if the attempt already completed);
+                    # a genuine re-deploy generates a fresh one and
+                    # executes
                     resp = c.call("run_job", job_id=job_id, entry=entry,
-                                  config=pconf, attempt=attempt, **extra)
+                                  config=pconf, attempt=attempt,
+                                  deploy_token=secrets.token_hex(8),
+                                  **extra)
                 finally:
                     c.close()
                 if not resp.get("accepted"):
@@ -385,11 +408,12 @@ class JobCoordinator(RpcEndpoint):
                 jj = self.jobs.get(job_id)
                 if jj is not None:
                     decision = self._route_failure(
-                        jj, f"deploy to {target.runner_id} failed: {e}")
+                        jj,
+                        f"deploy to {deploy_target.runner_id} failed: {e}")
             if decision.get("action") == "restart":
                 self._deploy_async(
                     job_id, decision.get("delay_ms", 0) / 1000,
-                    exclude=[target.runner_id])
+                    exclude=[deploy_target.runner_id])
 
     def rpc_job_status(self, job_id: str) -> dict:
         with self._lock:

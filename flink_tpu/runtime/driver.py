@@ -452,7 +452,9 @@ class Driver:
         interval path in the run loop uses the async form instead —
         this entry point waits for durability before returning."""
         assert self._coordinator is not None, "checkpointing not configured"
-        self._complete_pending_checkpoint(wait=True)
+        # the checkpoint already in flight is a PERIODIC one — its
+        # failure is tolerable; the one triggered here is not
+        self._complete_pending_checkpoint(wait=True, tolerate=True)
         self._ckpt_pending = self._begin_checkpoint(savepoint=savepoint)
         return self._complete_pending_checkpoint(wait=True)
 
@@ -535,7 +537,9 @@ class Driver:
         ex = DcnExchange(pid, n,
                          listen_port=int(cfg.get(ClusterOptions.DCN_PORT)),
                          bind_host=bind,
-                         attempt=int(cfg.get_raw("cluster.attempt", 1)))
+                         attempt=int(cfg.get_raw("cluster.attempt", 1)),
+                         secret=str(cfg.get(
+                             ClusterOptions.DCN_SECRET) or "") or None)
         if rendezvous:
             # coordinator-deployed job: publish this process's listener
             # and poll until the whole fleet registered (ref: the
@@ -583,11 +587,15 @@ class Driver:
             return None
         from flink_tpu.checkpoint.storage import FsCheckpointStorage
 
-        for h in self._coordinator.storage.list_complete():
-            if h.checkpoint_id == common and not h.is_savepoint:
-                payload = FsCheckpointStorage.load(h)
-                self._coordinator.resume_numbering(payload)
-                return payload
+        # last match: list_complete sorts by (id, epoch), so among
+        # fence-epoch duplicates of the negotiated id the successor's
+        # (highest-epoch) directory wins
+        match = [h for h in self._coordinator.storage.list_complete()
+                 if h.checkpoint_id == common and not h.is_savepoint]
+        if match:
+            payload = FsCheckpointStorage.load(match[-1])
+            self._coordinator.resume_numbering(payload)
+            return payload
         raise RuntimeError(
             f"negotiated checkpoint id {common} is missing locally — "
             "retention removed it; raise state.checkpoints.num-retained")
@@ -855,10 +863,19 @@ class Driver:
             else:
                 cb(h.path)  # simple callbacks (tests) take path only
 
-    def _complete_pending_checkpoint(self, wait: bool = False):
+    def _complete_pending_checkpoint(self, wait: bool = False,
+                                     tolerate: bool = False):
         """Apply the 2PC commit of a finished background checkpoint on
         the LOOP thread (the asynchronous notifyCheckpointComplete of
-        the reference). Non-blocking unless ``wait``."""
+        the reference). Non-blocking unless ``wait``.
+
+        ``tolerate``: the PERIODIC path rides out up to
+        execution.checkpointing.tolerable-failures consecutive
+        persist/commit failures instead of failing the job — the failed
+        id left no manifest at its final name, so restore ignores it,
+        and the staged 2PC epoch simply commits with the next
+        successful checkpoint. Savepoints and the final end-of-input
+        checkpoint never tolerate (their durability IS the contract)."""
         import os as _os
 
         p = self._ckpt_pending
@@ -866,7 +883,34 @@ class Driver:
             return None
         if not wait and not p.done():
             return None
-        handle = p.complete()
+        try:
+            handle = p.complete()
+        except Exception as e:  # noqa: BLE001 — persist/commit failure
+            self._ckpt_pending = None
+            if p.is_savepoint:
+                # savepoints neither count toward nor reset the
+                # CONSECUTIVE-PERIODIC-failure budget (the option's
+                # documented unit)
+                raise
+            self._ckpt_failures += 1
+            tol = int(self.config.get(
+                CheckpointingOptions.TOLERABLE_FAILURES))
+            if not tolerate or self._ckpt_failures > tol:
+                raise
+            from flink_tpu.obs.tracing import tracer
+
+            self.metrics["checkpoint_failures"] = (
+                self.metrics.get("checkpoint_failures", 0) + 1)
+            with tracer.span("checkpoint.failed",
+                             checkpoint_id=p.checkpoint_id,
+                             consecutive=self._ckpt_failures,
+                             error=f"{type(e).__name__}: {e}"):
+                pass
+            return None
+        if not p.is_savepoint:
+            # a savepoint landing between two periodic failures must
+            # not reset the consecutive-periodic counter either
+            self._ckpt_failures = 0
         self._ckpt_pending = None
         if not p.is_savepoint:
             names = handle.op_files or {}
@@ -904,6 +948,7 @@ class Driver:
             if self._coordinator is not None else None)
         self._ckpt_pending = None
         self._ckpt_base = None
+        self._ckpt_failures = 0  # consecutive, for tolerable-failures
         self._last_freeze_versions: Dict[Any, int] = {}
         interval_ms = self.config.get(CheckpointingOptions.INTERVAL)
         restore = self.config.get(CheckpointingOptions.RESTORE)
@@ -1042,10 +1087,17 @@ class Driver:
             splits = n.source.splits()
             owned = self._enumerate_owned(sid, len(splits))
             self._owned_splits[sid] = owned
-            if not owned:
+            if not owned and self._dcn is None:
                 # this runner owns nothing of the source: exhausted from
                 # birth — its watermark must not pin downstream at the
-                # floor while peers' shares flow
+                # floor while peers' shares flow. NOT under the DCN
+                # exchange: there out_wm[sid] is the GLOBAL watermark
+                # applied downstream (the rendezvous meta carries the
+                # per-process local, already _FINAL for an empty
+                # process) — pinning it to _FINAL here made a
+                # zero-split process fire its windows immediately and
+                # drop every routed record as late (found by the chaos
+                # suite's DCN peer-death soak).
                 self._out_wm[sid] = _FINAL
             d = srcs[sid] = {}
             for i in owned:
@@ -1170,7 +1222,7 @@ class Driver:
             # async checkpointing: commit any finished background
             # checkpoint (never blocks), then kick off the next one when
             # the interval elapsed and no persistence is in flight
-            self._complete_pending_checkpoint(wait=False)
+            self._complete_pending_checkpoint(wait=False, tolerate=True)
             if (self._coordinator is not None and interval_ms > 0
                     and self._ckpt_pending is None
                     and (time.time() - last_chk) * 1000 >= interval_ms):

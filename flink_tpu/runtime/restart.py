@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from flink_tpu.config import ClusterOptions, Configuration
 
@@ -49,12 +49,17 @@ class FixedDelayRestartStrategy(RestartStrategy):
 class ExponentialDelayRestartStrategy(RestartStrategy):
     """Delay doubles per failure up to max; resets after a quiet period
     (ref: ExponentialDelayRestartBackoffTimeStrategy defaults 1s→5min,
-    backoff multiplier 2, reset threshold 1h)."""
+    backoff multiplier 2, reset threshold 1h).
+
+    ``now_fn`` is the clock seam: time-dependent backoff logic is
+    tested with an injected fake clock instead of wall time (ref: the
+    ManualClock every reference backoff-strategy test drives)."""
 
     initial_ms: int = 1000
     max_ms: int = 300_000
     multiplier: float = 2.0
     reset_after_ms: int = 3_600_000
+    now_fn: Callable[[], float] = time.time
     _current: int = 0
     _last_failure: float = 0.0
 
@@ -62,7 +67,7 @@ class ExponentialDelayRestartStrategy(RestartStrategy):
         return True
 
     def next_delay_ms(self) -> int:
-        now = time.time()
+        now = self.now_fn()
         if self._last_failure and (now - self._last_failure) * 1000 >= self.reset_after_ms:
             self._current = 0
         self._last_failure = now
@@ -81,17 +86,18 @@ class FailureRateRestartStrategy(RestartStrategy):
     max_failures: int = 3
     interval_ms: int = 60_000
     delay_ms: int = 1000
+    now_fn: Callable[[], float] = time.time
 
     def __post_init__(self) -> None:
         self._times: List[float] = []
 
     def can_restart(self) -> bool:
-        cut = time.time() - self.interval_ms / 1000
+        cut = self.now_fn() - self.interval_ms / 1000
         self._times = [t for t in self._times if t >= cut]
         return len(self._times) < self.max_failures
 
     def next_delay_ms(self) -> int:
-        self._times.append(time.time())
+        self._times.append(self.now_fn())
         return self.delay_ms
 
 

@@ -20,7 +20,10 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
+
+from flink_tpu import faults
 
 _LEN = struct.Struct(">I")
 
@@ -113,6 +116,8 @@ class RpcServer:
                 return
             msg, box, done = item
             try:
+                faults.fire("rpc.server.dispatch", exc=RuntimeError,
+                            method=msg.get("method"))
                 fn = getattr(self.endpoint, "rpc_" + msg["method"], None)
                 if fn is None:
                     box["resp"] = {"error": f"no such method {msg['method']}"}
@@ -133,9 +138,22 @@ class RpcError(RuntimeError):
 
 
 class RpcClient:
-    def __init__(self, host: str, port: int, timeout_s: float = 10.0) -> None:
+    """Transport-fault tolerance: a failed send/recv (socket error or a
+    peer that closed mid-call, e.g. a restarting server) RECONNECTS and
+    retries with exponential backoff before surfacing RpcError — a
+    single dropped TCP connection must not register as a peer failure
+    (ref: Pekko remoting's transparent reconnect under the reference's
+    RPC). Control-plane calls are idempotent by design (register /
+    heartbeat / report_* / trigger re-sends are absorbed), so a retry
+    after an ambiguous send is safe. ``retries=0`` restores the old
+    fail-fast behavior."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0,
+                 retries: int = 2, retry_backoff_s: float = 0.05) -> None:
         self._addr = (host, port)
         self._timeout = timeout_s
+        self._retries = max(0, int(retries))
+        self._backoff = retry_backoff_s
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
@@ -147,20 +165,38 @@ class RpcClient:
         return self._sock
 
     def call(self, method: str, **args: Any) -> Any:
-        with self._lock:
+        delay = self._backoff
+        for attempt in range(self._retries + 1):
             try:
-                sock = self._connect()
-                _send_msg(sock, {"method": method, "args": args})
-                resp = _recv_msg(sock)
+                with self._lock:
+                    # the dead socket is torn down INSIDE the lock: a
+                    # concurrent caller must never have its in-flight
+                    # recv's socket closed out from under it
+                    try:
+                        faults.fire("rpc.client.send", exc=ConnectionError,
+                                    method=method)
+                        sock = self._connect()
+                        _send_msg(sock, {"method": method, "args": args})
+                        faults.fire("rpc.client.recv", exc=ConnectionError,
+                                    method=method)
+                        resp = _recv_msg(sock)
+                        if resp is None:
+                            raise ConnectionError(
+                                "connection closed by peer")
+                    except OSError:
+                        self.close()
+                        raise
             except OSError as e:
-                self.close()
-                raise RpcError(f"rpc transport failure: {e}") from e
-        if resp is None:
-            self.close()
-            raise RpcError("connection closed by peer")
-        if "error" in resp:
-            raise RpcError(resp["error"])
-        return resp["result"]
+                if attempt >= self._retries:
+                    raise RpcError(
+                        f"rpc transport failure after {attempt + 1} "
+                        f"attempt(s): {e}") from e
+                time.sleep(delay)
+                delay *= 2
+                continue
+            if "error" in resp:
+                raise RpcError(resp["error"])
+            return resp["result"]
 
     def close(self) -> None:
         if self._sock is not None:

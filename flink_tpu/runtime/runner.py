@@ -20,6 +20,7 @@ Run as a process::
 """
 from __future__ import annotations
 
+import collections
 import importlib
 import threading
 import time
@@ -87,12 +88,24 @@ class TaskRunner(RpcEndpoint):
         self.runner_id = runner_id or f"runner-{uuid.uuid4().hex[:8]}"
         self._coord_addr = (coordinator_host, coordinator_port)
         self._ha_dir = ha_dir
-        # modest timeout: heartbeats are tiny, and a frozen/partitioned
-        # leader must not hold the loop long enough to stall failover
-        # (leader re-resolution waits out 2 of these)
+        # modest timeout + NO transport retries: heartbeats are tiny and
+        # the beat loop is already periodic retry — transparent
+        # reconnect attempts would multiply the timeout against a
+        # frozen/partitioned leader and stall failover (leader
+        # re-resolution waits out 2 misses)
         self._coord = RpcClient(coordinator_host, coordinator_port,
-                                timeout_s=5.0)
+                                timeout_s=5.0, retries=0)
         self._jobs: Dict[str, Dict[str, Any]] = {}  # job_id -> {cancel, thread}
+        # (job_id, attempt, deploy_token) triples whose execution
+        # already COMPLETED on this runner: a deploy RPC retried after
+        # the response was lost re-sends the SAME token and must be
+        # answered accepted, never re-executed — the job record is
+        # popped at completion, so the duplicate guard needs this
+        # tombstone. Keyed by the per-push token so a legitimate
+        # RE-SUBMISSION of the same job id (fresh token) still runs.
+        # Bounded FIFO: the ambiguity window is seconds, not hours.
+        self._done_attempts: collections.OrderedDict = (
+            collections.OrderedDict())
         self._lock = threading.Lock()
         self._closed = False
         self._server: Optional[RpcServer] = None
@@ -136,6 +149,10 @@ class TaskRunner(RpcEndpoint):
                             metrics[jid] = drv.live_metrics()
                         except Exception:  # noqa: BLE001 racy reads
                             pass
+                from flink_tpu import faults
+
+                faults.fire("runner.heartbeat", exc=RpcError,
+                            runner=self.runner_id)
                 r = self._coord.call("heartbeat", runner_id=self.runner_id,
                                      jobs=running, metrics=metrics)
                 misses = 0
@@ -179,7 +196,7 @@ class TaskRunner(RpcEndpoint):
         if (host, int(port)) == self._coord_addr:
             return  # same leader; outage was transient
         try:
-            new = RpcClient(host, int(port), timeout_s=5.0)
+            new = RpcClient(host, int(port), timeout_s=5.0, retries=0)
             import jax
 
             new.call("register_runner", runner_id=self.runner_id,
@@ -213,14 +230,34 @@ class TaskRunner(RpcEndpoint):
     def rpc_run_job(self, job_id: str, entry: str,
                     config: Optional[dict] = None,
                     attempt: int = 1,
-                    py_blobs: Optional[list] = None) -> dict:
+                    py_blobs: Optional[list] = None,
+                    deploy_token: Optional[str] = None) -> dict:
         """Deploy a job: import ``module:function``, build the pipeline,
         execute. The entry-point contract is the job-jar analogue — the
         job's code must be importable on the runner host (ref:
         TaskExecutor.submitTask + TaskDeploymentDescriptor)."""
         with self._lock:
+            if (deploy_token is not None and (job_id, attempt,
+                                              deploy_token)
+                    in self._done_attempts):
+                # retried delivery of a push whose attempt already ran
+                # to completion here: its outcome was (or is being)
+                # reported through _report — re-executing would commit
+                # the whole job's output a second time. Token-less
+                # callers (tests, direct RPC) keep re-execute
+                # semantics.
+                return {"accepted": True, "runner_id": self.runner_id,
+                        "duplicate": True}
             old = self._jobs.get(job_id)
-            if old is not None and old["attempt"] >= attempt:
+            if old is not None and old["attempt"] == attempt:
+                # duplicate delivery of the SAME attempt (the deploy
+                # RPC retried after losing the first response): the job
+                # is already running exactly as requested — answer
+                # accepted so the retrying coordinator doesn't fail
+                # over a healthy deployment
+                return {"accepted": True, "runner_id": self.runner_id,
+                        "duplicate": True}
+            if old is not None and old["attempt"] > attempt:
                 return {"accepted": False, "reason": "already running"}
             if old is not None:
                 # a NEWER attempt supersedes the stale one still winding
@@ -234,6 +271,7 @@ class TaskRunner(RpcEndpoint):
             rec: Dict[str, Any] = {"cancel": cancel, "attempt": attempt,
                                    "savepoint": savepoint,
                                    "config": dict(config or {}),
+                                   "deploy_token": deploy_token,
                                    "py_blobs": list(py_blobs or [])}
             t = threading.Thread(
                 target=self._run_job,
@@ -281,9 +319,19 @@ class TaskRunner(RpcEndpoint):
                         "reason": "job has no checkpointing configured "
                                   "(execution.checkpointing.interval)"}
             if j["savepoint"].is_set():
-                # a pending request's stop/token must not be overwritten
-                # (a routine savepoint racing a rescale's would strip the
-                # rescale token and strand it armed forever)
+                if (j["savepoint"].token == token
+                        and j["savepoint"].stop_after == stop):
+                    # the SAME request re-delivered (transport retry
+                    # after a lost response): it is armed exactly as
+                    # asked — ok, or the retrying caller would wrongly
+                    # treat an in-flight savepoint as failed (and a
+                    # rescale would disarm while its savepoint runs)
+                    return {"ok": True, "dispatched": True,
+                            "duplicate": True}
+                # a DIFFERENT pending request's stop/token must not be
+                # overwritten (a routine savepoint racing a rescale's
+                # would strip the rescale token and strand it armed
+                # forever)
                 return {"ok": False, "reason": "savepoint already pending"}
             j["savepoint"].stop_after = stop
             j["savepoint"].token = token
@@ -345,6 +393,13 @@ class TaskRunner(RpcEndpoint):
                 # already replaced it
                 if self._jobs.get(job_id) is rec:
                     self._jobs.pop(job_id)
+                # tombstone the completed push so a late deploy-RPC
+                # retry can't re-execute it (see rpc_run_job)
+                if rec.get("deploy_token") is not None:
+                    self._done_attempts[
+                        (job_id, attempt, rec["deploy_token"])] = True
+                    while len(self._done_attempts) > 64:
+                        self._done_attempts.popitem(last=False)
 
     def _stage_blobs(self, job_id: str, attempt: int,
                      py_blobs: list) -> Optional[str]:
@@ -397,11 +452,20 @@ class TaskRunner(RpcEndpoint):
             pass
 
     def _report(self, method: str, **kw: Any) -> bool:
-        try:
-            self._coord.call(method, **kw)
-            return True
-        except RpcError:
-            return False  # coordinator down: its recovery re-syncs state
+        """One-shot lifecycle reports (finish/failure/savepoint/plan).
+        Unlike heartbeats these have NO periodic retry behind them — a
+        single dropped connection would wedge the job on the
+        coordinator (RUNNING forever after a lost finish_job, found by
+        the chaos drive) — so the report itself retries a few times
+        before giving up to the coordinator's own recovery resync."""
+        for i in range(3):
+            try:
+                self._coord.call(method, **kw)
+                return True
+            except RpcError:
+                if i < 2:
+                    time.sleep(0.2 * (i + 1))
+        return False  # coordinator down: its recovery re-syncs state
 
 
 def main(argv: Optional[list] = None) -> None:

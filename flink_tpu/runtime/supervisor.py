@@ -24,8 +24,13 @@ def run_with_recovery(
     StreamExecutionEnvironment (sources/sinks re-created per attempt —
     the redeploy step). First attempt starts fresh (or per config
     restore); every retry restores from the latest checkpoint."""
+    from flink_tpu import faults
     from flink_tpu.obs.tracing import tracer
 
+    # chaos deploys configure injection through faults.* — install once
+    # per process (idempotent for an unchanged spec+seed, so rule
+    # counters survive the restarts the plan itself causes)
+    faults.install_from_config(config)
     strategy = from_config(config)
     attempt_conf = config
     attempt = 1
@@ -39,11 +44,16 @@ def run_with_recovery(
             delay = strategy.next_delay_ms()
             # recovery span: failure → backoff → redeployed (the restore
             # itself is the 'restore' span inside the next execute; ref:
-            # job recovery spans, SURVEY §6.1)
+            # job recovery spans, SURVEY §6.1). The metrics half rides
+            # the process-global recovery.attempts counter.
             attempt += 1
+            faults.record_recovery(job_name)
             with tracer.span("recovery", job=job_name, attempt=attempt,
                              delay_ms=delay,
-                             error=f"{type(e).__name__}: {e}"):
+                             error=f"{type(e).__name__}: {e}",
+                             injected=faults.is_injected(e)):
+                faults.fire("supervisor.restart", exc=RuntimeError,
+                            job=job_name, attempt=attempt)
                 sleep_fn(delay / 1000.0)
             attempt_conf = Configuration(config.to_dict()).set(
                 "execution.checkpointing.restore", "latest")

@@ -24,3 +24,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`: the deterministic chaos slice (fixed
+    # seeds, <60s) stays in; the long randomized soaks are `slow`
+    config.addinivalue_line(
+        "markers", "slow: long-running soak/benchmark tests, excluded "
+        "from the tier-1 `-m 'not slow'` run")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection chaos tests (flink_tpu.faults)"
+        " — every failure report prints the fault seed for replay")

@@ -493,3 +493,77 @@ class TestNoSkip:
                         {"v": np.array([1])})
         d = dict(b.take_fired())
         assert sorted(int(x) for x in d["match_start"]) == [10, 20]
+
+
+class TestNoSkipOverflowAtomicity:
+    """ADVICE r5: the NO_SKIP partial-buffer overflow used to raise
+    MID-batch, after earlier rank steps had mutated p_stage/p_ts and
+    appended matches — a caller catching the error and retrying would
+    double-emit. The batch must now be atomic: overflow leaves the
+    operator exactly as before the batch."""
+
+    @staticmethod
+    def _pattern():
+        return (Pattern.begin("a").where(lambda d: d["v"] == 0)
+                .followed_by("b").where(lambda d: d["v"] == 1)
+                .after_match("NO_SKIP"))
+
+    @staticmethod
+    def _snap_view(op):
+        s = op.snapshot_state()
+        return {k: (v.copy() if hasattr(v, "copy") else v)
+                for k, v in s.items() if k not in ("late_records",)}
+
+    def test_overflow_rolls_back_partials_and_matches(self):
+        import numpy as _np
+
+        op = CepOperator(self._pattern(), num_shards=4, slots_per_shard=64)
+        P = op.max_partials
+        # seed SOME live partials, and one completable pair, in batch 1
+        op.process_batch(np.array([1, 1, 2], np.int64),
+                         np.array([10, 20, 30], np.int64),
+                         {"v": np.array([0, 0, 0])})
+        before = self._snap_view(op)
+        # batch 2: key 1 floods past the partial budget (P more starts on
+        # top of the 2 live ones) AND carries a completion for key 2 plus
+        # earlier in-batch matches for key 1 — all must vanish on rollback
+        n = P + 1
+        keys = np.array([1] * n + [2], np.int64)
+        ts = np.arange(100, 100 + n + 1, dtype=np.int64)
+        vals = np.array([0] * n + [1])
+        with pytest.raises(RuntimeError, match="partial-buffer overflow"):
+            op.process_batch(keys, ts, {"v": vals})
+        after = self._snap_view(op)
+        for k, v in before.items():
+            if isinstance(v, _np.ndarray):
+                assert (after[k] == v).all(), f"state {k} mutated"
+        assert op.take_fired() is None, "overflow leaked matches"
+
+    def test_recovery_after_overflow_matches_fresh_run(self):
+        """After a rolled-back overflow the operator keeps working: the
+        subsequent (non-overflowing) batches produce exactly what a
+        fresh operator fed only the good batches produces."""
+        good1 = (np.array([1, 1], np.int64), np.array([10, 20], np.int64),
+                 {"v": np.array([0, 0])})
+        good2 = (np.array([1], np.int64), np.array([200], np.int64),
+                 {"v": np.array([1])})
+
+        op = CepOperator(self._pattern(), num_shards=4, slots_per_shard=64)
+        op.process_batch(*good1)
+        P = op.max_partials
+        n = P + 1
+        with pytest.raises(RuntimeError, match="partial-buffer overflow"):
+            op.process_batch(np.array([1] * n, np.int64),
+                             np.arange(100, 100 + n, dtype=np.int64),
+                             {"v": np.zeros(n, np.int64)})
+        op.process_batch(*good2)
+        got = dict(op.take_fired())
+
+        ref = CepOperator(self._pattern(), num_shards=4, slots_per_shard=64)
+        ref.process_batch(*good1)
+        ref.process_batch(*good2)
+        want = dict(ref.take_fired())
+        assert sorted(map(int, got["match_start"])) == sorted(
+            map(int, want["match_start"]))
+        assert sorted(map(int, got["match_end"])) == sorted(
+            map(int, want["match_end"]))

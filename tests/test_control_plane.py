@@ -260,7 +260,7 @@ class TestSchedulerExecutionGraph:
         def __init__(self):
             self.deployed = []
 
-        def rpc_run_job(self, job_id, entry, config=None, attempt=1):
+        def rpc_run_job(self, job_id, entry, config=None, attempt=1, **kw):
             self.deployed.append((job_id, attempt))
             return {"accepted": True}
 
@@ -419,7 +419,7 @@ class TestActiveProvisioning:
             self.deployed = []
             self.savepoints = []
 
-        def rpc_run_job(self, job_id, entry, config=None, attempt=1):
+        def rpc_run_job(self, job_id, entry, config=None, attempt=1, **kw):
             self.deployed.append((job_id, attempt))
             return {"accepted": True}
 
@@ -501,3 +501,98 @@ class TestActiveProvisioning:
             c.close()
         finally:
             srv.close(); gw1.close(); gw2.close()
+
+
+class TestRetryIdempotence:
+    """The RpcClient transport retry re-delivers requests whose response
+    was lost; the deploy/savepoint surfaces must absorb duplicates, not
+    re-execute or fail them."""
+
+    def test_run_job_duplicate_of_completed_push_not_reexecuted(self):
+        from flink_tpu.runtime.runner import TaskRunner
+
+        r = TaskRunner("127.0.0.1", 1, runner_id="idem")
+        # the push ran to completion and its record was popped; the
+        # token-keyed tombstone is what's left
+        r._done_attempts[("j1", 3, "tok-abc")] = True
+        resp = r.rpc_run_job(job_id="j1", entry="x:y", attempt=3,
+                             deploy_token="tok-abc")
+        assert resp == {"accepted": True, "runner_id": "idem",
+                        "duplicate": True}
+        assert "j1" not in r._jobs  # nothing re-spawned
+
+    def test_fresh_submission_of_finished_job_id_still_runs(self):
+        """A NEW submission reusing a finished job's id carries a fresh
+        deploy token and must execute, not be swallowed by the old
+        push's tombstone."""
+        from flink_tpu.runtime.runner import TaskRunner
+
+        r = TaskRunner("127.0.0.1", 1, runner_id="idem4")
+        r._done_attempts[("nightly", 1, "tok-old")] = True
+        resp = r.rpc_run_job(job_id="nightly", entry="x:y", attempt=1,
+                             deploy_token="tok-new")
+        assert resp["accepted"] and not resp.get("duplicate")
+        assert "nightly" in r._jobs  # a real worker thread was spawned
+        r._jobs["nightly"]["cancel"].set()
+        r._jobs["nightly"]["thread"].join(timeout=30)
+
+    def test_run_job_duplicate_of_running_attempt_accepted(self):
+        import threading
+
+        from flink_tpu.runtime.runner import SavepointRequest, TaskRunner
+
+        r = TaskRunner("127.0.0.1", 1, runner_id="idem2")
+        r._jobs["j2"] = {"cancel": threading.Event(), "attempt": 2,
+                         "savepoint": SavepointRequest(r, "j2"),
+                         "config": {}}
+        resp = r.rpc_run_job(job_id="j2", entry="x:y", attempt=2)
+        assert resp["accepted"] and resp.get("duplicate")
+        # a STALE attempt is still rejected
+        assert not r.rpc_run_job(job_id="j2", entry="x:y",
+                                 attempt=1)["accepted"]
+
+    def test_trigger_savepoint_duplicate_request_is_ok(self):
+        import threading
+
+        from flink_tpu.runtime.runner import SavepointRequest, TaskRunner
+
+        r = TaskRunner("127.0.0.1", 1, runner_id="idem3")
+        r._jobs["j3"] = {
+            "cancel": threading.Event(), "attempt": 1,
+            "savepoint": SavepointRequest(r, "j3"),
+            "config": {"execution.checkpointing.interval": 1000},
+        }
+        assert r.rpc_trigger_savepoint("j3", stop=True,
+                                       token="tok-1")["ok"]
+        # same request re-delivered (transport retry): absorbed as ok
+        dup = r.rpc_trigger_savepoint("j3", stop=True, token="tok-1")
+        assert dup["ok"] and dup.get("duplicate")
+        # a DIFFERENT request while one is pending: still refused
+        assert not r.rpc_trigger_savepoint("j3", stop=False,
+                                           token="tok-2")["ok"]
+
+
+class TestFaultPlanConfigLifecycle:
+    def test_empty_spec_uninstalls_config_plan(self):
+        from flink_tpu import faults
+
+        chaos = Configuration({"faults.inject": "rpc.client.send=drop x1",
+                               "faults.seed": 5})
+        clean = Configuration({})
+        try:
+            assert faults.install_from_config(chaos) is not None
+            assert faults.active_plan() is not None
+            # the next job's config has no faults.*: the plan must not
+            # leak into it
+            assert faults.install_from_config(clean) is None
+            assert faults.active_plan() is None
+        finally:
+            faults.clear()
+
+    def test_empty_spec_leaves_test_activated_plan_alone(self):
+        from flink_tpu import faults
+
+        plan = faults.FaultPlan(seed=1).rule("x.y", "raise")
+        with plan.activate():
+            assert faults.install_from_config(Configuration({})) is None
+            assert faults.active_plan() is plan

@@ -229,7 +229,7 @@ class TestAttemptFencing:
         # stale dialer (attempt 1) connects first and must NOT occupy
         # peer slot 1
         stale = _socket.create_connection(("127.0.0.1", fresh[0].port))
-        stale.sendall(bytes([1]) + _struct.pack(">I", 1))
+        stale.sendall(bytes([1]) + _struct.pack(">I", 1) + b"\x00")
         time.sleep(0.1)
 
         done = []
@@ -334,3 +334,149 @@ class TestTier5TwoProcessQ5:
         for i, p in enumerate(ps):
             assert p.returncode == 0, f"p{i} failed:\n{outs[i][-3000:]}"
         assert _collect(tmp_path, 2) == golden
+
+
+class TestExchangeSecurity:
+    """ADVICE r5 medium: the exchange port was an unauthenticated RCE
+    surface on cross-host (0.0.0.0) deployments — frames decode through
+    blobformat, whose __pickle__ escape deserializes attacker pickle.
+    Closed two independent ways: an HMAC-over-hello shared secret
+    admission check, and a frame decoder that rejects the pickle escape
+    outright."""
+
+    def test_unauthenticated_hello_rejected(self):
+        """A dialer that knows the wire format but not the secret must
+        be dropped at the handshake, while the real (keyed) peers still
+        form the mesh."""
+        import socket as _socket
+        import struct as _struct
+        import threading
+
+        n = 2
+        exs = [DcnExchange(i, n, attempt=1, secret="job-secret")
+               for i in range(n)]
+        peers = [f"127.0.0.1:{e.port}" for e in exs]
+
+        # attacker: well-formed keyed hello, garbage MAC
+        bad = _socket.create_connection(("127.0.0.1", exs[0].port))
+        bad.sendall(bytes([1]) + _struct.pack(">I", 1) + b"\x01"
+                    + b"\x00" * 32)
+        time.sleep(0.1)
+
+        out = []
+
+        def run(i):
+            exs[i].connect(peers, timeout_s=10)
+            p, m = exs[i].exchange({}, {"pid": i})
+            out.append([mm.get("pid") for mm in m])
+
+        ths = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=20)
+        assert out == [[0, 1], [0, 1]]  # real peers, not the attacker
+        bad.settimeout(2)
+        assert bad.recv(1) == b"", "unauthenticated hello not dropped"
+        bad.close()
+        for e in exs:
+            e.close()
+
+    def test_secretless_hello_against_keyed_listener_rejected(self):
+        """A peer declaring no auth (flag 0) to a keyed listener must
+        not be admitted — closed at the handshake, before any frame
+        bytes are interpreted."""
+        import socket as _socket
+        import struct as _struct
+
+        ex = DcnExchange(0, 2, attempt=1, secret="job-secret")
+        legacy = _socket.create_connection(("127.0.0.1", ex.port))
+        legacy.sendall(bytes([1]) + _struct.pack(">I", 1) + b"\x00")
+        raw = blobformat.encode({"data": None, "meta": {}})
+        legacy.sendall(_struct.pack(">Q", len(raw)) + raw)
+        legacy.settimeout(2)
+        try:
+            got = legacy.recv(1)
+        except ConnectionResetError:
+            got = b""  # hard reset is rejection too
+        assert got == b"", "secretless hello not dropped"
+        assert 1 not in ex._in
+        legacy.close()
+        ex.close()
+
+    def test_keyed_hello_against_unkeyed_listener_rejected(self):
+        """The asymmetric rollout in the other direction: a keyed
+        dialer hitting an UNKEYED listener is closed cleanly at the
+        handshake — its 32 MAC bytes are drained, never parsed as a
+        frame length (which would hang or try a huge allocation)."""
+        import hmac as _hmac2
+        import socket as _socket
+        import struct as _struct
+
+        ex = DcnExchange(0, 2, attempt=1)  # no secret
+        keyed = _socket.create_connection(("127.0.0.1", ex.port))
+        hello = bytes([1]) + _struct.pack(">I", 1) + b"\x01"
+        keyed.sendall(hello + _hmac2.new(b"other-secret", hello,
+                                         "sha256").digest())
+        keyed.settimeout(2)
+        try:
+            got = keyed.recv(1)
+        except ConnectionResetError:
+            got = b""
+        assert got == b"", "keyed hello not rejected by unkeyed listener"
+        assert 1 not in ex._in
+        keyed.close()
+        ex.close()
+
+    def test_pickle_escape_frame_rejected(self):
+        """A frame smuggling a __pickle__ escape must fail the decode
+        loudly instead of deserializing attacker-controlled pickle."""
+        import socket as _socket
+        import struct as _struct
+
+        # an object-dtype array routes through the __pickle__ escape —
+        # the exact in-band vector an attacker's crafted frame uses
+        evil = np.array([{"x": 1}], dtype=object)
+        raw = blobformat.encode({"data": evil, "meta": {}})
+        assert b"__pickle__" in raw  # the attack vector exists in-band
+
+        ex = DcnExchange(0, 2, attempt=1)
+        s = _socket.create_connection(("127.0.0.1", ex.port))
+        s.sendall(bytes([1]) + _struct.pack(">I", 1)
+                  + b"\x00")  # valid unkeyed hello
+        deadline = time.time() + 5
+        while 1 not in ex._in and time.time() < deadline:
+            time.sleep(0.02)
+        assert 1 in ex._in
+        s.sendall(_struct.pack(">Q", len(raw)) + raw)
+        with pytest.raises(ValueError, match="__pickle__ escape rejected"):
+            ex.exchange({}, {})
+        s.close()
+        ex.close()
+
+    def test_numeric_frames_unaffected_by_pickle_rejection(self):
+        """The production payload shape (numeric arrays + scalar meta)
+        round-trips identically under allow_pickle=False."""
+        payload = {"data": {"k": np.arange(5, dtype=np.int64),
+                            "v": np.linspace(0, 1, 5)},
+                   "meta": {"wm": 123, "done": False, "persisted": -1}}
+        raw = blobformat.encode(payload)
+        got = blobformat.decode(raw, allow_pickle=False)
+        assert got["meta"] == payload["meta"]
+        assert (got["data"]["k"] == payload["data"]["k"]).all()
+        assert (got["data"]["v"] == payload["data"]["v"]).all()
+
+    def test_string_columns_cross_without_pickle(self):
+        """Text columns (object-dtype string arrays, the socket/file
+        source shape) encode via the native __strs__ tag — no pickle
+        escape — so they survive the exchange's allow_pickle=False."""
+        payload = {"data": {"line": np.array(["a", "bb", "ccc"],
+                                             dtype=object),
+                            "k": np.arange(3, dtype=np.int64)},
+                   "meta": {"wm": 7}}
+        raw = blobformat.encode(payload)
+        assert b"__pickle__" not in raw
+        got = blobformat.decode(raw, allow_pickle=False)
+        assert list(got["data"]["line"]) == ["a", "bb", "ccc"]
+        assert got["data"]["line"].dtype == object
+        assert (got["data"]["k"] == payload["data"]["k"]).all()

@@ -90,7 +90,7 @@ class _FakeRunnerGateway(RpcEndpoint):
     def __init__(self):
         self.deployed = []
 
-    def rpc_run_job(self, job_id, entry, config=None, attempt=1):
+    def rpc_run_job(self, job_id, entry, config=None, attempt=1, **kw):
         self.deployed.append((job_id, attempt, dict(config or {})))
         return {"accepted": True}
 
@@ -295,3 +295,57 @@ class TestStorageWriteFencing:
         st.save(1, {"n": 1})
         st.save(2, {"n": 2})
         assert st.latest().checkpoint_id == 2
+
+
+class TestEpochQualifiedFinalNames:
+    """ADVICE r5 low (storage.py fence race): _check_fence is
+    check-then-rename — a deposed leader whose fence check passed just
+    before the successor's first write landed could still
+    delete-and-replace the successor's completed checkpoint of the same
+    id. Final names are now epoch-qualified (chk-<id>.e<epoch>) under
+    fencing, so the stale rename lands on a DIFFERENT path and the
+    successor's directory is physically unclobberable."""
+
+    def test_raced_stale_writer_cannot_clobber_successor(self, tmp_path):
+        from flink_tpu.checkpoint.storage import FsCheckpointStorage
+
+        old = FsCheckpointStorage(str(tmp_path), "job", epoch=1)
+        new = FsCheckpointStorage(str(tmp_path), "job", epoch=2)
+        new.save(5, {"who": "new", "n": 5})
+        # simulate the race: the old writer's fence check ran BEFORE the
+        # successor's manifest landed (so it passed), and its rename
+        # fires now — neutralize the re-check to model that exact window
+        old._check_fence = lambda: None
+        old.save(5, {"who": "old", "n": 5})
+        # both directories exist under distinct epoch-qualified names...
+        import os as _os
+
+        names = sorted(n for n in _os.listdir(str(tmp_path / "job"))
+                       if n.startswith("chk-5"))
+        assert names == ["chk-5.e1", "chk-5.e2"]
+        # ...and resolution picks the successor's (highest epoch)
+        latest = new.latest()
+        assert (latest.checkpoint_id, latest.epoch) == (5, 2)
+        assert FsCheckpointStorage.load(latest)["who"] == "new"
+
+    def test_latest_orders_by_epoch_then_id(self, tmp_path):
+        """The epoch is the leadership fencing token: the newest
+        timeline outranks ANY id from a dead one. A deposed leader that
+        got further (higher ids) before losing the lease must not have
+        its late checkpoints eclipse the successor's — restoring the
+        dead timeline would rewind sources past output the live
+        timeline's 2PC sinks already committed."""
+        from flink_tpu.checkpoint.storage import FsCheckpointStorage
+
+        w1 = FsCheckpointStorage(str(tmp_path), "job", epoch=1)
+        w2 = FsCheckpointStorage(str(tmp_path), "job", epoch=2)
+        w2._check_fence = lambda: None  # keep both timelines writable
+        w1._check_fence = lambda: None
+        w1.save(1, {"n": 1})
+        w2.save(1, {"n": 1, "who": "new"})
+        w1.save(2, {"n": 2, "who": "old"})  # stale leader got further
+        assert [(h.checkpoint_id, h.epoch)
+                for h in w2.list_complete()] == [(1, 1), (2, 1), (1, 2)]
+        # the live (highest-epoch) timeline wins, not the dead higher id
+        assert (w2.latest().checkpoint_id, w2.latest().epoch) == (1, 2)
+        assert FsCheckpointStorage.load(w2.latest())["who"] == "new"

@@ -116,7 +116,7 @@ class TestRescaleLifecycle:
                 self.cancels = []
 
             def rpc_run_job(self, job_id, entry, config=None, attempt=1,
-                            py_blobs=None):
+                            py_blobs=None, **kw):
                 self.deployed.append((job_id, attempt))
                 return {"accepted": True}
 

@@ -1,0 +1,96 @@
+"""Restart-strategy backoff logic under an injected clock.
+
+The window pruning of FailureRateRestartStrategy and the
+reset-after-quiet-period of ExponentialDelayRestartStrategy are
+time-dependent paths that real-time tests cannot reach (an hour-long
+quiet period); the ``now_fn`` seam drives them with a fake clock (ref:
+the ManualClock the reference's *RestartBackoffTimeStrategyTest*s use).
+"""
+from flink_tpu.runtime.restart import (
+    ExponentialDelayRestartStrategy,
+    FailureRateRestartStrategy,
+)
+
+
+class FakeClock:
+    def __init__(self, t0: float = 1_000_000.0) -> None:
+        self.t = t0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> None:
+        self.t += seconds
+
+
+class TestFailureRateWindowPruning:
+    def test_failures_inside_window_exhaust_budget(self):
+        clk = FakeClock()
+        s = FailureRateRestartStrategy(max_failures=3, interval_ms=60_000,
+                                       delay_ms=100, now_fn=clk)
+        for _ in range(3):
+            assert s.can_restart()
+            assert s.next_delay_ms() == 100
+            clk.advance(1.0)
+        assert not s.can_restart()  # 3 failures within 60s: budget spent
+
+    def test_window_pruning_restores_budget(self):
+        clk = FakeClock()
+        s = FailureRateRestartStrategy(max_failures=3, interval_ms=60_000,
+                                       delay_ms=100, now_fn=clk)
+        for _ in range(3):
+            s.next_delay_ms()
+            clk.advance(1.0)
+        assert not s.can_restart()
+        # the oldest failure is 3s old; once it ages past the 60s window
+        # the budget frees exactly one slot
+        clk.advance(58.0)  # oldest now 61s old, the other two inside
+        assert s.can_restart()
+        s.next_delay_ms()
+        assert not s.can_restart()  # refilled slot spent again
+
+    def test_prune_is_by_age_not_count(self):
+        clk = FakeClock()
+        s = FailureRateRestartStrategy(max_failures=2, interval_ms=10_000,
+                                       now_fn=clk)
+        s.next_delay_ms()
+        clk.advance(11.0)  # first failure leaves the window entirely
+        s.next_delay_ms()
+        assert s.can_restart()  # only one failure inside the window
+
+
+class TestExponentialDelayReset:
+    def test_delay_doubles_to_cap(self):
+        clk = FakeClock()
+        s = ExponentialDelayRestartStrategy(
+            initial_ms=1000, max_ms=8000, multiplier=2.0,
+            reset_after_ms=3_600_000, now_fn=clk)
+        got = []
+        for _ in range(6):
+            got.append(s.next_delay_ms())
+            clk.advance(1.0)
+        assert got == [1000, 2000, 4000, 8000, 8000, 8000]
+
+    def test_quiet_period_resets_backoff(self):
+        clk = FakeClock()
+        s = ExponentialDelayRestartStrategy(
+            initial_ms=1000, max_ms=300_000, multiplier=2.0,
+            reset_after_ms=3_600_000, now_fn=clk)
+        for _ in range(4):
+            s.next_delay_ms()
+            clk.advance(60.0)
+        assert s.next_delay_ms() == 16_000
+        # a full quiet HOUR since the last failure: backoff starts over
+        clk.advance(3600.0)
+        assert s.next_delay_ms() == 1000
+        clk.advance(1.0)
+        assert s.next_delay_ms() == 2000
+
+    def test_just_under_quiet_period_keeps_backoff(self):
+        clk = FakeClock()
+        s = ExponentialDelayRestartStrategy(
+            initial_ms=1000, max_ms=300_000, multiplier=2.0,
+            reset_after_ms=3_600_000, now_fn=clk)
+        s.next_delay_ms()  # 1000
+        clk.advance(3599.0)  # one second short of the reset threshold
+        assert s.next_delay_ms() == 2000
