@@ -74,6 +74,18 @@ class StreamExecutionEnvironment:
     def _register(self, t: Transformation) -> None:
         self._transforms.append(t)
 
+    def set_runtime_mode(self, mode: str) -> "StreamExecutionEnvironment":
+        """'streaming' | 'batch' (ref: StreamExecutionEnvironment
+        .setRuntimeMode / execution.runtime-mode). Batch = bounded
+        execution: every source must report bounded=True; stages run
+        in topological waves over blocking columnar exchanges and
+        windows fire once at end-of-input (graph/compiler.py +
+        runtime/driver.py _run_batch). Validated at compile time."""
+        from flink_tpu.config import ExecutionOptions
+
+        self.config.set(ExecutionOptions.RUNTIME_MODE, mode)
+        return self
+
     # -- execution -------------------------------------------------------
     def execute(self, job_name: str = "job", cancel=None,
                 savepoint_request=None, transforms=None) -> "JobResult":

@@ -18,6 +18,16 @@ import numpy as np
 Batch = Tuple[Dict[str, np.ndarray], np.ndarray]  # (data fields, timestamps)
 
 
+def source_is_bounded(source: "Source") -> bool:
+    """Boundedness of a source instance (ref: Boundedness.BOUNDED /
+    CONTINUOUS_UNBOUNDED). The framework's sources all declare
+    ``bounded`` as a property; USER-defined sources sometimes spell it
+    as a plain method, which this tolerates rather than treating the
+    bound method object as truthy."""
+    b = source.bounded
+    return bool(b() if callable(b) else b)
+
+
 class Source:
     """A source produces numbered microbatches per split; position = batch
     index within the split (replay = start from a position)."""

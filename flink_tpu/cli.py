@@ -81,6 +81,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     runp.add_argument("--local", action="store_true",
                       help="execute in this process (LocalExecutor)")
     runp.add_argument("--job-id", default=None)
+    runp.add_argument("--runtime-mode", choices=("streaming", "batch"),
+                      default=None,
+                      help="execution.runtime-mode: 'batch' runs a "
+                           "fully bounded job in topological stage "
+                           "waves over blocking columnar exchanges "
+                           "(shorthand for --conf "
+                           "execution.runtime-mode=...)")
     runp.add_argument("--conf", action="append", default=[],
                       metavar="KEY=VALUE")
     runp.add_argument("--py-file", action="append", default=[],
@@ -111,6 +118,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.cmd == "run":
         job_id = args.job_id or f"job-{uuid.uuid4().hex[:8]}"
         conf = _parse_conf(args.conf)
+        if args.runtime_mode:
+            conf["execution.runtime-mode"] = args.runtime_mode
         if args.local:
             return _run_local(args.entry, conf, job_id)
         if not args.coordinator:

@@ -63,6 +63,20 @@ class FileSource(Source):
             raw = f.read()
         if isinstance(raw, str):
             raw = raw.encode("utf-8")
+        if getattr(self.format, "binary", False):
+            # self-framing binary format (columnar): the format owns
+            # block iteration — line-splitting would corrupt it. The
+            # replay position is the stored-block index; skip= elides
+            # decoding of already-consumed blocks.
+            for data in self.format.iter_batches(raw, skip=start_pos):
+                if self.ts_field is not None:
+                    ts = np.asarray(data[self.ts_field], np.int64)
+                else:
+                    now = np.int64(_time.time() * 1000)
+                    ts = np.full(len(next(iter(data.values()), [])),
+                                 now, np.int64)
+                yield data, ts
+            return
         lines = raw.split(b"\n")
         if lines and lines[-1] == b"":
             lines.pop()
@@ -79,6 +93,7 @@ class FileSource(Source):
                              now, np.int64)
             yield data, ts
 
+    @property
     def bounded(self) -> bool:
         return True
 
@@ -235,6 +250,7 @@ class SocketSource(Source):
     def splits(self) -> List[str]:
         return ["socket"]
 
+    @property
     def bounded(self) -> bool:
         return True  # ends when the producer disconnects
 

@@ -1,0 +1,168 @@
+"""Blocking shuffle — the batch-mode exchange plane.
+
+ref: runtime/io/network/partition/BoundedBlockingSubpartition + the
+BLOCKING ResultPartitionType (SURVEY §3.6 batch shuffles, §3.7
+blocking exchanges): in bounded execution an exchange edge is
+materialized in full before its consumer starts. This sits behind the
+same conceptual seam as the ICI collectives (``exchange/spi.py``) and
+the cross-host DCN plane (``exchange/dcn.py``) — a third data plane,
+for time rather than space: producer and consumer never run
+concurrently, so the "network" is node-local partition FILES in the
+self-contained columnar format (``formats_columnar.py``).
+
+Layout: ``<root>/<run>/edge-<u>-<v>/part-<p>.colb``. Keyed edges
+hash-route rows by the consumer's key column with the SAME hash the
+runtime exchange uses (``records.hash_keys_numpy``), so each partition
+file holds a disjoint key range and per-key record order is preserved
+(append order within a file = arrival order) — the property CEP /
+process-function consumers rely on. Timestamps ride as a reserved
+``__ts__`` column. Truncated/corrupt partitions fail the read loudly
+(ColumnarError) — a blocking exchange may never drop records.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import uuid
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.formats_columnar import (
+    ColumnarError,
+    ColumnarWriter,
+    infer_schema,
+    iter_file_blocks,
+)
+
+__all__ = ["BlockingShuffle", "EdgeWriter"]
+
+TS_COLUMN = "__ts__"
+
+
+class EdgeWriter:
+    """Spool of one blocking edge (u → v): appends arriving batches to
+    its partition files, sealed (footers written) before the consumer
+    stage starts. The schema is inferred from the first non-empty
+    batch and enforced on every later one — a mid-stream schema change
+    is a job bug and fails loudly."""
+
+    def __init__(self, directory: str, n_partitions: int,
+                 key_field: Optional[str]) -> None:
+        self.dir = directory
+        self.key_field = key_field
+        self.n_partitions = max(1, n_partitions) if key_field else 1
+        self._files: List[Optional[object]] = [None] * self.n_partitions
+        self._writers: List[Optional[ColumnarWriter]] = (
+            [None] * self.n_partitions)
+        self._schema = None
+        self.rows = 0
+        self.sealed = False
+        os.makedirs(directory, exist_ok=True)
+
+    def _writer(self, p: int) -> ColumnarWriter:
+        if self._writers[p] is None:
+            f = open(os.path.join(self.dir, f"part-{p:04d}.colb"), "wb")
+            self._files[p] = f
+            self._writers[p] = ColumnarWriter(f, self._schema)
+        return self._writers[p]
+
+    def write(self, data: Dict[str, np.ndarray], ts: np.ndarray,
+              valid: np.ndarray) -> None:
+        assert not self.sealed, "write into a sealed blocking edge"
+        ts = np.asarray(ts, np.int64)
+        valid = np.asarray(valid, bool)
+        if not valid.all():
+            data = {k: np.asarray(v)[valid] for k, v in data.items()}
+            ts = ts[valid]
+        if not len(ts):
+            return
+        row = dict(data)
+        row[TS_COLUMN] = ts
+        if self._schema is None:
+            self._schema = infer_schema(row)
+        if self.n_partitions == 1:
+            self._writer(0).write_batch(row)
+        else:
+            from flink_tpu.records import hash_keys_numpy
+
+            keys = np.asarray(data[self.key_field], np.int64)
+            dest = hash_keys_numpy(keys) % self.n_partitions
+            for p in np.unique(dest):
+                m = dest == p
+                self._writer(int(p)).write_batch(
+                    {k: v[m] for k, v in row.items()})
+        self.rows += len(ts)
+
+    def seal(self) -> None:
+        """Write footers + close — after this the partitions are
+        complete, self-validating files (the finished-partition
+        signal; ref: BoundedBlockingSubpartition.finish)."""
+        if self.sealed:
+            return
+        for w, f in zip(self._writers, self._files):
+            if w is not None:
+                w.close()
+                f.close()
+        self.sealed = True
+
+    @property
+    def bytes_written(self) -> int:
+        return sum(w.bytes_written for w in self._writers if w is not None)
+
+    def read(self) -> Iterator[Tuple[Dict[str, np.ndarray], np.ndarray]]:
+        """Replay the sealed partitions block-at-a-time, partition by
+        partition (per-key order preserved — each key lives in exactly
+        one partition file)."""
+        assert self.sealed, "read of an unsealed blocking edge"
+        for p, w in enumerate(self._writers):
+            if w is None:
+                continue
+            path = os.path.join(self.dir, f"part-{p:04d}.colb")
+            # streaming read: one block resident at a time — a sealed
+            # partition can be far larger than host memory headroom
+            with open(path, "rb") as f:
+                for block in iter_file_blocks(f,
+                                              expect_schema=self._schema):
+                    ts = block.pop(TS_COLUMN)
+                    yield block, np.asarray(ts, np.int64)
+
+
+class BlockingShuffle:
+    """All blocking edges of one batch run, spooled under a unique run
+    directory (the analogue of one job's shuffle files under
+    io.tmp.dirs)."""
+
+    def __init__(self, root: str, job_name: str, n_partitions: int = 1,
+                 cleanup: bool = True) -> None:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                       for c in job_name)[:64]
+        self.dir = os.path.join(root, f"{safe}-{uuid.uuid4().hex[:8]}")
+        self.n_partitions = n_partitions
+        self._cleanup = cleanup
+        self._edges: Dict[Tuple[int, int], EdgeWriter] = {}
+        os.makedirs(self.dir, exist_ok=True)
+
+    def open_edge(self, u: int, v: int,
+                  key_field: Optional[str] = None) -> EdgeWriter:
+        ew = EdgeWriter(os.path.join(self.dir, f"edge-{u}-{v}"),
+                        self.n_partitions, key_field)
+        self._edges[(u, v)] = ew
+        return ew
+
+    def edge(self, u: int, v: int) -> EdgeWriter:
+        return self._edges[(u, v)]
+
+    @property
+    def bytes_written(self) -> int:
+        return sum(e.bytes_written for e in self._edges.values())
+
+    @property
+    def rows_spooled(self) -> int:
+        return sum(e.rows for e in self._edges.values())
+
+    def close(self) -> None:
+        for e in self._edges.values():
+            e.seal()  # close file handles even on abort
+        if self._cleanup:
+            shutil.rmtree(self.dir, ignore_errors=True)

@@ -24,9 +24,16 @@ Batch = Dict[str, np.ndarray]
 class Format:
     """(De)serialization schema seam. ``fields`` names the columns in
     order; deserialize parses a text block; serialize renders a batch
-    back to bytes (the sink half)."""
+    back to bytes (the sink half).
+
+    ``binary``: False for line-framed text formats (a file of them can
+    be split on newlines — FileSource's batching unit); True for
+    self-framing binary formats (the columnar format in
+    ``formats_columnar.py``), which FileSource must hand the raw file
+    image and let the format iterate its own record blocks."""
 
     fields: Tuple[str, ...]
+    binary = False
 
     def deserialize(self, data: bytes) -> Batch:  # pragma: no cover
         raise NotImplementedError

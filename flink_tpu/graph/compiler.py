@@ -70,9 +70,70 @@ class ExecutionPlan:
     sources: List[int]
     topo_order: List[int]
     watermark_strategy: WatermarkStrategy
+    # bounded-execution plan (execution.runtime-mode=batch, SURVEY
+    # §3.7): stage_of levels every node into a topological wave;
+    # blocking_edges are the (upstream, stateful-consumer) edges the
+    # driver materializes through the blocking shuffle instead of
+    # pushing through. Empty/default in streaming mode.
+    runtime_mode: str = "streaming"
+    stage_of: Dict[int, int] = dataclasses.field(default_factory=dict)
+    blocking_edges: List[Tuple[int, int]] = dataclasses.field(
+        default_factory=list)
 
     def node(self, nid: int) -> ExecNode:
         return self.nodes[nid]
+
+
+# Stateful operator kinds whose input edge becomes BLOCKING in batch
+# mode — the exchange boundary of the reference's batch shuffles
+# (§3.6): the consumer must not see a single record until the producer
+# stage ran to completion. Chains/unions/partitions/sinks stay
+# pipelined within their stage (the isChainable rule: only exchange
+# edges block). async_io blocks too: its in-flight draining is driven
+# by the per-step watermark pass that batch mode deliberately skips,
+# so the batch driver owns its submit/poll cycle at a stage head.
+STAGE_HEAD_KINDS = frozenset((
+    "window", "session", "join", "count_window", "window_all",
+    "process", "cep", "evicting_window", "global_agg",
+    "broadcast_connect", "async_io",
+))
+
+
+def assign_stages(
+    nodes: Dict[int, ExecNode], topo: List[int],
+) -> Tuple[Dict[int, int], List[Tuple[int, int]]]:
+    """Level every node into topological waves: a stateful consumer
+    lives one wave below its producers (its input edges block); every
+    other node joins its deepest producer's wave (pipelined). The wave
+    number IS the scheduling order (runtime/scheduler.py runs waves
+    sequentially — the topological-wave analogue of batch pipelined-
+    region scheduling over BLOCKING result partitions)."""
+    upstream: Dict[int, List[int]] = {nid: [] for nid in nodes}
+    for n in nodes.values():
+        for d in n.downstream:
+            upstream[d].append(n.id)
+    stage_of: Dict[int, int] = {}
+    blocking: List[Tuple[int, int]] = []
+    for nid in topo:
+        ups = upstream[nid]
+        base = max((stage_of[u] for u in ups), default=0)
+        if nodes[nid].kind in STAGE_HEAD_KINDS:
+            if len(set(ups)) != len(ups):
+                # s.join(s) / s.connect(s): both inputs are the SAME
+                # producer node, so the two logical edges collapse onto
+                # one (u, v) key — the partition-file exchange cannot
+                # tell the sides apart. Reject rather than corrupt.
+                raise NotImplementedError(
+                    f"batch mode does not support a two-input operator "
+                    f"({nodes[nid].kind} {nodes[nid].name!r}) fed twice "
+                    "by the same upstream node (self-join/self-connect)"
+                    " — materialize one side through a distinct map "
+                    "first")
+            stage_of[nid] = base + 1
+            blocking.extend((u, nid) for u in ups)
+        else:
+            stage_of[nid] = base
+    return stage_of, blocking
 
 
 def compile_job(
@@ -234,8 +295,30 @@ def compile_job(
         raise ValueError("job has no sinks (add_sink/print/collect)")
 
     topo = _topo_order(nodes, sources)
+
+    from flink_tpu.config import ExecutionOptions
+
+    mode = str(config.get(ExecutionOptions.RUNTIME_MODE)).strip().lower()
+    if mode not in ("streaming", "batch"):
+        raise ValueError(
+            f"execution.runtime-mode must be 'streaming' or 'batch', "
+            f"got {mode!r}")
+    stage_of: Dict[int, int] = {}
+    blocking: List[Tuple[int, int]] = []
+    if mode == "batch":
+        from flink_tpu.api.sources import source_is_bounded
+
+        unbounded = [nodes[sid].name or str(sid) for sid in sources
+                     if not source_is_bounded(nodes[sid].source)]
+        if unbounded:
+            raise ValueError(
+                "execution.runtime-mode=batch requires every source to "
+                f"be bounded; unbounded source(s): {unbounded} (run "
+                "them in streaming mode, or bound the generator)")
+        stage_of, blocking = assign_stages(nodes, topo)
     return ExecutionPlan(nodes=nodes, sources=sources, topo_order=topo,
-                         watermark_strategy=default_wm)
+                         watermark_strategy=default_wm, runtime_mode=mode,
+                         stage_of=stage_of, blocking_edges=blocking)
 
 
 def _topo_order(nodes: Dict[int, ExecNode], sources: List[int]) -> List[int]:

@@ -29,7 +29,11 @@ from flink_tpu.config import (
     PipelineOptions,
     StateOptions,
 )
-from flink_tpu.graph.compiler import ExecNode, ExecutionPlan
+from flink_tpu.graph.compiler import (
+    STAGE_HEAD_KINDS,
+    ExecNode,
+    ExecutionPlan,
+)
 from flink_tpu.time.watermarks import LONG_MIN, WatermarkTracker, make_generator
 
 Batch = Tuple[Dict[str, np.ndarray], np.ndarray, np.ndarray]  # data, ts, valid
@@ -81,6 +85,14 @@ class Driver:
             getattr(op, "exchange_overflow", 0)
             for op in self._ops.values()))
         self._eps_meter = g.meter("records_per_sec")
+        # FIRE→SINK latency, not ingest→sink: the clock starts when the
+        # watermark advance DISPATCHES a fired window (see
+        # _emit_fired_sync) and stops at sink delivery — the
+        # latency-marker analogue (LatencyMarker.java). Time a record
+        # spends queued before its step dispatches is NOT included;
+        # artifacts quoting this metric must say "fire→sink", never
+        # "end-to-end" (VERDICT r05 weak #3; BASELINE.md states the
+        # same).
         self._lat_hist = g.histogram("emit_latency_ms")
         self._wm_lag = g.gauge("watermark_lag_ms")
         # adaptive microbatch debloater (ref: BufferDebloater): when a
@@ -108,6 +120,11 @@ class Driver:
         # re-armed by, a later run on the same Driver.
         self._drain_discard = [False]
         self._stateless_cache: Dict[int, bool] = {}
+        # batch (bounded) mode: open blocking-edge writers, keyed by
+        # (from_node, to_node); _push diverts matching edges into the
+        # shuffle spool instead of the consumer. Always a dict (empty
+        # on the streaming path) so the hot-path check is one truth test.
+        self._batch_capture: Dict[Tuple[int, int], Any] = {}
         import threading
 
         # set while a barrier (checkpoint / end-of-input) is waiting on
@@ -935,6 +952,31 @@ class Driver:
         self._cancel = cancel
         self._savepoint_request = savepoint_request
         self.last_savepoint = None
+        if self.plan.runtime_mode == "batch":
+            # bounded-mode recovery is re-execution (ref: batch jobs
+            # have no checkpoints — RestartAllFailoverStrategy re-runs
+            # the regions); a configured interval/restore is a config
+            # contradiction, not something to silently ignore
+            if self.config.get(CheckpointingOptions.INTERVAL) > 0:
+                raise ValueError(
+                    "execution.checkpointing.interval is incompatible "
+                    "with execution.runtime-mode=batch (bounded-mode "
+                    "recovery is re-execution; 2PC sinks commit once "
+                    "at end of input)")
+            restore = self.config.get(CheckpointingOptions.RESTORE)
+            if restore == "latest":
+                # coordinator/supervisor redeploys inject
+                # restore=latest on every retry attempt; for a batch
+                # job there is never a checkpoint to resume, and its
+                # documented recovery model IS re-execution — degrade
+                # to a fresh run instead of burning the restart budget
+                # on a config error that masks the original failure
+                self.config.set(CheckpointingOptions.RESTORE, "")
+            elif restore:
+                raise ValueError(
+                    "execution.checkpointing.restore is incompatible "
+                    "with execution.runtime-mode=batch (nothing "
+                    "checkpoints in batch mode — re-run the job)")
         import queue
         import threading
 
@@ -1047,6 +1089,12 @@ class Driver:
         # in the leader's shard ranges)
         self._dcn = None
         if int(self.config.get(ClusterOptions.NUM_PROCESSES)) > 1:
+            if self.plan.runtime_mode == "batch":
+                raise NotImplementedError(
+                    "execution.runtime-mode=batch is single-process in "
+                    "v1 — the DCN rendezvous is a per-step streaming "
+                    "protocol; cross-host batch needs a partition-file "
+                    "transfer plane (out of scope, see COMPONENTS #57)")
             self._dcn = self._dcn_connect()
 
         if restore:
@@ -1083,7 +1131,11 @@ class Driver:
         prefetch = self.config.get(PipelineOptions.SOURCE_PREFETCH)
         for sid in self.plan.sources:
             n = self.plan.node(sid)
-            self._maybe_chain_device_source(sid, n)
+            if self.plan.runtime_mode != "batch":
+                # batch mode keeps the host materialization path: the
+                # devgen chain fuses per-step fire logic into the step
+                # program, which final-only firing deliberately skips
+                self._maybe_chain_device_source(sid, n)
             splits = n.source.splits()
             owned = self._enumerate_owned(sid, len(splits))
             self._owned_splits[sid] = owned
@@ -1111,6 +1163,9 @@ class Driver:
                                          self._positions[sid].get(i, 0))
                 d[i] = (_Prefetcher(it, depth=prefetch)
                         if prefetch > 0 else it)
+
+        if self.plan.runtime_mode == "batch":
+            return self._run_batch(job_name, srcs, drain)
 
         last_chk = time.time()
         prof = self.prof
@@ -1254,16 +1309,28 @@ class Driver:
             # whole, so commit-at-end preserves exactly-once (ref:
             # StreamTask.endInput → final checkpoint committing
             # pending transactions even with checkpointing disabled).
-            # The epoch id must not collide with ANY earlier run's ids
-            # in a reused sink directory (a replayed id silently drops
-            # this run's staged output as "already committed") — a ms
-            # timestamp is unique across runs and above any
-            # coordinator-numbered epoch.
-            final_epoch = int(time.time() * 1000)
-            for n in self.plan.nodes.values():
-                if n.kind == "sink" and hasattr(n.sink, "prepare_commit"):
-                    n.sink.prepare_commit(final_epoch)
-                    n.sink.notify_checkpoint_complete(final_epoch)
+            self._commit_final_epoch()
+        return self._finish_run(job_name, drain)
+
+    def _commit_final_epoch(self) -> None:
+        """2PC sinks' terminal commit for a bounded run without
+        checkpointing — end of input is the terminal barrier. The epoch
+        id must not collide with ANY earlier run's ids in a reused sink
+        directory (a replayed id silently drops this run's staged
+        output as "already committed") — a ms timestamp is unique
+        across runs and above any coordinator-numbered epoch."""
+        final_epoch = int(time.time() * 1000)
+        for n in self.plan.nodes.values():
+            if n.kind == "sink" and hasattr(n.sink, "prepare_commit"):
+                n.sink.prepare_commit(final_epoch)
+                n.sink.notify_checkpoint_complete(final_epoch)
+
+    def _finish_run(self, job_name: str, drain) -> "JobResult":
+        """Shared happy-path epilogue of both runtime modes: stop the
+        drain, close sinks/ops/servers, fold counters into the
+        JobResult."""
+        from flink_tpu.api.environment import JobResult
+
         self._emit_q.put(None)
         drain.join()
         self._emit_q = None
@@ -1291,6 +1358,183 @@ class Driver:
                 final[f"profile.op{nid}.{k}"] = final.get(
                     f"profile.op{nid}.{k}", 0.0) + v
         return JobResult(job_name, final)
+
+    # -- bounded execution (execution.runtime-mode=batch) ----------------
+    def _run_batch(self, job_name: str, srcs, drain) -> "JobResult":
+        """Wave-ordered bounded execution (SURVEY §3.6/§3.7): stages
+        run in the topological order the compiler leveled them into
+        (runtime/scheduler.py BatchStageScheduler); every blocking edge
+        materializes in full as columnar partition files
+        (exchange/blocking.py) before its consumer starts; stateful
+        operators fire exactly ONCE, at end-of-input — no per-step fire
+        scans, which is the mode's entire performance case on bounded
+        inputs."""
+        from flink_tpu.config import ExecutionOptions
+        from flink_tpu.exchange.blocking import BlockingShuffle
+        from flink_tpu.runtime.scheduler import BatchStageScheduler
+
+        cfg = self.config
+        # re-execution exactly-once: a crashed prior attempt (kill -9
+        # skips run()'s cleanup) may have left staged rows in reused
+        # sink directories; this run must commit ONLY its own output
+        self._abort_sinks()
+        sched = BatchStageScheduler(self.plan)
+        shuffle = BlockingShuffle(
+            str(cfg.get(ExecutionOptions.BATCH_SHUFFLE_DIR)), job_name,
+            n_partitions=int(cfg.get(
+                ExecutionOptions.BATCH_SHUFFLE_PARTITIONS)),
+            cleanup=bool(cfg.get(ExecutionOptions.BATCH_SHUFFLE_CLEANUP)))
+        # every writer opens up front and stays open across waves (a
+        # union may merge wave-0 and wave-1 producers into one blocking
+        # edge); an edge seals exactly when its CONSUMER's wave starts —
+        # by then every producer wave has finished
+        for u, v in self.plan.blocking_edges:
+            self._batch_capture[(u, v)] = shuffle.open_edge(
+                u, v, key_field=self._edge_key_field(u, v))
+        t0 = time.perf_counter()
+        try:
+            for stage in sched.waves:
+                self._batch_reject_savepoint()
+                for u, v in stage.in_edges:
+                    self._batch_capture.pop((u, v)).seal()
+                sched.start(stage)
+                if stage.index == 0:
+                    for sid in stage.heads:
+                        self._batch_drain_source(sid, srcs[sid], job_name)
+                else:
+                    for v in stage.heads:
+                        self._batch_feed_head(v, stage, shuffle,
+                                              job_name)
+                self._batch_finalize_wave(stage)
+                sched.finish(stage)
+            self.metrics["shuffle_bytes_spooled"] = shuffle.bytes_written
+            self.metrics["shuffle_rows_spooled"] = shuffle.rows_spooled
+            self.metrics["batch_waves"] = len(sched.waves)
+            # a request armed DURING the last wave must fail too —
+            # the streaming path covers this window with its post-loop
+            # _maybe_take_savepoint; returning FINISHED while the
+            # requester waits forever would be the silent alternative
+            self._batch_reject_savepoint()
+        finally:
+            self._batch_capture = {}
+            shuffle.close()
+        self._commit_final_epoch()
+        self.metrics["batch_wall_s"] = round(time.perf_counter() - t0, 3)
+        return self._finish_run(job_name, drain)
+
+    def _batch_reject_savepoint(self) -> None:
+        """The runner rejects savepoint triggers for jobs without
+        checkpoint storage (which batch jobs are), so only a direct
+        caller can arm the request — fail loudly rather than leave the
+        requester waiting on a completion that can never come."""
+        if (self._savepoint_request is not None
+                and self._savepoint_request.is_set()):
+            raise ValueError(
+                "savepoints are not supported in "
+                "execution.runtime-mode=batch (nothing checkpoints; "
+                "recovery is re-execution)")
+
+    def _edge_key_field(self, u: int, v: int) -> Optional[str]:
+        """Key column routing a blocking edge's partition files (None =
+        single partition). Join edges key on their side's column."""
+        n = self.plan.node(v)
+        if n.kind == "join":
+            t = n.window_transform
+            return t.left_key if u == n.left_input else t.right_key
+        if n.kind in ("window", "session", "count_window", "process",
+                      "cep", "evicting_window", "global_agg"):
+            return n.key_field
+        return None  # window_all / async_io / broadcast_connect
+
+    def _batch_drain_source(self, sid: int, d, job_name: str) -> None:
+        """Wave 0: run one source's splits to exhaustion, pushing every
+        batch through its stage's pipelined (stateless) chain — and
+        into blocking-edge spools at the stage boundary. No watermark
+        propagation per batch: time only moves at the wave finalize."""
+        prof = self.prof
+        for split_ix in sorted(d):
+            it = d[split_ix]
+            while True:
+                if self._cancel is not None and self._cancel.is_set():
+                    raise JobCancelledError(job_name)
+                t0 = time.perf_counter()
+                nxt = next(it, None)
+                prof["source_next"] += time.perf_counter() - t0
+                if nxt is None:
+                    break
+                data, ts = nxt
+                ts = np.asarray(ts, np.int64)
+                t1 = time.perf_counter()
+                with self._push_lock:
+                    self.metrics["records_in"] += len(ts)
+                    self.metrics["batches"] += 1
+                    self._push_downstream(
+                        sid, (dict(data), ts, np.ones(len(ts), bool)))
+                for op in self._ops.values():
+                    if hasattr(op, "throttle"):
+                        op.throttle()
+                prof["push"] += time.perf_counter() - t1
+                self._positions[sid][split_ix] += 1
+                self._eps_meter.mark(len(ts))
+                if len(ts):
+                    self._max_ts[sid] = max(self._max_ts[sid],
+                                            int(ts.max()))
+                self._check_drain_error()
+        self._out_wm[sid] = _FINAL
+
+    def _batch_feed_head(self, v: int, stage, shuffle,
+                         job_name: str) -> None:
+        """Replay a stage head's sealed input partitions into the
+        operator. Broadcast state builds fully before the main input
+        (the batch BroadcastState discipline); join feeds left then
+        right (watermark-blind until the wave finalize, so side order
+        is semantics-free)."""
+        n = self.plan.node(v)
+        # the scheduler's in_edges are the single source of truth for
+        # which partitions exist (the seal loop used the same list)
+        edges = [(u2, v2) for u2, v2 in stage.in_edges if v2 == v]
+        if n.kind == "broadcast_connect":
+            edges.sort(key=lambda e: 0 if e[0] == n.right_input else 1)
+        elif n.kind == "join":
+            edges.sort(key=lambda e: 0 if e[0] == n.left_input else 1)
+        op = self._ops.get(v)
+        for u, _ in edges:
+            for data, ts in shuffle.edge(u, v).read():
+                if self._cancel is not None and self._cancel.is_set():
+                    raise JobCancelledError(job_name)
+                t1 = time.perf_counter()
+                with self._push_lock:
+                    self.metrics["shuffle_records_replayed"] = (
+                        self.metrics.get("shuffle_records_replayed", 0)
+                        + len(ts))
+                    self._push(v, (data, ts, np.ones(len(ts), bool)),
+                               from_node=u)
+                    if n.kind == "async_io":
+                        # keep enrichment results flowing mid-stage —
+                        # nothing else polls between wave finalizes
+                        for b in op.poll():
+                            self._push_downstream(v, b)
+                for o in self._ops.values():
+                    if hasattr(o, "throttle"):
+                        o.throttle()
+                self.prof["push"] += time.perf_counter() - t1
+                self._check_drain_error()
+
+    def _batch_finalize_wave(self, stage) -> None:
+        """End-of-input for one wave: quiesce its device pipelines,
+        then ONE final watermark pass over exactly this wave's nodes —
+        the single fire scan of the whole bounded run for each stateful
+        op — and barrier the emit drain so fires are fully delivered
+        (and captured into downstream blocking edges) before the wave
+        is declared finished."""
+        only = set(stage.nodes)
+        for nid in only:
+            op = self._ops.get(nid)
+            if op is not None and hasattr(op, "quiesce"):
+                op.quiesce()
+        with self._push_lock:
+            self._propagate_watermarks(final=True, only=only)
+        self._flush_emits()
 
     # -- data plane ------------------------------------------------------
     def live_metrics(self) -> Dict[str, Any]:
@@ -1328,6 +1572,14 @@ class Driver:
             self._push(d, batch, from_node=nid)
 
     def _push(self, nid: int, batch: Batch, from_node: int) -> None:
+        if self._batch_capture:
+            # bounded mode: a blocking edge diverts into its shuffle
+            # spool — the consumer sees nothing until its wave replays
+            # the sealed partition files (SURVEY §3.7)
+            w = self._batch_capture.get((from_node, nid))
+            if w is not None:
+                w.write(*batch)
+                return
         n = self.plan.node(nid)
         data, ts, valid = batch
         if n.kind == "chain":
@@ -1403,11 +1655,18 @@ class Driver:
             raise AssertionError(f"unroutable node kind {n.kind}")
 
     # -- time plane ------------------------------------------------------
-    def _propagate_watermarks(self, final: bool = False) -> None:
+    def _propagate_watermarks(self, final: bool = False,
+                              only=None) -> None:
         """Advance node watermarks in topo order (the StatusWatermarkValve
         min-over-inputs rule applied at node granularity, ref: streaming/
-        runtime/watermarkstatus/StatusWatermarkValve.java)."""
+        runtime/watermarkstatus/StatusWatermarkValve.java).
+
+        ``only``: restrict to a node-id set — the batch runtime's
+        per-wave finalize (a later wave's still-empty operators must
+        not see a final watermark before their input stage ran)."""
         for nid in self.plan.topo_order:
+            if only is not None and nid not in only:
+                continue
             n = self.plan.node(nid)
             if n.kind == "source":
                 continue
@@ -1503,10 +1762,10 @@ class Driver:
                 if d in seen:
                     continue
                 seen.add(d)
-                k = self.plan.node(d).kind
-                if k in ("window", "session", "join", "count_window",
-                         "window_all", "process", "async_io", "cep",
-                         "broadcast_connect"):
+                # STAGE_HEAD_KINDS is the authoritative stateful set —
+                # a stateful node below must keep fires on the loop
+                # thread (single-writer operator state)
+                if self.plan.node(d).kind in STAGE_HEAD_KINDS:
                     ok = False
                     break
                 stack.extend(self.plan.node(d).downstream)

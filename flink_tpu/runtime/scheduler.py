@@ -26,9 +26,10 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
-__all__ = ["Execution", "ExecutionVertex", "ExecutionGraph", "SlotPool"]
+__all__ = ["Execution", "ExecutionVertex", "ExecutionGraph", "SlotPool",
+           "BatchStage", "BatchStageScheduler"]
 
 
 @dataclasses.dataclass
@@ -120,6 +121,79 @@ class ExecutionGraph:
                      {"attempt": e.attempt, "runner": e.runner_id,
                       "state": e.state} for e in v.executions]}
                 for v in self.vertices],
+        }
+
+
+@dataclasses.dataclass
+class BatchStage:
+    """One topological wave of a bounded-execution plan (ref: the
+    pipelined regions batch scheduling carves a JobGraph into at
+    BLOCKING result partitions — DefaultScheduler's stage-wise deploy).
+    ``heads`` are the nodes that PULL this stage's input: sources in
+    wave 0, stateful consumers of sealed shuffle partitions after.
+    ``in_edges`` are the blocking edges whose partition files this
+    stage replays; they are complete (producer stages all finished)
+    before the stage starts — the blocking-exchange contract."""
+
+    index: int
+    nodes: List[int]
+    heads: List[int]
+    in_edges: List[Tuple[int, int]]
+    state: str = "CREATED"  # CREATED RUNNING FINISHED
+    started_at: float = 0.0
+    finished_at: float = 0.0
+
+
+class BatchStageScheduler:
+    """Wave-ordered scheduler for ``execution.runtime-mode=batch``: the
+    compiler's stage levels (graph/compiler.py assign_stages) become a
+    sequential wave list; the driver runs each wave to completion —
+    materializing its blocking outputs — before the next starts. This
+    replaces the streaming path's single all-at-once pipelined region
+    (SURVEY §3.7 bounded execution). Deliberately not implemented:
+    sort-merge spill and speculative execution (SPMD rationale,
+    SURVEY §3.7)."""
+
+    def __init__(self, plan) -> None:
+        if plan.runtime_mode != "batch" or not plan.stage_of:
+            raise ValueError(
+                "BatchStageScheduler needs a batch-compiled plan "
+                "(execution.runtime-mode=batch)")
+        self.plan = plan
+        n_waves = max(plan.stage_of.values()) + 1
+        by_level: List[List[int]] = [[] for _ in range(n_waves)]
+        for nid in plan.topo_order:  # topo order within each wave
+            by_level[plan.stage_of[nid]].append(nid)
+        self.waves: List[BatchStage] = []
+        for level, nids in enumerate(by_level):
+            heads = ([nid for nid in nids
+                      if plan.node(nid).kind == "source"] if level == 0
+                     else [nid for nid in nids
+                           if any(v == nid for _, v in plan.blocking_edges)])
+            self.waves.append(BatchStage(
+                index=level, nodes=nids, heads=heads,
+                in_edges=[(u, v) for u, v in plan.blocking_edges
+                          if plan.stage_of[v] == level]))
+
+    def start(self, stage: BatchStage) -> None:
+        stage.state = "RUNNING"
+        stage.started_at = time.time()
+
+    def finish(self, stage: BatchStage) -> None:
+        stage.state = "FINISHED"
+        stage.finished_at = time.time()
+
+    def snapshot(self) -> dict:
+        return {
+            "waves": [
+                {"index": s.index, "state": s.state,
+                 "heads": list(s.heads),
+                 "nodes": [f"{self.plan.node(n).kind}:"
+                           f"{self.plan.node(n).name or n}"
+                           for n in s.nodes],
+                 "wall_s": (round(s.finished_at - s.started_at, 3)
+                            if s.finished_at else None)}
+                for s in self.waves],
         }
 
 

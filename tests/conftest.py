@@ -35,3 +35,7 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: fault-injection chaos tests (flink_tpu.faults)"
         " — every failure report prints the fault seed for replay")
+    config.addinivalue_line(
+        "markers", "batch: bounded-execution (execution.runtime-mode="
+        "batch) tests — blocking shuffle, columnar exchange, final-only "
+        "fires")

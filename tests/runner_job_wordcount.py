@@ -1,0 +1,44 @@
+"""Bounded WordCount entry point for the batch-mode CLI smoke test —
+``python -m flink_tpu run --local --entry runner_job_wordcount:build
+--runtime-mode batch``. The sink is a FileSink in the self-contained
+columnar format, so the smoke test also proves the binary at-rest path
+end to end (FileSink write → commit → ColumnarFormat read-back)."""
+import numpy as np
+
+from flink_tpu.api.windowing import TumblingEventTimeWindows
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.connectors import FileSink
+from flink_tpu.formats_columnar import ColumnarFormat
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+BATCH = 128
+VOCAB = 40
+
+OUT_SCHEMA = (("key", "i64"), ("window_end", "i64"), ("count", "i64"))
+
+
+def batch_of(i: int):
+    rng = np.random.default_rng(7000 + i)
+    words = (rng.random(BATCH) ** 2 * VOCAB).astype(np.int64)
+    ts = (i * BATCH + np.arange(BATCH, dtype=np.int64)) * 10
+    return {"word": words}, ts
+
+
+def golden_total(n_batches: int) -> int:
+    return n_batches * BATCH  # count() sums to one row per input record
+
+
+def build(env):
+    n_batches = int(env.config.get_raw("test.n-batches", 6))
+    sink_dir = env.config.get_raw("test.sink-dir")
+    assert sink_dir, "test.sink-dir must be set"
+
+    def gen(split, i):
+        return batch_of(i) if i < n_batches else None
+
+    (env.from_source(GeneratorSource(gen),
+                     WatermarkStrategy.for_bounded_out_of_orderness(0))
+        .key_by("word")
+        .window(TumblingEventTimeWindows.of(1000))
+        .count()
+        .add_sink(FileSink(sink_dir, ColumnarFormat(OUT_SCHEMA))))

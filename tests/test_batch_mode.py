@@ -1,0 +1,402 @@
+"""Bounded execution (``execution.runtime-mode=batch``) — ISSUE 2.
+
+Covers: stage planning (blocking edges, topological waves), loud mode
+validation, the golden WordCount parity test (batch and streaming
+produce byte-identical committed output, and batch is measurably
+faster on the same input — wall clocks printed to the test log),
+multi-stage (3-wave) pipelines, the columnar FileSink→FileSource
+round trip, and the CLI smoke (``python -m flink_tpu run --local
+--runtime-mode batch``).
+
+ref: the reference's batch runtime — BLOCKING result partitions +
+stage-wise scheduling (SURVEY §3.6/§3.7); golden parity is the
+DataStream batch/streaming unification contract (same program, same
+results, different schedule)."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.api.sinks import FnSink
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.api.windowing import TumblingEventTimeWindows
+from flink_tpu.config import Configuration
+from flink_tpu.connectors import FileSink, FileSource
+from flink_tpu.formats import CsvFormat
+from flink_tpu.formats_columnar import ColumnarError, ColumnarFormat
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+pytestmark = pytest.mark.batch
+
+N_BATCHES, BATCH, VOCAB = 300, 128, 64
+
+OUT_SCHEMA = (("key", "i64"), ("window_end", "i64"), ("count", "i64"))
+
+
+def word_batch(i: int, n_batches: int = N_BATCHES):
+    if i >= n_batches:
+        return None
+    rng = np.random.default_rng(i)
+    words = (rng.random(BATCH) ** 2 * VOCAB).astype(np.int64)
+    ts = (i * BATCH + np.arange(BATCH, dtype=np.int64)) * 10
+    return {"word": words}, ts
+
+
+def make_env(mode, **conf):
+    base = {"state.num-key-shards": 8, "state.slots-per-shard": 64,
+            "pipeline.microbatch-size": BATCH,
+            "execution.runtime-mode": mode}
+    base.update(conf)
+    return StreamExecutionEnvironment(Configuration(base))
+
+
+def build_wordcount(env, sink, n_batches: int = N_BATCHES):
+    (env.from_source(GeneratorSource(
+        lambda split, i: word_batch(i, n_batches)),
+        WatermarkStrategy.for_bounded_out_of_orderness(0))
+        .key_by("word")
+        .window(TumblingEventTimeWindows.of(1000))
+        .count()
+        .add_sink(sink))
+    return env
+
+
+class TestStagePlanning:
+    def test_batch_plan_levels_and_blocking_edges(self):
+        env = build_wordcount(make_env("batch"), FnSink(lambda b: None))
+        plan = env.compile_plan()
+        assert plan.runtime_mode == "batch"
+        (win,) = [n.id for n in plan.nodes.values() if n.kind == "window"]
+        (src,) = plan.sources
+        # the window's input edge blocks; the window lives one wave down
+        assert all(v == win for _, v in plan.blocking_edges)
+        assert plan.stage_of[win] == 1
+        assert plan.stage_of[src] == 0
+        sink = [n.id for n in plan.nodes.values() if n.kind == "sink"][0]
+        assert plan.stage_of[sink] == 1  # pipelined with the window
+
+    def test_streaming_plan_has_no_stages(self):
+        env = build_wordcount(make_env("streaming"),
+                              FnSink(lambda b: None))
+        plan = env.compile_plan()
+        assert plan.runtime_mode == "streaming"
+        assert plan.stage_of == {} and plan.blocking_edges == []
+
+    def test_scheduler_waves(self):
+        from flink_tpu.runtime.scheduler import BatchStageScheduler
+
+        env = build_wordcount(make_env("batch"), FnSink(lambda b: None))
+        sched = BatchStageScheduler(env.compile_plan())
+        assert len(sched.waves) == 2
+        assert sched.waves[0].in_edges == []
+        assert len(sched.waves[1].in_edges) == 1
+        snap = sched.snapshot()
+        assert [w["state"] for w in snap["waves"]] == ["CREATED"] * 2
+
+
+class TestValidation:
+    def test_unbounded_source_rejected(self):
+        env = make_env("batch")
+        (env.from_source(GeneratorSource(
+            lambda s, i: word_batch(i), is_bounded=False),
+            WatermarkStrategy.for_bounded_out_of_orderness(0))
+            .key_by("word")
+            .window(TumblingEventTimeWindows.of(1000))
+            .count().add_sink(FnSink(lambda b: None)))
+        with pytest.raises(ValueError, match="bounded"):
+            env.compile_plan()
+
+    def test_unknown_mode_rejected(self):
+        env = build_wordcount(make_env("BATCHY"), FnSink(lambda b: None))
+        with pytest.raises(ValueError, match="runtime-mode"):
+            env.compile_plan()
+
+    def test_checkpoint_interval_rejected(self, tmp_path):
+        env = build_wordcount(
+            make_env("batch", **{
+                "execution.checkpointing.interval": 100,
+                "execution.checkpointing.dir": str(tmp_path)}),
+            FnSink(lambda b: None), n_batches=2)
+        with pytest.raises(ValueError, match="incompatible"):
+            env.execute("batch-ckpt")
+
+    def test_explicit_restore_path_rejected(self, tmp_path):
+        env = build_wordcount(
+            make_env("batch", **{
+                "execution.checkpointing.restore": str(tmp_path / "x"),
+                "execution.checkpointing.dir": str(tmp_path)}),
+            FnSink(lambda b: None), n_batches=2)
+        with pytest.raises(ValueError, match="incompatible"):
+            env.execute("batch-restore")
+
+    def test_recovery_injected_restore_latest_degrades_to_rerun(
+            self, tmp_path):
+        """Coordinator/supervisor redeploys inject restore=latest on
+        every retry attempt; a batch job must treat that as a fresh
+        re-execution (its recovery model), not a config error that
+        burns the restart budget."""
+        rows = [0]
+        env = build_wordcount(
+            make_env("batch", **{
+                "execution.checkpointing.restore": "latest",
+                "execution.checkpointing.dir": str(tmp_path)}),
+            FnSink(lambda b: rows.__setitem__(
+                0, rows[0] + len(next(iter(b.values()), [])))),
+            n_batches=4)
+        res = env.execute("batch-retry")
+        assert res.metrics["records_in"] == 4 * BATCH and rows[0] > 0
+
+    def test_self_join_rejected(self):
+        env = make_env("batch")
+        s = env.from_source(GeneratorSource(
+            lambda sp, i: word_batch(i, 2)),
+            WatermarkStrategy.for_bounded_out_of_orderness(0))
+        (s.key_by("word")
+          .window(TumblingEventTimeWindows.of(1000)).count()
+          .add_sink(FnSink(lambda b: None)))
+        (s.join(s).where("word").equal_to("word")
+          .window(TumblingEventTimeWindows.of(1000))
+          .apply().add_sink(FnSink(lambda b: None)))
+        with pytest.raises(NotImplementedError, match="same upstream"):
+            env.compile_plan()
+
+    def test_armed_savepoint_request_rejected(self):
+        """A directly-armed savepoint request must fail the batch job
+        loudly, not leave the requester waiting forever (the runner
+        path is already rejected up front — batch jobs have no
+        checkpoint storage)."""
+        import threading
+
+        req = threading.Event()
+        req.set()
+        env = build_wordcount(make_env("batch"), FnSink(lambda b: None),
+                              n_batches=2)
+        with pytest.raises(ValueError, match="savepoint"):
+            env.execute("batch-sp", savepoint_request=req)
+
+    def test_cross_process_rejected(self):
+        env = build_wordcount(
+            make_env("batch", **{"cluster.num-processes": 2,
+                                 "cluster.process-id": 0}),
+            FnSink(lambda b: None), n_batches=2)
+        with pytest.raises(NotImplementedError, match="single-process"):
+            env.execute("batch-dcn")
+
+
+def _committed_sorted_bytes(sink_dir: str) -> bytes:
+    committed = os.path.join(sink_dir, "committed")
+    lines = []
+    for name in sorted(os.listdir(committed)):
+        with open(os.path.join(committed, name), "rb") as f:
+            lines.extend(f.read().splitlines())
+    return b"\n".join(sorted(lines))
+
+
+class TestGoldenParity:
+    def test_batch_equals_streaming_and_is_faster(self, tmp_path):
+        """Acceptance criterion: bounded WordCount → byte-identical
+        committed output in both modes, and batch measurably faster on
+        the same input (generous margin — calibration on this suite's
+        config shows ~2.5×; the assertion only requires 1.18×). Wall
+        clocks go to the test log."""
+        fmt = CsvFormat(OUT_SCHEMA)
+
+        def run(mode, warmup: bool, tag: str = "w"):
+            d = str(tmp_path / f"{mode}-{tag}")
+            env = build_wordcount(
+                make_env(mode), FileSink(d, fmt),
+                n_batches=20 if warmup else N_BATCHES)
+            t0 = time.perf_counter()
+            res = env.execute(f"wc-{mode}")
+            return time.perf_counter() - t0, d, res
+
+        run("streaming", warmup=True)  # jit warmup, both modes share
+        run("batch", warmup=True)      # kernels + batch-only paths
+        # one retry on the TIMING comparison only: a noisy-neighbor
+        # stall during exactly one of the timed runs must not fail a
+        # correct build (the calibrated gap is ~2.5x, asserted at
+        # 1.18x; parity is asserted on every attempt, never retried)
+        for attempt in (1, 2):
+            t_stream, d_stream, r_stream = run(
+                "streaming", warmup=False, tag=f"m{attempt}")
+            t_batch, d_batch, r_batch = run(
+                "batch", warmup=False, tag=f"m{attempt}")
+            out_s = _committed_sorted_bytes(d_stream)
+            out_b = _committed_sorted_bytes(d_batch)
+            assert out_s == out_b and len(out_b) > 0
+            assert (r_batch.metrics["records_in"]
+                    == r_stream.metrics["records_in"]
+                    == N_BATCHES * BATCH)
+            # the mode's perf case: ONE fire pass instead of per batch
+            print(f"\n[batch-golden] attempt {attempt}: "
+                  f"streaming={t_stream:.2f}s batch={t_batch:.2f}s "
+                  f"speedup={t_stream / t_batch:.2f}x "
+                  f"(waves={r_batch.metrics['batch_waves']}, spooled="
+                  f"{r_batch.metrics['shuffle_bytes_spooled']}B)")
+            if t_batch < t_stream * 0.85:
+                break
+        else:
+            raise AssertionError(
+                f"batch ({t_batch:.2f}s) not measurably faster than "
+                f"streaming ({t_stream:.2f}s) in 2 attempts")
+
+
+class TestMultiStage:
+    def test_three_wave_pipeline_matches_streaming(self):
+        """source → 1s count per word (wave 1) → 10s sum of counts per
+        word (wave 2): two blocking exchanges, three waves, identical
+        results to the streaming schedule."""
+        def run(mode):
+            env = make_env(mode)
+            rows = []
+            (env.from_source(GeneratorSource(
+                lambda s, i: word_batch(i, 60)),
+                WatermarkStrategy.for_bounded_out_of_orderness(0))
+                .key_by("word")
+                .window(TumblingEventTimeWindows.of(1000))
+                .count()
+                .key_by("key")
+                .window(TumblingEventTimeWindows.of(10_000))
+                .sum("count")
+                .add_sink(FnSink(lambda b: rows.append(
+                    {k: np.asarray(v).copy() for k, v in b.items()}))))
+            res = env.execute(f"ms-{mode}")
+            out = {}
+            for b in rows:
+                cols = sorted(b)
+                for vals in zip(*(b[c] for c in cols)):
+                    kk = tuple(int(v) for v in vals)
+                    out[kk] = out.get(kk, 0) + 1
+            return res, out
+
+        res_b, out_b = run("batch")
+        _, out_s = run("streaming")
+        assert out_b == out_s and len(out_b) > 0
+        assert res_b.metrics["batch_waves"] == 3
+
+
+class TestPartitionedShuffle:
+    def test_hash_partitioned_edge_matches_single_partition(self):
+        """execution.batch.shuffle-partitions > 1: records hash-route
+        by the consumer's key column into disjoint partition files;
+        results must be identical to the single-partition spool (and
+        to streaming — per-key order is preserved within a file)."""
+        def run(mode, parts):
+            env = make_env(mode, **{
+                "execution.batch.shuffle-partitions": parts})
+            out = {}
+
+            def cap(b):
+                for k, w, c in zip(b["key"], b["window_end"],
+                                   b["count"]):
+                    out[(int(k), int(w))] = (
+                        out.get((int(k), int(w)), 0) + int(c))
+
+            build_wordcount(env, FnSink(cap), n_batches=40)
+            env.execute(f"part-{mode}-{parts}")
+            return out
+
+        ref = run("streaming", 1)
+        assert run("batch", 4) == ref
+        assert run("batch", 1) == ref and len(ref) > 0
+
+
+class TestColumnarConnectors:
+    def test_file_sink_to_file_source_round_trip(self, tmp_path):
+        """Batch WordCount commits columnar part files; a second batch
+        job re-reads them through FileSource with the SAME schema and
+        reproduces the totals — the self-contained at-rest format loop
+        (acceptance criterion: schema-checked both ways, numpy/struct
+        only)."""
+        fmt = ColumnarFormat(OUT_SCHEMA)
+        d = str(tmp_path / "colb")
+        env = build_wordcount(make_env("batch"), FileSink(d, fmt),
+                              n_batches=40)
+        env.execute("wc-colb")
+
+        total = [0]
+        env2 = make_env("batch")
+        (env2.from_source(FileSource(
+            os.path.join(d, "committed"), fmt, ts_field="window_end"))
+            .key_by("key")
+            .window(TumblingEventTimeWindows.of(3600_000))
+            .sum("count")
+            .add_sink(FnSink(lambda b: total.__setitem__(
+                0, total[0] + int(np.sum(b["sum_count"]))))))
+        env2.execute("wc-colb-read")
+        assert total[0] == 40 * BATCH  # counts sum back to every record
+
+        # read-back with a DIFFERENT schema must fail loudly
+        bad = ColumnarFormat((("key", "i64"), ("window_end", "i64"),
+                              ("count", "f64")))
+        env3 = make_env("batch")
+        (env3.from_source(FileSource(
+            os.path.join(d, "committed"), bad, ts_field="window_end"))
+            .key_by("key")
+            .window(TumblingEventTimeWindows.of(3600_000))
+            .sum("count").add_sink(FnSink(lambda b: None)))
+        with pytest.raises(ColumnarError, match="schema mismatch"):
+            env3.execute("wc-colb-bad")
+
+    def test_no_pyarrow_or_fastavro_anywhere(self):
+        """The format must stay self-contained (acceptance criterion:
+        no pyarrow/fastavro imports anywhere in the package)."""
+        import re
+
+        root = os.path.join(os.path.dirname(__file__), "..", "flink_tpu")
+        pat = re.compile(r"^\s*(import|from)\s+(pyarrow|fastavro)\b",
+                         re.M)
+        hits = []
+        for dirpath, _, files in os.walk(root):
+            for f in files:
+                if not f.endswith(".py"):
+                    continue
+                p = os.path.join(dirpath, f)
+                with open(p, "r", encoding="utf-8") as fh:
+                    if pat.search(fh.read()):
+                        hits.append(p)
+        assert hits == []
+
+
+class TestCliSmoke:
+    def test_bounded_wordcount_via_cli_batch_mode(self, tmp_path):
+        """Tier-1 smoke (ISSUE 2 satellite): a bounded WordCount runs
+        end-to-end through ``python -m flink_tpu run --local
+        --runtime-mode batch`` and commits columnar output."""
+        import runner_job_wordcount as job
+
+        sink_dir = str(tmp_path / "sink")
+        n_batches = 6
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=os.pathsep.join(
+                       [os.path.dirname(__file__),
+                        os.path.join(os.path.dirname(__file__), ".."),
+                        os.environ.get("PYTHONPATH", "")]))
+        proc = subprocess.run(
+            [sys.executable, "-m", "flink_tpu", "run", "--local",
+             "--entry", "runner_job_wordcount:build",
+             "--runtime-mode", "batch", "--job-id", "cli-batch-wc",
+             "--conf", f"test.n-batches={n_batches}",
+             "--conf", f"test.sink-dir={sink_dir}",
+             "--conf", "state.num-key-shards=4",
+             "--conf", "state.slots-per-shard=32"],
+            capture_output=True, text=True, timeout=240, env=env,
+            cwd=os.path.dirname(__file__))
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert out["state"] == "FINISHED"
+        assert out["records_in"] == n_batches * job.BATCH
+
+        fmt = ColumnarFormat(job.OUT_SCHEMA)
+        total = 0
+        committed = os.path.join(sink_dir, "committed")
+        for name in sorted(os.listdir(committed)):
+            with open(os.path.join(committed, name), "rb") as f:
+                cols = fmt.deserialize(f.read())
+            total += int(np.sum(cols["count"]))
+        assert total == job.golden_total(n_batches)

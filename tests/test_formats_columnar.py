@@ -1,0 +1,214 @@
+"""Columnar format edge cases (ISSUE 2 satellite: empty file,
+truncation, schema mismatch, zero-row batches — all loud; randomized
+round-trip property vs the jsonlines oracle).
+
+ref role: flink-formats/{flink-avro,flink-parquet} serialization tests
+(SURVEY §3.9) — except this format is self-contained (pure
+struct+numpy; the acceptance criterion bans pyarrow/fastavro)."""
+import io
+
+import numpy as np
+import pytest
+
+from flink_tpu.formats import JsonLinesFormat
+from flink_tpu.formats_columnar import (
+    ColumnarError,
+    ColumnarFormat,
+    ColumnarWriter,
+    infer_schema,
+    iter_blocks,
+)
+
+SCHEMA = (("k", "i64"), ("x", "f32"), ("d", "f64"), ("s", "str"))
+
+
+def _batch(rng, n):
+    return {
+        "k": rng.integers(-2**40, 2**40, n).astype(np.int64),
+        "x": rng.random(n).astype(np.float32),
+        "d": rng.random(n).astype(np.float64),
+        "s": np.array(["w" + str(int(v)) + ("é" if v % 3 == 0 else "")
+                       for v in rng.integers(0, 1000, n)], dtype=object),
+    }
+
+
+class TestRoundTrip:
+    def test_single_block_round_trip(self):
+        rng = np.random.default_rng(0)
+        fmt = ColumnarFormat(SCHEMA)
+        b = _batch(rng, 257)
+        out = fmt.deserialize(fmt.serialize(b))
+        for name in b:
+            np.testing.assert_array_equal(out[name], b[name])
+
+    def test_multi_block_writer_preserves_block_structure(self):
+        rng = np.random.default_rng(1)
+        buf = io.BytesIO()
+        w = ColumnarWriter(buf, SCHEMA)
+        batches = [_batch(rng, n) for n in (3, 1, 128)]
+        for b in batches:
+            w.write_batch(b)
+        w.close()
+        got = list(iter_blocks(buf.getvalue(), expect_schema=SCHEMA))
+        assert [len(g["k"]) for g in got] == [3, 1, 128]
+        for g, b in zip(got, batches):
+            for name in b:
+                np.testing.assert_array_equal(g[name], b[name])
+
+    def test_zero_row_batch_round_trips_typed(self):
+        """A zero-row block is legal and yields schema-TYPED empty
+        columns (downstream chains index columns on every batch)."""
+        buf = io.BytesIO()
+        w = ColumnarWriter(buf, SCHEMA)
+        w.write_batch(ColumnarFormat(SCHEMA).empty_batch())
+        w.close()
+        (got,) = iter_blocks(buf.getvalue())
+        assert len(got["k"]) == 0 and got["k"].dtype == np.int64
+        assert got["s"].dtype == object
+
+    def test_zero_row_serialize(self):
+        fmt = ColumnarFormat(SCHEMA)
+        data = fmt.serialize({n: np.array([], np.int64) for n, _ in SCHEMA})
+        out = fmt.deserialize(data)
+        assert len(out["k"]) == 0 and out["x"].dtype == np.float32
+
+    def test_property_round_trip_vs_jsonlines(self):
+        """Randomized rows: the columnar format and the jsonlines
+        format must reconstruct the SAME columns from the same batch —
+        jsonlines is the established oracle, columnar must agree
+        bit-exactly (i64/f32 survive the JSON double round trip)."""
+        schema = (("k", "i64"), ("x", "f32"), ("s", "str"))
+        col = ColumnarFormat(schema)
+        jl = JsonLinesFormat(schema)
+        rng = np.random.default_rng(42)
+        for trial in range(20):
+            n = int(rng.integers(0, 200))
+            b = {"k": rng.integers(-2**31, 2**31, n).astype(np.int64),
+                 "x": rng.random(n).astype(np.float32),
+                 "s": np.array([f"w{i}" for i in rng.integers(0, 99, n)],
+                               dtype=object)}
+            via_col = col.deserialize(col.serialize(b))
+            via_jl = jl.deserialize(jl.serialize(b))
+            for name in b:
+                np.testing.assert_array_equal(via_col[name], b[name])
+                np.testing.assert_array_equal(via_col[name], via_jl[name])
+
+    def test_bytes_values_decode_as_text(self):
+        """np.bytes_ / 'S'-dtype values must round-trip as the DECODED
+        text, never the Python repr "b'...'" (silent corruption)."""
+        fmt = ColumnarFormat((("s", "str"),))
+        b = {"s": np.array([b"abc", "café".encode("utf-8")],
+                           dtype=object)}
+        out = fmt.deserialize(fmt.serialize(b))
+        assert list(out["s"]) == ["abc", "café"]
+        out2 = fmt.deserialize(fmt.serialize(
+            {"s": np.array([b"x", b"yy"], dtype="S2")}))
+        assert list(out2["s"]) == ["x", "yy"]
+
+    def test_streaming_file_reader_matches_bytes_reader(self, tmp_path):
+        from flink_tpu.formats_columnar import iter_file_blocks
+
+        rng = np.random.default_rng(9)
+        p = tmp_path / "f.colb"
+        with open(p, "wb") as f:
+            w = ColumnarWriter(f, SCHEMA)
+            batches = [_batch(rng, n) for n in (5, 64)]
+            for b in batches:
+                w.write_batch(b)
+            w.close()
+        with open(p, "rb") as f:
+            got = list(iter_file_blocks(f, expect_schema=SCHEMA))
+        assert [len(g["k"]) for g in got] == [5, 64]
+        for g, b in zip(got, batches):
+            for name in b:
+                np.testing.assert_array_equal(g[name], b[name])
+        # truncated tail is loud on the streaming path too
+        raw = open(p, "rb").read()
+        with open(p, "wb") as f:
+            f.write(raw[:-8])
+        with pytest.raises(ColumnarError, match="truncated|footer"):
+            with open(p, "rb") as f:
+                list(iter_file_blocks(f))
+
+    def test_skip_elides_decoding_but_still_validates(self):
+        """skip=N (the replay position) yields only blocks >= N, but
+        the frame walk + CRC still cover the whole file — a truncated
+        tail is loud even when every block is skipped."""
+        rng = np.random.default_rng(11)
+        buf = io.BytesIO()
+        w = ColumnarWriter(buf, SCHEMA)
+        batches = [_batch(rng, n) for n in (4, 8, 16)]
+        for b in batches:
+            w.write_batch(b)
+        w.close()
+        data = buf.getvalue()
+        got = list(iter_blocks(data, expect_schema=SCHEMA, skip=2))
+        assert [len(g["k"]) for g in got] == [16]
+        np.testing.assert_array_equal(got[0]["k"], batches[2]["k"])
+        with pytest.raises(ColumnarError, match="truncated|footer"):
+            list(iter_blocks(data[:-6], skip=3))
+
+    def test_infer_schema(self):
+        b = {"a": np.arange(3, dtype=np.int32),
+             "b": np.zeros(3, np.float32),
+             "c": np.array(["x", "y", "z"], dtype=object)}
+        assert infer_schema(b) == (("a", "i64"), ("b", "f32"),
+                                   ("c", "str"))
+
+
+class TestLoudFailures:
+    def test_empty_file_rejected(self):
+        with pytest.raises(ColumnarError, match="empty columnar file"):
+            ColumnarFormat(SCHEMA).deserialize(b"")
+
+    def test_bad_magic_rejected(self):
+        with pytest.raises(ColumnarError, match="not a flink-tpu"):
+            ColumnarFormat(SCHEMA).deserialize(b"NOPE" + b"\x00" * 64)
+
+    def test_truncated_block_rejected(self):
+        fmt = ColumnarFormat(SCHEMA)
+        data = fmt.serialize(_batch(np.random.default_rng(2), 64))
+        with pytest.raises(ColumnarError, match="truncated"):
+            fmt.deserialize(data[: len(data) // 2])
+
+    def test_missing_footer_rejected(self):
+        """A writer that died before close(): blocks intact, footer
+        absent — must read as truncation, never as a complete file."""
+        rng = np.random.default_rng(3)
+        buf = io.BytesIO()
+        w = ColumnarWriter(buf, SCHEMA)
+        w.write_batch(_batch(rng, 16))
+        data = buf.getvalue()  # no close() → no footer
+        with pytest.raises(ColumnarError, match="truncated"):
+            list(iter_blocks(data))
+
+    def test_corrupt_payload_rejected_by_crc(self):
+        fmt = ColumnarFormat(SCHEMA)
+        data = bytearray(fmt.serialize(_batch(np.random.default_rng(4),
+                                              64)))
+        data[len(data) // 2] ^= 0xFF  # flip one payload byte
+        with pytest.raises(ColumnarError, match="CRC mismatch"):
+            fmt.deserialize(bytes(data))
+
+    def test_reader_schema_mismatch_rejected(self):
+        written = ColumnarFormat(SCHEMA).serialize(
+            _batch(np.random.default_rng(5), 8))
+        other = ColumnarFormat((("k", "i64"), ("x", "f64"),
+                                ("d", "f64"), ("s", "str")))
+        with pytest.raises(ColumnarError, match="schema mismatch"):
+            other.deserialize(written)
+
+    def test_writer_schema_mismatch_rejected(self):
+        fmt = ColumnarFormat((("a", "i64"), ("b", "i64")))
+        with pytest.raises(ColumnarError, match="schema mismatch"):
+            fmt.serialize({"a": np.arange(4), "WRONG": np.arange(4)})
+
+    def test_writer_dtype_mismatch_rejected(self):
+        fmt = ColumnarFormat((("a", "i64"),))
+        with pytest.raises(ColumnarError, match="declared i64"):
+            fmt.serialize({"a": np.zeros(4, np.float32)})
+
+    def test_ragged_batch_rejected(self):
+        fmt = ColumnarFormat((("a", "i64"), ("b", "i64")))
+        with pytest.raises(ColumnarError, match="ragged"):
+            fmt.serialize({"a": np.arange(4), "b": np.arange(3)})
