@@ -19,6 +19,7 @@ repo publishes no numbers — BASELINE.md). The north-star target is 20x.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -232,6 +233,69 @@ def run_wordcount(batch_size: int, n_batches: int) -> float:
     return batch_size * n_batches / el
 
 
+def run_wordcount_log_fed(batch_size: int, n_batches: int) -> float:
+    """Log-fed WordCount — the host→device INGEST/TRANSPORT plane's
+    number (VERDICT r05: the ingest plane lost its measured line). A
+    producer pass commits the word stream into an embedded durable-log
+    topic (flink_tpu/log/, sealed columnar segments + commit markers);
+    the MEASURED pass replays the topic's committed offsets through
+    LogSource, so every record pays deserialization + host keying +
+    h2d + dispatch — the path a job chained behind another job's
+    LogSink actually runs. Returns consumer events(words)/sec; the
+    producer/commit pass is setup, not part of the clock."""
+    import shutil
+    import tempfile
+
+    from flink_tpu.api.environment import StreamExecutionEnvironment
+    from flink_tpu.api.sources import GeneratorSource
+    from flink_tpu.api.windowing import TumblingEventTimeWindows
+    from flink_tpu.config import Configuration
+    from flink_tpu.log import LogSink, LogSource
+    from flink_tpu.time.watermarks import WatermarkStrategy
+
+    vocab = 30_000
+
+    def gen(split, i):
+        if i >= n_batches:
+            return None
+        rng = np.random.default_rng(i)
+        u = rng.random(batch_size)
+        words = (u * u * vocab).astype(np.int64)
+        ts = ((i * batch_size
+               + np.arange(batch_size, dtype=np.int64)) // 100)
+        return ({"word": words, "ts_ms": ts}, ts)
+
+    root = tempfile.mkdtemp(prefix="flink-tpu-bench-log-")
+    topic = os.path.join(root, "wordcount")
+    try:
+        penv = StreamExecutionEnvironment(Configuration({
+            "pipeline.microbatch-size": batch_size,
+        }))
+        penv.from_source(GeneratorSource(gen)).add_sink(
+            LogSink(topic, segment_records=batch_size))
+        penv.execute("wordcount-log-producer")
+
+        env = StreamExecutionEnvironment(Configuration({
+            "state.num-key-shards": 128, "state.slots-per-shard": 512,
+            "pipeline.microbatch-size": batch_size,
+            "pipeline.max-inflight-steps": 1,
+        }))
+        n, sink = _counting_sink()
+        (env.from_source(LogSource(topic, ts_field="ts_ms"),
+                         WatermarkStrategy.for_bounded_out_of_orderness(0))
+            .key_by("word")
+            .window(TumblingEventTimeWindows.of(1000))
+            .count()
+            .add_sink(sink))
+        t0 = time.perf_counter()
+        env.execute("wordcount-log-consumer")
+        el = time.perf_counter() - t0
+        assert n[0] > 0, "log-fed wordcount emitted nothing"
+        return batch_size * n_batches / el
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def run_sessions(batch_size: int, n_batches: int) -> float:
     """BASELINE.json config #4 shape: session-window clickstream
     aggregation with event time + allowed lateness (the Criteo-style
@@ -301,6 +365,15 @@ def suite() -> None:
     eps4 = run_sessions(1 << 20, 12)
     print(json.dumps({"metric": "session_clickstream_events_per_sec",
                       "value": round(eps4), "unit": "events/sec/chip"}))
+    # log-fed WordCount: the job-chaining ingest plane (durable-log
+    # replay → host keying → h2d → dispatch). Restores the measured
+    # host→device number VERDICT r05 flagged as missing; a regression
+    # in columnar deserialization, LogSource replay, or the h2d path
+    # lands here every round.
+    run_wordcount_log_fed(1 << 18, 4)  # warmup
+    epsl = run_wordcount_log_fed(1 << 18, 24)
+    print(json.dumps({"metric": "wordcount_log_fed_events_per_sec",
+                      "value": round(epsl), "unit": "events/sec/chip"}))
     # host-fed Q5 (device_source=False): the INGEST plane's number.
     # The headline's device-chained generator moves ~zero record bytes
     # over the link (VERDICT r05 missing #2 / weak #2); this permanent

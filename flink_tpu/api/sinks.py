@@ -38,6 +38,21 @@ class Sink:
     def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
         """Commit staged epochs <= checkpoint_id (ref: Committer.commit)."""
 
+    def notify_checkpoint_abort(self, checkpoint_id: int) -> None:
+        """The checkpoint covering this staged epoch failed before
+        completing — the epoch's rows replay from source positions, so
+        its staged transaction may be discarded (ref:
+        CheckpointListener.notifyCheckpointAborted). Default no-op:
+        non-transactional sinks have nothing staged."""
+
+    def set_attempt_epoch(self, epoch: int) -> None:
+        """The driver announces this attempt's fencing epoch before the
+        run starts (``cluster.attempt``, the same counter that fences
+        checkpoint storage as ``chk-<id>.e<epoch>``). Transactional
+        sinks qualify in-progress artifacts with it so a deposed
+        attempt restarting mid-commit can never clobber a successor's
+        committed output. Default no-op."""
+
     # -- staged-transaction persistence seam ------------------------------
     # The reference's TwoPhaseCommitSinkFunction keeps pending transactions
     # IN STATE and re-commits them on restore — a crash between the
@@ -53,6 +68,136 @@ class Sink:
 
     def close(self) -> None:
         pass
+
+
+class TwoPhaseCommitSink(Sink):
+    """Generalized pre-commit/commit transactional sink protocol (ref:
+    TwoPhaseCommitSinkFunction + the FLIP-143 unified Sink's
+    writer/committer split, generalized from SURVEY §3.9's
+    rename-on-commit). The base owns the TRANSACTION bookkeeping; a
+    subclass owns the in-memory buffer and the durable medium:
+
+    - ``write()`` buffers rows in memory (subclass-owned shape);
+    - ``prepare_commit(cid)`` (checkpoint barrier) calls
+      ``stage_transaction(cid)``: the subclass makes everything
+      buffered DURABLE under transaction ``cid`` — data plus a fsynced
+      pre-commit marker — without making any of it visible;
+    - ``notify_checkpoint_complete(cid)`` (checkpoint completion)
+      commits every staged transaction with id <= cid in id order —
+      ``commit_transaction`` is the atomic visibility point and must be
+      idempotent (a restore replays commits);
+    - ``notify_checkpoint_abort(cid)`` / ``abort_uncommitted()`` roll
+      staged transactions back durably (their rows replay from source
+      positions);
+    - staged transactions additionally ride INSIDE the checkpoint
+      payload (``snapshot_transaction``), so a crash that lands between
+      the checkpoint's manifest write and the commit round — or a
+      cleanup that deleted the staged artifacts — can always
+      ``rebuild_transaction`` and re-commit on restore.
+    """
+
+    # -- subclass contract (durable-medium operations) --------------------
+    def drop_pending(self) -> None:
+        """Clear the in-memory (never-staged) buffer."""
+        raise NotImplementedError
+
+    def stage_transaction(self, cid: int) -> bool:
+        """Durably stage everything buffered since the last barrier as
+        transaction ``cid`` (data + pre-commit marker, fsynced) and
+        clear the buffer. Return False when nothing was buffered (no
+        empty transactions)."""
+        raise NotImplementedError
+
+    def staged_transaction_ids(self) -> List[int]:
+        """Ids of transactions staged on the durable medium but not yet
+        committed (sorted ascending)."""
+        raise NotImplementedError
+
+    def commit_transaction(self, cid: int) -> None:
+        """Atomically publish transaction ``cid``. MUST be idempotent —
+        restore replays commits — and a no-op for unknown ids (an empty
+        epoch staged nothing)."""
+        raise NotImplementedError
+
+    def abort_transaction(self, cid: int) -> None:
+        """Durably discard staged transaction ``cid`` (idempotent)."""
+        raise NotImplementedError
+
+    def snapshot_transaction(self, cid: int) -> Any:
+        """Payload from which ``rebuild_transaction`` can reconstruct
+        the staged transaction — rides inside the checkpoint."""
+        raise NotImplementedError
+
+    def rebuild_transaction(self, cid: int, payload: Any) -> None:
+        """Re-create staged transaction ``cid`` from its checkpoint
+        payload if it is no longer on the durable medium (idempotent;
+        a commit_transaction call follows)."""
+        raise NotImplementedError
+
+    def cleanup_unreferenced(self) -> None:
+        """Optional hook: sweep torn half-staged debris no marker
+        references (a crash mid-stage). Default no-op."""
+
+    # -- the protocol (driver-facing, final) ------------------------------
+    def _live_staged(self) -> set:
+        """Cids THIS instance staged rows for (stage_transaction
+        returned True) and has not yet committed/aborted. The commit
+        round walks the union of this set and the on-disk staged ids,
+        so a staged transaction whose durable marker VANISHED before
+        commit still reaches commit_transaction — where the medium can
+        fail loudly instead of the epoch silently disappearing from
+        the staged listing (lazy init: subclasses own __init__)."""
+        s = getattr(self, "_live_staged_ids", None)
+        if s is None:
+            s = self._live_staged_ids = set()
+        return s
+
+    def prepare_commit(self, checkpoint_id: int) -> None:
+        if self.stage_transaction(checkpoint_id):
+            self._live_staged().add(int(checkpoint_id))
+
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        live = self._live_staged()
+        for cid in sorted(set(self.staged_transaction_ids()) | live):
+            if cid <= checkpoint_id:
+                self.commit_transaction(cid)
+                live.discard(cid)
+
+    def notify_checkpoint_abort(self, checkpoint_id: int) -> None:
+        if checkpoint_id in self.staged_transaction_ids():
+            self.abort_transaction(checkpoint_id)
+        self._live_staged().discard(int(checkpoint_id))
+
+    def snapshot_staged(self) -> Any:
+        return {"txn": {str(cid): self.snapshot_transaction(cid)
+                        for cid in self.staged_transaction_ids()}}
+
+    def restore_staged(self, staged: Any, checkpoint_id: int) -> None:
+        self.drop_pending()
+        self._live_staged().clear()  # staged knowledge now comes from
+        # the checkpoint payload, not this instance's write history
+        txns = {int(c): p
+                for c, p in (staged or {}).get("txn", {}).items()}
+        for cid in sorted(txns):
+            if cid <= checkpoint_id:
+                # the completed checkpoint proves this epoch must become
+                # visible even though the commit round never ran; if an
+                # abort deleted the staged artifacts in the meantime,
+                # rebuild them from the payload first
+                self.rebuild_transaction(cid, txns[cid])
+                self.commit_transaction(cid)
+        # anything still staged is either uncovered (replays from source
+        # positions) or a dead attempt's leftovers — roll it back
+        for cid in self.staged_transaction_ids():
+            self.abort_transaction(cid)
+        self.cleanup_unreferenced()
+
+    def abort_uncommitted(self) -> None:
+        self.drop_pending()
+        for cid in self.staged_transaction_ids():
+            self.abort_transaction(cid)
+        self._live_staged().clear()
+        self.cleanup_unreferenced()
 
 
 @dataclasses.dataclass

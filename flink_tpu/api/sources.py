@@ -40,6 +40,16 @@ class Source:
         A bounded split's iterator just ends (ref: Boundedness)."""
         raise NotImplementedError
 
+    def position_after(self, pos: int, data, ts) -> int:
+        """Replay position after consuming ONE batch that started at
+        ``pos`` — positions are SOURCE-defined, not framework-defined
+        (the FLIP-27 split-state principle: a Kafka-style source
+        checkpoints offsets, a file source checkpoints batch indices).
+        The default counts batches; offset-addressed sources
+        (log.LogSource) return ``pos + rows`` instead, so a restore
+        resumes mid-partition at an exact record offset."""
+        return pos + 1
+
     @property
     def bounded(self) -> bool:
         return True

@@ -63,11 +63,14 @@ class PendingCheckpoint:
     def __init__(self, coordinator: "CheckpointCoordinator", cid: int,
                  future: "Future[CheckpointHandle]",
                  commit_fns: List[Callable[[int], None]],
-                 t0: float) -> None:
+                 t0: float,
+                 abort_fns: Optional[List[Callable[[int], None]]] = None,
+                 ) -> None:
         self.coordinator = coordinator
         self.checkpoint_id = cid
         self.future = future
         self._commit_fns = commit_fns
+        self._abort_fns = list(abort_fns or [])
         self._t0 = t0
         self._end_cell: List[Optional[float]] = [None]
 
@@ -93,7 +96,25 @@ class PendingCheckpoint:
         return handle
 
     def abandon(self) -> None:
+        """Drop the in-flight checkpoint without committing, and
+        deliver ABORT notifications to the 2PC sinks (ref:
+        CheckpointCoordinator.sendAbortedMessages →
+        notifyCheckpointAborted): the epoch staged at this barrier
+        replays from the previous checkpoint's source positions, so
+        its staged transaction may be rolled back durably. Runs on the
+        attempt's failure path — a broken abort hook must not mask the
+        original failure, so errors are recorded, not raised."""
         self.future.cancel()
+        from flink_tpu.obs.tracing import tracer
+
+        for a in self._abort_fns:
+            try:
+                a(self.checkpoint_id)
+            except Exception as e:  # noqa: BLE001 — cleanup best-effort
+                with tracer.span("checkpoint.abort-notify-failed",
+                                 checkpoint_id=self.checkpoint_id,
+                                 error=f"{type(e).__name__}: {e}"):
+                    pass
 
 
 @dataclasses.dataclass
@@ -119,13 +140,14 @@ class CheckpointCoordinator:
         prepare_fns: List[Callable[[int], None]],
         savepoint: bool = False,
         executor=None,
+        abort_fns: Optional[List[Callable[[int], None]]] = None,
     ) -> CheckpointHandle:
         """One full SYNCHRONOUS checkpoint cycle — freeze, persist,
         commit, in the caller's thread (savepoints, final checkpoints,
         tests). The interval path uses ``trigger_async``."""
         pending = self.trigger_async(
             snapshot_fn, commit_fns, prepare_fns,
-            executor=executor, savepoint=savepoint)
+            executor=executor, savepoint=savepoint, abort_fns=abort_fns)
         return pending.complete()
 
     def trigger_async(
@@ -135,6 +157,7 @@ class CheckpointCoordinator:
         prepare_fns: List[Callable[[int], None]],
         executor=None,
         savepoint: bool = False,
+        abort_fns: Optional[List[Callable[[int], None]]] = None,
     ) -> PendingCheckpoint:
         """Freeze in the caller's thread, persist in the background:
         1. (loop) sinks stage their epoch (prepareCommit)
@@ -202,7 +225,8 @@ class CheckpointCoordinator:
                 fut.set_exception(e)
         else:
             fut = executor.submit(persist)
-        pend = PendingCheckpoint(self, cid, fut, commit_fns, t0)
+        pend = PendingCheckpoint(self, cid, fut, commit_fns, t0,
+                                 abort_fns=abort_fns)
         pend._end_cell = end_cell
         return pend
 

@@ -7,6 +7,7 @@ script. Here::
     python -m flink_tpu run --coordinator H:P --entry pkg.mod:build \
         [--job-id id] [--conf key=value ...]
     python -m flink_tpu run --local --entry pkg.mod:build [...]
+    python -m flink_tpu log TOPIC_DIR
     python -m flink_tpu list --coordinator H:P
     python -m flink_tpu status --coordinator H:P JOB_ID
     python -m flink_tpu cancel --coordinator H:P JOB_ID
@@ -56,14 +57,35 @@ def _parse_conf(pairs: List[str]) -> dict:
 def _run_local(entry: str, conf: dict, job_id: str) -> int:
     import importlib
 
+    from flink_tpu import faults
     from flink_tpu.api.environment import StreamExecutionEnvironment
     from flink_tpu.config import Configuration
 
     mod_name, _, fn_name = entry.partition(":")
     build = getattr(importlib.import_module(mod_name), fn_name)
-    env = StreamExecutionEnvironment(Configuration(conf))
-    build(env)
-    result = env.execute(job_id)
+    config = Configuration(conf)
+    # the faults.* grammar is live on the local path too — a chaos conf
+    # passed to `run --local` must inject, not silently no-op
+    faults.install_from_config(config)
+    if "restart-strategy.type" in conf:
+        # an EXPLICIT restart strategy runs under the supervisor:
+        # failures restore from the latest checkpoint and replay (the
+        # chained-jobs chaos drive). Without one, a local job stays
+        # fail-fast — wrapping unconditionally would silently
+        # restart-with-restore on any failure (the config default is
+        # exponential-delay), changing plain `run --local` semantics.
+        from flink_tpu.runtime.supervisor import run_with_recovery
+
+        def build_env(attempt_conf):
+            env = StreamExecutionEnvironment(attempt_conf)
+            build(env)
+            return env
+
+        result = run_with_recovery(build_env, config, job_name=job_id)
+    else:
+        env = StreamExecutionEnvironment(config)
+        build(env)
+        result = env.execute(job_id)
     print(json.dumps({"job_id": job_id, "state": "FINISHED",
                       "records_in": result.metrics.get("records_in"),
                       "records_out": result.metrics.get("records_out")}))
@@ -96,6 +118,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                            "coordinator's blob store (the job-jar "
                            "analogue); repeatable")
 
+    logp = sub.add_parser(
+        "log", help="inspect a durable log topic (committed offsets, "
+                    "staged transactions, segments)")
+    logp.add_argument("topic", metavar="TOPIC_DIR",
+                      help="topic directory (<log.dir>/<name>)")
+
     for name, help_ in (("list", "list jobs"), ("runners", "list runners")):
         sp = sub.add_parser(name, help=help_)
         sp.add_argument("--coordinator", required=True, metavar="HOST:PORT")
@@ -114,6 +142,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     rs.add_argument("job_id")
 
     args = p.parse_args(argv)
+
+    if args.cmd == "log":
+        from flink_tpu.log.topic import LogError, describe_topic
+
+        try:
+            print(json.dumps(describe_topic(args.topic)))
+        except LogError as e:
+            raise SystemExit(str(e))
+        return 0
 
     if args.cmd == "run":
         job_id = args.job_id or f"job-{uuid.uuid4().hex[:8]}"

@@ -264,6 +264,31 @@ class ExecutionOptions:
         "inspection.")
 
 
+class LogOptions:
+    DIR = ConfigOption(
+        "log.dir", "/tmp/flink-tpu-log",
+        "Root directory for embedded durable-log topics (flink_tpu/log/"
+        "— the job-chaining exchange plane, the Kafka role without a "
+        "broker process). LogSink.from_config resolves a topic name "
+        "under this root; any registered FileSystem scheme works. Jobs "
+        "chained through one topic must share this filesystem.")
+    PARTITIONS = ConfigOption(
+        "log.partitions", 1,
+        "Default partition count for topics created by "
+        "LogSink.from_config. Partitions are the source-split unit of "
+        "LogSource (one replayable split per partition); records "
+        "hash-route by the sink's key_field, so each partition holds a "
+        "disjoint key range and per-key order is preserved. Fixed at "
+        "topic creation — reopening with a different count fails "
+        "loudly (offsets are per-partition).")
+    SEGMENT_RECORDS = ConfigOption(
+        "log.segment-records", 65536,
+        "Records per appended log segment before the appender rolls to "
+        "a new file within one transaction. Every segment is written "
+        "sealed (columnar footer + fsync) at pre-commit, so this is "
+        "also the recovery/replay granularity of a topic partition.")
+
+
 class CoreOptions:
     PLUGINS = ConfigOption(
         "plugins.modules", "",

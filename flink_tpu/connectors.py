@@ -16,7 +16,7 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from flink_tpu.api.sinks import Sink
+from flink_tpu.api.sinks import Sink, TwoPhaseCommitSink
 from flink_tpu.api.sources import Source
 from flink_tpu.formats import Format
 from flink_tpu.fs import get_filesystem
@@ -98,16 +98,24 @@ class FileSource(Source):
         return True
 
 
-class FileSink(Sink):
-    """Exactly-once, format-serialized part files. Rows buffer in
-    memory per epoch; ``prepare_commit`` writes+fsyncs a staged part
-    file, ``notify_checkpoint_complete`` atomically renames it into
+class FileSink(TwoPhaseCommitSink):
+    """Exactly-once, format-serialized part files on the generalized
+    TwoPhaseCommitSink protocol (api/sinks.py). Rows buffer in memory
+    per epoch; the barrier stages them as fsynced part files under
+    ``staged/``; checkpoint completion atomically renames them into
     ``committed/`` (the transaction point). Rolling: a staged epoch
     splits into numbered part files every ``rolling_records`` rows, so
     downstream consumers see bounded files (ref: FileSink's
-    RollingPolicy + the TwoPhaseCommitSinkFunction discipline; same
-    restore/abort contract as FileTransactionalSink — staged rows ride
-    the checkpoint so a cleaned-up attempt can reconstruct them)."""
+    RollingPolicy + the TwoPhaseCommitSinkFunction discipline; staged
+    part BYTES ride the checkpoint so a cleaned-up attempt can
+    reconstruct them — the FileTransactionalSink rationale).
+
+    Part names are ATTEMPT-EPOCH-qualified —
+    ``part-<cid>-<part>.e<epoch>`` (the same ``chk-<id>.e<epoch>``
+    fencing discipline checkpoint storage uses): a deposed attempt
+    restarting mid-commit renames to ITS epoch's name, never over a
+    successor's committed part; readers resolve duplicates of one
+    (cid, part) to the highest epoch."""
 
     def __init__(self, directory: str, format: Format,
                  rolling_records: int = 1_000_000) -> None:
@@ -120,6 +128,10 @@ class FileSink(Sink):
         self._fs.mkdirs(self._staged_dir)
         self._fs.mkdirs(self._committed_dir)
         self._pending: List[Dict[str, np.ndarray]] = []
+        self._epoch = 0
+
+    def set_attempt_epoch(self, epoch: int) -> None:
+        self._epoch = int(epoch)
 
     # -- write path ------------------------------------------------------
     def write(self, batch: Dict[str, np.ndarray]) -> None:
@@ -137,12 +149,31 @@ class FileSink(Sink):
         return out
 
     def _part_name(self, cid: int, part: int) -> str:
-        return f"part-{cid:010d}-{part:04d}"
+        return f"part-{cid:010d}-{part:04d}.e{self._epoch}"
 
-    def prepare_commit(self, checkpoint_id: int) -> None:
+    @staticmethod
+    def _parse_part(name: str) -> Optional[Tuple[int, int, int]]:
+        """``part-<cid>-<part>[.e<epoch>]`` → (cid, part, epoch); None
+        for tmp files and foreign names. Suffixless names (pre-epoch
+        directories) read as epoch 0."""
+        if not name.startswith("part-") or name.endswith(".tmp"):
+            return None
+        core, _, esuf = name.partition(".e")
+        bits = core.split("-")
+        try:
+            return (int(bits[1]), int(bits[2]),
+                    int(esuf) if esuf else 0)
+        except (IndexError, ValueError):
+            return None
+
+    # -- TwoPhaseCommitSink contract -------------------------------------
+    def drop_pending(self) -> None:
+        self._pending = []
+
+    def stage_transaction(self, cid: int) -> bool:
         data = self._concat_pending()
         if data is None:
-            return
+            return False
         n = len(next(iter(data.values())))
         part = 0
         for lo in range(0, n, self.rolling_records):
@@ -150,7 +181,7 @@ class FileSink(Sink):
                      for k, v in data.items()}
             payload = self.format.serialize(chunk)
             path = os.path.join(self._staged_dir,
-                                self._part_name(checkpoint_id, part))
+                                self._part_name(cid, part))
             tmp = path + ".tmp"
             with self._fs.open_write(tmp) as f:
                 f.write(payload)
@@ -158,61 +189,97 @@ class FileSink(Sink):
                 os.fsync(f.fileno())
             self._fs.rename(tmp, path)
             part += 1
+        return True
 
-    # -- commit protocol -------------------------------------------------
     def _staged_parts(self) -> List[Tuple[int, str]]:
         out = []
         for f in self._fs.listdir(self._staged_dir):
-            if f.startswith("part-") and not f.endswith(".tmp"):
-                out.append((int(f.split("-")[1]), f))
+            parsed = self._parse_part(f)
+            if parsed is not None:
+                out.append((parsed[0], f))
         return sorted(out)
 
-    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
-        for cid, name in self._staged_parts():
-            if cid <= checkpoint_id:
-                src = os.path.join(self._staged_dir, name)
-                dst = os.path.join(self._committed_dir, name)
-                if self._fs.exists(dst):
-                    self._fs.delete(src)  # idempotent replayed commit
-                else:
-                    self._fs.rename(src, dst)
+    def staged_transaction_ids(self) -> List[int]:
+        return sorted({cid for cid, _ in self._staged_parts()})
 
-    def snapshot_staged(self) -> Any:
-        """Staged part BYTES ride in the checkpoint (same rationale as
-        FileTransactionalSink: an aborted attempt may have deleted the
-        staged files; the covering checkpoint must reconstruct them)."""
+    def _committed_keys(self) -> set:
+        """(cid, part) pairs committed at ANY epoch — the idempotence
+        check must see a part another attempt already published."""
+        out = set()
+        for f in self._fs.listdir(self._committed_dir):
+            parsed = self._parse_part(f)
+            if parsed is not None:
+                out.add(parsed[:2])
+        return out
+
+    def commit_transaction(self, cid: int) -> None:
+        committed = self._committed_keys()
+        staged = [(self._parse_part(name), name)
+                  for c, name in self._staged_parts() if c == cid]
+        # one winner per (cid, part): the highest staged epoch — a
+        # deposed attempt's duplicate staging of the same transaction
+        # loses to its successor's, so exactly one file publishes
+        winners: Dict[int, Tuple[int, str]] = {}
+        for (_, part, epoch), name in staged:
+            cur = winners.get(part)
+            if cur is None or epoch > cur[0]:
+                winners[part] = (epoch, name)
+        for (_, part, epoch), name in staged:
+            src = os.path.join(self._staged_dir, name)
+            if name != winners[part][1] or (cid, part) in committed:
+                self._fs.delete(src)  # deposed duplicate or idempotent
+                # replayed commit — possibly by another attempt's
+                # epoch; never clobber
+            else:
+                self._fs.rename(src, os.path.join(
+                    self._committed_dir, name))
+
+    def abort_transaction(self, cid: int) -> None:
+        for c, name in self._staged_parts():
+            # epoch fence: a part staged by a HIGHER attempt epoch is a
+            # successor's live transaction — a deposed attempt's late
+            # abort must not delete it (mirror of topic.py abort)
+            if c == cid and self._parse_part(name)[2] <= self._epoch:
+                self._fs.delete(os.path.join(self._staged_dir, name))
+
+    def snapshot_transaction(self, cid: int) -> Any:
         parts = {}
-        for cid, name in self._staged_parts():
+        for c, name in self._staged_parts():
+            if c != cid:
+                continue
             with self._fs.open_read(
                     os.path.join(self._staged_dir, name)) as f:
                 raw = f.read()
             parts[name] = raw if isinstance(raw, bytes) else raw.encode()
         return {"parts": parts}
 
-    def restore_staged(self, staged: Any, checkpoint_id: int) -> None:
-        self._pending = []
-        for name, payload in (staged or {}).get("parts", {}).items():
+    def rebuild_transaction(self, cid: int, payload: Any) -> None:
+        for name, data in (payload or {}).get("parts", {}).items():
             path = os.path.join(self._staged_dir, name)
             if self._fs.exists(path):
                 continue
             tmp = path + ".tmp"
             with self._fs.open_write(tmp) as f:
-                f.write(payload)
+                f.write(data)
             self._fs.rename(tmp, path)
-
-    def abort_uncommitted(self) -> None:
-        """Crash before the covering checkpoint: staged parts of the
-        dead attempt must never become visible."""
-        for _, name in self._staged_parts():
-            self._fs.delete(os.path.join(self._staged_dir, name))
-        self._pending = []
 
     # -- reading back (tests / consumers) -------------------------------
     def committed_batches(self) -> List[Dict[str, np.ndarray]]:
+        best: Dict[Tuple[int, int], Tuple[int, str]] = {}
+        for name in self._fs.listdir(self._committed_dir):
+            parsed = self._parse_part(name)
+            if parsed is None:
+                continue
+            cid, part, epoch = parsed
+            cur = best.get((cid, part))
+            if cur is None or epoch > cur[0]:
+                # duplicate (cid, part) across attempt epochs: the
+                # highest epoch wins (the checkpoint fence resolution)
+                best[(cid, part)] = (epoch, name)
         out = []
-        for name in sorted(self._fs.listdir(self._committed_dir)):
-            with self._fs.open_read(
-                    os.path.join(self._committed_dir, name)) as f:
+        for key in sorted(best):
+            with self._fs.open_read(os.path.join(
+                    self._committed_dir, best[key][1])) as f:
                 raw = f.read()
             out.append(self.format.deserialize(
                 raw if isinstance(raw, bytes) else raw.encode()))

@@ -68,6 +68,11 @@ Instrumented points (the stack's recovery-critical seams):
     runner.heartbeat                                       runner.py
     coordinator.deploy                                     coordinator.py
     supervisor.restart                                     supervisor.py
+    log.segment.append / .seal / .fsync                    log/topic.py
+    log.txn.marker / log.txn.commit                        log/topic.py
+        (the durable-log 2PC seams: torn segment append, lost fsync,
+        pre-commit marker write, and the commit-marker rename — a
+        raise there IS "crash between pre-commit and commit")
 """
 from __future__ import annotations
 

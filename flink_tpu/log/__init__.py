@@ -1,0 +1,15 @@
+"""Durable log exchange — embedded replayable topics + 2PC connectors
+for exactly-once job chaining (see log/topic.py for the protocol)."""
+from flink_tpu.log.connectors import LogSink, LogSource
+from flink_tpu.log.topic import (
+    LogError,
+    TopicAppender,
+    TopicReader,
+    create_topic,
+    describe_topic,
+    topic_partitions,
+)
+
+__all__ = ["LogError", "LogSink", "LogSource", "TopicAppender",
+           "TopicReader", "create_topic", "describe_topic",
+           "topic_partitions"]

@@ -1,0 +1,195 @@
+"""Log connectors — LogSink (two-phase-commit producer) + LogSource
+(replayable, committed-offset consumer): the exactly-once JOB CHAINING
+plane (ref: KafkaSink's transactional producer + the FLIP-27 Kafka
+consumer; here the "broker" is an embedded filesystem topic,
+``log/topic.py``). Job A's LogSink commits epochs in lockstep with its
+checkpoints; job B's LogSource reads only committed offsets and
+snapshots its positions through the ordinary source-position
+checkpoint machinery — exactly-once holds END TO END across the job
+boundary, under crashes on either side.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from flink_tpu.api.sinks import TwoPhaseCommitSink
+from flink_tpu.api.sources import Source
+from flink_tpu.log.topic import (
+    LogError,
+    TopicAppender,
+    TopicReader,
+    topic_partitions,
+)
+
+__all__ = ["LogSink", "LogSource"]
+
+
+class LogSink(TwoPhaseCommitSink):
+    """Exactly-once producer into a log topic. Rows buffer in memory
+    per partition (hash-routed by ``key_field``, or partition 0 when
+    the topic has one); the checkpoint barrier stages them as sealed
+    segments + a pre-commit marker; checkpoint completion publishes
+    the commit marker (``topic.py`` has the protocol). One LogSink
+    instance per topic at a time — the single-writer discipline.
+
+    Construction on a dirty topic (a dead attempt's staged
+    transactions on disk) rolls the uncommitted transactions back
+    immediately: this writer owns the topic now, and a covered epoch
+    is rebuilt from the checkpoint payload at restore anyway."""
+
+    def __init__(self, path: str, key_field: Optional[str] = None,
+                 partitions: int = 1,
+                 segment_records: int = 65536) -> None:
+        if partitions > 1 and not key_field:
+            raise LogError(
+                "a multi-partition LogSink needs key_field: records "
+                "hash-route by key so each partition holds a disjoint "
+                "key range (per-key order)")
+        self.path = path
+        self.key_field = key_field
+        self._appender = TopicAppender(
+            path, partitions, segment_records=segment_records)
+        self._appender.recover()
+        self._pending: Dict[int, List[Dict[str, np.ndarray]]] = {
+            p: [] for p in range(partitions)}
+
+    @classmethod
+    def from_config(cls, config, name: str,
+                    key_field: Optional[str] = None) -> "LogSink":
+        """Topic resolved through the ``log.*`` config grammar:
+        ``log.dir``/<name>, ``log.partitions``, ``log.segment-records``
+        (the CLI-entry-point construction path)."""
+        import os
+
+        from flink_tpu.config import LogOptions
+
+        return cls(os.path.join(str(config.get(LogOptions.DIR)), name),
+                   key_field=key_field,
+                   partitions=int(config.get(LogOptions.PARTITIONS)),
+                   segment_records=int(
+                       config.get(LogOptions.SEGMENT_RECORDS)))
+
+    def set_attempt_epoch(self, epoch: int) -> None:
+        self._appender.epoch = int(epoch)
+        # aborts are epoch-fenced (topic.py abort), so the recovery
+        # sweep at construction time — which ran at the default epoch —
+        # may have skipped a dead lower-epoch attempt's staged
+        # transactions; now that this attempt's (higher) epoch is
+        # known, roll them back for real
+        self._appender.recover()
+
+    # -- write path --------------------------------------------------------
+    def write(self, batch: Dict[str, np.ndarray]) -> None:
+        cols = {k: np.asarray(v) for k, v in batch.items()}
+        if not cols or not len(next(iter(cols.values()))):
+            return
+        n_part = self._appender.partitions
+        if n_part == 1:
+            self._pending[0].append(cols)
+            return
+        from flink_tpu.records import hash_keys_numpy
+
+        if self.key_field not in cols:
+            raise LogError(
+                f"LogSink key_field {self.key_field!r} missing from "
+                f"batch columns {sorted(cols)}")
+        keys = np.asarray(cols[self.key_field], np.int64)
+        dest = hash_keys_numpy(keys) % n_part
+        for p in np.unique(dest):
+            m = dest == p
+            self._pending[int(p)].append(
+                {k: v[m] for k, v in cols.items()})
+
+    # -- TwoPhaseCommitSink contract ---------------------------------------
+    def drop_pending(self) -> None:
+        self._pending = {p: [] for p in range(self._appender.partitions)}
+
+    def stage_transaction(self, cid: int) -> bool:
+        pending, self._pending = self._pending, {
+            p: [] for p in range(self._appender.partitions)}
+        return self._appender.stage(cid, pending)
+
+    def staged_transaction_ids(self) -> List[int]:
+        return self._appender.staged_ids()
+
+    def commit_transaction(self, cid: int) -> None:
+        self._appender.commit(cid)
+
+    def abort_transaction(self, cid: int) -> None:
+        self._appender.abort(cid)
+
+    def snapshot_transaction(self, cid: int) -> Any:
+        return self._appender.snapshot(cid)
+
+    def rebuild_transaction(self, cid: int, payload: Any) -> None:
+        self._appender.rebuild(cid, payload)
+
+    def cleanup_unreferenced(self) -> None:
+        self._appender.sweep_orphans()
+
+
+class LogSource(Source):
+    """FLIP-27-style replayable reads of a topic's COMMITTED prefix:
+    one split per partition; the replay position is the RECORD OFFSET
+    (``position_after`` advances by rows consumed), so a restore
+    resumes mid-partition — whole already-consumed segments are
+    skipped without opening, and the boundary block is sliced, not
+    re-delivered. Committed-offset isolation: the segment list is
+    captured from commit markers once per source instance (at first
+    split open — every split sees the same committed snapshot), so
+    staged (pre-committed, uncommitted) producer data is never
+    observable.
+
+    ``ts_field`` names the event-time column (ms); absent, batches get
+    ingest-time stamps like FileSource. Bounded: a split ends at the
+    committed offset observed at open (chained jobs run producer then
+    consumer; tailing a live topic is a broker's job, not this
+    embedded log's)."""
+
+    def __init__(self, path: str, ts_field: Optional[str] = None) -> None:
+        self.path = path
+        self.ts_field = ts_field
+        self._reader: Optional[TopicReader] = None
+
+    def _get_reader(self) -> TopicReader:
+        # one reader per source instance, shared by all splits: the
+        # TopicReader scan (every commit marker parsed + all partitions
+        # contiguity-validated) runs ONCE, not once per partition —
+        # and all splits observe the same committed snapshot. A
+        # restore re-creates the source (build_env per attempt), so
+        # the snapshot refreshes per attempt, not per split.
+        if self._reader is None:
+            self._reader = TopicReader(self.path)
+        return self._reader
+
+    def splits(self) -> List[str]:
+        return [str(p) for p in range(topic_partitions(self.path))]
+
+    def open_split(self, split: str,
+                   start_pos: int = 0) -> Iterator[Any]:
+        reader = self._get_reader()
+        for _offset, data in reader.read(int(split),
+                                         start_offset=start_pos):
+            if self.ts_field is not None:
+                if self.ts_field not in data:
+                    raise LogError(
+                        f"LogSource ts_field {self.ts_field!r} missing "
+                        f"from topic columns {sorted(data)}")
+                ts = np.asarray(data[self.ts_field], np.int64)
+            else:
+                now = np.int64(time.time() * 1000)
+                ts = np.full(len(next(iter(data.values()), ())),
+                             now, np.int64)
+            yield data, ts
+
+    def position_after(self, pos: int, data, ts) -> int:
+        # offsets, not batch indices: replay-exact regardless of how
+        # the committed prefix re-blocks at the restore boundary
+        return pos + len(ts)
+
+    @property
+    def bounded(self) -> bool:
+        return True

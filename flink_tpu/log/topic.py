@@ -1,0 +1,643 @@
+"""Embedded durable log — append-only segmented topics on the
+FileSystem abstraction (the Kafka/KafkaSink role WITHOUT a broker
+process: jobs chain through a shared filesystem instead of a network
+service; ref: flink-connector-kafka's transactional sink + FLIP-27
+consumer, SURVEY §3.9's rename-on-commit generalized to a
+pre-commit/commit marker protocol).
+
+A **topic** is a directory; a **partition** is an append-only sequence
+of records addressed by OFFSET (record index within the partition); a
+**segment** is one sealed file in the self-contained columnar format
+(``formats_columnar.py``: schema header, CRC'd blocks, footer
+tripwire, loud truncation errors) holding a contiguous offset range.
+Every segment is written complete — footer included — at transaction
+pre-commit time, so a reader never encounters a footerless active
+file: partial writes surface as loud ``ColumnarError``s, never as
+silently short reads.
+
+Layout::
+
+    <topic>/meta.json                         {"v":1, "partitions": N}
+    <topic>/p<k>/seg-<base:012d>-c<cid:010d>-e<epoch>.colb
+    <topic>/txn/pre-<cid:010d>.json           pre-commit marker
+    <topic>/txn/commit-<cid:010d>.json        commit marker
+
+Two-phase commit (the TwoPhaseCommitSink discipline, driven by
+checkpoint barriers through ``log/connectors.py LogSink``):
+
+1. **stage** (pre-commit, on the checkpoint barrier): the appender
+   writes each partition's pending rows as sealed+fsynced segment
+   files at the partition's next offsets, then durably publishes the
+   pre-commit marker ``txn/pre-<cid>.json`` naming every segment and
+   its offset range (tmp + fsync + atomic rename).
+2. **commit** (on checkpoint completion): the commit marker
+   ``txn/commit-<cid>.json`` — carrying the same segment list, the
+   resulting end offsets, and the schema — lands by atomic rename.
+   THAT rename is the visibility point: committed-offset readers
+   enumerate commit markers only, so uncommitted segments are never
+   observable, however long they sit on disk.
+3. **abort** (attempt failure / restore of an uncovered epoch): the
+   staged segments and the pre marker are deleted — recovery rolls
+   uncommitted segments back; the epoch's rows replay from source
+   positions.
+
+Honest scope: single filesystem (any registered scheme), no broker
+process, no compaction/retention, ONE writer per topic at a time (the
+2PC sink of one producer job; concurrent producers need a broker's
+coordination, which this deliberately is not).
+
+Fault points (flink_tpu/faults.py): ``log.segment.append`` /
+``log.segment.fsync`` / ``log.segment.seal`` on the segment write
+path, ``log.txn.marker`` at the pre-commit marker rename,
+``log.txn.commit`` at the commit marker rename — the seams chaos
+suites use to prove byte-identical committed output under crashes
+between pre-commit and commit (tests/test_log_chaos.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.formats_columnar import (
+    ColumnarWriter,
+    infer_schema,
+    iter_blocks,
+)
+from flink_tpu.fs import get_filesystem
+from flink_tpu.obs.metrics import MetricRegistry
+
+__all__ = ["LogError", "TopicAppender", "TopicReader", "create_topic",
+           "topic_partitions", "describe_topic", "registry"]
+
+TXN_DIR = "txn"
+# {:010d}/{:012d} formatting PADS to the width; ids can exceed it (the
+# bounded-run final epoch is a ms timestamp), so the patterns accept
+# longer runs of digits too
+_SEG_RE = re.compile(r"^seg-(\d{12,})-c(\d{10,})-e(\d+)\.colb$")
+
+# process-global log metrics (the faults.py registry pattern): appended
+# records / sealed segments / committed + aborted transactions per
+# topic, so a chained-job deployment can watch its exchange plane
+registry = MetricRegistry()
+_counter_lock = threading.Lock()
+_counters: Dict[Tuple[str, str], Any] = {}
+
+
+def _count(topic: str, name: str, n: int = 1) -> None:
+    key = (topic, name)
+    c = _counters.get(key)
+    if c is None:
+        with _counter_lock:
+            c = _counters.get(key)
+            if c is None:
+                c = registry.group("log", topic).counter(name)
+                _counters[key] = c
+    c.inc(n)
+
+
+class LogError(ValueError):
+    """Malformed or unusable topic state: missing topic, partition
+    mismatch, overlapping/non-contiguous committed offset ranges,
+    schema drift. Always loud — a log exchange must never silently
+    skip or duplicate records (the same contract as ColumnarError)."""
+
+
+def _seg_name(base: int, cid: int, epoch: int) -> str:
+    return f"seg-{base:012d}-c{cid:010d}-e{epoch}.colb"
+
+
+def _partition_dir(path: str, p: int) -> str:
+    return os.path.join(path, f"p{p}")
+
+
+def _txn_dir(path: str) -> str:
+    return os.path.join(path, TXN_DIR)
+
+
+def _write_atomic(fs, path: str, payload: bytes, fsync: bool = True) -> None:
+    tmp = path + ".tmp"
+    with fs.open_write(tmp) as f:
+        f.write(payload)
+        if fsync:
+            f.flush()
+            try:
+                os.fsync(f.fileno())
+            except (AttributeError, OSError):
+                pass  # non-local filesystems own their durability
+    fs.rename(tmp, path)
+
+
+def create_topic(path: str, partitions: int) -> None:
+    """Create (or validate) a topic directory. Idempotent for matching
+    partition counts; a mismatch is a loud error — offsets are
+    per-partition, so silently changing the count would re-route
+    keys."""
+    if partitions < 1:
+        raise LogError(f"topic needs >= 1 partition, got {partitions}")
+    fs = get_filesystem(path)
+    meta_path = os.path.join(path, "meta.json")
+    if fs.exists(meta_path):
+        existing = topic_partitions(path)
+        if existing != partitions:
+            raise LogError(
+                f"topic {path!r} exists with {existing} partitions; "
+                f"refusing to reopen with {partitions}")
+        return
+    fs.mkdirs(_txn_dir(path))
+    for p in range(partitions):
+        fs.mkdirs(_partition_dir(path, p))
+    _write_atomic(fs, meta_path, json.dumps(
+        {"v": 1, "partitions": int(partitions)}).encode("utf-8"))
+
+
+def topic_partitions(path: str) -> int:
+    fs = get_filesystem(path)
+    meta_path = os.path.join(path, "meta.json")
+    if not fs.exists(meta_path):
+        raise LogError(f"no such log topic: {path!r} (no meta.json)")
+    with fs.open_read(meta_path) as f:
+        raw = f.read()
+    try:
+        meta = json.loads(raw if isinstance(raw, str)
+                          else raw.decode("utf-8"))
+        return int(meta["partitions"])
+    except (ValueError, KeyError) as e:
+        raise LogError(f"corrupt topic meta at {path!r}: {e}") from e
+
+
+def _marker_ids(fs, path: str, kind: str) -> set:
+    """``kind`` in ('pre', 'commit') → {cid}, from filenames ALONE — no
+    marker is opened. The per-checkpoint hot path (staged_ids) runs on
+    this, so its cost stays O(directory entries) even as commit markers
+    accumulate over a topic's lifetime."""
+    tdir = _txn_dir(path)
+    if not fs.exists(tdir):
+        return set()
+    pat = re.compile(rf"^{kind}-(\d{{10,}})\.json$")
+    return {int(m.group(1))
+            for m in map(pat.match, fs.listdir(tdir)) if m}
+
+
+def _list_markers(fs, path: str, kind: str) -> Dict[int, Dict[str, Any]]:
+    """``kind`` in ('pre', 'commit') → {cid: marker dict}."""
+    tdir = _txn_dir(path)
+    out: Dict[int, Dict[str, Any]] = {}
+    if not fs.exists(tdir):
+        return out
+    pat = re.compile(rf"^{kind}-(\d{{10,}})\.json$")
+    for name in fs.listdir(tdir):
+        m = pat.match(name)
+        if m is None:
+            continue
+        with fs.open_read(os.path.join(tdir, name)) as f:
+            raw = f.read()
+        try:
+            out[int(m.group(1))] = json.loads(
+                raw if isinstance(raw, str) else raw.decode("utf-8"))
+        except ValueError as e:
+            raise LogError(
+                f"corrupt {kind}-commit marker {name!r} in topic "
+                f"{path!r}: {e}") from e
+    return out
+
+
+class TopicAppender:
+    """The single-writer append/2PC side of one topic (LogSink's
+    engine). Offset bookkeeping: ``_next[p]`` = committed end offset
+    plus every staged (pre-committed, uncommitted) transaction's rows —
+    staged transactions STACK, because checkpoint N+1's barrier can
+    stage a new epoch while N's commit notification is still in
+    flight."""
+
+    def __init__(self, path: str, partitions: int,
+                 segment_records: int = 65536, epoch: int = 0) -> None:
+        if segment_records < 1:
+            raise LogError(
+                f"log segment-records must be >= 1, got {segment_records}")
+        create_topic(path, partitions)
+        self.path = path
+        self.topic = os.path.basename(os.path.normpath(path)) or "topic"
+        self.partitions = partitions
+        self.segment_records = segment_records
+        self.epoch = int(epoch)
+        self._fs = get_filesystem(path)
+        # cids THIS writer staged rows for: commit() uses it to tell a
+        # genuinely-empty epoch (no marker was ever written — no-op by
+        # contract) from a marker that VANISHED after stage() returned
+        # True, which is data loss and must be loud
+        self._staged_live: set = set()
+        self._schema: Optional[Tuple[Tuple[str, str], ...]] = None
+        # adopt the committed schema: a second producer run appending to
+        # an existing topic must match it (readers enforce per segment)
+        commits = _list_markers(self._fs, path, "commit")
+        if commits:
+            last = commits[max(commits)]
+            if last.get("schema"):
+                self._schema = tuple(
+                    (str(n), str(t)) for n, t in last["schema"])
+        self._refresh_offsets()
+
+    # -- offsets ----------------------------------------------------------
+    def _refresh_offsets(self) -> None:
+        commits = _list_markers(self._fs, self.path, "commit")
+        pres = _list_markers(self._fs, self.path, "pre")
+        nxt = {p: 0 for p in range(self.partitions)}
+        for marker in commits.values():
+            for p_s, end in marker.get("offsets", {}).items():
+                p = int(p_s)
+                nxt[p] = max(nxt[p], int(end))
+        # staged-but-uncommitted transactions extend the chain
+        for cid in sorted(set(pres) - set(commits)):
+            for p_s, segs in pres[cid].get("segments", {}).items():
+                p = int(p_s)
+                for s in segs:
+                    nxt[p] = max(nxt[p], int(s["base"]) + int(s["rows"]))
+        self._next = nxt
+
+    def next_offset(self, p: int) -> int:
+        return self._next[p]
+
+    # -- 2PC --------------------------------------------------------------
+    def _check_schema(self, batch: Dict[str, np.ndarray]):
+        schema = infer_schema(batch)
+        if self._schema is None:
+            self._schema = schema
+        elif schema != self._schema:
+            raise LogError(
+                f"schema drift on topic {self.path!r}: appending "
+                f"{schema}, topic carries {self._schema} — a log "
+                "topic's schema is fixed at first append")
+        return self._schema
+
+    def _write_segment(self, p: int, base: int, cid: int,
+                       batches: List[Dict[str, np.ndarray]]) -> Dict[str, Any]:
+        from flink_tpu import faults
+
+        name = _seg_name(base, cid, self.epoch)
+        pdir = _partition_dir(self.path, p)
+        tmp = os.path.join(pdir, name + ".tmp")
+        rows = 0
+        with self._fs.open_write(tmp) as f:
+            w = ColumnarWriter(f, self._schema)
+            for b in batches:
+                # torn-append seam: a raise here leaves a footerless
+                # .tmp the recovery sweep removes — never a readable
+                # partial segment
+                faults.fire("log.segment.append", exc=OSError,
+                            topic=self.topic, partition=p, cid=cid)
+                w.write_batch(b)
+                rows += len(np.asarray(b[self._schema[0][0]]))
+            faults.fire("log.segment.seal", exc=OSError,
+                        topic=self.topic, partition=p, cid=cid)
+            w.close()  # footer — the completeness tripwire
+            f.flush()
+            faults.fire("log.segment.fsync", exc=OSError,
+                        topic=self.topic, partition=p, cid=cid)
+            try:
+                os.fsync(f.fileno())
+            except (AttributeError, OSError):
+                pass
+        self._fs.rename(tmp, os.path.join(pdir, name))
+        _count(self.topic, "segments_sealed")
+        _count(self.topic, "records_appended", rows)
+        return {"name": name, "base": int(base), "rows": int(rows)}
+
+    def stage(self, cid: int,
+              pending: Dict[int, List[Dict[str, np.ndarray]]]) -> bool:
+        """Pre-commit: write ``pending[p]`` (lists of column batches)
+        as sealed segments at each partition's next offsets, then
+        durably publish the pre-commit marker. Returns False when no
+        partition had rows (no empty transactions)."""
+        from flink_tpu import faults
+
+        per_part: Dict[str, List[Dict[str, Any]]] = {}
+        staged_next = dict(self._next)
+        for p in sorted(pending):
+            batches = [b for b in pending[p]
+                       if len(next(iter(b.values()), ()))]
+            if not batches:
+                continue
+            for b in batches:
+                self._check_schema(b)
+            base = staged_next[p]
+            segs: List[Dict[str, Any]] = []
+            chunks: List[Dict[str, np.ndarray]] = []
+            n_chunk = 0
+            for b in batches:
+                n = len(next(iter(b.values())))
+                lo = 0
+                while lo < n:
+                    take = min(self.segment_records - n_chunk, n - lo)
+                    chunks.append({k: np.asarray(v)[lo:lo + take]
+                                   for k, v in b.items()})
+                    n_chunk += take
+                    lo += take
+                    if n_chunk == self.segment_records:
+                        segs.append(self._write_segment(
+                            p, base, cid, chunks))
+                        base += n_chunk
+                        chunks, n_chunk = [], 0
+            if chunks:
+                segs.append(self._write_segment(p, base, cid, chunks))
+                base += n_chunk
+            per_part[str(p)] = segs
+            staged_next[p] = base
+        if not per_part:
+            return False
+        marker = {
+            "cid": int(cid), "epoch": self.epoch,
+            "segments": per_part,
+            "offsets": {p: int(staged_next[int(p)]) for p in per_part},
+            "schema": [[n, t] for n, t in self._schema],
+        }
+        # pre-commit marker: after this rename the transaction is
+        # recoverable (re-commit or roll back), before it the segments
+        # are unreferenced debris the cleanup sweep removes
+        faults.fire("log.txn.marker", exc=OSError,
+                    topic=self.topic, cid=cid)
+        _write_atomic(self._fs, os.path.join(
+            _txn_dir(self.path), f"pre-{cid:010d}.json"),
+            json.dumps(marker).encode("utf-8"))
+        self._next = staged_next
+        self._staged_live.add(int(cid))
+        return True
+
+    def staged_ids(self) -> List[int]:
+        return sorted(_marker_ids(self._fs, self.path, "pre")
+                      - _marker_ids(self._fs, self.path, "commit"))
+
+    def commit(self, cid: int) -> None:
+        """THE visibility point: rename the commit marker into place.
+        Idempotent; a no-op for ids that staged nothing."""
+        from flink_tpu import faults
+
+        cpath = os.path.join(_txn_dir(self.path), f"commit-{cid:010d}.json")
+        if self._fs.exists(cpath):
+            self._staged_live.discard(int(cid))
+            return
+        ppath = os.path.join(_txn_dir(self.path), f"pre-{cid:010d}.json")
+        if not self._fs.exists(ppath):
+            if int(cid) in self._staged_live:
+                # stage() durably published this marker and returned
+                # True — a vanished marker at commit time means some
+                # other actor rolled our live transaction back (e.g. a
+                # second writer's recover() on a topic we still own).
+                # Returning success here would silently drop the epoch.
+                raise LogError(
+                    f"pre-commit marker for staged transaction {cid} "
+                    f"vanished from topic {self.path!r} before commit "
+                    "— rolled back by another writer? (single-writer "
+                    "discipline violated; refusing to silently drop "
+                    "the epoch)")
+            return  # empty epoch — nothing was staged
+        with self._fs.open_read(ppath) as f:
+            raw = f.read()
+        pre = json.loads(raw if isinstance(raw, str)
+                         else raw.decode("utf-8"))
+        if int(pre.get("epoch", 0)) > self.epoch:
+            # epoch fence, commit side (mirror of abort): this marker
+            # was staged by a SUCCESSOR attempt — a deposed attempt's
+            # lagging commit round must not publish an epoch whose
+            # covering checkpoint (the successor's) hasn't completed;
+            # committing it early would make uncovered rows visible
+            # and duplicate them when the successor replays
+            return
+        commit = {"cid": int(cid), "epoch": pre.get("epoch", 0),
+                  "segments": pre["segments"],
+                  "offsets": pre["offsets"],
+                  "schema": pre.get("schema")}
+        faults.fire("log.txn.commit", exc=OSError,
+                    topic=self.topic, cid=cid)
+        _write_atomic(self._fs, cpath,
+                      json.dumps(commit).encode("utf-8"))
+        self._staged_live.discard(int(cid))
+        _count(self.topic, "txns_committed")
+
+    def abort(self, cid: int) -> None:
+        """Roll staged transaction ``cid`` back: delete its segments,
+        then its pre marker (in that order — a crash mid-abort leaves
+        the marker, so the next sweep finishes the job). EPOCH-FENCED:
+        a marker staged by a HIGHER attempt epoch belongs to a
+        successor that now owns the topic — a deposed attempt's
+        late-running cleanup must skip it, never delete a live
+        successor's staged epoch (the same fence the part/segment
+        names carry)."""
+        ppath = os.path.join(_txn_dir(self.path), f"pre-{cid:010d}.json")
+        cpath = os.path.join(_txn_dir(self.path), f"commit-{cid:010d}.json")
+        if self._fs.exists(cpath):
+            raise LogError(
+                f"refusing to abort committed transaction {cid} on "
+                f"topic {self.path!r}")
+        if not self._fs.exists(ppath):
+            self._staged_live.discard(int(cid))
+            return
+        with self._fs.open_read(ppath) as f:
+            raw = f.read()
+        pre = json.loads(raw if isinstance(raw, str)
+                         else raw.decode("utf-8"))
+        if int(pre.get("epoch", 0)) > self.epoch:
+            return  # a successor attempt's staged epoch — not ours
+        self._staged_live.discard(int(cid))
+        for p_s, segs in pre.get("segments", {}).items():
+            pdir = _partition_dir(self.path, int(p_s))
+            for s in segs:
+                seg = os.path.join(pdir, s["name"])
+                if self._fs.exists(seg):
+                    self._fs.delete(seg)
+        self._fs.delete(ppath)
+        _count(self.topic, "txns_aborted")
+        self._refresh_offsets()
+
+    def snapshot(self, cid: int) -> Dict[str, Any]:
+        """Checkpoint payload: the pre marker plus every staged segment's
+        bytes — enough to rebuild the transaction after an abort swept
+        the staged files (the FileSink staged-bytes rationale)."""
+        ppath = os.path.join(_txn_dir(self.path), f"pre-{cid:010d}.json")
+        with self._fs.open_read(ppath) as f:
+            raw = f.read()
+        pre = json.loads(raw if isinstance(raw, str)
+                         else raw.decode("utf-8"))
+        segments: Dict[str, bytes] = {}
+        for p_s, segs in pre.get("segments", {}).items():
+            pdir = _partition_dir(self.path, int(p_s))
+            for s in segs:
+                with self._fs.open_read(os.path.join(pdir, s["name"])) as f:
+                    b = f.read()
+                segments[f"{p_s}/{s['name']}"] = (
+                    b if isinstance(b, bytes) else b.encode())
+        return {"pre": pre, "segments": segments}
+
+    def rebuild(self, cid: int, payload: Dict[str, Any]) -> None:
+        """Re-create staged transaction ``cid`` from its checkpoint
+        payload where absent (idempotent; a commit follows)."""
+        cpath = os.path.join(_txn_dir(self.path), f"commit-{cid:010d}.json")
+        if self._fs.exists(cpath):
+            return  # already committed — nothing to rebuild
+        for key, data in payload.get("segments", {}).items():
+            p_s, _, name = key.partition("/")
+            dst = os.path.join(_partition_dir(self.path, int(p_s)), name)
+            if not self._fs.exists(dst):
+                _write_atomic(self._fs, dst, data)
+        ppath = os.path.join(_txn_dir(self.path), f"pre-{cid:010d}.json")
+        if not self._fs.exists(ppath):
+            _write_atomic(self._fs, ppath,
+                          json.dumps(payload["pre"]).encode("utf-8"))
+        self._refresh_offsets()
+
+    def sweep_orphans(self) -> int:
+        """Delete segment files no pre/commit marker references (a crash
+        between segment write and marker rename — torn prepare) and
+        stray .tmp leftovers. Returns the number removed."""
+        pres = _list_markers(self._fs, self.path, "pre")
+        commits = _list_markers(self._fs, self.path, "commit")
+        referenced = set()
+        for marker in list(pres.values()) + list(commits.values()):
+            for p_s, segs in marker.get("segments", {}).items():
+                for s in segs:
+                    referenced.add((int(p_s), s["name"]))
+        removed = 0
+        for p in range(self.partitions):
+            pdir = _partition_dir(self.path, p)
+            if not self._fs.exists(pdir):
+                continue
+            for name in self._fs.listdir(pdir):
+                if name.endswith(".tmp") or (
+                        _SEG_RE.match(name)
+                        and (p, name) not in referenced):
+                    self._fs.delete(os.path.join(pdir, name))
+                    removed += 1
+        if removed:
+            self._refresh_offsets()
+        return removed
+
+    def recover(self) -> None:
+        """Fresh-start recovery on a topic this writer now owns: roll
+        every uncommitted (staged) transaction back and sweep torn
+        debris — a dead producer attempt's pre-committed epochs must
+        never linger as phantom stageable state (restore_staged
+        rebuilds covered epochs from the checkpoint payload
+        afterwards)."""
+        for cid in self.staged_ids():
+            self.abort(cid)
+        self.sweep_orphans()
+        self._refresh_offsets()
+
+
+class _Segment:
+    __slots__ = ("p", "base", "end", "name", "cid")
+
+    def __init__(self, p: int, base: int, end: int, name: str, cid: int):
+        self.p, self.base, self.end = p, base, end
+        self.name, self.cid = name, cid
+
+
+class TopicReader:
+    """Committed-offset reads: only segments a COMMIT marker names are
+    observable, in offset order, validated contiguous (an overlap or
+    gap in the committed ranges is corruption and fails loudly).
+    Offset-addressed: ``read(p, start_offset)`` resumes mid-partition —
+    whole segments before the offset are skipped without opening,
+    already-consumed leading rows of the boundary block are sliced
+    off."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._fs = get_filesystem(path)
+        self.partitions = topic_partitions(path)
+        commits = _list_markers(self._fs, path, "commit")
+        self._schema = None
+        per_part: Dict[int, List[_Segment]] = {
+            p: [] for p in range(self.partitions)}
+        for cid in sorted(commits):
+            marker = commits[cid]
+            if self._schema is None and marker.get("schema"):
+                self._schema = tuple(
+                    (str(n), str(t)) for n, t in marker["schema"])
+            for p_s, segs in marker.get("segments", {}).items():
+                p = int(p_s)
+                for s in segs:
+                    per_part[p].append(_Segment(
+                        p, int(s["base"]), int(s["base"]) + int(s["rows"]),
+                        s["name"], cid))
+        for p, segs in per_part.items():
+            segs.sort(key=lambda s: s.base)
+            at = 0
+            for s in segs:
+                if s.base != at:
+                    raise LogError(
+                        f"topic {path!r} p{p}: committed segment "
+                        f"{s.name!r} starts at offset {s.base}, expected "
+                        f"{at} — overlapping or missing commit ranges "
+                        "(corrupt transaction log)")
+                at = s.end
+        self._segments = per_part
+
+    def committed_offsets(self) -> Dict[int, int]:
+        return {p: (segs[-1].end if segs else 0)
+                for p, segs in self._segments.items()}
+
+    def read(self, p: int, start_offset: int = 0
+             ) -> Iterator[Tuple[int, Dict[str, np.ndarray]]]:
+        """Yield ``(offset_of_first_row, batch)`` per stored block from
+        ``start_offset`` to the committed end. Truncated or corrupt
+        segments raise ColumnarError — a committed range that cannot be
+        read back whole is data loss, never a silent skip."""
+        if p not in self._segments:
+            raise LogError(
+                f"topic {self.path!r} has no partition {p} "
+                f"(partitions: {self.partitions})")
+        for seg in self._segments[p]:
+            if seg.end <= start_offset:
+                continue
+            path = os.path.join(_partition_dir(self.path, p), seg.name)
+            with self._fs.open_read(path) as f:
+                data = f.read()
+            if isinstance(data, str):
+                data = data.encode("utf-8")
+            offset = seg.base
+            rows_seen = 0
+            for block in iter_blocks(data, expect_schema=self._schema):
+                n = len(next(iter(block.values()), ()))
+                rows_seen += n
+                if offset + n <= start_offset:
+                    offset += n
+                    continue
+                if offset < start_offset:
+                    cut = start_offset - offset
+                    block = {k: v[cut:] for k, v in block.items()}
+                    offset = start_offset
+                yield offset, block
+                offset += len(next(iter(block.values()), ()))
+            if rows_seen != seg.end - seg.base:
+                raise LogError(
+                    f"topic {self.path!r} p{p}: segment {seg.name!r} "
+                    f"holds {rows_seen} rows, commit marker promised "
+                    f"{seg.end - seg.base} (corrupt segment)")
+
+
+def describe_topic(path: str) -> Dict[str, Any]:
+    """Inspection view (the CLI ``log`` subcommand): partitions,
+    committed offsets, staged (pre-committed, uncommitted)
+    transactions, per-partition segment counts."""
+    fs = get_filesystem(path)
+    reader = TopicReader(path)
+    pres = _list_markers(fs, path, "pre")
+    commits = _list_markers(fs, path, "commit")
+    committed = reader.committed_offsets()
+    return {
+        "topic": path,
+        "partitions": reader.partitions,
+        "committed_offsets": {str(p): committed[p] for p in committed},
+        "committed_records": int(sum(committed.values())),
+        "committed_transactions": sorted(commits),
+        "staged_transactions": sorted(set(pres) - set(commits)),
+        "segments": {str(p): len(reader._segments[p])
+                     for p in reader._segments},
+        "schema": ([[n, t] for n, t in reader._schema]
+                   if reader._schema else None),
+    }

@@ -494,6 +494,11 @@ class Driver:
             lambda: self._snapshot(allow_reuse=not savepoint),
             commit_fns=[s.notify_checkpoint_complete for s in sinks],
             prepare_fns=[s.prepare_commit for s in sinks],
+            # abandon() (attempt failure with this checkpoint in
+            # flight) notifies 2PC sinks to roll THIS epoch's staged
+            # transaction back — recovery rolls uncommitted log
+            # segments/parts back durably, not just in memory
+            abort_fns=[s.notify_checkpoint_abort for s in sinks],
             executor=self._ckpt_executor,
             savepoint=savepoint,
         )
@@ -650,7 +655,7 @@ class Driver:
                     continue
                 batch = nxt
                 batch_ix = ix
-                self._positions[sid][ix] += 1
+                self._advance_position(sid, ix, nxt[0], nxt[1])
                 break
             shares: Dict[int, Any] = {}
             if batch is not None:
@@ -983,6 +988,17 @@ class Driver:
         from flink_tpu.obs.metrics import METRICS_BIND, METRICS_PORT, MetricsServer
 
         self._coordinator = self._setup_checkpointing(job_name)
+        # announce this attempt's fencing epoch to transactional sinks
+        # BEFORE any restore/write: epoch-qualified in-progress names
+        # (part files, log segments) keep a deposed attempt's late
+        # renames off a successor's committed output — the same
+        # chk-<id>.e<epoch> discipline checkpoint storage uses
+        attempt_epoch = int(self.config.get_raw("cluster.attempt", 0))
+        for n in self.plan.nodes.values():
+            if n.kind == "sink":
+                setter = getattr(n.sink, "set_attempt_epoch", None)
+                if setter is not None:
+                    setter(attempt_epoch)
         from concurrent.futures import ThreadPoolExecutor
 
         self._ckpt_executor = (ThreadPoolExecutor(
@@ -1246,7 +1262,7 @@ class Driver:
                                 op.throttle()
                         prof["push"] += time.perf_counter() - t2
                         t1 = time.perf_counter()
-                    self._positions[sid][split_ix] += 1
+                    self._advance_position(sid, split_ix, data, ts)
                     self._eps_meter.mark(len(ts))
                     if len(ts):
                         mx = int(ts.max())
@@ -1474,7 +1490,7 @@ class Driver:
                     if hasattr(op, "throttle"):
                         op.throttle()
                 prof["push"] += time.perf_counter() - t1
-                self._positions[sid][split_ix] += 1
+                self._advance_position(sid, split_ix, data, ts)
                 self._eps_meter.mark(len(ts))
                 if len(ts):
                     self._max_ts[sid] = max(self._max_ts[sid],
@@ -1535,6 +1551,15 @@ class Driver:
         with self._push_lock:
             self._propagate_watermarks(final=True, only=only)
         self._flush_emits()
+
+    def _advance_position(self, sid: int, split_ix: int, data, ts) -> None:
+        """One consumed source batch: the SOURCE defines what the next
+        replay position is (api/sources.py position_after — batch
+        count by default; record OFFSETS for offset-addressed sources
+        like log.LogSource, so a restore resumes mid-partition)."""
+        src = self.plan.node(sid).source
+        pos = self._positions[sid][split_ix]
+        self._positions[sid][split_ix] = src.position_after(pos, data, ts)
 
     # -- data plane ------------------------------------------------------
     def live_metrics(self) -> Dict[str, Any]:

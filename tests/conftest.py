@@ -39,3 +39,7 @@ def pytest_configure(config):
         "markers", "batch: bounded-execution (execution.runtime-mode="
         "batch) tests — blocking shuffle, columnar exchange, final-only "
         "fires")
+    config.addinivalue_line(
+        "markers", "log: durable-log exchange tests (flink_tpu/log/) — "
+        "embedded replayable topics, 2PC commit markers, exactly-once "
+        "job chaining")

@@ -118,6 +118,60 @@ class TestFileSink:
         sink.notify_checkpoint_complete(1)
         assert sink.committed_batches() == []
 
+    def test_deposed_attempt_cannot_clobber_committed_part(self, tmp_path):
+        """Attempt-epoch-qualified part names (the chk-<id>.e<epoch>
+        fencing discipline): a deposed attempt restarting mid-commit
+        renames to ITS epoch's name and the idempotence check sees the
+        successor's committed copy — the committed part is never
+        clobbered and readers resolve one (cid, part) to exactly one
+        file (highest epoch)."""
+        f = CsvFormat([("k", "i64")])
+        deposed = FileSink(str(tmp_path), f)
+        deposed.set_attempt_epoch(1)
+        deposed.write({"k": np.array([1, 2], np.int64)})
+        deposed.prepare_commit(1)  # staged under .e1, then the attempt
+        # is deposed mid-commit; its successor re-stages and commits
+        succ = FileSink(str(tmp_path), f)
+        succ.set_attempt_epoch(2)
+        succ.write({"k": np.array([1, 2], np.int64)})
+        succ.prepare_commit(1)
+        succ.notify_checkpoint_complete(1)
+        committed = os.listdir(tmp_path / "committed")
+        assert committed == ["part-0000000001-0000.e2"]
+        # the deposed attempt wakes up and finishes ITS commit round
+        deposed.notify_checkpoint_complete(1)
+        assert os.listdir(tmp_path / "committed") == committed
+        assert os.listdir(tmp_path / "staged") == []
+        got = succ.committed_batches()
+        assert len(got) == 1 and got[0]["k"].tolist() == [1, 2]
+
+    def test_deposed_abort_cannot_delete_successor_staged(self, tmp_path):
+        """Abort is epoch-fenced like the rename path: a deposed
+        attempt's late cleanup skips staged parts a higher attempt
+        epoch owns."""
+        f = CsvFormat([("k", "i64")])
+        deposed = FileSink(str(tmp_path), f)
+        deposed.set_attempt_epoch(1)
+        succ = FileSink(str(tmp_path), f)
+        succ.set_attempt_epoch(2)
+        succ.write({"k": np.array([7], np.int64)})
+        succ.prepare_commit(3)
+        deposed.abort_uncommitted()  # deposed failure path fires late
+        assert os.listdir(tmp_path / "staged") == \
+            ["part-0000000003-0000.e2"]
+        succ.notify_checkpoint_complete(3)
+        got = succ.committed_batches()
+        assert len(got) == 1 and got[0]["k"].tolist() == [7]
+
+    def test_epochless_legacy_part_names_still_read(self, tmp_path):
+        f = CsvFormat([("k", "i64")])
+        sink = FileSink(str(tmp_path), f)
+        with open(tmp_path / "committed" / "part-0000000001-0000",
+                  "w") as fh:
+            fh.write("5\n")
+        got = sink.committed_batches()
+        assert len(got) == 1 and got[0]["k"].tolist() == [5]
+
     def test_snapshot_restore_reconstructs_staged(self, tmp_path):
         f = CsvFormat([("k", "i64")])
         sink = FileSink(str(tmp_path), f)

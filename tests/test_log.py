@@ -1,0 +1,463 @@
+"""Durable log exchange (flink_tpu/log/): append-only segmented
+topics with 2PC commit markers, committed-offset read isolation,
+offset-addressed replayable LogSource splits, and two jobs chained
+through a topic producing output identical to the fused single job —
+plus the tier-1 CLI smoke chaining two ``python -m flink_tpu run
+--local`` jobs through a topic (ISSUE 3)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.api.sinks import TransactionalCollectSink
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.api.windowing import TumblingEventTimeWindows
+from flink_tpu.config import Configuration
+from flink_tpu.log import (
+    LogError,
+    LogSink,
+    LogSource,
+    TopicReader,
+    create_topic,
+    describe_topic,
+)
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+pytestmark = pytest.mark.log
+
+
+def word_gen(n_batches, batch=64, vocab=10):
+    def gen(split, i):
+        if i >= n_batches:
+            return None
+        rng = np.random.default_rng(500 + i)
+        words = rng.integers(0, vocab, batch).astype(np.int64)
+        ts = (i * batch + np.arange(batch, dtype=np.int64)) * 10
+        return {"word": words, "ts_ms": ts}, ts
+
+    return gen
+
+
+def committed_view(sink):
+    return sorted((int(r["key"]), int(r["window_start"]), int(r["count"]))
+                  for r in sink.committed)
+
+
+def run_consumer(topic, shards=8):
+    sink = TransactionalCollectSink()
+    env = StreamExecutionEnvironment(Configuration({
+        "state.num-key-shards": shards, "state.slots-per-shard": 64}))
+    (env.from_source(LogSource(topic, ts_field="ts_ms"),
+                     WatermarkStrategy.for_bounded_out_of_orderness(1000))
+     .key_by("word").window(TumblingEventTimeWindows.of(1000)).count()
+     .add_sink(sink))
+    env.execute("log-consumer")
+    return committed_view(sink)
+
+
+def golden_fused(n_batches, shards=8):
+    sink = TransactionalCollectSink()
+    env = StreamExecutionEnvironment(Configuration({
+        "state.num-key-shards": shards, "state.slots-per-shard": 64}))
+    (env.from_source(GeneratorSource(word_gen(n_batches)),
+                     WatermarkStrategy.for_bounded_out_of_orderness(1000))
+     .key_by("word").window(TumblingEventTimeWindows.of(1000)).count()
+     .add_sink(sink))
+    env.execute("log-golden")
+    return committed_view(sink)
+
+
+class TestTopicCore:
+    def _batch(self, lo, n):
+        return {"k": np.arange(lo, lo + n, dtype=np.int64),
+                "v": np.arange(n, dtype=np.int64) * 10}
+
+    def test_stage_commit_offsets_and_segment_roll(self, tmp_path):
+        topic = str(tmp_path / "t")
+        sink = LogSink(topic, partitions=1, segment_records=5)
+        sink.write(self._batch(0, 12))
+        sink.prepare_commit(1)
+        assert TopicReader(topic).committed_offsets() == {0: 0}
+        sink.notify_checkpoint_complete(1)
+        r = TopicReader(topic)
+        assert r.committed_offsets() == {0: 12}
+        # 12 rows at 5/segment -> 3 sealed segments
+        d = describe_topic(topic)
+        assert d["segments"] == {"0": 3}
+        rows = [b["k"].tolist() for _, b in r.read(0)]
+        assert [x for blk in rows for x in blk] == list(range(12))
+
+    def test_commit_idempotent_and_staged_stack(self, tmp_path):
+        topic = str(tmp_path / "t")
+        sink = LogSink(topic, partitions=1)
+        sink.write(self._batch(0, 4))
+        sink.prepare_commit(1)
+        sink.write(self._batch(4, 4))
+        sink.prepare_commit(2)  # stacks ABOVE staged txn 1
+        assert sink.staged_transaction_ids() == [1, 2]
+        sink.notify_checkpoint_complete(2)  # commits both, in order
+        sink.notify_checkpoint_complete(2)  # replayed commit: no-op
+        r = TopicReader(topic)
+        assert r.committed_offsets() == {0: 8}
+        got = [x for _, b in r.read(0) for x in b["k"].tolist()]
+        assert got == list(range(8))
+
+    def test_abort_rolls_segments_and_offsets_back(self, tmp_path):
+        topic = str(tmp_path / "t")
+        sink = LogSink(topic, partitions=1)
+        sink.write(self._batch(0, 4))
+        sink.prepare_commit(1)
+        sink.notify_checkpoint_complete(1)
+        sink.write(self._batch(4, 4))
+        sink.prepare_commit(2)
+        assert sink._appender.next_offset(0) == 8
+        sink.abort_uncommitted()
+        assert sink.staged_transaction_ids() == []
+        assert sink._appender.next_offset(0) == 4
+        # the rolled-back segment file is gone, not just unreferenced
+        segs = os.listdir(tmp_path / "t" / "p0")
+        assert len([s for s in segs if s.endswith(".colb")]) == 1
+        # offsets reuse after rollback: the next epoch lands at 4
+        sink.write(self._batch(100, 2))
+        sink.prepare_commit(3)
+        sink.notify_checkpoint_complete(3)
+        got = [x for _, b in TopicReader(topic).read(0)
+               for x in b["k"].tolist()]
+        assert got == [0, 1, 2, 3, 100, 101]
+
+    def test_partition_count_is_fixed(self, tmp_path):
+        topic = str(tmp_path / "t")
+        create_topic(topic, 2)
+        with pytest.raises(LogError, match="refusing to reopen"):
+            LogSink(topic, key_field="k", partitions=3)
+
+    def test_schema_drift_rejected(self, tmp_path):
+        topic = str(tmp_path / "t")
+        sink = LogSink(topic, partitions=1)
+        sink.write(self._batch(0, 2))
+        sink.prepare_commit(1)
+        sink.notify_checkpoint_complete(1)
+        sink.write({"other": np.arange(2, dtype=np.int64)})
+        with pytest.raises(LogError, match="schema drift"):
+            sink.prepare_commit(2)
+
+    def test_multi_partition_routing_preserves_per_key_order(
+            self, tmp_path):
+        topic = str(tmp_path / "t")
+        sink = LogSink(topic, key_field="k", partitions=4,
+                       segment_records=7)
+        rng = np.random.default_rng(3)
+        keys = rng.integers(0, 20, 200).astype(np.int64)
+        seq = np.arange(200, dtype=np.int64)
+        sink.write({"k": keys, "seq": seq})
+        sink.prepare_commit(1)
+        sink.notify_checkpoint_complete(1)
+        r = TopicReader(topic)
+        assert sum(r.committed_offsets().values()) == 200
+        per_key = {}
+        for p in range(4):
+            for _, b in r.read(p):
+                for k, s in zip(b["k"].tolist(), b["seq"].tolist()):
+                    per_key.setdefault(k, []).append(s)
+        for k, seqs in per_key.items():
+            assert seqs == sorted(seqs), f"key {k} out of order"
+
+
+class TestCommittedOffsetIsolation:
+    def test_staged_is_never_observable(self, tmp_path):
+        topic = str(tmp_path / "t")
+        sink = LogSink(topic, partitions=1)
+        sink.write({"k": np.arange(6, dtype=np.int64)})
+        sink.prepare_commit(1)
+        # pre-committed (durable!) but uncommitted: invisible to the
+        # reader AND to LogSource
+        assert TopicReader(topic).committed_offsets() == {0: 0}
+        assert list(LogSource(topic).open_split("0")) == []
+        assert describe_topic(topic)["staged_transactions"] == [1]
+        sink.notify_checkpoint_complete(1)
+        got = [x for _, b in TopicReader(topic).read(0)
+               for x in b["k"].tolist()]
+        assert got == list(range(6))
+
+    def test_orphan_segments_are_swept_not_read(self, tmp_path):
+        """A crash between segment write and pre-marker rename leaves an
+        unreferenced segment: readers never see it; the writer's
+        recovery sweep removes it."""
+        topic = str(tmp_path / "t")
+        sink = LogSink(topic, partitions=1)
+        sink.write({"k": np.arange(3, dtype=np.int64)})
+        sink.prepare_commit(1)
+        sink.notify_checkpoint_complete(1)
+        # forge the torn-prepare debris: a sealed segment, no marker
+        orphan = tmp_path / "t" / "p0" / "seg-000000000099-c0000000099-e0.colb"
+        with open(tmp_path / "t" / "p0" /
+                  os.listdir(tmp_path / "t" / "p0")[0], "rb") as f:
+            orphan.write_bytes(f.read())
+        got = [x for _, b in TopicReader(topic).read(0)
+               for x in b["k"].tolist()]
+        assert got == [0, 1, 2]
+        sink2 = LogSink(topic, partitions=1)  # recovery sweeps at init
+        assert not orphan.exists()
+
+    def test_truncated_committed_segment_fails_loudly(self, tmp_path):
+        """Reader at a truncated tail: a committed range that cannot be
+        read back whole is data loss, surfaced as ColumnarError — never
+        a silent short read."""
+        from flink_tpu.formats_columnar import ColumnarError
+
+        topic = str(tmp_path / "t")
+        sink = LogSink(topic, partitions=1)
+        sink.write({"k": np.arange(50, dtype=np.int64)})
+        sink.prepare_commit(1)
+        sink.notify_checkpoint_complete(1)
+        pdir = tmp_path / "t" / "p0"
+        (seg,) = [n for n in os.listdir(pdir) if n.endswith(".colb")]
+        raw = (pdir / seg).read_bytes()
+        (pdir / seg).write_bytes(raw[:len(raw) - 9])  # tear the tail off
+        with pytest.raises(ColumnarError):
+            list(TopicReader(topic).read(0))
+
+
+class TestLogSourceReplay:
+    def _topic(self, tmp_path, n=20, segment_records=6):
+        topic = str(tmp_path / "t")
+        sink = LogSink(topic, partitions=1,
+                       segment_records=segment_records)
+        sink.write({"k": np.arange(n, dtype=np.int64),
+                    "ts_ms": np.arange(n, dtype=np.int64) * 100})
+        sink.prepare_commit(1)
+        sink.notify_checkpoint_complete(1)
+        return topic
+
+    def test_positions_are_offsets(self, tmp_path):
+        topic = self._topic(tmp_path)
+        src = LogSource(topic, ts_field="ts_ms")
+        pos = 0
+        rows = []
+        for data, ts in src.open_split("0"):
+            pos = src.position_after(pos, data, ts)
+            rows.extend(data["k"].tolist())
+        assert rows == list(range(20)) and pos == 20
+
+    def test_replay_resumes_mid_segment_mid_block(self, tmp_path):
+        topic = self._topic(tmp_path)
+        src = LogSource(topic, ts_field="ts_ms")
+        for start in (0, 1, 5, 6, 7, 13, 19, 20):
+            got = [x for data, _ in src.open_split("0", start_pos=start)
+                   for x in data["k"].tolist()]
+            assert got == list(range(start, 20)), start
+
+    def test_missing_ts_field_is_loud(self, tmp_path):
+        topic = self._topic(tmp_path)
+        src = LogSource(topic, ts_field="nope")
+        with pytest.raises(LogError, match="ts_field"):
+            list(src.open_split("0"))
+
+    def test_missing_topic_is_loud(self, tmp_path):
+        with pytest.raises(LogError, match="no such log topic"):
+            LogSource(str(tmp_path / "absent")).splits()
+
+
+class TestLogSink2pcRecovery:
+    def test_restore_rebuilds_and_commits_covered_epoch(self, tmp_path):
+        """Crash between the checkpoint manifest write and the commit
+        round, worst case: the dead attempt's cleanup also deleted the
+        staged segments. The covering checkpoint's payload rebuilds and
+        commits the epoch."""
+        topic = str(tmp_path / "t")
+        sink = LogSink(topic, partitions=1)
+        sink.write({"k": np.arange(4, dtype=np.int64)})
+        sink.prepare_commit(7)
+        snap = sink.snapshot_staged()
+        sink.abort_uncommitted()  # crashed attempt's cleanup
+        assert TopicReader(topic).committed_offsets() == {0: 0}
+        sink2 = LogSink(topic, partitions=1)
+        sink2.restore_staged(snap, 7)
+        got = [x for _, b in TopicReader(topic).read(0)
+               for x in b["k"].tolist()]
+        assert got == [0, 1, 2, 3]
+
+    def test_restore_rolls_uncovered_epochs_back(self, tmp_path):
+        """Epochs staged AFTER the restored checkpoint replay from
+        source positions — restore must roll their segments back."""
+        topic = str(tmp_path / "t")
+        sink = LogSink(topic, partitions=1)
+        sink.write({"k": np.arange(4, dtype=np.int64)})
+        sink.prepare_commit(1)
+        sink.notify_checkpoint_complete(1)
+        sink.write({"k": np.arange(4, 8, dtype=np.int64)})
+        sink.prepare_commit(2)  # staged, never committed, uncovered
+        snap = sink.snapshot_staged()
+        sink2 = LogSink(topic, partitions=1)
+        sink2.restore_staged(snap, 1)  # restored checkpoint is 1
+        assert sink2.staged_transaction_ids() == []
+        assert TopicReader(topic).committed_offsets() == {0: 4}
+        assert sink2._appender.next_offset(0) == 4
+
+    def test_fresh_sink_rolls_dead_attempts_staged_back(self, tmp_path):
+        topic = str(tmp_path / "t")
+        sink = LogSink(topic, partitions=1)
+        sink.write({"k": np.arange(4, dtype=np.int64)})
+        sink.prepare_commit(1)  # dead attempt: staged, never committed
+        sink2 = LogSink(topic, partitions=1)  # new owner
+        assert sink2.staged_transaction_ids() == []
+        assert TopicReader(topic).committed_offsets() == {0: 0}
+
+    def test_successor_epoch_rolls_lower_epoch_staged_back(self, tmp_path):
+        """The construction-time sweep runs at the default epoch and the
+        abort fence skips higher epochs — set_attempt_epoch must re-run
+        recovery so a successor actually rolls a dead lower-epoch
+        attempt's staged transactions back."""
+        topic = str(tmp_path / "t")
+        dead = LogSink(topic, partitions=1)
+        dead.set_attempt_epoch(1)
+        dead.write({"k": np.arange(4, dtype=np.int64)})
+        dead.prepare_commit(1)  # staged at epoch 1, attempt dies
+        succ = LogSink(topic, partitions=1)
+        succ.set_attempt_epoch(2)
+        assert succ.staged_transaction_ids() == []
+        assert succ._appender.next_offset(0) == 0
+
+    def test_deposed_abort_cannot_roll_back_successor_staged(
+            self, tmp_path):
+        """Abort is EPOCH-FENCED: a deposed attempt's late-running
+        failure-path cleanup must not delete the live successor's
+        staged transaction (the marker-file analogue of the
+        epoch-qualified part-name fence)."""
+        topic = str(tmp_path / "t")
+        deposed = LogSink(topic, partitions=1)
+        deposed.set_attempt_epoch(1)
+        succ = LogSink(topic, partitions=1)
+        succ.set_attempt_epoch(2)
+        succ.write({"k": np.arange(4, dtype=np.int64)})
+        succ.prepare_commit(5)
+        deposed.abort_uncommitted()  # the deposed attempt wakes up
+        assert succ.staged_transaction_ids() == [5]
+        succ.notify_checkpoint_complete(5)
+        got = [x for _, b in TopicReader(topic).read(0)
+               for x in b["k"].tolist()]
+        assert got == [0, 1, 2, 3]
+
+    def test_vanished_precommit_marker_is_loud(self, tmp_path):
+        """stage() returned True, so a missing pre marker at commit
+        time is a rolled-back LIVE transaction (single-writer
+        discipline violated) — committing must raise, never silently
+        drop the epoch. Checked on the PROTOCOL path: the commit round
+        walks the in-memory live-staged set too, so the vanished cid
+        is not silently absent from the on-disk staged listing."""
+        topic = str(tmp_path / "t")
+        sink = LogSink(topic, partitions=1)
+        sink.write({"k": np.arange(4, dtype=np.int64)})
+        sink.prepare_commit(1)
+        os.remove(tmp_path / "t" / "txn" / "pre-0000000001.json")
+        with pytest.raises(LogError, match="vanished"):
+            sink.notify_checkpoint_complete(1)
+
+    def test_deposed_commit_cannot_publish_successor_staged(
+            self, tmp_path):
+        """Commit is epoch-fenced like abort: a deposed attempt's
+        lagging commit round finds the successor's pre marker for the
+        same cid and must NOT publish it — the successor's covering
+        checkpoint hasn't completed, so committing would expose (and,
+        after the successor replays, duplicate) uncovered rows."""
+        topic = str(tmp_path / "t")
+        deposed = LogSink(topic, partitions=1)
+        deposed.set_attempt_epoch(1)
+        succ = LogSink(topic, partitions=1)
+        succ.set_attempt_epoch(2)
+        succ.write({"k": np.arange(4, dtype=np.int64)})
+        succ.prepare_commit(5)
+        deposed.commit_transaction(5)  # lagging deposed commit round
+        assert describe_topic(topic)["committed_transactions"] == []
+        assert succ.staged_transaction_ids() == [5]
+        succ.notify_checkpoint_complete(5)  # the real owner commits
+        assert describe_topic(topic)["committed_transactions"] == [5]
+
+
+class TestChainedJobs:
+    N = 6
+
+    def test_chain_matches_fused_job(self, tmp_path):
+        topic = str(tmp_path / "words")
+        env = StreamExecutionEnvironment(Configuration({}))
+        env.from_source(GeneratorSource(word_gen(self.N))).add_sink(
+            LogSink(topic, key_field="word", partitions=2))
+        env.execute("log-producer")
+        assert run_consumer(topic) == golden_fused(self.N)
+
+    def test_chain_with_checkpointed_producer(self, tmp_path):
+        """Producer committing epoch-by-epoch with its checkpoints (the
+        streaming path) feeds the same bytes as the terminal-commit
+        bounded path."""
+        topic = str(tmp_path / "words")
+        env = StreamExecutionEnvironment(Configuration({
+            "execution.checkpointing.dir": str(tmp_path / "ckpt"),
+            "execution.checkpointing.interval": 1,
+        }))
+        env.from_source(GeneratorSource(word_gen(self.N))).add_sink(
+            LogSink(topic, key_field="word", partitions=2))
+        env.execute("log-producer-chk")
+        d = describe_topic(topic)
+        assert d["staged_transactions"] == []
+        assert len(d["committed_transactions"]) >= 1
+        assert run_consumer(topic) == golden_fused(self.N)
+
+
+class TestCliChainSmoke:
+    """Tier-1 CLI smoke: two ``python -m flink_tpu run --local`` jobs
+    chained through a log topic; the consumer's committed FileSink
+    output is diffed against independently computed counts."""
+
+    def _cli(self, capsys, *argv):
+        from flink_tpu.cli import main as cli_main
+
+        rc = cli_main(list(argv))
+        out = capsys.readouterr().out.strip().splitlines()
+        return rc, json.loads(out[-1]) if out else {}
+
+    def test_two_local_jobs_chained_through_topic(self, tmp_path, capsys):
+        import runner_job_log_chain as jobs
+
+        log_dir = str(tmp_path / "logroot")
+        sink_dir = str(tmp_path / "sink")
+        n = 5
+        rc, out = self._cli(
+            capsys, "run", "--local",
+            "--entry", "runner_job_log_chain:produce",
+            "--job-id", "chain-a",
+            "--conf", f"log.dir={log_dir}",
+            "--conf", "log.partitions=2",
+            "--conf", f"test.n-batches={n}")
+        assert rc == 0 and out["state"] == "FINISHED"
+        assert out["records_in"] == n * jobs.BATCH
+
+        rc, out = self._cli(
+            capsys, "run", "--local",
+            "--entry", "runner_job_log_chain:consume",
+            "--job-id", "chain-b",
+            "--conf", f"log.dir={log_dir}",
+            "--conf", f"test.sink-dir={sink_dir}",
+            "--conf", "state.num-key-shards=8",
+            "--conf", "state.slots-per-shard=64")
+        assert rc == 0 and out["state"] == "FINISHED"
+        assert out["records_in"] == n * jobs.BATCH
+
+        # the log CLI sees the committed topic
+        rc, topic_info = self._cli(
+            capsys, "log", os.path.join(log_dir, jobs.TOPIC))
+        assert rc == 0
+        assert topic_info["partitions"] == 2
+        assert topic_info["committed_records"] == n * jobs.BATCH
+        assert topic_info["staged_transactions"] == []
+
+        # diff committed consumer output against independent counts
+        got = jobs.read_committed_counts(sink_dir)
+        assert got == jobs.expected_counts(n) and len(got) > 0
+
+    def test_log_command_on_missing_topic_fails(self, tmp_path):
+        from flink_tpu.cli import main as cli_main
+
+        with pytest.raises(SystemExit):
+            cli_main(["log", str(tmp_path / "nope")])

@@ -1,0 +1,256 @@
+"""Chaos suite for the durable log exchange (ISSUE 3): a producer job
+writing a topic through LogSink under injected faults at the log's 2PC
+seams, chained into a fault-free consumer job — the consumer's
+committed output must be BYTE-IDENTICAL to the fault-free chain for
+every fault kind, and uncommitted producer data must never be
+observable to a committed-offset reader, even when the producer dies
+for good.
+
+Fault kinds exercised (≥3 per the acceptance criteria, including the
+crash between pre-commit and commit):
+
+  1. torn segment append        log.segment.append = raise
+  2. fsync fault                log.segment.fsync  = raise
+  3. pre-commit marker write    log.txn.marker     = raise
+  4. crash between pre-commit   log.txn.commit     = raise
+     and commit                 (marker durable, commit round dead —
+                                restore re-commits from the covering
+                                checkpoint's staged payload)
+
+Every failure prints the fault seed + injection log for exact replay
+(the test_chaos.py discipline)."""
+import contextlib
+import sys
+
+import numpy as np
+import pytest
+
+from flink_tpu import faults
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.api.sinks import TransactionalCollectSink
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.api.windowing import TumblingEventTimeWindows
+from flink_tpu.config import Configuration
+from flink_tpu.log import LogSink, LogSource, TopicReader, describe_topic
+from flink_tpu.runtime.supervisor import run_with_recovery
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+pytestmark = [pytest.mark.chaos, pytest.mark.log]
+
+CHAOS_SEED = 1234
+N_BATCHES = 12
+BATCH = 64
+VOCAB = 10
+
+
+@contextlib.contextmanager
+def replayable(plan):
+    try:
+        yield
+    except BaseException:
+        print(f"\nCHAOS REPLAY: seed={plan.seed} spec={plan.spec!r} "
+              f"log={plan.log}", file=sys.stderr)
+        raise
+
+
+def word_gen(n_batches):
+    def gen(split, i):
+        if i >= n_batches:
+            return None
+        rng = np.random.default_rng(7100 + i)
+        words = rng.integers(0, VOCAB, BATCH).astype(np.int64)
+        ts = (i * BATCH + np.arange(BATCH, dtype=np.int64)) * 10
+        return {"word": words, "ts_ms": ts}, ts
+
+    return gen
+
+
+def produce(tmp_path, topic, tag):
+    """Producer job under run_with_recovery: deterministic word stream
+    → LogSink, per-batch checkpoints (so 2PC epochs commit all along
+    the run, giving the injected faults plenty of seams to land in)."""
+
+    def build_env(conf):
+        env = StreamExecutionEnvironment(conf)
+        env.from_source(GeneratorSource(word_gen(N_BATCHES))).add_sink(
+            LogSink(topic, key_field="word", partitions=2))
+        return env
+
+    conf = Configuration({
+        "pipeline.microbatch-size": BATCH,
+        "execution.checkpointing.dir": str(tmp_path / f"ckpt-{tag}"),
+        "execution.checkpointing.interval": 1,
+        "restart-strategy.type": "fixed-delay",
+        "restart-strategy.fixed-delay.attempts": 20,
+        "restart-strategy.fixed-delay.delay": 1,
+    })
+    run_with_recovery(build_env, conf, job_name=f"log-chaos-{tag}")
+
+
+def consume(topic):
+    """Fault-free consumer job over the topic's committed offsets."""
+    sink = TransactionalCollectSink()
+    env = StreamExecutionEnvironment(Configuration({
+        "state.num-key-shards": 8, "state.slots-per-shard": 64}))
+    (env.from_source(LogSource(topic, ts_field="ts_ms"),
+                     WatermarkStrategy.for_bounded_out_of_orderness(1000))
+     .key_by("word").window(TumblingEventTimeWindows.of(1000)).count()
+     .add_sink(sink))
+    env.execute("log-chaos-consumer")
+    return sorted((int(r["key"]), int(r["window_start"]), int(r["count"]))
+                  for r in sink.committed)
+
+
+@pytest.fixture(scope="module")
+def golden_chain(tmp_path_factory):
+    """Fault-free producer→consumer chain — the byte-identical
+    reference every chaos scenario must reproduce."""
+    d = tmp_path_factory.mktemp("golden")
+    topic = str(d / "topic")
+    produce(d, topic, "golden")
+    return consume(topic)
+
+
+class TestLogChaosExactlyOnce:
+    """One scenario per fault kind: the injection kills at least one
+    producer attempt; recovery restores from the last checkpoint, rolls
+    uncommitted segments back, replays from committed offsets — and the
+    chained consumer output is byte-identical to the fault-free run."""
+
+    SCENARIOS = {
+        "torn-append": ("log.segment.append", dict(count=1, after=3)),
+        "fsync-fault": ("log.segment.fsync", dict(count=1, after=3)),
+        "marker-write": ("log.txn.marker", dict(count=1, after=1)),
+        # THE 2PC window: pre-commit marker is durable, the commit
+        # round dies — the covering checkpoint must re-commit on
+        # restore, never duplicate, never lose
+        "precommit-commit-crash": ("log.txn.commit",
+                                   dict(count=1, after=1)),
+    }
+
+    @pytest.mark.parametrize("name", sorted(SCENARIOS))
+    def test_fault_kind_chain_is_byte_identical(
+            self, tmp_path, name, golden_chain):
+        point, kw = self.SCENARIOS[name]
+        topic = str(tmp_path / "topic")
+        plan = faults.FaultPlan(seed=CHAOS_SEED).rule(
+            point, "raise", **kw)
+        with plan.activate(), replayable(plan):
+            produce(tmp_path, topic, name)
+        with replayable(plan):
+            # the injection actually fired (the scenario is live)
+            assert [x[:2] for x in plan.log] == [(point, "raise")]
+            d = describe_topic(topic)
+            assert d["staged_transactions"] == [], (
+                "a finished producer must leave nothing staged")
+            got = consume(topic)
+            assert got == golden_chain
+            assert len(got) > 0
+
+    def test_same_seed_same_commit_crash_recovery(self, tmp_path,
+                                                  golden_chain):
+        """Replay determinism through the log seams: same seed, same
+        injection schedule, same committed bytes."""
+        logs = []
+        for i in range(2):
+            topic = str(tmp_path / f"topic{i}")
+            plan = faults.FaultPlan(seed=CHAOS_SEED).rule(
+                "log.txn.commit", "raise", count=1, after=1)
+            with plan.activate(), replayable(plan):
+                produce(tmp_path / f"r{i}", topic, f"det{i}")
+            assert consume(topic) == golden_chain
+            logs.append(plan.log)
+        assert logs[0] == logs[1]
+
+
+class TestIsolationUnderPermanentFailure:
+    def test_dead_producer_exposes_only_committed_prefix(self, tmp_path):
+        """Every commit attempt fails and the restart budget runs out:
+        the producer dies for good mid-topic. A committed-offset reader
+        still reads a clean committed PREFIX — staged transactions sit
+        on disk but are never observable, and reading raises nothing."""
+        topic = str(tmp_path / "topic")
+
+        def build_env(conf):
+            env = StreamExecutionEnvironment(conf)
+            env.from_source(
+                GeneratorSource(word_gen(N_BATCHES))).add_sink(
+                LogSink(topic, key_field="word", partitions=2))
+            return env
+
+        conf = Configuration({
+            "pipeline.microbatch-size": BATCH,
+            "execution.checkpointing.dir": str(tmp_path / "ckpt"),
+            "execution.checkpointing.interval": 1,
+            "restart-strategy.type": "fixed-delay",
+            "restart-strategy.fixed-delay.attempts": 2,
+            "restart-strategy.fixed-delay.delay": 1,
+        })
+        plan = faults.FaultPlan(seed=CHAOS_SEED).rule(
+            "log.txn.commit", "raise", after=1)  # every commit, forever
+        with plan.activate(), replayable(plan):
+            with pytest.raises(OSError, match="injected fault"):
+                run_with_recovery(build_env, conf, job_name="log-dead")
+        with replayable(plan):
+            r = TopicReader(topic)
+            committed = r.committed_offsets()
+            rows = 0
+            for p in sorted(committed):
+                for _, b in r.read(p):  # never raises, never sees staged
+                    rows += len(next(iter(b.values())))
+            assert rows == sum(committed.values())
+            assert rows < N_BATCHES * BATCH, (
+                "producer died mid-topic; the committed prefix must be "
+                "partial")
+            # committed rows are a prefix of the deterministic stream:
+            # every (word, ts) pair read must be one the generator
+            # produced, with no duplicates
+            produced = {}
+            for i in range(N_BATCHES):
+                data, ts = word_gen(N_BATCHES)(None, i)
+                for w, t in zip(data["word"].tolist(), ts.tolist()):
+                    produced[(w, t)] = produced.get((w, t), 0) + 1
+            seen = {}
+            for p in sorted(committed):
+                for _, b in TopicReader(topic).read(p):
+                    for w, t in zip(b["word"].tolist(),
+                                    b["ts_ms"].tolist()):
+                        seen[(w, t)] = seen.get((w, t), 0) + 1
+            for k, n in seen.items():
+                assert n <= produced.get(k, 0), (
+                    f"row {k} duplicated in committed output")
+
+
+@pytest.mark.slow
+class TestLogChaosSoak:
+    """Randomized multi-seed soak over every log fault point — the
+    chained output must stay byte-identical for each seed."""
+
+    @pytest.mark.parametrize("seed", [11, 12, 13])
+    def test_randomized_log_soak(self, tmp_path, seed, golden_chain):
+        topic = str(tmp_path / "topic")
+        plan = (faults.FaultPlan(seed=seed)
+                .rule("log.segment.append", "raise", p=0.05, count=2)
+                .rule("log.segment.fsync", "raise", p=0.05, count=1)
+                .rule("log.segment.seal", "raise", p=0.05, count=1)
+                .rule("log.txn.marker", "raise", p=0.1, count=1)
+                .rule("log.txn.commit", "raise", p=0.1, count=2))
+        conf_dir = tmp_path / f"s{seed}"
+        with plan.activate(), replayable(plan):
+            def build_env(conf):
+                env = StreamExecutionEnvironment(conf)
+                env.from_source(
+                    GeneratorSource(word_gen(N_BATCHES))).add_sink(
+                    LogSink(topic, key_field="word", partitions=2))
+                return env
+
+            run_with_recovery(build_env, Configuration({
+                "pipeline.microbatch-size": BATCH,
+                "execution.checkpointing.dir": str(conf_dir / "ckpt"),
+                "execution.checkpointing.interval": 1,
+                "restart-strategy.type": "fixed-delay",
+                "restart-strategy.fixed-delay.attempts": 40,
+                "restart-strategy.fixed-delay.delay": 1,
+            }), job_name=f"log-soak-{seed}")
+        with replayable(plan):
+            assert consume(topic) == golden_chain
