@@ -65,6 +65,7 @@ def bench_all_to_all(iters: int = 8) -> None:
 
     from flink_tpu.exchange.spi import all_to_all_shuffle
     from flink_tpu.parallel.mesh import AXIS, make_mesh_plan
+    from flink_tpu.utils.jaxcompat import shard_map
 
     n_dev = len(jax.devices())
     if n_dev < 2:
@@ -96,7 +97,7 @@ def bench_all_to_all(iters: int = 8) -> None:
             return lax.psum(local, AXIS)
 
         spec = {k: P(AXIS) for k in payload}
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             shard, mesh=mp.mesh, in_specs=(P(AXIS), P(AXIS), spec),
             out_specs=P()))
         float(fn(dest, valid, payload))  # warm

@@ -1,0 +1,40 @@
+"""Static analysis — catch correctness bugs before the first record flows.
+
+Two planes (ref: the validation pass of Flink's StreamGraph translation
+— StreamGraphGenerator / StreamingJobGraphGenerator reject malformed
+graphs at compile time, SURVEY §3.2; bounded-execution validation,
+§3.6 — generalized into a rule engine):
+
+- **Plan analysis** (``plan_rules.py``): walks a lowered
+  ``ExecutionPlan`` + its ``Configuration`` and reports structured
+  findings — misconfigurations that would otherwise fail minutes into a
+  run (unbounded source in batch mode, two writers on one log topic,
+  fault rules that match nothing) or silently corrupt results
+  (event-time windows with no watermark strategy, non-transactional
+  sinks under exactly-once). The driver runs it automatically at submit
+  (``analysis.fail-on``); ``python -m flink_tpu analyze`` runs it
+  standalone.
+
+- **Repo AST lints** (``pylints.py``): a pure-stdlib ``ast`` pass over
+  the codebase itself — tracer leaks in jit kernels (host conversions /
+  Python branches on traced values, the failure class PROFILE §8.1's
+  design rules exist to prevent), fault-point literals drifting from
+  the ``faults.py`` registry, config/metric name drift. Run via
+  ``python -m flink_tpu lint`` or ``tools/lint.py``; the dogfood gate
+  (tests/test_analysis.py) keeps the shipped tree at zero findings.
+
+Honest scope: a LINEAR rule engine — each rule is one walk over the
+plan or the AST. No dataflow analysis, no abstract interpretation, no
+cross-function taint; the tracer-leak lint tracks only direct uses of
+a jit-traced parameter inside its own kernel body.
+"""
+from flink_tpu.analysis.core import (
+    AnalysisError,
+    Finding,
+    analyze,
+    analyze_config,
+    render_findings,
+)
+
+__all__ = ["AnalysisError", "Finding", "analyze", "analyze_config",
+           "render_findings"]

@@ -1,0 +1,156 @@
+"""Analysis core — the Finding record and the rule engine.
+
+A rule is a function ``(plan, config) -> Iterable[Finding]`` registered
+with :func:`plan_rule` (needs a lowered plan) or :func:`config_rule`
+(configuration alone — runnable without compiling a pipeline). The
+engine just runs every registered rule and concatenates findings;
+severity and rule id live on the registration so the catalog is
+greppable in one place (``plan_rules.py``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+SEVERITIES = ("error", "warn")
+# severity sort weight: errors first in every report
+_SEV_ORDER = {"error": 0, "warn": 1}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One structured analysis result (rule id, severity, where, what,
+    how to fix). ``node``/``node_name`` locate plan findings;
+    ``file``/``line`` locate AST-lint findings."""
+
+    rule: str
+    severity: str
+    message: str
+    fix: str = ""
+    node: Optional[int] = None
+    node_name: str = ""
+    file: str = ""
+    line: int = 0
+
+    def __post_init__(self) -> None:
+        if self.severity not in SEVERITIES:
+            raise ValueError(
+                f"finding severity must be one of {SEVERITIES}, "
+                f"got {self.severity!r}")
+
+    def where(self) -> str:
+        if self.file:
+            return f"{self.file}:{self.line}"
+        if self.node is not None:
+            name = f" ({self.node_name})" if self.node_name else ""
+            return f"node {self.node}{name}"
+        return "config"
+
+    def render(self) -> str:
+        hint = f"\n    fix: {self.fix}" if self.fix else ""
+        return (f"[{self.severity}] {self.rule} at {self.where()}: "
+                f"{self.message}{hint}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def render_findings(findings: Iterable[Finding]) -> str:
+    fs = sorted(findings, key=lambda f: (_SEV_ORDER[f.severity], f.rule))
+    if not fs:
+        return "no findings"
+    return "\n".join(f.render() for f in fs)
+
+
+class AnalysisError(ValueError):
+    """Raised at submit when findings reach the ``analysis.fail-on``
+    threshold. Subclasses ValueError: analysis failures are config/graph
+    validation errors, same family as the compiler's own rejections."""
+
+    def __init__(self, findings: List[Finding], threshold: str) -> None:
+        self.findings = list(findings)
+        self.threshold = threshold
+        super().__init__(
+            f"plan analysis found {len(self.findings)} blocking "
+            f"finding(s) (analysis.fail-on={threshold}; set "
+            "analysis.fail-on: off to skip):\n"
+            + render_findings(self.findings))
+
+
+# -- rule registry ----------------------------------------------------------
+
+RuleFn = Callable[[Any, Any], Iterable[Finding]]
+# (rule_id, severity, needs_plan, fn)
+_RULES: List[Tuple[str, str, bool, RuleFn]] = []
+
+
+def _register(rule_id: str, severity: str, needs_plan: bool):
+    if severity not in SEVERITIES:
+        raise ValueError(f"bad severity {severity!r} for rule {rule_id}")
+
+    def deco(fn: RuleFn) -> RuleFn:
+        _RULES.append((rule_id, severity, needs_plan, fn))
+        fn.rule_id = rule_id
+        fn.severity = severity
+        return fn
+
+    return deco
+
+
+def plan_rule(rule_id: str, severity: str):
+    """Register a rule that needs a lowered ExecutionPlan."""
+    return _register(rule_id, severity, needs_plan=True)
+
+
+def config_rule(rule_id: str, severity: str):
+    """Register a rule over the Configuration alone."""
+    return _register(rule_id, severity, needs_plan=False)
+
+
+def rule_catalog() -> List[Tuple[str, str]]:
+    """(rule_id, severity) of every registered rule — docs and the
+    coverage test read this so no rule can ship untested."""
+    _load_rules()
+    return [(rid, sev) for rid, sev, _, _ in _RULES]
+
+
+def _load_rules() -> None:
+    # rule definitions live in plan_rules.py; importing it populates the
+    # registry (idempotent — the registry appends only at module init)
+    from flink_tpu.analysis import plan_rules  # noqa: F401
+
+
+def analyze(plan: Any, config: Any) -> List[Finding]:
+    """Run every rule over (plan, config). ``plan`` may be None to run
+    configuration rules alone (the conf-only CLI path)."""
+    _load_rules()
+    out: List[Finding] = []
+    for rule_id, severity, needs_plan, fn in _RULES:
+        if needs_plan and plan is None:
+            continue
+        for f in fn(plan, config):
+            # the registration owns id+severity; rules fill the rest
+            out.append(dataclasses.replace(
+                f, rule=rule_id, severity=severity))
+    out.sort(key=lambda f: (_SEV_ORDER[f.severity], f.rule, f.node or 0,
+                            f.file, f.line))
+    return out
+
+
+def analyze_config(config: Any) -> List[Finding]:
+    return analyze(None, config)
+
+
+def blocking(findings: Iterable[Finding], fail_on: str) -> List[Finding]:
+    """The subset of findings that fails the job under
+    ``analysis.fail-on=fail_on`` ('error' blocks errors only, 'warn'
+    blocks both, 'off' blocks nothing)."""
+    fail_on = (fail_on or "error").strip().lower()
+    if fail_on == "off":
+        return []
+    if fail_on == "warn":
+        return list(findings)
+    if fail_on != "error":
+        raise ValueError(
+            f"analysis.fail-on must be error|warn|off, got {fail_on!r}")
+    return [f for f in findings if f.severity == "error"]

@@ -1,0 +1,306 @@
+"""The plan-analysis rule catalog.
+
+Every rule is a single walk over the lowered ``ExecutionPlan`` and/or
+the ``Configuration`` — the static preconditions of guarantees PRs 1–3
+proved dynamically (exactly-once, job chaining, bounded execution),
+checked before the first record flows (ref: the validation layer of
+StreamGraph translation, SURVEY §3.2/§3.6).
+
+Severity contract (what ``analysis.fail-on`` keys on):
+
+- **error** — the job WILL fail or corrupt output at runtime: an
+  unbounded source in batch mode, a window that can never fire, two
+  writers on one log topic, a chaos rule injecting nothing, keyed
+  state with no key exchange, checkpointing in batch mode.
+- **warn** — correctness smells that depend on intent: event-time
+  windows riding the default watermark strategy, non-transactional
+  sinks under exactly-once checkpointing, config keys outside the
+  declared grammar (typos).
+"""
+from __future__ import annotations
+
+import difflib
+import fnmatch
+import os
+from typing import Any, Iterable, Iterator, List, Set
+
+from flink_tpu.analysis.core import Finding, config_rule, plan_rule
+
+# a rule fills message/location; analyze() stamps the registered
+# rule id + severity on every finding it yields
+def _f(message: str, fix: str = "", node=None, node_name: str = "",
+       file: str = "", line: int = 0) -> Finding:
+    return Finding(rule="", severity="warn", message=message, fix=fix,
+                   node=node, node_name=node_name, file=file, line=line)
+
+
+def _upstream_sources(plan, nid: int) -> Iterator[Any]:
+    """Source nodes transitively feeding ``nid``."""
+    upstream = {n: [] for n in plan.nodes}
+    for n in plan.nodes.values():
+        for d in n.downstream:
+            upstream[d].append(n.id)
+    seen: Set[int] = set()
+    stack = [nid]
+    while stack:
+        cur = stack.pop()
+        for u in upstream[cur]:
+            if u in seen:
+                continue
+            seen.add(u)
+            node = plan.nodes[u]
+            if node.kind == "source":
+                yield node
+            else:
+                stack.append(u)
+
+
+def _runtime_mode(config) -> str:
+    from flink_tpu.config import ExecutionOptions
+
+    return str(config.get(ExecutionOptions.RUNTIME_MODE)).strip().lower()
+
+
+# kinds whose operator keys state by node.key_field and therefore needs
+# the keyBy exchange the lowering folds into it (keyed_input)
+KEYED_KINDS = frozenset((
+    "window", "evicting_window", "session", "count_window", "process",
+    "cep", "global_agg",
+))
+
+# kinds that evaluate event-time semantics against the watermark clock
+_EVENT_TIME_KINDS = frozenset((
+    "window", "evicting_window", "window_all", "join", "session", "cep",
+))
+
+
+def _is_event_time(node) -> bool:
+    if node.kind in ("session", "cep"):
+        return True  # session gaps / CEP within-windows are event-time
+    assigner = getattr(node.window_transform, "assigner", None)
+    if assigner is None:
+        return False
+    return bool(getattr(assigner, "is_event_time", True))
+
+
+@plan_rule("EVENT_TIME_NO_WATERMARK", "warn")
+def event_time_no_watermark(plan, config) -> Iterable[Finding]:
+    """Event-time op fed by a source with no explicit watermark
+    strategy: the pipeline-default monotonous clock treats ANY
+    out-of-order timestamp as late and silently drops it."""
+    for node in plan.nodes.values():
+        if node.kind not in _EVENT_TIME_KINDS or not _is_event_time(node):
+            continue
+        for src in _upstream_sources(plan, node.id):
+            if src.watermark_strategy is None:
+                yield _f(
+                    f"event-time {node.kind} {node.name!r} is fed by "
+                    f"source {src.name!r} with no watermark strategy — "
+                    "out-of-order records will be dropped as late under "
+                    "the default monotonous clock",
+                    fix="pass a WatermarkStrategy to from_source(), e.g. "
+                        "WatermarkStrategy.for_bounded_out_of_orderness("
+                        "ms)",
+                    node=node.id, node_name=node.name)
+
+
+@plan_rule("NON_TRANSACTIONAL_SINK", "warn")
+def non_transactional_sink(plan, config) -> Iterable[Finding]:
+    """Checkpointing is on (exactly-once intended) but a sink writes
+    through: a recovery replays the uncheckpointed tail into it —
+    at-least-once output, duplicates on every restore."""
+    from flink_tpu.api.sinks import Sink
+    from flink_tpu.config import CheckpointingOptions
+
+    if config.get(CheckpointingOptions.INTERVAL) <= 0:
+        return
+    for node in plan.nodes.values():
+        if node.kind != "sink" or node.sink is None:
+            continue
+        cls = type(node.sink)
+        transactional = (
+            cls.prepare_commit is not Sink.prepare_commit
+            or cls.snapshot_staged is not Sink.snapshot_staged)
+        if not transactional:
+            yield _f(
+                f"sink {node.name!r} ({cls.__name__}) is not "
+                "transactional but execution.checkpointing.interval is "
+                "set — recovery will replay the un-checkpointed tail "
+                "into it (duplicates; at-least-once, not exactly-once)",
+                fix="use a TwoPhaseCommitSink (LogSink, FileSink, "
+                    "FileTransactionalSink) or disable checkpointing",
+                node=node.id, node_name=node.name)
+
+
+@plan_rule("UNBOUNDED_SOURCE_IN_BATCH", "error")
+def unbounded_source_in_batch(plan, config) -> Iterable[Finding]:
+    """Batch (bounded) mode requires every source to end: stages run to
+    completion in topological waves — an unbounded source never lets
+    its stage finish."""
+    from flink_tpu.api.sources import source_is_bounded
+
+    if _runtime_mode(config) != "batch":
+        return
+    for sid in plan.sources:
+        node = plan.nodes[sid]
+        if node.source is not None and not source_is_bounded(node.source):
+            yield _f(
+                f"source {node.name!r} is unbounded under "
+                "execution.runtime-mode=batch — its stage can never "
+                "run to completion",
+                fix="bound the source (is_bounded=True / finite "
+                    "generator) or run in streaming mode",
+                node=node.id, node_name=node.name)
+
+
+@plan_rule("KEYED_OP_WITHOUT_KEYBY", "error")
+def keyed_op_without_keyby(plan, config) -> Iterable[Finding]:
+    """A keyed stateful op whose input edge never went through a keyBy
+    exchange: state would partition on whatever column happens to share
+    the key field's name — wrong results or a missing-column crash."""
+    for node in plan.nodes.values():
+        if node.kind in KEYED_KINDS and not node.keyed_input:
+            yield _f(
+                f"keyed {node.kind} {node.name!r} is reachable without "
+                "a keyBy exchange — its state partitions on an "
+                "undeclared key column",
+                fix="insert .key_by(column_or_fn) immediately before "
+                    "the stateful op",
+                node=node.id, node_name=node.name)
+
+
+@plan_rule("WINDOW_WITHOUT_FIRE_BOUND", "error")
+def window_without_fire_bound(plan, config) -> Iterable[Finding]:
+    """A GlobalWindows op with no trigger never fires: every record is
+    state forever — unbounded growth and zero output."""
+    from flink_tpu.api.windowing import GlobalWindows
+
+    for node in plan.nodes.values():
+        wt = node.window_transform
+        if wt is None or not isinstance(
+                getattr(wt, "assigner", None), GlobalWindows):
+            continue
+        if getattr(wt, "trigger", None) is None:
+            yield _f(
+                f"{node.kind} {node.name!r} uses GlobalWindows with no "
+                "trigger — it can never fire, and per-key state grows "
+                "without bound",
+                fix="set a trigger (.trigger(CountTrigger.of(n))) or "
+                    "use count_window(n) / a time-bounded assigner",
+                node=node.id, node_name=node.name)
+
+
+@plan_rule("LOG_TOPIC_MULTI_WRITER", "error")
+def log_topic_multi_writer(plan, config) -> Iterable[Finding]:
+    """Two LogSinks on one topic directory: the embedded log is
+    single-writer by design (no broker to serialize appends) — a second
+    writer's recovery sweep rolls back the first writer's staged
+    transactions."""
+    try:
+        from flink_tpu.log.connectors import LogSink
+    except Exception:  # log subsystem not importable: nothing to check
+        return
+    by_topic = {}
+    for node in plan.nodes.values():
+        if node.kind == "sink" and isinstance(node.sink, LogSink):
+            topic = os.path.realpath(str(node.sink.path))
+            by_topic.setdefault(topic, []).append(node)
+    for topic, nodes in by_topic.items():
+        if len(nodes) > 1:
+            names = ", ".join(f"{n.id} ({n.name!r})" for n in nodes)
+            for node in nodes:
+                yield _f(
+                    f"log topic {topic!r} has {len(nodes)} writers in "
+                    f"this plan (sink nodes {names}) — the embedded log "
+                    "is single-writer; concurrent appenders roll back "
+                    "each other's staged transactions",
+                    fix="give each sink its own topic, or union the "
+                        "streams into ONE LogSink",
+                    node=node.id, node_name=node.name)
+
+
+@config_rule("FAULT_POINT_UNKNOWN", "error")
+def fault_point_unknown(plan, config) -> Iterable[Finding]:
+    """A faults.inject rule whose point glob matches no registered
+    fault point injects NOTHING — a chaos conf that silently does
+    nothing is worse than no chaos at all."""
+    from flink_tpu.faults import FAULT_INJECT, FAULT_SEED, FaultPlan
+    from flink_tpu.faults import KNOWN_FAULT_POINTS
+
+    spec = str(config.get(FAULT_INJECT) or "").strip()
+    if not spec:
+        return
+    try:
+        fplan = FaultPlan.from_spec(spec, seed=int(config.get(FAULT_SEED)))
+    except ValueError as e:
+        yield _f(f"faults.inject does not parse: {e}",
+                 fix="grammar: 'point=kind [@prob] [xCOUNT] [+AFTER] "
+                     "[~DELAY_MS]', rules ';'-separated")
+        return
+    for r in fplan.rules:
+        if not any(fnmatch.fnmatchcase(p, r.point)
+                   for p in KNOWN_FAULT_POINTS):
+            close = difflib.get_close_matches(
+                r.point, sorted(KNOWN_FAULT_POINTS), n=1)
+            hint = (f"did you mean {close[0]!r}? " if close else "")
+            yield _f(
+                f"faults.inject rule {r.point!r} matches no registered "
+                "fault point — it will never inject",
+                fix=hint + "see flink_tpu.faults.KNOWN_FAULT_POINTS "
+                    "for the registry")
+
+
+@config_rule("CONFIG_KEY_UNKNOWN", "warn")
+def config_key_unknown(plan, config) -> Iterable[Finding]:
+    """A set key outside the declared option grammar is almost always a
+    typo — the job silently runs with the default of the key you meant."""
+    from flink_tpu.config import all_options, is_declared_key
+
+    load_option_grammar()
+    known = sorted(all_options())
+    for key in config.keys():
+        if not is_declared_key(key):
+            close = difflib.get_close_matches(key, known, n=1)
+            yield _f(
+                f"config key {key!r} is not in the declared option "
+                "grammar — the job ignores it",
+                fix=(f"did you mean {close[0]!r}?" if close else
+                     "declare it as a ConfigOption (or under a dynamic "
+                     "prefix, config.declare_dynamic_prefix)"))
+
+
+@config_rule("CHECKPOINT_IN_BATCH", "error")
+def checkpoint_in_batch(plan, config) -> Iterable[Finding]:
+    """Bounded-mode recovery is re-execution: nothing checkpoints, so a
+    checkpoint interval or an explicit restore path is a config
+    contradiction (the driver rejects it at run; this catches it at
+    submit)."""
+    from flink_tpu.config import CheckpointingOptions
+
+    if _runtime_mode(config) != "batch":
+        return
+    if config.get(CheckpointingOptions.INTERVAL) > 0:
+        yield _f(
+            "execution.checkpointing.interval is incompatible with "
+            "execution.runtime-mode=batch (bounded-mode recovery is "
+            "re-execution; 2PC sinks commit once at end of input)",
+            fix="drop the interval, or run in streaming mode")
+    restore = str(config.get(CheckpointingOptions.RESTORE)).strip()
+    if restore and restore != "latest":
+        # restore=latest is injected by supervisor redeploys and the
+        # driver degrades it to a fresh run; an explicit path cannot work
+        yield _f(
+            f"execution.checkpointing.restore={restore!r} is "
+            "incompatible with execution.runtime-mode=batch (nothing "
+            "checkpoints in batch mode — re-run the job)",
+            fix="drop the restore path, or run in streaming mode")
+
+
+def load_option_grammar() -> None:
+    """Import every module that declares ConfigOptions so the registry
+    is complete before a key-validity check (options register at module
+    import; a job that never touches metrics would otherwise see
+    ``metrics.port`` as unknown)."""
+    import flink_tpu.config  # noqa: F401
+    import flink_tpu.faults  # noqa: F401
+    import flink_tpu.obs.metrics  # noqa: F401

@@ -1,0 +1,507 @@
+"""Repo AST lints — pure-stdlib ``ast`` pass over the codebase itself.
+
+The runtime's correctness leans on conventions no unit test can see
+whole: jit kernels must stay trace-pure (PROFILE §8.1's design rules
+exist because host round-trips inside kernels silently retrace or
+pin stale values), ``faults.fire`` literals must match the registry in
+``faults.py`` (a drifted literal = a chaos plan that injects nothing),
+and config/metric name literals must stay inside their declared
+grammars (a typo'd key silently runs the default). Each lint is one
+linear AST walk; `python -m flink_tpu lint` and the tier-1 dogfood
+gate (tests/test_analysis.py) keep the shipped tree at zero findings.
+
+Rule catalog:
+
+- ``TRACER_HOST_CALL`` (error): ``float()/int()/bool()``,
+  ``np.asarray()/np.array()``, ``.item()/.tolist()`` applied to a value
+  derived from a traced parameter inside a directly-jitted kernel —
+  a host materialization that breaks tracing (ConcretizationTypeError
+  at best, a silently-stale constant at worst).
+- ``TRACER_BRANCH`` (error): Python ``if``/``while``/ternary (or
+  ``range()`` iteration) on a value derived from a traced parameter
+  inside a jitted kernel — control flow must go through ``lax.cond`` /
+  ``jnp.where`` / masking.
+- ``FAULT_POINT_DRIFT`` (error): a ``faults.fire("...")`` literal not
+  in ``faults.KNOWN_FAULT_POINTS``.
+- ``CONFIG_KEY_DRIFT`` (error): a string key passed to
+  ``.get_raw()`` / ``Configuration({...})`` that is outside the
+  declared option grammar.
+- ``CONFIG_OPTION_DUP`` (error): one option key declared by two
+  ``ConfigOption``/``duration_option`` literals — last registration
+  silently wins.
+- ``METRIC_NAME_INVALID`` (warn): a metric/group name literal outside
+  the ``[a-z0-9_]`` snake-case grammar every dashboard keys on.
+
+Honest scope (linear, syntactic): "derived from a traced parameter"
+is one assignment hop inside the kernel body — no fixpoint, no
+cross-function taint, no aliasing. Values reached only through static
+attributes (``.shape``/``.ndim``/``.dtype``/``.size``), ``len()``,
+``is None`` / ``in`` tests are NOT tainted (those are static under
+tracing). Only functions jitted DIRECTLY (``@jit`` decorators or
+``jax.jit(f)`` / ``jax.jit(shard_map(f, ...))`` on a local def) are
+kernels: a helper merely *called* from a kernel may legitimately
+receive concrete Python values, so it is out of scope.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from flink_tpu.analysis.core import Finding
+
+LINT_RULES: Tuple[Tuple[str, str], ...] = (
+    ("TRACER_HOST_CALL", "error"),
+    ("TRACER_BRANCH", "error"),
+    ("FAULT_POINT_DRIFT", "error"),
+    ("CONFIG_KEY_DRIFT", "error"),
+    ("CONFIG_OPTION_DUP", "error"),
+    ("METRIC_NAME_INVALID", "warn"),
+)
+_SEV = dict(LINT_RULES)
+
+_METRIC_KINDS = ("counter", "gauge", "meter", "histogram")
+_METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)*$")
+# attribute reads that are STATIC under tracing — a name reached only
+# through these never carries the tracer into host code
+_STATIC_ATTRS = frozenset(("shape", "ndim", "dtype", "size"))
+_HOST_CONVERSIONS = frozenset(("float", "int", "bool"))
+_HOST_METHODS = frozenset(("item", "tolist"))
+_NP_MATERIALIZERS = frozenset(("asarray", "array"))
+
+
+def _finding(rule: str, message: str, file: str, line: int,
+             fix: str = "") -> Finding:
+    return Finding(rule=rule, severity=_SEV[rule], message=message,
+                   fix=fix, file=file, line=line)
+
+
+# -- jit-kernel discovery ---------------------------------------------------
+
+@dataclasses.dataclass
+class _Kernel:
+    fn: ast.AST                  # FunctionDef / AsyncFunctionDef / Lambda
+    static_names: Set[str]
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jit`` / ``jax.jit`` (any attribute path ending in .jit)."""
+    if isinstance(node, ast.Name):
+        return node.id == "jit"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "jit"
+    return False
+
+
+def _static_names(jit_call: Optional[ast.Call],
+                  fn: ast.AST) -> Set[str]:
+    """Param names excluded from tracing via static_argnums/names."""
+    out: Set[str] = set()
+    if jit_call is None:
+        return out
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnums":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, int):
+                    if 0 <= c.value < len(params):
+                        out.add(params[c.value])
+        elif kw.arg == "static_argnames":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    out.add(c.value)
+    return out
+
+
+def _collect_kernels(tree: ast.Module) -> List[_Kernel]:
+    """Functions DIRECTLY jitted in this file: decorator forms
+    (``@jit``, ``@jax.jit``, ``@partial(jax.jit, ...)``,
+    ``@jax.jit(...)`` with kwargs) and call forms (``jax.jit(f)``,
+    ``jax.jit(shard_map(f, ...))`` where ``f`` is a local def)."""
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    kernels: List[_Kernel] = []
+    seen: Set[int] = set()
+
+    def add(fn: ast.AST, jit_call: Optional[ast.Call]) -> None:
+        if id(fn) in seen:
+            return
+        seen.add(id(fn))
+        kernels.append(_Kernel(fn, _static_names(jit_call, fn)))
+
+    for node in ast.walk(tree):
+        # decorator forms
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_expr(dec):
+                    add(node, None)
+                elif isinstance(dec, ast.Call):
+                    if _is_jit_expr(dec.func):
+                        add(node, dec)
+                    elif (isinstance(dec.func, (ast.Name, ast.Attribute))
+                          and (dec.func.attr if isinstance(
+                              dec.func, ast.Attribute) else dec.func.id)
+                          == "partial"
+                          and dec.args and _is_jit_expr(dec.args[0])):
+                        add(node, dec)
+        # call forms: jax.jit(f) / jax.jit(shard_map(f, ...))
+        elif isinstance(node, ast.Call) and _is_jit_expr(node.func):
+            if not node.args:
+                continue
+            target = node.args[0]
+            if (isinstance(target, ast.Call)
+                    and isinstance(target.func, (ast.Name, ast.Attribute))
+                    and (target.func.attr if isinstance(
+                        target.func, ast.Attribute) else target.func.id)
+                    == "shard_map" and target.args):
+                target = target.args[0]
+            if isinstance(target, ast.Name):
+                for fn in defs_by_name.get(target.id, ()):
+                    add(fn, node)
+            elif isinstance(target, ast.Lambda):
+                add(target, node)
+    return kernels
+
+
+# -- taint walk over one kernel body ----------------------------------------
+
+class _TaintVisitor(ast.NodeVisitor):
+    """One in-order pass over a kernel body. ``tainted`` starts as the
+    traced parameter set; a single assignment hop propagates it. The
+    visitor flags host conversions and Python control flow on tainted
+    expressions."""
+
+    def __init__(self, file: str, kernel_name: str,
+                 tainted: Set[str]) -> None:
+        self.file = file
+        self.kernel = kernel_name
+        self.tainted = set(tainted)
+        self.findings: List[Finding] = []
+
+    # -- taint test -------------------------------------------------------
+    def _expr_tainted(self, node: ast.AST) -> bool:
+        """Does this expression carry a traced value into host code?
+        Names under static attributes / len() / `is`/`in` tests don't."""
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return False
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id == "len":
+                return False  # len() of arrays/dicts is static
+            if isinstance(fn, ast.Name) and fn.id == "isinstance":
+                return False
+        if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                for op in node.ops):
+            # `x is None` / `"col" in data` are static under tracing
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        return any(self._expr_tainted(c) for c in ast.iter_child_nodes(node))
+
+    # -- taint propagation (one hop, source order) ------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit(node.value)
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if self._expr_tainted(node.value):
+            self.tainted.update(names)
+        else:
+            self.tainted.difference_update(names)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.visit(node.value)
+        if (isinstance(node.target, ast.Name)
+                and self._expr_tainted(node.value)):
+            self.tainted.add(node.target.id)
+
+    # -- flagged sites ----------------------------------------------------
+    def _flag(self, rule: str, line: int, what: str, fix: str) -> None:
+        self.findings.append(_finding(
+            rule, f"{what} inside jit kernel {self.kernel!r} — host "
+            "round-trips on traced values retrace or pin stale "
+            "constants", self.file, line, fix=fix))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if (isinstance(fn, ast.Name) and fn.id in _HOST_CONVERSIONS
+                and node.args and self._expr_tainted(node.args[0])):
+            self._flag("TRACER_HOST_CALL", node.lineno,
+                       f"{fn.id}() on a traced value",
+                       "keep it on device (jnp.astype/where) or hoist "
+                       "the conversion out of the kernel")
+        elif (isinstance(fn, ast.Attribute)
+              and fn.attr in _NP_MATERIALIZERS
+              and isinstance(fn.value, ast.Name)
+              and fn.value.id in ("np", "numpy")
+              and node.args and self._expr_tainted(node.args[0])):
+            self._flag("TRACER_HOST_CALL", node.lineno,
+                       f"np.{fn.attr}() on a traced value",
+                       "use jnp inside kernels; numpy materializes on "
+                       "the host")
+        elif (isinstance(fn, ast.Attribute) and fn.attr in _HOST_METHODS
+              and self._expr_tainted(fn.value)):
+            self._flag("TRACER_HOST_CALL", node.lineno,
+                       f".{fn.attr}() on a traced value",
+                       "fetch after the kernel returns, not inside it")
+        self.generic_visit(node)
+
+    def _check_test(self, test: ast.AST, line: int, kind: str) -> None:
+        if self._expr_tainted(test):
+            self._flag("TRACER_BRANCH", line,
+                       f"Python {kind} on a traced value",
+                       "use lax.cond/lax.select/jnp.where or a mask; "
+                       "host control flow cannot see device values")
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_test(node.test, node.lineno, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_test(node.test, node.lineno, "while")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._check_test(node.test, node.lineno, "conditional expression")
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        it = node.iter
+        if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range"
+                and any(self._expr_tainted(a) for a in it.args)):
+            self._flag("TRACER_BRANCH", node.lineno,
+                       "range() over a traced value",
+                       "use lax.fori_loop/lax.scan for traced trip "
+                       "counts")
+        self.generic_visit(node)
+
+    # nested defs: their params shadow the outer taint
+    def _visit_nested(self, node) -> None:
+        params = {a.arg for a in node.args.posonlyargs + node.args.args}
+        saved = self.tainted
+        self.tainted = saved - params
+        self.generic_visit(node)
+        self.tainted = saved
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_nested(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_nested(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._visit_nested(node)
+
+
+def _lint_tracer_leaks(tree: ast.Module, file: str) -> List[Finding]:
+    out: List[Finding] = []
+    for kernel in _collect_kernels(tree):
+        fn = kernel.fn
+        if isinstance(fn, ast.Lambda):
+            params = {a.arg for a in fn.args.posonlyargs + fn.args.args}
+            name = "<lambda>"
+            body: Sequence[ast.AST] = [fn.body]
+        else:
+            params = {a.arg for a in fn.args.posonlyargs + fn.args.args}
+            name = fn.name
+            body = fn.body
+        tainted = params - kernel.static_names - {"self"}
+        v = _TaintVisitor(file, name, tainted)
+        for stmt in body:
+            v.visit(stmt)
+        out.extend(v.findings)
+    return out
+
+
+# -- registry-drift lints ---------------------------------------------------
+
+def _str_arg(node: ast.Call, i: int = 0) -> Optional[str]:
+    if len(node.args) > i and isinstance(node.args[i], ast.Constant) \
+            and isinstance(node.args[i].value, str):
+        return node.args[i].value
+    return None
+
+
+def _lint_fault_points(tree: ast.Module, file: str) -> List[Finding]:
+    from flink_tpu.faults import KNOWN_FAULT_POINTS
+
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        is_fire = (
+            (isinstance(fn, ast.Attribute) and fn.attr == "fire"
+             and isinstance(fn.value, ast.Name)
+             and fn.value.id == "faults")
+            or (isinstance(fn, ast.Name) and fn.id == "fire"))
+        if not is_fire:
+            continue
+        point = _str_arg(node)
+        if point is not None and point not in KNOWN_FAULT_POINTS:
+            out.append(_finding(
+                "FAULT_POINT_DRIFT",
+                f"faults.fire({point!r}) is not in "
+                "faults.KNOWN_FAULT_POINTS — chaos rules targeting it "
+                "can never be validated, and the analyzer will reject "
+                "confs that name it", file, node.lineno,
+                fix="add the point to KNOWN_FAULT_POINTS (and the "
+                    "module docstring's point list) or fix the literal"))
+    return out
+
+
+def _lint_config_keys(tree: ast.Module, file: str) -> List[Finding]:
+    from flink_tpu.config import is_declared_key
+
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        keys: List[Tuple[str, int]] = []
+        if isinstance(fn, ast.Attribute) and fn.attr == "get_raw":
+            k = _str_arg(node)
+            if k is not None:
+                keys.append((k, node.lineno))
+        elif (isinstance(fn, (ast.Name, ast.Attribute))
+              and (fn.attr if isinstance(fn, ast.Attribute) else fn.id)
+              == "Configuration" and node.args
+              and isinstance(node.args[0], ast.Dict)):
+            for kn in node.args[0].keys:
+                if isinstance(kn, ast.Constant) and isinstance(kn.value, str):
+                    keys.append((kn.value, kn.lineno))
+        for key, line in keys:
+            if not is_declared_key(key):
+                out.append(_finding(
+                    "CONFIG_KEY_DRIFT",
+                    f"config key {key!r} is outside the declared option "
+                    "grammar — the runtime ignores it", file, line,
+                    fix="declare a ConfigOption (or dynamic prefix) in "
+                        "config.py, or fix the literal"))
+    return out
+
+
+def _option_decls(tree: ast.Module, file: str) -> List[Tuple[str, str, int]]:
+    """(key, file, line) of every ConfigOption/duration_option literal."""
+    decls = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        if name in ("ConfigOption", "duration_option"):
+            key = _str_arg(node)
+            if key is not None:
+                decls.append((key, file, node.lineno))
+    return decls
+
+
+def _lint_metric_names(tree: ast.Module, file: str) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            continue
+        names: List[str] = []
+        if fn.attr in _METRIC_KINDS:
+            n = _str_arg(node)
+            if n is not None:
+                names.append(n)
+        elif fn.attr == "group":
+            names.extend(
+                a.value for a in node.args
+                if isinstance(a, ast.Constant) and isinstance(a.value, str))
+        for n in names:
+            if not _METRIC_NAME_RE.match(n):
+                out.append(_finding(
+                    "METRIC_NAME_INVALID",
+                    f"metric name {n!r} is outside the snake_case "
+                    "grammar ([a-z0-9_] dotted segments) dashboards "
+                    "key on", file, node.lineno,
+                    fix="rename to lowercase snake_case"))
+    return out
+
+
+# -- entry points -----------------------------------------------------------
+
+def lint_source(source: str, file: str) -> List[Finding]:
+    """Lint one file's source text (the unit every test fixture uses)."""
+    tree = ast.parse(source, filename=file)
+    out: List[Finding] = []
+    out.extend(_lint_tracer_leaks(tree, file))
+    out.extend(_lint_fault_points(tree, file))
+    out.extend(_lint_config_keys(tree, file))
+    out.extend(_lint_metric_names(tree, file))
+    return out
+
+
+def repo_root() -> str:
+    """The directory holding the flink_tpu package (lint path base)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+DEFAULT_LINT_PATHS = ("flink_tpu", "tools", "bench.py", "bench_micro.py")
+
+
+def lint_paths(paths: Optional[Sequence[str]] = None,
+               root: Optional[str] = None) -> List[Finding]:
+    """Lint every ``*.py`` under ``paths`` (files or directories,
+    resolved against ``root`` — defaults to the shipped tree). Also
+    runs the cross-file CONFIG_OPTION_DUP check over the whole set."""
+    from flink_tpu.analysis.plan_rules import load_option_grammar
+
+    load_option_grammar()
+    root = root or repo_root()
+    files: List[str] = []
+    for p in (paths or DEFAULT_LINT_PATHS):
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if not os.path.exists(full) and not os.path.isabs(p):
+            full = os.path.abspath(p)  # CLI arg relative to the cwd
+        if os.path.isfile(full):
+            files.append(full)
+        elif os.path.isdir(full):
+            for dirpath, dirnames, fnames in os.walk(full):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(fnames) if f.endswith(".py"))
+        else:
+            # a typo'd path silently linting NOTHING would leave a CI
+            # drift gate green while checking nothing — fail loudly
+            raise ValueError(f"lint path does not exist: {p!r} "
+                             f"(resolved against {root!r} and the cwd)")
+    out: List[Finding] = []
+    decls: List[Tuple[str, str, int]] = []
+    for f in sorted(set(files)):
+        rel = os.path.relpath(f, root)
+        with open(f, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        tree = ast.parse(src, filename=rel)
+        out.extend(_lint_tracer_leaks(tree, rel))
+        out.extend(_lint_fault_points(tree, rel))
+        out.extend(_lint_config_keys(tree, rel))
+        out.extend(_lint_metric_names(tree, rel))
+        decls.extend(_option_decls(tree, rel))
+    by_key: Dict[str, List[Tuple[str, str, int]]] = {}
+    for key, file, line in decls:
+        by_key.setdefault(key, []).append((key, file, line))
+    for key, sites in sorted(by_key.items()):
+        if len(sites) > 1:
+            first = f"{sites[0][1]}:{sites[0][2]}"
+            for _, file, line in sites[1:]:
+                out.append(_finding(
+                    "CONFIG_OPTION_DUP",
+                    f"option key {key!r} already declared at {first} — "
+                    "re-declaration silently replaces it in the "
+                    "registry", file, line,
+                    fix="reuse the existing ConfigOption constant"))
+    out.sort(key=lambda f: (f.file, f.line, f.rule))
+    return out

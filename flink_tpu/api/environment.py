@@ -149,12 +149,24 @@ class StreamExecutionEnvironment:
             self.config.get(StateOptions.SLOTS_PER_SHARD),
             devices)
 
-    def compile_plan(self):
+    def compile_plan(self, strict: bool = True):
         """Lowered execution plan without running (inspection/tests —
-        the getExecutionPlan analogue)."""
+        the getExecutionPlan analogue). ``strict=False`` lowers plans
+        strict compilation rejects, so the analyzer can report the
+        violations as findings (`python -m flink_tpu analyze`)."""
         from flink_tpu.graph.compiler import compile_job
 
-        return compile_job(self._transforms, self.config, self._watermark_strategy)
+        return compile_job(self._transforms, self.config,
+                           self._watermark_strategy, strict=strict)
+
+    def analyze(self):
+        """Run compile-time plan analysis over this environment's
+        pipeline + config without executing (the `flink_tpu analyze`
+        surface; the driver runs the same rules at submit under
+        ``analysis.fail-on``). Returns the findings list."""
+        from flink_tpu.analysis import analyze
+
+        return analyze(self.compile_plan(strict=False), self.config)
 
 
 class JobResult:

@@ -56,6 +56,38 @@ def all_options() -> Mapping[str, ConfigOption[Any]]:
     return dict(_REGISTRY)
 
 
+# Namespaces whose keys are legal without a per-key declaration — the
+# plan analyzer's CONFIG_KEY_UNKNOWN rule and the repo lints treat any
+# key under a declared prefix as grammatical. Use sparingly: a dynamic
+# prefix trades per-key validation away for open-ended parameters.
+_DYNAMIC_PREFIXES: Dict[str, str] = {}
+
+
+def declare_dynamic_prefix(prefix: str, description: str = "") -> str:
+    if not prefix.endswith("."):
+        raise ValueError(f"dynamic prefix must end with '.': {prefix!r}")
+    _DYNAMIC_PREFIXES[prefix] = description
+    return prefix
+
+
+def dynamic_prefixes() -> Mapping[str, str]:
+    return dict(_DYNAMIC_PREFIXES)
+
+
+def is_declared_key(key: str) -> bool:
+    """True when ``key`` is part of the config grammar: a registered
+    option or under a declared dynamic prefix."""
+    return key in _REGISTRY or any(
+        key.startswith(p) for p in _DYNAMIC_PREFIXES)
+
+
+# test.* carries per-job parameters of the deployable test jobs
+# (tests/runner_job*.py) through the submitted Configuration — the
+# job-jar argument channel of the test harness.
+declare_dynamic_prefix(
+    "test.", "test-harness job parameters (tests/runner_job*.py)")
+
+
 class Configuration:
     """Layered key→value store (ref: Configuration.java).
 
@@ -424,6 +456,36 @@ class ClusterOptions:
     HEARTBEAT_TIMEOUT = duration_option(
         "heartbeat.timeout", 50_000,
         "Declare a runner dead after this silence (ref: heartbeat.timeout=50s).")
+    # -- deploy-injected identity keys (the TaskDeploymentDescriptor
+    # analogue): the coordinator/runner stamp these into the attempt's
+    # config at deploy; user configs normally never set them.
+    ATTEMPT = ConfigOption(
+        "cluster.attempt", 0,
+        "This attempt's fencing epoch, minted by the coordinator on "
+        "every (re)deploy. Qualifies in-progress artifacts — "
+        "chk-<id>.e<epoch> checkpoints, part-file and log-segment "
+        "names — so a deposed attempt can never clobber a successor.")
+    COORDINATOR = ConfigOption(
+        "cluster.coordinator", "",
+        "HOST:PORT of the job coordinator's RPC server, injected by the "
+        "runner at deploy (split enumeration, savepoint reporting).")
+    JOB_ID = ConfigOption(
+        "cluster.job-id", "",
+        "Submitted job id, injected by the runner at deploy.")
+    RUNNER_ID = ConfigOption(
+        "cluster.runner-id", "",
+        "This runner's id, injected at deploy (coordinator-side split "
+        "enumeration keys on it).")
+    DCN_HOST = ConfigOption(
+        "cluster.dcn-host", "",
+        "Advertised host of this process's DCN exchange listener "
+        "(coordinator-brokered rendezvous; defaults to the RPC-visible "
+        "address when empty).")
+    DCN_RENDEZVOUS = ConfigOption(
+        "cluster.dcn-rendezvous", "",
+        "'coordinator' lets a multi-process job discover DCN peers "
+        "through the coordinator instead of a static cluster.dcn-peers "
+        "list; stamped into the attempt config at deploy.")
     RESTART_STRATEGY = ConfigOption(
         "restart-strategy.type", "exponential-delay",
         "fixed-delay | exponential-delay | failure-rate | none (ref: "
@@ -434,6 +496,23 @@ class ClusterOptions:
     RESTART_DELAY = duration_option(
         "restart-strategy.fixed-delay.delay", 1_000,
         "Delay between restarts for fixed-delay strategy.")
+
+
+class AnalysisOptions:
+    FAIL_ON = ConfigOption(
+        "analysis.fail-on", "error",
+        "Compile-time plan analysis at submit (flink_tpu/analysis/): "
+        "'error' (default) fails the job when any error-severity "
+        "finding fires (misconfigurations that WILL break at runtime: "
+        "unbounded source in batch mode, two log writers on one topic, "
+        "fault rules matching no registered point); 'warn' also fails "
+        "on warn-severity findings (correctness smells: event-time "
+        "windows without a watermark strategy, non-transactional sinks "
+        "under checkpointing, unknown config keys); 'off' skips "
+        "analysis entirely. Findings below the threshold are kept on "
+        "the driver (driver.analysis_findings) without failing the "
+        "job. `python -m flink_tpu analyze` runs the same rules "
+        "standalone.")
 
 
 class SourceOptions:

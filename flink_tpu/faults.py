@@ -100,6 +100,35 @@ FAULT_INJECT = ConfigOption(
     "[~DELAY_MS]'. kind: raise|drop|delay|crash. Empty = no injection "
     "(production default). See flink_tpu/faults.py for the point list.")
 
+# Authoritative registry of every instrumented fault point. A
+# ``faults.fire`` call site whose literal is missing here is DRIFT: the
+# repo AST lint (analysis/pylints.py FAULT_POINT_DRIFT) flags it, and
+# the plan analyzer (FAULT_POINT_UNKNOWN) rejects ``faults.inject``
+# rules whose glob matches none of these — a chaos conf that silently
+# injects nothing is worse than no chaos at all. Keep in sync with the
+# point list in the module docstring above.
+KNOWN_FAULT_POINTS = frozenset((
+    "checkpoint.storage.stall",
+    "checkpoint.storage.write",
+    "checkpoint.storage.fsync",
+    "checkpoint.storage.rename",
+    "checkpoint.upload",
+    "rpc.client.send",
+    "rpc.client.recv",
+    "rpc.server.dispatch",
+    "dcn.accept",
+    "dcn.send",
+    "dcn.recv",
+    "runner.heartbeat",
+    "coordinator.deploy",
+    "supervisor.restart",
+    "log.segment.append",
+    "log.segment.seal",
+    "log.segment.fsync",
+    "log.txn.marker",
+    "log.txn.commit",
+))
+
 # process-global fault/recovery metrics — chaos tests assert every
 # injection and every recovery attempt is visible here and on the tracer
 registry = MetricRegistry()

@@ -61,6 +61,11 @@ class ExecNode:
     right_input: Optional[int] = None
     # partition: non-keyed redistribution strategy (exchange boundary)
     partition_strategy: Optional[str] = None
+    # keyed stateful ops: whether the op's input edge came through a
+    # keyBy exchange (the lowering folds KeyByTransformation into the
+    # op, so the plan must remember the exchange existed — the
+    # analyzer's KEYED_WITHOUT_KEYBY rule reads this)
+    keyed_input: bool = False
     name: str = ""
 
 
@@ -140,11 +145,17 @@ def compile_job(
     transforms: Sequence[Transformation],
     config: Configuration,
     default_wm: WatermarkStrategy,
+    strict: bool = True,
 ) -> ExecutionPlan:
     """Lower the transformation list. Chaining rule (the isChainable
     analogue): consecutive Map/Filter/FlatMap nodes with a single
     consumer fuse into one ExecChain; KeyBy folds into the downstream
-    stateful op (the exchange lives inside its device program)."""
+    stateful op (the exchange lives inside its device program).
+
+    ``strict=False`` lowers a plan that strict compilation would
+    reject (unbounded sources in batch mode) so the plan ANALYZER can
+    report the violation as a structured finding instead of dying on
+    the first hard error — the execution path always compiles strict."""
     # consumers per transformation
     consumers: Dict[int, List[Transformation]] = {}
     for t in transforms:
@@ -160,6 +171,13 @@ def compile_job(
         next_id[0] += 1
         nodes[n.id] = n
         return n
+
+    def keyed_in(t: Transformation) -> bool:
+        """Whether t's input edge is a keyBy exchange (KeyBy folds
+        into the downstream stateful op, so the plan records the
+        exchange on the op — analysis/plan_rules.py
+        KEYED_OP_WITHOUT_KEYBY reads this)."""
+        return isinstance(t.inputs[0], KeyByTransformation)
 
     def node_for(t: Transformation) -> int:
         """Exec node that PRODUCES t's output batches."""
@@ -209,12 +227,12 @@ def compile_job(
         elif isinstance(t, WindowAggregateTransformation):
             up = node_for(t.inputs[0])
             n = new_node("window", t.name, window_transform=t,
-                         key_field=t.key_field)
+                         key_field=t.key_field, keyed_input=keyed_in(t))
             nodes[up].downstream.append(n.id)
         elif isinstance(t, EvictingWindowTransformation):
             up = node_for(t.inputs[0])
             n = new_node("evicting_window", t.name, window_transform=t,
-                         key_field=t.key_field)
+                         key_field=t.key_field, keyed_input=keyed_in(t))
             nodes[up].downstream.append(n.id)
         elif isinstance(t, AsyncIOTransformation):
             up = node_for(t.inputs[0])
@@ -229,12 +247,12 @@ def compile_job(
         elif isinstance(t, CepTransformation):
             up = node_for(t.inputs[0])
             n = new_node("cep", t.name, window_transform=t,
-                         key_field=t.key_field)
+                         key_field=t.key_field, keyed_input=keyed_in(t))
             nodes[up].downstream.append(n.id)
         elif isinstance(t, KeyedProcessTransformation):
             up = node_for(t.inputs[0])
             n = new_node("process", t.name, window_transform=t,
-                         key_field=t.key_field)
+                         key_field=t.key_field, keyed_input=keyed_in(t))
             nodes[up].downstream.append(n.id)
         elif isinstance(t, WindowAllAggregateTransformation):
             up = node_for(t.inputs[0])
@@ -243,17 +261,17 @@ def compile_job(
         elif isinstance(t, CountWindowAggregateTransformation):
             up = node_for(t.inputs[0])
             n = new_node("count_window", t.name, window_transform=t,
-                         key_field=t.key_field)
+                         key_field=t.key_field, keyed_input=keyed_in(t))
             nodes[up].downstream.append(n.id)
         elif isinstance(t, GlobalAggregateTransformation):
             up = node_for(t.inputs[0])
             n = new_node("global_agg", t.name, window_transform=t,
-                         key_field=t.key_field)
+                         key_field=t.key_field, keyed_input=keyed_in(t))
             nodes[up].downstream.append(n.id)
         elif isinstance(t, SessionAggregateTransformation):
             up = node_for(t.inputs[0])
             n = new_node("session", t.name, window_transform=t,
-                         key_field=t.key_field)
+                         key_field=t.key_field, keyed_input=keyed_in(t))
             nodes[up].downstream.append(n.id)
         elif isinstance(t, WindowJoinTransformation):
             lup = node_for(t.inputs[0])
@@ -310,7 +328,7 @@ def compile_job(
 
         unbounded = [nodes[sid].name or str(sid) for sid in sources
                      if not source_is_bounded(nodes[sid].source)]
-        if unbounded:
+        if unbounded and strict:
             raise ValueError(
                 "execution.runtime-mode=batch requires every source to "
                 f"be bounded; unbounded source(s): {unbounded} (run "

@@ -46,6 +46,7 @@ from jax.sharding import PartitionSpec as P
 
 from flink_tpu.api.windowing import WindowAssigner
 from flink_tpu.hostsync import ready_wait
+from flink_tpu.utils.jaxcompat import shard_map
 from flink_tpu.ops.aggregates import LaneAggregate
 from flink_tpu.parallel.mesh import AXIS, MeshPlan
 from flink_tpu.state.keyed import (
@@ -1376,7 +1377,7 @@ class WindowOperator:
         rep = P()
 
         self._apply_sharded = jax.jit(
-            jax.shard_map(
+            shard_map(
                 apply_shard, mesh=mp.mesh,
                 in_specs=(state_spec, batch_spec, batch_spec),
                 out_specs=(state_spec, rep),
@@ -1396,7 +1397,7 @@ class WindowOperator:
             return apply_shard(state, packed, data)
 
         self._apply_sharded_split = jax.jit(
-            jax.shard_map(
+            shard_map(
                 apply_shard_split, mesh=mp.mesh,
                 in_specs=(state_spec, batch_spec, batch_spec),
                 out_specs=(state_spec, rep),
@@ -1427,7 +1428,7 @@ class WindowOperator:
                     return packed.at[:, 0].add(offset)
 
                 fn = jax.jit(
-                    jax.shard_map(
+                    shard_map(
                         fire_shard, mesh=mp.mesh,
                         in_specs=(state_spec, rep, P(AXIS)),
                         out_specs=P(AXIS),
@@ -1479,7 +1480,7 @@ class WindowOperator:
                             sel_cap=sel_cap, row_offset=my * rows_local)
 
                     fn = jax.jit(
-                        jax.shard_map(
+                        shard_map(
                             topn_shard, mesh=mp.mesh,
                             in_specs=(state_spec, P(AXIS), rep, P(AXIS)),
                             out_specs=P(AXIS),
@@ -1490,7 +1491,7 @@ class WindowOperator:
 
             self._ring_topn = ring_topn_sharded
         self._clear = jax.jit(
-            jax.shard_map(
+            shard_map(
                 clear_kernel, mesh=mp.mesh,
                 in_specs=(state_spec, rep),
                 out_specs=state_spec,

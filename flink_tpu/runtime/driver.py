@@ -56,6 +56,9 @@ class Driver:
         self.plan = plan
         self.config = config
         self.mesh_plan = mesh_plan
+        # submit-time plan analysis results (execute() refreshes this;
+        # an empty list before/without analysis keeps the surface total)
+        self.analysis_findings: List[Any] = []
         self._upstream: Dict[int, List[int]] = {nid: [] for nid in plan.nodes}
         for n in plan.nodes.values():
             for d in n.downstream:
@@ -520,8 +523,8 @@ class Driver:
         peers = [p.strip() for p in
                  str(cfg.get(ClusterOptions.DCN_PEERS)).split(",")
                  if p.strip()]
-        rendezvous = (not peers and str(cfg.get_raw(
-            "cluster.dcn-rendezvous", "")).strip() == "coordinator")
+        rendezvous = (not peers and str(cfg.get(
+            ClusterOptions.DCN_RENDEZVOUS)).strip() == "coordinator")
         if not rendezvous and len(peers) != n:
             raise ValueError(
                 f"cluster.dcn-peers must list {n} host:port entries, "
@@ -982,6 +985,24 @@ class Driver:
                     "execution.checkpointing.restore is incompatible "
                     "with execution.runtime-mode=batch (nothing "
                     "checkpoints in batch mode — re-run the job)")
+        # compile-time plan analysis at submit (flink_tpu/analysis/):
+        # findings surface BEFORE the first record flows; the fail-on
+        # threshold decides which severities abort the run, everything
+        # else stays inspectable on driver.analysis_findings. Runs
+        # after the explicit batch-mode contradictions above so their
+        # long-standing error messages keep first claim.
+        from flink_tpu.config import AnalysisOptions
+
+        fail_on = str(self.config.get(AnalysisOptions.FAIL_ON)).strip().lower()
+        self.analysis_findings = []
+        if fail_on != "off":
+            from flink_tpu.analysis import AnalysisError, analyze
+            from flink_tpu.analysis.core import blocking
+
+            self.analysis_findings = analyze(self.plan, self.config)
+            blockers = blocking(self.analysis_findings, fail_on)
+            if blockers:
+                raise AnalysisError(blockers, fail_on)
         import queue
         import threading
 

@@ -1,0 +1,23 @@
+"""jax API compatibility — one import site for symbols that moved
+between jax releases.
+
+``shard_map`` was promoted from ``jax.experimental.shard_map`` to
+``jax.shard_map``; containers pin either side of the move. Every
+shard_map consumer (ops/window.py, exchange parity tests, bench_micro)
+imports it from here, and the tier-1 capability probe in
+tests/conftest.py keys on :data:`HAS_SHARD_MAP` — mesh tests skip
+instead of erroring when NEITHER spelling exists.
+"""
+from __future__ import annotations
+
+import jax
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:
+    try:
+        from jax.experimental.shard_map import shard_map
+    except ImportError:  # pragma: no cover - no shard_map at all
+        shard_map = None
+
+HAS_SHARD_MAP = shard_map is not None

@@ -25,6 +25,27 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import pytest  # noqa: E402
+
+# Capability probe: shard_map moved from jax.experimental.shard_map to
+# jax.shard_map across jax releases; flink_tpu.utils.jaxcompat resolves
+# whichever spelling this container has. When NEITHER exists, every
+# mesh/exchange test (marked ``shard_map``) SKIPS instead of erroring —
+# tier-1 stays green-or-skipped on environments that reproduce the
+# seed's jax.shard_map AttributeError failures.
+from flink_tpu.utils.jaxcompat import HAS_SHARD_MAP  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    if HAS_SHARD_MAP:
+        return
+    skip = pytest.mark.skip(
+        reason="jax.shard_map unavailable (neither jax.shard_map nor "
+               "jax.experimental.shard_map imports in this container)")
+    for item in items:
+        if "shard_map" in item.keywords:
+            item.add_marker(skip)
+
 
 def pytest_configure(config):
     # tier-1 runs `-m 'not slow'`: the deterministic chaos slice (fixed
@@ -43,3 +64,11 @@ def pytest_configure(config):
         "markers", "log: durable-log exchange tests (flink_tpu/log/) — "
         "embedded replayable topics, 2PC commit markers, exactly-once "
         "job chaining")
+    config.addinivalue_line(
+        "markers", "shard_map: needs jax shard_map (device-mesh "
+        "execution) — skipped by the conftest capability probe when "
+        "neither jax.shard_map nor jax.experimental.shard_map exists")
+    config.addinivalue_line(
+        "markers", "analysis: static-analysis suite (flink_tpu/analysis"
+        "/) — plan-analyzer rules, repo AST lints, and the dogfood gate "
+        "that keeps the shipped tree at zero findings (tier-1)")

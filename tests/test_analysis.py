@@ -1,0 +1,289 @@
+"""Plan-analyzer suite (flink_tpu/analysis/): one seeded-violation
+pipeline per registered rule asserting the exact rule id + node fires,
+clean-pipeline negatives, the driver's submit-time ``analysis.fail-on``
+thresholds, the `flink_tpu analyze` CLI surface, and the DOGFOOD GATE —
+the shipped tree and the golden pipelines must report zero findings,
+so registry/config drift can never land silently (tier-1)."""
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from flink_tpu.analysis import AnalysisError, analyze_config
+from flink_tpu.analysis.core import blocking, rule_catalog
+from flink_tpu.api.datastream import DataStream
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.api.windowing import GlobalWindows, TumblingEventTimeWindows
+from flink_tpu.config import Configuration
+from flink_tpu.graph.transformations import WindowAggregateTransformation
+from flink_tpu.ops.aggregates import count
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+pytestmark = pytest.mark.analysis
+
+WM = WatermarkStrategy.for_monotonous_timestamps
+
+
+def gen(split, i):
+    if i >= 2:
+        return None
+    return ({"word": np.arange(8, dtype=np.int64)},
+            (np.arange(8, dtype=np.int64) + i * 8) * 100)
+
+
+def make_env(extra=None):
+    conf = {"state.num-key-shards": 8, "state.slots-per-shard": 64,
+            "pipeline.microbatch-size": 256}
+    conf.update(extra or {})
+    return StreamExecutionEnvironment(Configuration(conf))
+
+
+def clean_pipeline(extra=None):
+    """The golden shape: watermarked bounded source, keyBy, bounded
+    window, collect — nothing for any rule to say."""
+    env = make_env(extra)
+    (env.from_source(GeneratorSource(gen), WM())
+        .key_by("word")
+        .window(TumblingEventTimeWindows.of(1000))
+        .count()
+        .collect())
+    return env
+
+
+# -- seeded violations: one builder per rule --------------------------------
+# The coverage test parametrizes over rule_catalog(), so a rule added
+# to the engine without a seeded-violation case here FAILS the suite.
+
+SEEDS = {}
+
+
+def seed(rule_id, node_name=None):
+    def deco(fn):
+        SEEDS[rule_id] = (fn, node_name)
+        return fn
+    return deco
+
+
+@seed("EVENT_TIME_NO_WATERMARK", node_name="window_agg")
+def _no_watermark(tmp_path):
+    env = make_env()
+    (env.from_source(GeneratorSource(gen))  # no WatermarkStrategy
+        .key_by("word")
+        .window(TumblingEventTimeWindows.of(1000))
+        .count()
+        .collect())
+    return env.analyze()
+
+
+@seed("NON_TRANSACTIONAL_SINK", node_name="collect")
+def _write_through_sink(tmp_path):
+    env = clean_pipeline({"execution.checkpointing.interval": 500})
+    return env.analyze()
+
+
+@seed("UNBOUNDED_SOURCE_IN_BATCH", node_name="source")
+def _unbounded_batch(tmp_path):
+    # strict compilation rejects this plan outright — the analyzer's
+    # non-strict lowering must still surface it as a structured finding
+    env = make_env({"execution.runtime-mode": "batch"})
+    (env.from_source(GeneratorSource(gen, is_bounded=False), WM())
+        .key_by("word")
+        .window(TumblingEventTimeWindows.of(1000))
+        .count()
+        .collect())
+    return env.analyze()
+
+
+@seed("KEYED_OP_WITHOUT_KEYBY", node_name="rogue_window")
+def _keyed_without_keyby(tmp_path):
+    # the fluent API always inserts the keyBy exchange; build the
+    # malformed graph the way a buggy planner/raw-transformation user
+    # would — window fed directly by the source
+    env = make_env()
+    ds = env.from_source(GeneratorSource(gen), WM())
+    t = WindowAggregateTransformation(
+        "rogue_window", (ds.transform,),
+        assigner=TumblingEventTimeWindows.of(1000), aggregate=count(),
+        key_field="word")
+    env._register(t)
+    DataStream(env, t).collect()
+    return env.analyze()
+
+
+@seed("WINDOW_WITHOUT_FIRE_BOUND", node_name="window_agg")
+def _global_window_no_trigger(tmp_path):
+    env = make_env()
+    (env.from_source(GeneratorSource(gen), WM())
+        .key_by("word")
+        .window(GlobalWindows.create())  # no .trigger(...)
+        .count()
+        .collect())
+    return env.analyze()
+
+
+@seed("LOG_TOPIC_MULTI_WRITER")
+def _two_writers_one_topic(tmp_path):
+    from flink_tpu.log.connectors import LogSink
+
+    topic = str(tmp_path / "topic")
+    env = make_env()
+    ds = env.from_source(GeneratorSource(gen), WM())
+    ds.add_sink(LogSink(topic), name="writer_a")
+    ds.add_sink(LogSink(topic), name="writer_b")
+    return env.analyze()
+
+
+@seed("FAULT_POINT_UNKNOWN")
+def _fault_point_unknown(tmp_path):
+    env = clean_pipeline({"faults.inject": "bogus.point=raise @1.0"})
+    return env.analyze()
+
+
+@seed("CONFIG_KEY_UNKNOWN")
+def _config_key_typo(tmp_path):
+    env = clean_pipeline({"execution.checkpointng.interval": 500})
+    return env.analyze()
+
+
+@seed("CHECKPOINT_IN_BATCH")
+def _checkpoint_in_batch(tmp_path):
+    # config-only rule: no pipeline needed
+    return analyze_config(Configuration({
+        "execution.runtime-mode": "batch",
+        "execution.checkpointing.interval": 500}))
+
+
+class TestRuleCatalog:
+    def test_catalog_has_at_least_eight_rules(self):
+        assert len(rule_catalog()) >= 8
+
+    @pytest.mark.parametrize("rule_id,severity",
+                             rule_catalog(),
+                             ids=[r for r, _ in rule_catalog()])
+    def test_every_rule_fires_on_its_seeded_violation(
+            self, rule_id, severity, tmp_path):
+        assert rule_id in SEEDS, (
+            f"rule {rule_id} has no seeded-violation case — every rule "
+            "in the catalog must prove it fires")
+        builder, node_name = SEEDS[rule_id]
+        findings = builder(tmp_path)
+        hits = [f for f in findings if f.rule == rule_id]
+        assert hits, (f"{rule_id} did not fire; findings: "
+                      f"{[f.rule for f in findings]}")
+        for f in hits:
+            assert f.severity == severity
+            assert f.fix, f"{rule_id} finding has no fix hint"
+        if node_name is not None:
+            assert any(f.node_name == node_name for f in hits), (
+                f"{rule_id} did not locate node {node_name!r}: "
+                f"{[(f.node, f.node_name) for f in hits]}")
+
+    def test_clean_pipeline_zero_findings(self):
+        assert clean_pipeline().analyze() == []
+
+    def test_clean_batch_pipeline_zero_findings(self):
+        assert clean_pipeline(
+            {"execution.runtime-mode": "batch"}).analyze() == []
+
+
+class TestSubmitTimeAnalysis:
+    """The driver runs the same rules at submit; ``analysis.fail-on``
+    picks the blocking severity."""
+
+    def test_error_finding_blocks_submit(self):
+        env = clean_pipeline({"faults.inject": "bogus.point=raise"})
+        with pytest.raises(AnalysisError) as ei:
+            env.execute("blocked")
+        assert any(f.rule == "FAULT_POINT_UNKNOWN"
+                   for f in ei.value.findings)
+        assert "analysis.fail-on" in str(ei.value)
+
+    def test_fail_on_off_skips_analysis(self):
+        env = clean_pipeline({"faults.inject": "bogus.point=raise",
+                              "analysis.fail-on": "off"})
+        r = env.execute("unblocked")
+        assert r.metrics.get("records_in") == 16
+
+    def test_warn_threshold_blocks_warn_findings(self):
+        env = clean_pipeline({"no.such.key": 1,
+                              "analysis.fail-on": "warn"})
+        with pytest.raises(AnalysisError) as ei:
+            env.execute("blocked")
+        assert any(f.rule == "CONFIG_KEY_UNKNOWN"
+                   for f in ei.value.findings)
+
+    def test_warn_findings_pass_default_threshold_but_stay_visible(self):
+        env = clean_pipeline({"no.such.key": 1})
+        r = env.execute("warned")
+        assert r.metrics.get("records_in") == 16
+        assert any(f.rule == "CONFIG_KEY_UNKNOWN"
+                   for f in env._driver.analysis_findings)
+
+    def test_bad_fail_on_value_rejected(self):
+        with pytest.raises(ValueError, match="fail-on"):
+            blocking([], "sometimes")
+
+
+class TestAnalyzeCli:
+    def test_conf_file_violations_exit_1_with_json_findings(
+            self, tmp_path, capsys):
+        from flink_tpu.cli import main
+
+        conf = tmp_path / "job.conf"
+        conf.write_text("faults.inject: bogus.point=raise\n"
+                        "execution.checkpointng.interval: 500\n")
+        rc = main(["analyze", str(conf), "--json"])
+        assert rc == 1
+        rules = {json.loads(line)["rule"]
+                 for line in capsys.readouterr().out.splitlines()}
+        assert rules == {"FAULT_POINT_UNKNOWN", "CONFIG_KEY_UNKNOWN"}
+
+    def test_clean_conf_exits_0(self, tmp_path, capsys):
+        from flink_tpu.cli import main
+
+        conf = tmp_path / "job.conf"
+        conf.write_text("execution.checkpointing.interval: 500\n")
+        assert main(["analyze", str(conf)]) == 0
+        assert "no findings" in capsys.readouterr().out
+
+    def test_fail_on_flag_overrides_conf(self, tmp_path, capsys):
+        from flink_tpu.cli import main
+
+        conf = tmp_path / "job.conf"
+        conf.write_text("some.typo.key: 1\n")
+        assert main(["analyze", str(conf)]) == 0  # warn < error
+        assert main(["analyze", str(conf), "--fail-on", "warn"]) == 1
+        capsys.readouterr()
+
+    def test_golden_wordcount_entry_zero_findings(self, tmp_path, capsys):
+        """Dogfood: the shipped golden pipeline (the batch-mode CLI
+        smoke entry point) analyzes clean, plan rules included."""
+        from flink_tpu.cli import main
+
+        rc = main(["analyze", "--entry", "runner_job_wordcount:build",
+                   "--conf", f"test.sink-dir={tmp_path / 'out'}"])
+        assert rc == 0
+        assert "no findings" in capsys.readouterr().out
+
+
+class TestDogfoodGate:
+    """Zero findings on the shipped tree — registry/config drift can
+    never land silently again."""
+
+    def test_repo_lints_zero_findings(self):
+        from flink_tpu.analysis.pylints import lint_paths
+
+        findings = lint_paths()
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_lint_cli_smoke(self):
+        """`python -m flink_tpu lint` from a cold process — the tier-1
+        wrapper's drift gate, exit status included."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "flink_tpu", "lint"],
+            capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "no findings" in proc.stdout

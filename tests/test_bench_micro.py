@@ -2,9 +2,12 @@
 emits a parseable line (tier-7 analogue, SURVEY §5; BASELINE.md list)."""
 import json
 
+import pytest
+
 import bench_micro
 
 
+@pytest.mark.shard_map
 def test_all_micro_benchmarks_emit(capsys):
     bench_micro.bench_state_update(batch=1 << 12, iters=2)
     bench_micro.bench_all_to_all(iters=2)

@@ -153,6 +153,7 @@ class TestExactlyOnceResume:
         assert len(sink.committed) == n1  # nothing new: already at end
 
 
+@pytest.mark.shard_map
 class TestReshard:
     def test_restore_local_snapshot_into_mesh(self):
         """Rescale 1 → 8 devices: snapshot from a local operator restores

@@ -279,6 +279,7 @@ class TestAttemptFencing:
             e.close()
 
 
+@pytest.mark.shard_map
 class TestTier5TwoProcessQ5:
     def test_two_process_q5_matches_single_process(self, tmp_path):
         """Q5-shaped job over 2 processes: the union of both processes'

@@ -1,0 +1,321 @@
+"""Repo AST-lint suite (flink_tpu/analysis/pylints.py): fixture
+sources with deliberate tracer leaks prove every lint fires at the
+right line, and trace-static idioms (shape reads, len(), `is None`,
+static_argnums) prove it stays quiet — the false-positive budget of
+the dogfood gate is ZERO, so the negatives matter as much as the
+positives (tier-1)."""
+import textwrap
+
+import pytest
+
+from flink_tpu.analysis.pylints import (
+    DEFAULT_LINT_PATHS,
+    LINT_RULES,
+    lint_paths,
+    lint_source,
+)
+
+pytestmark = pytest.mark.analysis
+
+
+def lint(src):
+    return lint_source(textwrap.dedent(src), "fixture.py")
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# -- tracer leaks: host conversions -----------------------------------------
+
+class TestTracerHostCall:
+    def test_float_on_traced_param(self):
+        fs = lint("""
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                return float(x)
+        """)
+        assert rules_of(fs) == ["TRACER_HOST_CALL"]
+        assert fs[0].line == 6
+        assert fs[0].severity == "error"
+        assert "kernel" in fs[0].message
+
+    def test_np_asarray_one_assignment_hop(self):
+        fs = lint("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def kernel(x):
+                y = x * 2
+                return np.asarray(y)
+        """)
+        assert rules_of(fs) == ["TRACER_HOST_CALL"]
+        assert "np.asarray" in fs[0].message
+
+    def test_item_method_on_traced(self):
+        fs = lint("""
+            from jax import jit
+
+            @jit
+            def kernel(x):
+                return x.sum().item()
+        """)
+        assert rules_of(fs) == ["TRACER_HOST_CALL"]
+
+    def test_reassignment_clears_taint(self):
+        fs = lint("""
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                x = 3
+                return float(x)
+        """)
+        assert fs == []
+
+    def test_untainted_conversion_is_fine(self):
+        fs = lint("""
+            import jax
+
+            @jax.jit
+            def kernel(x, n):
+                return x * int(x.shape[0])
+        """)
+        assert fs == []
+
+
+# -- tracer leaks: host control flow ----------------------------------------
+
+class TestTracerBranch:
+    def test_if_on_traced_value(self):
+        fs = lint("""
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                if x > 0:
+                    return x
+                return -x
+        """)
+        assert rules_of(fs) == ["TRACER_BRANCH"]
+        assert fs[0].line == 6
+
+    def test_while_and_ternary(self):
+        fs = lint("""
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                while x > 0:
+                    x = x - 1
+                return x if x > 0 else -x
+        """)
+        assert rules_of(fs) == ["TRACER_BRANCH", "TRACER_BRANCH"]
+
+    def test_range_over_traced_trip_count(self):
+        fs = lint("""
+            import jax
+
+            @jax.jit
+            def kernel(x, n):
+                for i in range(n):
+                    x = x + i
+                return x
+        """)
+        assert rules_of(fs) == ["TRACER_BRANCH"]
+        assert "range()" in fs[0].message
+
+    def test_static_idioms_stay_quiet(self):
+        # shape/ndim/dtype/size reads, len(), `is None`, `in` — all
+        # static under tracing; flagging any of them would poison the
+        # dogfood gate with false positives
+        fs = lint("""
+            import jax
+
+            @jax.jit
+            def kernel(x, data):
+                if x.shape[0] > 4:
+                    x = x[:4]
+                if x.ndim == 2:
+                    x = x.sum(0)
+                if len(data) > 1:
+                    x = x * 2
+                if x is None:
+                    return x
+                if "col" in data:
+                    x = x + 1
+                for i in range(x.shape[0]):
+                    x = x + i
+                return x
+        """)
+        assert fs == []
+
+    def test_static_argnums_excludes_param(self):
+        fs = lint("""
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnums=(1,))
+            def kernel(x, n):
+                if n > 4:
+                    return x[:n]
+                return x
+        """)
+        assert fs == []
+
+    def test_static_argnames_excludes_param(self):
+        fs = lint("""
+            import jax
+
+            @partial(jax.jit, static_argnames=("n",))
+            def kernel(x, n):
+                return x[:n] if n > 4 else x
+        """)
+        assert fs == []
+
+    def test_nested_def_params_shadow_taint(self):
+        fs = lint("""
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                def helper(x):
+                    # this x is the helper's own (concrete) parameter
+                    return float(x)
+                return x
+        """)
+        assert fs == []
+
+    def test_jit_call_form_on_local_def(self):
+        fs = lint("""
+            import jax
+
+            def step(x):
+                if x > 0:
+                    return x
+                return -x
+
+            fn = jax.jit(step)
+        """)
+        assert rules_of(fs) == ["TRACER_BRANCH"]
+
+    def test_jit_of_shard_map_call_form(self):
+        fs = lint("""
+            import jax
+            from flink_tpu.utils.jaxcompat import shard_map
+
+            def shard(x):
+                return bool(x.sum())
+
+            fn = jax.jit(shard_map(shard, mesh=None, in_specs=(),
+                                   out_specs=()))
+        """)
+        assert rules_of(fs) == ["TRACER_HOST_CALL"]
+
+    def test_plain_function_is_not_a_kernel(self):
+        fs = lint("""
+            def host_side(x):
+                if x > 0:
+                    return float(x)
+                return x
+        """)
+        assert fs == []
+
+
+# -- registry drift ---------------------------------------------------------
+
+class TestRegistryDrift:
+    def test_unknown_fault_point_literal(self):
+        fs = lint("""
+            from flink_tpu import faults
+
+            def save():
+                faults.fire("checkpoint.storage.wrte")
+        """)
+        assert rules_of(fs) == ["FAULT_POINT_DRIFT"]
+        assert "checkpoint.storage.wrte" in fs[0].message
+
+    def test_known_fault_point_is_quiet(self):
+        fs = lint("""
+            from flink_tpu import faults
+
+            def save():
+                faults.fire("checkpoint.storage.write")
+        """)
+        assert fs == []
+
+    def test_undeclared_get_raw_key(self):
+        fs = lint("""
+            def f(config):
+                return config.get_raw("execution.checkpontng.interval")
+        """)
+        assert rules_of(fs) == ["CONFIG_KEY_DRIFT"]
+
+    def test_dynamic_prefix_key_is_declared(self):
+        fs = lint("""
+            def f(config):
+                return config.get_raw("test.n-batches", 6)
+        """)
+        assert fs == []
+
+    def test_configuration_dict_literal_keys(self):
+        fs = lint("""
+            from flink_tpu.config import Configuration
+
+            conf = Configuration({
+                "state.num-key-shards": 8,
+                "state.num-key-shrads": 8,
+            })
+        """)
+        assert rules_of(fs) == ["CONFIG_KEY_DRIFT"]
+        assert "shrads" in fs[0].message
+
+    def test_metric_name_grammar(self):
+        fs = lint("""
+            def register(group):
+                group.counter("checkpointCount")
+                group.counter("checkpoint_count")
+        """)
+        assert rules_of(fs) == ["METRIC_NAME_INVALID"]
+        assert fs[0].severity == "warn"
+
+
+class TestLintPaths:
+    def test_duplicate_option_declaration_across_files(self, tmp_path):
+        a = tmp_path / "a.py"
+        b = tmp_path / "b.py"
+        a.write_text('X = ConfigOption("dup.key", 1, "first")\n')
+        b.write_text('Y = ConfigOption("dup.key", 2, "second")\n')
+        fs = lint_paths([str(a), str(b)], root=str(tmp_path))
+        assert rules_of(fs) == ["CONFIG_OPTION_DUP"]
+        assert fs[0].file == "b.py"
+        assert "a.py:1" in fs[0].message
+
+    def test_walks_directories_and_skips_pycache(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        (pkg / "__pycache__").mkdir(parents=True)
+        (pkg / "__pycache__" / "junk.py").write_text("syntax error ][")
+        (pkg / "mod.py").write_text(
+            "import jax\n\n@jax.jit\ndef k(x):\n    return float(x)\n")
+        fs = lint_paths(["pkg"], root=str(tmp_path))
+        assert rules_of(fs) == ["TRACER_HOST_CALL"]
+        assert fs[0].file == "pkg/mod.py"
+
+    def test_nonexistent_path_fails_loudly(self, tmp_path):
+        # a typo'd CI path silently linting nothing would leave the
+        # drift gate green while checking nothing
+        with pytest.raises(ValueError, match="does not exist"):
+            lint_paths(["no/such/dir"], root=str(tmp_path))
+
+    def test_rule_table_covers_every_emitted_rule(self):
+        assert {r for r, _ in LINT_RULES} >= {
+            "TRACER_HOST_CALL", "TRACER_BRANCH", "FAULT_POINT_DRIFT",
+            "CONFIG_KEY_DRIFT", "CONFIG_OPTION_DUP",
+            "METRIC_NAME_INVALID"}
+
+    def test_default_paths_cover_the_shipped_surface(self):
+        assert "flink_tpu" in DEFAULT_LINT_PATHS
+        assert "bench.py" in DEFAULT_LINT_PATHS

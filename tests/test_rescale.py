@@ -41,6 +41,7 @@ def wait_until(pred, timeout=120.0, interval=0.2, what="condition"):
     raise AssertionError(f"timed out waiting for {what}")
 
 
+@pytest.mark.shard_map
 def test_live_rescale_exactly_once(tmp_path):
     import runner_job
 

@@ -13,6 +13,10 @@ from flink_tpu.exchange.keyby import bucket_by_destination, keyby_exchange
 from flink_tpu.ops.aggregates import count, max_of, multi, sum_of
 from flink_tpu.ops.window import WindowOperator
 from flink_tpu.parallel.mesh import AXIS, make_mesh_plan
+from flink_tpu.utils.jaxcompat import shard_map
+
+
+pytestmark = pytest.mark.shard_map  # device-mesh suite: skipped when shard_map is unavailable
 
 
 @pytest.fixture(scope="module")
@@ -63,7 +67,7 @@ class TestAllToAll:
             misrouted = jnp.sum(jnp.where(rv, ~ok, False))
             return jnp.sum(rv)[None], misrouted[None]
 
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             step, mesh=mesh_plan.mesh,
             in_specs=(P(AXIS), P(AXIS)),
             out_specs=(P(AXIS), P(AXIS))))

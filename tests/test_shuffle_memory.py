@@ -16,6 +16,7 @@ from flink_tpu.exchange.spi import (
 )
 from flink_tpu.memory import InsufficientMemoryError, MemoryBudget
 from flink_tpu.parallel.mesh import AXIS, make_mesh_plan
+from flink_tpu.utils.jaxcompat import shard_map
 
 
 def _run_shuffle(fn, n_dev=4, capacity=8, seed=0):
@@ -29,7 +30,7 @@ def _run_shuffle(fn, n_dev=4, capacity=8, seed=0):
     def shard(dest, valid, payload):
         return fn(dest, valid, payload, n_devices=n_dev, capacity=capacity)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         shard, mesh=mp.mesh,
         in_specs=(P(AXIS), P(AXIS), {"x": P(AXIS)}),
         out_specs=({"x": P(AXIS)}, P(AXIS), P(AXIS))))(
@@ -40,6 +41,7 @@ def _run_shuffle(fn, n_dev=4, capacity=8, seed=0):
             np.asarray(overflow), dest, valid, payload)
 
 
+@pytest.mark.shard_map
 class TestShuffleSpi:
     def test_ring_matches_all_to_all(self):
         """Both implementations must deliver the same multiset of

@@ -1,0 +1,21 @@
+#!/usr/bin/env python3
+"""Repo AST lints, runnable straight from a checkout::
+
+    python tools/lint.py [PATH ...] [--json]
+
+Thin wrapper over ``python -m flink_tpu lint`` (the rules live in
+flink_tpu/analysis/pylints.py) so CI and pre-commit hooks can invoke
+the linter without installing the package: it puts the repo root on
+sys.path itself. Exit status 1 when any finding fires — the shipped
+tree is kept at zero findings by the tier-1 dogfood gate
+(tests/test_analysis.py).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flink_tpu.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(["lint", *sys.argv[1:]]))
