@@ -302,11 +302,13 @@ def run_wordcount_log_fed(batch_size: int, n_batches: int) -> float:
         shutil.rmtree(root, ignore_errors=True)
 
 
-def run_sessions(batch_size: int, n_batches: int) -> float:
+def run_sessions(batch_size: int, n_batches: int,
+                 host_parallelism: "int | None" = None) -> float:
     """BASELINE.json config #4 shape: session-window clickstream
     aggregation with event time + allowed lateness (the Criteo-style
     workload: many users, bursty activity separated by gaps). Returns
-    events/sec."""
+    events/sec. ``host_parallelism`` pins host.parallelism for the
+    §9.4 thread-count sweep; None = the declared default."""
     from flink_tpu.api.environment import StreamExecutionEnvironment
     from flink_tpu.api.sources import GeneratorSource
     from flink_tpu.api.windowing import EventTimeSessionWindows
@@ -328,11 +330,14 @@ def run_sessions(batch_size: int, n_batches: int) -> float:
         ts = np.where(late, np.maximum(ts - 3000, 0), ts).astype(np.int64)
         return ({"user": user}, ts)
 
-    env = StreamExecutionEnvironment(Configuration({**BENCH_CONF,
-        "state.num-key-shards": 128, "state.slots-per-shard": 512,
-        "pipeline.microbatch-size": batch_size,
-        "pipeline.max-inflight-steps": 1,
-    }))
+    conf = {**BENCH_CONF,
+            "state.num-key-shards": 128, "state.slots-per-shard": 512,
+            "pipeline.microbatch-size": batch_size,
+            "pipeline.max-inflight-steps": 1,
+            }
+    if host_parallelism is not None:
+        conf["host.parallelism"] = host_parallelism
+    env = StreamExecutionEnvironment(Configuration(conf))
     n, sink = _counting_sink()
     (env.from_source(GeneratorSource(gen),
                      WatermarkStrategy.for_bounded_out_of_orderness(1000))
@@ -399,10 +404,34 @@ def suite() -> None:
     main()  # Q5 headline last (its line is the one the driver records)
 
 
+def host_parallelism_sweep(spec: str) -> None:
+    """`python bench.py --host-parallelism 1,2,4,8`: the §9.4
+    thread-count sweep on the sessions config (#4) — one JSON line per
+    worker count, same generator/batch shape as the suite's sessions
+    line. The PR-notes win claim is the ratio AT THE DECLARED DEFAULT
+    (min(4, os.cpu_count())), never the best point of the sweep."""
+    ws = [int(x) for x in spec.split(",") if x.strip()]
+    if not ws:
+        raise SystemExit("--host-parallelism needs a list, e.g. 1,2,4,8")
+    run_sessions(1 << 20, 4)  # warmup (shared compiled kernels)
+    for w in ws:
+        eps = run_sessions(1 << 20, 12, host_parallelism=w)
+        print(json.dumps({
+            "metric": "session_clickstream_events_per_sec",
+            "host_parallelism": w,
+            "value": round(eps), "unit": "events/sec/chip"}))
+
+
 if __name__ == "__main__":
     import sys
 
-    if "--suite" in sys.argv:
+    if "--host-parallelism" in sys.argv:
+        ix = sys.argv.index("--host-parallelism")
+        if ix + 1 >= len(sys.argv):
+            raise SystemExit("--host-parallelism needs a list, "
+                             "e.g. 1,2,4,8")
+        host_parallelism_sweep(sys.argv[ix + 1])
+    elif "--suite" in sys.argv:
         suite()
     else:
         main()

@@ -269,6 +269,38 @@ def config_key_unknown(plan, config) -> Iterable[Finding]:
                      "prefix, config.declare_dynamic_prefix)"))
 
 
+@config_rule("HOST_PARALLELISM_INVALID", "warn")
+def host_parallelism_invalid(plan, config) -> Iterable[Finding]:
+    """host.parallelism outside [1, os.cpu_count()]: below 1 the driver
+    cannot size the shared host pool and rejects the job at build;
+    above the core count the workers contend for cores instead of
+    scaling (the §9.4 contract sizes pools FROM os.cpu_count())."""
+    from flink_tpu.config import HostOptions
+
+    try:
+        w = int(config.get(HostOptions.PARALLELISM))
+    except (TypeError, ValueError):
+        yield _f(
+            "host.parallelism does not parse as an integer",
+            fix="set an integer >= 1 (1 = serial path; default "
+                "min(4, os.cpu_count()))")
+        return
+    ncpu = os.cpu_count() or 1
+    if w < 1:
+        yield _f(
+            f"host.parallelism={w} is below 1 — the shared host worker "
+            "pool cannot be sized and the driver rejects the job at "
+            "build",
+            fix="set host.parallelism >= 1 (1 = the exact serial path)")
+    elif w > ncpu:
+        yield _f(
+            f"host.parallelism={w} exceeds os.cpu_count()={ncpu} — "
+            "oversubscribed workers contend for cores instead of "
+            "scaling the host operator paths",
+            fix=f"set host.parallelism <= {ncpu} (default "
+                f"min(4, os.cpu_count()) = {min(4, ncpu)})")
+
+
 @config_rule("CHECKPOINT_IN_BATCH", "error")
 def checkpoint_in_batch(plan, config) -> Iterable[Finding]:
     """Bounded-mode recovery is re-execution: nothing checkpoints, so a

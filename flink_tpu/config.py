@@ -498,6 +498,29 @@ class ClusterOptions:
         "Delay between restarts for fixed-delay strategy.")
 
 
+class HostOptions:
+    PARALLELISM = ConfigOption(
+        "host.parallelism", min(4, os.cpu_count() or 1),
+        "Worker threads of the driver's shared host pool "
+        "(flink_tpu/parallel/hostpool.py) running the host-resident "
+        "operator paths: the key-sharded session span registry, the "
+        "pane-partitioned spill store, and the chunked windowAll fold "
+        "(PROFILE.md §9). 1 = the exact serial path (no pool threads; "
+        "keeps single-core benchmark numbers reproducible). Default "
+        "min(4, os.cpu_count()); the plan analyzer warns on values < 1 "
+        "or beyond os.cpu_count() (HOST_PARALLELISM_INVALID).")
+    FOLD_CHUNK_RECORDS = ConfigOption(
+        "host.fold-chunk-records", 1 << 18,
+        "Batch-size floor (and chunk size) of the host spill store's "
+        "tree-reduction fold: batches below it absorb in one pass "
+        "(pool dispatch overhead would exceed the fold, PROFILE.md "
+        "§9.2); at or above it the batch splits into chunks of this "
+        "many records whose pane partials combine in chunk order. The "
+        "chunk size is independent of host.parallelism, so the "
+        "reduction tree — and the output bytes — do not change with "
+        "the worker count.")
+
+
 class AnalysisOptions:
     FAIL_ON = ConfigOption(
         "analysis.fail-on", "error",

@@ -73,6 +73,11 @@ Instrumented points (the stack's recovery-critical seams):
         (the durable-log 2PC seams: torn segment append, lost fsync,
         pre-commit marker write, and the commit-marker rename — a
         raise there IS "crash between pre-commit and commit")
+    host.pool.task                                 parallel/hostpool.py
+        (the shared host worker-pool task-submit seam: a raise there is
+        a host-parallel operator pass dying mid-batch — the chaos gate
+        for the key-sharded session registry / pane-partitioned spill
+        store under host.parallelism > 1)
 """
 from __future__ import annotations
 
@@ -127,6 +132,7 @@ KNOWN_FAULT_POINTS = frozenset((
     "log.segment.fsync",
     "log.txn.marker",
     "log.txn.commit",
+    "host.pool.task",
 ))
 
 # process-global fault/recovery metrics — chaos tests assert every

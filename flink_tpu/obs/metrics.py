@@ -33,12 +33,20 @@ METRICS_BIND = ConfigOption(
     "the control-plane RpcServer posture) — set 0.0.0.0 to expose.")
 
 
+# The primitives' WRITE paths are lock-guarded: host-pool worker
+# threads (parallel/hostpool.py), the drain thread, and the scrape
+# thread all hit one registry, and `self._v += n` / reservoir writes
+# are read-modify-write races without it. Reads stay lock-free — a
+# scrape observing a value one update stale is fine; losing updates
+# is not.
 class Counter:
     def __init__(self) -> None:
         self._v = 0
+        self._lock = threading.Lock()
 
     def inc(self, n: int = 1) -> None:
-        self._v += n
+        with self._lock:
+            self._v += n
 
     @property
     def value(self) -> int:
@@ -49,9 +57,11 @@ class Gauge:
     def __init__(self, fn: Optional[Callable[[], float]] = None) -> None:
         self._fn = fn
         self._v = 0.0
+        self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
-        self._v = v
+        with self._lock:
+            self._v = v
 
     @property
     def value(self) -> float:
@@ -65,10 +75,12 @@ class Histogram:
     def __init__(self, size: int = 1024) -> None:
         self._buf = np.zeros(size, np.float64)
         self._n = 0
+        self._lock = threading.Lock()
 
     def update(self, v: float) -> None:
-        self._buf[self._n % len(self._buf)] = v
-        self._n += 1
+        with self._lock:
+            self._buf[self._n % len(self._buf)] = v
+            self._n += 1
 
     def _samples(self) -> np.ndarray:
         return self._buf[: min(self._n, len(self._buf))]
@@ -102,20 +114,23 @@ class Meter:
 
     def __init__(self) -> None:
         self._events: List[Tuple[float, int]] = []
+        self._lock = threading.Lock()
 
     def mark(self, n: int = 1) -> None:
         now = time.time()
-        self._events.append((now, n))
-        cut = now - 60
-        while self._events and self._events[0][0] < cut:
-            self._events.pop(0)
+        with self._lock:
+            self._events.append((now, n))
+            cut = now - 60
+            while self._events and self._events[0][0] < cut:
+                self._events.pop(0)
 
     @property
     def rate(self) -> float:
-        if not self._events:
-            return 0.0
-        span = max(time.time() - self._events[0][0], 1e-9)
-        return sum(n for _, n in self._events) / span
+        with self._lock:  # a concurrent mark() pops the head this reads
+            if not self._events:
+                return 0.0
+            span = max(time.time() - self._events[0][0], 1e-9)
+            return sum(n for _, n in self._events) / span
 
 
 class MetricGroup:

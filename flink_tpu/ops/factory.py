@@ -42,6 +42,12 @@ class OperatorBuildContext:
     # cross-host jobs: this process's contiguous key-shard span (the
     # key-group range of its "subtask"); None = whole shard space
     shard_range: Optional[Any] = None
+    # the driver's shared host worker pool (parallel/hostpool.py) for
+    # host-resident operator paths; None = serial
+    host_pool: Optional[Any] = None
+    # host.fold-chunk-records, the spill store's tree-fold batch floor;
+    # None = the declared config default
+    fold_chunk_records: Optional[int] = None
 
 
 OperatorFactory = Callable[[Any, OperatorBuildContext], Any]
@@ -80,6 +86,8 @@ def _window_factory(node, ctx: OperatorBuildContext):
         exchange_capacity=ctx.exchange_capacity,
         spill=(ctx.backend == "spill"),
         exchange_impl=ctx.exchange_impl,
+        host_pool=ctx.host_pool,
+        fold_chunk_records=ctx.fold_chunk_records,
     )
     op.max_inflight_steps = ctx.max_inflight_steps
     # backpressure blocks happen OUTSIDE the push lock (the ingest loop

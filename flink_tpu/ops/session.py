@@ -21,10 +21,18 @@ static pane layout, so the decomposition is:
   Python list of dataclasses per key and died at exactly that scale).
 - fired sessions stay in the registry until allowed lateness expires so
   late records re-open/merge and re-fire (late firing semantics).
+- the registry is **key-sharded onto the host pool** (PROFILE.md §9.1):
+  under ``host.parallelism = W > 1`` it splits into W independent span
+  stores (``key % W`` — the key-group discipline), and the per-shard
+  merge/fire/expiry passes run as pool tasks. Sessions never merge
+  across keys, so no cross-shard invariant exists; fired shards'
+  rows re-sort by (key, start) so output bytes match the serial path
+  exactly (the §9 determinism contract). ``host.parallelism = 1`` IS
+  the serial path: one store, no partitioning, no pool threads.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -116,6 +124,7 @@ class SessionOperator:
         num_shards: int = 128,
         slots_per_shard: int = 1024,
         max_out_of_orderness_ms: int = 0,
+        host_pool: Optional[Any] = None,
     ) -> None:
         if gap_ms <= 0:
             raise ValueError("session gap must be positive")
@@ -125,7 +134,14 @@ class SessionOperator:
         self.watermark = LONG_MIN
         self.late_records = 0
         self.state_version = 0
-        self._store = _SpanStore(agg.sum_width, agg.max_width, agg.min_width)
+        # key-sharded registry (PROFILE §9.1): W independent stores at
+        # host.parallelism = W; exactly one (the serial path) at W = 1
+        self._pool = (host_pool if host_pool is not None
+                      and host_pool.parallelism > 1 else None)
+        n_shards = self._pool.parallelism if self._pool is not None else 1
+        self._shards: List[_SpanStore] = [
+            _SpanStore(agg.sum_width, agg.max_width, agg.min_width)
+            for _ in range(n_shards)]
         self._has_refire = False
 
     # -- ingest ----------------------------------------------------------
@@ -134,7 +150,32 @@ class SessionOperator:
         keys = np.asarray(keys, np.int64)
         ts = np.asarray(ts, np.int64)
         valid = np.ones(len(ts), bool) if valid is None else np.asarray(valid, bool)
+        if self._pool is None:
+            self.late_records += self._process_shard(
+                self._shards[0], keys, ts, data, valid)
+            return
+        # partition by key shard; per-key work is identical to serial
+        # (no session logic crosses keys), so per-shard passes compose
+        # to the exact serial result
+        n_shards = len(self._shards)
+        shard = keys % n_shards
+        data = {k: np.asarray(v) for k, v in data.items()}
+        tasks = []
+        for w in range(n_shards):
+            m = shard == w
+            if not bool(m.any()):
+                continue
+            tasks.append(lambda st=self._shards[w], m=m: self._process_shard(
+                st, keys[m], ts[m],
+                {k: v[m] for k, v in data.items()}, valid[m]))
+        self.late_records += sum(self._pool.run_tasks(tasks))
 
+    def _process_shard(self, st: _SpanStore, keys, ts,
+                       data: Dict[str, np.ndarray], valid) -> int:
+        """Full ingest pass for one shard's records against its store;
+        returns the shard's beyond-lateness drop count. At
+        host.parallelism=1 this IS the whole batch — the serial path."""
+        late_count = 0
         # drop beyond-lateness records (side output accounting): a record
         # is late iff its singleton session is dead AND it cannot merge
         # into any retained span (the reference checks isWindowLate on
@@ -150,7 +191,6 @@ class SessionOperator:
                 # ONLY span a record t can merge with is the rightmost
                 # one with start <= t + gap — one searchsorted over the
                 # candidate keys' span subset finds it.
-                st = self._store
                 uk = np.unique(keys[cand])
                 rows = st.rows_for(uk)
                 if len(rows):
@@ -182,10 +222,10 @@ class SessionOperator:
                                     (st.start[a:b] <= t + self.gap)
                                     & (t <= st.last[a:b] + self.gap))):
                                 late[i] = False
-            self.late_records += int(late.sum())
+            late_count = int(late.sum())
             valid = valid & ~late
         if not valid.any():
-            return
+            return late_count
         keys = keys[valid]
         ts = ts[valid]
         data = {k: np.asarray(v)[valid] for k, v in data.items()}
@@ -225,9 +265,10 @@ class SessionOperator:
                    if mn_l.shape[1] else np.zeros((G, 0), np.float32))
         seg_ends = np.append(seg_starts[1:], len(sk))
         self._merge_segments(
-            sk[seg_starts], st_[seg_starts], st_[seg_ends - 1],
+            st, sk[seg_starts], st_[seg_starts], st_[seg_ends - 1],
             seg_sum, seg_max, seg_min,
             (seg_ends - seg_starts).astype(np.int64))
+        return late_count
 
     def _host_lift(self, data, valid) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Run the aggregate's lift on the host CPU backend (session lane
@@ -243,13 +284,13 @@ class SessionOperator:
                 {k: jnp.asarray(v) for k, v in data.items()}, jnp.asarray(valid))
             return np.asarray(s), np.asarray(mx), np.asarray(mn)
 
-    def _merge_segments(self, seg_key, seg_tmin, seg_tmax,
+    def _merge_segments(self, st: _SpanStore, seg_key, seg_tmin, seg_tmax,
                         seg_sum, seg_max, seg_min, seg_count) -> None:
-        """Merge batch segments into the registry — the MergingWindowSet
-        role, fully vectorized: pull every touched key's spans, run one
-        interval-union scan over (touched ∪ new) sorted by (key, start),
-        combine groups with reduceat, splice the results back."""
-        st = self._store
+        """Merge batch segments into shard registry ``st`` — the
+        MergingWindowSet role, fully vectorized: pull every touched
+        key's spans, run one interval-union scan over (touched ∪ new)
+        sorted by (key, start), combine groups with reduceat, splice
+        the results back."""
         gap = self.gap
         uk, first = np.unique(seg_key, return_index=True)
         touched_idx = st.rows_for(uk)
@@ -357,9 +398,33 @@ class SessionOperator:
         self.state_version += 1
         self.watermark = max(self.watermark, wm)
         self._has_refire = False
-        st = self._store
-        if not len(st):
+        if self._pool is None:
+            rows = self._advance_shard(self._shards[0])
+        else:
+            # per-shard fire/expiry on the pool; shard rows re-sort by
+            # (key, start) — the serial store's emit order — so output
+            # bytes are independent of the shard count
+            parts = [r for r in self._pool.run_tasks(
+                [lambda st=st: self._advance_shard(st)
+                 for st in self._shards]) if r is not None]
+            if not parts:
+                rows = None
+            elif len(parts) == 1:
+                rows = parts[0]
+            else:
+                cat = {k: np.concatenate([p[k] for p in parts])
+                       for k in parts[0]}
+                order = np.lexsort((cat["window_start"], cat["key"]))
+                rows = {k: v[order] for k, v in cat.items()}
+        if rows is None:
             return FiredWindows(data=self._empty())
+        return FiredWindows(data=rows)
+
+    def _advance_shard(self, st: _SpanStore) -> Optional[Dict[str, np.ndarray]]:
+        """Fire + expiry pass for one shard at the current watermark;
+        returns the shard's emitted rows (store order: (key, start))."""
+        if not len(st):
+            return None
         end1 = st.last + self.gap - 1
         complete = end1 <= self.watermark
         emit = complete & (~st.fired | st.refire)
@@ -370,9 +435,7 @@ class SessionOperator:
         dead = end1 + self.lateness <= self.watermark
         if dead.any():
             st._filter(~dead)
-        if rows is None:
-            return FiredWindows(data=self._empty())
-        return FiredWindows(data=rows)
+        return rows
 
     def _emit(self, cols: Tuple[np.ndarray, ...]) -> Dict[str, np.ndarray]:
         import jax
@@ -410,17 +473,30 @@ class SessionOperator:
         return dict(self._empty_cache)
 
     def final_watermark(self) -> int:
-        if not len(self._store):
+        lasts = [int(st.last.max()) for st in self._shards if len(st)]
+        if not lasts:
             return self.watermark if self.watermark != LONG_MIN else 0
-        return int(self._store.last.max()) + self.gap + self.lateness + 1
+        return max(lasts) + self.gap + self.lateness + 1
 
     # -- snapshot --------------------------------------------------------
+    def _merged_columns(self) -> Dict[str, np.ndarray]:
+        """The registry's columns as ONE (key, start)-sorted block — the
+        checkpoint format is shard-count-independent, so snapshots move
+        freely across host.parallelism settings (and stay byte-stable
+        for the incremental-checkpoint reuse check)."""
+        if len(self._shards) == 1:
+            st = self._shards[0]
+            return {c: getattr(st, c).copy() for c in st._COLS}
+        cols = {c: np.concatenate([getattr(st, c) for st in self._shards])
+                for c in _SpanStore._COLS}
+        order = np.lexsort((cols["start"], cols["key"]))
+        return {c: v[order] for c, v in cols.items()}
+
     def snapshot_state(self) -> Dict[str, Any]:
-        st = self._store
         return {
             "watermark": self.watermark,
             "late_records": self.late_records,
-            "columns": {c: getattr(st, c).copy() for c in st._COLS},
+            "columns": self._merged_columns(),
         }
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
@@ -450,5 +526,24 @@ class SessionOperator:
                 st.count = np.array([r[6] for r in rows], np.int64)
                 st.fired = np.array([r[7] for r in rows], bool)
                 st.refire = np.array([r[8] for r in rows], bool)
-        self._store = st
+        self._install_store(st)
         self._has_refire = bool(st.refire.any())
+
+    def _install_store(self, st: _SpanStore) -> None:
+        """Adopt a merged (key, start)-sorted store, re-sharding it to
+        this operator's parallelism (restore is shard-count-agnostic:
+        a snapshot taken at W=1 restores into W=4 and vice versa)."""
+        n_shards = len(self._shards)
+        if n_shards == 1:
+            self._shards = [st]
+            return
+        shards = []
+        sh = st.key % n_shards
+        for w in range(n_shards):
+            part = _SpanStore(self.agg.sum_width, self.agg.max_width,
+                              self.agg.min_width)
+            m = sh == w
+            for c in st._COLS:  # mask keeps (key, start) order per shard
+                setattr(part, c, getattr(st, c)[m])
+            shards.append(part)
+        self._shards = shards

@@ -1063,6 +1063,8 @@ class WindowOperator:
         top_n: Optional[Tuple[str, int]] = None,
         spill: bool = False,
         exchange_impl: str = "all-to-all",
+        host_pool: Optional[Any] = None,
+        fold_chunk_records: Optional[int] = None,
     ) -> None:
         self.assigner = assigner
         self.agg = agg
@@ -1140,8 +1142,12 @@ class WindowOperator:
         # _resolve_overflow) — never block the pipeline per batch
         self._overflow_markers = collections.deque()
         # state.backend='spill': keys past HBM capacity aggregate on the
-        # host (exact, slower) instead of dropping with a counter
-        self._spill = HostSpillStore(agg) if spill else None
+        # host (exact, slower) instead of dropping with a counter; the
+        # shared host pool parallelizes its per-pane merges and
+        # per-window fires (PROFILE §9.3)
+        self._spill = (HostSpillStore(
+            agg, pool=host_pool, fold_chunk_records=fold_chunk_records)
+            if spill else None)
         # top-n + spill: host rows can't ride per-fire markers because
         # device rows flow through the SHARED emit ring (a coalesced
         # drain would re-rank against the wrong fires). They queue here

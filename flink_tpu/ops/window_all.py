@@ -47,13 +47,20 @@ class WindowAllOperator:
         *,
         allowed_lateness_ms: int = 0,
         max_out_of_orderness_ms: int = 0,
+        host_pool: Optional[Any] = None,
+        fold_chunk_records: Optional[int] = None,
     ) -> None:
         self.agg = agg
         self.plan = WindowPlan.plan(
             assigner,
             allowed_lateness_ms=allowed_lateness_ms,
             max_out_of_orderness_ms=max_out_of_orderness_ms)
-        self.store = HostSpillStore(agg)
+        # the global fold is ONE logical key, so key-sharding cannot
+        # apply; scaling is the store's chunked tree fold over batch
+        # slices + per-window parallel fires (PROFILE §9.2), gated on
+        # the fold_chunk_records batch floor
+        self.store = HostSpillStore(agg, pool=host_pool,
+                                    fold_chunk_records=fold_chunk_records)
         self.ctl = HostPaneControl(self.plan)
         self.state_version = 0
         self._empty_cache: Optional[Dict[str, np.ndarray]] = None

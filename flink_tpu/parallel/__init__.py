@@ -1,3 +1,4 @@
+from flink_tpu.parallel.hostpool import HostPool
 from flink_tpu.parallel.mesh import MeshPlan, make_mesh_plan, AXIS
 
-__all__ = ["MeshPlan", "make_mesh_plan", "AXIS"]
+__all__ = ["HostPool", "MeshPlan", "make_mesh_plan", "AXIS"]

@@ -223,6 +223,19 @@ class Driver:
             pid = int(self.config.get(ClusterOptions.PROCESS_ID))
             spp = num_shards // nproc
             shard_range = (pid * spp, (pid + 1) * spp)
+        # ONE shared host worker pool per driver (PROFILE §9, flink_tpu/
+        # parallel/hostpool.py): sized by host.parallelism, handed to
+        # every operator with host-resident parallel work; parallelism 1
+        # creates no threads and keeps the exact serial paths
+        from flink_tpu.config import HostOptions
+        from flink_tpu.parallel.hostpool import HostPool
+
+        self.host_pool = HostPool.from_config(self.config,
+                                              registry=self.registry)
+        fold_chunk = int(self.config.get(HostOptions.FOLD_CHUNK_RECORDS))
+        if fold_chunk < 1:
+            raise ValueError(
+                f"host.fold-chunk-records must be >= 1, got {fold_chunk}")
         ctx = OperatorBuildContext(
             config=self.config, mesh_plan=self.mesh_plan,
             num_shards=num_shards, slots_per_shard=slots,
@@ -231,6 +244,8 @@ class Driver:
             exchange_impl=self.config.get(ClusterOptions.EXCHANGE_IMPL),
             max_out_of_orderness_ms=wm.max_out_of_orderness_ms,
             shard_range=shard_range,
+            host_pool=self.host_pool,
+            fold_chunk_records=fold_chunk,
         )
         allow_drops = bool(self.config.get(StateOptions.ALLOW_DROPS))
         for n in self.plan.nodes.values():
@@ -267,7 +282,9 @@ class Driver:
                 self._ops[n.id] = WindowAllOperator(
                     t.assigner, t.aggregate,
                     allowed_lateness_ms=t.allowed_lateness_ms,
-                    max_out_of_orderness_ms=max(wm.max_out_of_orderness_ms, 0))
+                    max_out_of_orderness_ms=max(wm.max_out_of_orderness_ms, 0),
+                    host_pool=self.host_pool,
+                    fold_chunk_records=fold_chunk)
             elif n.kind == "count_window":
                 from flink_tpu.ops.count_window import CountWindowOperator
 
@@ -299,6 +316,7 @@ class Driver:
                     allowed_lateness_ms=t.allowed_lateness_ms,
                     num_shards=num_shards, slots_per_shard=slots,
                     max_out_of_orderness_ms=max(wm.max_out_of_orderness_ms, 0),
+                    host_pool=self.host_pool,
                 )
             elif n.kind == "evicting_window":
                 from flink_tpu.ops.evicting_window import (
@@ -1103,6 +1121,10 @@ class Driver:
                 # writing; letting it finish is safe (manifest-last)
                 self._ckpt_executor.shutdown(wait=False)
                 self._ckpt_executor = None
+            # the shared host pool dies with the run (a wedged task
+            # must not hang teardown: shutdown is non-waiting, and a
+            # post-close straggler call degrades to the inline path)
+            self.host_pool.close()
 
     def _run_loop(self, job_name: str, drain, interval_ms: int,
                   restore) -> "JobResult":

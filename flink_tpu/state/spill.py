@@ -22,9 +22,24 @@ v1 keeps placement static.)
 Fire/refire/purge mirror the device path exactly: the operator passes
 the SAME fired-ends list (including re-fires of late-within-lateness
 data) to both stores, and purges both at the same lateness horizon.
+
+Host-parallel plane (PROFILE.md §9.2/§9.3): given a ``HostPool`` the
+store runs its independent units as pool tasks — per-pane merges in
+``absorb`` (absorb already buckets by pane and ``_merge_pane`` touches
+only that pane's table), per-window combines in ``fire`` (windows own
+disjoint pane ranges), and above the ``host.fold-chunk-records`` batch
+floor a chunked TREE fold: chunks group independently, pane partials
+combine in chunk order (the windowAll scaling shape — one global key,
+so key-sharding cannot apply). The pane→table dict's serial point is
+guarded by one lock PER PANE ENTRY, not a global lock. Chunk size is
+independent of the worker count, so the reduction tree — and the
+output bytes for the exact lane monoids — never change with
+``host.parallelism``; pool absent or parallelism 1 is the exact
+serial path.
 """
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -51,15 +66,44 @@ class HostSpillStore:
     (the round-2 session-registry mistake, not repeated here).
     """
 
-    def __init__(self, agg):  # duck-typed LaneAggregate (ops.aggregates)
+    def __init__(self, agg, *, pool=None,
+                 fold_chunk_records: Optional[int] = None):
         # NOTE: deliberately untyped — the state layer sits BELOW ops in
         # the layer map (tests/test_architecture.py) and only needs the
-        # lane contract: sum/max/min_width, lift_masked, finalize
+        # lane contract: sum/max/min_width, lift_masked, finalize.
+        # ``pool`` is equally duck-typed (parallel.hostpool.HostPool):
+        # .parallelism + .run_tasks(fns) — None or parallelism 1 keeps
+        # the exact serial path.
         self.agg = agg
         self.panes: Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray,
                                     np.ndarray, np.ndarray]] = {}
         self.records_spilled = 0
         self._cpu = _cpu_device()
+        self._pool = (pool if pool is not None
+                      and pool.parallelism > 1 else None)
+        if fold_chunk_records is None:
+            # None = the declared config default — the floor is
+            # single-sourced at HostOptions.FOLD_CHUNK_RECORDS so a
+            # retune there reaches directly-constructed stores too
+            from flink_tpu.config import HostOptions
+            fold_chunk_records = HostOptions.FOLD_CHUNK_RECORDS.default
+        self.fold_chunk_records = int(fold_chunk_records)
+        # one lock PER PANE entry (§9.3), never a global lock. Within
+        # one run_tasks batch every pane has at most one merge task
+        # (absorb's spans are pane-contiguous; the tree fold combines
+        # all of a pane's chunk partials inside a single task), and
+        # the operator's absorb/fire entry points run sequentially on
+        # the driver loop today — the locks are the pane tables'
+        # read-modify-write guard for any caller that DOES overlap
+        # absorb batches, so the store's safety never depends on that
+        # entry discipline. Fire-side reads stay lock-free:
+        # _merge_pane replaces a pane's tuple atomically.
+        self._pane_locks: Dict[int, threading.Lock] = {}
+        self._locks_guard = threading.Lock()
+
+    def _pane_lock(self, pane: int) -> threading.Lock:
+        with self._locks_guard:
+            return self._pane_locks.setdefault(pane, threading.Lock())
 
     # -- ingest ----------------------------------------------------------
 
@@ -85,9 +129,18 @@ class HostSpillStore:
         if n == 0:
             return
         self.records_spilled += n
-        sums, maxs, mins = self._lift(data, n)
+        if self._pool is not None and n >= self.fold_chunk_records:
+            self._absorb_tree(keys, panes, data)
+            return
+        groups = self._group_batch(keys, panes, data)
+        self._splice_groups(*groups)
 
-        # group by (pane, key): lexsort + boundary flags + segment reduce
+    def _group_batch(self, keys: np.ndarray, panes: np.ndarray,
+                     data: Dict[str, np.ndarray]) -> Tuple[np.ndarray, ...]:
+        """One vectorized (pane, key) grouping pass: lexsort + boundary
+        flags + segment reduce. Returns pane-contiguous group arrays."""
+        n = len(keys)
+        sums, maxs, mins = self._lift(data, n)
         o = np.lexsort((keys, panes))
         pk, kk = panes[o], keys[o]
         new_grp = np.empty(n, bool)
@@ -103,16 +156,67 @@ class HostSpillStore:
         g_min = np.full((G, m), _POS_INF, np.float32)
         np.minimum.at(g_min, gid, mins[o])
         g_cnt = np.bincount(gid, minlength=G).astype(np.int64)
-        g_pane = pk[new_grp]
-        g_key = kk[new_grp]
+        return pk[new_grp], kk[new_grp], g_sum, g_max, g_min, g_cnt
 
-        # splice each touched pane (few per batch — event-time locality)
+    @staticmethod
+    def _pane_spans(g_pane: np.ndarray) -> List[Tuple[int, int]]:
         bounds = np.flatnonzero(
             np.concatenate([[True], g_pane[1:] != g_pane[:-1], [True]]))
-        for i in range(len(bounds) - 1):
-            a, b = bounds[i], bounds[i + 1]
-            self._merge_pane(int(g_pane[a]), g_key[a:b], g_sum[a:b],
-                             g_max[a:b], g_min[a:b], g_cnt[a:b])
+        return [(int(bounds[i]), int(bounds[i + 1]))
+                for i in range(len(bounds) - 1)]
+
+    def _splice_groups(self, g_pane, g_key, g_sum, g_max, g_min,
+                       g_cnt) -> None:
+        """Splice each touched pane (few per batch — event-time
+        locality); independent per pane, so with a pool the merges run
+        as parallel tasks under their pane locks (§9.3)."""
+        spans = self._pane_spans(g_pane)
+
+        def merge(a: int, b: int) -> None:
+            pane = int(g_pane[a])
+            with self._pane_lock(pane):
+                self._merge_pane(pane, g_key[a:b], g_sum[a:b],
+                                 g_max[a:b], g_min[a:b], g_cnt[a:b])
+
+        if self._pool is not None and len(spans) > 1:
+            self._pool.run_tasks(
+                [lambda a=a, b=b: merge(a, b) for a, b in spans])
+        else:
+            for a, b in spans:
+                merge(a, b)
+
+    def _absorb_tree(self, keys: np.ndarray, panes: np.ndarray,
+                     data: Dict[str, np.ndarray]) -> None:
+        """Chunked tree fold (§9.2, the windowAll scaling shape): group
+        fixed-size chunks on the pool, then combine each pane's chunk
+        partials IN CHUNK ORDER. The chunk size is a config constant
+        (never derived from the worker count), so the reduction tree is
+        identical at every host.parallelism > 1."""
+        n = len(keys)
+        chunk = self.fold_chunk_records
+        data = {k: np.asarray(v) for k, v in data.items()}
+        spans = [(lo, min(lo + chunk, n)) for lo in range(0, n, chunk)]
+        parts = self._pool.run_tasks(
+            [lambda lo=lo, hi=hi: self._group_batch(
+                keys[lo:hi], panes[lo:hi],
+                {k: v[lo:hi] for k, v in data.items()})
+             for lo, hi in spans])
+        # pane → its chunk partials, insertion-ordered by chunk index
+        per_pane: Dict[int, List[Tuple[np.ndarray, ...]]] = {}
+        for g_pane, g_key, g_sum, g_max, g_min, g_cnt in parts:
+            for a, b in self._pane_spans(g_pane):
+                per_pane.setdefault(int(g_pane[a]), []).append(
+                    (g_key[a:b], g_sum[a:b], g_max[a:b], g_min[a:b],
+                     g_cnt[a:b]))
+
+        def combine(pane: int, pieces) -> None:
+            with self._pane_lock(pane):
+                for piece in pieces:  # chunk order: deterministic tree
+                    self._merge_pane(pane, *piece)
+
+        self._pool.run_tasks(
+            [lambda p=p, pcs=pcs: combine(p, pcs)
+             for p, pcs in per_pane.items()])
 
     def _merge_pane(self, pane: int, keys, sums, maxs, mins, counts) -> None:
         cur = self.panes.get(pane)
@@ -156,43 +260,25 @@ class HostSpillStore:
         live = [e for e in ends if e > lo_stored and e - ppw <= hi_stored]
         if not live:
             return None
-        S, M, m = self.agg.sum_width, self.agg.max_width, self.agg.min_width
+        # windows own disjoint pane ranges' COMBINE work (reads only),
+        # so per-window fires are independent pool tasks (§9.3);
+        # results assemble in the fired-ends order either way
+        if self._pool is not None and len(live) > 1:
+            fired = self._pool.run_tasks(
+                [lambda e=e: self._fire_window(e, ppw) for e in live])
+        else:
+            fired = [self._fire_window(e, ppw) for e in live]
         keys_out: List[np.ndarray] = []
         ends_out: List[np.ndarray] = []
         cnt_out: List[np.ndarray] = []
         res_cols: Dict[str, List[np.ndarray]] = {}
-        for e in live:
-            span = [self.panes[p] for p in range(e - ppw, e)
-                    if p in self.panes]
-            if not span:
+        for hit in fired:
+            if hit is None:
                 continue
-            union = span[0][0] if len(span) == 1 else np.unique(
-                np.concatenate([s[0] for s in span]))
-            K = len(union)
-            ws = np.zeros((K, S), np.float32)
-            wx = np.full((K, M), _NEG_INF, np.float32)
-            wn = np.full((K, m), _POS_INF, np.float32)
-            wc = np.zeros(K, np.int64)
-            for ck, cs, cx, cn, cc in span:
-                pos = np.searchsorted(union, ck)
-                ws[pos] += cs
-                wx[pos] = np.maximum(wx[pos], cx)
-                wn[pos] = np.minimum(wn[pos], cn)
-                wc[pos] += cc
-            has = wc > 0
-            if not has.any():
-                continue
-            if self._cpu is not None:
-                with jax.default_device(self._cpu):
-                    res = self.agg.finalize(ws[has], wx[has], wn[has],
-                                            wc[has].astype(np.int32))
-            else:
-                res = self.agg.finalize(ws[has], wx[has], wn[has],
-                                        wc[has].astype(np.int32))
-            kk = union[has]
+            e, kk, wc_has, res = hit
             keys_out.append(kk)
             ends_out.append(np.full(len(kk), e, np.int64))
-            cnt_out.append(wc[has])
+            cnt_out.append(wc_has)
             for f, v in res.items():
                 if f == "count":
                     continue  # the exact element count wins (mirrors
@@ -212,11 +298,49 @@ class HostSpillStore:
             out[f] = np.concatenate(cols)
         return out
 
+    def _fire_window(self, e: int, ppw: int
+                     ) -> Optional[Tuple[int, np.ndarray, np.ndarray, Dict]]:
+        """Combine one window's panes with the same monoid ops the
+        device kernel uses; returns (end_pane, keys, counts, finalize
+        fields) or None when the window holds nothing."""
+        S, M, m = self.agg.sum_width, self.agg.max_width, self.agg.min_width
+        span = [self.panes[p] for p in range(e - ppw, e)
+                if p in self.panes]
+        if not span:
+            return None
+        union = span[0][0] if len(span) == 1 else np.unique(
+            np.concatenate([s[0] for s in span]))
+        K = len(union)
+        ws = np.zeros((K, S), np.float32)
+        wx = np.full((K, M), _NEG_INF, np.float32)
+        wn = np.full((K, m), _POS_INF, np.float32)
+        wc = np.zeros(K, np.int64)
+        for ck, cs, cx, cn, cc in span:
+            pos = np.searchsorted(union, ck)
+            ws[pos] += cs
+            wx[pos] = np.maximum(wx[pos], cx)
+            wn[pos] = np.minimum(wn[pos], cn)
+            wc[pos] += cc
+        has = wc > 0
+        if not has.any():
+            return None
+        if self._cpu is not None:
+            with jax.default_device(self._cpu):
+                res = self.agg.finalize(ws[has], wx[has], wn[has],
+                                        wc[has].astype(np.int32))
+        else:
+            res = self.agg.finalize(ws[has], wx[has], wn[has],
+                                    wc[has].astype(np.int32))
+        return e, union[has], wc[has], res
+
     # -- lifecycle -------------------------------------------------------
 
     def purge_below(self, dead_pane: int) -> None:
         for p in [p for p in self.panes if p < dead_pane]:
             del self.panes[p]
+        with self._locks_guard:  # locks track live panes, never grow
+            for p in [p for p in self._pane_locks if p < dead_pane]:
+                del self._pane_locks[p]
 
     def bytes_used(self) -> int:
         """Host memory held by spilled panes (memory.host_spill_bytes).

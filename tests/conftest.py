@@ -72,3 +72,8 @@ def pytest_configure(config):
         "markers", "analysis: static-analysis suite (flink_tpu/analysis"
         "/) — plan-analyzer rules, repo AST lints, and the dogfood gate "
         "that keeps the shipped tree at zero findings (tier-1)")
+    config.addinivalue_line(
+        "markers", "hostpool: shared host worker-pool plane (flink_tpu/"
+        "parallel/hostpool.py) — pool unit tests and the serial-vs-"
+        "parallel byte-identical parity gates on the sessions, "
+        "windowAll, and spill golden pipelines (tier-1)")

@@ -148,6 +148,15 @@ def _config_key_typo(tmp_path):
     return env.analyze()
 
 
+@seed("HOST_PARALLELISM_INVALID")
+def _host_parallelism_invalid(tmp_path):
+    # below 1: the driver rejects it at build; the analyzer must flag
+    # it at submit (oversubscription past os.cpu_count() warns too,
+    # but is machine-dependent — the < 1 case seeds deterministically)
+    env = clean_pipeline({"host.parallelism": 0})
+    return env.analyze()
+
+
 @seed("CHECKPOINT_IN_BATCH")
 def _checkpoint_in_batch(tmp_path):
     # config-only rule: no pipeline needed

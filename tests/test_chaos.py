@@ -31,7 +31,10 @@ from flink_tpu import faults
 from flink_tpu.api.environment import StreamExecutionEnvironment
 from flink_tpu.api.sinks import TransactionalCollectSink
 from flink_tpu.api.sources import GeneratorSource
-from flink_tpu.api.windowing import TumblingEventTimeWindows
+from flink_tpu.api.windowing import (
+    EventTimeSessionWindows,
+    TumblingEventTimeWindows,
+)
 from flink_tpu.config import Configuration
 from flink_tpu.obs.tracing import tracer
 from flink_tpu.runtime.supervisor import run_with_recovery
@@ -643,3 +646,187 @@ class TestChaosSoak:
             assert len(fault_spans) == len(plan.log)
             fatal = sum(1 for x in plan.log if x[1] == "raise")
             assert len(recoveries) == fatal
+
+
+class TestHostPoolChaos:
+    """The §9.4 correctness gate: the sessions and spill-overflow
+    pipelines recover EXACTLY-ONCE with the shared host pool ON
+    (host.parallelism=4) and the ``host.pool.task`` submit seam armed —
+    a worker-pool pass dying mid-batch must never corrupt committed
+    output. Goldens run FAULT-FREE AT host.parallelism=1, so each
+    assertion covers both the recovery contract and the serial-vs-
+    parallel determinism contract at once."""
+
+    N_BATCHES = 8
+    POOL_CONF = {"host.parallelism": 4}
+
+    # -- sessions ---------------------------------------------------------
+
+    @staticmethod
+    def sessions_source(n_batches, batch=256, n_users=30):
+        def gen(split, i):
+            if i >= n_batches:
+                return None
+            rng = np.random.default_rng(500 + 1000 * int(split) + i)
+            user = rng.integers(0, n_users, batch).astype(np.int64)
+            ts = (i * 400 + rng.integers(0, 600, batch)).astype(np.int64)
+            return {"u": user}, ts
+        return gen
+
+    def _sessions_builder(self, sink):
+        def build_env(conf):
+            env = StreamExecutionEnvironment(conf)
+            (env.from_source(
+                GeneratorSource(self.sessions_source(self.N_BATCHES)),
+                WatermarkStrategy.for_bounded_out_of_orderness(500))
+             .key_by("u")
+             .window(EventTimeSessionWindows.with_gap(150))
+             .allowed_lateness(1000)
+             .count()
+             .add_sink(sink))
+            return env
+        return build_env
+
+    @staticmethod
+    def _session_view(sink):
+        return sorted((int(r["key"]), int(r["window_start"]),
+                       int(r["window_end"]), int(r["count"]))
+                      for r in sink.committed)
+
+    def _golden(self, builder_fn, view, tmp_path, extra=None):
+        """Fault-free reference at host.parallelism=1 (the serial
+        path's bytes are the contract both gates compare against)."""
+        sink = TransactionalCollectSink()
+        conf = {
+            "state.num-key-shards": 8, "state.slots-per-shard": 64,
+            "pipeline.microbatch-size": 256,
+            "execution.checkpointing.dir": str(tmp_path / "golden-ckpt"),
+            "execution.checkpointing.interval": 1,
+            "host.parallelism": 1,
+        }
+        conf.update(extra or {})
+        builder_fn(sink)(Configuration(conf)).execute("hostpool-golden")
+        return view(sink)
+
+    def _chaos(self, builder_fn, view, tmp_path, plan, extra=None):
+        sink = TransactionalCollectSink()
+        conf = dict(self.POOL_CONF)
+        conf.update(extra or {})
+        tracer.clear()
+        with plan.activate(), replayable(plan):
+            run_with_recovery(builder_fn(sink),
+                              chaos_conf(tmp_path, conf),
+                              job_name="hostpool-chaos")
+        return (view(sink), tracer.spans("recovery"),
+                tracer.spans("fault"))
+
+    def test_sessions_chaos_pool_on_exactly_once(self, tmp_path):
+        golden = self._golden(self._sessions_builder, self._session_view,
+                              tmp_path)
+        plan = (faults.FaultPlan(seed=CHAOS_SEED)
+                .rule("host.pool.task", "raise", count=1, after=6)
+                .rule("checkpoint.storage.write", "raise", count=1,
+                      after=1))
+        got, recoveries, fault_spans = self._chaos(
+            self._sessions_builder, self._session_view,
+            tmp_path, plan)
+        with replayable(plan):
+            assert got == golden
+            assert sorted(x[:2] for x in plan.log) == sorted([
+                ("host.pool.task", "raise"),
+                ("checkpoint.storage.write", "raise")])
+            assert len(fault_spans) == len(plan.log)
+            # the async persist's fault can land in the same attempt as
+            # a pool fault, so recoveries ∈ [1, #raises] — what's exact
+            # is the schedule (above) and the committed bytes
+            assert 1 <= len(recoveries) <= 2
+
+    # -- spill overflow ---------------------------------------------------
+
+    @staticmethod
+    def churn_source(n_batches, batch=256, n_keys=800):
+        def gen(split, i):
+            if i >= n_batches:
+                return None
+            rng = np.random.default_rng(900 + 1000 * int(split) + i)
+            return ({"k": rng.integers(0, n_keys, batch).astype(np.int64)},
+                    np.sort(rng.integers(i * 500, i * 500 + 1000,
+                                         batch)).astype(np.int64))
+        return gen
+
+    SPILL_CONF = {"state.backend": "spill", "state.slots-per-shard": 4}
+
+    def _spill_builder(self, sink):
+        def build_env(conf):
+            env = StreamExecutionEnvironment(conf)
+            (env.from_source(
+                GeneratorSource(self.churn_source(self.N_BATCHES)),
+                WatermarkStrategy.for_bounded_out_of_orderness(500))
+             .key_by("k")
+             .window(TumblingEventTimeWindows.of(1000))
+             .count()
+             .add_sink(sink))
+            return env
+        return build_env
+
+    def test_spill_overflow_chaos_pool_on_exactly_once(self, tmp_path):
+        golden = self._golden(self._spill_builder, committed_view,
+                              tmp_path, extra=self.SPILL_CONF)
+        plan = (faults.FaultPlan(seed=CHAOS_SEED)
+                .rule("host.pool.task", "raise", count=2, after=4)
+                .rule("checkpoint.storage.write", "raise", count=1,
+                      after=2))
+        got, recoveries, fault_spans = self._chaos(
+            self._spill_builder, committed_view, tmp_path, plan,
+            extra=self.SPILL_CONF)
+        with replayable(plan):
+            assert got == golden
+            assert len(fault_spans) == len(plan.log) == 3
+            assert 1 <= len(recoveries) <= 3
+
+
+@pytest.mark.slow
+class TestHostPoolChaosSoak:
+    """Randomized multi-seed soak of the pool-on spill overflow and
+    sessions pipelines (the §9.4 gate's long tail): probabilistic
+    injection at the host.pool.task seam composed with storage faults.
+    Failures print the seed for exact replay."""
+
+    @pytest.mark.parametrize("seed", [11, 12, 13])
+    def test_spill_overflow_soak(self, tmp_path, seed):
+        t = TestHostPoolChaos()
+        golden = t._golden(t._spill_builder, committed_view, tmp_path,
+                           extra=t.SPILL_CONF)
+        plan = (faults.FaultPlan(seed=seed)
+                .rule("host.pool.task", "raise", p=0.03, count=3)
+                .rule("checkpoint.storage.write", "raise", p=0.15,
+                      count=2))
+        got, recoveries, fault_spans = t._chaos(
+            t._spill_builder, committed_view, tmp_path / f"s{seed}",
+            plan,
+            extra={**t.SPILL_CONF,
+                   "restart-strategy.fixed-delay.attempts": 40})
+        fatal = sum(1 for x in plan.log if x[1] == "raise")
+        with replayable(plan):
+            assert got == golden
+            assert len(fault_spans) == len(plan.log)
+            assert len(recoveries) <= fatal
+            assert (fatal == 0) == (len(recoveries) == 0)
+
+    @pytest.mark.parametrize("seed", [21, 22])
+    def test_sessions_soak(self, tmp_path, seed):
+        t = TestHostPoolChaos()
+        golden = t._golden(t._sessions_builder, t._session_view,
+                           tmp_path)
+        plan = (faults.FaultPlan(seed=seed)
+                .rule("host.pool.task", "raise", p=0.05, count=3))
+        got, recoveries, fault_spans = t._chaos(
+            t._sessions_builder, t._session_view,
+            tmp_path / f"s{seed}", plan,
+            extra={"restart-strategy.fixed-delay.attempts": 40})
+        fatal = sum(1 for x in plan.log if x[1] == "raise")
+        with replayable(plan):
+            assert got == golden
+            assert len(fault_spans) == len(plan.log)
+            assert len(recoveries) <= fatal
+            assert (fatal == 0) == (len(recoveries) == 0)

@@ -1,0 +1,318 @@
+"""Shared host worker-pool plane (flink_tpu/parallel/hostpool.py).
+
+Two layers: unit tests of the pool's lifecycle/determinism/fault-seam
+contract, and the §9.4 PARITY GATE — the sessions, windowAll, and
+spill golden pipelines must produce BYTE-IDENTICAL output (same
+fields, dtypes, values, and row order) at host.parallelism 1, 2, and
+4, where 1 is the exact pre-pool serial path. The parity aggregates
+are the exact lane monoids (count/max, integer-valued sums below
+2**24), matching the §9 determinism contract's terms.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu import faults
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.api.sinks import FnSink
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.api.windowing import (
+    EventTimeSessionWindows,
+    TumblingEventTimeWindows,
+)
+from flink_tpu.config import Configuration, HostOptions
+from flink_tpu.obs.metrics import MetricRegistry
+from flink_tpu.ops import aggregates
+from flink_tpu.ops.session import SessionOperator
+from flink_tpu.parallel.hostpool import HostPool, default_parallelism
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+pytestmark = pytest.mark.hostpool
+
+PARALLELISMS = (1, 2, 4)
+
+
+# -- pool unit contract -----------------------------------------------------
+
+class TestHostPoolUnit:
+    def test_parallelism_one_is_inline_and_threadless(self):
+        pool = HostPool(1)
+        assert pool._executor is None  # the serial path makes no threads
+        tids = []
+        out = pool.run_tasks(
+            [lambda i=i: (tids.append(threading.get_ident()), i)[1]
+             for i in range(5)])
+        assert out == [0, 1, 2, 3, 4]
+        assert set(tids) == {threading.get_ident()}
+        pool.close()
+
+    def test_results_in_submission_order(self):
+        pool = HostPool(4)
+        try:
+            def task(i):
+                time.sleep(0.02 * (4 - i % 5))  # finish out of order
+                return i
+            out = pool.run_tasks([lambda i=i: task(i) for i in range(16)])
+            assert out == list(range(16))
+        finally:
+            pool.close()
+
+    def test_first_exception_by_index_propagates(self):
+        pool = HostPool(4)
+        try:
+            def task(i):
+                if i in (3, 7):
+                    raise ValueError(f"boom-{i}")
+                return i
+            with pytest.raises(ValueError, match="boom-3"):
+                pool.run_tasks([lambda i=i: task(i) for i in range(10)])
+        finally:
+            pool.close()
+
+    def test_close_degrades_to_inline(self):
+        pool = HostPool(4)
+        pool.close()
+        assert pool.run_tasks([lambda: 1, lambda: 2]) == [1, 2]
+
+    def test_parallelism_below_one_rejected(self):
+        with pytest.raises(ValueError, match="host.parallelism"):
+            HostPool(0)
+
+    def test_from_config_default_is_min_4_cores(self):
+        pool = HostPool.from_config(Configuration())
+        try:
+            assert pool.parallelism == default_parallelism()
+            assert pool.parallelism == min(4, os.cpu_count() or 1)
+        finally:
+            pool.close()
+
+    def test_per_task_metrics(self):
+        reg = MetricRegistry()
+        pool = HostPool(2, registry=reg)
+        try:
+            pool.run_tasks([lambda: None] * 7)
+        finally:
+            pool.close()
+        snap = reg.snapshot()
+        assert snap["hostpool.tasks_total"] == 7
+        assert snap["hostpool.task_ms.count"] == 7
+        assert snap["hostpool.parallelism"] == 2.0
+
+    def test_fault_point_registered_and_fires_at_submit(self):
+        assert "host.pool.task" in faults.KNOWN_FAULT_POINTS
+        for w in (1, 4):  # the seam behaves identically at any width
+            pool = HostPool(w)
+            plan = faults.FaultPlan(seed=0).rule(
+                "host.pool.task", "raise", count=1, after=2)
+            try:
+                with plan.activate():
+                    with pytest.raises(RuntimeError) as ei:
+                        pool.run_tasks([lambda: 1] * 6)
+                assert faults.is_injected(ei.value)
+            finally:
+                pool.close()
+
+
+# -- the §9.4 serial-vs-parallel parity gates -------------------------------
+
+def collect_ordered(env_builder):
+    """Run the pipeline and return its sink output as one
+    field→array dict, concatenated in DELIVERY order — the comparison
+    covers values, dtypes, AND row order."""
+    batches = []
+    env = env_builder(FnSink(lambda b: batches.append(
+        {k: np.asarray(v).copy() for k, v in b.items()})))
+    env.execute("hostpool-parity")
+    if not batches:
+        return {}
+    return {k: np.concatenate([b[k] for b in batches])
+            for k in batches[0]}
+
+
+def assert_byte_identical(ref, got, label):
+    assert set(ref) == set(got), label
+    for k in ref:
+        assert ref[k].dtype == got[k].dtype, (label, k)
+        assert np.array_equal(ref[k], got[k]), (label, k)
+    for k in ref:
+        assert len(ref[k])  # the gate must compare real output
+
+
+def sessions_env(sink, w):
+    """The sessions golden shape (bench config #4): bursty users, gap
+    sessions, allowed lateness, ~5% late records — exercises merge,
+    re-fire, beyond-lateness drops, and expiry on every shard."""
+    def gen(split, i):
+        if i >= 6:
+            return None
+        rng = np.random.default_rng(11 + i)
+        user = rng.integers(0, 300, 4096).astype(np.int64)
+        base = i * 512
+        ts = base + rng.integers(0, 700, 4096)
+        late = rng.random(4096) < 0.05
+        ts = np.where(late, np.maximum(ts - 2500, 0), ts).astype(np.int64)
+        return ({"user": user}, ts)
+
+    env = StreamExecutionEnvironment(Configuration({
+        "state.num-key-shards": 8, "state.slots-per-shard": 64,
+        "pipeline.microbatch-size": 4096,
+        "host.parallelism": w}))
+    (env.from_source(GeneratorSource(gen),
+                     WatermarkStrategy.for_bounded_out_of_orderness(800))
+        .key_by("user")
+        .window(EventTimeSessionWindows.with_gap(150))
+        .allowed_lateness(3000)
+        .count()
+        .add_sink(sink))
+    return env
+
+
+def window_all_env(sink, w, agg_builder):
+    """The windowAll golden shape (Q7) with the tree-fold floor lowered
+    so the chunked fold engages on test-sized batches."""
+    def gen(split, i):
+        if i >= 6:
+            return None
+        rng = np.random.default_rng(23 + i)
+        return ({"v": rng.integers(1, 100, 8192).astype(np.int64)},
+                np.sort(rng.integers(i * 700, i * 700 + 1400,
+                                     8192)).astype(np.int64))
+
+    env = StreamExecutionEnvironment(Configuration({
+        "pipeline.microbatch-size": 8192,
+        "host.parallelism": w,
+        "host.fold-chunk-records": 2048}))
+    s = (env.from_source(GeneratorSource(gen),
+                         WatermarkStrategy.for_bounded_out_of_orderness(800))
+         .window_all(TumblingEventTimeWindows.of(1000)))
+    agg_builder(s).add_sink(sink)
+    return env
+
+
+def spill_env(sink, w):
+    """The spill golden shape: 1600 keys into 32 resident slots —
+    every batch overflows into the host store's pane merges."""
+    def gen(split, i):
+        if i >= 6:
+            return None
+        rng = np.random.default_rng(42 + i)
+        return ({"k": rng.integers(0, 1600, 512).astype(np.int64),
+                 "v": rng.integers(1, 100, 512).astype(np.int64)},
+                np.sort(rng.integers(i * 700, i * 700 + 1400,
+                                     512)).astype(np.int64))
+
+    env = StreamExecutionEnvironment(Configuration({
+        "state.num-key-shards": 8, "state.slots-per-shard": 4,
+        "state.backend": "spill",
+        "pipeline.microbatch-size": 512,
+        "host.parallelism": w}))
+    (env.from_source(GeneratorSource(gen),
+                     WatermarkStrategy.for_bounded_out_of_orderness(800))
+        .key_by("k")
+        .window(TumblingEventTimeWindows.of(1000))
+        .count()
+        .add_sink(sink))
+    return env
+
+
+class TestSerialParallelParity:
+    def test_sessions_parity_1_2_4(self):
+        ref = collect_ordered(lambda s: sessions_env(s, 1))
+        for w in PARALLELISMS[1:]:
+            got = collect_ordered(lambda s: sessions_env(s, w))
+            assert_byte_identical(ref, got, f"sessions w={w}")
+
+    def test_window_all_max_parity_1_2_4(self):
+        ref = collect_ordered(
+            lambda s: window_all_env(s, 1, lambda ws: ws.max("v")))
+        for w in PARALLELISMS[1:]:
+            got = collect_ordered(
+                lambda s: window_all_env(s, w, lambda ws: ws.max("v")))
+            assert_byte_identical(ref, got, f"window_all max w={w}")
+
+    def test_window_all_int_sum_parity_1_2_4(self):
+        """Integer-valued sums below 2**24 are exact in f32 at every
+        association, so even the CHUNKED tree fold (whose reduction
+        tree differs from serial) must reproduce the serial bytes."""
+        agg = aggregates.multi(aggregates.sum_of("v"), aggregates.count())
+        ref = collect_ordered(
+            lambda s: window_all_env(s, 1, lambda ws: ws.aggregate(agg)))
+        for w in PARALLELISMS[1:]:
+            got = collect_ordered(
+                lambda s: window_all_env(s, w,
+                                         lambda ws: ws.aggregate(agg)))
+            assert_byte_identical(ref, got, f"window_all sum w={w}")
+
+    def test_spill_parity_1_2_4(self):
+        ref = collect_ordered(lambda s: spill_env(s, 1))
+        for w in PARALLELISMS[1:]:
+            got = collect_ordered(lambda s: spill_env(s, w))
+            assert_byte_identical(ref, got, f"spill w={w}")
+
+
+class TestSnapshotAcrossParallelism:
+    """Checkpoints are shard-count-agnostic: the session registry
+    snapshots as ONE (key, start)-sorted block, so a snapshot taken at
+    one host.parallelism restores at another."""
+
+    def _feed(self, op):
+        rng = np.random.default_rng(7)
+        for i in range(4):
+            keys = rng.integers(0, 40, 512)
+            ts = i * 300 + rng.integers(0, 400, 512)
+            op.process_batch(keys, ts, {})
+        return op
+
+    def _fire_all(self, op):
+        f = op.advance_watermark(10_000_000)
+        return {k: np.asarray(v) for k, v in f.to_dict().items()} \
+            if hasattr(f, "to_dict") else f._data
+
+    def test_serial_snapshot_restores_into_parallel(self):
+        agg = aggregates.count()
+        serial = self._feed(SessionOperator(gap_ms=100, agg=agg,
+                                            allowed_lateness_ms=500))
+        snap = serial.snapshot_state()
+        pool = HostPool(4)
+        try:
+            par = SessionOperator(gap_ms=100, agg=agg,
+                                  allowed_lateness_ms=500, host_pool=pool)
+            par.restore_state(snap)
+            assert len(par._shards) == 4
+            ref = self._fire_all(self._feed(SessionOperator(
+                gap_ms=100, agg=agg, allowed_lateness_ms=500)))
+            got = self._fire_all(par)
+            for k in ref:
+                assert np.array_equal(ref[k], got[k]), k
+        finally:
+            pool.close()
+
+    def test_parallel_snapshot_equals_serial_snapshot(self):
+        agg = aggregates.count()
+        serial = self._feed(SessionOperator(gap_ms=100, agg=agg,
+                                            allowed_lateness_ms=500))
+        pool = HostPool(4)
+        try:
+            par = self._feed(SessionOperator(
+                gap_ms=100, agg=agg, allowed_lateness_ms=500,
+                host_pool=pool))
+            s1, s2 = serial.snapshot_state(), par.snapshot_state()
+            assert s1["watermark"] == s2["watermark"]
+            for c in s1["columns"]:
+                assert np.array_equal(s1["columns"][c],
+                                      s2["columns"][c]), c
+        finally:
+            pool.close()
+
+
+class TestConfigSurface:
+    def test_host_options_declared(self):
+        from flink_tpu.config import is_declared_key
+
+        assert is_declared_key("host.parallelism")
+        assert is_declared_key("host.fold-chunk-records")
+        assert Configuration().get(HostOptions.PARALLELISM) == \
+            min(4, os.cpu_count() or 1)

@@ -69,3 +69,62 @@ class TestDriverMetrics:
         assert res.metrics["fired_windows"] > 0
         assert "driver.emit_latency_ms.p99" in res.metrics
         assert res.metrics["driver.records_in"] == 100
+
+
+class TestThreadSafety:
+    """The primitives' write paths are lock-guarded: host-pool worker
+    threads (flink_tpu/parallel/hostpool.py), the drain thread, and the
+    scrape thread share one registry — an unguarded `+=` loses updates
+    under contention. Regression: concurrent writers must land EXACTLY."""
+
+    THREADS = 8
+    PER_THREAD = 5_000
+
+    def _hammer(self, fn, per_thread=None):
+        import threading
+
+        start = threading.Barrier(self.THREADS)
+        per_thread = per_thread or self.PER_THREAD
+
+        def work():
+            start.wait()
+            for _ in range(per_thread):
+                fn()
+
+        ts = [threading.Thread(target=work) for _ in range(self.THREADS)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+    def test_concurrent_counter_inc_exact(self):
+        from flink_tpu.obs.metrics import Counter
+
+        c = Counter()
+        self._hammer(lambda: c.inc())
+        assert c.value == self.THREADS * self.PER_THREAD
+
+    def test_concurrent_histogram_update_exact_count(self):
+        from flink_tpu.obs.metrics import Histogram
+
+        h = Histogram(size=256)
+        self._hammer(lambda: h.update(1.0))
+        assert h.count == self.THREADS * self.PER_THREAD
+        assert h.quantile(0.5) == 1.0  # every reservoir slot intact
+
+    def test_concurrent_gauge_set_and_meter_mark(self):
+        from flink_tpu.obs.metrics import Gauge, Meter
+
+        g = Gauge()
+        m = Meter()
+
+        def touch():
+            g.set(42.0)
+            m.mark()
+            m.rate  # reader racing the marker's head-pop
+
+        # smaller sweep: rate re-scans the event list per call, so the
+        # hammer is quadratic in marks — 200/thread races plenty
+        self._hammer(touch, per_thread=200)
+        assert g.value == 42.0
+        assert m.rate > 0.0
