@@ -51,7 +51,8 @@ def _counting_sink():
 
 
 def run_q5(batch_size: int, n_batches: int, *, shards: int, slots: int,
-           device_source: bool = True) -> dict:
+           device_source: bool = True, sub_batches: int = 1,
+           profile_dir: str = "") -> dict:
     from flink_tpu.api.environment import StreamExecutionEnvironment
     from flink_tpu.config import Configuration
     from flink_tpu.nexmark.generator import (
@@ -64,11 +65,20 @@ def run_q5(batch_size: int, n_batches: int, *, shards: int, slots: int,
     cfg = NexmarkConfig(
         batch_size=batch_size, n_batches=n_batches,
         events_per_ms=100, num_active_auctions=10_000, hot_ratio=4)
-    env = StreamExecutionEnvironment(Configuration({**BENCH_CONF,
-        "state.num-key-shards": shards,
-        "state.slots-per-shard": slots,
-        "pipeline.microbatch-size": batch_size,
-    }))
+    conf = {**BENCH_CONF,
+            "state.num-key-shards": shards,
+            "state.slots-per-shard": slots,
+            "pipeline.microbatch-size": batch_size,
+            # sub-batch fire/emit decoupling (PROFILE.md §8.6): fires
+            # reach the host at ~batch_wall/K cadence instead of riding
+            # the drain behind one full logical-batch device step
+            "pipeline.sub-batches": sub_batches,
+            }
+    if profile_dir:
+        # per-op device trace of N warm steps (obs/profiling.py); the
+        # summary rides JobResult.metrics["profile.trace_summary"]
+        conf["pipeline.profile-dir"] = profile_dir
+    env = StreamExecutionEnvironment(Configuration(conf))
     emitted, sink = _counting_sink()
     # device_source: the generator is synthesized inside the window
     # operator's step program (DeviceGeneratorSource — zero record
@@ -82,40 +92,84 @@ def run_q5(batch_size: int, n_batches: int, *, shards: int, slots: int,
     return res.metrics
 
 
+# THE default sub-batch config of the headline (and of the acceptance
+# bar): 2^22-record logical batches executed as 4 chained 2^20
+# sub-batch device programs — logical-batch ingest amortization with
+# fire visibility at sub-batch cadence (PROFILE.md §8.6).
+HEADLINE_BATCH = 1 << 22
+HEADLINE_SUB_BATCHES = 4
+
+
+def _q5_trial(batch, n_meas, sub_batches, profile_dir=""):
+    start = time.perf_counter()
+    metrics = run_q5(batch, n_meas, shards=128, slots=256,
+                     sub_batches=sub_batches, profile_dir=profile_dir)
+    elapsed = time.perf_counter() - start
+    assert metrics["emitted"] > 0, "q5 emitted nothing"
+    assert metrics.get("records_dropped_full", 0) == 0, "q5 dropped records"
+    trial = {
+        "events_per_sec": round(batch * n_meas / elapsed),
+        "batch": batch,
+        "sub_batches": sub_batches,
+        "p50_latency_ms": round(metrics.get("driver.emit_latency_ms.p50", 0.0), 1),
+        "p90_latency_ms": round(metrics.get("driver.emit_latency_ms.p90", 0.0), 1),
+        "p99_latency_ms": round(metrics.get("driver.emit_latency_ms.p99", 0.0), 1),
+        "max_latency_ms": round(metrics.get("driver.emit_latency_ms.max", 0.0), 1),
+    }
+    return trial, metrics
+
+
+def _profile_top_ops(batch, sub_batches, n_batches=16):
+    """One short PROFILED Q5 run (pipeline.profile-dir): returns the
+    per-op device-time summary so the bench ARTIFACT itself names the
+    expensive ops (the §8.5 anomaly hunt) — never fails the bench."""
+    import tempfile
+
+    try:
+        d = tempfile.mkdtemp(prefix="flink-tpu-bench-prof-")
+        _, metrics = _q5_trial(batch, n_batches, sub_batches,
+                               profile_dir=d)
+        summary = metrics.get("profile.trace_summary") or {}
+        if summary.get("error"):
+            return {"error": summary["error"]}
+        planes = summary.get("planes", [])
+        device = [p for p in planes if p.get("device")] or planes[:1]
+        return {
+            "trace_dir": d,
+            "steps": summary.get("steps"),
+            "window_wall_s": summary.get("window_wall_s"),
+            "top_ops": [
+                {"plane": p["plane"], "ops": p["ops"][:10]}
+                for p in device[:2]],
+        }
+    except Exception as e:  # noqa: BLE001 — profiling is best-effort
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def main() -> None:
-    # 2^22-record microbatches: with the device-chained generator the
-    # per-batch cost is dominated by per-step relay overheads (hdr
-    # upload, throttle probes — each ~tens of ms on the remote-attached
-    # chip), so bigger batches amortize them. The latency/throughput
-    # knob: 2^21 gives ~21M ev/s at p99 ~200ms, 2^22 ~30M at p99
-    # ~450ms (PROFILE.md §8.5 has the curve); the headline takes the
-    # throughput point, which still holds p50/p90 ~11ms.
-    batch = 1 << 22
+    # 2^22-record LOGICAL microbatches (the r05 throughput point) run
+    # as 4×2^20 chained sub-batch programs: ingest amortization stays
+    # at 2^22 while fired rows become host-visible at sub-batch
+    # cadence — the p99 decoupling ISSUE 6 ships (r05 paid p99 ≈ 406ms
+    # for the same median; PROFILE.md §8.5/§8.6 have the curves).
+    batch = HEADLINE_BATCH
+    sub = HEADLINE_SUB_BATCHES
     # warmup: same operator configs → shared compiled kernels (covers
     # apply, steady fires, ring growth + remap, catch-up fires, clear,
-    # emit-ring drain)
-    run_q5(batch, 12, shards=128, slots=256)
+    # emit-ring drain; the subdivided devgen spec is part of the key)
+    run_q5(batch, 12, shards=128, slots=256, sub_batches=sub)
 
     # long enough that the fixed end-of-input flush is amortized — the
     # metric is STEADY-STATE throughput, which is what Nexmark measures.
     # THREE trials: the headline is the MEDIAN, and the artifact carries
-    # every trial's throughput + latency histogram so run-to-run spread
-    # is part of the claim, not folklore.
+    # every trial's throughput + latency histogram AND sub-batch config
+    # so run-to-run spread and the benched config are part of the
+    # claim, not folklore.
     n_meas = 48
     trials = []
     for _ in range(3):
-        start = time.perf_counter()
-        metrics = run_q5(batch, n_meas, shards=128, slots=256)
-        elapsed = time.perf_counter() - start
-        assert metrics["emitted"] > 0, "q5 emitted nothing"
-        assert metrics.get("records_dropped_full", 0) == 0, "q5 dropped records"
-        trials.append({
-            "events_per_sec": round(batch * n_meas / elapsed),
-            "p50_latency_ms": round(metrics.get("driver.emit_latency_ms.p50", 0.0), 1),
-            "p90_latency_ms": round(metrics.get("driver.emit_latency_ms.p90", 0.0), 1),
-            "p99_latency_ms": round(metrics.get("driver.emit_latency_ms.p99", 0.0), 1),
-            "max_latency_ms": round(metrics.get("driver.emit_latency_ms.max", 0.0), 1),
-        })
+        trial, _ = _q5_trial(batch, n_meas, sub)
+        trials.append(trial)
     rates = sorted(t["events_per_sec"] for t in trials)
     eps = rates[len(rates) // 2]
     med = next(t for t in trials if t["events_per_sec"] == eps)
@@ -127,16 +181,55 @@ def main() -> None:
         # this environment to measure the real one; see BASELINE.md)
         "vs_baseline": round(eps / ASSUMED_FLINK_EVENTS_PER_SEC, 3),
         "baseline_assumed": True,
+        "batch": batch,
+        "sub_batches": sub,
         "throughput_min": rates[0],
         "throughput_max": rates[-1],
         "spread_pct": round((rates[-1] - rates[0]) / eps * 100, 1),
         "trials": trials,
         # fire-dispatch → sink-delivery latency of fired windows (the
         # latency-marker analogue; BASELINE.md's p99 column), from the
-        # median-throughput trial
+        # median-throughput trial. Samples are stamped per fire cohort
+        # at actual host-visibility (drain fetch), not at queue-item
+        # delivery — see driver._note_ring_latency.
         "p99_latency_ms": med["p99_latency_ms"],
         "p50_latency_ms": med["p50_latency_ms"],
+        # per-op device-time summary from one short profiled run: the
+        # §8.5 anomaly hunt ships IN the artifact (jax.profiler.trace
+        # via pipeline.profile-dir; obs/profiling.py)
+        "profile_top_ops": _profile_top_ops(batch, sub),
     }))
+
+
+def sub_batch_sweep(spec: str) -> None:
+    """``python bench.py --sub-batches 1,2,4,8``: the fire-cadence
+    sweep on the headline Q5 config — one JSON line per K with
+    throughput AND the latency histogram, so the throughput/p99
+    trade-off of the sub-batch knob is measured, not asserted. The
+    headline claim remains the DEFAULT config's line (bench main), not
+    the sweep's best point."""
+    ks = [int(x) for x in spec.split(",") if x.strip()]
+    if not ks:
+        raise SystemExit("--sub-batches needs a list, e.g. 1,2,4")
+    for k in ks:
+        if k < 1 or HEADLINE_BATCH % k:
+            raise SystemExit(
+                f"--sub-batches values must divide {HEADLINE_BATCH}, "
+                f"got {k}")
+    for k in ks:
+        # per-K warmup: the sub-batch count is a STATIC of the devgen
+        # step kernel (batch shape + generator spec), so every K
+        # compiles its own program — warm each before its clock
+        run_q5(HEADLINE_BATCH, 8, shards=128, slots=256, sub_batches=k)
+        trial, _ = _q5_trial(HEADLINE_BATCH, 24, k)
+        print(json.dumps({
+            "metric": "nexmark_q5_hot_items_end_to_end_events_per_sec",
+            "unit": "events/sec/chip",
+            "value": trial["events_per_sec"],
+            **{f: trial[f] for f in (
+                "batch", "sub_batches", "p50_latency_ms",
+                "p90_latency_ms", "p99_latency_ms", "max_latency_ms")},
+        }))
 
 
 def run_q7(batch_size: int, n_batches: int) -> float:
@@ -409,17 +502,54 @@ def host_parallelism_sweep(spec: str) -> None:
     thread-count sweep on the sessions config (#4) — one JSON line per
     worker count, same generator/batch shape as the suite's sessions
     line. The PR-notes win claim is the ratio AT THE DECLARED DEFAULT
-    (min(4, os.cpu_count())), never the best point of the sweep."""
+    (min(4, os.cpu_count())), never the best point of the sweep.
+
+    CORE-COUNT GUARD (ROADMAP carry-over / PROFILE.md §9.4): the
+    ≥1.25× @W=4 target is only MEASURABLE on a host with ≥ 4 physical
+    cores — on fewer, W=4 is pure oversubscription and the sweep would
+    print a silent parity-or-worse number that reads like a subsystem
+    regression. Such hosts get an explicit SKIPPED line instead."""
     ws = [int(x) for x in spec.split(",") if x.strip()]
     if not ws:
         raise SystemExit("--host-parallelism needs a list, e.g. 1,2,4,8")
+    cores = os.cpu_count() or 1
+    over = [w for w in ws if w > cores]
+    if cores < 4 and over:
+        # only the oversubscribed points are meaningless — measure the
+        # w <= cores points normally (they ARE this host's subsystem)
+        print(json.dumps({
+            "metric": "session_clickstream_host_parallelism_sweep",
+            "skipped": "insufficient-cores",
+            "skipped_points": over,
+            "cores": cores,
+            "required_cores": 4,
+            "detail": "the >=1.25x @W=4 validation (PROFILE.md §9.4) "
+                      "needs >=4 cores (os.cpu_count; SMT threads "
+                      "inflate this — prefer physical-core hosts); "
+                      "W>cores would print oversubscription, not the "
+                      "subsystem — re-run on the chip host"}))
+        ws = [w for w in ws if w <= cores]
+        if not ws:
+            return
     run_sessions(1 << 20, 4)  # warmup (shared compiled kernels)
+    by_w = {}
     for w in ws:
         eps = run_sessions(1 << 20, 12, host_parallelism=w)
+        by_w[w] = eps
         print(json.dumps({
             "metric": "session_clickstream_events_per_sec",
             "host_parallelism": w,
             "value": round(eps), "unit": "events/sec/chip"}))
+    if 1 in by_w and 4 in by_w:
+        # the carried-over target line (ROADMAP item: ≥1.25× @W=4,
+        # within-run ratio so link/host weather cancels)
+        ratio = by_w[4] / by_w[1]
+        print(json.dumps({
+            "metric": "session_clickstream_host_parallelism_ratio_w4",
+            "value": round(ratio, 3),
+            "target": 1.25,
+            "target_met": ratio >= 1.25,
+            "cores": cores}))
 
 
 if __name__ == "__main__":
@@ -431,6 +561,11 @@ if __name__ == "__main__":
             raise SystemExit("--host-parallelism needs a list, "
                              "e.g. 1,2,4,8")
         host_parallelism_sweep(sys.argv[ix + 1])
+    elif "--sub-batches" in sys.argv:
+        ix = sys.argv.index("--sub-batches")
+        if ix + 1 >= len(sys.argv):
+            raise SystemExit("--sub-batches needs a list, e.g. 1,2,4")
+        sub_batch_sweep(sys.argv[ix + 1])
     elif "--suite" in sys.argv:
         suite()
     else:

@@ -116,6 +116,33 @@ class DeviceGeneratorSource(Source):
     # when the batch's pane bounds also rule out late/refire work —
     # one fewer device→host transfer per microbatch on the relay.
     keys_bounded: bool = False
+    # sub-batch re-slicing (pipeline.sub-batches, the fire/emit
+    # decoupling knob): a callable ``k -> DeviceGeneratorSource`` whose
+    # result produces the IDENTICAL record stream at batch_size/k
+    # granularity — sub-batch j of logical batch i must be batch
+    # i*k + j of the returned source, bit-exact slice [j*b', (j+1)*b')
+    # of the logical batch. None = the source cannot subdivide; the
+    # driver then keeps its device chain at logical granularity.
+    subdivide: Optional[Callable[[int], "DeviceGeneratorSource"]] = None
+
+    def subdivided(self, k: int) -> "DeviceGeneratorSource":
+        """The equivalent source at batch_size/k granularity (see
+        ``subdivide``). Raises when the source declares no subdivision
+        or the batch size does not split evenly — callers decide
+        whether that is a config error or a fallback."""
+        if k < 1:
+            raise ValueError(f"sub-batch count must be >= 1, got {k}")
+        if k == 1:
+            return self
+        if self.subdivide is None:
+            raise ValueError(
+                "this DeviceGeneratorSource declares no subdivide "
+                "callable — it cannot re-slice its stream")
+        if self.batch_size % k:
+            raise ValueError(
+                f"pipeline.sub-batches={k} does not divide the device "
+                f"source's batch_size={self.batch_size}")
+        return self.subdivide(k)
 
     def splits(self) -> List[str]:
         return ["0"]  # device chaining is single-split by construction

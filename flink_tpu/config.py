@@ -260,6 +260,42 @@ class PipelineOptions:
         "while recent emit p99 exceeds the target and growing it back "
         "toward the source batch size while p99 sits under half the "
         "target. 0 = off (source batch size rules, maximum throughput).")
+    SUB_BATCHES = ConfigOption(
+        "pipeline.sub-batches", 1,
+        "Chained sub-batch device programs per LOGICAL microbatch (the "
+        "fire/emit decoupling knob, PROFILE.md §8.6): K > 1 splits each "
+        "logical batch into K equal sub-batch steps with watermark "
+        "advances, fire dispatches, and drain deliveries interleaved at "
+        "sub-batch boundaries — a fired window's rows become "
+        "host-visible at sub-batch cadence (~batch_wall/K) instead of "
+        "full-batch cadence, while source positions, throttle probes, "
+        "and checkpoint checks stay amortized at the logical-batch "
+        "granularity. Must divide pipeline.microbatch-size (the plan "
+        "analyzer rejects misconfigurations at submit, SUBBATCH_"
+        "INVALID). 1 = the exact pre-split path. Committed output is "
+        "byte-identical across K for exact lane monoids (counts, "
+        "min/max, integer sums — the same contract as host.parallelism"
+        "); float sums may differ in last-bit rounding because the "
+        "device folds K partial batches instead of one.")
+    PROFILE_DIR = ConfigOption(
+        "pipeline.profile-dir", "",
+        "When set, the driver wraps pipeline.profile-steps WARM logical "
+        "batches (after pipeline.profile-skip) of the streaming run in "
+        "jax.profiler.trace(dir) and writes a per-op device-time "
+        "summary to <dir>/profile_summary.json (flink_tpu/obs/"
+        "profiling.py; the summary also rides JobResult.metrics under "
+        "'profile.trace_summary'). The first-class seam for naming "
+        "per-op device costs that black-box bisection cannot (PROFILE."
+        "md §8.5). Empty = off (zero overhead).")
+    PROFILE_STEPS = ConfigOption(
+        "pipeline.profile-steps", 8,
+        "Logical batches captured inside the jax.profiler.trace window "
+        "when pipeline.profile-dir is set.")
+    PROFILE_SKIP = ConfigOption(
+        "pipeline.profile-skip", 4,
+        "Warm-up logical batches to run BEFORE the profiler trace "
+        "starts (compile + cache warm-up must not pollute the per-op "
+        "summary) when pipeline.profile-dir is set.")
 
 
 class ExecutionOptions:

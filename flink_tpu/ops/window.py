@@ -1114,6 +1114,21 @@ class WindowOperator:
         # never read a version older than the rows it must deliver.
         self._ring_versions: collections.deque = collections.deque(maxlen=4)
         self._ring_version_no = 0
+        # fire-cohort latency bookkeeping (driver emit_latency_ms): a
+        # (ring_version, dispatch_stamp) entry per row-carrying fire,
+        # popped to _delivered_stamps by the drain_ring call whose
+        # fetched version first makes those rows HOST-VISIBLE. The
+        # driver records one histogram sample per delivered cohort —
+        # without this, a drain poll that coalesces several sub-batch
+        # fires would attribute every row to the OLDEST marker's stamp
+        # and overstate p99 (under-reporting the sub-batch cadence win).
+        # Both deques are bounded: in modes where nothing pops them
+        # (the synchronous spill+top-n drain), old entries fall off —
+        # lost samples, never lost rows.
+        self._fire_stamps: collections.deque = collections.deque(
+            maxlen=4096)
+        self._delivered_stamps: collections.deque = collections.deque(
+            maxlen=512)
         # device→host copies are expensive stream ops on the measured
         # transport (~1MB/s effective for announced copies): announce
         # the ring at a TIME/FILL cadence, not per fire. The drain's
@@ -2455,6 +2470,11 @@ class WindowOperator:
         _ring_versions)."""
         with self._ring_lock:
             self._ring_version_no += 1
+            if n_ends > 0:
+                # row-carrying fire: stamp the cohort for host-visibility
+                # latency attribution (see _fire_stamps above)
+                self._fire_stamps.append(
+                    (self._ring_version_no, time.time()))
             self._rows_bound_since_announce += max(n_ends, 0) * (
                 self._topn[1] * 8)
             now = time.perf_counter()
@@ -2656,13 +2676,15 @@ class WindowOperator:
                 acceptable = [(no, arr_) for no, arr_ in
                               self._ring_versions if no >= need]
                 target = None
+                no_read = None
                 for no, cand in reversed(acceptable):
                     if cand.is_ready():
-                        target = cand
+                        target, no_read = cand, no
                         break
                 else:
                     if acceptable:
-                        target = acceptable[0][1]  # oldest OK = soonest
+                        # oldest OK = soonest
+                        no_read, target = acceptable[0]
                 if target is None:
                     if min_no == 0:
                         # opportunistic poll with nothing announced yet
@@ -2675,6 +2697,7 @@ class WindowOperator:
                         # the fetch is a landed-copy read, not an
                         # unannounced round trip
                         target = self._emit_ring
+                        no_read = self._ring_version_no
                         target.copy_to_host_async()
                         self._ring_versions.append(
                             (self._ring_version_no, target))
@@ -2683,6 +2706,13 @@ class WindowOperator:
                 if target is not None:
                     ready_wait(target)
                     arr = np.asarray(target)         # ONE round trip
+                    # every fire cohort at or below the fetched version
+                    # just became host-visible — hand its dispatch
+                    # stamp to the latency accounting
+                    while (self._fire_stamps
+                           and self._fire_stamps[0][0] <= no_read):
+                        self._delivered_stamps.append(
+                            self._fire_stamps.popleft())
                 self.prof["drain_fetch"] += time.perf_counter() - tdr
                 self.prof["drain_fetches"] += 1
         if arr is None:
@@ -2742,6 +2772,17 @@ class WindowOperator:
         if extras:
             out = _drain_merge_extras(out, extras, self._topn)
         return out
+
+    def take_delivered_fire_stamps(self):
+        """Pop the dispatch stamps of fire cohorts whose rows became
+        host-visible since the last call (see ``_fire_stamps``). The
+        driver records one emit-latency sample per cohort at delivery
+        time — host-visibility-accurate even when one drain poll
+        coalesces many sub-batch fires."""
+        with self._ring_lock:
+            out = [stamp for _, stamp in self._delivered_stamps]
+            self._delivered_stamps.clear()
+            return out
 
     def _check_fire_cap(self, n: int, cap: int) -> None:
         """A packed buffer reporting more fired rows than its capacity
@@ -2894,6 +2935,8 @@ class WindowOperator:
         self._ring_drained = 0
         self._ring_anchor = None
         self._ring_versions.clear()
+        self._fire_stamps.clear()
+        self._delivered_stamps.clear()
         # a stash from the pre-restore attempt belongs to a replayed
         # stream position — never apply it to restored state
         self._stash_u32 = None

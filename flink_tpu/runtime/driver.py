@@ -108,6 +108,31 @@ class Driver:
         self._debloat_chunk: Optional[int] = None
         self._debloat_min = 4096
         self._debloat_seen = 0  # histogram count at last control step
+        # sub-batch fire/emit decoupling (PROFILE.md §8.6): K > 1 runs
+        # each logical batch as K chained sub-batch device steps with
+        # watermark advances + fire dispatches interleaved at sub-batch
+        # boundaries, so fired rows become host-visible at ~batch_wall/K
+        # cadence. Source positions / throttle probes / checkpoint
+        # checks stay at logical-batch granularity. K=1 IS the exact
+        # pre-split path (every new branch is guarded on K > 1).
+        self._sub_batches = int(config.get(_PO.SUB_BATCHES))
+        if self._sub_batches < 1:
+            raise ValueError(
+                f"pipeline.sub-batches must be >= 1, got "
+                f"{self._sub_batches}")
+        mb = int(config.get(_PO.MICROBATCH_SIZE))
+        if mb % self._sub_batches:
+            raise ValueError(
+                f"pipeline.sub-batches ({self._sub_batches}) must "
+                f"divide pipeline.microbatch-size ({mb}) — sub-batches "
+                "are equal slices of the logical batch (the plan "
+                "analyzer flags this at submit: SUBBATCH_INVALID)")
+        # per-source sub-batch factor actually in effect this run:
+        # sub_batches for device-chained sources iterating a subdivided
+        # stream (positions then count SUB-batches), 1 otherwise (host
+        # path slices inside one position). Snapshots record it so a
+        # restore under a different factor can re-base positions.
+        self._sub_factor: Dict[int, int] = {}
         g.gauge("debloat_chunk",
                 lambda: float(self._debloat_chunk or 0))
         # per-phase wall-time accumulators (seconds) for the ingest loop
@@ -115,6 +140,7 @@ class Driver:
         # work is steered by measurement (PROFILE.md), not vibes
         self.prof: Dict[str, float] = collections.defaultdict(float)
         self._emit_q = None
+        self._profiler = None  # armed per run (pipeline.profile-dir)
         self._drain_error: Optional[BaseException] = None
         # per-run discard cell: set on abort so the run's drain thread
         # drops (never delivers) everything it still holds. One CELL per
@@ -186,7 +212,18 @@ class Driver:
     def _build_ops(self) -> None:
         num_shards = self.config.get(StateOptions.NUM_KEY_SHARDS)
         slots = self.config.get(StateOptions.SLOTS_PER_SHARD)
-        inflight = self.config.get(PipelineOptions.MAX_INFLIGHT_STEPS)
+        self._base_inflight = int(
+            self.config.get(PipelineOptions.MAX_INFLIGHT_STEPS))
+        # sub-batching dispatches K steps per logical batch, each 1/K
+        # the records: scale the in-flight credit so pipeline depth
+        # measured in LOGICAL batches (and therefore in bytes queued on
+        # the transport) is unchanged — emit polls read only landed
+        # ring copies, so the deeper sub-step queue never parks a drain
+        # behind in-flight compute. A device chain whose source cannot
+        # subdivide still steps at LOGICAL granularity; its operator is
+        # reset to the base credit at chain attach (the scaled credit
+        # there would queue K× the bytes, not the same bytes).
+        inflight = self._base_inflight * self._sub_batches
         xcap = self.config.get(PipelineOptions.EXCHANGE_CAPACITY)
         if xcap < 0:
             raise ValueError(
@@ -403,6 +440,10 @@ class Driver:
             for nid, op in self._ops.items()}
         return {
             "sources": {sid: dict(pos) for sid, pos in self._positions.items()},
+            # the sub-batch factor positions were counted under (device
+            # chains iterate a subdivided stream): restore re-bases
+            # positions when the factor differs — see _run_loop
+            "sub_factors": dict(self._sub_factor),
             "wm_gens": {sid: [g.snapshot() for g in gens]
                         for sid, gens in self._wm_gens.items()},
             "max_ts": dict(self._max_ts),
@@ -426,6 +467,9 @@ class Driver:
     def _restore(self, payload: Dict[str, Any]) -> None:
         self._positions = {sid: dict(pos)
                            for sid, pos in payload["sources"].items()}
+        self._restored_sub_factors = {
+            int(k): int(v)
+            for k, v in payload.get("sub_factors", {}).items()}
         # time-state keys may be absent: a state-processor savepoint
         # with reset_watermarks() restarts event time from scratch
         for sid, states in payload.get("wm_gens", {}).items():
@@ -765,7 +809,15 @@ class Driver:
         operator when the topology allows it: single downstream window
         node keyed on the source's key field, single process, and an
         operator config the devgen kernel can host (the operator's own
-        gate). Any miss falls back to normal host materialization."""
+        gate). Any miss falls back to normal host materialization.
+
+        ``pipeline.sub-batches`` > 1: the source is SUBDIVIDED before
+        attach — the operator's step program runs at sub-batch
+        granularity (bit-exact slices of the logical stream), so fires
+        ride each sub-step's dispatch and positions count sub-batches
+        (``self._sub_factor[sid]``). A source that declares no
+        subdivision chains at logical granularity — sub-batch fire
+        cadence then applies only to host-fed sources."""
         from flink_tpu.api.sources import DeviceGeneratorSource
 
         src = n.source
@@ -778,10 +830,26 @@ class Driver:
         if (wn.kind != "window"
                 or getattr(wn, "key_field", None) != src.key_field):
             return
+        factor = 1
+        if self._sub_batches > 1 and src.subdivide is not None:
+            # a declared-but-failing subdivision is a config error (the
+            # source's batch size does not split into K) — loud, not a
+            # silent fall back to full-batch fire cadence
+            src = src.subdivided(self._sub_batches)
+            factor = self._sub_batches
         op = self._ops.get(wid)
         if op is not None and hasattr(op, "attach_device_source") \
                 and op.attach_device_source(src):
             self._dev_chains[sid] = wid
+            if factor > 1:
+                self._sub_factor[sid] = factor
+                self._dev_subdivided[sid] = src
+            elif self._sub_batches > 1:
+                # the chain stays at LOGICAL granularity (no subdivide
+                # callable): the ×K in-flight credit from _build_ops
+                # would let K× the bytes queue before throttle engages
+                # — restore the base depth for this operator
+                op.max_inflight_steps = self._base_inflight
 
     def _enumerate_owned(self, sid: int, n_splits: int) -> List[int]:
         """Which split indices THIS runner reads (ref: FLIP-27
@@ -1056,6 +1124,12 @@ class Driver:
             MetricsServer(self.registry, port, bind) if port else None)
         self._emit_q = queue.Queue()
         self._drain_discard = [False]  # fresh cell per run (see __init__)
+        # per-op device profiling window (pipeline.profile-dir): wraps
+        # N warm driver steps in jax.profiler.trace and reduces the
+        # trace to a per-op summary (obs/profiling.py) — the §8.5 seam
+        from flink_tpu.obs.profiling import StepProfiler
+
+        self._profiler = StepProfiler.from_config(self.config)
         drain = threading.Thread(target=self._drain_loop, daemon=True)
         drain.start()
         try:
@@ -1114,6 +1188,10 @@ class Driver:
             for nid, op in self._ops.items():
                 if self.plan.node(nid).kind == "async_io":
                     op.close()
+            # a trace window left open by the failure must be stopped —
+            # a dangling jax profiler session would poison the next run
+            if self._profiler is not None:
+                self._profiler.close()
             raise
         finally:
             if self._ckpt_executor is not None:
@@ -1154,8 +1232,20 @@ class Driver:
                     "v1 — the DCN rendezvous is a per-step streaming "
                     "protocol; cross-host batch needs a partition-file "
                     "transfer plane (out of scope, see COMPONENTS #57)")
+            if self._sub_batches > 1:
+                raise NotImplementedError(
+                    "pipeline.sub-batches > 1 is single-process in v1 "
+                    "— the DCN rendezvous (watermark/termination/"
+                    "checkpoint consensus) is a per-LOGICAL-batch "
+                    "protocol; interleaving sub-batch fires would need "
+                    "a sub-step rendezvous. Run cross-host jobs with "
+                    "sub-batches=1")
             self._dcn = self._dcn_connect()
 
+        # per-source sub-batch factor the restored checkpoint's positions
+        # were written under (see _snapshot "sub_factors"); {} = fresh
+        # run or pre-sub-batch checkpoint (factor 1 everywhere)
+        self._restored_sub_factors: Dict[int, int] = {}
         if restore:
             from flink_tpu.checkpoint.storage import FsCheckpointStorage
 
@@ -1187,6 +1277,10 @@ class Driver:
         # the window operator's step program (see DeviceGeneratorSource
         # + ops/window.py devgen_step_kernel); maps sid -> window nid
         self._dev_chains: Dict[int, int] = {}
+        # sid -> the SUBDIVIDED source actually iterated this run
+        # (pipeline.sub-batches > 1 on a device chain); marker
+        # iteration, gen fallback, and positions all use it
+        self._dev_subdivided: Dict[int, Any] = {}
         prefetch = self.config.get(PipelineOptions.SOURCE_PREFETCH)
         for sid in self.plan.sources:
             n = self.plan.node(sid)
@@ -1195,6 +1289,18 @@ class Driver:
                 # devgen chain fuses per-step fire logic into the step
                 # program, which final-only firing deliberately skips
                 self._maybe_chain_device_source(sid, n)
+            # restored positions were written in the restoring run's
+            # sub-batch units — re-base them to THIS run's factor. Only
+            # positions landing on a common sub-batch boundary convert
+            # (a checkpoint cut mid-logical-batch at K=4 cannot resume
+            # at K=3); misaligned factors fail loudly here rather than
+            # silently replaying a partial logical batch.
+            old_f = int(self._restored_sub_factors.get(sid, 1))
+            new_f = int(self._sub_factor.get(sid, 1))
+            if old_f != new_f:
+                for i, p in list(self._positions[sid].items()):
+                    self._positions[sid][i] = _rebase_position(
+                        int(p), old_f, new_f, sid=sid, split_ix=i)
             splits = n.source.splits()
             owned = self._enumerate_owned(sid, len(splits))
             self._owned_splits[sid] = owned
@@ -1215,8 +1321,10 @@ class Driver:
                 if sid in self._dev_chains:
                     # no materialization, no feeder thread: the
                     # iterator yields per-batch metadata markers only
+                    # (sub-batch markers when the chain subdivided)
                     d[i] = _dev_batch_markers(
-                        n.source, self._positions[sid].get(i, 0))
+                        self._dev_subdivided.get(sid, n.source),
+                        self._positions[sid].get(i, 0))
                     continue
                 it = n.source.open_split(splits[i],
                                          self._positions[sid].get(i, 0))
@@ -1252,6 +1360,7 @@ class Driver:
                     if nxt is None:
                         splits_alive.remove(split_ix)
                         continue
+                    already_sub = False
                     if isinstance(nxt, _DevBatch):
                         op = self._ops[self._dev_chains[sid]]
                         with self._link_lock:
@@ -1264,9 +1373,18 @@ class Driver:
                                 self.metrics["records_in"] += nxt.n
                                 self.metrics["batches"] += 1
                         if ok:
-                            for op2 in self._ops.values():
-                                if hasattr(op2, "throttle"):
-                                    op2.throttle()
+                            # throttle probes cost a relay round trip
+                            # each — amortize them at LOGICAL-batch
+                            # granularity: only the last sub-batch of
+                            # its logical group rate-matches (the
+                            # in-flight credit was scaled by the same
+                            # factor in _build_ops, so depth in bytes
+                            # is unchanged)
+                            f = self._sub_factor.get(sid, 1)
+                            if f == 1 or (nxt.index + 1) % f == 0:
+                                for op2 in self._ops.values():
+                                    if hasattr(op2, "throttle"):
+                                        op2.throttle()
                             prof["push"] += time.perf_counter() - t2
                             self._positions[sid][split_ix] += 1
                             self._eps_meter.mark(nxt.n)
@@ -1279,32 +1397,29 @@ class Driver:
                         # a devgen gate closed for this batch (ring
                         # outgrew the header, oversized lateness span):
                         # materialize it on the host and push normally
-                        nxt = self.plan.node(sid).source.gen(
+                        # (the subdivided stream's gen yields the same
+                        # bit-exact sub-batch slice — already at
+                        # sub-batch size, so the host path must not
+                        # slice it K ways again)
+                        already_sub = self._sub_factor.get(sid, 1) > 1
+                        nxt = self._dev_subdivided.get(
+                            sid, self.plan.node(sid).source).gen(
                             "0", nxt.index)
                     data, ts = nxt
                     ts = np.asarray(ts, np.int64)
-                    for data_c, ts_c in self._debloat_split(data, ts):
-                        valid = np.ones(len(ts_c), bool)
-                        # yield the transport to a drain fetch in
-                        # progress (see _link_lock): blocks only while
-                        # one is active
-                        with self._link_lock:
-                            pass
-                        t2 = time.perf_counter()
-                        prof["link_lock_wait"] += t2 - t1
-                        with self._push_lock:
-                            self.metrics["records_in"] += len(ts_c)
-                            self.metrics["batches"] += 1
-                            self._push_downstream(
-                                sid, (dict(data_c), ts_c, valid))
-                        # backpressure wait OUTSIDE the lock: the drain
-                        # thread must be able to deliver while ingest
-                        # blocks on the device pipeline
-                        for op in self._ops.values():
-                            if hasattr(op, "throttle"):
-                                op.throttle()
-                        prof["push"] += time.perf_counter() - t2
-                        t1 = time.perf_counter()
+                    if self._sub_batches > 1 and not already_sub:
+                        # sub-batch fire/emit decoupling, host plane:
+                        # K equal slices, each followed by a watermark
+                        # advance + fire dispatch, so fired rows reach
+                        # the drain at sub-batch cadence. Position /
+                        # eps / max-ts accounting stays below, at
+                        # logical-batch granularity.
+                        t1 = self._ingest_host_subbatched(
+                            sid, split_ix, splits_alive, data, ts, t1)
+                    else:
+                        for data_c, ts_c in self._debloat_split(data, ts):
+                            t1 = self._push_source_chunk(
+                                sid, data_c, ts_c, t1)
                     self._advance_position(sid, split_ix, data, ts)
                     self._eps_meter.mark(len(ts))
                     if len(ts):
@@ -1313,22 +1428,15 @@ class Driver:
                         self._wm_gens[sid][split_ix].on_batch(mx)
                         self._wm_lag.set(mx - self._out_wm[sid])
                 # exhausted splits stop holding the watermark back
-                # (ref: idle-channel handling in the valve). Combines run
-                # over OWNED splits only — an enumerator-assigned subset
-                # must not let never-advancing foreign splits pin the
-                # watermark at the floor.
-                gens = [self._wm_gens[sid][i] for i in splits_alive]
-                owned = self._owned_splits.get(sid) or []
-                if gens:
-                    self._out_wm[sid] = min(g.current() for g in gens)
-                elif owned:
-                    self._out_wm[sid] = min(
-                        self._wm_gens[sid][i].current() for i in owned)
+                # (ref: idle-channel handling in the valve)
+                self._recombine_source_wm(sid, splits_alive)
                 t3 = time.perf_counter()
                 with self._push_lock:
                     self._propagate_watermarks()
                 prof["advance_wm"] += time.perf_counter() - t3
                 self._check_drain_error()
+            if self._profiler is not None:
+                self._profiler.step()
             self._debloat_adjust()
             # operator-triggered savepoint (CLI `savepoint` command):
             # synchronous + retained, at this batch boundary
@@ -1412,6 +1520,10 @@ class Driver:
         final.update(self.registry.snapshot())
         for k, v in self.prof.items():
             final[f"profile.driver.{k}"] = v
+        if self._profiler is not None:
+            summary = self._profiler.close()
+            if summary is not None:
+                final["profile.trace_summary"] = summary
         for nid, op in self._ops.items():
             for k, v in getattr(op, "prof", {}).items():
                 final[f"profile.op{nid}.{k}"] = final.get(
@@ -1594,6 +1706,78 @@ class Driver:
         with self._push_lock:
             self._propagate_watermarks(final=True, only=only)
         self._flush_emits()
+
+    def _push_source_chunk(self, sid: int, data_c, ts_c,
+                           t1: float) -> float:
+        """Push ONE ingest chunk downstream (the hot-loop body shared
+        by the plain and sub-batched paths): link-quiet handshake,
+        locked push + metrics, backpressure wait OUTSIDE the lock.
+        Returns the next chunk's profiling anchor."""
+        prof = self.prof
+        valid = np.ones(len(ts_c), bool)
+        # yield the transport to a drain fetch in progress (see
+        # _link_lock): blocks only while one is active
+        with self._link_lock:
+            pass
+        t2 = time.perf_counter()
+        prof["link_lock_wait"] += t2 - t1
+        with self._push_lock:
+            self.metrics["records_in"] += len(ts_c)
+            self.metrics["batches"] += 1
+            self._push_downstream(sid, (dict(data_c), ts_c, valid))
+        # backpressure wait OUTSIDE the lock: the drain thread must be
+        # able to deliver while ingest blocks on the device pipeline
+        for op in self._ops.values():
+            if hasattr(op, "throttle"):
+                op.throttle()
+        prof["push"] += time.perf_counter() - t2
+        return time.perf_counter()
+
+    def _recombine_source_wm(self, sid: int, splits_alive) -> None:
+        """Source watermark = min over ALIVE split generators (a
+        lagging split must hold it back); exhausted splits drop out.
+        Combines run over OWNED splits only — an enumerator-assigned
+        subset must not let never-advancing foreign splits pin the
+        watermark at the floor."""
+        gens = [self._wm_gens[sid][i] for i in splits_alive]
+        owned = self._owned_splits.get(sid) or []
+        if gens:
+            self._out_wm[sid] = min(g.current() for g in gens)
+        elif owned:
+            self._out_wm[sid] = min(
+                self._wm_gens[sid][i].current() for i in owned)
+
+    def _ingest_host_subbatched(self, sid: int, split_ix: int,
+                                splits_alive, data, ts,
+                                t1: float) -> float:
+        """Host-plane sub-batching (pipeline.sub-batches = K > 1): the
+        logical batch is pushed as K equal slices, and after EACH slice
+        the watermark clock advances and fires dispatch — a fired
+        window's rows become host-visible at sub-batch cadence instead
+        of waiting out the whole logical batch. Record order is
+        untouched (slices are contiguous), so watermark semantics and
+        committed rows match the K=1 run; only fire GROUPING is finer.
+        Position advance and throughput accounting stay with the
+        caller, at logical-batch granularity."""
+        prof = self.prof
+        n = len(ts)
+        sub = max(1, -(-n // self._sub_batches))  # ceil: ragged tails
+        gens = self._wm_gens[sid]
+        for lo in range(0, n, sub):
+            hi = min(lo + sub, n)
+            data_s = {k: v[lo:hi] for k, v in data.items()}
+            ts_s = ts[lo:hi]
+            for data_c, ts_c in self._debloat_split(data_s, ts_s):
+                t1 = self._push_source_chunk(sid, data_c, ts_c, t1)
+            if len(ts_s):
+                gens[split_ix].on_batch(int(ts_s.max()))
+            self._recombine_source_wm(sid, splits_alive)
+            t3 = time.perf_counter()
+            with self._push_lock:
+                self._propagate_watermarks()
+            prof["advance_wm"] += time.perf_counter() - t3
+            self._check_drain_error()
+        return t1
 
     def _advance_position(self, sid: int, split_ix: int, data, ts) -> None:
         """One consumed source batch: the SOURCE defines what the next
@@ -1799,7 +1983,16 @@ class Driver:
         self._emit_fired_sync(nid, fired, time.time())
 
     def _emit_fired_sync(self, nid: int, fired, stamp: float) -> None:
-        out = dict(fired)
+        ring_origin = getattr(fired, "_ring", False)
+        out = dict(fired)  # materializes lazy FiredWindows
+        if ring_origin:
+            # emit-ring fires: one latency sample PER FIRE COHORT whose
+            # rows this drain made host-visible, stamped NOW (delivery)
+            # against each cohort's own dispatch time. The per-batch
+            # sample below would attribute every coalesced sub-batch
+            # fire to the OLDEST queue item's stamp — overstating p99
+            # exactly when sub-batching improves it.
+            self._note_ring_latency(nid)
         if "__ts__" in out:
             # process-function emissions: explicit per-row timestamps
             ts = np.asarray(out.pop("__ts__"), np.int64)
@@ -1816,7 +2009,17 @@ class Driver:
         self._push_downstream(nid, (out, ts, valid))
         # latency marker: watermark-advance dispatch → delivered at sink
         # (ref: streaming/runtime/streamrecord/LatencyMarker.java)
-        self._lat_hist.update((time.time() - stamp) * 1000.0)
+        if not ring_origin:
+            self._lat_hist.update((time.time() - stamp) * 1000.0)
+
+    def _note_ring_latency(self, nid: int) -> None:
+        op = self._ops.get(nid)
+        take = getattr(op, "take_delivered_fire_stamps", None)
+        if take is None:
+            return
+        now = time.time()
+        for fire_stamp in take():
+            self._lat_hist.update((now - fire_stamp) * 1000.0)
 
     def _stateless_downstream(self, nid: int) -> bool:
         """True iff nothing stateful (window/session/join) is reachable
@@ -2030,6 +2233,25 @@ class _Prefetcher:
             self._done = True
             raise item
         return item
+
+
+def _rebase_position(pos: int, old_f: int, new_f: int, *,
+                     sid: int = 0, split_ix: int = 0) -> int:
+    """Convert a source replay position between sub-batch factors: a
+    position counted in old_f sub-batches per logical batch becomes the
+    equivalent count in new_f units. Only positions on a common
+    sub-batch boundary convert (a checkpoint cut mid-logical-batch at
+    K=4 cannot resume at K=3) — misalignment fails loudly rather than
+    silently replaying a partial logical batch."""
+    scaled = pos * new_f
+    if scaled % old_f:
+        raise ValueError(
+            f"checkpoint position {pos} of source {sid} split "
+            f"{split_ix} was taken at sub-batch factor {old_f} and "
+            f"does not align to factor {new_f} — restore with the "
+            "original pipeline.sub-batches, or from a logical-batch-"
+            "aligned checkpoint")
+    return scaled // old_f
 
 
 _FINAL = np.iinfo(np.int64).max  # end-of-input marker watermark

@@ -77,3 +77,8 @@ def pytest_configure(config):
         "parallel/hostpool.py) — pool unit tests and the serial-vs-"
         "parallel byte-identical parity gates on the sessions, "
         "windowAll, and spill golden pipelines (tier-1)")
+    config.addinivalue_line(
+        "markers", "subbatch: sub-batch fire/emit decoupling "
+        "(pipeline.sub-batches) — K-parity gates on the golden Q5/"
+        "sessions pipelines, checkpoint/restore across a sub-batch "
+        "boundary, chaos at K=4, and the CLI smoke (tier-1)")
