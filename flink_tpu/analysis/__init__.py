@@ -1,32 +1,51 @@
 """Static analysis — catch correctness bugs before the first record flows.
 
-Two planes (ref: the validation pass of Flink's StreamGraph translation
-— StreamGraphGenerator / StreamingJobGraphGenerator reject malformed
-graphs at compile time, SURVEY §3.2; bounded-execution validation,
-§3.6 — generalized into a rule engine):
+Three planes (ref: the validation pass of Flink's StreamGraph
+translation — StreamGraphGenerator / StreamingJobGraphGenerator reject
+malformed graphs at compile time, SURVEY §3.2; bounded-execution
+validation, §3.6 — generalized into a rule engine):
 
-- **Plan analysis** (``plan_rules.py``): walks a lowered
-  ``ExecutionPlan`` + its ``Configuration`` and reports structured
-  findings — misconfigurations that would otherwise fail minutes into a
-  run (unbounded source in batch mode, two writers on one log topic,
-  fault rules that match nothing) or silently corrupt results
-  (event-time windows with no watermark strategy, non-transactional
-  sinks under exactly-once). The driver runs it automatically at submit
-  (``analysis.fail-on``); ``python -m flink_tpu analyze`` runs it
-  standalone.
+- **Plan analysis** (``plan_rules.py``): linear rules over a lowered
+  ``ExecutionPlan`` + its ``Configuration`` — misconfigurations that
+  would otherwise fail minutes into a run (unbounded source in batch
+  mode, two writers on one log topic, fault rules that match nothing)
+  or silently corrupt results (event-time windows with no watermark
+  strategy, non-transactional sinks under exactly-once). The driver
+  runs every plane automatically at submit (``analysis.fail-on``);
+  ``python -m flink_tpu analyze`` runs them standalone.
+
+- **Dataflow analysis** (``dataflow.py``): ONE topological abstract
+  interpretation propagating three lattices edge-by-edge — record
+  schema (source declarations + compiler-recorded op schemas + abstract
+  evaluation of chain fns on empty typed batches), state-growth bounds
+  (bounded-by-geometry with a bytes-per-key estimate vs unbounded, from
+  assigner/trigger/evictor/gap/skip-strategy facts), and watermark
+  capability (event / processing / no time axis per leg). The dataflow
+  rules (field-not-in-schema, union mismatch, unbounded growth, stalled
+  legs, exactly-once taint through log topics, state budgets) read the
+  propagated facts; ``analyze --explain`` prints them per node.
 
 - **Repo AST lints** (``pylints.py``): a pure-stdlib ``ast`` pass over
   the codebase itself — tracer leaks in jit kernels (host conversions /
   Python branches on traced values, the failure class PROFILE §8.1's
   design rules exist to prevent), fault-point literals drifting from
-  the ``faults.py`` registry, config/metric name drift. Run via
-  ``python -m flink_tpu lint`` or ``tools/lint.py``; the dogfood gate
-  (tests/test_analysis.py) keeps the shipped tree at zero findings.
+  the ``faults.py`` registry, config/metric name drift, and unlocked
+  shared-state writes in HostPool task closures (the concurrency
+  plane). Run via ``python -m flink_tpu lint`` or ``tools/lint.py``;
+  the dogfood gate (tests/test_analysis.py) keeps the shipped tree at
+  zero findings.
 
-Honest scope: a LINEAR rule engine — each rule is one walk over the
-plan or the AST. No dataflow analysis, no abstract interpretation, no
-cross-function taint; the tracer-leak lint tracks only direct uses of
-a jit-traced parameter inside its own kernel body.
+RULES.md is GENERATED from the registrations (``docs.py`` +
+``tools/gen_rules.py``) with a tier-1 staleness gate, so a rule cannot
+ship undocumented.
+
+Honest scope: the dataflow plane has no cross-function taint (a field
+smuggled through opaque user state is invisible), no symbolic shapes
+(state estimates use declared config geometry, not data), and schema
+facts stop at the first chain that is opaque to empty-batch
+evaluation; the tracer-leak lint tracks only direct uses of a
+jit-traced parameter inside its own kernel body, and the concurrency
+lint sees one call hop from the submitted closure.
 """
 from flink_tpu.analysis.core import (
     AnalysisError,

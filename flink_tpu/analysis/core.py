@@ -80,16 +80,51 @@ class AnalysisError(ValueError):
 # -- rule registry ----------------------------------------------------------
 
 RuleFn = Callable[[Any, Any], Iterable[Finding]]
-# (rule_id, severity, needs_plan, fn)
-_RULES: List[Tuple[str, str, bool, RuleFn]] = []
+
+# the documented analysis planes (RULES.md groups by these): "plan" =
+# linear walks over the lowered plan, "config" = Configuration alone,
+# "dataflow" = rules over the propagated lattices (analysis/dataflow.py);
+# the repo AST lints are a sibling "pylint" plane (pylints.LINT_CATALOG)
+PLANES = ("plan", "config", "dataflow")
 
 
-def _register(rule_id: str, severity: str, needs_plan: bool):
+@dataclasses.dataclass(frozen=True)
+class RuleInfo:
+    """One registered rule's catalog entry — what RULES.md renders and
+    the coverage test parametrizes over."""
+
+    rule_id: str
+    severity: str
+    plane: str
+    needs_plan: bool
+    description: str  # first sentence of the rule's docstring
+    fix: str          # catalog-level fix hint (findings carry specifics)
+    fn: RuleFn
+
+
+_RULES: List[RuleInfo] = []
+
+
+def _doc_summary(fn: RuleFn) -> str:
+    """First sentence of the rule docstring, whitespace-collapsed —
+    the one-line description RULES.md publishes."""
+    doc = " ".join((fn.__doc__ or "").split())
+    for stop in (". ", ".\n"):
+        if stop in doc:
+            return doc.split(stop, 1)[0] + "."
+    return doc
+
+
+def _register(rule_id: str, severity: str, needs_plan: bool, plane: str,
+              fix: str):
     if severity not in SEVERITIES:
         raise ValueError(f"bad severity {severity!r} for rule {rule_id}")
+    if plane not in PLANES:
+        raise ValueError(f"bad plane {plane!r} for rule {rule_id}")
 
     def deco(fn: RuleFn) -> RuleFn:
-        _RULES.append((rule_id, severity, needs_plan, fn))
+        _RULES.append(RuleInfo(rule_id, severity, plane, needs_plan,
+                               _doc_summary(fn), fix, fn))
         fn.rule_id = rule_id
         fn.severity = severity
         return fn
@@ -97,44 +132,76 @@ def _register(rule_id: str, severity: str, needs_plan: bool):
     return deco
 
 
-def plan_rule(rule_id: str, severity: str):
+def plan_rule(rule_id: str, severity: str, plane: str = "plan",
+              fix: str = ""):
     """Register a rule that needs a lowered ExecutionPlan."""
-    return _register(rule_id, severity, needs_plan=True)
+    return _register(rule_id, severity, needs_plan=True, plane=plane,
+                     fix=fix)
 
 
-def config_rule(rule_id: str, severity: str):
+def config_rule(rule_id: str, severity: str, fix: str = ""):
     """Register a rule over the Configuration alone."""
-    return _register(rule_id, severity, needs_plan=False)
+    return _register(rule_id, severity, needs_plan=False, plane="config",
+                     fix=fix)
 
 
 def rule_catalog() -> List[Tuple[str, str]]:
     """(rule_id, severity) of every registered rule — docs and the
     coverage test read this so no rule can ship untested."""
     _load_rules()
-    return [(rid, sev) for rid, sev, _, _ in _RULES]
+    return [(r.rule_id, r.severity) for r in _RULES]
+
+
+def rule_catalog_full() -> List[RuleInfo]:
+    """Every registered rule with plane/description/fix metadata — the
+    RULES.md generation surface (analysis/docs.py)."""
+    _load_rules()
+    return list(_RULES)
 
 
 def _load_rules() -> None:
-    # rule definitions live in plan_rules.py; importing it populates the
-    # registry (idempotent — the registry appends only at module init)
-    from flink_tpu.analysis import plan_rules  # noqa: F401
+    # rule definitions live in plan_rules.py + dataflow.py; importing
+    # them populates the registry (idempotent — the registry appends
+    # only at module init)
+    from flink_tpu.analysis import dataflow, plan_rules  # noqa: F401
 
 
-def analyze(plan: Any, config: Any) -> List[Finding]:
+def analyze(plan: Any, config: Any, *,
+            eval_chains: bool = True) -> List[Finding]:
     """Run every rule over (plan, config). ``plan`` may be None to run
-    configuration rules alone (the conf-only CLI path)."""
+    configuration rules alone (the conf-only CLI path).
+
+    ``eval_chains`` gates the dataflow plane's abstract evaluation of
+    user chain functions on empty typed batches (schema inference
+    through map/filter/flat_map). The explicit surfaces — ``env
+    .analyze()`` and ``python -m flink_tpu analyze`` — evaluate them;
+    the DRIVER's automatic submit-time pass does not (a user fn with
+    observable side effects must never see a phantom empty batch just
+    because the job was submitted), so submit-time schema facts stop at
+    the first opaque chain."""
     _load_rules()
+    from flink_tpu.analysis import dataflow
+
     out: List[Finding] = []
-    for rule_id, severity, needs_plan, fn in _RULES:
-        if needs_plan and plan is None:
-            continue
-        for f in fn(plan, config):
-            # the registration owns id+severity; rules fill the rest
-            out.append(dataclasses.replace(
-                f, rule=rule_id, severity=severity))
-    out.sort(key=lambda f: (_SEV_ORDER[f.severity], f.rule, f.node or 0,
-                            f.file, f.line))
+    with dataflow.chain_eval_mode(eval_chains):
+        for info in _RULES:
+            if info.needs_plan and plan is None:
+                continue
+            for f in info.fn(plan, config):
+                # the registration owns id+severity; rules fill the rest
+                out.append(dataclasses.replace(
+                    f, rule=info.rule_id, severity=info.severity))
+    out.sort(key=finding_sort_key)
     return out
+
+
+def finding_sort_key(f: Finding):
+    """Deterministic report order: severity, rule, then node index with
+    config-level findings (node=None) explicitly LAST — ``f.node or 0``
+    used to conflate node 0 with None, so a rule firing on both gave an
+    input-order-dependent interleave (regression-tested)."""
+    return (_SEV_ORDER[f.severity], f.rule, f.node is None,
+            f.node if f.node is not None else 0, f.file, f.line)
 
 
 def analyze_config(config: Any) -> List[Finding]:

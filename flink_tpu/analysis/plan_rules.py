@@ -83,7 +83,8 @@ def _is_event_time(node) -> bool:
     return bool(getattr(assigner, "is_event_time", True))
 
 
-@plan_rule("EVENT_TIME_NO_WATERMARK", "warn")
+@plan_rule("EVENT_TIME_NO_WATERMARK", "warn",
+           fix="pass a WatermarkStrategy to from_source()")
 def event_time_no_watermark(plan, config) -> Iterable[Finding]:
     """Event-time op fed by a source with no explicit watermark
     strategy: the pipeline-default monotonous clock treats ANY
@@ -104,12 +105,13 @@ def event_time_no_watermark(plan, config) -> Iterable[Finding]:
                     node=node.id, node_name=node.name)
 
 
-@plan_rule("NON_TRANSACTIONAL_SINK", "warn")
+@plan_rule("NON_TRANSACTIONAL_SINK", "warn",
+           fix="use a TwoPhaseCommitSink or disable checkpointing")
 def non_transactional_sink(plan, config) -> Iterable[Finding]:
     """Checkpointing is on (exactly-once intended) but a sink writes
     through: a recovery replays the uncheckpointed tail into it —
     at-least-once output, duplicates on every restore."""
-    from flink_tpu.api.sinks import Sink
+    from flink_tpu.api.sinks import sink_is_transactional
     from flink_tpu.config import CheckpointingOptions
 
     if config.get(CheckpointingOptions.INTERVAL) <= 0:
@@ -117,13 +119,9 @@ def non_transactional_sink(plan, config) -> Iterable[Finding]:
     for node in plan.nodes.values():
         if node.kind != "sink" or node.sink is None:
             continue
-        cls = type(node.sink)
-        transactional = (
-            cls.prepare_commit is not Sink.prepare_commit
-            or cls.snapshot_staged is not Sink.snapshot_staged)
-        if not transactional:
+        if not sink_is_transactional(node.sink):
             yield _f(
-                f"sink {node.name!r} ({cls.__name__}) is not "
+                f"sink {node.name!r} ({type(node.sink).__name__}) is not "
                 "transactional but execution.checkpointing.interval is "
                 "set — recovery will replay the un-checkpointed tail "
                 "into it (duplicates; at-least-once, not exactly-once)",
@@ -132,7 +130,8 @@ def non_transactional_sink(plan, config) -> Iterable[Finding]:
                 node=node.id, node_name=node.name)
 
 
-@plan_rule("UNBOUNDED_SOURCE_IN_BATCH", "error")
+@plan_rule("UNBOUNDED_SOURCE_IN_BATCH", "error",
+           fix="bound the source or run in streaming mode")
 def unbounded_source_in_batch(plan, config) -> Iterable[Finding]:
     """Batch (bounded) mode requires every source to end: stages run to
     completion in topological waves — an unbounded source never lets
@@ -153,7 +152,8 @@ def unbounded_source_in_batch(plan, config) -> Iterable[Finding]:
                 node=node.id, node_name=node.name)
 
 
-@plan_rule("KEYED_OP_WITHOUT_KEYBY", "error")
+@plan_rule("KEYED_OP_WITHOUT_KEYBY", "error",
+           fix="insert .key_by(...) before the stateful op")
 def keyed_op_without_keyby(plan, config) -> Iterable[Finding]:
     """A keyed stateful op whose input edge never went through a keyBy
     exchange: state would partition on whatever column happens to share
@@ -169,7 +169,8 @@ def keyed_op_without_keyby(plan, config) -> Iterable[Finding]:
                 node=node.id, node_name=node.name)
 
 
-@plan_rule("WINDOW_WITHOUT_FIRE_BOUND", "error")
+@plan_rule("WINDOW_WITHOUT_FIRE_BOUND", "error",
+           fix="set a trigger or use a time-bounded assigner")
 def window_without_fire_bound(plan, config) -> Iterable[Finding]:
     """A GlobalWindows op with no trigger never fires: every record is
     state forever — unbounded growth and zero output."""
@@ -190,7 +191,8 @@ def window_without_fire_bound(plan, config) -> Iterable[Finding]:
                 node=node.id, node_name=node.name)
 
 
-@plan_rule("LOG_TOPIC_MULTI_WRITER", "error")
+@plan_rule("LOG_TOPIC_MULTI_WRITER", "error",
+           fix="one LogSink per topic (union streams if needed)")
 def log_topic_multi_writer(plan, config) -> Iterable[Finding]:
     """Two LogSinks on one topic directory: the embedded log is
     single-writer by design (no broker to serialize appends) — a second
@@ -219,7 +221,8 @@ def log_topic_multi_writer(plan, config) -> Iterable[Finding]:
                     node=node.id, node_name=node.name)
 
 
-@config_rule("FAULT_POINT_UNKNOWN", "error")
+@config_rule("FAULT_POINT_UNKNOWN", "error",
+             fix="match a faults.KNOWN_FAULT_POINTS entry")
 def fault_point_unknown(plan, config) -> Iterable[Finding]:
     """A faults.inject rule whose point glob matches no registered
     fault point injects NOTHING — a chaos conf that silently does
@@ -250,7 +253,8 @@ def fault_point_unknown(plan, config) -> Iterable[Finding]:
                     "for the registry")
 
 
-@config_rule("CONFIG_KEY_UNKNOWN", "warn")
+@config_rule("CONFIG_KEY_UNKNOWN", "warn",
+             fix="fix the typo or declare the ConfigOption")
 def config_key_unknown(plan, config) -> Iterable[Finding]:
     """A set key outside the declared option grammar is almost always a
     typo — the job silently runs with the default of the key you meant."""
@@ -269,7 +273,8 @@ def config_key_unknown(plan, config) -> Iterable[Finding]:
                      "prefix, config.declare_dynamic_prefix)"))
 
 
-@config_rule("HOST_PARALLELISM_INVALID", "warn")
+@config_rule("HOST_PARALLELISM_INVALID", "warn",
+             fix="set 1 <= host.parallelism <= os.cpu_count()")
 def host_parallelism_invalid(plan, config) -> Iterable[Finding]:
     """host.parallelism outside [1, os.cpu_count()]: below 1 the driver
     cannot size the shared host pool and rejects the job at build;
@@ -301,7 +306,8 @@ def host_parallelism_invalid(plan, config) -> Iterable[Finding]:
                 f"min(4, os.cpu_count()) = {min(4, ncpu)})")
 
 
-@config_rule("SUBBATCH_INVALID", "error")
+@config_rule("SUBBATCH_INVALID", "error",
+             fix="pick a divisor of pipeline.microbatch-size")
 def subbatch_invalid(plan, config) -> Iterable[Finding]:
     """pipeline.sub-batches misconfigurations the driver would reject
     at build (or that silently defeat the feature): a count below 1, a
@@ -353,7 +359,8 @@ def subbatch_invalid(plan, config) -> Iterable[Finding]:
                     "wall time")
 
 
-@config_rule("CHECKPOINT_IN_BATCH", "error")
+@config_rule("CHECKPOINT_IN_BATCH", "error",
+             fix="drop checkpointing config or run in streaming mode")
 def checkpoint_in_batch(plan, config) -> Iterable[Finding]:
     """Bounded-mode recovery is re-execution: nothing checkpoints, so a
     checkpoint interval or an explicit restore path is a config

@@ -31,6 +31,16 @@ Rule catalog:
   silently wins.
 - ``METRIC_NAME_INVALID`` (warn): a metric/group name literal outside
   the ``[a-z0-9_]`` snake-case grammar every dashboard keys on.
+- ``HOSTPOOL_SHARED_WRITE`` (warn): the CONCURRENCY plane — a closure
+  submitted to ``HostPool.run_tasks`` assigns through a free variable
+  (``self.total += n``, ``shared[k] = v``, ``nonlocal``/``global``)
+  outside a ``with <...lock...>:`` guard. Pool tasks run on worker
+  threads; an unguarded read-modify-write on shared state is exactly
+  the race class PR 5 fixed by hand in ``obs/metrics.py`` (Counter's
+  ``self._v += n``). The sanctioned disciplines (parallel/hostpool.py):
+  RETURN a partial and let the caller combine (results come back in
+  submission order), or guard the write with a lock whose name
+  contains "lock" — the lint keys on the name.
 
 Honest scope (linear, syntactic): "derived from a traced parameter"
 is one assignment hop inside the kernel body — no fixpoint, no
@@ -40,7 +50,13 @@ attributes (``.shape``/``.ndim``/``.dtype``/``.size``), ``len()``,
 tracing). Only functions jitted DIRECTLY (``@jit`` decorators or
 ``jax.jit(f)`` / ``jax.jit(shard_map(f, ...))`` on a local def) are
 kernels: a helper merely *called* from a kernel may legitimately
-receive concrete Python values, so it is out of scope.
+receive concrete Python values, so it is out of scope. The hostpool
+lint covers closures reachable from the ``run_tasks`` call site — a
+lambda/def in the argument list (incl. list literals/comprehensions),
+a local name the file assigns/appends such closures to, and ONE call
+hop into a local def the closure body invokes by name; writes through
+closure PARAMETERS are per-task by convention and out of scope, as
+are mutating method calls (``shared.append(x)``).
 """
 from __future__ import annotations
 
@@ -52,14 +68,41 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from flink_tpu.analysis.core import Finding
 
-LINT_RULES: Tuple[Tuple[str, str], ...] = (
-    ("TRACER_HOST_CALL", "error"),
-    ("TRACER_BRANCH", "error"),
-    ("FAULT_POINT_DRIFT", "error"),
-    ("CONFIG_KEY_DRIFT", "error"),
-    ("CONFIG_OPTION_DUP", "error"),
-    ("METRIC_NAME_INVALID", "warn"),
+# (rule id, severity, one-line description, fix hint) — the "pylint"
+# plane of RULES.md (analysis/docs.py renders this next to the plan/
+# config/dataflow catalog in core.rule_catalog_full()).
+LINT_CATALOG: Tuple[Tuple[str, str, str, str], ...] = (
+    ("TRACER_HOST_CALL", "error",
+     "Host conversion (float/int/bool, np.asarray, .item/.tolist) on a "
+     "traced value inside a jit kernel.",
+     "keep it on device (jnp) or hoist the conversion out"),
+    ("TRACER_BRANCH", "error",
+     "Python if/while/ternary or range() on a traced value inside a "
+     "jit kernel.",
+     "use lax.cond / jnp.where / lax.fori_loop"),
+    ("FAULT_POINT_DRIFT", "error",
+     "A faults.fire literal outside faults.KNOWN_FAULT_POINTS.",
+     "register the point or fix the literal"),
+    ("CONFIG_KEY_DRIFT", "error",
+     "A get_raw/Configuration key literal outside the declared option "
+     "grammar.",
+     "declare a ConfigOption / dynamic prefix, or fix the literal"),
+    ("CONFIG_OPTION_DUP", "error",
+     "One option key declared by two ConfigOption literals — last "
+     "registration silently wins.",
+     "reuse the existing ConfigOption constant"),
+    ("METRIC_NAME_INVALID", "warn",
+     "A metric/group name literal outside the snake_case grammar.",
+     "rename to lowercase snake_case"),
+    ("HOSTPOOL_SHARED_WRITE", "warn",
+     "A closure submitted to HostPool.run_tasks writes shared mutable "
+     "state (free-variable attribute/subscript target, nonlocal/"
+     "global) outside a lock guard.",
+     "guard the write with a lock, or return a partial and combine on "
+     "the caller"),
 )
+LINT_RULES: Tuple[Tuple[str, str], ...] = tuple(
+    (r, s) for r, s, _, _ in LINT_CATALOG)
 _SEV = dict(LINT_RULES)
 
 _METRIC_KINDS = ("counter", "gauge", "meter", "histogram")
@@ -430,6 +473,228 @@ def _lint_metric_names(tree: ast.Module, file: str) -> List[Finding]:
     return out
 
 
+# -- concurrency lint: shared writes in HostPool.run_tasks closures ---------
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """The base Name of an Attribute/Subscript chain (``self`` of
+    ``self.panes[p]``), or None when the base is not a plain name."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _lock_guarded_expr(node: ast.AST) -> bool:
+    """A with-item context expression that names a lock (any Name or
+    attribute segment containing 'lock', case-insensitive) — the
+    discipline marker parallel/hostpool.py documents."""
+    for c in ast.walk(node):
+        if isinstance(c, ast.Name) and "lock" in c.id.lower():
+            return True
+        if isinstance(c, ast.Attribute) and "lock" in c.attr.lower():
+            return True
+    return False
+
+
+class _SharedWriteVisitor(ast.NodeVisitor):
+    """Walk one task closure's body: flag Assign/AugAssign whose target
+    routes through a FREE variable (not a parameter, not a local)
+    unless the statement sits under a with-lock guard."""
+
+    def __init__(self, file: str, closure_name: str,
+                 local_names: Set[str]) -> None:
+        self.file = file
+        self.closure = closure_name
+        self.locals = set(local_names)
+        self.lock_depth = 0
+        self.findings: List[Finding] = []
+
+    def _flag(self, line: int, target_src: str) -> None:
+        self.findings.append(_finding(
+            "HOSTPOOL_SHARED_WRITE",
+            f"task closure {self.closure!r} writes shared state "
+            f"({target_src}) without a lock — run_tasks executes it on "
+            "a pool worker thread; unguarded read-modify-writes lose "
+            "updates (the obs/metrics.py Counter race class)",
+            self.file, line,
+            fix="guard the write with a `with <lock>:` block, or "
+                "return a partial and combine on the caller (results "
+                "arrive in submission order)"))
+
+    def _check_target(self, target: ast.AST, line: int) -> None:
+        if self.lock_depth > 0:
+            return
+        if isinstance(target, (ast.Attribute, ast.Subscript)):
+            root = _root_name(target)
+            if root is not None and root not in self.locals:
+                self._flag(line, ast.unparse(target))
+        elif isinstance(target, ast.Name):
+            # a bare-name write is local unless declared otherwise
+            # (visit_Nonlocal/Global remove such names from `locals`)
+            if target.id not in self.locals:
+                self._flag(line, target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._check_target(el, line)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._check_target(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Nonlocal(self, node: ast.Nonlocal) -> None:
+        self.locals.difference_update(node.names)
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self.locals.difference_update(node.names)
+
+    def visit_With(self, node: ast.With) -> None:
+        guarded = any(_lock_guarded_expr(i.context_expr)
+                      for i in node.items)
+        if guarded:
+            self.lock_depth += 1
+        self.generic_visit(node)
+        if guarded:
+            self.lock_depth -= 1
+
+    # nested defs/lambdas get their own scope; don't descend (only the
+    # submitted closure and its one-hop callee are in scope)
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        pass
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        pass
+
+
+def _fn_params(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def _fn_locals(fn: ast.AST) -> Set[str]:
+    """Parameters + bare names the body binds (assignments, for/with
+    targets, comprehension-free walk at this scope)."""
+    names = _fn_params(fn)
+    body = [fn.body] if isinstance(fn, ast.Lambda) else fn.body
+    for stmt in body:
+        for c in ast.walk(stmt):
+            if isinstance(c, ast.Assign):
+                for t in c.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+            elif isinstance(c, (ast.AnnAssign, ast.AugAssign,
+                                ast.NamedExpr)):
+                # `n: int = 0`, `n += 1` (local unless nonlocal/global
+                # — the visitor re-frees declared names), `(n := ...)`
+                if isinstance(c.target, ast.Name):
+                    names.add(c.target.id)
+            elif isinstance(c, (ast.For, ast.AsyncFor)):
+                for t in ast.walk(c.target):
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+            elif isinstance(c, (ast.With, ast.AsyncWith)):
+                for i in c.items:
+                    if isinstance(i.optional_vars, ast.Name):
+                        names.add(i.optional_vars.id)
+    return names
+
+
+def _called_local_defs(fn: ast.AST,
+                       defs_by_name: Dict[str, List[ast.AST]]
+                       ) -> List[ast.AST]:
+    """Local defs the closure body calls BY NAME — one call hop (the
+    `run_tasks([lambda a=a: merge(a)])` shape, where the real body
+    lives in `merge`)."""
+    body = [fn.body] if isinstance(fn, ast.Lambda) else fn.body
+    out: List[ast.AST] = []
+    for stmt in body:
+        for c in ast.walk(stmt):
+            if isinstance(c, ast.Call) and isinstance(c.func, ast.Name):
+                out.extend(defs_by_name.get(c.func.id, ()))
+    return out
+
+
+def _lint_hostpool_writes(tree: ast.Module, file: str) -> List[Finding]:
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    # name → closures the file binds into it (list/tuple literals,
+    # listcomp values, .append(lambda ...) / .append(local_def)) —
+    # resolves `run_tasks(tasks)`. Name references resolve to local
+    # defs only where the expression IS the closure (a bare name, a
+    # literal element, a comprehension elt) — resolving every Name in
+    # an arbitrary value would mis-tag caller-thread helpers as tasks.
+    bound: Dict[str, List[ast.AST]] = {}
+
+    def closures_in(expr: ast.AST) -> List[ast.AST]:
+        out = [c for c in ast.walk(expr) if isinstance(c, ast.Lambda)]
+        names: List[str] = []
+        if isinstance(expr, ast.Name):
+            names = [expr.id]
+        elif isinstance(expr, (ast.List, ast.Tuple)):
+            names = [e.id for e in expr.elts if isinstance(e, ast.Name)]
+        elif isinstance(expr, (ast.ListComp, ast.GeneratorExp)) \
+                and isinstance(expr.elt, ast.Name):
+            names = [expr.elt.id]
+        for nm in names:
+            out.extend(bound.get(nm, ()))
+            out.extend(defs_by_name.get(nm, ()))
+        return out
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            closures = closures_in(node.value)
+            if closures:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        bound.setdefault(t.id, []).extend(closures)
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "append"
+              and isinstance(node.func.value, ast.Name)):
+            for a in node.args:
+                bound.setdefault(node.func.value.id, []).extend(
+                    closures_in(a))
+
+    out: List[Finding] = []
+    seen: Set[int] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "run_tasks"):
+            continue
+        closures: List[ast.AST] = []
+        for a in node.args:
+            closures.extend(closures_in(a))
+        for fn in closures:
+            hops = [fn] + _called_local_defs(fn, defs_by_name)
+            for body_fn in hops:
+                if id(body_fn) in seen:
+                    continue
+                seen.add(id(body_fn))
+                name = getattr(body_fn, "name", "<lambda>")
+                v = _SharedWriteVisitor(file, name, _fn_locals(body_fn))
+                body = ([body_fn.body] if isinstance(body_fn, ast.Lambda)
+                        else body_fn.body)
+                for stmt in body:
+                    v.visit(stmt)
+                out.extend(v.findings)
+    return out
+
+
 # -- entry points -----------------------------------------------------------
 
 def lint_source(source: str, file: str) -> List[Finding]:
@@ -440,6 +705,7 @@ def lint_source(source: str, file: str) -> List[Finding]:
     out.extend(_lint_fault_points(tree, file))
     out.extend(_lint_config_keys(tree, file))
     out.extend(_lint_metric_names(tree, file))
+    out.extend(_lint_hostpool_writes(tree, file))
     return out
 
 
@@ -489,6 +755,7 @@ def lint_paths(paths: Optional[Sequence[str]] = None,
         out.extend(_lint_fault_points(tree, rel))
         out.extend(_lint_config_keys(tree, rel))
         out.extend(_lint_metric_names(tree, rel))
+        out.extend(_lint_hostpool_writes(tree, rel))
         decls.extend(_option_decls(tree, rel))
     by_key: Dict[str, List[Tuple[str, str, int]]] = {}
     for key, file, line in decls:

@@ -70,6 +70,18 @@ class Sink:
         pass
 
 
+def sink_is_transactional(sink: "Sink") -> bool:
+    """Whether a sink instance participates in exactly-once 2PC — it
+    overrides the staging seam (``prepare_commit``) or the persistence
+    seam (``snapshot_staged``). Single-sourced here because TWO analyzer
+    rules key on it (NON_TRANSACTIONAL_SINK and its log-chain
+    escalation NON_TXN_SINK_IN_CHAIN) and must never disagree about
+    what "transactional" means."""
+    cls = type(sink)
+    return (cls.prepare_commit is not Sink.prepare_commit
+            or cls.snapshot_staged is not Sink.snapshot_staged)
+
+
 class TwoPhaseCommitSink(Sink):
     """Generalized pre-commit/commit transactional sink protocol (ref:
     TwoPhaseCommitSinkFunction + the FLIP-143 unified Sink's

@@ -32,6 +32,15 @@ class Source:
     """A source produces numbered microbatches per split; position = batch
     index within the split (replay = start from a position)."""
 
+    def declared_schema(self) -> Optional[Dict[str, str]]:
+        """The record schema this source emits — field name → numpy
+        dtype name — or None when it cannot be known without running
+        (the plan analyzer's dataflow plane seeds schema propagation
+        here; analysis/dataflow.py). Declaring is optional but a source
+        with no schema makes every downstream field-reference check a
+        no-op."""
+        return None
+
     def splits(self) -> List[str]:
         return ["0"]
 
@@ -64,6 +73,10 @@ class CollectionSource(Source):
     data: Mapping[str, np.ndarray]
     timestamps: np.ndarray
     batch_size: int = 8192
+
+    def declared_schema(self) -> Optional[Dict[str, str]]:
+        # exact by construction: the collection IS the stream
+        return {k: str(np.asarray(v).dtype) for k, v in self.data.items()}
 
     def open_split(self, split: str, start_pos: int = 0) -> Iterator[Batch]:
         n = len(self.timestamps)
@@ -124,6 +137,12 @@ class DeviceGeneratorSource(Source):
     # of the logical batch. None = the source cannot subdivide; the
     # driver then keeps its device chain at logical granularity.
     subdivide: Optional[Callable[[int], "DeviceGeneratorSource"]] = None
+    # declared record schema (field → numpy dtype name) of ``gen``'s
+    # batches; seeds the analyzer's schema lattice (declared_schema)
+    schema: Optional[Dict[str, str]] = None
+
+    def declared_schema(self) -> Optional[Dict[str, str]]:
+        return dict(self.schema) if self.schema is not None else None
 
     def subdivided(self, k: int) -> "DeviceGeneratorSource":
         """The equivalent source at batch_size/k granularity (see
@@ -172,6 +191,12 @@ class GeneratorSource(Source):
     gen: Callable[[str, int], Optional[Batch]]
     n_splits: int = 1
     is_bounded: bool = True
+    # declared record schema (field → numpy dtype name); None = opaque
+    # generator — downstream schema checks stay silent
+    schema: Optional[Dict[str, str]] = None
+
+    def declared_schema(self) -> Optional[Dict[str, str]]:
+        return dict(self.schema) if self.schema is not None else None
 
     def splits(self) -> List[str]:
         return [str(i) for i in range(self.n_splits)]
@@ -199,6 +224,9 @@ class TextLineSource(Source):
 
     path: str
     batch_size: int = 8192
+
+    def declared_schema(self) -> Optional[Dict[str, str]]:
+        return {"line": "object"}
 
     def open_split(self, split: str, start_pos: int = 0) -> Iterator[Batch]:
         import time

@@ -7,8 +7,9 @@ script. Here::
     python -m flink_tpu run --coordinator H:P --entry pkg.mod:build \
         [--job-id id] [--conf key=value ...]
     python -m flink_tpu run --local --entry pkg.mod:build [...]
-    python -m flink_tpu analyze [job.conf] [--entry pkg.mod:build]
-    python -m flink_tpu lint [paths ...]
+    python -m flink_tpu analyze [job.conf] [--entry pkg.mod:build] \
+        [--json] [--explain] [--fail-on error|warn|off]
+    python -m flink_tpu lint [paths ...] [--json]
     python -m flink_tpu log TOPIC_DIR
     python -m flink_tpu list --coordinator H:P
     python -m flink_tpu status --coordinator H:P JOB_ID
@@ -107,30 +108,57 @@ def _print_findings(findings, as_json: bool) -> None:
 def _analyze(args) -> int:
     """`flink_tpu analyze`: the same rules the driver runs at submit,
     standalone — a misconfigured job fails here in milliseconds instead
-    of minutes into a run."""
+    of minutes into a run.
+
+    Exit-code contract (the CI surface, mirrored by `lint` and
+    asserted in tests/test_cli.py): 0 = clean at the threshold,
+    1 = blocking findings, 2 = usage/path error (unreadable conf file,
+    unimportable --entry, --explain without a plan)."""
     import importlib
 
     from flink_tpu.analysis import analyze, analyze_config
     from flink_tpu.analysis.core import blocking
     from flink_tpu.config import AnalysisOptions, Configuration
 
+    if args.explain and not args.entry:
+        print("error: --explain needs --entry (per-node facts are "
+              "properties of a compiled plan)", file=sys.stderr)
+        return 2
     config = Configuration(_parse_conf(args.conf))
     if args.job_conf:
-        config = Configuration.from_file(args.job_conf).merged_with(config)
+        try:
+            config = Configuration.from_file(
+                args.job_conf).merged_with(config)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot load job conf {args.job_conf!r}: {e}",
+                  file=sys.stderr)
+            return 2
+    plan = None
     if args.entry:
         from flink_tpu.api.environment import StreamExecutionEnvironment
 
         mod_name, _, fn_name = args.entry.partition(":")
-        build = getattr(importlib.import_module(mod_name), fn_name)
+        try:
+            build = getattr(importlib.import_module(mod_name), fn_name)
+        except (ImportError, AttributeError) as e:
+            print(f"error: cannot import entry {args.entry!r}: {e}",
+                  file=sys.stderr)
+            return 2
         env = StreamExecutionEnvironment(config)
         build(env)
         # non-strict lowering: plans strict compilation rejects still
         # analyze, so the violation reports as a finding with a fix
         # hint instead of a bare compiler stack trace
-        findings = analyze(env.compile_plan(strict=False), env.config)
+        plan = env.compile_plan(strict=False)
+        config = env.config
+        findings = analyze(plan, config)
     else:
         findings = analyze_config(config)
     _print_findings(findings, as_json=args.json)
+    if args.explain:
+        from flink_tpu.analysis.dataflow import explain_plan
+
+        print(explain_plan(plan, config))
     fail_on = args.fail_on or str(
         config.get(AnalysisOptions.FAIL_ON)).strip().lower()
     return 1 if blocking(findings, fail_on) else 0
@@ -167,7 +195,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="compile-time plan analysis: run every analyzer rule over "
              "a job conf (and, with --entry, its compiled pipeline) "
              "WITHOUT executing; findings print before the first "
-             "record would flow")
+             "record would flow",
+        epilog="exit codes: 0 = clean at the threshold, 1 = blocking "
+               "findings, 2 = usage/path error. --json prints one "
+               "Finding.to_dict object per line (keys: rule, severity, "
+               "message, fix, node, node_name, file, line — the stable "
+               "CI shape shared with `lint --json`; RULES.md documents "
+               "it).")
     az.add_argument("job_conf", nargs="?", metavar="JOB_CONF",
                     help="`key: value` / JSON config file "
                          "(Configuration.from_file grammar); omit to "
@@ -179,6 +213,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     metavar="KEY=VALUE")
     az.add_argument("--json", action="store_true",
                     help="one JSON object per finding (machine surface)")
+    az.add_argument("--explain", action="store_true",
+                    help="after the findings, print each plan node's "
+                         "inferred dataflow facts — record schema, "
+                         "watermark axis, state bound + bytes-per-key "
+                         "estimate (needs --entry; analysis/dataflow"
+                         ".py)")
     az.add_argument("--fail-on", choices=("error", "warn", "off"),
                     default=None,
                     help="exit nonzero at this severity (default: the "
@@ -188,8 +228,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     lint = sub.add_parser(
         "lint",
         help="repo AST lints: tracer leaks in jit kernels, fault-point "
-             "/ config-key / metric-name drift (pure-stdlib ast pass; "
-             "zero findings on the shipped tree is a tier-1 gate)")
+             "/ config-key / metric-name drift, unlocked shared writes "
+             "in host-pool task closures (pure-stdlib ast pass; zero "
+             "findings on the shipped tree is a tier-1 gate)",
+        epilog="exit codes: 0 = clean, 1 = findings, 2 = usage/path "
+               "error. --json prints one Finding.to_dict object per "
+               "line (same shape as `analyze --json`).")
     lint.add_argument("paths", nargs="*", metavar="PATH",
                       help="files or directories (default: the shipped "
                            "flink_tpu tree + tools + bench scripts)")

@@ -572,6 +572,18 @@ class AnalysisOptions:
         "the driver (driver.analysis_findings) without failing the "
         "job. `python -m flink_tpu analyze` runs the same rules "
         "standalone.")
+    MAX_STATE_BYTES_PER_KEY = ConfigOption(
+        "analysis.max-state-bytes-per-key", 0,
+        "Per-key state budget in BYTES for the analyzer's dataflow "
+        "plane (analysis/dataflow.py): when > 0, any stateful operator "
+        "whose statically-estimated per-key state footprint (lane "
+        "accumulators x live panes, from the window/lateness geometry) "
+        "exceeds it raises a STATE_BYTES_EXCEEDED warning at submit — "
+        "the admission-control seam for multi-tenant budgeting (the "
+        "same estimate `analyze --explain` prints per node). 0 = off. "
+        "Estimates cover the dense lane layouts; element-buffer "
+        "operators (evictors, CEP partial matches) are data-dependent "
+        "and never flagged.")
 
 
 class SourceOptions:

@@ -66,6 +66,14 @@ class ExecNode:
     # op, so the plan must remember the exchange existed — the
     # analyzer's KEYED_WITHOUT_KEYBY rule reads this)
     keyed_input: bool = False
+    # declared OUTPUT record schema (field → numpy dtype name) of this
+    # node's emitted rows, recorded at lowering for the operator kinds
+    # whose fired-row shape is a plan fact (key/window columns + the
+    # aggregate's probed result fields). None = not statically known
+    # (chains, opaque window fns, CEP matches). The analyzer's dataflow
+    # plane reads this the way KEYED_OP_WITHOUT_KEYBY reads
+    # ``keyed_input`` (analysis/dataflow.py).
+    out_schema: Optional[Dict[str, str]] = None
     name: str = ""
 
 
@@ -139,6 +147,61 @@ def assign_stages(
         else:
             stage_of[nid] = base
     return stage_of, blocking
+
+
+def _probe_result_schema(agg) -> Dict[str, str]:
+    """Result-field names + coarse dtypes of a LaneAggregate, via the
+    shared empty-lane probe (ops/aggregates.probe_finalize — the same
+    source WindowOperator._result_fields classifies dtypes from):
+    integer-classified lanes emit int64 columns, the rest float32."""
+    from flink_tpu.ops.aggregates import probe_finalize
+
+    return {
+        k: ("int64" if np.issubdtype(np.asarray(v).dtype, np.integer)
+            else "float32")
+        for k, v in probe_finalize(agg).items()}
+
+
+def _op_out_schema(node: ExecNode) -> Optional[Dict[str, str]]:
+    """The statically-known fired-row schema of a stateful op — the
+    (key, window_start, window_end, count) columns every windowed
+    operator emits plus the aggregate's probed result fields (kept in
+    lockstep with ops/{window,session,count_window,global_agg,
+    window_all,join}.py output assembly). None when the output shape is
+    not a plan fact (opaque window fns, CEP match rows, async
+    enrichment)."""
+    wt = node.window_transform
+    try:
+        if node.kind in ("window", "session", "count_window"):
+            out = {"key": "int64", "window_start": "int64",
+                   "window_end": "int64", "count": "int64"}
+            out.update(_probe_result_schema(wt.aggregate))
+            return out
+        if node.kind == "window_all":
+            out = {"window_start": "int64", "window_end": "int64",
+                   "count": "int64"}
+            out.update(_probe_result_schema(wt.aggregate))
+            return out
+        if node.kind == "global_agg":
+            out = {"key": "int64", "count": "int64"}
+            out.update(_probe_result_schema(wt.aggregate))
+            return out
+        if node.kind == "join":
+            out = {"key": "int64", "window_start": "int64",
+                   "window_end": "int64"}
+            if wt.mode == "aggregate":
+                out["left_count"] = "int64"
+                out["right_count"] = "int64"
+            for f in wt.left_fields:
+                out[f"left_{f}"] = "float32"
+            for f in wt.right_fields:
+                out[f"right_{f}"] = "float32"
+            return out
+    except Exception:
+        # schema recording must never fail a lowering the runtime would
+        # accept (a user aggregate whose finalize rejects empty lanes)
+        return None
+    return None
 
 
 def compile_job(
@@ -313,6 +376,12 @@ def compile_job(
         raise ValueError("job has no sinks (add_sink/print/collect)")
 
     topo = _topo_order(nodes, sources)
+
+    # record each stateful op's declared output schema (the analyzer's
+    # dataflow plane seeds field-reference checks downstream of the op
+    # from this, the way keyed_input records the folded keyBy exchange)
+    for n in nodes.values():
+        n.out_schema = _op_out_schema(n)
 
     from flink_tpu.config import ExecutionOptions
 

@@ -43,6 +43,16 @@ class NexmarkConfig:
     hot_ratio: int = 2             # 1/hot_ratio of bids go to hot auctions
 
 
+# Declared record schemas (field -> numpy dtype name) of the three
+# event streams -- seeds the plan analyzer's schema lattice so a Q5/Q7/
+# Q8 pipeline's field references are checked at compile time
+# (analysis/dataflow.py; the generators' output dicts must match).
+BID_SCHEMA = {"auction": "int64", "bidder": "int64", "price": "float32"}
+PERSON_SCHEMA = {"person": "int64", "state_id": "int64"}
+AUCTION_SCHEMA = {"auction": "int64", "seller": "int64",
+                  "category": "int64", "reserve": "float32"}
+
+
 def _event_ids(cfg: NexmarkConfig, split: int, index: int) -> Tuple[np.ndarray, np.ndarray]:
     """Global event ids + event-time for one batch (monotone per split,
     interleaved across splits)."""
@@ -86,7 +96,8 @@ def bid_stream(cfg: NexmarkConfig) -> GeneratorSource:
         price = np.round(np.exp(rng.normal(6.0, 1.0, b)), 2).astype(np.float32)
         return ({"auction": auction, "bidder": bidder, "price": price}, ts)
 
-    return GeneratorSource(gen, n_splits=cfg.n_splits)
+    return GeneratorSource(gen, n_splits=cfg.n_splits,
+                           schema=BID_SCHEMA)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -228,6 +239,7 @@ def bid_stream_device(cfg: NexmarkConfig,
         key_field="auction", batch_size=b, n_batches=cfg.n_batches * k,
         # multiply-shift range reduction: auction < n_auctions ALWAYS
         key_domain=cfg.num_active_auctions, keys_bounded=True,
+        schema=BID_SCHEMA,
         # further subdivision re-derives from the config so the logical
         # seed unit stays cfg.batch_size (only the K=1 source carries
         # it; the driver subdivides exactly once)
@@ -249,7 +261,8 @@ def person_stream(cfg: NexmarkConfig) -> GeneratorSource:
         return ({"person": person.astype(np.int64),
                  "state_id": rng.integers(0, 50, b).astype(np.int64)}, ts)
 
-    return GeneratorSource(gen, n_splits=cfg.n_splits)
+    return GeneratorSource(gen, n_splits=cfg.n_splits,
+                           schema=PERSON_SCHEMA)
 
 
 def auction_stream(cfg: NexmarkConfig) -> GeneratorSource:
@@ -270,4 +283,5 @@ def auction_stream(cfg: NexmarkConfig) -> GeneratorSource:
             "reserve": np.round(np.exp(rng.normal(6.0, 1.0, b)), 2).astype(np.float32),
         }, ts)
 
-    return GeneratorSource(gen, n_splits=cfg.n_splits)
+    return GeneratorSource(gen, n_splits=cfg.n_splits,
+                           schema=AUCTION_SCHEMA)

@@ -93,15 +93,23 @@ def _empty_lanes(b: jax.Array) -> jax.Array:
     return jnp.zeros(b.shape[:1] + (0,), dtype=jnp.float32)
 
 
-def result_fields(agg: LaneAggregate) -> Tuple[str, ...]:
-    """The result-field names an aggregate's finalize produces (probed on
-    empty lanes; mirrors WindowOperator._result_fields ordering)."""
-    res = agg.finalize(
+def probe_finalize(agg: LaneAggregate) -> Arrays:
+    """``finalize`` evaluated on EMPTY lanes — THE result-field probe.
+    Single-sourced here because three consumers must agree on the
+    fired-row result columns: :func:`result_fields`, the compiler's
+    ``ExecNode.out_schema`` recording (graph/compiler.py), and
+    ``WindowOperator._result_fields``' dtype classification."""
+    return agg.finalize(
         np.zeros((0, agg.sum_width), np.float32),
         np.zeros((0, agg.max_width), np.float32),
         np.zeros((0, agg.min_width), np.float32),
         np.zeros((0,), np.int32))
-    return tuple(sorted(res))
+
+
+def result_fields(agg: LaneAggregate) -> Tuple[str, ...]:
+    """The result-field names an aggregate's finalize produces (probed on
+    empty lanes; mirrors WindowOperator._result_fields ordering)."""
+    return tuple(sorted(probe_finalize(agg)))
 
 
 def _cached(factory):

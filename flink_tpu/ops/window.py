@@ -2558,12 +2558,9 @@ class WindowOperator:
         fire_pack_kernel's ``sorted(res)`` exactly (including a result
         field named 'count' if the aggregate emits one)."""
         if not hasattr(self, "_res_fields"):
-            agg = self.agg
-            res = agg.finalize(
-                np.zeros((0, agg.sum_width), np.float32),
-                np.zeros((0, agg.max_width), np.float32),
-                np.zeros((0, agg.min_width), np.float32),
-                np.zeros((0,), np.int32))
+            from flink_tpu.ops.aggregates import probe_finalize
+
+            res = probe_finalize(self.agg)
             self._res_fields = sorted(res)
             self._res_is_int = {
                 k: np.issubdtype(np.asarray(res[k]).dtype, np.integer)

@@ -33,6 +33,20 @@ spill-overflow pipelines through recovery with this point armed at
 
 Observability: per-task metrics under the ``hostpool`` group —
 ``tasks_total``, ``task_ms`` (per-task wall), ``parallelism``.
+
+Shared-state discipline (LINTED — ``HOSTPOOL_SHARED_WRITE`` in
+analysis/pylints.py walks every ``run_tasks`` call site): a submitted
+closure runs on a pool worker thread, so it must either
+
+- **return a partial** and let the caller combine (results come back
+  in submission order — the merge discipline every client here uses), or
+- **guard shared writes with a lock** — the lint recognizes a
+  ``with <...lock...>:`` block by name (the spill store's per-pane
+  locks, metrics' ``_lock``), so name your locks ``*lock*``.
+
+An unguarded ``self.total += n`` / ``shared[k] = v`` inside a task
+closure is the read-modify-write race PR 5 fixed by hand in
+obs/metrics.py — the lint keeps it from coming back.
 """
 from __future__ import annotations
 

@@ -1085,7 +1085,12 @@ class Driver:
             from flink_tpu.analysis import AnalysisError, analyze
             from flink_tpu.analysis.core import blocking
 
-            self.analysis_findings = analyze(self.plan, self.config)
+            # eval_chains=False: the automatic submit pass must never
+            # CALL user chain fns (a side-effecting map would observe a
+            # phantom empty batch); schema facts go opaque at the first
+            # unevaluated chain. `env.analyze()` / the CLI evaluate.
+            self.analysis_findings = analyze(self.plan, self.config,
+                                             eval_chains=False)
             blockers = blocking(self.analysis_findings, fail_on)
             if blockers:
                 raise AnalysisError(blockers, fail_on)

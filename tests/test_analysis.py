@@ -175,9 +175,109 @@ def _checkpoint_in_batch(tmp_path):
         "execution.checkpointing.interval": 500}))
 
 
+# -- dataflow-plane seeds (the propagated lattices; full coverage and
+# clean negatives live in tests/test_dataflow.py) ---------------------------
+
+@seed("FIELD_NOT_IN_SCHEMA", node_name="window_agg")
+def _keyby_on_dropped_field(tmp_path):
+    # schema lattice: the map renames the key column away; the keyBy's
+    # field reference is checked against the PROPAGATED schema
+    env = make_env()
+    (env.from_source(GeneratorSource(gen, schema={"word": "int64"}), WM())
+        .map(lambda d: {"renamed": d["word"]}, name="drop_word")
+        .key_by("word")
+        .window(TumblingEventTimeWindows.of(1000))
+        .count()
+        .collect())
+    return env.analyze()
+
+
+@seed("SCHEMA_MISMATCH_UNION", node_name="union")
+def _union_of_different_schemas(tmp_path):
+    env = make_env()
+    a = env.from_collection({"k": np.array([1], np.int64)},
+                            np.array([100], np.int64))
+    b = env.from_collection({"other": np.array([2], np.int64)},
+                            np.array([200], np.int64))
+    a.union(b).collect()
+    return env.analyze()
+
+
+@seed("UNBOUNDED_STATE_GROWTH", node_name="window_agg")
+def _global_window_nonpurging_trigger(tmp_path):
+    # state lattice: GlobalWindows element buffer + non-purging
+    # CountTrigger + no evictor, fed by an UNBOUNDED source
+    from flink_tpu.api.windowing import CountTrigger
+
+    env = make_env()
+    (env.from_source(GeneratorSource(gen, is_bounded=False), WM())
+        .key_by("word")
+        .window(GlobalWindows.create())
+        .trigger(CountTrigger.of(3))
+        .count()
+        .collect())
+    return env.analyze()
+
+
+@seed("STALLED_WATERMARK_LEG", node_name="window_agg")
+def _event_time_window_fed_by_count_window(tmp_path):
+    # watermark lattice: count-window fires carry no event time; the
+    # downstream event-time window's panes can never be crossed
+    env = make_env()
+    (env.from_source(GeneratorSource(gen, schema={"word": "int64"}), WM())
+        .key_by("word")
+        .count_window(3)
+        .count()
+        .key_by("key")
+        .window(TumblingEventTimeWindows.of(1000))
+        .count()
+        .collect())
+    return env.analyze()
+
+
+@seed("NON_TXN_SINK_IN_CHAIN", node_name="collect")
+def _log_chain_into_write_through_sink(tmp_path):
+    # exactly-once taint through log topics: LogSource → CollectSink
+    # under checkpointing escalates the generic sink warning to error
+    from flink_tpu.log.connectors import LogSource
+
+    env = make_env({"execution.checkpointing.interval": 500})
+    (env.from_source(LogSource(str(tmp_path / "topic")), WM())
+        .collect())
+    return env.analyze()
+
+
+@seed("STATE_BYTES_EXCEEDED", node_name="window_agg")
+def _state_bytes_over_budget(tmp_path):
+    # the --explain estimate as an admission check: a tiny per-key
+    # budget trips on the clean pipeline's window geometry
+    env = clean_pipeline({"analysis.max-state-bytes-per-key": 4})
+    return env.analyze()
+
+
 class TestRuleCatalog:
     def test_catalog_has_at_least_eight_rules(self):
         assert len(rule_catalog()) >= 8
+
+    def test_dataflow_plane_has_at_least_six_rules(self):
+        from flink_tpu.analysis.core import rule_catalog_full
+
+        planes = [r.plane for r in rule_catalog_full()]
+        assert planes.count("dataflow") >= 6
+        for r in rule_catalog_full():
+            assert r.description, f"{r.rule_id} has no description"
+            assert r.fix, f"{r.rule_id} has no catalog fix hint"
+
+    def test_finding_sort_puts_config_findings_after_node_zero(self):
+        # regression: the old key `f.node or 0` conflated node 0 with
+        # config-level findings (node=None) — None must sort LAST
+        from flink_tpu.analysis.core import Finding, finding_sort_key
+
+        at_node0 = Finding(rule="R", severity="warn", message="n0",
+                           node=0)
+        at_config = Finding(rule="R", severity="warn", message="conf")
+        ordered = sorted([at_config, at_node0], key=finding_sort_key)
+        assert ordered == [at_node0, at_config]
 
     @pytest.mark.parametrize("rule_id,severity",
                              rule_catalog(),
@@ -306,3 +406,20 @@ class TestDogfoodGate:
             capture_output=True, text=True, timeout=300)
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "no findings" in proc.stdout
+
+    def test_rules_md_is_current(self):
+        """RULES.md staleness gate: the committed catalog doc must be
+        byte-identical to what the registrations render — a new rule
+        (analysis plane OR pylint plane) cannot ship undocumented; run
+        `python tools/gen_rules.py` after editing rules."""
+        import os
+
+        from flink_tpu.analysis.docs import render_rules_md
+        from flink_tpu.analysis.pylints import repo_root
+
+        path = os.path.join(repo_root(), "RULES.md")
+        with open(path, "r", encoding="utf-8") as f:
+            committed = f.read()
+        assert committed == render_rules_md(), (
+            "RULES.md is stale — regenerate with "
+            "`python tools/gen_rules.py`")

@@ -20,6 +20,56 @@ def cli(capsys, *argv):
     return rc, json.loads(out[-1]) if out else {}
 
 
+class TestExitCodeContract:
+    """The documented CI contract of both analysis CLIs (RULES.md):
+    0 = clean at the threshold, 1 = findings at the threshold,
+    2 = usage/path error — plus the shared Finding.to_dict JSON shape
+    (one object per line under --json)."""
+
+    FINDING_KEYS = {"rule", "severity", "message", "fix", "node",
+                    "node_name", "file", "line"}
+
+    def test_analyze_clean_is_0_findings_1_bad_path_2(self, tmp_path,
+                                                      capsys):
+        conf = tmp_path / "job.conf"
+        conf.write_text("execution.checkpointing.interval: 500\n")
+        assert cli_main(["analyze", str(conf)]) == 0
+        conf.write_text("faults.inject: bogus.point=raise\n")
+        assert cli_main(["analyze", str(conf)]) == 1
+        assert cli_main(["analyze", str(tmp_path / "absent.conf")]) == 2
+        assert cli_main(["analyze", "--entry", "no.such:build"]) == 2
+        assert cli_main(["analyze", "--explain"]) == 2
+        capsys.readouterr()
+
+    def test_lint_clean_is_0_findings_1_bad_path_2(self, tmp_path,
+                                                   capsys):
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        assert cli_main(["lint", str(clean)]) == 0
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text(
+            "import jax\n\n@jax.jit\ndef k(x):\n    return float(x)\n")
+        assert cli_main(["lint", str(dirty)]) == 1
+        assert cli_main(["lint", str(tmp_path / "absent.py")]) == 2
+        capsys.readouterr()
+
+    def test_both_clis_share_the_finding_json_shape(self, tmp_path,
+                                                    capsys):
+        conf = tmp_path / "job.conf"
+        conf.write_text("faults.inject: bogus.point=raise\n")
+        cli_main(["analyze", str(conf), "--json"])
+        analyze_lines = capsys.readouterr().out.strip().splitlines()
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text(
+            "import jax\n\n@jax.jit\ndef k(x):\n    return float(x)\n")
+        cli_main(["lint", str(dirty), "--json"])
+        lint_lines = capsys.readouterr().out.strip().splitlines()
+        for line in analyze_lines + lint_lines:
+            f = json.loads(line)
+            assert set(f) == self.FINDING_KEYS, f
+            assert f["severity"] in ("error", "warn")
+
+
 class TestLocalRun:
     def test_run_local_executes_entry(self, tmp_path, capsys):
         import runner_job

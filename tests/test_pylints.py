@@ -283,6 +283,148 @@ class TestRegistryDrift:
         assert fs[0].severity == "warn"
 
 
+class TestHostpoolSharedWrite:
+    """The concurrency lint plane: shared-mutable-state writes inside
+    closures submitted to HostPool.run_tasks without a lock/merge
+    discipline — the exact race shape PR 5 fixed by hand in
+    obs/metrics.py (Counter's `self._v += n`)."""
+
+    def test_unlocked_counter_in_lambda_list_fires(self):
+        fs = lint("""
+            class Op:
+                def __init__(self, pool):
+                    self.pool = pool
+                    self.total = 0
+
+                def absorb(self, chunks):
+                    self.pool.run_tasks(
+                        [lambda c=c: self._bump(c) for c in chunks])
+
+                def _bump(self, c):
+                    pass
+
+            def drive(pool, chunks, counter):
+                def task(c):
+                    counter["n"] += len(c)   # racy subscript write
+                    return len(c)
+                pool.run_tasks([lambda c=c: task(c) for c in chunks])
+        """)
+        assert rules_of(fs) == ["HOSTPOOL_SHARED_WRITE"]
+        assert fs[0].severity == "warn"
+        assert "counter" in fs[0].message and fs[0].fix
+
+    def test_unlocked_self_attribute_fires_one_call_hop_deep(self):
+        fs = lint("""
+            class Op:
+                def absorb(self, chunks):
+                    def merge(c):
+                        self.total += len(c)   # racy attribute RMW
+                    self.pool.run_tasks(
+                        [lambda c=c: merge(c) for c in chunks])
+        """)
+        assert rules_of(fs) == ["HOSTPOOL_SHARED_WRITE"]
+        assert "self.total" in fs[0].message
+
+    def test_nonlocal_accumulator_through_append_fires(self):
+        fs = lint("""
+            def drive(pool, chunks):
+                done = 0
+                tasks = []
+                for c in chunks:
+                    def task(c=c):
+                        nonlocal done
+                        done += 1
+                    tasks.append(task)
+                pool.run_tasks(tasks)
+        """)
+        assert rules_of(fs) == ["HOSTPOOL_SHARED_WRITE"]
+
+    def test_named_def_bound_through_list_literal_fires(self):
+        """Review regression: `tasks = [merge]` (a NAMED local def, not
+        a lambda) must resolve to the def — the obs/metrics.py race
+        class must not escape through a plain list binding."""
+        fs = lint("""
+            class Op:
+                def absorb(self, chunks):
+                    def merge():
+                        self.total += 1
+                    tasks = [merge]
+                    self.pool.run_tasks(tasks)
+        """)
+        assert rules_of(fs) == ["HOSTPOOL_SHARED_WRITE"]
+
+    def test_annotated_and_walrus_locals_are_silent(self):
+        """Review regression: `n: int = 0` and `(n := ...)` bind LOCALS
+        — they must never read as shared writes."""
+        fs = lint("""
+            def drive(pool, chunks):
+                def task(c):
+                    n: int = 0
+                    n += len(c)
+                    if (m := len(c)) > 2:
+                        m += 1
+                    return n + m
+                pool.run_tasks([lambda c=c: task(c) for c in chunks])
+        """)
+        assert fs == []
+
+    def test_lock_guarded_write_is_silent(self):
+        fs = lint("""
+            import threading
+
+            class Op:
+                def __init__(self, pool):
+                    self.pool = pool
+                    self.total = 0
+                    self._lock = threading.Lock()
+
+                def absorb(self, chunks):
+                    def task(c):
+                        with self._lock:
+                            self.total += len(c)
+                        return len(c)
+                    self.pool.run_tasks(
+                        [lambda c=c: task(c) for c in chunks])
+        """)
+        assert fs == []
+
+    def test_merge_discipline_returning_partials_is_silent(self):
+        fs = lint("""
+            def drive(pool, chunks):
+                parts = pool.run_tasks(
+                    [lambda c=c: sum(c) for c in chunks])
+                total = sum(parts)   # combine on the CALLER: fine
+                return total
+        """)
+        assert fs == []
+
+    def test_local_writes_inside_tasks_are_silent(self):
+        fs = lint("""
+            def drive(pool, chunks):
+                def task(c):
+                    acc = {}
+                    acc["n"] = len(c)     # local dict: per-task state
+                    acc["n"] += 1
+                    return acc
+                pool.run_tasks([lambda c=c: task(c) for c in chunks])
+        """)
+        assert fs == []
+
+    def test_obs_metrics_as_shipped_is_silent(self):
+        """The PR 5 fix itself (lock-guarded primitives) must never be
+        re-flagged — and neither may the shipped pool clients."""
+        import os
+
+        from flink_tpu.analysis.pylints import repo_root
+
+        for rel in ("flink_tpu/obs/metrics.py", "flink_tpu/state/spill.py",
+                    "flink_tpu/ops/session.py"):
+            with open(os.path.join(repo_root(), rel)) as f:
+                fs = [x for x in lint_source(f.read(), rel)
+                      if x.rule == "HOSTPOOL_SHARED_WRITE"]
+            assert fs == [], f"{rel}: {[x.render() for x in fs]}"
+
+
 class TestLintPaths:
     def test_duplicate_option_declaration_across_files(self, tmp_path):
         a = tmp_path / "a.py"

@@ -1,0 +1,23 @@
+#!/usr/bin/env python3
+"""Regenerate RULES.md from the analyzer rule registrations::
+
+    python tools/gen_rules.py
+
+The catalog is rendered by flink_tpu/analysis/docs.py from
+core.rule_catalog_full() + pylints.LINT_CATALOG; the tier-1 staleness
+gate (tests/test_analysis.py) asserts the committed RULES.md matches,
+so run this after adding or editing a rule.
+"""
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from flink_tpu.analysis.docs import render_rules_md  # noqa: E402
+
+if __name__ == "__main__":
+    out = os.path.join(ROOT, "RULES.md")
+    with open(out, "w", encoding="utf-8") as f:
+        f.write(render_rules_md())
+    print(f"wrote {out}")
