@@ -306,6 +306,65 @@ def host_parallelism_invalid(plan, config) -> Iterable[Finding]:
                 f"min(4, os.cpu_count()) = {min(4, ncpu)})")
 
 
+@config_rule("SESSION_QUOTA_INVALID", "error",
+             fix="set 1 <= session.slots-per-job <= "
+                 "session.runner-slots, and session.max-jobs >= 1")
+def session_quota_invalid(plan, config) -> Iterable[Finding]:
+    """A session-cluster quota the dispatcher can never satisfy: a
+    slots-per-job or max-jobs or runner-slots below 1 (admission
+    rejects the submission / the dispatcher refuses to start), or a
+    per-job slot quota above one runner's slot capacity — no runner in
+    the fleet could ever host the job, so it would be rejected at
+    submit (runtime/session.py enforces the same bounds)."""
+    from flink_tpu.config import SessionOptions
+
+    def _get(opt, label):
+        try:
+            return int(config.get(opt)), None
+        except (TypeError, ValueError):
+            return None, _f(
+                f"{label} does not parse as an integer",
+                fix=f"set an integer >= 1 for {label}")
+
+    spj, err = _get(SessionOptions.SLOTS_PER_JOB, "session.slots-per-job")
+    if err is not None:
+        yield err
+        return
+    rs, err = _get(SessionOptions.RUNNER_SLOTS, "session.runner-slots")
+    if err is not None:
+        yield err
+        return
+    mj, err = _get(SessionOptions.MAX_JOBS, "session.max-jobs")
+    if err is not None:
+        yield err
+        return
+    if spj < 1:
+        yield _f(
+            f"session.slots-per-job={spj} is below 1 — the dispatcher "
+            "rejects the submission at admission",
+            fix="set session.slots-per-job >= 1 (1 = the default "
+                "single-slot share)")
+    if mj < 1:
+        yield _f(
+            f"session.max-jobs={mj} is below 1 — the session cluster "
+            "could never run a job and refuses to start",
+            fix="set session.max-jobs >= 1")
+    if rs < 1:
+        yield _f(
+            f"session.runner-slots={rs} is below 1 — runners would "
+            "contribute no slot capacity and the cluster refuses to "
+            "start",
+            fix="set session.runner-slots >= 1")
+    elif spj > rs:
+        yield _f(
+            f"session.slots-per-job={spj} exceeds "
+            f"session.runner-slots={rs} — the quota is above every "
+            "runner's slot capacity, so no fleet of any size could "
+            "ever place the job (admission rejects it)",
+            fix=f"lower session.slots-per-job to <= {rs}, or raise "
+                "session.runner-slots")
+
+
 @config_rule("SUBBATCH_INVALID", "error",
              fix="pick a divisor of pipeline.microbatch-size")
 def subbatch_invalid(plan, config) -> Iterable[Finding]:

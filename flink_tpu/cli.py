@@ -7,6 +7,11 @@ script. Here::
     python -m flink_tpu run --coordinator H:P --entry pkg.mod:build \
         [--job-id id] [--conf key=value ...]
     python -m flink_tpu run --local --entry pkg.mod:build [...]
+    python -m flink_tpu run --session H:P --entry pkg.mod:build [...]
+    python -m flink_tpu session start [--port P] [--local-runners N] \
+        [--conf key=value ...]
+    python -m flink_tpu session submit --session H:P --entry mod:build
+    python -m flink_tpu session list|cancel|stop --session H:P [...]
     python -m flink_tpu analyze [job.conf] [--entry pkg.mod:build] \
         [--json] [--explain] [--fail-on error|warn|off]
     python -m flink_tpu lint [paths ...] [--json]
@@ -30,12 +35,12 @@ import uuid
 from typing import List, Optional
 
 
-def _coord_client(spec: str):
+def _coord_client(spec: str, flag: str = "--coordinator"):
     from flink_tpu.runtime.rpc import RpcClient
 
     host, _, port = spec.partition(":")
     if not port:
-        raise SystemExit(f"--coordinator must be HOST:PORT, got {spec!r}")
+        raise SystemExit(f"{flag} must be HOST:PORT, got {spec!r}")
     return RpcClient(host or "127.0.0.1", int(port))
 
 
@@ -93,6 +98,70 @@ def _run_local(entry: str, conf: dict, job_id: str) -> int:
                       "records_in": result.metrics.get("records_in"),
                       "records_out": result.metrics.get("records_out")}))
     return 0
+
+
+def _run_attached(session: str, entry: str, conf: dict,
+                  job_id: str) -> int:
+    """``run --session H:P``: attach the job to a RUNNING session
+    cluster instead of spinning a private runtime — submit through the
+    dispatcher's admission gate, then block until the job is terminal
+    (the `flink run` against a session cluster flow)."""
+    import time as _time
+
+    c = _coord_client(session, flag="--session")
+    try:
+        resp = c.call("submit_session_job", job_id=job_id, entry=entry,
+                      config=conf)
+        if not resp.get("admitted"):
+            print(json.dumps({"job_id": job_id, **resp}))
+            return 1
+        while True:
+            st = c.call("job_status", job_id=job_id)
+            state = st.get("state")
+            if state in ("FINISHED", "FAILED", "CANCELED", "UNKNOWN"):
+                print(json.dumps({"job_id": job_id, **st}))
+                return 0 if state == "FINISHED" else 1
+            _time.sleep(0.3)
+    finally:
+        c.close()
+
+
+def _session(args) -> int:
+    """``flink_tpu session ...``: the session-cluster control surface
+    (runtime/session.py SessionDispatcher). Exit-code contract
+    (asserted in tests/test_session.py, same shape as
+    tests/test_cli.py TestExitCodeContract): 0 = ok (started /
+    admitted / listed / stopped), 1 = the cluster refused (admission
+    rejection, unknown job), 2 = usage error (argparse)."""
+    if args.session_cmd == "start":
+        from flink_tpu.config import Configuration
+        from flink_tpu.runtime.session import serve_session
+
+        return serve_session(Configuration(_parse_conf(args.conf)),
+                             port=args.port,
+                             local_runners=args.local_runners)
+    c = _coord_client(args.session, flag="--session")
+    try:
+        if args.session_cmd == "submit":
+            job_id = args.job_id or f"job-{uuid.uuid4().hex[:8]}"
+            resp = c.call("submit_session_job", job_id=job_id,
+                          entry=args.entry,
+                          config=_parse_conf(args.conf))
+            print(json.dumps({"job_id": job_id, **resp}))
+            return 0 if resp.get("admitted") else 1
+        if args.session_cmd == "list":
+            print(json.dumps(c.call("session_jobs")))
+            return 0
+        if args.session_cmd == "cancel":
+            resp = c.call("cancel_job", job_id=args.job_id)
+            print(json.dumps(resp))
+            return 0 if resp.get("ok") else 1
+        # stop
+        resp = c.call("stop_session")
+        print(json.dumps(resp))
+        return 0 if resp.get("ok") else 1
+    finally:
+        c.close()
 
 
 def _print_findings(findings, as_json: bool) -> None:
@@ -174,6 +243,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     runp.add_argument("--coordinator", metavar="HOST:PORT")
     runp.add_argument("--local", action="store_true",
                       help="execute in this process (LocalExecutor)")
+    runp.add_argument("--session", metavar="HOST:PORT",
+                      help="attach the job to a RUNNING session "
+                           "cluster (`session start`) instead of "
+                           "spinning a private runtime; blocks until "
+                           "the job is terminal (exit 0 = FINISHED)")
     runp.add_argument("--job-id", default=None)
     runp.add_argument("--runtime-mode", choices=("streaming", "batch"),
                       default=None,
@@ -240,6 +314,53 @@ def main(argv: Optional[List[str]] = None) -> int:
     lint.add_argument("--json", action="store_true",
                       help="one JSON object per finding")
 
+    sess = sub.add_parser(
+        "session",
+        help="session-cluster mode (runtime/session.py): one "
+             "long-lived dispatcher hosting N concurrent jobs on a "
+             "shared runner fleet — slot quotas, FIFO submission "
+             "queue, fair drain scheduling, queue-depth autoscaling",
+        epilog="exit codes: 0 = ok, 1 = the cluster refused "
+               "(admission rejection / unknown job), 2 = usage error.")
+    ssub = sess.add_subparsers(dest="session_cmd", required=True)
+    st = ssub.add_parser(
+        "start", help="serve a session dispatcher until `session "
+                      "stop` (prints one JSON line with the address, "
+                      "then blocks)")
+    st.add_argument("--port", type=int, default=0,
+                    help="dispatcher RPC port (0 = ephemeral, read it "
+                         "from the printed JSON line)")
+    st.add_argument("--local-runners", type=int, default=0,
+                    metavar="N",
+                    help="also start N in-process runners registered "
+                         "to this dispatcher (a self-contained local "
+                         "cluster; 0 = external runners register "
+                         "themselves via python -m "
+                         "flink_tpu.runtime.runner)")
+    st.add_argument("--conf", action="append", default=[],
+                    metavar="KEY=VALUE",
+                    help="session.* quotas / autoscale knobs and any "
+                         "other cluster config")
+    sb = ssub.add_parser(
+        "submit", help="submit a job to a running session cluster "
+                       "(exit 0 = admitted or queued, 1 = rejected)")
+    sb.add_argument("--session", required=True, metavar="HOST:PORT")
+    sb.add_argument("--entry", required=True, metavar="MODULE:FUNCTION")
+    sb.add_argument("--job-id", default=None)
+    sb.add_argument("--conf", action="append", default=[],
+                    metavar="KEY=VALUE")
+    sl = ssub.add_parser(
+        "list", help="per-job registry: state, slots, queue position, "
+                     "attempts, heartbeat-carried metrics")
+    sl.add_argument("--session", required=True, metavar="HOST:PORT")
+    sc = ssub.add_parser("cancel", help="cancel one session job")
+    sc.add_argument("--session", required=True, metavar="HOST:PORT")
+    sc.add_argument("job_id")
+    sp_ = ssub.add_parser(
+        "stop", help="shut the cluster down (cancels every "
+                     "non-terminal job, then the dispatcher exits)")
+    sp_.add_argument("--session", required=True, metavar="HOST:PORT")
+
     logp = sub.add_parser(
         "log", help="inspect a durable log topic (committed offsets, "
                     "staged transactions, segments)")
@@ -268,6 +389,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.cmd == "analyze":
         return _analyze(args)
 
+    if args.cmd == "session":
+        return _session(args)
+
     if args.cmd == "lint":
         from flink_tpu.analysis.pylints import lint_paths
 
@@ -295,8 +419,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             conf["execution.runtime-mode"] = args.runtime_mode
         if args.local:
             return _run_local(args.entry, conf, job_id)
+        if args.session:
+            return _run_attached(args.session, args.entry, conf, job_id)
         if not args.coordinator:
-            raise SystemExit("run needs --coordinator (or --local)")
+            raise SystemExit(
+                "run needs --coordinator, --session, or --local")
         c = _coord_client(args.coordinator)
         try:
             blobs = []

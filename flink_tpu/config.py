@@ -557,6 +557,87 @@ class HostOptions:
         "the worker count.")
 
 
+class SessionOptions:
+    """Session-cluster runtime mode (runtime/session.py, PAPER §3.4
+    dispatcher / ResourceManager / slot pool + §4 session deployment):
+    a long-lived SessionDispatcher multiplexes N submitted jobs onto a
+    shared runner fleet through logical slot quotas, with fair drain
+    scheduling and per-job isolation of checkpoints/faults/metrics."""
+
+    SLOTS_PER_JOB = ConfigOption(
+        "session.slots-per-job", 1,
+        "Logical slots ONE job occupies on its runner (the slot-sharing "
+        "group size, ref: taskmanager slot model). A job may raise it "
+        "in its own submitted config to claim a bigger share; admission "
+        "rejects values < 1 or above session.runner-slots (a quota no "
+        "single runner can ever satisfy — SESSION_QUOTA_INVALID flags "
+        "both at analyze time).")
+    RUNNER_SLOTS = ConfigOption(
+        "session.runner-slots", 4,
+        "Logical slot capacity each registered runner contributes to "
+        "the session slot pool (ref: taskmanager.numberOfTaskSlots). "
+        "Per RUNNER HOST, not per device: the session plane shares one "
+        "chip/host among jobs — device-exclusive placement stays the "
+        "per-job (non-session) submit path.")
+    MAX_JOBS = ConfigOption(
+        "session.max-jobs", 8,
+        "Maximum jobs RUNNING concurrently across the session cluster; "
+        "submissions beyond it queue FIFO and deploy as running jobs "
+        "finish (the Dispatcher submission queue). Queued depth feeds "
+        "the autoscaler.")
+    FAIR_DRAIN = ConfigOption(
+        "session.fair-drain", False,
+        "Serialize co-resident jobs' emit-ring drain fetches through a "
+        "round-robin turnstile (runtime/session.py FairDrainGate) so "
+        "one job's fire/drain burst cannot starve another's emit ring "
+        "on the shared device→host link. The dispatcher stamps this "
+        "true into every session deploy; single-job (non-session) runs "
+        "default off and pay zero overhead.")
+    CONCURRENT_JOBS = ConfigOption(
+        "session.concurrent-jobs", 1,
+        "Deploy-injected by the SessionDispatcher: the job's STATIC "
+        "slot-proportional share denominator — how many jobs of its "
+        "quota fit one runner (session.runner-slots // session.slots-"
+        "per-job, clamped by session.max-jobs). The driver divides "
+        "its host-pool worker count and in-flight step credit by it, "
+        "so K co-resident tenants can never oversubscribe the host "
+        "K-fold regardless of deploy order (the reference's per-slot "
+        "managed-memory split discipline). User configs normally "
+        "never set it.")
+    SCOPED_FAULTS = ConfigOption(
+        "session.scoped-faults", False,
+        "Deploy-injected by the SessionDispatcher when a session job "
+        "carries a faults.* plan: the runner installs it as a JOB-"
+        "SCOPED plan (faults.install_scoped) instead of the process-"
+        "global one, so one tenant's chaos schedule can never inject "
+        "into a co-resident job (the per-job fault-plan isolation of "
+        "the session contract).")
+    AUTOSCALE = ConfigOption(
+        "session.autoscale", True,
+        "Run the dispatcher's autoscaler loop: submission-queue depth "
+        "and aggregate slot pressure push scale-OUT demand through the "
+        "provisioner seam (runtime/provisioner.py request_capacity); "
+        "runners idle past session.scale-down-idle above session.min-"
+        "runners drain (stop-with-savepoint redeploy) and are released "
+        "(release_capacity). False = fixed fleet.")
+    AUTOSCALE_INTERVAL = duration_option(
+        "session.autoscale-interval", 2_000,
+        "Autoscaler evaluation period.")
+    MIN_RUNNERS = ConfigOption(
+        "session.min-runners", 1,
+        "Floor the autoscaler never drains below.")
+    MAX_RUNNERS = ConfigOption(
+        "session.max-runners", 8,
+        "Ceiling on the runner fleet the autoscaler will request "
+        "capacity for (scale-out demand is clamped here, mirroring the "
+        "provisioner's own max_replicas guard).")
+    SCALE_DOWN_IDLE = duration_option(
+        "session.scale-down-idle", 30_000,
+        "A runner holding zero session slots for this long (with the "
+        "fleet above session.min-runners) is drained and released by "
+        "the autoscaler.")
+
+
 class AnalysisOptions:
     FAIL_ON = ConfigOption(
         "analysis.fail-on", "error",

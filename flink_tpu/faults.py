@@ -78,6 +78,22 @@ Instrumented points (the stack's recovery-critical seams):
         a host-parallel operator pass dying mid-batch — the chaos gate
         for the key-sharded session registry / pane-partitioned spill
         store under host.parallelism > 1)
+    session.admit                                  runtime/session.py
+        (the SessionDispatcher admission seam: a raise there is a
+        submission dying between RPC receipt and registry insert — the
+        chaos gate for multi-tenant admission/queueing)
+
+Job-scoped plans (the session-cluster isolation contract): a runner
+process hosting N concurrent jobs cannot use the process-global plan —
+one tenant's chaos schedule would inject into every co-resident job.
+``install_scoped(job_id, config)`` registers a plan keyed by job id and
+``job_scope(job_id)`` marks the current thread as belonging to that
+job; ``fire`` on a scoped thread uses the job's own plan EXCLUSIVELY
+(no scoped plan for the scope → fall back to the global plan, which
+tests install via ``activate()``). The driver propagates its scope to
+the threads it owns (drain, checkpoint executor); threads that serve
+every job (runner heartbeat, RPC server dispatch) stay unscoped on the
+global plan — those seams are process-shared by nature.
 """
 from __future__ import annotations
 
@@ -133,6 +149,7 @@ KNOWN_FAULT_POINTS = frozenset((
     "log.txn.marker",
     "log.txn.commit",
     "host.pool.task",
+    "session.admit",
 ))
 
 # process-global fault/recovery metrics — chaos tests assert every
@@ -276,9 +293,80 @@ _active_from_config = False
 _counter_lock = threading.Lock()
 _counters: Dict[Tuple[str, str], Any] = {}
 
+# job-scoped plans (session-cluster isolation): job_id -> plan, plus
+# the thread-local scope marking which job the current thread serves
+_scoped: Dict[str, FaultPlan] = {}
+_scope_tls = threading.local()
+
 
 def active_plan() -> Optional[FaultPlan]:
     return _active
+
+
+def current_scope() -> Optional[str]:
+    """Job id the current thread is scoped to (None = unscoped)."""
+    return getattr(_scope_tls, "job", None)
+
+
+def set_thread_scope(job_id: Optional[str]) -> None:
+    """Pin THIS thread's scope permanently — the executor-initializer
+    form of ``job_scope`` (a driver's checkpoint worker thread serves
+    exactly one job for its whole life)."""
+    _scope_tls.job = job_id
+
+
+@contextlib.contextmanager
+def job_scope(job_id: Optional[str]):
+    """Mark the current thread as serving ``job_id`` for the block;
+    ``fire`` resolves that job's scoped plan first. None is a no-op
+    passthrough (callers thread an optional scope without branching)."""
+    prev = getattr(_scope_tls, "job", None)
+    _scope_tls.job = job_id
+    try:
+        yield
+    finally:
+        _scope_tls.job = prev
+
+
+def install_scoped(job_id: str, config,
+                   fresh: bool = False) -> Optional[FaultPlan]:
+    """Install the config's fault plan scoped to ``job_id`` — the
+    session-cluster deploy path (one plan per tenant, never the
+    process-global slot). Same idempotence contract as
+    ``install_from_config``: an identical (spec, seed) keeps the
+    existing plan's counters, so count-limited rules survive recovery
+    re-deploys instead of re-firing forever; an empty spec uninstalls.
+
+    ``fresh=True`` (the runner passes it on attempt 1) REPLACES any
+    existing plan regardless: a brand-new submission reusing a job id
+    must never inherit the exhausted counters of a prior tenant that
+    FAILED terminally (the terminal-failure path cannot reliably
+    uninstall — the runner doesn't see the coordinator's fail/restart
+    decision)."""
+    spec = str(config.get(FAULT_INJECT) or "").strip()
+    with _counter_lock:
+        if not spec:
+            _scoped.pop(job_id, None)
+            return None
+        seed = int(config.get(FAULT_SEED))
+        cur = _scoped.get(job_id)
+        if (not fresh and cur is not None and cur.spec == spec
+                and cur.seed == seed):
+            return cur
+        plan = FaultPlan.from_spec(spec, seed=seed)
+        _scoped[job_id] = plan
+        return plan
+
+
+def uninstall_scoped(job_id: str) -> None:
+    """Drop a job's scoped plan (terminal completion / cancel — the
+    tenant left; its schedule must not leak to a job id reuse)."""
+    with _counter_lock:
+        _scoped.pop(job_id, None)
+
+
+def scoped_plan(job_id: str) -> Optional[FaultPlan]:
+    return _scoped.get(job_id)
 
 
 def install_from_config(config) -> Optional[FaultPlan]:
@@ -307,10 +395,13 @@ def install_from_config(config) -> Optional[FaultPlan]:
 
 
 def clear() -> None:
-    """Drop the process-global plan (teardown safety)."""
+    """Drop the process-global plan AND every scoped plan (teardown
+    safety)."""
     global _active, _active_from_config
     _active = None
     _active_from_config = False
+    with _counter_lock:
+        _scoped.clear()
 
 
 def fire(point: str, exc: type = RuntimeError, **attrs: Any) -> None:
@@ -319,6 +410,16 @@ def fire(point: str, exc: type = RuntimeError, **attrs: Any) -> None:
     (OSError for storage, ConnectionError for transports) so injected
     faults travel the production error paths."""
     plan = _active
+    if _scoped:
+        # a scoped thread uses its job's plan EXCLUSIVELY (tenant
+        # isolation); a scope with no plan of its own falls back to the
+        # global plan (tests' activate()); unscoped threads (heartbeat,
+        # RPC dispatch — process-shared seams) stay on the global plan
+        sid = getattr(_scope_tls, "job", None)
+        if sid is not None:
+            sp = _scoped.get(sid)
+            if sp is not None:
+                plan = sp
     if plan is None:
         return
     hit = plan.decide(point)

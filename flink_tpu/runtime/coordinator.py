@@ -77,6 +77,11 @@ class JobInfo:
     egraph: Optional[ExecutionGraph] = None
     # newest heartbeat-carried driver metrics (web UI gauges)
     last_metrics: Optional[Dict[str, Any]] = None
+    # lifecycle stamps (session registry / bench wall clocks): submit
+    # receipt, first successful deploy, terminal transition
+    submitted_at: float = dataclasses.field(default_factory=time.time)
+    started_at: Optional[float] = None
+    finished_at: Optional[float] = None
 
 
 class JobCoordinator(RpcEndpoint):
@@ -246,6 +251,26 @@ class JobCoordinator(RpcEndpoint):
         return {"assigned": chosen}
 
     # -- deployment ------------------------------------------------------
+    def _admit_locked(self, j: JobInfo) -> bool:
+        """Admission gate consulted by _deploy under the lock before any
+        slot is allocated. The base coordinator admits everything; the
+        SessionDispatcher overrides it with the max-jobs headroom check
+        (queued jobs park in WAITING_FOR_RESOURCES until a running job
+        frees headroom — the finish/cancel capacity kicks re-deploy
+        them in submission order)."""
+        return True
+
+    def _admit_refusal(self, j: JobInfo) -> str:
+        """Human-readable parking reason when _admit_locked refuses."""
+        return "queued by the admission gate"
+
+    def _deploy_config_locked(self, j: JobInfo, config: Dict[str, Any],
+                              target: "RunnerInfo") -> Dict[str, Any]:
+        """Per-deploy config injection (lock held, slots allocated):
+        the SessionDispatcher stamps admission-decided resource shares
+        here; the base coordinator pushes the job's config untouched."""
+        return config
+
     def _deploy_async(self, job_id: str, delay_s: float = 0.0,
                       exclude: Optional[List[str]] = None) -> None:
         """Push the job's deployment descriptor to an alive runner on a
@@ -269,6 +294,15 @@ class JobCoordinator(RpcEndpoint):
             # re-deploy it onto another runner
             if (j.state == "RUNNING"
                     and self._slots.allocation(job_id) is not None):
+                return
+            # session-mode admission seam (runtime/session.py): the
+            # base coordinator admits every deploy; a SessionDispatcher
+            # parks jobs past its max-jobs headroom back on the queue.
+            # Checked UNDER the lock so racing capacity kicks cannot
+            # admit two jobs into one remaining slot of headroom.
+            if not self._admit_locked(j):
+                j.state = "WAITING_FOR_RESOURCES"
+                j.failure = self._admit_refusal(j)
                 return
             # slot allocation: best-fit over free device counts; a retry
             # releases the previous allocation first (ref:
@@ -336,6 +370,8 @@ class JobCoordinator(RpcEndpoint):
                 j.egraph.set_parallelism(resolved)
             j.state = "RUNNING"
             j.failure = None
+            if j.started_at is None:
+                j.started_at = time.time()
             j.assigned_runners = ([t.runner_id for t in targets]
                                   if targets is not None
                                   else [target.runner_id])
@@ -343,7 +379,11 @@ class JobCoordinator(RpcEndpoint):
             if j.egraph is not None:
                 j.egraph.start_attempt(j.attempts, target.runner_id)
             self._persist_locked(j)
-            entry, config, attempt = j.entry, dict(j.config), j.attempts
+            entry, attempt = j.entry, j.attempts
+            # per-deploy config injection seam (runtime/session.py
+            # stamps the resource-share denominator here); base = the
+            # job's own config, untouched
+            config = self._deploy_config_locked(j, dict(j.config), target)
             blobs = list(j.py_blobs)
             if j.restore_path:
                 # one-shot explicit restore (rescale savepoint); a later
@@ -445,9 +485,15 @@ class JobCoordinator(RpcEndpoint):
         targets: List[RunnerInfo] = []
         with self._lock:
             j = self.jobs.get(job_id)
-            if j is not None and j.state in (
+            if j is None:
+                # unknown id is an ERROR, not a silent no-op: the CLI
+                # exit contract (0 = canceled, 1 = refused) must let a
+                # script distinguish a typo'd job id from a real cancel
+                return {"ok": False, "reason": f"unknown job {job_id!r}"}
+            if j.state in (
                     "RUNNING", "RESTARTING", "WAITING_FOR_RESOURCES"):
                 j.state = "CANCELED"
+                j.finished_at = time.time()
                 j.pending_rescale = None
                 j.rescale_token = None
                 self._slots.release(job_id)
@@ -510,6 +556,7 @@ class JobCoordinator(RpcEndpoint):
             # ran to completion does not flip CANCELED back to FINISHED
             if j is not None and j.state in ("RUNNING", "RESTARTING"):
                 j.state = "FINISHED"
+                j.finished_at = time.time()
                 j.pending_rescale = None
                 j.rescale_token = None
                 self._slots.release(job_id)
@@ -577,6 +624,7 @@ class JobCoordinator(RpcEndpoint):
             return {"action": "restart", "delay_ms": delay,
                     "restore": "latest"}
         j.state = "FAILED"
+        j.finished_at = time.time()
         self._slots.release(j.job_id)
         self._persist_locked(j)
         return {"action": "fail"}

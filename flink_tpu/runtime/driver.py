@@ -185,6 +185,19 @@ class Driver:
         # drain thread (shared sinks + metrics are single-writer at a
         # time; the expensive materialization stays outside the lock)
         self._push_lock = threading.Lock()
+        # fair drain scheduling (session-cluster mode): co-resident
+        # jobs' drain fetches take round-robin turns on the process-
+        # global gate so one tenant's fire burst cannot starve a
+        # peer's emit ring on the shared device→host link. Off (None)
+        # outside session deploys — the single-job path is untouched.
+        from flink_tpu.config import SessionOptions as _SO
+
+        self._drain_gate = None
+        self._gate_token = f"drv-{id(self)}"
+        if bool(self.config.get(_SO.FAIR_DRAIN)):
+            from flink_tpu.runtime.session import drain_gate
+
+            self._drain_gate = drain_gate()
         self._build_ops()
         # plan-time HBM budgeting: dense static layouts make the device
         # footprint computable BEFORE the first step — fail at build
@@ -214,6 +227,22 @@ class Driver:
         slots = self.config.get(StateOptions.SLOTS_PER_SHARD)
         self._base_inflight = int(
             self.config.get(PipelineOptions.MAX_INFLIGHT_STEPS))
+        # session resource shares (runtime/session.py): the dispatcher
+        # stamps session.concurrent-jobs = K (the STATIC slot-
+        # proportional denominator: jobs of this quota that fit one
+        # runner) into the deploy config; this job's in-flight step
+        # credit and host-pool worker count each take a 1/K share so
+        # co-resident jobs cannot oversubscribe the transport queue or
+        # the host cores, regardless of deploy order — the host-pool /
+        # in-flight legs of the admission quota. K = 1 (every
+        # non-session run) changes nothing.
+        from flink_tpu.config import SessionOptions
+
+        self._session_share = max(
+            1, int(self.config.get(SessionOptions.CONCURRENT_JOBS)))
+        if self._session_share > 1:
+            self._base_inflight = max(
+                1, self._base_inflight // self._session_share)
         # sub-batching dispatches K steps per logical batch, each 1/K
         # the records: scale the in-flight credit so pipeline depth
         # measured in LOGICAL batches (and therefore in bytes queued on
@@ -267,8 +296,13 @@ class Driver:
         from flink_tpu.config import HostOptions
         from flink_tpu.parallel.hostpool import HostPool
 
-        self.host_pool = HostPool.from_config(self.config,
-                                              registry=self.registry)
+        host_w = int(self.config.get(HostOptions.PARALLELISM))
+        if self._session_share > 1:
+            # the host-pool share of the session quota: K co-resident
+            # jobs split the configured worker count instead of each
+            # claiming all of it
+            host_w = max(1, host_w // self._session_share)
+        self.host_pool = HostPool(host_w, registry=self.registry)
         fold_chunk = int(self.config.get(HostOptions.FOLD_CHUNK_RECORDS))
         if fold_chunk < 1:
             raise ValueError(
@@ -1113,8 +1147,18 @@ class Driver:
                     setter(attempt_epoch)
         from concurrent.futures import ThreadPoolExecutor
 
+        from flink_tpu import faults
+
+        # fault-scope propagation (session tenant isolation): the run
+        # executes on a thread the runner already scoped to this job;
+        # the threads the DRIVER owns — drain, checkpoint executor —
+        # must carry the same scope or a tenant's checkpoint/upload
+        # fault rules would miss its own background work
+        self._fault_scope = faults.current_scope()
         self._ckpt_executor = (ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="ckpt")
+            max_workers=1, thread_name_prefix="ckpt",
+            initializer=faults.set_thread_scope,
+            initargs=(self._fault_scope,))
             if self._coordinator is not None else None)
         self._ckpt_pending = None
         self._ckpt_base = None
@@ -1135,7 +1179,7 @@ class Driver:
         from flink_tpu.obs.profiling import StepProfiler
 
         self._profiler = StepProfiler.from_config(self.config)
-        drain = threading.Thread(target=self._drain_loop, daemon=True)
+        drain = threading.Thread(target=self._drain_entry, daemon=True)
         drain.start()
         try:
             return self._run_loop(job_name, drain, interval_ms, restore)
@@ -2048,7 +2092,24 @@ class Driver:
             self._stateless_cache[nid] = ok
         return self._stateless_cache[nid]
 
+    def _drain_entry(self) -> None:
+        """Drain-thread trampoline: carries the job's fault scope (a
+        session tenant's scoped plan must see this thread as the job's)
+        and the fair-drain gate membership across the loop's lifetime."""
+        from flink_tpu import faults
+
+        gate = self._drain_gate
+        if gate is not None:
+            gate.register(self._gate_token)
+        try:
+            with faults.job_scope(getattr(self, "_fault_scope", None)):
+                self._drain_loop()
+        finally:
+            if gate is not None:
+                gate.unregister(self._gate_token)
+
     def _drain_loop(self) -> None:
+        import contextlib
         import queue as _q
 
         from flink_tpu.ops.window import FiredWindows
@@ -2058,6 +2119,7 @@ class Driver:
         # self._emit_q / re-arms for a successor run
         emit_q = self._emit_q
         discard = self._drain_discard
+        gate = self._drain_gate
         while True:
             items = [emit_q.get()]
             # Deferral: the fire dispatch already issued copy_to_host_async
@@ -2090,9 +2152,15 @@ class Driver:
             barrier = stop or self._flush_req.is_set()
             try:
                 tm0 = time.perf_counter()
-                with self._link_lock:
-                    FiredWindows.materialize_many(
-                        [f for _, f, _ in batch], barrier=barrier)
+                # fair-drain turn: the device fetch — the part that
+                # holds the shared device→host link — waits its round-
+                # robin turn among co-resident jobs; the host-side
+                # decode/push below stays outside the turn
+                with (gate.turn(self._gate_token) if gate is not None
+                      else contextlib.nullcontext()):
+                    with self._link_lock:
+                        FiredWindows.materialize_many(
+                            [f for _, f, _ in batch], barrier=barrier)
                 self.prof["drain_link_held"] += time.perf_counter() - tm0
                 with self._push_lock:
                     # re-check under the push lock: the run may have

@@ -30,6 +30,12 @@ class Provisioner:
     def request_capacity(self, demands: List[Dict[str, Any]]) -> None:
         raise NotImplementedError
 
+    def release_capacity(self, runner_ids: List[str]) -> None:
+        """Scale-IN: the named runners hold nothing (the coordinator
+        already drained them via ``rpc_drain_runner``) and may be
+        removed. Default no-op — standalone mode leaves machine
+        lifecycle to whoever started the runner."""
+
 
 class StandaloneProvisioner(Provisioner):
     """No active provisioning (ref: StandaloneResourceManager): demand
@@ -38,9 +44,13 @@ class StandaloneProvisioner(Provisioner):
 
     def __init__(self) -> None:
         self.requests: List[List[Dict[str, Any]]] = []
+        self.releases: List[List[str]] = []
 
     def request_capacity(self, demands: List[Dict[str, Any]]) -> None:
         self.requests.append(list(demands))
+
+    def release_capacity(self, runner_ids: List[str]) -> None:
+        self.releases.append(list(runner_ids))
 
 
 class KubectlScaleProvisioner(Provisioner):
@@ -67,8 +77,33 @@ class KubectlScaleProvisioner(Provisioner):
         if target <= self._target:
             return
         self._target = target
-        cmd = ["kubectl", "-n", self.namespace, "scale", self.workload,
-               f"--replicas={target}"]
+        self._scale(target)
+
+    def release_capacity(self, runner_ids: List[str]) -> None:
+        """Scale-in targeting THE DRAINED PODS, not an arbitrary one:
+        a bare replica decrement lets the Deployment controller pick
+        its victim, which can kill a BUSY runner while the drained
+        idle pod keeps running (its jobs would ride loss-detection
+        restarts for nothing). The drained pod is marked cheapest to
+        evict via ``controller.kubernetes.io/pod-deletion-cost`` first,
+        THEN the replica target drops — requires runner_id == pod name
+        (deploy/kubernetes.yaml wires ``--runner-id`` from the
+        downward-API pod name)."""
+        target = max(0, self._target - len(runner_ids))
+        if target == self._target:
+            return
+        self._target = target
+        for rid in runner_ids:
+            self._run(["kubectl", "-n", self.namespace, "annotate",
+                       "pod", rid, "--overwrite",
+                       "controller.kubernetes.io/pod-deletion-cost=-1"])
+        self._scale(target)
+
+    def _scale(self, target: int) -> None:
+        self._run(["kubectl", "-n", self.namespace, "scale",
+                   self.workload, f"--replicas={target}"])
+
+    def _run(self, cmd: List[str]) -> None:
         self.commands.append(cmd)
         if not self.dry_run:
             subprocess.run(cmd, check=False, capture_output=True)

@@ -367,16 +367,40 @@ class TaskRunner(RpcEndpoint):
             config.setdefault(
                 "cluster.coordinator",
                 f"{self._coord_addr[0]}:{self._coord_addr[1]}")
-            env = StreamExecutionEnvironment(Configuration(config))
-            build(env)
-            rec["env"] = env  # live-metrics seam for heartbeats
-            self._report_plan(job_id, env)
-            env.execute(job_id, cancel=cancel,
-                        savepoint_request=rec.get("savepoint"))
+            from flink_tpu import faults
+
+            # session tenant isolation: a session-deployed job's
+            # faults.* plan installs keyed to ITS job id, never in the
+            # process-global slot — co-resident jobs on this runner are
+            # invisible to it. Idempotent across recovery re-deploys
+            # (counters persist, so count-limited rules don't re-fire
+            # forever). Non-session deploys keep the documented
+            # process-global posture: chaos runs get their own runner.
+            scoped = bool(config.get("session.scoped-faults"))
+            if scoped:
+                # attempt 1 = a NEW submission: always a fresh plan (a
+                # prior FAILED tenant with this id may have left
+                # exhausted counters behind); attempt >= 2 = recovery
+                # of THIS submission: keep counters
+                faults.install_scoped(job_id, Configuration(config),
+                                      fresh=attempt <= 1)
+            with faults.job_scope(job_id if scoped else None):
+                env = StreamExecutionEnvironment(Configuration(config))
+                build(env)
+                rec["env"] = env  # live-metrics seam for heartbeats
+                self._report_plan(job_id, env)
+                env.execute(job_id, cancel=cancel,
+                            savepoint_request=rec.get("savepoint"))
             self._report("finish_job", job_id=job_id, attempt=attempt,
                          runner_id=self.runner_id)
+            if scoped:
+                faults.uninstall_scoped(job_id)
         except JobCancelledError:
-            pass  # the canceller (coordinator) already owns the state
+            # the canceller (coordinator) already owns the state; a
+            # cancelled tenant's scoped plan leaves with it
+            from flink_tpu import faults
+
+            faults.uninstall_scoped(job_id)
         except BaseException:  # noqa: BLE001 — every fault goes upstream
             self._report("report_failure", job_id=job_id, attempt=attempt,
                          error=traceback.format_exc(limit=5))

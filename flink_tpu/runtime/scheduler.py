@@ -213,12 +213,15 @@ class SlotPool:
         self._allocations: Dict[str, tuple] = {}
         self._multi: Dict[str, List[tuple]] = {}
 
-    def free_devices(self, runner_id: str, total: int) -> int:
+    def used_devices(self, runner_id: str) -> int:
         used = sum(d for r, d in self._allocations.values()
                    if r == runner_id)
         used += sum(d for allocs in self._multi.values()
                     for r, d in allocs if r == runner_id)
-        return total - used
+        return used
+
+    def free_devices(self, runner_id: str, total: int) -> int:
+        return total - self.used_devices(runner_id)
 
     def allocate(self, job_id: str, runner_id: str, devices: int) -> None:
         self._allocations[job_id] = (runner_id, devices)

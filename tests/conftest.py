@@ -82,3 +82,8 @@ def pytest_configure(config):
         "(pipeline.sub-batches) — K-parity gates on the golden Q5/"
         "sessions pipelines, checkpoint/restore across a sub-batch "
         "boundary, chaos at K=4, and the CLI smoke (tier-1)")
+    config.addinivalue_line(
+        "markers", "session: session-cluster runtime mode (flink_tpu/"
+        "runtime/session.py) — slot quotas, FIFO admission queue, fair "
+        "drain scheduling, autoscaler, per-job isolation, multi-tenant "
+        "chaos, and the `session` CLI smoke (tier-1)")

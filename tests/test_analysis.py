@@ -148,6 +148,16 @@ def _config_key_typo(tmp_path):
     return env.analyze()
 
 
+@seed("SESSION_QUOTA_INVALID")
+def _session_quota_invalid(tmp_path):
+    # a per-job slot quota above one runner's capacity: no fleet of any
+    # size could place the job — the dispatcher rejects the submission
+    # and the analyzer flags the conf before it is ever submitted
+    env = clean_pipeline({"session.slots-per-job": 3,
+                          "session.runner-slots": 2})
+    return env.analyze()
+
+
 @seed("HOST_PARALLELISM_INVALID")
 def _host_parallelism_invalid(tmp_path):
     # below 1: the driver rejects it at build; the analyzer must flag

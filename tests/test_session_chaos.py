@@ -1,0 +1,255 @@
+"""Multi-tenant chaos (ISSUE 8 satellite): two CONCURRENT jobs on one
+session cluster under ``faults.*`` injection — exactly-once per job,
+and NO cross-job interference: one tenant's induced restart leaves the
+other's committed output identical to its fault-free golden.
+
+The isolation mechanism under test is the JOB-SCOPED fault plan
+(faults.install_scoped + thread scopes): the faulty tenant's plan
+injects only on threads serving that job (its run thread, drain
+thread, checkpoint executor), so the co-resident tenant never sees an
+injection even though both share one runner process — the situation
+the process-global plan's docstring explicitly forbids co-scheduling
+under.
+
+Fault kinds: checkpoint-storage write failure (induces a full restart
++ restore of one tenant), RPC transport drop on a lifecycle report,
+and the new ``session.admit`` dispatcher admission point.
+"""
+import os
+import time
+
+import pytest
+
+from flink_tpu import faults
+from flink_tpu.config import Configuration
+from flink_tpu.runtime.session import LocalSessionCluster, SessionDispatcher
+
+from test_runner_process import wait_until
+
+pytestmark = [pytest.mark.session, pytest.mark.chaos]
+
+
+def _cluster_conf():
+    return Configuration({
+        "heartbeat.interval": "200ms",
+        "heartbeat.timeout": "5s",
+        "session.autoscale": False,
+        "restart-strategy.type": "fixed-delay",
+        "restart-strategy.fixed-delay.attempts": 3,
+        "restart-strategy.fixed-delay.delay": "100ms",
+    })
+
+
+def _job_conf(tmp_path, tag, n_batches, faults_spec=None, seed=7):
+    conf = {
+        "test.n-batches": n_batches,
+        "test.batch-sleep-ms": 40,
+        "test.sink-dir": str(tmp_path / f"sink-{tag}"),
+        "execution.checkpointing.dir": str(tmp_path / "chk"),
+        "execution.checkpointing.interval": "150ms",
+        "state.num-key-shards": 8,
+        "state.slots-per-shard": 16,
+    }
+    if faults_spec:
+        conf["faults.inject"] = faults_spec
+        conf["faults.seed"] = seed
+    return conf
+
+
+def _committed(sink_dir):
+    """Sorted committed rows (key, window_start, count) — the
+    byte-equivalent comparable view of a FileTransactionalSink's
+    output (row content is everything the sink commits; file
+    boundaries follow checkpoint timing, which is wall-clock)."""
+    from flink_tpu.api.sinks import FileTransactionalSink
+
+    return sorted(
+        (int(r["key"]), int(r["window_start"]), int(r["count"]))
+        for r in FileTransactionalSink.committed_rows(sink_dir))
+
+
+def _assert_exactly_once(sink_dir, n_batches):
+    import runner_job
+    from flink_tpu.api.sinks import FileTransactionalSink
+
+    got = {}
+    for r in FileTransactionalSink.committed_rows(sink_dir):
+        kk = (int(r["key"]), int(r["window_start"]))
+        assert kk not in got, f"duplicate emission for {kk}"
+        got[kk] = int(r["count"])
+    assert got == runner_job.golden_counts(n_batches)
+
+
+class TestTwoTenantChaos:
+    def test_storage_fault_restart_leaves_peer_untouched(self, tmp_path):
+        """Tenant A takes an injected checkpoint-storage failure →
+        full restart + restore from ITS checkpoint subtree; tenant B
+        runs fault-free beside it the whole time. A must still commit
+        exactly-once; B must commit its fault-free golden in ONE
+        attempt, with its checkpoint subtree untouched by A's
+        recovery."""
+        n = 10
+        # fault-free golden for B, alone on its own cluster
+        with LocalSessionCluster(_cluster_conf(), runners=1,
+                                 runner_prefix="golden") as c:
+            r = c.submit("runner_job:build",
+                         config=_job_conf(tmp_path / "solo", "b", n),
+                         job_id="golden-b")
+            assert r["admitted"]
+            assert c.wait("golden-b") == "FINISHED"
+        golden_b = _committed(str(tmp_path / "solo" / "sink-b"))
+        assert golden_b
+
+        with LocalSessionCluster(_cluster_conf(), runners=1,
+                                 runner_prefix="chaos") as c:
+            ra = c.submit(
+                "runner_job:build",
+                config=_job_conf(
+                    tmp_path, "a", n,
+                    faults_spec="checkpoint.storage.write=raise x1 +2"),
+                job_id="chaos-a")
+            rb = c.submit("runner_job:build",
+                          config=_job_conf(tmp_path, "b", n),
+                          job_id="live-b")
+            assert ra["admitted"] and rb["admitted"]
+            wait_until(
+                lambda: all(c.dispatcher.jobs[j].state == "RUNNING"
+                            for j in ("chaos-a", "live-b")), 30,
+                what="both tenants running concurrently")
+            assert c.wait("chaos-a") == "FINISHED"
+            assert c.wait("live-b") == "FINISHED"
+            # the fault fired and A actually recovered through restart
+            assert c.dispatcher.jobs["chaos-a"].attempts >= 2, (
+                "storage fault never induced a restart")
+            # B never restarted: the injection was invisible to it
+            assert c.dispatcher.jobs["live-b"].attempts == 1
+        snap = faults.snapshot()
+        assert snap.get("faults.checkpoint.storage.write.raise", 0) >= 1
+        _assert_exactly_once(str(tmp_path / "sink-a"), n)
+        # NO cross-job interference: B's committed output is identical
+        # to its fault-free golden, row for row
+        assert _committed(str(tmp_path / "sink-b")) == golden_b
+        # and the checkpoint subtrees stayed disjoint per tenant
+        assert sorted(os.listdir(tmp_path / "chk")) == [
+            "chaos-a", "live-b"]
+
+    def test_rpc_drop_scoped_to_one_tenant(self, tmp_path):
+        """Transport drops on tenant A's lifecycle reports (scoped
+        rpc.client.send) ride the report retry loop; tenant B's RPC
+        traffic — sharing the same runner process and the same
+        coordinator client — is never injected."""
+        n = 6
+        with LocalSessionCluster(_cluster_conf(), runners=1,
+                                 runner_prefix="rpc") as c:
+            ra = c.submit(
+                "runner_job:build",
+                config=_job_conf(tmp_path, "ra", n,
+                                 faults_spec="rpc.client.send=drop x2"),
+                job_id="rpc-a")
+            rb = c.submit("runner_job:build",
+                          config=_job_conf(tmp_path, "rb", n),
+                          job_id="rpc-b")
+            assert ra["admitted"] and rb["admitted"]
+            assert c.wait("rpc-a") == "FINISHED"
+            assert c.wait("rpc-b") == "FINISHED"
+            assert c.dispatcher.jobs["rpc-b"].attempts == 1
+        snap = faults.snapshot()
+        assert snap.get("faults.rpc.client.send.drop", 0) >= 1
+        _assert_exactly_once(str(tmp_path / "sink-ra"), n)
+        _assert_exactly_once(str(tmp_path / "sink-rb"), n)
+
+
+class TestAdmissionFaultPoint:
+    def test_admit_fault_leaves_registry_consistent(self):
+        """The dispatcher admission fault point (session.admit): an
+        injected failure between RPC receipt and registry insert loses
+        the submission cleanly — no half-registered job — and the
+        caller's retry admits normally."""
+        plan = faults.FaultPlan(seed=3).rule("session.admit", "raise",
+                                             count=1)
+        disp = SessionDispatcher(Configuration({
+            "session.autoscale": False}))
+        try:
+            with plan.activate():
+                with pytest.raises(RuntimeError) as e:
+                    disp.rpc_submit_session_job("adm", "m:f", {})
+                assert faults.is_injected(e.value)
+                assert "adm" not in disp.jobs, (
+                    "a failed admission must not half-register the job")
+                r = disp.rpc_submit_session_job("adm", "m:f", {})
+                assert r["admitted"]
+                assert disp.jobs["adm"].state == "WAITING_FOR_RESOURCES"
+            assert plan.log and plan.log[0][0] == "session.admit"
+        finally:
+            disp.close()
+
+
+class TestScopedPlanMechanics:
+    def test_scoped_plan_exclusive_to_its_thread_scope(self):
+        faults.clear()
+        plan = faults.install_scoped(
+            "tenant-x",
+            Configuration({"faults.inject": "host.pool.task=raise x1"}))
+        try:
+            assert plan is not None
+            # unscoped thread: no injection
+            faults.fire("host.pool.task")
+            # peer scope: no injection
+            with faults.job_scope("tenant-y"):
+                faults.fire("host.pool.task")
+            # the owning scope: injects
+            with faults.job_scope("tenant-x"):
+                with pytest.raises(RuntimeError):
+                    faults.fire("host.pool.task")
+                faults.fire("host.pool.task")  # x1 exhausted
+        finally:
+            faults.clear()
+
+    def test_install_scoped_idempotent_preserves_counters(self):
+        """A recovery re-deploy re-installs the same (spec, seed):
+        the plan object — and its injection counters — must survive,
+        or count-limited rules would re-fire on every attempt and the
+        job could never complete."""
+        faults.clear()
+        conf = Configuration({"faults.inject": "dcn.send=drop x1",
+                              "faults.seed": 11})
+        try:
+            p1 = faults.install_scoped("t", conf)
+            with faults.job_scope("t"):
+                with pytest.raises(ConnectionError):
+                    faults.fire("dcn.send")
+            p2 = faults.install_scoped("t", conf)  # the re-deploy
+            assert p2 is p1
+            with faults.job_scope("t"):
+                faults.fire("dcn.send")  # still exhausted — no re-fire
+            # a CHANGED spec is a new plan
+            p3 = faults.install_scoped("t", Configuration(
+                {"faults.inject": "dcn.send=drop x2", "faults.seed": 11}))
+            assert p3 is not p1
+            # empty spec uninstalls
+            faults.install_scoped("t", Configuration({}))
+            assert faults.scoped_plan("t") is None
+        finally:
+            faults.clear()
+
+    def test_fresh_install_replaces_exhausted_plan(self):
+        """A NEW submission reusing a job id (runner attempt 1 passes
+        fresh=True) must not inherit a FAILED prior tenant's exhausted
+        counters — its count-limited rules fire again (review
+        regression)."""
+        faults.clear()
+        conf = Configuration({"faults.inject": "dcn.send=drop x1",
+                              "faults.seed": 11})
+        try:
+            faults.install_scoped("t", conf)
+            with faults.job_scope("t"):
+                with pytest.raises(ConnectionError):
+                    faults.fire("dcn.send")  # exhaust x1
+            # same spec+seed, but a FRESH submission: counters reset
+            p = faults.install_scoped("t", conf, fresh=True)
+            assert p is faults.scoped_plan("t")
+            with faults.job_scope("t"):
+                with pytest.raises(ConnectionError):
+                    faults.fire("dcn.send")
+        finally:
+            faults.clear()
