@@ -192,12 +192,15 @@ def window_without_fire_bound(plan, config) -> Iterable[Finding]:
 
 
 @plan_rule("LOG_TOPIC_MULTI_WRITER", "error",
-           fix="one LogSink per topic (union streams if needed)")
+           fix="lease disjoint partitions (owned_partitions + "
+               "producer_id), or one LogSink per topic")
 def log_topic_multi_writer(plan, config) -> Iterable[Finding]:
-    """Two LogSinks on one topic directory: the embedded log is
-    single-writer by design (no broker to serialize appends) — a second
-    writer's recovery sweep rolls back the first writer's staged
-    transactions."""
+    """Multiple LogSinks on one topic directory WITHOUT disjoint
+    partition leases: the embedded log serializes appends per
+    PARTITION via fenced writer leases (log/bus.py), so N sinks with
+    pairwise-disjoint ``owned_partitions`` (distinct producer ids) are
+    legal — but two un-leased writers, or two leases overlapping on a
+    partition, roll back each other's staged transactions."""
     try:
         from flink_tpu.log.connectors import LogSink
     except Exception:  # log subsystem not importable: nothing to check
@@ -208,17 +211,74 @@ def log_topic_multi_writer(plan, config) -> Iterable[Finding]:
             topic = os.path.realpath(str(node.sink.path))
             by_topic.setdefault(topic, []).append(node)
     for topic, nodes in by_topic.items():
-        if len(nodes) > 1:
-            names = ", ".join(f"{n.id} ({n.name!r})" for n in nodes)
-            for node in nodes:
-                yield _f(
-                    f"log topic {topic!r} has {len(nodes)} writers in "
-                    f"this plan (sink nodes {names}) — the embedded log "
-                    "is single-writer; concurrent appenders roll back "
-                    "each other's staged transactions",
-                    fix="give each sink its own topic, or union the "
-                        "streams into ONE LogSink",
-                    node=node.id, node_name=node.name)
+        if len(nodes) < 2:
+            continue
+        appenders = [n.sink._appender for n in nodes]
+        leased = all(a.writer_id for a in appenders)
+        owners = {}
+        overlap = set()
+        for n, a in zip(nodes, appenders):
+            for p in a.owned:
+                if p in owners:
+                    overlap.add(p)
+                owners[p] = n
+        distinct_ids = len({a.writer_id for a in appenders}) == len(
+            appenders)
+        if leased and distinct_ids and not overlap:
+            continue  # disjoint leased partitions: legal multi-writer
+        names = ", ".join(f"{n.id} ({n.name!r})" for n in nodes)
+        if leased and overlap:
+            why = (f"their leased partition sets overlap on "
+                   f"{sorted(overlap)} — a partition has ONE writer; "
+                   "the lease fence will depose one of them mid-run")
+        elif leased:
+            why = ("they share a producer_id — writer-scoped markers "
+                   "and leases would collide")
+        else:
+            why = ("they hold no partition leases — un-leased "
+                   "concurrent appenders roll back each other's "
+                   "staged transactions")
+        for node in nodes:
+            yield _f(
+                f"log topic {topic!r} has {len(nodes)} writers in "
+                f"this plan (sink nodes {names}) and {why}",
+                fix="give each sink disjoint owned_partitions with a "
+                    "distinct producer_id (fenced leases), or give "
+                    "each its own topic / union the streams into ONE "
+                    "LogSink",
+                node=node.id, node_name=node.name)
+
+
+@config_rule("LOG_RETENTION_UNSAFE", "warn",
+             fix="set log.retention.ms >= "
+                 "execution.checkpointing.interval (or disable one)")
+def log_retention_unsafe(plan, config) -> Iterable[Finding]:
+    """A retention window shorter than the checkpoint interval under
+    checkpointing: consumer-group offsets only advance at checkpoint
+    complete, so the dynamic safety floor pins every segment a group
+    still needs — but a retention pass between a consumer's start and
+    its FIRST completed checkpoint sees no group floor to respect for
+    groups that have not committed yet, and a window below the
+    checkpoint cadence guarantees the topic is perpetually at the
+    floor (retention that can never drop anything, or drops history a
+    brand-new group expected to backfill from)."""
+    from flink_tpu.config import CheckpointingOptions, LogOptions
+
+    retention_ms = int(config.get(LogOptions.RETENTION_MS))
+    interval = int(config.get(CheckpointingOptions.INTERVAL))
+    if retention_ms <= 0 or interval <= 0:
+        return
+    if retention_ms < interval:
+        yield _f(
+            f"log.retention.ms={retention_ms} is shorter than "
+            f"execution.checkpointing.interval={interval}: group "
+            "committed offsets (the retention safety floor) only "
+            "advance at checkpoint complete, so retention this "
+            "aggressive either never drops anything (floor-pinned) or "
+            "expires history a new consumer generation expected to "
+            "bootstrap from",
+            fix=f"raise log.retention.ms to >= {interval}, lower the "
+                "checkpoint interval, or disable time retention")
 
 
 @config_rule("FAULT_POINT_UNKNOWN", "error",

@@ -15,7 +15,8 @@ script. Here::
     python -m flink_tpu analyze [job.conf] [--entry pkg.mod:build] \
         [--json] [--explain] [--fail-on error|warn|off]
     python -m flink_tpu lint [paths ...] [--json]
-    python -m flink_tpu log TOPIC_DIR
+    python -m flink_tpu log TOPIC_DIR [--compact] [--retain] \
+        [--conf key=value ...]
     python -m flink_tpu list --coordinator H:P
     python -m flink_tpu status --coordinator H:P JOB_ID
     python -m flink_tpu cancel --coordinator H:P JOB_ID
@@ -362,10 +363,30 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp_.add_argument("--session", required=True, metavar="HOST:PORT")
 
     logp = sub.add_parser(
-        "log", help="inspect a durable log topic (committed offsets, "
-                    "staged transactions, segments)")
+        "log",
+        help="inspect a durable log topic (committed offsets, staged "
+             "transactions, segments, compaction generation, "
+             "retention floor, active writer leases with epochs, "
+             "per-consumer-group committed offsets) — optionally run "
+             "a maintenance pass first",
+        epilog="exit codes: 0 = ok, 1 = topic/maintenance error "
+               "(corrupt state, compaction failure), 2 = usage/path "
+               "error (no such topic).")
     logp.add_argument("topic", metavar="TOPIC_DIR",
                       help="topic directory (<log.dir>/<name>)")
+    logp.add_argument("--compact", action="store_true",
+                      help="run one key-compaction pass before "
+                           "describing (log.compaction.* grammar via "
+                           "--conf; key defaults to the topic's "
+                           "recorded key_field)")
+    logp.add_argument("--retain", action="store_true",
+                      help="run one retention pass before describing "
+                           "(log.retention.ms / .bytes / .ts-field "
+                           "via --conf)")
+    logp.add_argument("--conf", action="append", default=[],
+                      metavar="KEY=VALUE",
+                      help="log.compaction.* / log.retention.* "
+                           "maintenance knobs")
 
     for name, help_ in (("list", "list jobs"), ("runners", "list runners")):
         sp = sub.add_parser(name, help=help_)
@@ -404,12 +425,44 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1 if findings else 0
 
     if args.cmd == "log":
+        import os
+
+        from flink_tpu.fs import get_filesystem
         from flink_tpu.log.topic import LogError, describe_topic
 
+        # path errors are exit 2 (the analyze/lint contract: a typo'd
+        # TOPIC_DIR — or an unregistered scheme — must not read like
+        # corrupt topic state)
         try:
-            print(json.dumps(describe_topic(args.topic)))
+            missing = not get_filesystem(args.topic).exists(
+                os.path.join(args.topic, "meta.json"))
+        except ValueError as e:  # no filesystem for the scheme
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if missing:
+            print(f"error: no such log topic: {args.topic!r} "
+                  "(no meta.json)", file=sys.stderr)
+            return 2
+        try:
+            out = {}
+            if args.compact or args.retain:
+                from flink_tpu.config import Configuration
+                from flink_tpu.log.bus import TopicMaintenance
+
+                config = Configuration(_parse_conf(args.conf))
+                if args.compact:
+                    out["compaction"] = (
+                        TopicMaintenance.compact_from_config(
+                            config, args.topic))
+                if args.retain:
+                    out["retention"] = (
+                        TopicMaintenance.retain_from_config(
+                            config, args.topic))
+            print(json.dumps({**out,
+                              **describe_topic(args.topic)}))
         except LogError as e:
-            raise SystemExit(str(e))
+            print(f"error: {e}", file=sys.stderr)
+            return 1
         return 0
 
     if args.cmd == "run":

@@ -355,6 +355,62 @@ class LogOptions:
         "a new file within one transaction. Every segment is written "
         "sealed (columnar footer + fsync) at pre-commit, so this is "
         "also the recovery/replay granularity of a topic partition.")
+    COMPACTION_KEY_FIELD = ConfigOption(
+        "log.compaction.key-field", "",
+        "Key column for latest-wins key compaction (log/bus.py "
+        "Compactor): sealed committed segments below the safety floor "
+        "are rewritten keeping only the latest committed row per key, "
+        "original offsets preserved. Empty = the key_field recorded in "
+        "the topic's meta.json at creation (the sink's routing key).")
+    COMPACTION_MIN_SEGMENTS = ConfigOption(
+        "log.compaction.min-segments", 2,
+        "Only compact a partition when at least this many sealed "
+        "committed segments sit wholly below the safety floor — a "
+        "single segment gains nothing from a rewrite; raising it "
+        "amortizes rewrite I/O over more input (the Kafka "
+        "min.cleanable.dirty.ratio role, count-based).")
+    RETENTION_MS = ConfigOption(
+        "log.retention.ms", 0,
+        "Retention window: whole sealed segments whose newest row is "
+        "older than this (by the topic's ts column) are dropped, but "
+        "NEVER above the safety floor (lowest consumer-group committed "
+        "offset / open pre-commit marker). 0 = keep forever.")
+    RETENTION_TS_FIELD = ConfigOption(
+        "log.retention.ts-field", "",
+        "Event-time column used by log.retention.ms: a segment's age "
+        "is now minus its newest row's value in this column. Required "
+        "whenever log.retention.ms > 0 — a time-retention pass "
+        "without it fails loudly (size-only retention leaves both "
+        "unset).")
+    RETENTION_BYTES = ConfigOption(
+        "log.retention.bytes", 0,
+        "Per-partition size budget: oldest whole sealed segments are "
+        "dropped until the partition fits, subject to the same safety "
+        "floor as log.retention.ms. 0 = unbounded.")
+    LEASE_TTL_MS = ConfigOption(
+        "log.lease.ttl-ms", 30_000,
+        "Per-partition writer-lease time-to-live (log/bus.py "
+        "LeaseManager). A producer renews its leases at every stage/"
+        "commit; a lease this stale is expired and another producer "
+        "may take the partition over with a bumped fencing epoch — "
+        "the deposed holder's late writes are rejected by epoch.")
+    GROUP_NAME = ConfigOption(
+        "log.group.name", "",
+        "Consumer-group name for LogSource.from_config: members share "
+        "a topic with per-partition committed offsets published at "
+        "checkpoint complete (the compaction/retention safety floor "
+        "and the cross-generation resume point). Empty = no group "
+        "(anonymous reader, offsets live only in the job checkpoint).")
+    GROUP_MEMBER = ConfigOption(
+        "log.group.member", 0,
+        "This reader's member index within log.group.members: static "
+        "partition assignment p % members == member (disjoint, "
+        "deterministic — no broker to rebalance).")
+    GROUP_MEMBERS = ConfigOption(
+        "log.group.members", 1,
+        "Total members in the consumer group; together with "
+        "log.group.member this fixes the partition assignment. All "
+        "members of one group must agree on this count.")
 
 
 class CoreOptions:

@@ -73,6 +73,24 @@ Instrumented points (the stack's recovery-critical seams):
         (the durable-log 2PC seams: torn segment append, lost fsync,
         pre-commit marker write, and the commit-marker rename — a
         raise there IS "crash between pre-commit and commit")
+    log.compact.rewrite / log.compact.swap                 log/bus.py
+        (key compaction: segment rewrite and the manifest-generation
+        rename — a raise at .swap IS "crash between compaction rewrite
+        and manifest swap"; readers must observe the OLD generation
+        whole. The .swap seam is SHARED by retention passes: both
+        planes publish through the same manifest rename)
+    log.retention.drop                                     log/bus.py
+        (retention's post-swap delete loop: a raise between the
+        manifest swap and the segment deletes leaves droppable debris
+        the orphan sweep removes — never a half-visible partition)
+    log.lease.acquire / log.lease.renew                    log/bus.py
+        (the per-partition writer-lease seams: a raise there is a
+        producer losing the fencing race — its attempt dies and
+        recovery re-acquires or is rejected by epoch)
+    log.group.commit                                       log/bus.py
+        (consumer-group offset publication at checkpoint complete: a
+        raise there leaves the group floor behind the checkpoint —
+        safe, the next completed checkpoint max-merges past it)
     host.pool.task                                 parallel/hostpool.py
         (the shared host worker-pool task-submit seam: a raise there is
         a host-parallel operator pass dying mid-batch — the chaos gate
@@ -148,6 +166,12 @@ KNOWN_FAULT_POINTS = frozenset((
     "log.segment.fsync",
     "log.txn.marker",
     "log.txn.commit",
+    "log.compact.rewrite",
+    "log.compact.swap",
+    "log.retention.drop",
+    "log.lease.acquire",
+    "log.lease.renew",
+    "log.group.commit",
     "host.pool.task",
     "session.admit",
 ))

@@ -1,5 +1,15 @@
 """Durable log exchange — embedded replayable topics + 2PC connectors
-for exactly-once job chaining (see log/topic.py for the protocol)."""
+for exactly-once job chaining (log/topic.py has the protocol), plus
+the message-bus tier on top: key compaction, retention, fenced
+per-partition writer leases, and consumer groups (log/bus.py)."""
+from flink_tpu.log.bus import (
+    Compactor,
+    ConsumerGroups,
+    LeaseError,
+    LeaseManager,
+    Retention,
+    TopicMaintenance,
+)
 from flink_tpu.log.connectors import LogSink, LogSource
 from flink_tpu.log.topic import (
     LogError,
@@ -7,9 +17,15 @@ from flink_tpu.log.topic import (
     TopicReader,
     create_topic,
     describe_topic,
+    list_group_offsets,
+    list_leases,
+    topic_key_field,
     topic_partitions,
 )
 
 __all__ = ["LogError", "LogSink", "LogSource", "TopicAppender",
            "TopicReader", "create_topic", "describe_topic",
-           "topic_partitions"]
+           "topic_partitions", "topic_key_field", "list_leases",
+           "list_group_offsets", "Compactor", "ConsumerGroups",
+           "LeaseError", "LeaseManager", "Retention",
+           "TopicMaintenance"]

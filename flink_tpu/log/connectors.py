@@ -32,36 +32,94 @@ class LogSink(TwoPhaseCommitSink):
     per partition (hash-routed by ``key_field``, or partition 0 when
     the topic has one); the checkpoint barrier stages them as sealed
     segments + a pre-commit marker; checkpoint completion publishes
-    the commit marker (``topic.py`` has the protocol). One LogSink
-    instance per topic at a time — the single-writer discipline.
+    the commit marker (``topic.py`` has the protocol).
+
+    Multi-writer (``owned_partitions`` + ``producer_id``): M LogSinks
+    may produce into ONE topic concurrently as long as their owned
+    partition sets are disjoint — each holds fenced per-partition
+    leases (log/bus.py LeaseManager), acquired LAZILY at first
+    use/epoch announcement (NOT at construction — building a plan must
+    be side-effect-free on live lease state; see ``_ensure_open``),
+    routes its rows among its OWNED partitions only, writer-scopes its
+    transaction markers, and is re-verified by lease epoch before
+    every marker publication (a deposed holder's late writes raise,
+    never publish).
+    Per-key order across the topic holds when each key is produced by
+    exactly one producer (the callers' partitioning contract — there
+    is no broker to re-route). Without ``owned_partitions`` the sink
+    is the legacy single-writer owning every partition.
 
     Construction on a dirty topic (a dead attempt's staged
-    transactions on disk) rolls the uncommitted transactions back
-    immediately: this writer owns the topic now, and a covered epoch
-    is rebuilt from the checkpoint payload at restore anyway."""
+    transactions on disk) rolls THIS writer's uncommitted transactions
+    back immediately — plus, when leased, a deposed previous holder's
+    staged transactions on the partitions it took over."""
 
     def __init__(self, path: str, key_field: Optional[str] = None,
                  partitions: int = 1,
-                 segment_records: int = 65536) -> None:
+                 segment_records: int = 65536,
+                 owned_partitions: Optional[List[int]] = None,
+                 producer_id: Optional[str] = None,
+                 lease_ttl_ms: int = 30_000) -> None:
         if partitions > 1 and not key_field:
             raise LogError(
                 "a multi-partition LogSink needs key_field: records "
                 "hash-route by key so each partition holds a disjoint "
                 "key range (per-key order)")
+        if owned_partitions is not None and not producer_id:
+            raise LogError(
+                "owned_partitions needs producer_id: leases and "
+                "transaction markers are writer-scoped")
         self.path = path
         self.key_field = key_field
+        self._lease = None
+        if owned_partitions is not None:
+            from flink_tpu.log.bus import LeaseManager
+
+            # touches no disk: the lease dir is created in acquire(),
+            # which runs lazily (TopicAppender below creates the topic)
+            self._lease = LeaseManager(
+                path, producer_id, list(owned_partitions),
+                ttl_ms=lease_ttl_ms)
         self._appender = TopicAppender(
-            path, partitions, segment_records=segment_records)
-        self._appender.recover()
+            path, partitions, segment_records=segment_records,
+            writer_id=producer_id if owned_partitions is not None
+            else None,
+            owned_partitions=(list(owned_partitions)
+                              if owned_partitions is not None else None),
+            lease=self._lease, key_field=key_field)
+        self._opened = self._lease is None
+        if self._lease is None:
+            # legacy single-writer: recovery at construction (the
+            # documented dirty-topic sweep)
+            self._appender.recover()
+        self._route = self._appender.owned
         self._pending: Dict[int, List[Dict[str, np.ndarray]]] = {
             p: [] for p in range(partitions)}
 
+    def _ensure_open(self) -> None:
+        """Leased sinks acquire their partitions LAZILY, at first
+        use/first epoch announcement — construction is side-effect-free
+        on live lease state, so merely BUILDING a plan (the analyzer
+        constructs sinks via the user's pipeline code) can neither
+        depose a live producer whose lease momentarily lapsed nor
+        crash on a held lease, and the LOG_TOPIC_MULTI_WRITER overlap
+        diagnostic stays reachable. Acquisition then runs inside the
+        attempt's retry scope: losing the fencing race restarts like
+        any deploy failure."""
+        if not self._opened:
+            self._lease.acquire()
+            self._appender.recover()
+            self._opened = True
+
     @classmethod
     def from_config(cls, config, name: str,
-                    key_field: Optional[str] = None) -> "LogSink":
+                    key_field: Optional[str] = None,
+                    owned_partitions: Optional[List[int]] = None,
+                    producer_id: Optional[str] = None) -> "LogSink":
         """Topic resolved through the ``log.*`` config grammar:
-        ``log.dir``/<name>, ``log.partitions``, ``log.segment-records``
-        (the CLI-entry-point construction path)."""
+        ``log.dir``/<name>, ``log.partitions``,
+        ``log.segment-records``, ``log.lease.ttl-ms`` (the
+        CLI-entry-point construction path)."""
         import os
 
         from flink_tpu.config import LogOptions
@@ -70,10 +128,17 @@ class LogSink(TwoPhaseCommitSink):
                    key_field=key_field,
                    partitions=int(config.get(LogOptions.PARTITIONS)),
                    segment_records=int(
-                       config.get(LogOptions.SEGMENT_RECORDS)))
+                       config.get(LogOptions.SEGMENT_RECORDS)),
+                   owned_partitions=owned_partitions,
+                   producer_id=producer_id,
+                   lease_ttl_ms=int(
+                       config.get(LogOptions.LEASE_TTL_MS)))
 
     def set_attempt_epoch(self, epoch: int) -> None:
         self._appender.epoch = int(epoch)
+        if not self._opened:
+            self._ensure_open()
+            return
         # aborts are epoch-fenced (topic.py abort), so the recovery
         # sweep at construction time — which ran at the default epoch —
         # may have skipped a dead lower-epoch attempt's staged
@@ -83,12 +148,13 @@ class LogSink(TwoPhaseCommitSink):
 
     # -- write path --------------------------------------------------------
     def write(self, batch: Dict[str, np.ndarray]) -> None:
+        self._ensure_open()
         cols = {k: np.asarray(v) for k, v in batch.items()}
         if not cols or not len(next(iter(cols.values()))):
             return
-        n_part = self._appender.partitions
-        if n_part == 1:
-            self._pending[0].append(cols)
+        route = self._route  # owned partitions (all of them, legacy)
+        if len(route) == 1:
+            self._pending[route[0]].append(cols)
             return
         from flink_tpu.records import hash_keys_numpy
 
@@ -97,17 +163,28 @@ class LogSink(TwoPhaseCommitSink):
                 f"LogSink key_field {self.key_field!r} missing from "
                 f"batch columns {sorted(cols)}")
         keys = np.asarray(cols[self.key_field], np.int64)
-        dest = hash_keys_numpy(keys) % n_part
+        # hash-route WITHIN the owned set: a leased producer only ever
+        # stages into partitions it holds (legacy: owned == all, so
+        # this is the original hash % partitions)
+        dest = np.asarray(route, np.int64)[
+            hash_keys_numpy(keys) % len(route)]
         for p in np.unique(dest):
             m = dest == p
             self._pending[int(p)].append(
                 {k: v[m] for k, v in cols.items()})
 
     # -- TwoPhaseCommitSink contract ---------------------------------------
+    # _ensure_open guards only the DURABLY MUTATING ops: clearing the
+    # in-memory buffer (drop_pending) or listing staged ids must not
+    # force a lease acquisition on a never-used sink inside a teardown
+    # path — it could mask the root failure with a LeaseError, or
+    # perform a takeover as a side effect of cleanup. If teardown DOES
+    # find staged transactions to roll back, the abort itself opens.
     def drop_pending(self) -> None:
         self._pending = {p: [] for p in range(self._appender.partitions)}
 
     def stage_transaction(self, cid: int) -> bool:
+        self._ensure_open()
         pending, self._pending = self._pending, {
             p: [] for p in range(self._appender.partitions)}
         return self._appender.stage(cid, pending)
@@ -116,32 +193,58 @@ class LogSink(TwoPhaseCommitSink):
         return self._appender.staged_ids()
 
     def commit_transaction(self, cid: int) -> None:
+        self._ensure_open()
         self._appender.commit(cid)
 
     def abort_transaction(self, cid: int) -> None:
+        self._ensure_open()
         self._appender.abort(cid)
 
     def snapshot_transaction(self, cid: int) -> Any:
         return self._appender.snapshot(cid)
 
     def rebuild_transaction(self, cid: int, payload: Any) -> None:
+        self._ensure_open()
         self._appender.rebuild(cid, payload)
 
     def cleanup_unreferenced(self) -> None:
         self._appender.sweep_orphans()
 
+    def close(self) -> None:
+        if self._lease is not None and self._opened:
+            # clean shutdown releases the partitions so a successor
+            # producer can acquire immediately instead of waiting out
+            # the ttl (a crash skips this — expiry + epoch bump is the
+            # takeover path)
+            self._lease.release()
+
 
 class LogSource(Source):
     """FLIP-27-style replayable reads of a topic's COMMITTED prefix:
-    one split per partition; the replay position is the RECORD OFFSET
-    (``position_after`` advances by rows consumed), so a restore
-    resumes mid-partition — whole already-consumed segments are
-    skipped without opening, and the boundary block is sliced, not
-    re-delivered. Committed-offset isolation: the segment list is
-    captured from commit markers once per source instance (at first
-    split open — every split sees the same committed snapshot), so
-    staged (pre-committed, uncommitted) producer data is never
-    observable.
+    one split per (assigned) partition; the replay position is the
+    RECORD OFFSET, so a restore resumes mid-partition — whole
+    already-consumed segments are skipped without opening, and the
+    boundary block is sliced, not re-delivered. Committed-offset
+    isolation: the segment list is captured from commit markers (and
+    the compaction manifest) once per source instance, so staged
+    (pre-committed, uncommitted) producer data is never observable.
+
+    Compacted topics read transparently: below the compaction floor
+    only the latest committed row per key survives, each at its
+    ORIGINAL offset — ``position_after`` follows the sparse offsets
+    (last row's offset + 1), so replay positions jump the gaps a naive
+    ``pos + len`` would re-deliver from.
+
+    Consumer groups (``group`` + ``member_index``/``members``): the
+    member reads its statically assigned partitions
+    (``p % members == member_index``), and the driver publishes its
+    checkpointed positions to the group's committed-offset files at
+    checkpoint complete (``commit_offsets`` — the compaction/retention
+    safety floor). A NEW job joining the group bootstraps each
+    assigned partition at ``max(restore position, group committed
+    offset)`` — compacted history first, then the live tail (the
+    backfill-then-live shape), exactly once per group across consumer
+    generations.
 
     ``ts_field`` names the event-time column (ms); absent, batches get
     ingest-time stamps like FileSource. Bounded: a split ends at the
@@ -149,10 +252,48 @@ class LogSource(Source):
     consumer; tailing a live topic is a broker's job, not this
     embedded log's)."""
 
-    def __init__(self, path: str, ts_field: Optional[str] = None) -> None:
+    def __init__(self, path: str, ts_field: Optional[str] = None,
+                 group: Optional[str] = None, member_index: int = 0,
+                 members: int = 1) -> None:
         self.path = path
         self.ts_field = ts_field
+        self.group = group or None
+        if self.group is not None:
+            from flink_tpu.log.topic import _WRITER_RE
+
+            # early-loud (the writer_id discipline): an invalid name
+            # would otherwise only fail at the FIRST checkpoint-
+            # complete commit round, deep into the job
+            if not _WRITER_RE.match(self.group):
+                raise LogError(
+                    f"consumer-group name {self.group!r} must match "
+                    "[A-Za-z0-9_.-]+ (it becomes a directory name)")
+        self.member_index = int(member_index)
+        self.members = int(members)
         self._reader: Optional[TopicReader] = None
+        # per-batch replay positions for sparse (compacted) reads,
+        # keyed by batch-dict identity: open_split records each
+        # yielded batch's next position, position_after pops it — the
+        # driver advances positions immediately after consuming each
+        # batch, so at most one entry per in-flight split batch lives
+        # here
+        self._next_pos: Dict[int, int] = {}
+
+    @classmethod
+    def from_config(cls, config, name: str,
+                    ts_field: Optional[str] = None) -> "LogSource":
+        """Topic + group resolved through the ``log.*`` grammar:
+        ``log.dir``/<name>, ``log.group.name`` / ``log.group.member``
+        / ``log.group.members``."""
+        import os
+
+        from flink_tpu.config import LogOptions
+
+        group = str(config.get(LogOptions.GROUP_NAME)).strip()
+        return cls(os.path.join(str(config.get(LogOptions.DIR)), name),
+                   ts_field=ts_field, group=group or None,
+                   member_index=int(config.get(LogOptions.GROUP_MEMBER)),
+                   members=int(config.get(LogOptions.GROUP_MEMBERS)))
 
     def _get_reader(self) -> TopicReader:
         # one reader per source instance, shared by all splits: the
@@ -165,14 +306,43 @@ class LogSource(Source):
             self._reader = TopicReader(self.path)
         return self._reader
 
+    def assigned_partitions(self) -> List[int]:
+        n = topic_partitions(self.path)
+        if self.group is None and self.members == 1:
+            return list(range(n))
+        from flink_tpu.log.bus import ConsumerGroups
+
+        return ConsumerGroups.assignment(
+            n, self.member_index, self.members)
+
     def splits(self) -> List[str]:
-        return [str(p) for p in range(topic_partitions(self.path))]
+        return [str(p) for p in self.assigned_partitions()]
+
+    def _bootstrap_offset(self, p: int) -> int:
+        """The group's committed offset for ``p`` (0 without a group):
+        where a FRESH consumer generation starts reading."""
+        if self.group is None:
+            return 0
+        from flink_tpu.log.bus import ConsumerGroups
+
+        return int(ConsumerGroups.committed(
+            self.path, self.group).get(p, 0))
 
     def open_split(self, split: str,
                    start_pos: int = 0) -> Iterator[Any]:
         reader = self._get_reader()
-        for _offset, data in reader.read(int(split),
-                                         start_offset=start_pos):
+        p = int(split)
+        # group bootstrap applies ONLY to a fresh split (position 0 —
+        # nothing consumed yet, so the group's committed offset is the
+        # generation resume point). An EXPLICIT position > 0 is
+        # authoritative even when it lies below the group offset: a
+        # deliberate savepoint rewind must re-deliver those rows, not
+        # silently fast-forward past them (the rows below it replay
+        # under the job's own checkpoint lineage; group offsets never
+        # regress, so the maintenance floor is unaffected).
+        start = (self._bootstrap_offset(p) if int(start_pos) == 0
+                 else int(start_pos))
+        for _offset, nxt, data in reader.read3(p, start_offset=start):
             if self.ts_field is not None:
                 if self.ts_field not in data:
                     raise LogError(
@@ -183,12 +353,42 @@ class LogSource(Source):
                 now = np.int64(time.time() * 1000)
                 ts = np.full(len(next(iter(data.values()), ())),
                              now, np.int64)
+            self._next_pos[id(data)] = (len(ts), int(nxt))
             yield data, ts
 
     def position_after(self, pos: int, data, ts) -> int:
         # offsets, not batch indices: replay-exact regardless of how
-        # the committed prefix re-blocks at the restore boundary
+        # the committed prefix re-blocks at the restore boundary —
+        # sparse (compacted) blocks advance to last-row-offset + 1 via
+        # the side table recorded at yield time. Contract: the driver
+        # advances positions once per consumed batch with the IDENTICAL
+        # dict object (_advance_position); the recorded row count must
+        # match, so a stale entry from a recycled id can never smuggle
+        # in a wrong position — mismatches take the dense fallback
+        # (exact everywhere except inside a compacted gap, which only
+        # a re-blocking wrapper between source and driver could hit).
+        rec = self._next_pos.pop(id(data), None)
+        if rec is not None and rec[0] == len(ts):
+            return rec[1]
         return pos + len(ts)
+
+    def commit_offsets(self, checkpoint_id: int,
+                       positions: Dict[int, int]) -> None:
+        """Publish this member's checkpointed positions as the group's
+        committed offsets (the driver's checkpoint-complete commit
+        round calls this with the positions frozen at the barrier).
+        No-op without a group; never regresses (max-merge)."""
+        if self.group is None:
+            return
+        from flink_tpu.log.bus import ConsumerGroups
+
+        parts = self.assigned_partitions()
+        offsets = {}
+        for split_ix, pos in positions.items():
+            if 0 <= int(split_ix) < len(parts) and int(pos) > 0:
+                offsets[parts[int(split_ix)]] = int(pos)
+        if offsets:
+            ConsumerGroups.commit(self.path, self.group, offsets)
 
     @property
     def bounded(self) -> bool:

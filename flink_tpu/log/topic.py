@@ -17,10 +17,32 @@ silently short reads.
 
 Layout::
 
-    <topic>/meta.json                         {"v":1, "partitions": N}
+    <topic>/meta.json                  {"v":1, "partitions": N,
+                                        "key_field": k?}
     <topic>/p<k>/seg-<base:012d>-c<cid:010d>-e<epoch>.colb
-    <topic>/txn/pre-<cid:010d>.json           pre-commit marker
-    <topic>/txn/commit-<cid:010d>.json        commit marker
+    <topic>/p<k>/cmp-<gen:06d>-<base:012d>.colb   compacted segment
+    <topic>/txn/pre-<cid:010d>[-w.<writer>].json  pre-commit marker
+    <topic>/txn/commit-<cid:010d>[-w.<writer>].json
+    <topic>/manifest.json              compaction/retention generation
+    <topic>/leases/p<k>.json           per-partition writer lease
+    <topic>/groups/<name>/p<k>.json    consumer-group committed offset
+
+The ``-w.<writer>`` marker suffix appears only for lease-fenced
+multi-writer producers (log/bus.py): each producer's checkpoint-id
+sequence is private, so markers are writer-scoped to keep two
+producers' cid 7 from colliding. Suffixless markers are the legacy
+single-writer form and stay readable forever.
+
+A **compacted segment** (``cmp-…``) holds the latest committed row per
+key for an offset range, sparse: its schema is the topic schema plus a
+leading ``__offset`` i64 column carrying each surviving row's ORIGINAL
+offset, so offset-addressed reads and replay positions survive
+compaction (gaps where superseded rows were dropped). ``manifest.json``
+(atomic-renamed, generation-numbered) is the single swap point: per
+partition it records the retention floor (``start``), the compacted
+range end (``compacted_end``) and the compacted segment list — readers
+observe the old or the new generation whole, never a half-compacted
+topic (log/bus.py owns the rewrite/swap/retention machinery).
 
 Two-phase commit (the TwoPhaseCommitSink discipline, driven by
 checkpoint barriers through ``log/connectors.py LogSink``):
@@ -42,9 +64,14 @@ checkpoint barriers through ``log/connectors.py LogSink``):
    positions.
 
 Honest scope: single filesystem (any registered scheme), no broker
-process, no compaction/retention, ONE writer per topic at a time (the
-2PC sink of one producer job; concurrent producers need a broker's
-coordination, which this deliberately is not).
+process. Concurrent producers are supported per PARTITION via fenced
+writer leases (log/bus.py LeaseManager): M producers may own disjoint
+partition sets of one topic; two writers on one partition remain
+illegal and are fenced by lease epoch. Compaction/retention run as
+explicit maintenance invocations (no background cleaner thread);
+a reader holding a pre-swap snapshot whose files a later swap deleted
+fails LOUDLY on open and retries with a fresh snapshot — it can never
+read a half-compacted view.
 
 Fault points (flink_tpu/faults.py): ``log.segment.append`` /
 ``log.segment.fsync`` / ``log.segment.seal`` on the segment write
@@ -72,13 +99,25 @@ from flink_tpu.fs import get_filesystem
 from flink_tpu.obs.metrics import MetricRegistry
 
 __all__ = ["LogError", "TopicAppender", "TopicReader", "create_topic",
-           "topic_partitions", "describe_topic", "registry"]
+           "topic_partitions", "topic_key_field", "describe_topic",
+           "load_manifest", "list_leases", "list_group_offsets",
+           "registry", "OFFSET_COL"]
 
 TXN_DIR = "txn"
+LEASE_DIR = "leases"
+GROUP_DIR = "groups"
+MANIFEST = "manifest.json"
+MAINT_LOCK = "maintenance.lock"
+# a maintenance pass older than this is presumed crashed and its lock
+# is broken (compaction of an embedded topic is seconds, not minutes)
+MAINT_LOCK_STALE_MS = 15 * 60 * 1000
+OFFSET_COL = "__offset"  # sparse-offset column of compacted segments
 # {:010d}/{:012d} formatting PADS to the width; ids can exceed it (the
 # bounded-run final epoch is a ms timestamp), so the patterns accept
 # longer runs of digits too
 _SEG_RE = re.compile(r"^seg-(\d{12,})-c(\d{10,})-e(\d+)\.colb$")
+_CMP_RE = re.compile(r"^cmp-(\d{6,})-(\d{12,})\.colb$")
+_WRITER_RE = re.compile(r"^[A-Za-z0-9_.\-]+$")
 
 # process-global log metrics (the faults.py registry pattern): appended
 # records / sealed segments / committed + aborted transactions per
@@ -111,12 +150,121 @@ def _seg_name(base: int, cid: int, epoch: int) -> str:
     return f"seg-{base:012d}-c{cid:010d}-e{epoch}.colb"
 
 
+def compacted_seg_name(gen: int, base: int) -> str:
+    return f"cmp-{gen:06d}-{base:012d}.colb"
+
+
+def _marker_file(kind: str, cid: int, writer: str = "") -> str:
+    """Writer-scoped for multi-writer producers (``-w.<writer>``),
+    suffixless for the legacy single-writer form."""
+    suffix = f"-w.{writer}" if writer else ""
+    return f"{kind}-{cid:010d}{suffix}.json"
+
+
 def _partition_dir(path: str, p: int) -> str:
     return os.path.join(path, f"p{p}")
 
 
 def _txn_dir(path: str) -> str:
     return os.path.join(path, TXN_DIR)
+
+
+def _local_path(path: str) -> Optional[str]:
+    """The plain-OS path of a local/file:// location, or None for a
+    non-local scheme (where O_EXCL lock files are unavailable)."""
+    if path.startswith("file://"):
+        return path[len("file://"):]
+    return None if "://" in path else path
+
+
+def _break_stale_lock(lock: str) -> None:
+    """Break a crashed holder's stale lock WITHOUT the unlink race:
+    rename it to a unique name first — the rename is atomic, so of two
+    racing breakers exactly ONE wins and the loser's rename fails
+    (it can never unlink a FRESH lock the winner creates a moment
+    later)."""
+    import uuid
+
+    grave = f"{lock}.stale-{uuid.uuid4().hex[:8]}"
+    try:
+        os.rename(lock, grave)
+    except OSError:
+        return  # another breaker won the rename — its problem now
+    try:
+        os.unlink(grave)
+    except OSError:
+        pass
+
+
+def _unlink_if_ours(lock: str, fd: int) -> None:
+    """Release discipline: only unlink the lock if the path still IS
+    our open file (inode compare) — if our stale lock was broken and
+    replaced, a blind unlink would delete the new holder's lock."""
+    try:
+        ours = os.fstat(fd).st_ino == os.stat(lock).st_ino
+    except OSError:
+        ours = False
+    os.close(fd)
+    if ours:
+        try:
+            os.unlink(lock)
+        except OSError:
+            pass
+
+
+def try_maintenance_lock(path: str) -> Optional[int]:
+    """Non-blockingly take the topic's MAINTENANCE lock (O_EXCL on
+    local filesystems): compaction/retention passes hold it across
+    rewrite → manifest swap → delete, and the orphan sweep's
+    compacted-file cleanup requires it — otherwise a producer-attempt
+    recovery racing a live pass's pre-swap window would delete cmp
+    files the imminent manifest is about to reference (permanent data
+    loss). Returns an fd to pass to ``release_maintenance_lock``, or
+    None when another pass holds it. A lock older than
+    MAINT_LOCK_STALE_MS is a crashed pass's leftover and is broken.
+    Non-local schemes return a sentinel fd (no O_EXCL there — the
+    single-maintenance-invoker discipline is operational, honest
+    scope)."""
+    import time as _time
+
+    lock = _local_path(os.path.join(path, MAINT_LOCK))
+    if lock is None:
+        return -1  # non-local: best-effort (documented degradation)
+    for _ in range(2):
+        try:
+            return os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            try:
+                age_ms = (_time.time() - os.path.getmtime(lock)) * 1000
+            except OSError:
+                continue  # vanished under us — retry
+            if age_ms > MAINT_LOCK_STALE_MS:
+                _break_stale_lock(lock)
+                continue
+            return None
+    return None
+
+
+def release_maintenance_lock(path: str, fd: int) -> None:
+    if fd is None or fd < 0:
+        return
+    lock = _local_path(os.path.join(path, MAINT_LOCK))
+    if lock is None:
+        return
+    _unlink_if_ours(lock, fd)
+
+
+def _read_json(fs, path: str, what: str) -> Dict[str, Any]:
+    """Read+parse one JSON control file (meta/manifest/marker/lease/
+    group-offset), loud on corruption — the single implementation all
+    six control-file readers share."""
+    with fs.open_read(path) as f:
+        raw = f.read()
+    try:
+        return json.loads(raw if isinstance(raw, str)
+                          else raw.decode("utf-8"))
+    except ValueError as e:
+        raise LogError(f"corrupt {what} at {path!r}: {e}") from e
 
 
 def _write_atomic(fs, path: str, payload: bytes, fsync: bool = True) -> None:
@@ -132,99 +280,193 @@ def _write_atomic(fs, path: str, payload: bytes, fsync: bool = True) -> None:
     fs.rename(tmp, path)
 
 
-def create_topic(path: str, partitions: int) -> None:
+def create_topic(path: str, partitions: int,
+                 key_field: Optional[str] = None) -> None:
     """Create (or validate) a topic directory. Idempotent for matching
     partition counts; a mismatch is a loud error — offsets are
     per-partition, so silently changing the count would re-route
-    keys."""
+    keys. ``key_field`` (the sink's routing key) is recorded in
+    meta.json as the default compaction key (log/bus.py Compactor)."""
     if partitions < 1:
         raise LogError(f"topic needs >= 1 partition, got {partitions}")
     fs = get_filesystem(path)
     meta_path = os.path.join(path, "meta.json")
     if fs.exists(meta_path):
-        existing = topic_partitions(path)
+        meta = _topic_meta(path)
+        existing = int(meta.get("partitions", 0))
         if existing != partitions:
             raise LogError(
                 f"topic {path!r} exists with {existing} partitions; "
                 f"refusing to reopen with {partitions}")
+        recorded = meta.get("key_field")
+        if key_field and recorded and str(recorded) != str(key_field):
+            # same loud-mismatch contract as the partition count: the
+            # recorded key is the DEFAULT COMPACTION key — silently
+            # keeping the old one would let a later compaction pass
+            # dedup on the wrong column and drop live rows
+            raise LogError(
+                f"topic {path!r} exists with key_field {recorded!r}; "
+                f"refusing to reopen with key_field {key_field!r} — "
+                "compaction dedups on the recorded key")
+        if key_field and not recorded:
+            # upgrade path: an older topic that never recorded a key
+            # adopts the first one declared (no conflict is possible —
+            # compaction refuses to run without a key)
+            meta["key_field"] = str(key_field)
+            _write_atomic(fs, meta_path,
+                          json.dumps(meta).encode("utf-8"))
         return
     fs.mkdirs(_txn_dir(path))
     for p in range(partitions):
         fs.mkdirs(_partition_dir(path, p))
-    _write_atomic(fs, meta_path, json.dumps(
-        {"v": 1, "partitions": int(partitions)}).encode("utf-8"))
+    meta: Dict[str, Any] = {"v": 1, "partitions": int(partitions)}
+    if key_field:
+        meta["key_field"] = str(key_field)
+    _write_atomic(fs, meta_path, json.dumps(meta).encode("utf-8"))
 
 
-def topic_partitions(path: str) -> int:
+def _topic_meta(path: str) -> Dict[str, Any]:
     fs = get_filesystem(path)
     meta_path = os.path.join(path, "meta.json")
     if not fs.exists(meta_path):
         raise LogError(f"no such log topic: {path!r} (no meta.json)")
-    with fs.open_read(meta_path) as f:
-        raw = f.read()
+    return _read_json(fs, meta_path, "topic meta")
+
+
+def topic_key_field(path: str) -> Optional[str]:
+    """The compaction key recorded at topic creation, or None."""
+    kf = _topic_meta(path).get("key_field")
+    return str(kf) if kf else None
+
+
+def load_manifest(fs, path: str) -> Optional[Dict[str, Any]]:
+    """The compaction/retention generation file, normalized:
+    ``{"gen": int, "partitions": {int p: {"start", "compacted_end",
+    "segments": [{"name","base","end","rows"}]}}}`` — or None before
+    the first compaction/retention pass."""
+    mpath = os.path.join(path, MANIFEST)
+    if not fs.exists(mpath):
+        return None
+    m = _read_json(fs, mpath, "compaction manifest")
     try:
-        meta = json.loads(raw if isinstance(raw, str)
-                          else raw.decode("utf-8"))
-        return int(meta["partitions"])
+        return {
+            "gen": int(m["gen"]),
+            "partitions": {
+                int(p): {
+                    "start": int(e.get("start", 0)),
+                    "compacted_end": int(e.get("compacted_end", 0)),
+                    "segments": [
+                        {"name": str(s["name"]), "base": int(s["base"]),
+                         "end": int(s["end"]), "rows": int(s["rows"])}
+                        for s in e.get("segments", [])],
+                }
+                for p, e in m.get("partitions", {}).items()},
+        }
+    except (ValueError, KeyError, TypeError) as e:
+        raise LogError(
+            f"corrupt compaction manifest at {path!r}: {e}") from e
+
+
+def topic_partitions(path: str) -> int:
+    try:
+        return int(_topic_meta(path)["partitions"])
     except (ValueError, KeyError) as e:
         raise LogError(f"corrupt topic meta at {path!r}: {e}") from e
 
 
+def _marker_pat(kind: str):
+    # group 1 = cid, group 2 = writer ("" for the legacy suffixless form)
+    return re.compile(
+        rf"^{kind}-(\d{{10,}})(?:-w\.([A-Za-z0-9_.\-]+))?\.json$")
+
+
 def _marker_ids(fs, path: str, kind: str) -> set:
-    """``kind`` in ('pre', 'commit') → {cid}, from filenames ALONE — no
-    marker is opened. The per-checkpoint hot path (staged_ids) runs on
-    this, so its cost stays O(directory entries) even as commit markers
-    accumulate over a topic's lifetime."""
+    """``kind`` in ('pre', 'commit') → {(cid, writer)}, from filenames
+    ALONE — no marker is opened. The per-checkpoint hot path
+    (staged_ids) runs on this, so its cost stays O(directory entries)
+    even as commit markers accumulate over a topic's lifetime. writer
+    is '' for legacy suffixless markers."""
     tdir = _txn_dir(path)
     if not fs.exists(tdir):
         return set()
-    pat = re.compile(rf"^{kind}-(\d{{10,}})\.json$")
-    return {int(m.group(1))
+    pat = _marker_pat(kind)
+    return {(int(m.group(1)), m.group(2) or "")
             for m in map(pat.match, fs.listdir(tdir)) if m}
 
 
-def _list_markers(fs, path: str, kind: str) -> Dict[int, Dict[str, Any]]:
-    """``kind`` in ('pre', 'commit') → {cid: marker dict}."""
+def _list_markers(fs, path: str,
+                  kind: str) -> Dict[Tuple[int, str], Dict[str, Any]]:
+    """``kind`` in ('pre', 'commit') → {(cid, writer): marker dict};
+    writer is '' for legacy suffixless markers."""
     tdir = _txn_dir(path)
-    out: Dict[int, Dict[str, Any]] = {}
+    out: Dict[Tuple[int, str], Dict[str, Any]] = {}
     if not fs.exists(tdir):
         return out
-    pat = re.compile(rf"^{kind}-(\d{{10,}})\.json$")
+    pat = _marker_pat(kind)
     for name in fs.listdir(tdir):
         m = pat.match(name)
         if m is None:
             continue
-        with fs.open_read(os.path.join(tdir, name)) as f:
-            raw = f.read()
-        try:
-            out[int(m.group(1))] = json.loads(
-                raw if isinstance(raw, str) else raw.decode("utf-8"))
-        except ValueError as e:
-            raise LogError(
-                f"corrupt {kind}-commit marker {name!r} in topic "
-                f"{path!r}: {e}") from e
+        out[(int(m.group(1)), m.group(2) or "")] = _read_json(
+            fs, os.path.join(tdir, name), f"{kind} marker")
     return out
 
 
 class TopicAppender:
-    """The single-writer append/2PC side of one topic (LogSink's
-    engine). Offset bookkeeping: ``_next[p]`` = committed end offset
-    plus every staged (pre-committed, uncommitted) transaction's rows —
-    staged transactions STACK, because checkpoint N+1's barrier can
-    stage a new epoch while N's commit notification is still in
-    flight."""
+    """The append/2PC side of one topic (LogSink's engine) — one writer
+    per PARTITION. Legacy single-writer form: no ``writer_id``, all
+    partitions owned, suffixless markers. Lease-fenced multi-writer
+    form (log/bus.py): ``writer_id`` scopes this producer's markers,
+    ``owned_partitions`` restricts appends, and ``lease`` (a
+    LeaseManager bound to this writer) is re-verified+renewed before
+    every marker publication — a deposed leaseholder's late stage or
+    commit raises instead of clobbering the successor's partition.
+
+    Offset bookkeeping: ``_next[p]`` = committed end offset plus every
+    staged (pre-committed, uncommitted) transaction's rows — staged
+    transactions STACK, because checkpoint N+1's barrier can stage a
+    new epoch while N's commit notification is still in flight."""
 
     def __init__(self, path: str, partitions: int,
-                 segment_records: int = 65536, epoch: int = 0) -> None:
+                 segment_records: int = 65536, epoch: int = 0,
+                 writer_id: Optional[str] = None,
+                 owned_partitions: Optional[List[int]] = None,
+                 lease: Any = None,
+                 key_field: Optional[str] = None) -> None:
         if segment_records < 1:
             raise LogError(
                 f"log segment-records must be >= 1, got {segment_records}")
-        create_topic(path, partitions)
+        if writer_id is not None and not _WRITER_RE.match(writer_id):
+            raise LogError(
+                f"writer id {writer_id!r} must match [A-Za-z0-9_.-]+ "
+                "(it becomes part of marker filenames)")
+        if owned_partitions is not None and writer_id is None:
+            raise LogError(
+                "owned_partitions needs a writer_id: concurrent "
+                "producers run private checkpoint-id sequences, so "
+                "their transaction markers must be writer-scoped")
+        create_topic(path, partitions, key_field=key_field)
         self.path = path
         self.topic = os.path.basename(os.path.normpath(path)) or "topic"
         self.partitions = partitions
         self.segment_records = segment_records
         self.epoch = int(epoch)
+        self.writer_id = writer_id or ""
+        self.owned = (sorted(int(p) for p in owned_partitions)
+                      if owned_partitions is not None
+                      else list(range(partitions)))
+        if owned_partitions is not None and not self.owned:
+            raise LogError(
+                "owned_partitions must be non-empty: a writer owning "
+                "no partitions can never route a row (the first write "
+                "would die in a mod-by-zero far from this "
+                "misconfiguration)")
+        bad = [p for p in self.owned if p < 0 or p >= partitions]
+        if bad:
+            raise LogError(
+                f"owned partitions {bad} outside topic range "
+                f"[0, {partitions})")
+        self.lease = lease
         self._fs = get_filesystem(path)
         # cids THIS writer staged rows for: commit() uses it to tell a
         # genuinely-empty epoch (no marker was ever written — no-op by
@@ -242,6 +484,20 @@ class TopicAppender:
                     (str(n), str(t)) for n, t in last["schema"])
         self._refresh_offsets()
 
+    # -- marker paths (writer-scoped for multi-writer producers) ----------
+    def _marker_path(self, kind: str, cid: int) -> str:
+        return os.path.join(_txn_dir(self.path),
+                            _marker_file(kind, cid, self.writer_id))
+
+    def _verify_lease(self) -> None:
+        """Fencing gate before every marker publication: renew our
+        per-partition leases and raise if any was taken over (a higher
+        epoch on file means we are the DEPOSED holder — our late write
+        must be rejected, the PR-3 attempt-epoch discipline applied to
+        partition ownership)."""
+        if self.lease is not None:
+            self.lease.verify(renew=True)
+
     # -- offsets ----------------------------------------------------------
     def _refresh_offsets(self) -> None:
         commits = _list_markers(self._fs, self.path, "commit")
@@ -251,9 +507,11 @@ class TopicAppender:
             for p_s, end in marker.get("offsets", {}).items():
                 p = int(p_s)
                 nxt[p] = max(nxt[p], int(end))
-        # staged-but-uncommitted transactions extend the chain
-        for cid in sorted(set(pres) - set(commits)):
-            for p_s, segs in pres[cid].get("segments", {}).items():
+        # staged-but-uncommitted transactions (ANY writer's — disjoint
+        # partitions make foreign entries no-ops on ours) extend the
+        # chain
+        for key in sorted(set(pres) - set(commits)):
+            for p_s, segs in pres[key].get("segments", {}).items():
                 p = int(p_s)
                 for s in segs:
                     nxt[p] = max(nxt[p], int(s["base"]) + int(s["rows"]))
@@ -315,6 +573,20 @@ class TopicAppender:
         partition had rows (no empty transactions)."""
         from flink_tpu import faults
 
+        if self._fs.exists(self._marker_path("commit", cid)):
+            # a reused checkpoint id: this writer already COMMITTED cid
+            # in an earlier run (a fresh checkpoint dir restarts ids at
+            # 1). Staging under it would be SILENT data loss — commit()
+            # would see the old marker and "succeed" without ever
+            # publishing these rows.
+            raise LogError(
+                f"writer {self.writer_id or '<single>'!r} already "
+                f"committed transaction {cid} to topic {self.path!r} "
+                "in an earlier run — refusing to stage new rows under "
+                "a reused checkpoint id (they could never become "
+                "visible). Append bounded tails WITHOUT checkpointing "
+                "(the terminal epoch is a unique ms timestamp), or "
+                "resume the original checkpoint dir so ids continue")
         per_part: Dict[str, List[Dict[str, Any]]] = {}
         staged_next = dict(self._next)
         for p in sorted(pending):
@@ -322,6 +594,12 @@ class TopicAppender:
                        if len(next(iter(b.values()), ()))]
             if not batches:
                 continue
+            if p not in self.owned:
+                raise LogError(
+                    f"writer {self.writer_id or '<single>'!r} staging "
+                    f"rows into partition {p} of topic {self.path!r} "
+                    f"outside its owned set {self.owned} — partition "
+                    "leases are the multi-writer contract")
             for b in batches:
                 self._check_schema(b)
             base = staged_next[p]
@@ -355,32 +633,43 @@ class TopicAppender:
             "offsets": {p: int(staged_next[int(p)]) for p in per_part},
             "schema": [[n, t] for n, t in self._schema],
         }
-        # pre-commit marker: after this rename the transaction is
-        # recoverable (re-commit or roll back), before it the segments
-        # are unreferenced debris the cleanup sweep removes
+        if self.writer_id:
+            marker["writer"] = self.writer_id
+        if self.lease is not None:
+            marker["lease_epochs"] = {
+                str(p): int(self.lease.epochs[int(p)]) for p in per_part}
+        # fencing gate, then the pre-commit marker: after this rename
+        # the transaction is recoverable (re-commit or roll back),
+        # before it the segments are unreferenced debris the cleanup
+        # sweep removes. A deposed leaseholder raises HERE, before its
+        # stale rows become recoverable state.
+        self._verify_lease()
         faults.fire("log.txn.marker", exc=OSError,
                     topic=self.topic, cid=cid)
-        _write_atomic(self._fs, os.path.join(
-            _txn_dir(self.path), f"pre-{cid:010d}.json"),
-            json.dumps(marker).encode("utf-8"))
+        _write_atomic(self._fs, self._marker_path("pre", cid),
+                      json.dumps(marker).encode("utf-8"))
         self._next = staged_next
         self._staged_live.add(int(cid))
         return True
 
     def staged_ids(self) -> List[int]:
-        return sorted(_marker_ids(self._fs, self.path, "pre")
-                      - _marker_ids(self._fs, self.path, "commit"))
+        """THIS writer's staged-but-uncommitted cids (another
+        producer's staged transactions are its own to commit or roll
+        back — fenced by its lease, not ours)."""
+        staged = (_marker_ids(self._fs, self.path, "pre")
+                  - _marker_ids(self._fs, self.path, "commit"))
+        return sorted(cid for cid, w in staged if w == self.writer_id)
 
     def commit(self, cid: int) -> None:
         """THE visibility point: rename the commit marker into place.
         Idempotent; a no-op for ids that staged nothing."""
         from flink_tpu import faults
 
-        cpath = os.path.join(_txn_dir(self.path), f"commit-{cid:010d}.json")
+        cpath = self._marker_path("commit", cid)
         if self._fs.exists(cpath):
             self._staged_live.discard(int(cid))
             return
-        ppath = os.path.join(_txn_dir(self.path), f"pre-{cid:010d}.json")
+        ppath = self._marker_path("pre", cid)
         if not self._fs.exists(ppath):
             if int(cid) in self._staged_live:
                 # stage() durably published this marker and returned
@@ -411,6 +700,12 @@ class TopicAppender:
                   "segments": pre["segments"],
                   "offsets": pre["offsets"],
                   "schema": pre.get("schema")}
+        for extra in ("writer", "lease_epochs"):
+            if extra in pre:
+                commit[extra] = pre[extra]
+        # fencing gate: the commit rename is THE visibility point — a
+        # deposed leaseholder must raise here, never publish
+        self._verify_lease()
         faults.fire("log.txn.commit", exc=OSError,
                     topic=self.topic, cid=cid)
         _write_atomic(self._fs, cpath,
@@ -427,8 +722,8 @@ class TopicAppender:
         late-running cleanup must skip it, never delete a live
         successor's staged epoch (the same fence the part/segment
         names carry)."""
-        ppath = os.path.join(_txn_dir(self.path), f"pre-{cid:010d}.json")
-        cpath = os.path.join(_txn_dir(self.path), f"commit-{cid:010d}.json")
+        ppath = self._marker_path("pre", cid)
+        cpath = self._marker_path("commit", cid)
         if self._fs.exists(cpath):
             raise LogError(
                 f"refusing to abort committed transaction {cid} on "
@@ -457,7 +752,7 @@ class TopicAppender:
         """Checkpoint payload: the pre marker plus every staged segment's
         bytes — enough to rebuild the transaction after an abort swept
         the staged files (the FileSink staged-bytes rationale)."""
-        ppath = os.path.join(_txn_dir(self.path), f"pre-{cid:010d}.json")
+        ppath = self._marker_path("pre", cid)
         with self._fs.open_read(ppath) as f:
             raw = f.read()
         pre = json.loads(raw if isinstance(raw, str)
@@ -475,7 +770,7 @@ class TopicAppender:
     def rebuild(self, cid: int, payload: Dict[str, Any]) -> None:
         """Re-create staged transaction ``cid`` from its checkpoint
         payload where absent (idempotent; a commit follows)."""
-        cpath = os.path.join(_txn_dir(self.path), f"commit-{cid:010d}.json")
+        cpath = self._marker_path("commit", cid)
         if self._fs.exists(cpath):
             return  # already committed — nothing to rebuild
         for key, data in payload.get("segments", {}).items():
@@ -483,63 +778,173 @@ class TopicAppender:
             dst = os.path.join(_partition_dir(self.path, int(p_s)), name)
             if not self._fs.exists(dst):
                 _write_atomic(self._fs, dst, data)
-        ppath = os.path.join(_txn_dir(self.path), f"pre-{cid:010d}.json")
+        ppath = self._marker_path("pre", cid)
         if not self._fs.exists(ppath):
             _write_atomic(self._fs, ppath,
                           json.dumps(payload["pre"]).encode("utf-8"))
         self._refresh_offsets()
 
     def sweep_orphans(self) -> int:
-        """Delete segment files no pre/commit marker references (a crash
-        between segment write and marker rename — torn prepare) and
-        stray .tmp leftovers. Returns the number removed."""
+        """Delete partition-file debris, restricted to OWNED partitions
+        (a co-resident producer's crash window between segment write
+        and marker rename must never be swept by its neighbor):
+
+        - stray ``.tmp`` leftovers and raw segments no pre/commit
+          marker references (torn prepare);
+        - raw segments wholly below the manifest's compacted/retention
+          floor that the manifest does not list (superseded by a
+          compaction swap, or retention-dropped — a crash between the
+          manifest rename and the file deletes leaves them);
+        - compacted (``cmp-``) files the current manifest does not
+          reference (a crash between compaction rewrite and manifest
+          swap, or a superseded generation).
+
+        Returns the number removed."""
         pres = _list_markers(self._fs, self.path, "pre")
         commits = _list_markers(self._fs, self.path, "commit")
-        referenced = set()
+        referenced: Dict[Tuple[int, str], int] = {}
         for marker in list(pres.values()) + list(commits.values()):
             for p_s, segs in marker.get("segments", {}).items():
                 for s in segs:
-                    referenced.add((int(p_s), s["name"]))
-        removed = 0
-        for p in range(self.partitions):
-            pdir = _partition_dir(self.path, p)
-            if not self._fs.exists(pdir):
-                continue
-            for name in self._fs.listdir(pdir):
-                if name.endswith(".tmp") or (
-                        _SEG_RE.match(name)
-                        and (p, name) not in referenced):
-                    self._fs.delete(os.path.join(pdir, name))
-                    removed += 1
+                    referenced[(int(p_s), s["name"])] = (
+                        int(s["base"]) + int(s["rows"]))
+        # cmp-file cleanup needs the MAINTENANCE lock: an unreferenced
+        # cmp file may be a LIVE pass's pre-swap output that the
+        # imminent manifest rename is about to reference — deleting it
+        # would make the new generation permanently unreadable. Lock
+        # busy → keep cmp files this sweep (a later sweep, or the pass
+        # itself, removes real debris).
+        maint_fd = try_maintenance_lock(self.path)
+        try:
+            manifest = load_manifest(self._fs, self.path)
+            mparts = (manifest or {}).get("partitions", {})
+            removed = 0
+            for p in self.owned:
+                pdir = _partition_dir(self.path, p)
+                if not self._fs.exists(pdir):
+                    continue
+                pm = mparts.get(p) or {}
+                floor = max(int(pm.get("start", 0)),
+                            int(pm.get("compacted_end", 0)))
+                live_cmp = {s["name"] for s in pm.get("segments", [])}
+                for name in self._fs.listdir(pdir):
+                    drop = False
+                    if name.endswith(".tmp"):
+                        drop = True
+                    elif _CMP_RE.match(name):
+                        drop = (maint_fd is not None
+                                and name not in live_cmp)
+                    elif _SEG_RE.match(name):
+                        end = referenced.get((p, name))
+                        drop = (end is None
+                                or (end <= floor
+                                    and name not in live_cmp))
+                    if drop:
+                        self._fs.delete(os.path.join(pdir, name))
+                        removed += 1
+        finally:
+            release_maintenance_lock(self.path, maint_fd)
         if removed:
             self._refresh_offsets()
         return removed
 
     def recover(self) -> None:
-        """Fresh-start recovery on a topic this writer now owns: roll
-        every uncommitted (staged) transaction back and sweep torn
+        """Fresh-start recovery on partitions this writer now owns:
+        roll OUR uncommitted (staged) transactions back and sweep torn
         debris — a dead producer attempt's pre-committed epochs must
         never linger as phantom stageable state (restore_staged
         rebuilds covered epochs from the checkpoint payload
-        afterwards)."""
+        afterwards). With a lease, additionally roll back staged
+        transactions a DEPOSED previous holder of our partitions left
+        behind (its lease epoch on file is below ours — takeover
+        completes the dead writer's abort). A LEGACY (unleased) writer
+        claims the WHOLE topic — the pre-lease single-writer
+        semantics — so its recovery also rolls back any foreign
+        writer-scoped staged transaction: left in place, a dead leased
+        producer's staged rows would hold their offsets in ``_next``
+        forever and the never-committed range would read as a
+        permanent contiguity gap (a still-LIVE leased producer mixed
+        with a legacy writer is a misuse either way; its next commit
+        fails loudly on the vanished marker, never silently)."""
         for cid in self.staged_ids():
             self.abort(cid)
+        if self.lease is not None:
+            self._abort_deposed_staged()
+        elif self.writer_id == "":
+            self._abort_foreign_staged()
         self.sweep_orphans()
         self._refresh_offsets()
 
+    def _abort_foreign_staged(self) -> None:
+        """Legacy whole-topic claim: roll back every OTHER writer's
+        staged-but-uncommitted transaction (segments, then marker)."""
+        pres = _list_markers(self._fs, self.path, "pre")
+        commits = _marker_ids(self._fs, self.path, "commit")
+        for (cid, writer), pre in sorted(pres.items()):
+            if writer == self.writer_id or (cid, writer) in commits:
+                continue
+            for p_s, segs in pre.get("segments", {}).items():
+                pdir = _partition_dir(self.path, int(p_s))
+                for s in segs:
+                    seg = os.path.join(pdir, s["name"])
+                    if self._fs.exists(seg):
+                        self._fs.delete(seg)
+            self._fs.delete(os.path.join(
+                _txn_dir(self.path), _marker_file("pre", cid, writer)))
+            _count(self.topic, "txns_aborted")
+
+    def _abort_deposed_staged(self) -> None:
+        """Takeover sweep: any OTHER writer's staged-but-uncommitted
+        transaction touching one of our leased partitions with a lease
+        epoch below ours was staged by the partition's previous holder,
+        now deposed — roll the whole transaction back (2PC aborts are
+        all-or-nothing; if that writer is somehow still alive its next
+        commit fails loudly on the vanished marker)."""
+        pres = _list_markers(self._fs, self.path, "pre")
+        commits = _marker_ids(self._fs, self.path, "commit")
+        for (cid, writer), pre in sorted(pres.items()):
+            if writer == self.writer_id or (cid, writer) in commits:
+                continue
+            epochs = {int(p): int(e) for p, e in
+                      pre.get("lease_epochs", {}).items()}
+            ours = [int(p) for p in pre.get("segments", {})
+                    if int(p) in self.owned]
+            if not ours:
+                continue
+            if all(epochs.get(p, -1) < self.lease.epochs.get(p, 0)
+                   for p in ours):
+                for p_s, segs in pre.get("segments", {}).items():
+                    pdir = _partition_dir(self.path, int(p_s))
+                    for s in segs:
+                        seg = os.path.join(pdir, s["name"])
+                        if self._fs.exists(seg):
+                            self._fs.delete(seg)
+                self._fs.delete(os.path.join(
+                    _txn_dir(self.path),
+                    _marker_file("pre", cid, writer)))
+                _count(self.topic, "txns_aborted")
+
 
 class _Segment:
-    __slots__ = ("p", "base", "end", "name", "cid")
+    __slots__ = ("p", "base", "end", "name", "cid", "sparse", "rows")
 
-    def __init__(self, p: int, base: int, end: int, name: str, cid: int):
+    def __init__(self, p: int, base: int, end: int, name: str, cid: int,
+                 sparse: bool = False, rows: Optional[int] = None):
         self.p, self.base, self.end = p, base, end
         self.name, self.cid = name, cid
+        self.sparse = sparse  # compacted: rows < end-base, offsets in
+        self.rows = (end - base) if rows is None else rows  # __offset
 
 
 class TopicReader:
-    """Committed-offset reads: only segments a COMMIT marker names are
-    observable, in offset order, validated contiguous (an overlap or
-    gap in the committed ranges is corruption and fails loudly).
+    """Committed-offset reads: only segments a COMMIT marker or the
+    compaction manifest names are observable, in offset order,
+    validated contiguous above the compaction floor (an overlap or gap
+    in the committed ranges is corruption and fails loudly). Below the
+    floor, COMPACTED segments are sparse — each surviving row carries
+    its original offset in the ``__offset`` column, so offsets are
+    stable across compaction (gaps where superseded rows were dropped)
+    and below the retention ``start`` nothing is readable at all.
     Offset-addressed: ``read(p, start_offset)`` resumes mid-partition —
     whole segments before the offset are skipped without opening,
     already-consumed leading rows of the boundary block are sliced
@@ -549,25 +954,50 @@ class TopicReader:
         self.path = path
         self._fs = get_filesystem(path)
         self.partitions = topic_partitions(path)
+        manifest = load_manifest(self._fs, path)
+        self.generation = int((manifest or {}).get("gen", 0))
+        mparts = (manifest or {}).get("partitions", {})
         commits = _list_markers(self._fs, path, "commit")
         self._schema = None
-        per_part: Dict[int, List[_Segment]] = {
+        raw: Dict[int, List[_Segment]] = {
             p: [] for p in range(self.partitions)}
-        for cid in sorted(commits):
-            marker = commits[cid]
+        for key in sorted(commits):
+            marker = commits[key]
             if self._schema is None and marker.get("schema"):
                 self._schema = tuple(
                     (str(n), str(t)) for n, t in marker["schema"])
             for p_s, segs in marker.get("segments", {}).items():
                 p = int(p_s)
                 for s in segs:
-                    per_part[p].append(_Segment(
+                    raw[p].append(_Segment(
                         p, int(s["base"]), int(s["base"]) + int(s["rows"]),
-                        s["name"], cid))
-        for p, segs in per_part.items():
+                        s["name"], key[0]))
+        per_part: Dict[int, List[_Segment]] = {}
+        self._starts: Dict[int, int] = {}
+        self._compacted_end: Dict[int, int] = {}
+        for p, segs in raw.items():
+            pm = mparts.get(p) or {}
+            start = int(pm.get("start", 0))
+            cend = int(pm.get("compacted_end", 0))
+            floor = max(start, cend)
+            self._starts[p] = start
+            self._compacted_end[p] = cend
+            live = [_Segment(p, s["base"], s["end"], s["name"], -1,
+                             sparse=True, rows=s["rows"])
+                    for s in pm.get("segments", [])]
+            at = start
+            for s in live:
+                if s.base < at or s.end > cend:
+                    raise LogError(
+                        f"topic {path!r} p{p}: compacted segment "
+                        f"{s.name!r} covers [{s.base}, {s.end}) outside "
+                        f"the manifest's [{at}, {cend}) (corrupt "
+                        "manifest)")
+                at = s.end
             segs.sort(key=lambda s: s.base)
-            at = 0
-            for s in segs:
+            tail = [s for s in segs if s.end > floor]
+            at = floor
+            for s in tail:
                 if s.base != at:
                     raise LogError(
                         f"topic {path!r} p{p}: committed segment "
@@ -575,22 +1005,70 @@ class TopicReader:
                         f"{at} — overlapping or missing commit ranges "
                         "(corrupt transaction log)")
                 at = s.end
+            per_part[p] = live + tail
         self._segments = per_part
+        self._floors = {p: max(self._starts[p], self._compacted_end[p])
+                        for p in per_part}
 
     def committed_offsets(self) -> Dict[int, int]:
-        return {p: (segs[-1].end if segs else 0)
+        """Per-partition committed END (the original high-water mark —
+        compaction/retention never move it backwards)."""
+        return {p: (segs[-1].end if segs else self._floors[p])
                 for p, segs in self._segments.items()}
+
+    def start_offsets(self) -> Dict[int, int]:
+        """Per-partition retention floor: offsets below this were
+        dropped by retention and are gone (0 before any retention)."""
+        return dict(self._starts)
+
+    def compacted_ends(self) -> Dict[int, int]:
+        """Per-partition end of the key-compacted range (0 before any
+        compaction): reads below this see only the latest committed
+        row per key."""
+        return dict(self._compacted_end)
+
+    def _sparse_schema(self):
+        if self._schema is None:
+            return None
+        return ((OFFSET_COL, "i64"),) + tuple(self._schema)
 
     def read(self, p: int, start_offset: int = 0
              ) -> Iterator[Tuple[int, Dict[str, np.ndarray]]]:
         """Yield ``(offset_of_first_row, batch)`` per stored block from
-        ``start_offset`` to the committed end. Truncated or corrupt
-        segments raise ColumnarError — a committed range that cannot be
-        read back whole is data loss, never a silent skip."""
+        ``start_offset`` to the committed end (see ``read3`` for the
+        replay-position variant). Truncated or corrupt segments raise
+        ColumnarError — a committed range that cannot be read back
+        whole is data loss, never a silent skip."""
+        for offset, _nxt, block in self.read3(p, start_offset):
+            yield offset, block
+
+    def read3(self, p: int, start_offset: int = 0
+              ) -> Iterator[Tuple[int, int, Dict[str, np.ndarray]]]:
+        """Yield ``(offset_of_first_row, next_position, batch)`` per
+        stored block: ``next_position`` is the replay position AFTER
+        consuming the block — ``last row's offset + 1``, which for
+        sparse (compacted) blocks jumps the gaps a naive
+        ``offset + len`` would land in (and re-deliver rows from) on
+        restore."""
         if p not in self._segments:
             raise LogError(
                 f"topic {self.path!r} has no partition {p} "
                 f"(partitions: {self.partitions})")
+        if 0 < start_offset < self._starts[p]:
+            # a POSITIVE replay position below the retention floor is a
+            # checkpointed promise this topic can no longer keep — the
+            # rows were expired. Silently yielding from the floor would
+            # skip records a restore expects to re-deliver (the same
+            # loud-failure contract as a truncated committed range).
+            # start_offset == 0 stays legal: a fresh consumer reading
+            # "from earliest available" starts at the floor by design.
+            raise LogError(
+                f"topic {self.path!r} p{p}: replay position "
+                f"{start_offset} is below the retention floor "
+                f"{self._starts[p]} — the checkpointed range was "
+                "expired by retention (an anonymous reader's positions "
+                "are not part of the safety floor; use a consumer "
+                "group to pin history)")
         for seg in self._segments[p]:
             if seg.end <= start_offset:
                 continue
@@ -599,45 +1077,147 @@ class TopicReader:
                 data = f.read()
             if isinstance(data, str):
                 data = data.encode("utf-8")
-            offset = seg.base
             rows_seen = 0
-            for block in iter_blocks(data, expect_schema=self._schema):
-                n = len(next(iter(block.values()), ()))
-                rows_seen += n
-                if offset + n <= start_offset:
-                    offset += n
-                    continue
-                if offset < start_offset:
-                    cut = start_offset - offset
-                    block = {k: v[cut:] for k, v in block.items()}
-                    offset = start_offset
-                yield offset, block
-                offset += len(next(iter(block.values()), ()))
-            if rows_seen != seg.end - seg.base:
+            if seg.sparse:
+                for block in iter_blocks(
+                        data, expect_schema=self._sparse_schema()):
+                    offs = np.asarray(block[OFFSET_COL], np.int64)
+                    rows_seen += len(offs)
+                    if not len(offs) or int(offs[-1]) < start_offset:
+                        continue
+                    cut = int(np.searchsorted(offs, start_offset))
+                    payload = {k: v[cut:] for k, v in block.items()
+                               if k != OFFSET_COL}
+                    yield (int(offs[cut]), int(offs[-1]) + 1, payload)
+            else:
+                offset = seg.base
+                for block in iter_blocks(data,
+                                         expect_schema=self._schema):
+                    n = len(next(iter(block.values()), ()))
+                    rows_seen += n
+                    if offset + n <= start_offset:
+                        offset += n
+                        continue
+                    if offset < start_offset:
+                        cut = start_offset - offset
+                        block = {k: v[cut:] for k, v in block.items()}
+                        offset = start_offset
+                    n_out = len(next(iter(block.values()), ()))
+                    yield offset, offset + n_out, block
+                    offset += n_out
+            if rows_seen != seg.rows:
                 raise LogError(
                     f"topic {self.path!r} p{p}: segment {seg.name!r} "
-                    f"holds {rows_seen} rows, commit marker promised "
-                    f"{seg.end - seg.base} (corrupt segment)")
+                    f"holds {rows_seen} rows, its "
+                    f"{'manifest entry' if seg.sparse else 'commit marker'}"
+                    f" promised {seg.rows} (corrupt segment)")
+
+
+def list_leases(path: str) -> Dict[int, Dict[str, Any]]:
+    """Per-partition writer leases on file: {p: {"owner", "epoch",
+    "deadline_ms", ...}} — the read side of log/bus.py LeaseManager
+    (inspection + fencing checks share it)."""
+    fs = get_filesystem(path)
+    ldir = os.path.join(path, LEASE_DIR)
+    out: Dict[int, Dict[str, Any]] = {}
+    if not fs.exists(ldir):
+        return out
+    pat = re.compile(r"^p(\d+)\.json$")
+    for name in fs.listdir(ldir):
+        m = pat.match(name)
+        if m is None:
+            continue
+        out[int(m.group(1))] = _read_json(
+            fs, os.path.join(ldir, name), "lease file")
+    return out
+
+
+def list_group_offsets(path: str,
+                       group: Optional[str] = None
+                       ) -> Dict[str, Dict[int, int]]:
+    """Committed consumer-group offsets: {group: {p: offset}} — the
+    read side of log/bus.py ConsumerGroups (the compaction/retention
+    safety floor and the CLI's per-group view). ``group`` restricts
+    the scan to ONE group's directory — the per-checkpoint commit
+    round and split bootstrap use it so their cost is O(own
+    partitions), not O(all groups x partitions)."""
+    fs = get_filesystem(path)
+    gdir = os.path.join(path, GROUP_DIR)
+    out: Dict[str, Dict[int, int]] = {}
+    if not fs.exists(gdir):
+        return out
+    pat = re.compile(r"^p(\d+)\.json$")
+    names = [group] if group is not None else fs.listdir(gdir)
+    for gname in names:
+        sub = os.path.join(gdir, gname)
+        if not fs.exists(sub) or not fs.is_dir(sub):
+            continue
+        offsets: Dict[int, int] = {}
+        for name in fs.listdir(sub):
+            m = pat.match(name)
+            if m is None:
+                continue
+            rec = _read_json(fs, os.path.join(sub, name),
+                             "group-offset file")
+            try:
+                offsets[int(m.group(1))] = int(rec["offset"])
+            except (ValueError, KeyError, TypeError) as e:
+                raise LogError(
+                    f"corrupt group-offset file {gname}/{name!r} in "
+                    f"topic {path!r}: {e}") from e
+        out[gname] = offsets
+    return out
 
 
 def describe_topic(path: str) -> Dict[str, Any]:
     """Inspection view (the CLI ``log`` subcommand): partitions,
     committed offsets, staged (pre-committed, uncommitted)
-    transactions, per-partition segment counts."""
+    transactions, per-partition segment counts — plus the message-bus
+    tier's state: compaction generation, retention floor, active
+    writer leases with fencing epochs, per-consumer-group committed
+    offsets."""
     fs = get_filesystem(path)
     reader = TopicReader(path)
     pres = _list_markers(fs, path, "pre")
     commits = _list_markers(fs, path, "commit")
     committed = reader.committed_offsets()
+    starts = reader.start_offsets()
+    cends = reader.compacted_ends()
+
+    def _txn_view(keys):
+        # legacy shape for single-writer topics (a sorted cid list —
+        # tests and operators key on it); writer-scoped markers are
+        # reported per writer alongside
+        return sorted(cid for cid, w in keys if not w)
+
+    def _writer_view(keys):
+        by_w: Dict[str, List[int]] = {}
+        for cid, w in keys:
+            if w:
+                by_w.setdefault(w, []).append(cid)
+        return {w: sorted(c) for w, c in sorted(by_w.items())}
+
+    staged = set(pres) - set(commits)
     return {
         "topic": path,
         "partitions": reader.partitions,
         "committed_offsets": {str(p): committed[p] for p in committed},
         "committed_records": int(sum(committed.values())),
-        "committed_transactions": sorted(commits),
-        "staged_transactions": sorted(set(pres) - set(commits)),
+        "committed_transactions": _txn_view(commits),
+        "staged_transactions": _txn_view(staged),
+        "writer_transactions": {
+            "committed": _writer_view(commits),
+            "staged": _writer_view(staged)},
         "segments": {str(p): len(reader._segments[p])
                      for p in reader._segments},
         "schema": ([[n, t] for n, t in reader._schema]
                    if reader._schema else None),
+        "compaction_generation": reader.generation,
+        "retention_floor": {str(p): starts[p] for p in sorted(starts)},
+        "compacted_end": {str(p): cends[p] for p in sorted(cends)},
+        "key_field": topic_key_field(path),
+        "leases": {str(p): lease
+                   for p, lease in sorted(list_leases(path).items())},
+        "groups": {g: {str(p): off for p, off in sorted(offs.items())}
+                   for g, offs in sorted(list_group_offsets(path).items())},
     }

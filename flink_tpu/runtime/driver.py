@@ -589,9 +589,11 @@ class Driver:
                         self._push_downstream(nid, b)
         self._flush_emits()  # barrier: staged epoch must be complete
         sinks = [n.sink for n in self.plan.nodes.values() if n.kind == "sink"]
+        commit_fns = [s.notify_checkpoint_complete for s in sinks]
+        commit_fns.extend(self._source_offset_committers())
         pend = self._coordinator.trigger_async(
             lambda: self._snapshot(allow_reuse=not savepoint),
-            commit_fns=[s.notify_checkpoint_complete for s in sinks],
+            commit_fns=commit_fns,
             prepare_fns=[s.prepare_commit for s in sinks],
             # abandon() (attempt failure with this checkpoint in
             # flight) notifies 2PC sinks to roll THIS epoch's staged
@@ -1528,18 +1530,43 @@ class Driver:
             self._commit_final_epoch()
         return self._finish_run(job_name, drain)
 
+    def _source_offset_committers(self):
+        """One commit-round fn per source that publishes externally
+        visible committed offsets (log.LogSource consumer groups):
+        called with the checkpoint id AFTER the checkpoint is durable,
+        with the replay positions FROZEN at this barrier — the group
+        floor can never outrun the checkpoint that proves the rows
+        were consumed exactly once."""
+        fns = []
+        for sid in self.plan.sources:
+            src = self.plan.node(sid).source
+            if src is None or not hasattr(src, "commit_offsets"):
+                continue
+            frozen = dict(self._positions.get(sid, {}))
+
+            def _commit(cid, _src=src, _frozen=frozen):
+                _src.commit_offsets(cid, _frozen)
+
+            fns.append(_commit)
+        return fns
+
     def _commit_final_epoch(self) -> None:
         """2PC sinks' terminal commit for a bounded run without
         checkpointing — end of input is the terminal barrier. The epoch
         id must not collide with ANY earlier run's ids in a reused sink
         directory (a replayed id silently drops this run's staged
         output as "already committed") — a ms timestamp is unique
-        across runs and above any coordinator-numbered epoch."""
+        across runs and above any coordinator-numbered epoch. Consumer-
+        group sources publish their final offsets under the same
+        terminal barrier (the run completes whole or replays whole)."""
         final_epoch = int(time.time() * 1000)
         for n in self.plan.nodes.values():
             if n.kind == "sink" and hasattr(n.sink, "prepare_commit"):
                 n.sink.prepare_commit(final_epoch)
                 n.sink.notify_checkpoint_complete(final_epoch)
+        if getattr(self, "_positions", None):
+            for fn in self._source_offset_committers():
+                fn(final_epoch)
 
     def _finish_run(self, job_name: str, drain) -> "JobResult":
         """Shared happy-path epilogue of both runtime modes: stop the

@@ -35,8 +35,13 @@ def run_with_recovery(
     attempt_conf = config
     attempt = 1
     while True:
-        env = build_env(attempt_conf)
         try:
+            # build INSIDE the retry scope: constructing sources/sinks
+            # is part of the redeploy step (a lease acquisition losing
+            # a fencing race, a dirty-topic recovery sweep failing — a
+            # deploy-time death restarts like any task failure, the
+            # cluster path's coordinator.deploy discipline)
+            env = build_env(attempt_conf)
             return env.execute(job_name)
         except Exception as e:  # noqa: BLE001 — any task failure
             if not strategy.can_restart():

@@ -70,6 +70,86 @@ class TestExitCodeContract:
             assert f["severity"] in ("error", "warn")
 
 
+class TestLogCli:
+    """ISSUE 9: `flink_tpu log TOPIC_DIR` prints the message-bus view
+    — compaction generation, retention floor, active leases with
+    epochs, per-consumer-group committed offsets — and honors the
+    0/1/2 exit-code contract (0 = ok, 1 = topic/maintenance error,
+    2 = usage/path error)."""
+
+    def _seed_topic(self, tmp_path):
+        import numpy as np
+
+        from flink_tpu.log import (ConsumerGroups, LeaseManager,
+                                   TopicAppender)
+
+        topic = str(tmp_path / "topic")
+        ap = TopicAppender(topic, 2, segment_records=8, key_field="k")
+        for cid in (1, 2, 3):
+            batch = {p: [{"k": np.arange(8, dtype=np.int64) % 4,
+                          "ts": np.arange(8, dtype=np.int64) + cid}]
+                     for p in range(2)}
+            assert ap.stage(cid, batch)
+            ap.commit(cid)
+        ConsumerGroups.commit(topic, "readers", {0: 24, 1: 24})
+        lease = LeaseManager(topic, "prod-a", [0], ttl_ms=60_000)
+        lease.acquire()
+        return topic
+
+    def test_describe_prints_bus_state_exit_0(self, tmp_path, capsys):
+        topic = self._seed_topic(tmp_path)
+        rc, out = cli(capsys, "log", topic)
+        assert rc == 0
+        assert out["compaction_generation"] == 0
+        assert out["retention_floor"] == {"0": 0, "1": 0}
+        assert out["leases"]["0"]["owner"] == "prod-a"
+        assert out["leases"]["0"]["epoch"] == 1
+        assert out["groups"] == {"readers": {"0": 24, "1": 24}}
+        assert out["key_field"] == "k"
+
+    def test_compact_flag_runs_a_pass_and_describes(self, tmp_path,
+                                                    capsys):
+        topic = self._seed_topic(tmp_path)
+        rc, out = cli(capsys, "log", topic, "--compact")
+        assert rc == 0
+        assert out["compaction_generation"] == 1
+        assert out["compaction"]["gen"] == 1
+        # latest-per-key survivors only, committed end preserved
+        assert out["compaction"]["partitions"]["0"]["rows_out"] == 4
+        assert out["committed_offsets"] == {"0": 24, "1": 24}
+        assert out["compacted_end"] == {"0": 24, "1": 24}
+
+    def test_retain_flag_advances_the_floor(self, tmp_path, capsys):
+        topic = self._seed_topic(tmp_path)
+        rc, out = cli(capsys, "log", topic, "--retain",
+                      "--conf", "log.retention.ms=1",
+                      "--conf", "log.retention.ts-field=ts")
+        assert rc == 0
+        assert out["retention"]["gen"] == 1
+        assert out["retention_floor"] == {"0": 24, "1": 24}
+
+    def test_missing_topic_exits_2(self, tmp_path, capsys):
+        assert cli_main(["log", str(tmp_path / "absent")]) == 2
+        err = capsys.readouterr().err
+        assert "no such log topic" in err
+
+    def test_maintenance_error_exits_1(self, tmp_path, capsys):
+        import numpy as np
+
+        from flink_tpu.log import TopicAppender
+
+        # a topic created WITHOUT a key_field: --compact has no key
+        # column to compact by — a maintenance error, not a path error
+        topic = str(tmp_path / "nokey")
+        ap = TopicAppender(topic, 1, segment_records=8)
+        for cid in (1, 2):
+            assert ap.stage(cid, {0: [{"k": np.arange(
+                8, dtype=np.int64)}]})
+            ap.commit(cid)
+        assert cli_main(["log", topic, "--compact"]) == 1
+        assert "key" in capsys.readouterr().err
+
+
 class TestLocalRun:
     def test_run_local_executes_entry(self, tmp_path, capsys):
         import runner_job

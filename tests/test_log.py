@@ -456,8 +456,11 @@ class TestCliChainSmoke:
         got = jobs.read_committed_counts(sink_dir)
         assert got == jobs.expected_counts(n) and len(got) > 0
 
-    def test_log_command_on_missing_topic_fails(self, tmp_path):
+    def test_log_command_on_missing_topic_fails(self, tmp_path, capsys):
         from flink_tpu.cli import main as cli_main
 
-        with pytest.raises(SystemExit):
-            cli_main(["log", str(tmp_path / "nope")])
+        # exit 2 = usage/path error (the analyze/lint contract; ISSUE 9
+        # aligned `log` with it — a typo'd TOPIC_DIR must not read like
+        # corrupt topic state)
+        assert cli_main(["log", str(tmp_path / "nope")]) == 2
+        assert "no such log topic" in capsys.readouterr().err

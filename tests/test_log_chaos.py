@@ -221,6 +221,328 @@ class TestIsolationUnderPermanentFailure:
                     f"row {k} duplicated in committed output")
 
 
+# -- ISSUE 9: message-bus tier chaos (compaction / retention / leases /
+# consumer groups) ----------------------------------------------------------
+
+KV_BATCHES = 8
+
+
+def kv_gen(n_batches, base=0):
+    """Keyed upsert stream: each batch overwrites a small key domain
+    with strictly increasing values — latest-per-key is well-defined
+    and changes every batch (the compaction-meaningful shape)."""
+
+    def gen(split, i):
+        if i >= n_batches:
+            return None
+        seq = base + i * BATCH + np.arange(BATCH, dtype=np.int64)
+        keys = seq % VOCAB + (base // 1000) * 100
+        ts = seq * 10
+        return {"k": keys, "seq": seq, "ts_ms": ts}, ts
+
+    return gen
+
+
+def produce_kv(tmp_path, topic, tag, owned=None, producer_id=None,
+               base=0):
+    """Producer job under run_with_recovery: per-batch checkpoints so
+    2PC epochs commit all along the run (plenty of seams for injected
+    faults), optionally lease-fenced onto owned partitions."""
+    from flink_tpu.log import LogSink
+
+    def build_env(conf):
+        env = StreamExecutionEnvironment(conf)
+        env.from_source(GeneratorSource(kv_gen(KV_BATCHES, base))
+                        ).add_sink(LogSink(
+                            topic, key_field="k", partitions=2,
+                            owned_partitions=owned,
+                            producer_id=producer_id))
+        return env
+
+    conf = Configuration({
+        "pipeline.microbatch-size": BATCH,
+        "execution.checkpointing.dir": str(tmp_path / f"ckpt-{tag}"),
+        "execution.checkpointing.interval": 1,
+        "restart-strategy.type": "fixed-delay",
+        "restart-strategy.fixed-delay.attempts": 20,
+        "restart-strategy.fixed-delay.delay": 1,
+    })
+    run_with_recovery(build_env, conf, job_name=f"bus-chaos-{tag}")
+
+
+def read_everything(topic):
+    """Full committed read, per partition in offset order."""
+    r = TopicReader(topic)
+    out = {}
+    for p in range(r.partitions):
+        rows = []
+        for _off, _nxt, b in r.read3(p):
+            rows.extend(zip(b["k"].tolist(), b["seq"].tolist(),
+                            b["ts_ms"].tolist()))
+        out[p] = rows
+    return out
+
+
+def latest_table(topic):
+    table = {}
+    for rows in read_everything(topic).values():
+        for k, seq, _ts in rows:
+            if k not in table or seq > table[k]:
+                table[k] = seq
+    return dict(sorted(table.items()))
+
+
+def consume_group(topic, group, out_dir, ckpt_dir, plan=None):
+    """Consumer-group job with checkpointing + recovery into a DURABLE
+    transactional sink (committed rows survive attempt restarts), so
+    exactly-once accounting is checked against what actually became
+    visible — not an in-memory list a restart would wipe."""
+    from flink_tpu.api.sinks import FileTransactionalSink
+    from flink_tpu.log import LogSource
+
+    def build_env(conf):
+        env = StreamExecutionEnvironment(conf)
+        env.from_source(LogSource(topic, ts_field="ts_ms", group=group)
+                        ).add_sink(FileTransactionalSink(str(out_dir)))
+        return env
+
+    conf = Configuration({
+        "pipeline.microbatch-size": BATCH,
+        "execution.checkpointing.dir": str(ckpt_dir),
+        "execution.checkpointing.interval": 1,
+        "restart-strategy.type": "fixed-delay",
+        "restart-strategy.fixed-delay.attempts": 20,
+        "restart-strategy.fixed-delay.delay": 1,
+    })
+    run_with_recovery(build_env, conf, job_name=f"group-{group}")
+    from flink_tpu.api.sinks import FileTransactionalSink as FTS
+
+    return sorted((int(r["k"]), int(r["seq"]))
+                  for r in FTS.committed_rows(str(out_dir)))
+
+
+@pytest.fixture(scope="module")
+def kv_golden(tmp_path_factory):
+    """One fault-free keyed topic + its full read and latest-per-key
+    table; maintenance-chaos scenarios copy the DIRECTORY so every
+    injection case starts from identical bytes."""
+    d = tmp_path_factory.mktemp("kv-golden")
+    topic = str(d / "topic")
+    produce_kv(d, topic, "golden")
+    return {"dir": topic, "full": read_everything(topic),
+            "latest": latest_table(topic)}
+
+
+def _copy_topic(kv_golden, tmp_path):
+    import shutil
+
+    topic = str(tmp_path / "topic")
+    shutil.copytree(kv_golden["dir"], topic)
+    return topic
+
+
+class TestBusMaintenanceChaos:
+    """Injection at every new maintenance fault point: the pass dies,
+    the topic stays byte-identical to the uncompacted golden (readers
+    observe the OLD generation whole — the manifest swap is the only
+    visibility point), debris sweeps clean, and a retried pass
+    converges to the same state a fault-free pass produces."""
+
+    MAINT_POINTS = ("log.compact.rewrite", "log.compact.swap")
+
+    @pytest.mark.parametrize("point", MAINT_POINTS)
+    def test_compaction_crash_leaves_old_generation_whole(
+            self, tmp_path, kv_golden, point):
+        from flink_tpu.log import Compactor, ConsumerGroups, TopicAppender
+
+        topic = _copy_topic(kv_golden, tmp_path)
+        ConsumerGroups.commit(
+            topic, "g", dict(TopicReader(topic).committed_offsets()))
+        plan = faults.FaultPlan(seed=CHAOS_SEED).rule(
+            point, "raise", count=1)
+        with plan.activate(), replayable(plan):
+            with pytest.raises(OSError, match="injected fault"):
+                Compactor(topic, min_segments=1).compact()
+            assert [x[:2] for x in plan.log] == [(point, "raise")]
+        # the crash window (incl. THE rewrite→swap window at
+        # log.compact.swap): reads byte-identical to the golden
+        assert TopicReader(topic).generation == 0
+        assert read_everything(topic) == kv_golden["full"]
+        # debris (half-written cmp files) sweeps without touching data
+        TopicAppender(topic, 2).sweep_orphans()
+        assert read_everything(topic) == kv_golden["full"]
+        # the retried pass converges: latest-per-key == golden's table
+        res = Compactor(topic, min_segments=1).compact()
+        assert res["gen"] == 1
+        assert latest_table(topic) == kv_golden["latest"]
+        # reads from the group's committed offset stay byte-identical
+        # (the tail above the floor is untouched raw history — empty
+        # here, the group is at the end)
+        r = TopicReader(topic)
+        for p, end in r.committed_offsets().items():
+            assert list(r.read3(p, end)) == []
+
+    def test_retention_preswap_crash_drops_nothing(self, tmp_path,
+                                                   kv_golden):
+        """The manifest-swap seam is SHARED by retention passes: a
+        raise at log.compact.swap during retention aborts the pass
+        before anything becomes visible — reads byte-identical."""
+        from flink_tpu.log import ConsumerGroups, Retention
+
+        topic = _copy_topic(kv_golden, tmp_path)
+        ConsumerGroups.commit(
+            topic, "g", dict(TopicReader(topic).committed_offsets()))
+        plan = faults.FaultPlan(seed=CHAOS_SEED).rule(
+            "log.compact.swap", "raise", count=1)
+        with plan.activate(), replayable(plan):
+            with pytest.raises(OSError, match="injected fault"):
+                Retention(topic, retention_ms=1, ts_field="ts_ms",
+                          now_fn=lambda: 10 ** 13).apply()
+            assert [x[:2] for x in plan.log] == [
+                ("log.compact.swap", "raise")]
+        assert TopicReader(topic).generation == 0
+        assert read_everything(topic) == kv_golden["full"]
+
+    def test_retention_postswap_crash_leaves_only_debris(
+            self, tmp_path, kv_golden):
+        """log.retention.drop fires in the POST-swap delete loop: the
+        manifest (new floor) is already durable, the raise leaves
+        undeleted segment files below it — droppable debris the orphan
+        sweep removes; existing-group reads (from their committed
+        offsets) are unchanged either way."""
+        from flink_tpu.log import ConsumerGroups, Retention, TopicAppender
+
+        topic = _copy_topic(kv_golden, tmp_path)
+        end = dict(TopicReader(topic).committed_offsets())
+        ConsumerGroups.commit(topic, "g", end)
+        plan = faults.FaultPlan(seed=CHAOS_SEED).rule(
+            "log.retention.drop", "raise", count=1)
+        with plan.activate(), replayable(plan):
+            with pytest.raises(OSError, match="injected fault"):
+                Retention(topic, retention_ms=1, ts_field="ts_ms",
+                          now_fn=lambda: 10 ** 13).apply()
+            assert [x[:2] for x in plan.log] == [
+                ("log.retention.drop", "raise")]
+        r = TopicReader(topic)
+        assert r.generation == 1  # the swap was the visibility point
+        assert r.start_offsets() == end
+        # the committed high-water mark survives total expiry, and the
+        # group's reads from its committed offsets are unchanged (empty
+        # tail before AND after)
+        assert r.committed_offsets() == end
+        for p, e in end.items():
+            assert list(r.read3(p, e)) == []
+        # the undeleted files below the floor are sweepable debris
+        removed = TopicAppender(topic, 2).sweep_orphans()
+        assert removed > 0
+        assert TopicReader(topic).committed_offsets() == end
+
+
+class TestLeaseChaos:
+    """Injection at the lease seams of a fenced producer: the attempt
+    dies at acquire or at the renew gate, recovery re-acquires (same
+    owner keeps its epoch) and the committed chain stays
+    byte-identical to the fault-free golden."""
+
+    @pytest.mark.parametrize("point,kw", [
+        ("log.lease.acquire", dict(count=1)),
+        ("log.lease.renew", dict(count=1, after=2)),
+    ])
+    def test_leased_producer_chain_byte_identical(
+            self, tmp_path, kv_golden, point, kw):
+        topic = str(tmp_path / "topic")
+        plan = faults.FaultPlan(seed=CHAOS_SEED).rule(
+            point, "raise", **kw)
+        with plan.activate(), replayable(plan):
+            produce_kv(tmp_path, topic, f"lease-{point}",
+                       owned=[0, 1], producer_id="prod")
+            assert [x[:2] for x in plan.log] == [(point, "raise")]
+        with replayable(plan):
+            assert read_everything(topic) == kv_golden["full"]
+            d = describe_topic(topic)
+            assert d["staged_transactions"] == []
+            assert d["writer_transactions"]["staged"] == {}
+
+
+class TestTwoProducersTwoGroups:
+    """THE acceptance chain: 2 concurrent producers on leased disjoint
+    partitions → 2 consumer groups, exactly-once accounting per group
+    under crash-restart of one producer (injected commit-round death)
+    AND one consumer (injected group-offset-commit death). Each
+    group's committed output equals the fault-free golden exactly
+    once."""
+
+    def _expected_rows(self):
+        rows = []
+        for base in (0, 1000):
+            for i in range(KV_BATCHES):
+                data, _ts = kv_gen(KV_BATCHES, base)(None, i)
+                rows.extend(zip(data["k"].tolist(),
+                                data["seq"].tolist()))
+        return sorted(rows)
+
+    def test_exactly_once_per_group_under_crashes(self, tmp_path):
+        import threading
+
+        from flink_tpu.log import create_topic
+
+        topic = str(tmp_path / "topic")
+        create_topic(topic, 2, key_field="k")
+        # one injected commit-round death lands in whichever producer
+        # reaches the seam first; BOTH must converge through recovery
+        plan = faults.FaultPlan(seed=CHAOS_SEED).rule(
+            "log.txn.commit", "raise", count=1, after=1)
+        errors = []
+
+        def run_producer(pid, owned, base):
+            try:
+                produce_kv(tmp_path, topic, pid, owned=owned,
+                           producer_id=pid, base=base)
+            except BaseException as e:  # noqa: BLE001 — reported below
+                errors.append((pid, e))
+
+        with plan.activate(), replayable(plan):
+            threads = [
+                threading.Thread(target=run_producer,
+                                 args=("prod-a", [0], 0)),
+                threading.Thread(target=run_producer,
+                                 args=("prod-b", [1], 1000)),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert not errors, errors
+        assert [x[:2] for x in plan.log] == [("log.txn.commit",
+                                              "raise")]
+        expected = self._expected_rows()
+        with replayable(plan):
+            got = sorted(
+                (k, s)
+                for rows in read_everything(topic).values()
+                for k, s, _ in rows)
+            assert got == expected, "producer-side exactly-once broke"
+
+        # consumer side: group A crash-restarts at the group-offset
+        # commit round; group B runs fault-free — both must commit the
+        # golden exactly once
+        cplan = faults.FaultPlan(seed=CHAOS_SEED).rule(
+            "log.group.commit", "raise", count=1, after=1)
+        with cplan.activate(), replayable(cplan):
+            got_a = consume_group(topic, "grp-a", tmp_path / "out-a",
+                                  tmp_path / "ckpt-ga")
+            assert [x[:2] for x in cplan.log] == [
+                ("log.group.commit", "raise")]
+        got_b = consume_group(topic, "grp-b", tmp_path / "out-b",
+                              tmp_path / "ckpt-gb")
+        assert got_a == expected, "group A lost/duplicated rows"
+        assert got_b == expected, "group B lost/duplicated rows"
+        d = describe_topic(topic)
+        assert d["groups"]["grp-a"] == d["groups"]["grp-b"]
+        assert sum(int(v) for v in
+                   d["groups"]["grp-a"].values()) == len(expected)
+
+
 @pytest.mark.slow
 class TestLogChaosSoak:
     """Randomized multi-seed soak over every log fault point — the

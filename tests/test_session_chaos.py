@@ -106,7 +106,14 @@ class TestTwoTenantChaos:
                 "runner_job:build",
                 config=_job_conf(
                     tmp_path, "a", n,
-                    faults_spec="checkpoint.storage.write=raise x1 +2"),
+                    # +1 (skip ONE write, then raise): the restart
+                    # still restores from a completed checkpoint, and
+                    # the schedule stays live on a loaded host where
+                    # the ~400ms job may only reach 2 storage writes
+                    # (with +2 the fault sometimes never fired and the
+                    # 'induced a restart' assertion flaked under full-
+                    # suite load)
+                    faults_spec="checkpoint.storage.write=raise x1 +1"),
                 job_id="chaos-a")
             rb = c.submit("runner_job:build",
                           config=_job_conf(tmp_path, "b", n),
