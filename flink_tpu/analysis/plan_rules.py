@@ -425,6 +425,45 @@ def session_quota_invalid(plan, config) -> Iterable[Finding]:
                 "session.runner-slots")
 
 
+@config_rule("SESSION_HA_UNSAFE", "warn",
+             fix="set high-availability.dir to a shared directory and "
+                 "run a standby contender (`session start --standby`)")
+def session_ha_unsafe(plan, config) -> Iterable[Finding]:
+    """A session cluster running CHECKPOINTING jobs without
+    ``high-availability.dir``: every tenant's state is individually
+    durable (checkpoints + transactional sinks survive a crash), but
+    one dispatcher SIGKILL strands ALL of them — queued, running, and
+    admitted-but-undeployed jobs evaporate with the in-memory registry
+    even though each could have recovered. The durable session
+    registry + standby takeover (runtime/session.py serve_session)
+    exists exactly for this; a cluster that bothered to checkpoint
+    should bother to survive its control plane."""
+    from flink_tpu.config import (
+        CheckpointingOptions,
+        HighAvailabilityOptions,
+    )
+
+    quota_keys = ("session.runner-slots", "session.max-jobs",
+                  "session.slots-per-job")
+    present = [k for k in quota_keys if k in set(config.keys())]
+    if not present:
+        return  # no session-cluster intent in this config
+    if int(config.get(CheckpointingOptions.INTERVAL)) <= 0:
+        return  # nothing durable to strand: re-submission is recovery
+    if str(config.get(HighAvailabilityOptions.HA_DIR)).strip():
+        return
+    yield _f(
+        f"session-cluster config ({', '.join(present)}) runs "
+        "checkpointing jobs with no high-availability.dir: a "
+        "dispatcher crash strands every tenant's queued and running "
+        "jobs even though their checkpoints would survive it — no "
+        "durable session registry, no standby takeover, no leader "
+        "epoch fencing",
+        fix="set high-availability.dir to a directory every contender "
+            "and runner shares, and start a hot standby with "
+            "`session start --standby --ha-dir <dir>`")
+
+
 @config_rule("SUBBATCH_INVALID", "error",
              fix="pick a divisor of pipeline.microbatch-size")
 def subbatch_invalid(plan, config) -> Iterable[Finding]:

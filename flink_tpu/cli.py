@@ -7,11 +7,12 @@ script. Here::
     python -m flink_tpu run --coordinator H:P --entry pkg.mod:build \
         [--job-id id] [--conf key=value ...]
     python -m flink_tpu run --local --entry pkg.mod:build [...]
-    python -m flink_tpu run --session H:P --entry pkg.mod:build [...]
+    python -m flink_tpu run --session H:P [--ha-dir D] --entry mod:build
     python -m flink_tpu session start [--port P] [--local-runners N] \
-        [--conf key=value ...]
+        [--ha-dir D] [--standby] [--conf key=value ...]
     python -m flink_tpu session submit --session H:P --entry mod:build
-    python -m flink_tpu session list|cancel|stop --session H:P [...]
+    python -m flink_tpu session list|info|cancel|stop \
+        (--session H:P | --ha-dir D) [...]
     python -m flink_tpu analyze [job.conf] [--entry pkg.mod:build] \
         [--json] [--explain] [--fail-on error|warn|off]
     python -m flink_tpu lint [paths ...] [--json]
@@ -43,6 +44,84 @@ def _coord_client(spec: str, flag: str = "--coordinator"):
     if not port:
         raise SystemExit(f"{flag} must be HOST:PORT, got {spec!r}")
     return RpcClient(host or "127.0.0.1", int(port))
+
+
+# leader re-resolution budget of the HA-aware session client: with
+# --ha-dir, a connection-refused (the leader died / a standby is mid-
+# takeover) re-reads the lease and retries up to this many times
+# before surfacing the failure (exit 1, never a traceback). Module
+# constants so tests can shrink the budget.
+_HA_RETRIES = 24
+_HA_RETRY_DELAY_S = 0.25
+
+
+class _SessionClient:
+    """Session-cluster RPC client that survives dispatcher failover.
+
+    Address resolution: an explicit ``--session HOST:PORT`` wins for
+    the FIRST attempt; with ``--ha-dir`` every retry re-resolves the
+    current leader from the lease file (``runtime/ha.leader_address``),
+    so a submit/list/poll issued against a dead leader lands on the
+    standby that took over. Without ``--ha-dir`` transport errors
+    surface immediately (the pre-HA behavior)."""
+
+    def __init__(self, session: Optional[str], ha_dir: Optional[str],
+                 flag: str = "--session") -> None:
+        if not session and not ha_dir:
+            # usage error, same class as a missing required flag —
+            # the documented exit-2 leg of the session CLI contract
+            print(f"error: {flag} HOST:PORT or --ha-dir is required",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        self._session = session
+        self._ha_dir = ha_dir
+        self._flag = flag
+        self._client = None
+        self._addr: Optional[str] = session
+
+    def _resolve(self) -> Optional[str]:
+        if self._addr:
+            return self._addr
+        from flink_tpu.runtime.ha import leader_address
+
+        self._addr = leader_address(self._ha_dir)
+        return self._addr
+
+    def call(self, method: str, **kw):
+        import time as _time
+
+        from flink_tpu.runtime.rpc import RpcError
+
+        last: Optional[Exception] = None
+        attempts = (_HA_RETRIES + 1) if self._ha_dir else 1
+        for i in range(attempts):
+            if i:
+                _time.sleep(_HA_RETRY_DELAY_S)
+            addr = self._resolve()
+            if addr is None:
+                last = RpcError(
+                    f"no session leader lease in --ha-dir "
+                    f"{self._ha_dir!r}")
+                continue
+            if self._client is None:
+                self._client = _coord_client(addr, flag=self._flag)
+            try:
+                return self._client.call(method, **kw)
+            except RpcError as e:
+                last = e
+                self.close()
+                if self._ha_dir:
+                    # drop the cached address: the next attempt
+                    # re-reads the lease (a takeover moves it)
+                    self._addr = None
+        raise last  # type: ignore[misc]
+
+    def close(self) -> None:
+        if self._client is not None:
+            try:
+                self._client.close()
+            finally:
+                self._client = None
 
 
 def _parse_conf(pairs: List[str]) -> dict:
@@ -101,15 +180,19 @@ def _run_local(entry: str, conf: dict, job_id: str) -> int:
     return 0
 
 
-def _run_attached(session: str, entry: str, conf: dict,
-                  job_id: str) -> int:
+def _run_attached(session: Optional[str], entry: str, conf: dict,
+                  job_id: str, ha_dir: Optional[str] = None) -> int:
     """``run --session H:P``: attach the job to a RUNNING session
     cluster instead of spinning a private runtime — submit through the
     dispatcher's admission gate, then block until the job is terminal
-    (the `flink run` against a session cluster flow)."""
+    (the `flink run` against a session cluster flow). With --ha-dir
+    the attach survives a dispatcher failover: submit and every status
+    poll re-resolve the leader through the lease."""
     import time as _time
 
-    c = _coord_client(session, flag="--session")
+    from flink_tpu.runtime.rpc import RpcError
+
+    c = _SessionClient(session, ha_dir)
     try:
         resp = c.call("submit_session_job", job_id=job_id, entry=entry,
                       config=conf)
@@ -123,6 +206,9 @@ def _run_attached(session: str, entry: str, conf: dict,
                 print(json.dumps({"job_id": job_id, **st}))
                 return 0 if state == "FINISHED" else 1
             _time.sleep(0.3)
+    except RpcError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     finally:
         c.close()
 
@@ -130,18 +216,25 @@ def _run_attached(session: str, entry: str, conf: dict,
 def _session(args) -> int:
     """``flink_tpu session ...``: the session-cluster control surface
     (runtime/session.py SessionDispatcher). Exit-code contract
-    (asserted in tests/test_session.py, same shape as
-    tests/test_cli.py TestExitCodeContract): 0 = ok (started /
-    admitted / listed / stopped), 1 = the cluster refused (admission
-    rejection, unknown job), 2 = usage error (argparse)."""
+    (asserted in tests/test_session.py and tests/test_cli.py
+    TestSessionHaCli, same shape as TestExitCodeContract): 0 = ok
+    (started / admitted / listed / stopped), 1 = the cluster refused
+    (admission rejection, unknown job, no reachable leader), 2 = usage
+    error (argparse / --standby without an HA dir)."""
+    from flink_tpu.runtime.rpc import RpcError
+
     if args.session_cmd == "start":
         from flink_tpu.config import Configuration
         from flink_tpu.runtime.session import serve_session
 
-        return serve_session(Configuration(_parse_conf(args.conf)),
+        conf = _parse_conf(args.conf)
+        if args.ha_dir:
+            conf["high-availability.dir"] = args.ha_dir
+        return serve_session(Configuration(conf),
                              port=args.port,
-                             local_runners=args.local_runners)
-    c = _coord_client(args.session, flag="--session")
+                             local_runners=args.local_runners,
+                             standby=args.standby)
+    c = _SessionClient(args.session, args.ha_dir)
     try:
         if args.session_cmd == "submit":
             job_id = args.job_id or f"job-{uuid.uuid4().hex[:8]}"
@@ -153,6 +246,9 @@ def _session(args) -> int:
         if args.session_cmd == "list":
             print(json.dumps(c.call("session_jobs")))
             return 0
+        if args.session_cmd == "info":
+            print(json.dumps(c.call("session_info")))
+            return 0
         if args.session_cmd == "cancel":
             resp = c.call("cancel_job", job_id=args.job_id)
             print(json.dumps(resp))
@@ -161,6 +257,12 @@ def _session(args) -> int:
         resp = c.call("stop_session")
         print(json.dumps(resp))
         return 0 if resp.get("ok") else 1
+    except RpcError as e:
+        # no reachable leader (after the --ha-dir retry budget): the
+        # cluster refused — a clean 1, never a traceback, so scripts
+        # can distinguish it from a usage error
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     finally:
         c.close()
 
@@ -249,6 +351,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                            "cluster (`session start`) instead of "
                            "spinning a private runtime; blocks until "
                            "the job is terminal (exit 0 = FINISHED)")
+    runp.add_argument("--ha-dir", default=None, metavar="DIR",
+                      help="with --session (or alone): resolve the "
+                           "session leader through the HA lease in "
+                           "DIR; the submit and every status poll "
+                           "re-resolve on connection failure, so the "
+                           "attach survives a dispatcher failover")
     runp.add_argument("--job-id", default=None)
     runp.add_argument("--runtime-mode", choices=("streaming", "batch"),
                       default=None,
@@ -338,29 +446,61 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "cluster; 0 = external runners register "
                          "themselves via python -m "
                          "flink_tpu.runtime.runner)")
+    st.add_argument("--ha-dir", default=None, metavar="DIR",
+                    help="shared HA directory (shorthand for --conf "
+                         "high-availability.dir=DIR): contend for the "
+                         "leadership lease and serve only while "
+                         "holding it; the durable session registry "
+                         "lives here too, so a standby takeover "
+                         "recovers every admitted job")
+    st.add_argument("--standby", action="store_true",
+                    help="hot-standby contender: block on the "
+                         "leadership lease in --ha-dir and take over "
+                         "(re-hydrating the session registry) when "
+                         "the incumbent's lease lapses")
     st.add_argument("--conf", action="append", default=[],
                     metavar="KEY=VALUE",
                     help="session.* quotas / autoscale knobs and any "
                          "other cluster config")
+    _HA_HELP = ("resolve the session leader through the HA lease in "
+                "DIR instead of (or as failover for) a fixed "
+                "--session address; connection failures re-resolve "
+                "and retry with a bounded budget")
     sb = ssub.add_parser(
         "submit", help="submit a job to a running session cluster "
                        "(exit 0 = admitted or queued, 1 = rejected)")
-    sb.add_argument("--session", required=True, metavar="HOST:PORT")
+    sb.add_argument("--session", metavar="HOST:PORT")
+    sb.add_argument("--ha-dir", default=None, metavar="DIR",
+                    help=_HA_HELP)
     sb.add_argument("--entry", required=True, metavar="MODULE:FUNCTION")
     sb.add_argument("--job-id", default=None)
     sb.add_argument("--conf", action="append", default=[],
                     metavar="KEY=VALUE")
     sl = ssub.add_parser(
         "list", help="per-job registry: state, slots, queue position, "
-                     "attempts, heartbeat-carried metrics")
-    sl.add_argument("--session", required=True, metavar="HOST:PORT")
+                     "attempts, heartbeat-carried metrics, leader "
+                     "epoch + takeover count")
+    sl.add_argument("--session", metavar="HOST:PORT")
+    sl.add_argument("--ha-dir", default=None, metavar="DIR",
+                    help=_HA_HELP)
+    si = ssub.add_parser(
+        "info", help="cluster view: runners with slot occupancy, "
+                     "quotas, leader epoch, takeover count, jobs "
+                     "recovered by the current leader")
+    si.add_argument("--session", metavar="HOST:PORT")
+    si.add_argument("--ha-dir", default=None, metavar="DIR",
+                    help=_HA_HELP)
     sc = ssub.add_parser("cancel", help="cancel one session job")
-    sc.add_argument("--session", required=True, metavar="HOST:PORT")
+    sc.add_argument("--session", metavar="HOST:PORT")
+    sc.add_argument("--ha-dir", default=None, metavar="DIR",
+                    help=_HA_HELP)
     sc.add_argument("job_id")
     sp_ = ssub.add_parser(
         "stop", help="shut the cluster down (cancels every "
                      "non-terminal job, then the dispatcher exits)")
-    sp_.add_argument("--session", required=True, metavar="HOST:PORT")
+    sp_.add_argument("--session", metavar="HOST:PORT")
+    sp_.add_argument("--ha-dir", default=None, metavar="DIR",
+                     help=_HA_HELP)
 
     logp = sub.add_parser(
         "log",
@@ -472,8 +612,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             conf["execution.runtime-mode"] = args.runtime_mode
         if args.local:
             return _run_local(args.entry, conf, job_id)
-        if args.session:
-            return _run_attached(args.session, args.entry, conf, job_id)
+        if args.session or args.ha_dir:
+            return _run_attached(args.session, args.entry, conf, job_id,
+                                 ha_dir=args.ha_dir)
         if not args.coordinator:
             raise SystemExit(
                 "run needs --coordinator, --session, or --local")

@@ -692,6 +692,26 @@ class SessionOptions:
         "A runner holding zero session slots for this long (with the "
         "fleet above session.min-runners) is drained and released by "
         "the autoscaler.")
+    HA_STANDBY = ConfigOption(
+        "session.ha.standby", False,
+        "Start this `session start` process as a hot-standby contender "
+        "(the --standby flag sets it): it contends for the leadership "
+        "lease in high-availability.dir and serves only once granted — "
+        "on takeover it re-hydrates the durable session registry, "
+        "re-queues undeployed jobs in original FIFO order, and waits "
+        "for runners to re-attach their live executions. Requires "
+        "high-availability.dir.")
+    HA_REATTACH_GRACE = duration_option(
+        "session.ha.reattach-grace", 10_000,
+        "How long a new leader waits for a recovered RUNNING job's "
+        "runner to re-register carrying it before falling back to a "
+        "blind redeploy with restore:latest. A stored runner that "
+        "re-registers WITHOUT the job collapses the window early (the "
+        "execution died there); a runner that re-attaches it ends the "
+        "wait with an in-place re-adoption (no redeploy, exactly-once "
+        "preserved). Lower it when runners re-resolve the leader fast "
+        "(small heartbeat.interval); raise it on congested fleets "
+        "where a blind double-deploy is costlier than a slow failover.")
 
 
 class AnalysisOptions:

@@ -100,6 +100,25 @@ Instrumented points (the stack's recovery-critical seams):
         (the SessionDispatcher admission seam: a raise there is a
         submission dying between RPC receipt and registry insert — the
         chaos gate for multi-tenant admission/queueing)
+    ha.lease.renew                                 runtime/ha.py
+        (the leader's lease-renewal seam: repeated raises are a leader
+        stalled past its lease — the contender thread survives but the
+        lease ages until a standby steals it, the induced-failover
+        chaos gate)
+    ha.store.write                                 runtime/ha.py
+        (the durable session/job registry write: a raise during
+        admission loses the submission CLEANLY — persisted-before-
+        registered means no half-admitted job — and a raise during a
+        lifecycle persist leaves the prior record intact, tmp+rename)
+    session.failover.takeover                      runtime/session.py
+        (takeover re-hydration of the session registry by a freshly
+        granted leader: a raise is a standby dying mid-takeover — the
+        serve loop retries construction, the lease keeps the epoch)
+    runner.reattach                                runtime/runner.py
+        (the runner's re-register-with-inventory push to a new leader:
+        a drop/raise is a lost re-attach — the next heartbeat miss
+        retries, so live executions still re-adopt instead of being
+        redeployed blind)
 
 Job-scoped plans (the session-cluster isolation contract): a runner
 process hosting N concurrent jobs cannot use the process-global plan —
@@ -174,6 +193,10 @@ KNOWN_FAULT_POINTS = frozenset((
     "log.group.commit",
     "host.pool.task",
     "session.admit",
+    "ha.lease.renew",
+    "ha.store.write",
+    "session.failover.takeover",
+    "runner.reattach",
 ))
 
 # process-global fault/recovery metrics — chaos tests assert every

@@ -82,6 +82,16 @@ class JobInfo:
     submitted_at: float = dataclasses.field(default_factory=time.time)
     started_at: Optional[float] = None
     finished_at: Optional[float] = None
+    # leader-takeover re-attach window (HA recovery of a job the store
+    # says was RUNNING): until ``reattach_until`` the new leader waits
+    # for a runner to re-register carrying (job_id, reattach_attempt)
+    # and re-adopts the live execution in place instead of redeploying
+    # blind; the window collapses early when one of the job's stored
+    # runners comes back WITHOUT it (the job died there). attempts is
+    # pre-bumped for the fallback redeploy; re-attach rolls it back.
+    reattach_until: Optional[float] = None
+    reattach_attempt: Optional[int] = None
+    reattach_runners: List[str] = dataclasses.field(default_factory=list)
 
 
 class JobCoordinator(RpcEndpoint):
@@ -96,6 +106,13 @@ class JobCoordinator(RpcEndpoint):
         self.config = config or Configuration()
         self.runners: Dict[str, RunnerInfo] = {}
         self.jobs: Dict[str, JobInfo] = {}
+        # leadership fencing token (HA serve loops stamp the election
+        # epoch here before the RPC server starts): every deploy/cancel/
+        # savepoint push to a runner carries it, and the runner rejects
+        # a lower epoch — a deposed leader's late RPCs land dead (the
+        # writer-lease fencing discipline of the bus, log/bus.py).
+        # 0 = non-HA single coordinator (pushes stay unstamped).
+        self.leader_epoch = 0
         self._slots = SlotPool()
         # active-resource seam (ref: ActiveResourceManager): unmet slot
         # demand is pushed here; standalone mode just records it
@@ -129,30 +146,72 @@ class JobCoordinator(RpcEndpoint):
         self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
         self._monitor.start()
 
+    def _required_devices_from_config(self, conf: Dict[str, Any]) -> int:
+        """Slot demand of a stored job record (the SessionDispatcher
+        overrides this to read the session slot quota instead)."""
+        spec = str(conf.get("cluster.mesh-devices", "") or "").strip()
+        return (SlotPool.ALL if spec == "all"
+                else max(1, int(spec)) if spec.isdigit() else 1)
+
     def _recover_from_store(self) -> None:
-        """Resume every non-terminal deployable job from the HA store:
-        parked WAITING_FOR_RESOURCES with a bumped attempt — the moment
-        runners (re-)register with this coordinator, the scheduler
-        deploys them and attempt>1 wires restore:latest (ref:
-        Dispatcher.recoverJobs → JobMaster restore from the
+        """Re-hydrate every non-terminal deployable job from the HA
+        store (ref: Dispatcher.recoverJobs → JobMaster restore from the
         CompletedCheckpointStore; checkpoint manifests are already
-        durable under the job's checkpoint dir)."""
+        durable under the job's checkpoint dir). Two classes:
+
+        - stored RUNNING/RESTARTING: the execution may STILL be live on
+          its runner (leader loss is not runner loss) — park with a
+          re-attach window so the runner re-registering with its
+          in-flight job ids re-adopts the attempt in place; only after
+          the window (or the runner coming back without the job) does
+          the bumped-attempt redeploy with restore:latest fire.
+        - stored WAITING_FOR_RESOURCES/CREATED: never deployed — re-
+          queue at the ORIGINAL attempt and the original submitted_at,
+          so the FIFO submission order survives the takeover.
+        """
+        from flink_tpu.config import SessionOptions
+
+        grace = self.config.get(SessionOptions.HA_REATTACH_GRACE) / 1000
+        now = time.time()
         for rec in self._store.recoverable():
             job_id = rec["job_id"]
-            attempts = int(rec.get("attempts", 1)) + 1
-            spec = str(rec.get("config", {}).get(
-                "cluster.mesh-devices", "") or "").strip()
-            required = (SlotPool.ALL if spec == "all"
-                        else max(1, int(spec)) if spec.isdigit() else 1)
-            self.jobs[job_id] = JobInfo(
-                job_id, state="WAITING_FOR_RESOURCES", attempts=attempts,
-                entry=rec.get("entry"), config=dict(rec.get("config", {})),
-                failure="recovered by new coordinator; awaiting runners",
+            stored_attempts = int(rec.get("attempts", 1))
+            conf = dict(rec.get("config", {}))
+            required = self._required_devices_from_config(conf)
+            was_live = rec.get("state") in ("RUNNING", "RESTARTING")
+            j = JobInfo(
+                job_id, state="WAITING_FOR_RESOURCES",
+                attempts=stored_attempts + 1 if was_live
+                else stored_attempts,
+                entry=rec.get("entry"), config=conf,
+                failure=("recovered by new leader; awaiting runner "
+                         "re-attach" if was_live
+                         else "recovered by new leader; re-queued"),
                 required_devices=required,
                 py_blobs=list(rec.get("py_blobs", [])),
                 egraph=ExecutionGraph(job_id, required))
+            if rec.get("submitted_at") is not None:
+                j.submitted_at = float(rec["submitted_at"])
+            if was_live:
+                j.reattach_attempt = stored_attempts
+                j.reattach_until = now + grace
+                j.reattach_runners = list(rec.get("assigned_runners", []))
+                # keep the stored assignment visible through the window:
+                # a cancel during it still routes to the runner that may
+                # hold the live execution
+                j.assigned_runners = list(j.reattach_runners)
+            self.jobs[job_id] = j
             self._strategies[job_id] = from_config(self.config)
-            self._persist_locked(self.jobs[job_id])
+            if not was_live:
+                self._persist_locked(j)
+            # was_live jobs are NOT re-persisted here: the stored
+            # RUNNING record (original attempt + runner) IS the durable
+            # truth that the execution may still be live — overwriting
+            # it with this leader's parked WAITING view would make a
+            # SECOND failover during the window recover the job as
+            # never-deployed and blind-redeploy beside the live
+            # attempt. The record advances only when something real
+            # happens: re-attach, redeploy, or a terminal transition.
 
     def _persist_locked(self, j: JobInfo) -> None:
         """Write-through to the HA job store (caller holds the lock or
@@ -163,15 +222,29 @@ class JobCoordinator(RpcEndpoint):
             return  # bookkeeping-only jobs are not recoverable
         self._store.put(j.job_id, entry=j.entry, config=j.config,
                         state=j.state, attempts=j.attempts,
-                        py_blobs=j.py_blobs)
+                        py_blobs=j.py_blobs,
+                        submitted_at=j.submitted_at,
+                        assigned_runners=j.assigned_runners)
 
     # -- rpc methods -----------------------------------------------------
     def rpc_register_runner(self, runner_id: str, host: str, n_devices: int,
-                            port: int = 0) -> dict:
+                            port: int = 0,
+                            jobs: Optional[List[Dict[str, Any]]] = None
+                            ) -> dict:
+        """``jobs`` is the runner's in-flight inventory
+        (``[{"job_id", "attempt"}, ...]``): after a leader takeover the
+        runner re-registers CARRYING it, so slot-pool occupancy is
+        rebuilt from truth — a live execution is re-adopted in place
+        (never redeployed blind) and its slots are re-allocated before
+        any queued job can claim them. Legacy registrations omit it
+        (None), which reads as 'carrying nothing'."""
         waiting: List[str] = []
         with self._lock:
             self.runners[runner_id] = RunnerInfo(
                 runner_id, host, n_devices, time.time(), port=port)
+            carried = {str(e.get("job_id")): int(e.get("attempt", 1))
+                       for e in (jobs or [])}
+            self._reattach_locked(runner_id, carried)
             # new capacity: kick jobs parked on WAITING_FOR_RESOURCES
             # (ref: AdaptiveScheduler WaitingForResources → Executing on
             # new slots)
@@ -179,7 +252,78 @@ class JobCoordinator(RpcEndpoint):
         for job_id in waiting:
             self._deploy_async(job_id)
         return {"heartbeat_interval_ms":
-                self.config.get(ClusterOptions.HEARTBEAT_INTERVAL)}
+                self.config.get(ClusterOptions.HEARTBEAT_INTERVAL),
+                "leader_epoch": self.leader_epoch}
+
+    def _reattach_locked(self, runner_id: str,
+                         carried: Dict[str, int]) -> None:
+        """Re-adopt recovered jobs a (re-)registering runner still
+        runs. For each job in its takeover re-attach window:
+
+        - the runner carries (job_id, attempt == reattach_attempt):
+          the execution is LIVE — re-allocate its slots on that runner,
+          roll the pre-bumped attempt back, mark RUNNING. No redeploy,
+          so committed output stays exactly-once across the takeover.
+        - the runner is one of the job's stored hosts but does NOT
+          carry it: the execution died there — collapse the window so
+          the checkpoint-restore redeploy fires now instead of waiting
+          out the grace.
+
+        Jobs the runner carries that this leader does not know (or
+        knows under a different attempt) are left to the heartbeat
+        revocation fence."""
+        for j in self.jobs.values():
+            if j.reattach_attempt is None:
+                continue
+            if j.state != "WAITING_FOR_RESOURCES":
+                # the window only re-adopts a job still PARKED by
+                # recovery: one canceled (or otherwise transitioned)
+                # during the window must never be resurrected to
+                # RUNNING by its returning runner — the heartbeat
+                # revocation fence stops the runner-side zombie
+                j.reattach_attempt = None
+                j.reattach_until = None
+                j.reattach_runners = []
+                continue
+            att = carried.get(j.job_id)
+            nproc = max(1, int(j.config.get("cluster.num-processes", 1)))
+            if nproc > 1:
+                # a cross-host job is only whole with ALL its process
+                # allocations; re-adopting through one runner's
+                # inventory would mis-account the rest — collapse to
+                # the restore redeploy once ANY stored runner returns
+                if runner_id in j.reattach_runners:
+                    j.reattach_attempt = None
+                    j.reattach_until = None
+                    j.reattach_runners = []
+                    j.assigned_runners = []
+                continue
+            if att is not None and att == j.reattach_attempt:
+                r = self.runners[runner_id]
+                resolved = (r.n_devices
+                            if j.required_devices == SlotPool.ALL
+                            else j.required_devices)
+                self._slots.release(j.job_id)
+                self._slots.allocate(j.job_id, runner_id, resolved)
+                j.attempts = j.reattach_attempt
+                j.state = "RUNNING"
+                j.failure = None
+                j.assigned_runners = [runner_id]
+                j.finished_runners = []
+                if j.started_at is None:
+                    j.started_at = time.time()
+                j.reattach_attempt = None
+                j.reattach_until = None
+                j.reattach_runners = []
+                if j.egraph is not None:
+                    j.egraph.start_attempt(j.attempts, runner_id)
+                    j.egraph.transition("RUNNING", attempt=j.attempts)
+                self._persist_locked(j)
+            elif runner_id in j.reattach_runners:
+                j.reattach_attempt = None
+                j.reattach_until = None
+                j.reattach_runners = []
+                j.assigned_runners = []
 
     def _waiting_locked(self) -> List[str]:
         return [j.job_id for j in self.jobs.values()
@@ -198,7 +342,8 @@ class JobCoordinator(RpcEndpoint):
         with self._lock:
             r = self.runners.get(runner_id)
             if r is None:
-                return {"known": False}  # re-register (coordinator restarted)
+                # re-register (coordinator restarted / new leader)
+                return {"known": False, "leader_epoch": self.leader_epoch}
             r.last_heartbeat = time.time()
             r.alive = True
             for jid, m in (metrics or {}).items():
@@ -219,7 +364,8 @@ class JobCoordinator(RpcEndpoint):
                         "CANCELED", "FAILED", "RESTARTING") or (
                         runner_id not in j.assigned_runners):
                     revoked.append(job_id)
-        return {"known": True, "revoked_jobs": revoked}
+        return {"known": True, "revoked_jobs": revoked,
+                "leader_epoch": self.leader_epoch}
 
     def rpc_submit_job(self, job_id: str, runners: Optional[List[str]] = None,
                        entry: Optional[str] = None,
@@ -295,6 +441,21 @@ class JobCoordinator(RpcEndpoint):
             if (j.state == "RUNNING"
                     and self._slots.allocation(job_id) is not None):
                 return
+            # takeover re-attach window: the execution may still be
+            # LIVE on its pre-takeover runner — a blind redeploy here
+            # would run the job twice. Deploy kicks defer until the
+            # runner re-attaches it, comes back without it, or the
+            # grace expires (the monitor loop re-kicks then).
+            if j.reattach_until is not None:
+                if time.time() < j.reattach_until:
+                    j.state = "WAITING_FOR_RESOURCES"
+                    j.failure = ("awaiting runner re-attach after "
+                                 "leader takeover")
+                    return
+                j.reattach_attempt = None
+                j.reattach_until = None
+                j.reattach_runners = []
+                j.assigned_runners = []
             # session-mode admission seam (runtime/session.py): the
             # base coordinator admits every deploy; a SessionDispatcher
             # parks jobs past its max-jobs headroom back on the queue.
@@ -405,6 +566,12 @@ class JobCoordinator(RpcEndpoint):
             # the runner the failure handler blames/excludes must be the
             # one whose push actually failed, not the primary
             deploy_target = target
+            # the LEADER epoch fences the control plane the way the
+            # attempt epoch fences storage: a deposed leader's late
+            # deploy is rejected at the runner. Only stamped under HA
+            # (epoch > 0) so non-HA wire traffic is unchanged.
+            fence = ({"leader_epoch": self.leader_epoch}
+                     if self.leader_epoch > 0 else {})
             for i, t in enumerate(push_targets):
                 deploy_target = t
                 pconf = dict(config)
@@ -433,7 +600,7 @@ class JobCoordinator(RpcEndpoint):
                     resp = c.call("run_job", job_id=job_id, entry=entry,
                                   config=pconf, attempt=attempt,
                                   deploy_token=secrets.token_hex(8),
-                                  **extra)
+                                  **fence, **extra)
                 finally:
                     c.close()
                 if not resp.get("accepted"):
@@ -496,6 +663,13 @@ class JobCoordinator(RpcEndpoint):
                 j.finished_at = time.time()
                 j.pending_rescale = None
                 j.rescale_token = None
+                # a cancel during the takeover re-attach window closes
+                # it: the returning runner's inventory must not
+                # resurrect the job, and the monitor must not kick a
+                # redeploy for it
+                j.reattach_attempt = None
+                j.reattach_until = None
+                j.reattach_runners = []
                 self._slots.release(job_id)
                 if j.egraph is not None:
                     j.egraph.transition("CANCELED")
@@ -517,11 +691,15 @@ class JobCoordinator(RpcEndpoint):
         race ahead and kill the redeployed attempt on the same runner."""
         from flink_tpu.runtime.rpc import RpcClient, RpcError
 
+        epoch = self.leader_epoch
+
         def push() -> None:
             try:
                 c = RpcClient(runner.host, runner.port, timeout_s=5.0)
                 try:
                     kw = {"attempt": attempt} if attempt is not None else {}
+                    if epoch > 0:
+                        kw["leader_epoch"] = epoch
                     c.call("cancel_job", job_id=job_id, **kw)
                 finally:
                     c.close()
@@ -654,6 +832,9 @@ class JobCoordinator(RpcEndpoint):
         if not targets:
             return {"ok": False, "reason": "no reachable runner"}
 
+        fence = ({"leader_epoch": self.leader_epoch}
+                 if self.leader_epoch > 0 else {})
+
         def push() -> None:
             from flink_tpu.runtime.rpc import RpcClient, RpcError
 
@@ -662,7 +843,7 @@ class JobCoordinator(RpcEndpoint):
                     c = RpcClient(r.host, r.port, timeout_s=5.0)
                     try:
                         resp = c.call("trigger_savepoint", job_id=job_id,
-                                      stop=stop, token=token)
+                                      stop=stop, token=token, **fence)
                     finally:
                         c.close()
                     if resp.get("ok"):
@@ -909,6 +1090,21 @@ class JobCoordinator(RpcEndpoint):
             time.sleep(min(self._hb_timeout / 5, 1.0))
             now = time.time()
             redeploys = []  # (job_id, delay_ms, lost_runner)
+            expired: List[str] = []
+            with self._lock:
+                # takeover re-attach windows that ran out: the stored
+                # runner never came back — fall through to the normal
+                # checkpoint-restore redeploy (attempt is pre-bumped)
+                for j in self.jobs.values():
+                    if (j.reattach_until is not None
+                            and now >= j.reattach_until):
+                        j.reattach_attempt = None
+                        j.reattach_until = None
+                        j.reattach_runners = []
+                        j.assigned_runners = []
+                        expired.append(j.job_id)
+            for job_id in expired:
+                self._deploy_async(job_id)
             with self._lock:
                 for r in self.runners.values():
                     if r.alive and now - r.last_heartbeat > self._hb_timeout:
@@ -1007,7 +1203,13 @@ def main(argv: Optional[list] = None) -> None:
             grant_evt.clear()
             revoke_evt.clear()
             print(f"elected leader (epoch {election.epoch})", flush=True)
-            server = start_coordinator(conf, port=args.port)
+            # fencing: every runner push from this incumbency carries
+            # the election epoch; a deposed leader's late RPCs are
+            # rejected at the runner. Stamped BETWEEN construction and
+            # serving, so no push can ever leave unstamped.
+            endpoint = JobCoordinator(conf)
+            endpoint.leader_epoch = election.epoch
+            server = RpcServer(endpoint, args.port)
             rest = serve_forever(server)
             revoke_evt.wait()  # leadership lost: stop serving
             print("leadership revoked; closing", flush=True)

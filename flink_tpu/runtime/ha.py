@@ -28,7 +28,8 @@ import time
 import uuid
 from typing import Callable, Dict, List, Optional
 
-__all__ = ["LeaderElection", "JobStore", "leader_address"]
+__all__ = ["LeaderElection", "JobStore", "leader_address",
+           "takeover_count"]
 
 
 @dataclasses.dataclass
@@ -71,30 +72,91 @@ class LeaderElection:
 
     # -- lease file primitives ------------------------------------------
     def _read(self) -> Optional[LeaderRecord]:
+        return self._read_path(self._lease)
+
+    @staticmethod
+    def _read_path(path: str) -> Optional[LeaderRecord]:
         try:
-            with open(self._lease) as f:
+            with open(path) as f:
                 d = json.load(f)
             return LeaderRecord(d["leader_id"], d["address"],
                                 int(d["epoch"]), float(d["claimed_at"]))
         except (OSError, ValueError, KeyError):
             return None
 
-    def _write(self, rec: LeaderRecord, *, exclusive: bool) -> bool:
+    def _claim_exclusive(self, rec: LeaderRecord) -> bool:
+        """Claim an ABSENT lease with O_CREAT|O_EXCL (atomic on POSIX):
+        of N racing claimers exactly one wins. The written record
+        (leader_id + epoch) is the claim's identity — release and
+        revoke checks compare content, never inodes (which local
+        filesystems recycle instantly)."""
         payload = json.dumps(dataclasses.asdict(rec)).encode()
-        if exclusive:
-            try:
-                fd = os.open(self._lease,
-                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-            except FileExistsError:
-                return False
-            with os.fdopen(fd, "wb") as f:
-                f.write(payload)
-            return True
-        tmp = self._lease + f".{self.leader_id}.tmp"
-        with open(tmp, "wb") as f:
+        try:
+            fd = os.open(self._lease,
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        with os.fdopen(fd, "wb") as f:
             f.write(payload)
-        os.replace(tmp, self._lease)  # atomic steal/renew
         return True
+
+    def _steal_stale(self, cur: LeaderRecord) -> None:
+        """Break a stale incumbent's lease with the rename-first
+        discipline (the bus writer-lease rule, log/topic.py
+        _break_stale_lock): rename the stale file to a unique grave
+        name FIRST — the rename is atomic, so of two racing breakers
+        exactly one wins and the loser can never unlink the fresh
+        lease the winner claims a moment later. The renamed file is
+        identity-checked: if it is NOT the stale record this breaker
+        observed (a peer already broke + re-claimed), it is restored
+        via link() — which cannot clobber an even newer claim — and
+        the steal aborts."""
+        # floor the fencing token BEFORE the lease disappears: a third
+        # contender claiming the now-absent lease continues from the
+        # high-water mark, never below the stale incumbent's epoch
+        self._record_hwm(cur.epoch)
+        grave = f"{self._lease}.stale-{uuid.uuid4().hex[:8]}"
+        try:
+            os.rename(self._lease, grave)
+        except OSError:
+            return  # another breaker won the rename
+        took = self._read_path(grave)
+        if (took is None or took.leader_id != cur.leader_id
+                or took.epoch != cur.epoch
+                or took.claimed_at != cur.claimed_at):
+            # we renamed a FRESH lease a faster breaker just claimed:
+            # put it back (link-first: if yet another claim landed in
+            # the window, the restore fails instead of clobbering it —
+            # the hwm keeps epochs monotone either way)
+            try:
+                os.link(grave, self._lease)
+            except OSError:
+                pass
+            try:
+                os.unlink(grave)
+            except OSError:
+                pass
+            return
+        os.unlink(grave)
+        epoch = max(cur.epoch, self._epoch_hwm()) + 1
+        if self._claim_exclusive(LeaderRecord(
+                self.leader_id, self.address, epoch, time.time())):
+            # a successful STEAL is a takeover; a fresh claim after a
+            # clean handover is not (the epoch advances in both cases,
+            # so epoch arithmetic cannot tell them apart — this
+            # durable counter can)
+            self._bump_takeovers()
+            self._granted(epoch)
+
+    def _bump_takeovers(self) -> None:
+        path = os.path.join(self.ha_dir, "takeovers.count")
+        tmp = path + f".{self.leader_id}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                f.write(str(takeover_count(self.ha_dir) + 1))
+            os.replace(tmp, path)
+        except OSError:
+            pass  # observability counter: never fail a takeover over it
 
     def _lease_age(self) -> float:
         try:
@@ -153,6 +215,14 @@ class LeaderElection:
                 if self.on_revoke:
                     self.on_revoke()
             else:
+                from flink_tpu import faults
+
+                # the renewal seam: an injected OSError here is a
+                # leader stalling past its lease (NFS blip, frozen
+                # process) — the contender thread survives (the _run
+                # guard) but the lease ages toward a standby's steal
+                faults.fire("ha.lease.renew", exc=OSError,
+                            leader=self.leader_id)
                 os.utime(self._lease)  # renew
         else:
             cur = self._read()
@@ -161,22 +231,15 @@ class LeaderElection:
                 # after a clean handover continues from the recorded
                 # high-water mark, not from 1
                 epoch = self._epoch_hwm() + 1
-                got = self._write(LeaderRecord(
-                    self.leader_id, self.address, epoch, time.time()),
-                    exclusive=True)
-                if got:
+                if self._claim_exclusive(LeaderRecord(
+                        self.leader_id, self.address, epoch,
+                        time.time())):
                     self._granted(epoch)
             elif (cur.leader_id != self.leader_id
                   and self._lease_age() > self.lease_timeout_s):
-                # stale incumbent: steal with a higher epoch
-                self._write(LeaderRecord(
-                    self.leader_id, self.address,
-                    max(cur.epoch, self._epoch_hwm()) + 1,
-                    time.time()), exclusive=False)
-                # confirm we won the replace race
-                again = self._read()
-                if again and again.leader_id == self.leader_id:
-                    self._granted(again.epoch)
+                # stale incumbent: rename-first break, then exclusive
+                # re-claim with a higher epoch (see _steal_stale)
+                self._steal_stale(cur)
 
     def _granted(self, epoch: int) -> None:
         self.is_leader = True
@@ -190,10 +253,50 @@ class LeaderElection:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
         if self.is_leader:
-            try:
-                os.remove(self._lease)  # clean handover
-            except OSError:
-                pass
+            self._release_if_ours()
+
+    def _release_if_ours(self) -> None:
+        """Clean handover, identity-checked: the lease is removed ONLY
+        if it still carries THIS incumbency's record (leader_id +
+        epoch — inode numbers recycle instantly on local filesystems,
+        so content is the identity; a blind remove could unlink the
+        fresh lease of a contender that stole ours while we stalled).
+        Rename-first like the steal, with a post-rename re-check that
+        restores a raced replacement."""
+        try:
+            rec = self._read()
+            if (rec is None or rec.leader_id != self.leader_id
+                    or rec.epoch != self.epoch):
+                return  # replaced: it is someone else's lease now
+            grave = f"{self._lease}.rel-{uuid.uuid4().hex[:8]}"
+            os.rename(self._lease, grave)
+            took = self._read_path(grave)
+            if (took is not None and took.leader_id == self.leader_id
+                    and took.epoch == self.epoch):
+                os.unlink(grave)
+            else:
+                # raced between read and rename: restore the thief's
+                # lease (link-first — cannot clobber a newer claim)
+                try:
+                    os.link(grave, self._lease)
+                except OSError:
+                    pass
+                os.unlink(grave)
+        except OSError:
+            pass
+
+
+def takeover_count(ha_dir: str) -> int:
+    """How many times leadership in ``ha_dir`` was TAKEN OVER (a
+    contender stealing a lapsed lease). Clean stop/restart cycles do
+    not count — the fencing epoch advances on those too, so epoch
+    arithmetic over-reports; this durable counter is what `session
+    info`/`list` surface as ``takeovers``."""
+    try:
+        with open(os.path.join(ha_dir, "takeovers.count")) as f:
+            return int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return 0
 
 
 def leader_address(ha_dir: str) -> Optional[str]:
@@ -228,16 +331,31 @@ class JobStore:
 
     def put(self, job_id: str, *, entry: Optional[str], config: Dict,
             state: str, attempts: int,
-            py_blobs: Optional[List[Dict]] = None) -> None:
+            py_blobs: Optional[List[Dict]] = None,
+            submitted_at: Optional[float] = None,
+            assigned_runners: Optional[List[str]] = None) -> None:
         """Active jobs live in jobs/; a terminal write MOVES the record
         to jobs-archive/ so leader recovery never scans or parses
         finished history (ref: JobGraphStore removes terminal graphs;
-        ExecutionGraphInfoStore keeps the archived view)."""
+        ExecutionGraphInfoStore keeps the archived view).
+
+        ``submitted_at`` makes the FIFO submission-queue position
+        durable (a new leader re-queues undeployed jobs in original
+        order); ``assigned_runners`` records WHERE a RUNNING job lives
+        so the new leader can wait for that runner to re-attach it
+        instead of redeploying blind (tmp + rename keeps every write
+        atomic — readers see the old or new record whole)."""
+        from flink_tpu import faults
+
+        faults.fire("ha.store.write", exc=OSError, job=job_id,
+                    state=state)
         terminal = state in self.TERMINAL
         dst = self._archive_path(job_id) if terminal else self._path(job_id)
         rec = {"job_id": job_id, "entry": entry, "config": config,
                "state": state, "attempts": attempts,
-               "py_blobs": list(py_blobs or [])}
+               "py_blobs": list(py_blobs or []),
+               "submitted_at": submitted_at,
+               "assigned_runners": list(assigned_runners or [])}
         tmp = dst + ".tmp"
         with open(tmp, "w") as f:
             json.dump(rec, f)

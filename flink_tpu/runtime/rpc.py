@@ -130,6 +130,14 @@ class RpcServer:
 
     def close(self) -> None:
         self._server.shutdown()
+        # close the LISTENER too: shutdown() only stops the accept
+        # loop, leaving the bound socket accepting connections that no
+        # one will ever answer — peers of a dead endpoint would hang
+        # out their full RPC timeout instead of failing fast
+        # (connection refused), stretching HA failover detection from
+        # milliseconds to multiples of the timeout, and a revoked
+        # leader could never rebind its own port on re-grant
+        self._server.server_close()
         self._calls.put(None)
 
 

@@ -96,6 +96,12 @@ class TaskRunner(RpcEndpoint):
         self._coord = RpcClient(coordinator_host, coordinator_port,
                                 timeout_s=5.0, retries=0)
         self._jobs: Dict[str, Dict[str, Any]] = {}  # job_id -> {cancel, thread}
+        # highest leader epoch this runner has acknowledged (register /
+        # heartbeat responses carry it under HA): deploy/cancel/
+        # savepoint RPCs stamped with a LOWER epoch come from a deposed
+        # leader and are rejected — the control-plane fencing mirror of
+        # the bus writer-lease epochs. 0 = non-HA (unstamped RPCs pass).
+        self._leader_epoch = 0
         # (job_id, attempt, deploy_token) triples whose execution
         # already COMPLETED on this runner: a deploy RPC retried after
         # the response was lost re-sends the SAME token and must be
@@ -126,12 +132,30 @@ class TaskRunner(RpcEndpoint):
             host="127.0.0.1",
             n_devices=len(jax.devices()),
             port=self._server.port,
+            jobs=self._carried_jobs(),
         )
+        self._note_epoch(resp)
         interval = resp.get("heartbeat_interval_ms", 10_000) / 1000
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, args=(interval,), daemon=True)
         self._hb_thread.start()
         return self._server.port
+
+    def _carried_jobs(self) -> list:
+        """In-flight inventory shipped with every (re-)registration:
+        the new leader rebuilds slot occupancy from it and re-adopts
+        live executions instead of redeploying them blind."""
+        with self._lock:
+            return [{"job_id": jid, "attempt": rec["attempt"]}
+                    for jid, rec in self._jobs.items()]
+
+    def _note_epoch(self, resp: dict) -> None:
+        try:
+            e = int(resp.get("leader_epoch", 0) or 0)
+        except (TypeError, ValueError):
+            return
+        if e > self._leader_epoch:
+            self._leader_epoch = e
 
     def _heartbeat_loop(self, interval: float) -> None:
         misses = 0
@@ -156,6 +180,7 @@ class TaskRunner(RpcEndpoint):
                 r = self._coord.call("heartbeat", runner_id=self.runner_id,
                                      jobs=running, metrics=metrics)
                 misses = 0
+                self._note_epoch(r)
                 # revocation: jobs the coordinator no longer considers
                 # ours (reassigned after a false-positive loss, or
                 # terminal) must stop producing output here — the
@@ -167,19 +192,28 @@ class TaskRunner(RpcEndpoint):
                         if j is not None:
                             j["cancel"].set()
                 if not r.get("known"):
-                    # coordinator restarted: re-register (ref:
-                    # TaskExecutor re-connect to ResourceManager)
+                    # coordinator restarted: re-register CARRYING the
+                    # in-flight jobs (ref: TaskExecutor re-connect to
+                    # ResourceManager; a new leader on the same address
+                    # re-attaches them from this inventory)
                     import jax
 
-                    self._coord.call(
+                    faults.fire("runner.reattach", exc=RpcError,
+                                runner=self.runner_id)
+                    self._note_epoch(self._coord.call(
                         "register_runner", runner_id=self.runner_id,
                         host="127.0.0.1",
                         n_devices=len(jax.devices()),
-                        port=self._server.port if self._server else 0)
-            except RpcError:
-                # transient; next beat retries. In HA mode a coordinator
-                # that stays unreachable has likely lost leadership —
-                # re-resolve the lease and follow the new leader (ref:
+                        port=self._server.port if self._server else 0,
+                        jobs=self._carried_jobs()))
+            except (RpcError, ConnectionError):
+                # transient (ConnectionError: an injected transport
+                # drop fires BEFORE the client's RpcError wrapping —
+                # the beat loop must survive it, a dead heartbeat
+                # thread never follows a new leader). Next beat
+                # retries. In HA mode a coordinator that stays
+                # unreachable has likely lost leadership — re-resolve
+                # the lease and follow the new leader (ref:
                 # TaskExecutor re-connecting after JM leader change)
                 misses += 1
                 if self._ha_dir and misses >= 2:
@@ -196,14 +230,26 @@ class TaskRunner(RpcEndpoint):
         if (host, int(port)) == self._coord_addr:
             return  # same leader; outage was transient
         try:
+            from flink_tpu import faults
+
+            # the takeover re-attach seam: an injected failure here is
+            # a lost re-registration — the next heartbeat miss retries
+            # it, so the inventory eventually lands on the new leader
+            faults.fire("runner.reattach", exc=RpcError,
+                        runner=self.runner_id)
             new = RpcClient(host, int(port), timeout_s=5.0, retries=0)
             import jax
 
-            new.call("register_runner", runner_id=self.runner_id,
-                     host="127.0.0.1", n_devices=len(jax.devices()),
-                     port=self._server.port if self._server else 0)
-        except RpcError:
-            return  # new leader not serving yet; retry next beat
+            self._note_epoch(new.call(
+                "register_runner", runner_id=self.runner_id,
+                host="127.0.0.1", n_devices=len(jax.devices()),
+                port=self._server.port if self._server else 0,
+                jobs=self._carried_jobs()))
+        except (RpcError, ConnectionError):
+            # new leader not serving yet, or the re-attach push was
+            # dropped (runner.reattach chaos): retry next beat — the
+            # inventory eventually lands
+            return
         old = self._coord
         self._coord_addr = (host, int(port))
         self._coord = new
@@ -227,16 +273,39 @@ class TaskRunner(RpcEndpoint):
     def rpc_ping(self) -> dict:
         return {"runner_id": self.runner_id, "jobs": list(self._jobs)}
 
+    def _fence_leader_epoch(self, leader_epoch: Optional[int]
+                            ) -> Optional[str]:
+        """Leader-epoch gate (caller holds the lock): a control RPC
+        stamped with a LOWER epoch than this runner has acknowledged
+        comes from a deposed leader — reject it so a stale dispatcher's
+        late deploy/cancel can never land after a takeover (mirrors
+        the bus writer-lease fencing). Unstamped RPCs (non-HA, tests)
+        pass; a HIGHER epoch is adopted (the push may arrive before
+        the first heartbeat response from the new leader)."""
+        if leader_epoch is None:
+            return None
+        e = int(leader_epoch)
+        if e < self._leader_epoch:
+            return (f"stale leader epoch {e} < {self._leader_epoch} "
+                    "(deposed leader fenced)")
+        if e > self._leader_epoch:
+            self._leader_epoch = e
+        return None
+
     def rpc_run_job(self, job_id: str, entry: str,
                     config: Optional[dict] = None,
                     attempt: int = 1,
                     py_blobs: Optional[list] = None,
-                    deploy_token: Optional[str] = None) -> dict:
+                    deploy_token: Optional[str] = None,
+                    leader_epoch: Optional[int] = None) -> dict:
         """Deploy a job: import ``module:function``, build the pipeline,
         execute. The entry-point contract is the job-jar analogue — the
         job's code must be importable on the runner host (ref:
         TaskExecutor.submitTask + TaskDeploymentDescriptor)."""
         with self._lock:
+            stale = self._fence_leader_epoch(leader_epoch)
+            if stale is not None:
+                return {"accepted": False, "reason": stale}
             if (deploy_token is not None and (job_id, attempt,
                                               deploy_token)
                     in self._done_attempts):
@@ -284,12 +353,18 @@ class TaskRunner(RpcEndpoint):
         return {"accepted": True, "runner_id": self.runner_id}
 
     def rpc_cancel_job(self, job_id: str,
-                       attempt: Optional[int] = None) -> dict:
+                       attempt: Optional[int] = None,
+                       leader_epoch: Optional[int] = None) -> dict:
         """``attempt`` is a fencing token: a cancel aimed at attempt N
         must not kill attempt N+1 that superseded it on this runner
         (the rescale stop→redeploy race; ref: execution attempt ids
-        fencing cancelTask). None = cancel whatever runs (user cancel)."""
+        fencing cancelTask). None = cancel whatever runs (user cancel).
+        ``leader_epoch`` fences a deposed leader's late cancel the same
+        way run_job's is fenced."""
         with self._lock:
+            stale = self._fence_leader_epoch(leader_epoch)
+            if stale is not None:
+                return {"ok": False, "reason": stale}
             j = self._jobs.get(job_id)
             if j is None:
                 return {"ok": False, "reason": "unknown job"}
@@ -299,7 +374,8 @@ class TaskRunner(RpcEndpoint):
         return {"ok": True}
 
     def rpc_trigger_savepoint(self, job_id: str, stop: bool = False,
-                              token: Optional[str] = None) -> dict:
+                              token: Optional[str] = None,
+                              leader_epoch: Optional[int] = None) -> dict:
         """Request a savepoint at the job's next batch boundary (ref:
         the CLI `flink savepoint` → JobMaster.triggerSavepoint path).
         Rejected up front when the job has no checkpoint storage — a
@@ -309,6 +385,9 @@ class TaskRunner(RpcEndpoint):
         from flink_tpu.config import CheckpointingOptions, Configuration
 
         with self._lock:
+            stale = self._fence_leader_epoch(leader_epoch)
+            if stale is not None:
+                return {"ok": False, "reason": stale}
             j = self._jobs.get(job_id)
             if j is None:
                 return {"ok": False, "reason": "unknown job"}

@@ -41,14 +41,24 @@ Pieces:
   --local-runners` backing, the bench ``--concurrent-jobs`` harness,
   and the tier-1 e2e surface.
 
-Honest scope: ONE dispatcher process (no HA failover of the dispatcher
-itself — runners and jobs survive it only through the coordinator's
-existing HA store when configured); slots are logical admission units,
-not cgroup/HBM partitions — the enforced shares are the host-pool
-worker count and in-flight step credit (``session.concurrent-jobs``
-division in the driver) plus the fair drain turnstile; session jobs
-are single-runner (``cluster.num-processes > 1`` stays on the per-job
-submit path).
+HA (ISSUE 11): with ``high-availability.dir`` set, ``serve_session``
+runs the contend → serve → revoke leader cycle over the shared-file
+lease (``runtime/ha.py``); every admission persists the job — entry,
+config, quota, FIFO position — to the durable registry BEFORE it
+returns, a standby (``session start --standby``) takes over on lease
+lapse, re-queues undeployed jobs in original order, and re-attaches
+still-live executions that runners carry back (epoch-fenced: a deposed
+leader's late deploy/cancel is rejected at the runner).
+
+Honest scope: consensus is the shared filesystem (one lease directory
+all contenders and runners can reach — no quorum protocol, no
+cross-region HA); failover latency is bounded below by the lease
+timeout + runner heartbeat re-resolution; slots are logical admission
+units, not cgroup/HBM partitions — the enforced shares are the
+host-pool worker count and in-flight step credit
+(``session.concurrent-jobs`` division in the driver) plus the fair
+drain turnstile; session jobs are single-runner
+(``cluster.num-processes > 1`` stays on the per-job submit path).
 """
 from __future__ import annotations
 
@@ -207,7 +217,22 @@ class SessionDispatcher(JobCoordinator):
                 f"(got {self.runner_slots}, {self.max_jobs}) — the plan "
                 "analyzer flags this at analyze time "
                 "(SESSION_QUOTA_INVALID)")
+        # set BEFORE super().__init__: _recover_from_store runs inside
+        # it and records how many jobs this incumbency re-hydrated
+        self.recovered_jobs = 0
         super().__init__(config)
+        # takeover count comes from the durable HA-dir counter bumped
+        # at each lease STEAL — NOT from epoch arithmetic, which would
+        # count clean stop/restart cycles as takeovers
+        from flink_tpu.config import HighAvailabilityOptions
+
+        ha_dir = str(config.get(HighAvailabilityOptions.HA_DIR)).strip()
+        if ha_dir:
+            from flink_tpu.runtime.ha import takeover_count
+
+            self.takeovers = takeover_count(ha_dir)
+        else:
+            self.takeovers = 0
         # swap the device-exclusive pool for the logical-slot pool; the
         # inherited deploy/drain machinery only sees the SlotPool shape
         self._slots = SessionSlotPool(self.runner_slots)
@@ -233,6 +258,28 @@ class SessionDispatcher(JobCoordinator):
             self._autoscale_thread = threading.Thread(
                 target=self._autoscale_loop, daemon=True)
             self._autoscale_thread.start()
+
+    # -- HA takeover -----------------------------------------------------
+    def _required_devices_from_config(self, conf: dict) -> int:
+        """Recovered session jobs demand their SLOT quota, not a
+        device count (the stored config carries the admission-stamped
+        session.slots-per-job)."""
+        if "session.slots-per-job" in conf:
+            return max(1, int(conf["session.slots-per-job"]))
+        return super()._required_devices_from_config(conf)
+
+    def _recover_from_store(self) -> None:
+        """Takeover re-hydration (the Dispatcher.recoverJobs leg of a
+        failover): the inherited recovery re-queues undeployed jobs in
+        original FIFO order (durable submitted_at) and opens re-attach
+        windows for jobs whose executions may still be live on their
+        runners. The fault point is the chaos gate for a standby dying
+        mid-takeover — the serve loop retries construction."""
+        from flink_tpu import faults
+
+        faults.fire("session.failover.takeover")
+        super()._recover_from_store()
+        self.recovered_jobs = len(self.jobs)
 
     # -- admission -------------------------------------------------------
     @staticmethod
@@ -295,10 +342,25 @@ class SessionDispatcher(JobCoordinator):
             if existing is not None and existing.state in (
                     "RUNNING", "RESTARTING", "WAITING_FOR_RESOURCES",
                     "CREATED"):
+                if existing.entry == entry:
+                    # the same submission re-delivered: the HA client
+                    # retries a submit whose RESPONSE died with the
+                    # leader (the admission itself was durably
+                    # persisted before the crash), and a takeover-
+                    # recovered job re-submitted through the new
+                    # leader is the same case — ack it instead of
+                    # failing a script whose job is in fact admitted
+                    # and running. A job id is an identity: same id +
+                    # same entry IS the same job.
+                    return {"admitted": True, "job_id": job_id,
+                            "slots": int(existing.config.get(
+                                "session.slots-per-job", slots)),
+                            "duplicate": True, "queued_behind": []}
                 self._c_rejected.inc()
                 return {"admitted": False,
                         "reason": f"job id {job_id!r} is already active "
-                                  f"({existing.state})"}
+                                  f"({existing.state}) with a different "
+                                  "entry point"}
             conf["session.slots-per-job"] = slots
             # checkpoint isolation: every tenant gets its own directory
             # subtree — a job restoring 'latest' can only ever see its
@@ -319,9 +381,15 @@ class SessionDispatcher(JobCoordinator):
                           required_devices=slots,
                           py_blobs=list(py_blobs or []),
                           egraph=ExecutionGraph(job_id, slots))
+            # the DURABLE registry write comes FIRST: admission only
+            # returns (and the registry only gains the job) once the
+            # entry/config/quota AND its FIFO queue position
+            # (submitted_at) are on disk — a store failure here loses
+            # the submission cleanly, never half-registers it, and a
+            # leader crash one instruction later still recovers the job
+            self._persist_locked(job)
             self.jobs[job_id] = job
             self._strategies[job_id] = from_config(self.config)
-            self._persist_locked(job)
             queued_behind = [
                 j.job_id for j in self.jobs.values()
                 if j.entry is not None and j.job_id != job_id
@@ -416,7 +484,8 @@ class SessionDispatcher(JobCoordinator):
                     "metrics": j.last_metrics,
                 })
         jobs.sort(key=lambda r: r["submitted_at"])
-        return {"jobs": jobs}
+        return {"jobs": jobs, "leader_epoch": self.leader_epoch,
+                "takeovers": self.takeovers}
 
     def rpc_session_info(self) -> dict:
         with self._lock:
@@ -436,6 +505,13 @@ class SessionDispatcher(JobCoordinator):
             "quotas": {"slots-per-job": self.default_slots,
                        "runner-slots": self.runner_slots,
                        "max-jobs": self.max_jobs},
+            # leadership view: the fencing epoch of this incumbency,
+            # how many lease STEALS the HA domain has seen (clean
+            # restarts advance the epoch but are not takeovers), and
+            # how many jobs THIS leader re-hydrated at grant
+            "leader_epoch": self.leader_epoch,
+            "takeovers": self.takeovers,
+            "recovered_jobs": self.recovered_jobs,
             "metrics": self.registry.snapshot(),
         }
 
@@ -620,35 +696,163 @@ class LocalSessionCluster:
         self.close()
 
 
+def _drain_stop(disp: SessionDispatcher) -> None:
+    """Stop acknowledged: give the in-flight RPC response and the
+    runners' cancel pushes a moment to settle before teardown."""
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        with disp._lock:
+            busy = any(j.state in ("RUNNING", "RESTARTING")
+                       for j in disp.jobs.values())
+        if not busy:
+            break
+        time.sleep(0.1)
+    time.sleep(0.3)
+
+
+def _build_dispatcher(config: Configuration,
+                      retries: int = 3) -> SessionDispatcher:
+    """Construct the dispatcher with bounded retries: takeover
+    re-hydration reads shared storage (and hosts the
+    ``session.failover.takeover`` fault point) — a transient failure
+    there must not burn the whole incumbency. Quota errors are
+    permanent and re-raise immediately."""
+    last: Optional[Exception] = None
+    for i in range(retries):
+        try:
+            return SessionDispatcher(config)
+        except ValueError:
+            raise  # bad quotas: retrying cannot help
+        except Exception as e:  # noqa: BLE001 — shared-fs transients
+            last = e
+            time.sleep(0.2 * (i + 1))
+    raise last  # type: ignore[misc]
+
+
 def serve_session(config: Configuration, port: int = 0,
-                  local_runners: int = 0) -> int:
+                  local_runners: int = 0, standby: bool = False) -> int:
     """`python -m flink_tpu session start` body: serve a dispatcher
     (optionally with in-process local runners) until `session stop`
     arrives or the process is interrupted. Prints ONE json line with
-    the serving address first — scripts read it to find the port."""
-    import json
+    the serving address first — scripts read it to find the port.
 
-    cluster = LocalSessionCluster(config, runners=local_runners,
-                                  port=port)
-    print(json.dumps({"session": cluster.address, "port": cluster.port,
-                      "runners": local_runners}), flush=True)
-    disp = cluster.dispatcher
-    try:
-        while not disp.stop_event.wait(0.2):
+    With ``high-availability.dir`` set, the process runs the
+    contend → serve-while-leader → revoke-and-stop-serving cycle
+    (the coordinator.py main() discipline): N contenders (``--standby``
+    documents the intent) share one lease directory; on grant the new
+    leader re-hydrates the durable session registry, re-queues
+    undeployed jobs in original FIFO order, and waits for runners to
+    re-attach live executions before any redeploy. A revoked leader
+    tears its endpoint down — a stalled process that lost its lease
+    must not keep accepting work (split-brain)."""
+    import json
+    import socket
+    import sys
+    import uuid
+
+    from flink_tpu.config import HighAvailabilityOptions
+
+    ha_dir = str(config.get(HighAvailabilityOptions.HA_DIR)).strip()
+    standby = bool(standby or config.get(SessionOptions.HA_STANDBY))
+    if standby and not ha_dir:
+        print("error: --standby needs high-availability.dir (the "
+              "shared lease + durable-registry directory all "
+              "contenders point at)", file=sys.stderr)
+        return 2
+
+    if not ha_dir:
+        cluster = LocalSessionCluster(config, runners=local_runners,
+                                      port=port)
+        print(json.dumps({"session": cluster.address,
+                          "port": cluster.port,
+                          "runners": local_runners}), flush=True)
+        disp = cluster.dispatcher
+        try:
+            while not disp.stop_event.wait(0.2):
+                pass
+            _drain_stop(disp)
+        except KeyboardInterrupt:
             pass
-        # stop acknowledged: give the in-flight RPC response and the
-        # runners' cancel pushes a moment to settle before teardown
-        deadline = time.time() + 15
-        while time.time() < deadline:
-            with disp._lock:
-                busy = any(j.state in ("RUNNING", "RESTARTING")
-                           for j in disp.jobs.values())
-            if not busy:
-                break
-            time.sleep(0.1)
-        time.sleep(0.3)
+        finally:
+            cluster.close()
+        return 0
+
+    # -- HA mode ---------------------------------------------------------
+    # the lease must carry this contender's address BEFORE it can win,
+    # so an ephemeral port is resolved up front
+    if port == 0:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+    address = f"127.0.0.1:{port}"
+    print(json.dumps({"session": address, "port": port,
+                      "runners": local_runners, "ha_dir": ha_dir,
+                      "standby": standby}), flush=True)
+
+    from flink_tpu.runtime.ha import LeaderElection
+    from flink_tpu.runtime.runner import TaskRunner
+
+    grant_evt = threading.Event()
+    revoke_evt = threading.Event()
+    election = LeaderElection(
+        ha_dir, address,
+        config.get(HighAvailabilityOptions.LEASE_TIMEOUT) / 1000)
+    election.on_grant = lambda epoch: grant_evt.set()
+    election.on_revoke = revoke_evt.set
+    election.start()
+    runners: List[Any] = []
+    try:
+        while True:
+            print("contending for session leadership...", flush=True)
+            grant_evt.wait()
+            grant_evt.clear()
+            revoke_evt.clear()
+            disp = _build_dispatcher(config)
+            # fencing: stamped between construction and serving so no
+            # runner push can ever leave unstamped
+            disp.leader_epoch = election.epoch
+            server = RpcServer(disp, port)
+            print(json.dumps({"elected": True, "epoch": election.epoch,
+                              "recovered_jobs": disp.recovered_jobs}),
+                  flush=True)
+            if local_runners and not runners:
+                # spawned at FIRST grant (a standby's fleet must not
+                # sit registered to a peer before it leads); unique ids
+                # so a takeover's fleet can never be mistaken for the
+                # dead leader's stored runners
+                tag = uuid.uuid4().hex[:6]
+                for i in range(local_runners):
+                    r = TaskRunner("127.0.0.1", port,
+                                   runner_id=f"local-{tag}-{i}",
+                                   ha_dir=ha_dir)
+                    r.start()
+                    runners.append(r)
+            stopped = False
+            while True:
+                if disp.stop_event.wait(0.1):
+                    stopped = True
+                    break
+                if revoke_evt.is_set():
+                    break
+            if stopped:
+                _drain_stop(disp)
+                disp.close()
+                server.close()
+                return 0
+            # leadership lost: STOP SERVING (jobs re-load from the
+            # durable registry on the next grant, so dropping the
+            # in-memory state is safe); local runners stay up — they
+            # follow the new leader through the lease
+            print("session leadership revoked; closing", flush=True)
+            disp.close()
+            server.close()
     except KeyboardInterrupt:
-        pass
+        return 0
     finally:
-        cluster.close()
-    return 0
+        for r in runners:
+            try:
+                r.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        election.close()

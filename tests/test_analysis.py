@@ -165,6 +165,18 @@ def _session_quota_invalid(tmp_path):
     return env.analyze()
 
 
+@seed("SESSION_HA_UNSAFE")
+def _session_checkpointing_without_ha(tmp_path):
+    # a session cluster running checkpointing jobs with no
+    # high-availability.dir: one dispatcher SIGKILL strands every
+    # tenant even though their checkpoints would survive it. Clean
+    # negatives: no session intent (plain checkpointing config) and a
+    # session conf WITH an HA dir — both below.
+    return analyze_config(Configuration({
+        "session.max-jobs": 4,
+        "execution.checkpointing.interval": 500}))
+
+
 @seed("HOST_PARALLELISM_INVALID")
 def _host_parallelism_invalid(tmp_path):
     # below 1: the driver rejects it at build; the analyzer must flag
@@ -270,6 +282,30 @@ def _state_bytes_over_budget(tmp_path):
     # budget trips on the clean pipeline's window geometry
     env = clean_pipeline({"analysis.max-state-bytes-per-key": 4})
     return env.analyze()
+
+
+class TestSessionHaUnsafeNegatives:
+    """SESSION_HA_UNSAFE fires ONLY on the stranding shape: session
+    intent + checkpointing + no HA dir. Each leg missing keeps it
+    quiet (seeded violation in SEEDS above)."""
+
+    def _hits(self, conf):
+        return [f for f in analyze_config(Configuration(conf))
+                if f.rule == "SESSION_HA_UNSAFE"]
+
+    def test_checkpointing_without_session_intent_is_clean(self):
+        assert self._hits(
+            {"execution.checkpointing.interval": 500}) == []
+
+    def test_session_without_checkpointing_is_clean(self):
+        # nothing durable to strand: re-submission IS recovery
+        assert self._hits({"session.max-jobs": 4}) == []
+
+    def test_session_with_ha_dir_is_clean(self, tmp_path):
+        assert self._hits({
+            "session.max-jobs": 4,
+            "execution.checkpointing.interval": 500,
+            "high-availability.dir": str(tmp_path)}) == []
 
 
 class TestRuleCatalog:

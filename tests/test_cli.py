@@ -70,6 +70,89 @@ class TestExitCodeContract:
             assert f["severity"] in ("error", "warn")
 
 
+class TestSessionHaCli:
+    """ISSUE 11 satellite: the session CLI resolves the leader through
+    --ha-dir (runtime/ha.leader_address) and RE-resolves on connection
+    failure with a bounded retry budget — exit-code contract 0/1/2
+    preserved: 0 = ok, 1 = refused / no reachable leader (clean error,
+    never a traceback), 2 = usage error."""
+
+    def _lease(self, d, address, epoch=1):
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "leader.lease"), "w") as f:
+            json.dump({"leader_id": "L", "address": address,
+                       "epoch": epoch, "claimed_at": time.time()}, f)
+
+    def test_no_leader_exits_1_cleanly(self, tmp_path, capsys,
+                                       monkeypatch):
+        import flink_tpu.cli as cli_mod
+
+        monkeypatch.setattr(cli_mod, "_HA_RETRIES", 3)
+        monkeypatch.setattr(cli_mod, "_HA_RETRY_DELAY_S", 0.05)
+        rc = cli_main(["session", "list", "--ha-dir",
+                       str(tmp_path / "empty")])
+        err = capsys.readouterr().err
+        assert rc == 1
+        assert "error:" in err and "Traceback" not in err
+
+    def test_lease_resolution_without_session_flag(self, tmp_path,
+                                                   capsys):
+        from flink_tpu.config import Configuration
+        from flink_tpu.runtime.session import LocalSessionCluster
+
+        with LocalSessionCluster(Configuration(
+                {"session.autoscale": False})) as c:
+            self._lease(str(tmp_path), c.address)
+            rc, out = cli(capsys, "session", "list",
+                          "--ha-dir", str(tmp_path))
+            assert rc == 0 and out["jobs"] == []
+            # `session info` prints the leadership view
+            rc, out = cli(capsys, "session", "info",
+                          "--ha-dir", str(tmp_path))
+            assert rc == 0
+            assert "leader_epoch" in out and "takeovers" in out
+
+    def test_refused_connection_re_resolves_mid_retry(
+            self, tmp_path, capsys, monkeypatch):
+        """The failover flow a client sees: the lease points at a DEAD
+        leader; the new leader's lease lands DURING the retry budget —
+        the call re-resolves and succeeds (exit 0)."""
+        import socket
+        import threading
+
+        import flink_tpu.cli as cli_mod
+        from flink_tpu.config import Configuration
+        from flink_tpu.runtime.session import LocalSessionCluster
+
+        monkeypatch.setattr(cli_mod, "_HA_RETRIES", 30)
+        monkeypatch.setattr(cli_mod, "_HA_RETRY_DELAY_S", 0.1)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        self._lease(str(tmp_path), f"127.0.0.1:{dead_port}", epoch=1)
+        with LocalSessionCluster(Configuration(
+                {"session.autoscale": False})) as c:
+            def takeover():
+                time.sleep(0.5)
+                self._lease(str(tmp_path), c.address, epoch=2)
+
+            threading.Thread(target=takeover, daemon=True).start()
+            rc, out = cli(capsys, "session", "list",
+                          "--ha-dir", str(tmp_path))
+            assert rc == 0 and out["jobs"] == []
+
+    def test_standby_without_ha_dir_exits_2(self, capsys):
+        assert cli_main(["session", "start", "--standby"]) == 2
+        assert "standby" in capsys.readouterr().err
+
+    def test_neither_session_nor_ha_dir_exits_2(self, capsys):
+        with pytest.raises(SystemExit) as e:
+            cli_main(["session", "list"])
+        assert e.value.code == 2
+        assert "--ha-dir" in capsys.readouterr().err
+
+
 class TestLogCli:
     """ISSUE 9: `flink_tpu log TOPIC_DIR` prints the message-bus view
     — compaction generation, retention floor, active leases with

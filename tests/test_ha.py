@@ -73,6 +73,166 @@ class TestLeaderElection:
             b.close()
 
 
+class TestRacingContenders:
+    """ISSUE 11 satellite: the lease-steal follows the rename-first
+    stale-lock-breaking discipline PR 9 established for bus leases —
+    two racing breakers must never unlink each other's FRESH lease,
+    and release is inode/identity-checked."""
+
+    def _stale_lease(self, d, epoch=3):
+        import json as _json
+        import os as _os
+
+        lease = _os.path.join(d, "leader.lease")
+        with open(lease, "w") as f:
+            _json.dump({"leader_id": "dead", "address": "h:9",
+                        "epoch": epoch, "claimed_at": time.time() - 60},
+                       f)
+        _os.utime(lease, (time.time() - 60, time.time() - 60))
+        return lease
+
+    def test_racing_breaker_cannot_unlink_fresh_lease(self, tmp_path):
+        """The exact race the old tmp+replace steal lost: contender B
+        reads the stale record, contender A completes its steal (fresh
+        lease claimed), THEN B's steal fires with the stale record it
+        observed. B must neither become leader nor destroy A's fresh
+        lease."""
+        d = str(tmp_path)
+        self._stale_lease(d)
+        a = LeaderElection(d, "127.0.0.1:1111", lease_timeout_s=0.3,
+                           leader_id="breaker-a")
+        b = LeaderElection(d, "127.0.0.1:2222", lease_timeout_s=0.3,
+                           leader_id="breaker-b")
+        try:
+            stale_as_b_saw_it = b._read()
+            a._steal_stale(a._read())
+            assert a.is_leader and a.epoch == 4
+            # B races in with its stale observation
+            b._steal_stale(stale_as_b_saw_it)
+            assert not b.is_leader
+            survivor = a._read()
+            assert survivor is not None, (
+                "the racing breaker unlinked the fresh lease")
+            assert survivor.leader_id == "breaker-a"
+            assert leader_address(d) == "127.0.0.1:1111"
+        finally:
+            a.close()
+            b.close()
+
+    def test_concurrent_steals_exactly_one_winner(self, tmp_path):
+        """N contenders breaking one stale lease concurrently: exactly
+        one wins, the surviving lease is the winner's, and the epoch
+        advanced past the stale incumbent's."""
+        import threading as _threading
+
+        d = str(tmp_path)
+        self._stale_lease(d, epoch=7)
+        contenders = [
+            LeaderElection(d, f"127.0.0.1:{1000 + i}",
+                           lease_timeout_s=0.3, leader_id=f"c{i}")
+            for i in range(4)]
+        try:
+            stale = contenders[0]._read()
+            barrier = _threading.Barrier(len(contenders))
+
+            def steal(c):
+                barrier.wait()
+                c._steal_stale(stale)
+
+            ts = [_threading.Thread(target=steal, args=(c,))
+                  for c in contenders]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(10)
+            winners = [c for c in contenders if c.is_leader]
+            assert len(winners) == 1, (
+                f"split brain: {[c.leader_id for c in winners]}")
+            rec = winners[0]._read()
+            assert rec is not None
+            assert rec.leader_id == winners[0].leader_id
+            assert rec.epoch > 7
+        finally:
+            for c in contenders:
+                c.close()
+
+    def test_release_is_identity_checked(self, tmp_path):
+        """close() of a leader whose lease was already stolen must NOT
+        unlink the thief's fresh lease (inode-checked release)."""
+        d = str(tmp_path)
+        a = LeaderElection(d, "127.0.0.1:1111", lease_timeout_s=0.2,
+                           leader_id="old")
+        a.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not a.is_leader:
+            time.sleep(0.02)
+        assert a.is_leader
+        # stop the renewal thread, age the lease, let a thief steal it
+        a._closed = True
+        a._thread.join(timeout=2)
+        import os as _os
+
+        lease = _os.path.join(d, "leader.lease")
+        _os.utime(lease, (time.time() - 60, time.time() - 60))
+        thief = LeaderElection(d, "127.0.0.1:2222", lease_timeout_s=0.2,
+                               leader_id="thief")
+        try:
+            thief._steal_stale(thief._read())
+            assert thief.is_leader
+            # the deposed leader exits believing it still leads
+            # (is_leader was never flipped): its release must no-op
+            a.close()
+            rec = thief._read()
+            assert rec is not None and rec.leader_id == "thief", (
+                "release unlinked the thief's fresh lease")
+        finally:
+            thief.close()
+
+
+class TestTakeoverCount:
+    """`takeovers` is a durable count of lease STEALS — a clean
+    stop/restart advances the fencing epoch but is NOT a takeover
+    (review regression: epoch-1 arithmetic false-alarmed on every
+    routine restart)."""
+
+    def _lead(self, d, addr, timeout=0.3):
+        e = LeaderElection(d, addr, lease_timeout_s=timeout)
+        e.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not e.is_leader:
+            time.sleep(0.02)
+        assert e.is_leader
+        return e
+
+    def test_clean_restart_is_not_a_takeover(self, tmp_path):
+        from flink_tpu.runtime.ha import takeover_count
+
+        d = str(tmp_path)
+        a = self._lead(d, "127.0.0.1:1111")
+        a.close()  # clean handover
+        b = self._lead(d, "127.0.0.1:2222")
+        try:
+            assert b.epoch > 1  # fencing epoch still advanced
+            assert takeover_count(d) == 0  # but nothing was stolen
+        finally:
+            b.close()
+
+    def test_steal_increments_the_counter(self, tmp_path):
+        from flink_tpu.runtime.ha import takeover_count
+
+        d = str(tmp_path)
+        a = self._lead(d, "127.0.0.1:1111")
+        # incumbent dies WITHOUT cleanup
+        a._closed = True
+        a._thread.join(timeout=2)
+        b = self._lead(d, "127.0.0.1:2222", timeout=0.3)
+        try:
+            assert takeover_count(d) == 1
+        finally:
+            b.close()
+            a.close()
+
+
 class TestJobStore:
     def test_roundtrip_and_recoverable_filter(self, tmp_path):
         s = JobStore(str(tmp_path))

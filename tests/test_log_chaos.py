@@ -446,7 +446,17 @@ class TestLeaseChaos:
 
     @pytest.mark.parametrize("point,kw", [
         ("log.lease.acquire", dict(count=1)),
-        ("log.lease.renew", dict(count=1, after=2)),
+        # after=1, not after=2: the renew gate fires per MARKER
+        # publication, and publications follow the 1ms wall-clock
+        # checkpoint cadence — a fast run may complete in ONE
+        # checkpoint round (pre + commit = exactly 2 verifies), so
+        # skipping 2 made the schedule dead and the fired-once assert
+        # flaky under suite load. Skipping 1 lands the raise on the
+        # guaranteed second publication (the terminal commit marker —
+        # THE crash window between pre-commit and commit, for the
+        # lease seam) on every timing. Same deflake discipline as the
+        # session-chaos +2→+1 (PR 9).
+        ("log.lease.renew", dict(count=1, after=1)),
     ])
     def test_leased_producer_chain_byte_identical(
             self, tmp_path, kv_golden, point, kw):

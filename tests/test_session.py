@@ -194,7 +194,13 @@ class TestAdmission:
             assert not r["admitted"] and "runner-slots" in r["reason"]
             r = disp.rpc_submit_session_job("c", "m:f", {})
             assert r["admitted"]
+            # the SAME submission re-delivered (the HA client retries a
+            # submit whose response died with the leader): ack'd as a
+            # duplicate, never an error — the job IS admitted
             r = disp.rpc_submit_session_job("c", "m:f", {})
+            assert r["admitted"] and r.get("duplicate")
+            # a DIFFERENT job reusing an active id is still rejected
+            r = disp.rpc_submit_session_job("c", "other:entry", {})
             assert not r["admitted"] and "already active" in r["reason"]
         finally:
             disp.close()
