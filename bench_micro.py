@@ -24,6 +24,22 @@ def _line(metric: str, value: float, unit: str, **extra) -> None:
                       "unit": unit, **extra}), flush=True)
 
 
+def _emit(rows: list, metric: str, value: float, unit: str,
+          **extra) -> None:
+    """Print one metric line AND collect it for the artifact — the two
+    must never diverge (the artifact's whole point is that claims are
+    recorded numbers)."""
+    rows.append({"metric": metric, "value": round(value, 3),
+                 "unit": unit, **extra})
+    _line(metric, value, unit, **extra)
+
+
+def _write_artifact(path: str, bench: str, rows: list, **extra) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"bench": bench, **extra, "lines": rows}, f, indent=1)
+    print(f"# {bench} artifact -> {path}", flush=True)
+
+
 def bench_state_update(batch: int = 1 << 20, iters: int = 12) -> None:
     """#1: pane scatter-add ops/sec — apply_kernel_split on a Q5-shaped
     layout, pipelined like the driver (inflight steps)."""
@@ -204,57 +220,232 @@ def bench_checkpoint(tmp: str | None = None) -> None:
 
 
 def bench_dcn(payloads=(0, 64 * 1024, 1 << 20), procs=(2, 4),
-              iters: int = 30) -> None:
+              iters: int = 30, codecs=("legacy", "binary"),
+              artifact: str | None = None,
+              target_x: float = 5.0) -> list:
     """Cross-host exchange cost (exchange/dcn.py): per-step rendezvous
-    wall time vs payload size and process count, plus the implied
-    records/s for 12-byte records. In-process threads over loopback —
-    measures the framework's framing + blobformat + barrier costs (the
-    wire is the hardware's job). Round-4 VERDICT missing #4: the DCN
-    plane needs a performance story."""
+    wall time vs payload size, process count, AND wire codec —
+    ``legacy`` is the pre-rebuild serial blobformat plane kept
+    byte-for-byte as the baseline, ``binary`` is the production plane
+    (fixed binary frames + parallel per-peer I/O, ISSUE 12). One
+    ``dcn_codec_speedup`` line per (procs, payload) records the
+    binary/legacy bytes-per-second ratio with ``target_met`` against
+    the >=``target_x`` bar at 1MB, and ``artifact`` (a path) persists
+    every line as JSON so the claim is a recorded number, not a log
+    grep. In-process threads over loopback — measures the framework's
+    framing + barrier costs (the wire is the hardware's job)."""
     import threading
 
     import numpy as np
 
     from flink_tpu.exchange.dcn import DcnExchange
 
-    for n in procs:
-        for nbytes in payloads:
-            exs = [DcnExchange(i, n) for i in range(n)]
-            peers = [f"127.0.0.1:{e.port}" for e in exs]
-            per_peer = max(nbytes // max(n - 1, 1), 0)
-            share = np.zeros(per_peer // 8 or 1, np.int64)
-            times = [0.0] * n
+    rows: list = []
 
-            def run(i):
-                exs[i].connect(peers)
-                shares = {j: share for j in range(n) if j != i}
-                # warm
-                exs[i].exchange(shares, {"wm": 0})
-                t0 = time.perf_counter()
-                for k in range(iters):
-                    exs[i].exchange(shares, {"wm": k})
-                times[i] = (time.perf_counter() - t0) / iters
+    def emit(metric, value, unit, **extra):
+        _emit(rows, metric, value, unit, **extra)
 
-            ths = [threading.Thread(target=run, args=(i,))
-                   for i in range(n)]
-            for t in ths:
-                t.start()
-            for t in ths:
-                t.join(timeout=120)
-            for e in exs:
-                e.close()
-            step_ms = max(times) * 1000
-            if step_ms <= 0:
-                raise RuntimeError(
-                    f"dcn bench barrier failed (n={n}, {nbytes}B): "
-                    "a peer thread never completed")
-            _line("dcn_exchange_step_ms", step_ms, "ms/step",
-                  n_processes=n, payload_bytes=nbytes)
-            if nbytes:
-                _line("dcn_exchange_records_per_sec",
-                      (nbytes / 12) / (step_ms / 1000), "records/sec",
-                      n_processes=n, payload_bytes=nbytes,
-                      record_bytes=12)
+    step_by: dict = {}
+    for codec in codecs:
+        for n in procs:
+            for nbytes in payloads:
+                exs = [DcnExchange(i, n, codec=codec) for i in range(n)]
+                peers = [f"127.0.0.1:{e.port}" for e in exs]
+                per_peer = max(nbytes // max(n - 1, 1), 0)
+                share = np.zeros(per_peer // 8 or 1, np.int64)
+                times = [0.0] * n
+
+                def run(i):
+                    exs[i].connect(peers)
+                    shares = {j: share for j in range(n) if j != i}
+                    # warm
+                    exs[i].exchange(shares, {"wm": 0})
+                    t0 = time.perf_counter()
+                    for k in range(iters):
+                        exs[i].exchange(shares, {"wm": k})
+                    times[i] = (time.perf_counter() - t0) / iters
+
+                ths = [threading.Thread(target=run, args=(i,))
+                       for i in range(n)]
+                for t in ths:
+                    t.start()
+                for t in ths:
+                    t.join(timeout=120)
+                for e in exs:
+                    e.close()
+                step_ms = max(times) * 1000
+                if step_ms <= 0:
+                    raise RuntimeError(
+                        f"dcn bench barrier failed (n={n}, {nbytes}B, "
+                        f"{codec}): a peer thread never completed")
+                step_by[(codec, n, nbytes)] = step_ms
+                emit("dcn_exchange_step_ms", step_ms, "ms/step",
+                     n_processes=n, payload_bytes=nbytes, codec=codec)
+                if nbytes:
+                    emit("dcn_exchange_bytes_per_sec",
+                         nbytes / (step_ms / 1000), "bytes/sec",
+                         n_processes=n, payload_bytes=nbytes,
+                         codec=codec)
+                    emit("dcn_exchange_records_per_sec",
+                         (nbytes / 12) / (step_ms / 1000), "records/sec",
+                         n_processes=n, payload_bytes=nbytes,
+                         record_bytes=12, codec=codec)
+    if "legacy" in codecs and "binary" in codecs:
+        import os
+
+        for n in procs:
+            for nbytes in payloads:
+                if not nbytes:
+                    continue
+                sp = (step_by[("legacy", n, nbytes)]
+                      / step_by[("binary", n, nbytes)])
+                extra = {}
+                # honest-constraint convention (bench.py
+                # --host-parallelism): this bench runs every endpoint
+                # as a THREAD of one interpreter, so the parallel I/O
+                # plane and the per-peer checksum threads only overlap
+                # when each endpoint has roughly a core to itself; on
+                # fewer cores the measurement is a single-core codec
+                # comparison, not a data-plane scaling number
+                cores = len(os.sched_getaffinity(0))
+                if cores < 2 * n:
+                    extra["constraint"] = (
+                        f"insufficient-cores ({cores} available, "
+                        f"{2 * n} wanted: in-process endpoints share "
+                        "cores AND one GIL — parallel peer I/O cannot "
+                        "overlap here; run on the chip host)")
+                emit("dcn_codec_speedup", sp, "x", n_processes=n,
+                     payload_bytes=nbytes,
+                     target=target_x if nbytes == 1 << 20 else None,
+                     target_met=(sp >= target_x
+                                 if nbytes == 1 << 20 else None),
+                     **extra)
+    if artifact:
+        _write_artifact(artifact, "dcn_exchange", rows, iters=iters)
+    return rows
+
+
+_Q5_WORKER = r"""
+import json, os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import numpy as np
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.api.windowing import TumblingEventTimeWindows
+from flink_tpu.config import Configuration
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+pid = int(sys.argv[1]); n = int(sys.argv[2]); peers = sys.argv[3]
+my_port = int(sys.argv[4]); n_batches = int(sys.argv[5])
+batch = int(sys.argv[6])
+
+def gen(split, i):
+    if i >= n_batches:
+        return None
+    rng = np.random.default_rng(31 + 1000 * int(split) + i)
+    return ({{"k": rng.integers(0, 256, batch).astype(np.int64)}},
+            i * 1000 + rng.integers(0, 1000, batch).astype(np.int64))
+
+conf = {{"state.num-key-shards": 8, "state.slots-per-shard": 512,
+         "pipeline.microbatch-size": batch}}
+if n > 1:
+    conf.update({{"cluster.num-processes": n, "cluster.process-id": pid,
+                  "cluster.dcn-peers": peers,
+                  "cluster.dcn-port": my_port}})
+env = StreamExecutionEnvironment(Configuration(conf))
+(env.from_source(GeneratorSource(gen, n_splits=2),
+                 WatermarkStrategy.for_bounded_out_of_orderness(1000))
+ .key_by("k").window(TumblingEventTimeWindows.of(1000)).count()
+ .collect())
+t0 = time.perf_counter()
+env.execute("q5-scale")
+print(json.dumps({{"wall_s": time.perf_counter() - t0}}), flush=True)
+"""
+
+
+def bench_dcn_q5(procs: int = 2, n_batches: int = 24,
+                 batch: int = 1 << 12, force: bool = False,
+                 artifact: str | None = None) -> list:
+    """The 2-process Q5 throughput-scaling run of ROADMAP item 2: the
+    same keyed-window job as one process vs ``procs`` processes through
+    the DCN plane (binary frames + parallel I/O + overlap), events/s
+    clocked INSIDE each worker (interpreter + jit warm-up excluded).
+    ``dcn_q5_scaling`` records throughput(N)/throughput(1) with
+    ``target_met`` = scales past 1x; on a host without at least a core
+    per process it emits the honest SKIPPED line instead (parity —
+    byte-identical committed output — is proven in tier-1 regardless,
+    tests/test_dcn.py). ``force`` runs the measurement anyway
+    (validation on small hosts)."""
+    import json as _json
+    import os
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+
+    rows: list = []
+
+    def emit(metric, value, unit, **extra):
+        _emit(rows, metric, value, unit, **extra)
+
+    cores = len(os.sched_getaffinity(0))
+    if cores < 2 * procs and not force:
+        emit("dcn_q5_scaling", 0.0, "ratio", skipped=(
+            f"insufficient-cores ({cores} available): {procs}-process "
+            "Q5 throughput scaling needs >= 1 core per process — run "
+            "on the chip host; parity is proven in tier-1 "
+            "(tests/test_dcn.py)"))
+    else:
+        repo = os.path.dirname(os.path.abspath(__file__))
+        script = os.path.join(tempfile.mkdtemp(prefix="dcn-q5-"),
+                              "worker.py")
+        with open(script, "w", encoding="utf-8") as f:
+            f.write(_Q5_WORKER.format(repo=repo))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+        def fleet(n):
+            socks = [socket.socket() for _ in range(n)]
+            for s in socks:
+                s.bind(("127.0.0.1", 0))
+            ports = [s.getsockname()[1] for s in socks]
+            for s in socks:
+                s.close()
+            peers = ",".join(f"127.0.0.1:{p}" for p in ports)
+            ps = [subprocess.Popen(
+                [sys.executable, script, str(i), str(n), peers,
+                 str(ports[i]), str(n_batches), str(batch)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                env=env) for i in range(n)]
+            outs = [p.communicate(timeout=900)[0].decode() for p in ps]
+            for i, p in enumerate(ps):
+                if p.returncode:
+                    raise RuntimeError(
+                        f"q5-scale worker {i}/{n} rc={p.returncode}:\n"
+                        + outs[i][-2000:])
+            walls = [_json.loads(o.strip().splitlines()[-1])["wall_s"]
+                     for o in outs]
+            # the fleet DIVIDES the 2-split stream (local enumeration:
+            # process p reads splits p, p+n, ...), so total events are
+            # identical across fleet widths; throughput = total events
+            # over the slowest member (the rendezvous barrier means
+            # members finish together anyway)
+            return 2 * n_batches * batch / max(walls)
+
+        eps1 = fleet(1)
+        epsn = fleet(procs)
+        ratio = epsn / eps1
+        emit("dcn_q5_events_per_sec", eps1, "events/sec", n_processes=1)
+        emit("dcn_q5_events_per_sec", epsn, "events/sec",
+             n_processes=procs)
+        emit("dcn_q5_scaling", ratio, "ratio", n_processes=procs,
+             target_met=ratio > 1.0,
+             note="throughput must scale with process count "
+                  "(ROADMAP item 2); parity is tier-1's job")
+    if artifact:
+        _write_artifact(artifact, "dcn_q5_scaling", rows)
+    return rows
 
 
 def main() -> None:
@@ -273,7 +464,8 @@ def main() -> None:
     bench_codec()
     bench_fire_flush()
     bench_checkpoint()
-    bench_dcn()
+    bench_dcn(artifact="BENCH_DCN.json")
+    bench_dcn_q5(artifact="BENCH_DCN_Q5.json")
 
 
 if __name__ == "__main__":

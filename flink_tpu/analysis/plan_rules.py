@@ -464,6 +464,40 @@ def session_ha_unsafe(plan, config) -> Iterable[Finding]:
             "`session start --standby --ha-dir <dir>`")
 
 
+@config_rule("DCN_OVERLAP_UNSAFE", "warn",
+             fix="leave cluster.dcn-overlap-drain true (the default), "
+                 "or disable checkpointing / overlap")
+def dcn_overlap_unsafe(plan, config) -> Iterable[Finding]:
+    """Step-overlapped cross-host exchange with checkpointing but the
+    barrier drain DISABLED: the snapshot's source positions include
+    the one in-flight exchange step, whose records are still on the
+    wire — a restore from that checkpoint skips past them (at-most-
+    once for that step). The drain exists exactly so the cut covers
+    every routed record; turning it off is a loss-tolerant perf trade
+    that must be a visible decision, not a silent config."""
+    from flink_tpu.config import CheckpointingOptions, ClusterOptions
+
+    if int(config.get(ClusterOptions.NUM_PROCESSES)) <= 1:
+        return  # no cross-host exchange in this job
+    if int(config.get(CheckpointingOptions.INTERVAL)) <= 0:
+        return  # nothing snapshots: nothing to miss the cut
+    if not bool(config.get(ClusterOptions.DCN_OVERLAP)):
+        return  # lockstep loop: the barrier IS the dispatch
+    if bool(config.get(ClusterOptions.DCN_OVERLAP_DRAIN)):
+        return  # drained at the barrier: the cut is complete
+    yield _f(
+        "cluster.dcn-overlap is on with checkpointing but "
+        "cluster.dcn-overlap-drain is false: the in-flight overlapped "
+        "exchange step is NOT drained at the checkpoint barrier, so "
+        "its records are in the snapshot's source positions but in "
+        "nobody's state — a restore from that checkpoint loses them "
+        "(at-most-once for that step)",
+        fix="leave cluster.dcn-overlap-drain true (the default; one "
+            "extra consume per checkpoint), or disable "
+            "cluster.dcn-overlap / checkpointing if the pipeline "
+            "tolerates loss")
+
+
 @config_rule("SUBBATCH_INVALID", "error",
              fix="pick a divisor of pipeline.microbatch-size")
 def subbatch_invalid(plan, config) -> Iterable[Finding]:

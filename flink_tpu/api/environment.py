@@ -140,10 +140,20 @@ class StreamExecutionEnvironment:
         num_shards = self.config.get(StateOptions.NUM_KEY_SHARDS)
         nproc = int(self.config.get(ClusterOptions.NUM_PROCESSES))
         if nproc > 1:
-            # cross-host: this process's LOCAL mesh covers only its
-            # shard span (records arrive pre-routed through the DCN
-            # exchange; the key directory keeps the global shard space)
-            num_shards = num_shards // nproc
+            # cross-host: the HYBRID topology (SNIPPETS.md [1] — DCN
+            # outer axis, ICI inner). This process's local mesh covers
+            # only its contiguous shard span; records arrive pre-routed
+            # through the DCN exchange, so every in-step collective
+            # names the inner axis only and keyBy shuffle bytes stay
+            # intra-slice (the key directory keeps the global space)
+            from flink_tpu.parallel.mesh import make_hybrid_mesh_plan
+
+            return make_hybrid_mesh_plan(
+                num_shards,
+                self.config.get(StateOptions.SLOTS_PER_SHARD),
+                nproc,
+                int(self.config.get(ClusterOptions.PROCESS_ID)),
+                devices)
         return make_mesh_plan(
             num_shards,
             self.config.get(StateOptions.SLOTS_PER_SHARD),

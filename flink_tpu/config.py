@@ -527,6 +527,42 @@ class ClusterOptions:
         "config; static cluster.dcn-peers deployments set it "
         "themselves. Empty = unauthenticated (single-host loopback "
         "only).")
+    DCN_OVERLAP = ConfigOption(
+        "cluster.dcn-overlap", True,
+        "Step-overlapped cross-host exchange (exchange/dcn.py "
+        "exchange_async): the driver dispatches step N+1's frames and "
+        "consumes step N's at the NEXT iteration, so the N-way "
+        "rendezvous overlaps the device compute and the host "
+        "ingest/route work of the following step instead of "
+        "serializing with them. Committed output is identical — the "
+        "barrier moves, the per-step consensus (watermark/termination/"
+        "checkpoint) does not. False = consume at dispatch (the v0 "
+        "lockstep loop; one step of extra exchange latency saved per "
+        "barrier, useful when bisecting the exchange itself).")
+    DCN_OVERLAP_DRAIN = ConfigOption(
+        "cluster.dcn-overlap-drain", True,
+        "Drain the ONE in-flight overlapped exchange step before "
+        "snapshotting at a checkpoint barrier (the default, and the "
+        "exactly-once contract: the cut covers every routed record). "
+        "False skips the drain — the snapshot's source positions then "
+        "include a step whose records are still on the wire, so a "
+        "restore from that checkpoint LOSES them (at-most-once for "
+        "that step). Only for pipelines that tolerate loss; the plan "
+        "analyzer flags it (DCN_OVERLAP_UNSAFE).")
+    DCN_IO_THREADS = ConfigOption(
+        "cluster.dcn-io-threads", 0,
+        "Sender-worker threads of the parallel DCN I/O plane. 0 = "
+        "auto (one per peer — all N-1 sends overlap). A positive "
+        "value caps the workers; peers are assigned round-robin and "
+        "stick to one worker so per-peer frame order stays FIFO. "
+        "Receive threads are always per-peer (each blocks on its own "
+        "socket; they are the step barrier).")
+    DCN_BUFFER_BYTES = ConfigOption(
+        "cluster.dcn-buffer-bytes", 0,
+        "SO_SNDBUF/SO_RCVBUF for every DCN exchange socket, in bytes. "
+        "0 = OS default. Raise it (e.g. 4-16 MB) on high-bandwidth-"
+        "delay cross-rack links so one step's frames fit in the "
+        "kernel buffers and the sender workers never stall mid-step.")
     DCN_BIND = ConfigOption(
         "cluster.dcn-bind", "auto",
         "Address the exchange listener binds. 'auto' (default) stays "

@@ -105,3 +105,29 @@ def keyby_exchange(
         dest, valid, payload, n_dest=n_devices, capacity=capacity)
     recv, rv = all_to_all_records(buckets, bv, axis_name)
     return recv, rv, overflow
+
+
+def intra_slice_exchange(
+    dest_local: jax.Array,
+    valid: jax.Array,
+    payload: Arrays,
+    *,
+    n_local: int,
+    capacity: int,
+) -> Tuple[Arrays, jax.Array, jax.Array]:
+    """The ICI leg of the hybrid ICI×DCN topology (SNIPPETS.md [1]:
+    DCN outer axis, ICI inner axis — parallel/mesh.HybridMeshPlan).
+
+    Identical collective to :func:`keyby_exchange`, but named over the
+    INNER mesh axis only, which is the whole point: on a
+    ``(DCN_AXIS, AXIS)`` hybrid mesh, ``lax.all_to_all(..., AXIS)``
+    permutes data among the devices of ONE slice and never crosses the
+    outer axis — so keyBy shuffle bytes stay on ICI by construction,
+    and only the cross-slice residue (pre-split on the host by
+    ``exchange/partitioners.hybrid_route`` coordinate 0) rides the
+    slow DCN plane through ``exchange/dcn.py``. ``dest_local`` is
+    routing coordinate 1 of the same ``hybrid_route`` call — one
+    routing truth for both planes."""
+    return keyby_exchange(dest_local, valid, payload,
+                          n_devices=n_local, capacity=capacity,
+                          axis_name=AXIS)

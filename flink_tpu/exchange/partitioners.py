@@ -146,6 +146,64 @@ class ForwardPartitioner(Partitioner):
         return np.zeros(b, np.int32)
 
 
+# -- the keyed (hash) assignment of the hybrid ICI×DCN topology -------------
+# ref: KeyGroupStreamPartitioner.computeKeyGroupForKeyHash — key → key
+# group → operator index. Here the hash space is state.num-key-shards
+# and a "subtask" has TWO coordinates: the PROCESS (slice) that owns
+# the shard's span, reached over the slow DCN plane, and the LOCAL
+# DEVICE within that slice, reached over ICI inside the compiled step.
+# This function is the ONE routing truth both planes share: the
+# driver's host-side DCN router takes coordinate 0, the in-process
+# keyBy all_to_all takes coordinate 1 — so a record's owner is decided
+# once, and intra-slice records (process == self) never touch the wire
+# (SNIPPETS.md [1] create_hybrid_device_mesh: ICI inner axis, DCN
+# outer axis — most shuffle bytes stay on the fast plane).
+
+def hash_shards(keys: np.ndarray, num_shards: int) -> np.ndarray:
+    """(B,) int64 keys → global shard ids (the key-group hash)."""
+    from flink_tpu.records import hash_keys_numpy
+
+    return hash_keys_numpy(np.asarray(keys, np.int64)) % num_shards
+
+
+def hybrid_route(keys: np.ndarray, num_shards: int, n_processes: int,
+                 local_devices: int = 1):
+    """(B,) keys → (process_dest, local_device_dest) int32 arrays.
+
+    Shards are contiguous per process (the key-group range contract:
+    process p owns [p*spp, (p+1)*spp)) and contiguous per device within
+    the process's span, so rescaling by process count or device count
+    moves whole shard ranges, never single keys. ``num_shards`` must
+    divide evenly by ``n_processes`` and the per-process span by
+    ``local_devices`` — the same divisibility the driver and mesh plan
+    enforce at build."""
+    shard = hash_shards(keys, num_shards)
+    spp = num_shards // n_processes
+    if spp * n_processes != num_shards:
+        raise ValueError(
+            f"num_shards ({num_shards}) must divide by n_processes "
+            f"({n_processes}) — shards are the rescale unit")
+    proc = shard // spp
+    spd = spp // max(local_devices, 1)
+    if local_devices > 1 and spd * local_devices != spp:
+        raise ValueError(
+            f"per-process shard span ({spp}) must divide by the local "
+            f"device count ({local_devices})")
+    local = (shard - proc * spp) // max(spd, 1)
+    return proc.astype(np.int32), local.astype(np.int32)
+
+
+def cross_slice_fraction(process_dest: np.ndarray,
+                         process_id: int) -> float:
+    """Fraction of a routed batch that must leave this slice over DCN —
+    the residue the hybrid topology exists to minimize (1 - 1/N for a
+    uniform key hash; observability for skew diagnosis)."""
+    n = len(process_dest)
+    if n == 0:
+        return 0.0
+    return float(np.count_nonzero(process_dest != process_id)) / n
+
+
 def make_partitioner(strategy: str, seed: int = 0) -> Partitioner:
     """``seed`` decorrelates stacked shuffle exchanges (pass the exec
     node id); non-random strategies ignore it."""

@@ -65,6 +65,20 @@ Instrumented points (the stack's recovery-critical seams):
     checkpoint.upload                                      coordinator.py
     rpc.client.send / rpc.client.recv / rpc.server.dispatch  rpc.py
     dcn.accept / dcn.send / dcn.recv                       dcn.py
+    dcn.frame.encode                                       exchange/frames.py
+        (binary frame encode, per peer per step: a raise there is a
+        codec failure — the attempt dies before any partial frame
+        reaches the wire)
+    dcn.send.partial                                       dcn.py
+        (the sender-worker write seam of the parallel I/O plane: a
+        drop there is the connection dying mid-frame UNDER a peer —
+        the error parks in the first-error cell and surfaces at the
+        step barrier, the overlapped-path chaos gate)
+    dcn.overlap.consume                                    driver.py
+        (the step-overlapped consume seam — where the rendezvous
+        barrier lands when cluster.dcn-overlap defers it by one step:
+        a raise there is the in-flight exchange collapsing while the
+        device computes the previous step)
     runner.heartbeat                                       runner.py
     coordinator.deploy                                     coordinator.py
     supervisor.restart                                     supervisor.py
@@ -177,6 +191,9 @@ KNOWN_FAULT_POINTS = frozenset((
     "dcn.accept",
     "dcn.send",
     "dcn.recv",
+    "dcn.frame.encode",
+    "dcn.send.partial",
+    "dcn.overlap.consume",
     "runner.heartbeat",
     "coordinator.deploy",
     "supervisor.restart",

@@ -103,6 +103,8 @@ def _bind(lib, i64p, f32p) -> None:
     lib.ht_insert.argtypes = [ctypes.c_void_p, i64p, i64p, ctypes.c_int64]
     lib.hash_keys.restype = None
     lib.hash_keys.argtypes = [i64p, ctypes.c_int64, i64p]
+    lib.crc32_zlib.restype = ctypes.c_uint32
+    lib.crc32_zlib.argtypes = [u8p, ctypes.c_int64, ctypes.c_uint32]
     lib.sr_listen.restype = ctypes.c_void_p
     lib.sr_listen.argtypes = [ctypes.c_int]
     lib.sr_port.restype = ctypes.c_int
@@ -250,6 +252,31 @@ def encode_i64_rows(vals: np.ndarray, delim: str = ",") -> bytes:
                             delim.encode(), buf, cap)
     assert n >= 0
     return buf.raw[:n]
+
+
+#: buffers below this go straight to zlib (ctypes call overhead and the
+#: numpy view wrap cost more than the GIL hold on a few KB)
+_CRC_NATIVE_MIN = 1 << 14
+
+
+def crc32(buf, value: int = 0) -> int:
+    """CRC-32 of a bytes-like buffer, BIT-IDENTICAL to ``zlib.crc32``
+    — but computed WITHOUT the GIL on the native path (slice-by-8 in
+    codec.cc), so concurrent frame checksums of the DCN exchange's
+    per-peer I/O threads actually overlap. CPython 3.10's zlib holds
+    the GIL for the whole pass; on a multi-peer exchange that
+    serializes every checksum in the process. Falls back to zlib
+    (same result) when the .so is unavailable."""
+    import zlib
+
+    mv = memoryview(buf)
+    if mv.format != "B":
+        mv = mv.cast("B")
+    lib = _load()
+    if lib is None or mv.nbytes < _CRC_NATIVE_MIN:
+        return zlib.crc32(mv, value)
+    arr = np.frombuffer(mv, np.uint8)
+    return int(lib.crc32_zlib(arr, arr.size, value & 0xFFFFFFFF))
 
 
 def hash_keys_native(keys: np.ndarray) -> Optional[np.ndarray]:

@@ -22,6 +22,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS = "d"
 
+#: outer (cross-slice) axis of a hybrid ICI×DCN mesh — collectives over
+#: AXIS stay inside a slice (ICI); nothing in the compiled step ever
+#: reduces over this axis, because cross-slice residue is routed on the
+#: HOST through the DCN exchange before ingest (exchange/dcn.py)
+DCN_AXIS = "h"
+
 
 @dataclasses.dataclass(frozen=True)
 class MeshPlan:
@@ -95,3 +101,72 @@ def make_mesh_plan(
             f"device count ({n}) — the key-group/maxParallelism contract")
     mesh = Mesh(np.asarray(devices), (AXIS,))
     return MeshPlan(mesh=mesh, num_shards=num_shards, slots_per_shard=slots_per_shard)
+
+
+@dataclasses.dataclass(frozen=True)
+class HybridMeshPlan(MeshPlan):
+    """The ICI×DCN topology of one slice of a cross-host job
+    (SNIPPETS.md [1] ``create_hybrid_device_mesh``: ICI inner axis, DCN
+    outer axis). ``num_shards`` here is this process's LOCAL span —
+    the operator contract is identical to a plain :class:`MeshPlan` —
+    while the global fields expose the fleet-level shard math the
+    host-side DCN router shares (exchange/partitioners.hybrid_route).
+
+    The local mesh carries BOTH axes, (``DCN_AXIS``=1, ``AXIS``=n):
+    every in-step collective names ``AXIS`` only, so keyBy shuffle
+    bytes provably stay intra-slice — the outer axis exists so the
+    compiled program's sharding layout is the hybrid one, and a future
+    multi-controller global mesh (all slices in one Mesh) changes the
+    axis SIZES, not the program."""
+
+    n_processes: int = 1
+    process_id: int = 0
+
+    @property
+    def global_num_shards(self) -> int:
+        return self.num_shards * self.n_processes
+
+    @property
+    def shard_lo(self) -> int:
+        """First global shard this slice owns (contiguous span)."""
+        return self.process_id * self.num_shards
+
+    def owner(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """(B,) keys → (process, local device) — the one routing truth,
+        delegated to exchange/partitioners.hybrid_route."""
+        from flink_tpu.exchange.partitioners import hybrid_route
+
+        return hybrid_route(keys, self.global_num_shards,
+                            self.n_processes, self.n_devices)
+
+
+def make_hybrid_mesh_plan(
+    global_num_shards: int,
+    slots_per_shard: int,
+    n_processes: int,
+    process_id: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> HybridMeshPlan:
+    """This process's slice of the hybrid topology: a (1, n_local)
+    local mesh with the DCN axis outermost, owning the contiguous
+    global shard span ``[pid*spp, (pid+1)*spp)``."""
+    from flink_tpu.utils.jaxcompat import hybrid_device_mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if global_num_shards % n_processes:
+        raise ValueError(
+            f"state.num-key-shards ({global_num_shards}) must divide by "
+            f"cluster.num-processes ({n_processes}) — shards are the "
+            "rescale unit (the key-group contract)")
+    local_shards = global_num_shards // n_processes
+    if local_shards % n:
+        raise ValueError(
+            f"per-process shard span ({local_shards}) must be a multiple "
+            f"of the local device count ({n})")
+    arr = hybrid_device_mesh((1, n), (1, 1), devices)
+    mesh = Mesh(arr, (DCN_AXIS, AXIS))
+    return HybridMeshPlan(
+        mesh=mesh, num_shards=local_shards,
+        slots_per_shard=slots_per_shard,
+        n_processes=n_processes, process_id=process_id)

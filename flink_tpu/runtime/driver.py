@@ -662,38 +662,50 @@ class Driver:
                          bind_host=bind,
                          attempt=int(cfg.get_raw("cluster.attempt", 1)),
                          secret=str(cfg.get(
-                             ClusterOptions.DCN_SECRET) or "") or None)
-        if rendezvous:
-            # coordinator-deployed job: publish this process's listener
-            # and poll until the whole fleet registered (ref: the
-            # reference's TaskManagers learning partition locations
-            # from the JobMaster's deployment descriptors)
-            from flink_tpu.runtime.rpc import RpcClient
+                             ClusterOptions.DCN_SECRET) or "") or None,
+                         io_threads=int(cfg.get(
+                             ClusterOptions.DCN_IO_THREADS)),
+                         buffer_bytes=int(cfg.get(
+                             ClusterOptions.DCN_BUFFER_BYTES)))
+        try:
+            if rendezvous:
+                # coordinator-deployed job: publish this process's
+                # listener and poll until the whole fleet registered
+                # (ref: the reference's TaskManagers learning partition
+                # locations from the JobMaster's deployment descriptors)
+                from flink_tpu.runtime.rpc import RpcClient
 
-            addr = str(cfg.get_raw("cluster.coordinator", "")).strip()
-            job_id = str(cfg.get_raw("cluster.job-id", "job")).strip()
-            attempt = int(cfg.get_raw("cluster.attempt", 1))
-            dcn_host = str(cfg.get_raw("cluster.dcn-host",
-                                       "127.0.0.1")).strip()
-            host, _, port = addr.partition(":")
-            c = RpcClient(host, int(port), timeout_s=5.0)
-            try:
-                c.call("dcn_register", job_id=job_id, attempt=attempt,
-                       process_id=pid, host=dcn_host, port=ex.port)
-                deadline = time.time() + 60.0
-                while True:
-                    resp = c.call("dcn_peers", job_id=job_id,
-                                  attempt=attempt, n_processes=n)
-                    if resp.get("ready"):
-                        peers = resp["peers"]
-                        break
-                    if time.time() > deadline:
-                        raise TimeoutError(
-                            "DCN rendezvous incomplete after 60s")
-                    time.sleep(0.1)
-            finally:
-                c.close()
-        ex.connect(peers)
+                addr = str(cfg.get_raw("cluster.coordinator", "")).strip()
+                job_id = str(cfg.get_raw("cluster.job-id", "job")).strip()
+                attempt = int(cfg.get_raw("cluster.attempt", 1))
+                dcn_host = str(cfg.get_raw("cluster.dcn-host",
+                                           "127.0.0.1")).strip()
+                host, _, port = addr.partition(":")
+                c = RpcClient(host, int(port), timeout_s=5.0)
+                try:
+                    c.call("dcn_register", job_id=job_id, attempt=attempt,
+                           process_id=pid, host=dcn_host, port=ex.port)
+                    deadline = time.time() + 60.0
+                    while True:
+                        resp = c.call("dcn_peers", job_id=job_id,
+                                      attempt=attempt, n_processes=n)
+                        if resp.get("ready"):
+                            peers = resp["peers"]
+                            break
+                        if time.time() > deadline:
+                            raise TimeoutError(
+                                "DCN rendezvous incomplete after 60s")
+                        time.sleep(0.1)
+                finally:
+                    c.close()
+            ex.connect(peers)
+        except BaseException:
+            # a half-connected endpoint must not outlive the attempt: a
+            # LEAKED listener (live accept thread on a fixed
+            # cluster.dcn-port) turns every recovery retry into
+            # EADDRINUSE — the attempt could never rebind its own port
+            ex.close()
+            raise
         self._dcn_key_field = keyed[0].key_field
         self._dcn_shards = num_shards
         return ex
@@ -729,22 +741,48 @@ class Driver:
         watermark / termination / checkpoint consensus), then run the
         local pipeline on this process's share. See exchange/dcn.py for
         why the rendezvous replaces flow control, in-band watermarks,
-        and barrier alignment."""
-        from flink_tpu.records import hash_keys_numpy
+        and barrier alignment.
+
+        STEP OVERLAP (``cluster.dcn-overlap``, default on): step k+1's
+        frames are dispatched BEFORE step k's are consumed, so one
+        step's exchange is always in flight while the device computes
+        the previous step's records and the host ingests/routes the
+        next — the rendezvous barrier moves from dispatch to
+        consumption. The per-step consensus is untouched (metas are
+        identical fleet-wide, so every process makes the same
+        checkpoint/termination decision one step later), and a
+        checkpoint barrier DRAINS the one in-flight step first
+        (``cluster.dcn-overlap-drain``) so the cut still covers every
+        routed record — disabling the drain is the analyzer-flagged
+        at-most-once trade (DCN_OVERLAP_UNSAFE).
+
+        ``pipeline.sub-batches`` = K > 1: this process's merged share is
+        pushed as K contiguous slices with fire dispatches between them
+        (``_push_dcn_merged``) — dispatch granularity shrinks K-fold
+        while the GLOBAL watermark still advances once per rendezvous
+        (the clock is fleet consensus; a sub-step advance would need a
+        sub-step rendezvous), so committed rows stay byte-identical to
+        K=1."""
+        from flink_tpu.exchange.partitioners import hybrid_route
 
         cfg = self.config
         n = int(cfg.get(ClusterOptions.NUM_PROCESSES))
         pid = int(cfg.get(ClusterOptions.PROCESS_ID))
-        spp = self._dcn_shards // n
         key_field = self._dcn_key_field
         (sid,) = list(self.plan.sources)
         d = srcs[sid]
         order = sorted(d)
-        last_chk = time.time()
         ex = self._dcn
-        pending = None          # persisted-but-uncommitted checkpoint
-        pending_id = -1
-        persisted_id = -1       # newest id THIS process holds durably
+        overlap = (bool(cfg.get(ClusterOptions.DCN_OVERLAP))
+                   and ex.supports_async)
+        drain_at_barrier = bool(cfg.get(ClusterOptions.DCN_OVERLAP_DRAIN))
+        st = _DcnStepState(last_chk=time.time())
+        pending_x = None        # the ONE in-flight overlapped step
+        stale_ckpt = False      # drain-off mode: the undrained step's
+        # meta was dispatched BEFORE the snapshot it rode behind, so
+        # its ckpt flag is stale — absorb it once (symmetric: every
+        # process just checkpointed at the same boundary), or the
+        # fleet double-checkpoints back-to-back every interval
         while True:
             batch = None
             batch_ix = None
@@ -767,7 +805,13 @@ class Driver:
                     self._max_ts[sid] = max(self._max_ts[sid], mx)
                     self._wm_gens[sid][batch_ix].on_batch(mx)
                 keys = np.asarray(data[key_field], np.int64)
-                dest = (hash_keys_numpy(keys) % self._dcn_shards) // spp
+                # process destination from the ONE routing truth the
+                # hybrid mesh plan also uses (exchange/partitioners.py):
+                # intra-slice records (dest == pid) never touch the
+                # wire — they ride shares[pid] straight into the local
+                # push, and the in-process device mesh distributes them
+                # over ICI
+                dest, _ = hybrid_route(keys, self._dcn_shards, n)
                 for j in range(n):
                     m = dest == j
                     if m.any():
@@ -779,66 +823,144 @@ class Driver:
                         if order else _FINAL)
             want_ckpt = (pid == 0 and self._coordinator is not None
                          and interval_ms > 0
-                         and (time.time() - last_chk) * 1000 >= interval_ms)
+                         and (time.time() - st.last_chk) * 1000
+                         >= interval_ms)
             meta = {"wm": int(local_wm), "done": batch is None,
                     "ckpt": bool(want_ckpt),
                     # 2PC phase-2 ack: the id this process has DURABLY
                     # persisted (commit waits until everyone has it —
                     # the reference's all-acks-then-notifyComplete rule,
                     # 4.C, carried on the rendezvous instead of RPC)
-                    "persisted": int(persisted_id)}
-            payloads, metas = ex.exchange(shares, meta)
-            parts = [p for p in payloads if p is not None
-                     and len(p["ts"])]
-            if parts:
-                md = {k: np.concatenate([p["data"][k] for p in parts])
-                      for k in parts[0]["data"]}
-                mts = np.concatenate([p["ts"] for p in parts])
-                valid = np.ones(len(mts), bool)
-                with self._push_lock:
-                    self.metrics["records_in"] += len(mts)
-                    self.metrics["batches"] += 1
-                    self._push_downstream(sid, (md, mts, valid))
-                for op in self._ops.values():
-                    if hasattr(op, "throttle"):
-                        op.throttle()
-                self._eps_meter.mark(len(mts))
-            # identical global watermark on every process: min of the
-            # piggybacked locals (exhausted processes report _FINAL so
-            # they stop pinning the clock)
-            gwm = min(int(m["wm"]) for m in metas)
-            if gwm != _FINAL and gwm > self._out_wm[sid]:
-                self._out_wm[sid] = gwm
-            with self._push_lock:
-                self._propagate_watermarks()
-            self._check_drain_error()
-            # commit the PREVIOUS checkpoint once every process acked
-            # durability (phase 2): only then may 2PC sinks publish
-            if (pending is not None
-                    and all(int(m.get("persisted", -1)) >= pending_id
-                            for m in metas)):
-                pending.complete()
-                self._ckpt_pending = None
-                pending = None
-            # checkpoint consensus: process 0's clock decides, the flag
-            # rides the rendezvous, so EVERY process snapshots at this
-            # same step boundary — a globally consistent cut with no
-            # in-flight records (SURVEY §6.4's step-barrier insight)
-            if any(bool(m.get("ckpt")) for m in metas):
-                if self._coordinator is not None and pending is None:
-                    pending = self._begin_checkpoint()
-                    self._ckpt_pending = pending
-                    pending.future.result()  # durable before acking
-                    pending_id = pending.checkpoint_id
-                    persisted_id = pending_id
-                last_chk = time.time()
-            if all(bool(m["done"]) for m in metas):
-                if pending is not None:
+                    "persisted": int(st.persisted_id)}
+            h = ex.exchange_async(shares, meta)
+            if overlap and pending_x is None:
+                # prime the double buffer: nothing to consume yet
+                pending_x = h
+                continue
+            target, pending_x = (pending_x, h) if overlap else (h, None)
+            all_done, ckpt_req = self._dcn_consume_step(
+                sid, target, st, deferred=overlap)
+            if stale_ckpt:
+                ckpt_req = False
+                stale_ckpt = False
+            if not (all_done or ckpt_req):
+                continue
+            if pending_x is not None and (all_done or drain_at_barrier):
+                # drain the in-flight step so the snapshot cut (or the
+                # final barrier) covers its routed records. Its own
+                # consensus flags are ABSORBED — metas are identical
+                # fleet-wide, so every process absorbs the same ones —
+                # except termination, which must still be honored.
+                done2, _ = self._dcn_consume_step(sid, pending_x, st,
+                                                  absorb=True,
+                                                  deferred=True)
+                all_done = all_done or done2
+                pending_x = None
+            if ckpt_req:
+                # checkpoint consensus: process 0's clock decided, the
+                # flag rode the rendezvous, so EVERY process snapshots
+                # at this same step boundary — a globally consistent
+                # cut (SURVEY §6.4's step-barrier insight). With the
+                # drain above there are no in-flight records; with
+                # cluster.dcn-overlap-drain=false the one in-flight
+                # step's records are NOT covered (the analyzer-warned
+                # at-most-once trade).
+                if self._coordinator is not None and st.pending is None:
+                    st.pending = self._begin_checkpoint()
+                    self._ckpt_pending = st.pending
+                    st.pending.future.result()  # durable before acking
+                    st.pending_id = st.pending.checkpoint_id
+                    st.persisted_id = st.pending_id
+                st.last_chk = time.time()
+                # without the drain, the in-flight step still carries
+                # its pre-snapshot ckpt flag — consume it ABSORBED
+                stale_ckpt = pending_x is not None
+            if all_done:
+                if st.pending is not None:
                     # end of input doubles as the final barrier: every
                     # process reached it, so the last cut is global
-                    pending.complete()
+                    st.pending.complete()
                     self._ckpt_pending = None
                 return
+
+    def _dcn_consume_step(self, sid: int, handle, st: "_DcnStepState",
+                          absorb: bool = False,
+                          deferred: bool = False):
+        """Consume ONE rendezvous step: barrier on the handle, push the
+        merged share through the local pipeline, apply the global
+        watermark, and run the 2PC persisted-ack check. Returns
+        (all_done, ckpt_requested); ``absorb`` suppresses the ckpt
+        flag (the drained step rides the barrier that drained it);
+        ``deferred`` marks an OVERLAPPED consume — the only place the
+        dcn.overlap.consume fault point fires, so a chaos bisect of
+        the overlap seam stays quiet on lockstep runs."""
+        if deferred:
+            from flink_tpu import faults
+
+            faults.fire("dcn.overlap.consume", exc=ConnectionError)
+        payloads, metas = handle.result()
+        parts = [p for p in payloads if p is not None
+                 and len(p["ts"])]
+        if parts:
+            md = {k: np.concatenate([p["data"][k] for p in parts])
+                  for k in parts[0]["data"]}
+            mts = np.concatenate([p["ts"] for p in parts])
+            self._push_dcn_merged(sid, md, mts)
+            for op in self._ops.values():
+                if hasattr(op, "throttle"):
+                    op.throttle()
+            self._eps_meter.mark(len(mts))
+        # identical global watermark on every process: min of the
+        # piggybacked locals (exhausted processes report _FINAL so
+        # they stop pinning the clock)
+        gwm = min(int(m["wm"]) for m in metas)
+        if gwm != _FINAL and gwm > self._out_wm[sid]:
+            self._out_wm[sid] = gwm
+        with self._push_lock:
+            self._propagate_watermarks()
+        self._check_drain_error()
+        # commit the PREVIOUS checkpoint once every process acked
+        # durability (phase 2): only then may 2PC sinks publish
+        if (st.pending is not None
+                and all(int(m.get("persisted", -1)) >= st.pending_id
+                        for m in metas)):
+            st.pending.complete()
+            self._ckpt_pending = None
+            st.pending = None
+        ckpt_req = (not absorb) and any(bool(m.get("ckpt")) for m in metas)
+        return all(bool(m["done"]) for m in metas), ckpt_req
+
+    def _push_dcn_merged(self, sid: int, md, mts) -> None:
+        """Push this process's merged exchange share downstream — as
+        ONE batch at K=1 (the exact pre-sub-batch path), or as K
+        contiguous slices with a fire-dispatch pass between them at
+        ``pipeline.sub-batches`` = K > 1, so device dispatch granularity
+        and fire/drain cadence shrink K-fold cross-host too. Record
+        order is untouched (slices are contiguous) and the global
+        watermark is applied by the CALLER after the whole push, so
+        late classification — and committed rows — are byte-identical
+        across K."""
+        nrec = len(mts)
+        valid = np.ones(nrec, bool)
+        k = self._sub_batches
+        if k <= 1 or nrec <= k:
+            with self._push_lock:
+                self.metrics["records_in"] += nrec
+                self.metrics["batches"] += 1
+                self._push_downstream(sid, (md, mts, valid))
+            return
+        with self._push_lock:
+            self.metrics["records_in"] += nrec
+            self.metrics["batches"] += 1
+        sub = -(-nrec // k)  # ceil: ragged tails allowed cross-host
+        for lo in range(0, nrec, sub):
+            hi = min(lo + sub, nrec)
+            with self._push_lock:
+                self._push_downstream(
+                    sid, ({kk: v[lo:hi] for kk, v in md.items()},
+                          mts[lo:hi], valid[lo:hi]))
+                self._propagate_watermarks()
+            self._check_drain_error()
 
     def _maybe_chain_device_source(self, sid: int, n) -> None:
         """Chain a DeviceGeneratorSource into its consuming window
@@ -1223,6 +1345,13 @@ class Driver:
                 self._emit_q = None
             self._drain_error = None
             self._flush_req.clear()
+            # a DCN endpoint alive past its attempt (the negotiated
+            # restore or source setup failed before the ingest loop's
+            # own close) would hold its fixed cluster.dcn-port —
+            # every recovery rebind then dies with EADDRINUSE
+            if getattr(self, "_dcn", None) is not None:
+                self._dcn.close()
+                self._dcn = None
             # rows delivered BEFORE the crash still sit in sink buffers;
             # drop them here too — the restore path only runs when the
             # next attempt configures restore (ref: StreamTask
@@ -1283,14 +1412,6 @@ class Driver:
                     "v1 — the DCN rendezvous is a per-step streaming "
                     "protocol; cross-host batch needs a partition-file "
                     "transfer plane (out of scope, see COMPONENTS #57)")
-            if self._sub_batches > 1:
-                raise NotImplementedError(
-                    "pipeline.sub-batches > 1 is single-process in v1 "
-                    "— the DCN rendezvous (watermark/termination/"
-                    "checkpoint consensus) is a per-LOGICAL-batch "
-                    "protocol; interleaving sub-batch fires would need "
-                    "a sub-step rendezvous. Run cross-host jobs with "
-                    "sub-batches=1")
             self._dcn = self._dcn_connect()
 
         # per-source sub-batch factor the restored checkpoint's positions
@@ -2248,6 +2369,18 @@ class Driver:
             finally:
                 self._flush_req.clear()
         self._check_drain_error()
+
+
+@dataclasses.dataclass
+class _DcnStepState:
+    """Per-run mutable state of the cross-host step loop, threaded
+    through ``_dcn_consume_step`` so the overlapped and lockstep paths
+    share one consume implementation."""
+
+    last_chk: float = 0.0
+    pending: Any = None     # persisted-but-uncommitted checkpoint
+    pending_id: int = -1
+    persisted_id: int = -1  # newest id THIS process holds durably
 
 
 class _DevBatch:

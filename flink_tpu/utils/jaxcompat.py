@@ -21,3 +21,36 @@ except AttributeError:
         shard_map = None
 
 HAS_SHARD_MAP = shard_map is not None
+
+try:
+    from jax.experimental.mesh_utils import create_hybrid_device_mesh
+except ImportError:  # pragma: no cover - older mesh_utils layout
+    create_hybrid_device_mesh = None
+
+HAS_HYBRID_MESH = create_hybrid_device_mesh is not None
+
+
+def hybrid_device_mesh(mesh_shape, dcn_mesh_shape, devices):
+    """``create_hybrid_device_mesh`` with a reshape fallback: the ICI
+    axes (``mesh_shape``) index within a slice, the DCN axes
+    (``dcn_mesh_shape``) across slices (SNIPPETS.md [1] — the hybrid
+    topology that keeps intra-slice collectives off the slow plane).
+    Returns a device ndarray of elementwise shape ``dcn * ici``.
+
+    The jax helper groups devices by process granule; on a
+    single-granule fleet (one process's local devices, or the virtual
+    CPU mesh) it rejects multi-slice shapes, so any single-granule —
+    or shim-less — call falls back to a plain C-order reshape, which
+    is exactly the hybrid layout when the device list is already
+    slice-major."""
+    import numpy as np
+
+    devices = list(devices)
+    shape = tuple(d * i for d, i in zip(dcn_mesh_shape, mesh_shape))
+    if create_hybrid_device_mesh is not None and any(
+            d > 1 for d in dcn_mesh_shape):
+        granules = {getattr(d, "process_index", 0) for d in devices}
+        if len(granules) > 1:
+            return create_hybrid_device_mesh(
+                mesh_shape, dcn_mesh_shape, devices)
+    return np.asarray(devices, dtype=object).reshape(shape)

@@ -196,6 +196,18 @@ def _subbatch_indivisible(tmp_path):
         "pipeline.sub-batches": 3}))
 
 
+@seed("DCN_OVERLAP_UNSAFE")
+def _dcn_overlap_without_drain(tmp_path):
+    # the loss-tolerant perf trade made silently: overlapped cross-host
+    # exchange + checkpointing with the barrier drain off — a restore
+    # would skip the one in-flight step's records. Clean negatives in
+    # TestDcnOverlapUnsafeNegatives below.
+    return analyze_config(Configuration({
+        "cluster.num-processes": 2,
+        "execution.checkpointing.interval": 500,
+        "cluster.dcn-overlap-drain": False}))
+
+
 @seed("CHECKPOINT_IN_BATCH")
 def _checkpoint_in_batch(tmp_path):
     # config-only rule: no pipeline needed
@@ -306,6 +318,38 @@ class TestSessionHaUnsafeNegatives:
             "session.max-jobs": 4,
             "execution.checkpointing.interval": 500,
             "high-availability.dir": str(tmp_path)}) == []
+
+
+class TestDcnOverlapUnsafeNegatives:
+    """DCN_OVERLAP_UNSAFE fires ONLY on the losing shape: cross-host +
+    checkpointing + overlap on + drain off. Each leg missing keeps it
+    quiet (seeded violation in SEEDS above)."""
+
+    def _hits(self, conf):
+        return [f for f in analyze_config(Configuration(conf))
+                if f.rule == "DCN_OVERLAP_UNSAFE"]
+
+    def test_default_drain_is_clean(self):
+        assert self._hits({
+            "cluster.num-processes": 2,
+            "execution.checkpointing.interval": 500}) == []
+
+    def test_single_process_is_clean(self):
+        assert self._hits({
+            "execution.checkpointing.interval": 500,
+            "cluster.dcn-overlap-drain": False}) == []
+
+    def test_no_checkpointing_is_clean(self):
+        assert self._hits({
+            "cluster.num-processes": 2,
+            "cluster.dcn-overlap-drain": False}) == []
+
+    def test_lockstep_loop_is_clean(self):
+        assert self._hits({
+            "cluster.num-processes": 2,
+            "execution.checkpointing.interval": 500,
+            "cluster.dcn-overlap": False,
+            "cluster.dcn-overlap-drain": False}) == []
 
 
 class TestRuleCatalog:

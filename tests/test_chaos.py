@@ -551,9 +551,11 @@ class TestChaosDcn:
             s.close()
         return ports
 
-    def test_dcn_peer_death_mid_exchange_recovers_exactly_once(
-            self, tmp_path):
-        golden = self._golden(tmp_path)
+    def _run_fleet(self, tmp_path, plan, extra_conf=None,
+                   expected_log=None, subdir="c-ckpt"):
+        """Two in-process 'processes' through the DCN exchange under
+        ``plan``; asserts both recover, the injection log matches, and
+        returns the committed union."""
         ports = self._free_ports(2)
         peers = ",".join(f"127.0.0.1:{p}" for p in ports)
         sinks = [TransactionalCollectSink() for _ in range(2)]
@@ -573,30 +575,27 @@ class TestChaosDcn:
             return build_env
 
         def run(pid):
-            conf = Configuration({
+            c = {
                 "state.num-key-shards": 8, "state.slots-per-shard": 64,
                 "pipeline.microbatch-size": 64,
                 "cluster.num-processes": 2, "cluster.process-id": pid,
                 "cluster.dcn-peers": peers,
                 "cluster.dcn-port": ports[pid],
                 "cluster.dcn-secret": "chaos-suite-secret",
-                "execution.checkpointing.dir": str(tmp_path / "c-ckpt"),
+                "execution.checkpointing.dir": str(tmp_path / subdir),
                 "execution.checkpointing.interval": 1,
                 "restart-strategy.type": "fixed-delay",
                 "restart-strategy.fixed-delay.attempts": 10,
                 "restart-strategy.fixed-delay.delay": 200,
-            })
+            }
+            c.update(extra_conf or {})
             try:
                 results[pid] = run_with_recovery(
-                    make_build(pid), conf, job_name="dcn-chaos")
+                    make_build(pid), Configuration(c),
+                    job_name="dcn-chaos")
             except BaseException as e:  # surfaces in the assert below
                 results[pid] = e
 
-        # one mid-run frame send (the 7th across the fleet) drops: the
-        # victim attempt dies mid-exchange, its sockets close, the PEER's
-        # recv collapses — both fail over and re-rendezvous
-        plan = (faults.FaultPlan(seed=CHAOS_SEED)
-                .rule("dcn.send", "drop", count=1, after=6))
         tracer.clear()
         with plan.activate(), replayable(plan):
             ths = [threading.Thread(target=run, args=(i,))
@@ -609,12 +608,58 @@ class TestChaosDcn:
             for pid, r in enumerate(results):
                 assert not isinstance(r, BaseException), (
                     f"p{pid} did not recover: {r!r}")
-            assert [x[:2] for x in plan.log] == [("dcn.send", "drop")]
-            union = sorted(committed_view(sinks[0])
-                           + committed_view(sinks[1]))
-            assert union == golden
+            if expected_log is not None:
+                assert sorted(x[:2] for x in plan.log) == sorted(
+                    expected_log), plan.log
             # both processes failed over at least once, visibly
             assert len(tracer.spans("recovery")) >= 2
+            return sorted(committed_view(sinks[0])
+                          + committed_view(sinks[1]))
+
+    def test_dcn_peer_death_mid_exchange_recovers_exactly_once(
+            self, tmp_path):
+        # one mid-run frame send (the 7th across the fleet) drops: the
+        # victim attempt dies mid-exchange, its sockets close, the PEER's
+        # recv collapses — both fail over and re-rendezvous
+        golden = self._golden(tmp_path)
+        plan = (faults.FaultPlan(seed=CHAOS_SEED)
+                .rule("dcn.send", "drop", count=1, after=6))
+        union = self._run_fleet(tmp_path, plan,
+                                expected_log=[("dcn.send", "drop")])
+        assert union == golden
+
+    def test_dcn_parallel_send_worker_death_recovers_exactly_once(
+            self, tmp_path):
+        """Faults on the PARALLEL I/O plane: a sender-WORKER-thread
+        write dies mid-step (dcn.send.partial — the connection cut
+        under a peer, detected at the step barrier via the first-error
+        cell) and later a frame encode fails on the caller thread.
+        Committed union stays byte-identical to the fault-free
+        golden."""
+        golden = self._golden(tmp_path)
+        plan = (faults.FaultPlan(seed=CHAOS_SEED)
+                .rule("dcn.send.partial", "drop", count=1, after=5)
+                .rule("dcn.frame.encode", "raise", count=1, after=24))
+        union = self._run_fleet(
+            tmp_path, plan,
+            expected_log=[("dcn.send.partial", "drop"),
+                          ("dcn.frame.encode", "raise")])
+        assert union == golden
+
+    def test_dcn_overlap_consume_fault_recovers_exactly_once(
+            self, tmp_path):
+        """A fault at the OVERLAPPED consume seam (the deferred step
+        barrier) collapses the attempt while a second exchange step is
+        in flight; recovery re-negotiates a common checkpoint and the
+        committed union still equals the golden run — exactly-once on
+        the overlapped path."""
+        golden = self._golden(tmp_path)
+        plan = (faults.FaultPlan(seed=CHAOS_SEED)
+                .rule("dcn.overlap.consume", "raise", count=1, after=4))
+        union = self._run_fleet(
+            tmp_path, plan,
+            expected_log=[("dcn.overlap.consume", "raise")])
+        assert union == golden
 
 
 @pytest.mark.slow

@@ -12,9 +12,23 @@ import numpy as np
 import pytest
 
 from flink_tpu.checkpoint import blobformat
+from flink_tpu.exchange import frames
 from flink_tpu.exchange.dcn import DcnExchange
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _hello(sender, attempt, codec=1, auth=0, secret=None):
+    """A v2 wire hello (magic + sender + attempt + codec + auth flag,
+    optionally MAC'd) — what a well-formed dialer sends."""
+    import hmac
+    import struct
+
+    h = (b"D2" + bytes([sender]) + struct.pack(">I", attempt)
+         + bytes([codec, auth]))
+    if secret is not None:
+        h += hmac.new(secret, h, "sha256").digest()
+    return h
 
 
 class TestExchange:
@@ -229,7 +243,7 @@ class TestAttemptFencing:
         # stale dialer (attempt 1) connects first and must NOT occupy
         # peer slot 1
         stale = _socket.create_connection(("127.0.0.1", fresh[0].port))
-        stale.sendall(bytes([1]) + _struct.pack(">I", 1) + b"\x00")
+        stale.sendall(_hello(1, 1))
         time.sleep(0.1)
 
         done = []
@@ -337,6 +351,138 @@ class TestTier5TwoProcessQ5:
         assert _collect(tmp_path, 2) == golden
 
 
+class TestDcnSubBatchAndOverlap:
+    """Cross-host contract of pipeline.sub-batches (the rendezvous is
+    per-LOGICAL-batch; K slices the local push only, so committed rows
+    are identical across K) and of cluster.dcn-overlap on/off (the
+    barrier moves, the consensus does not)."""
+
+    N_BATCHES = 8
+    B = 64
+
+    def _gen(self):
+        n_batches, b = self.N_BATCHES, self.B
+
+        def gen(split, i):
+            if i >= n_batches:
+                return None
+            rng = np.random.default_rng(500 * int(split) + i)
+            keys = rng.integers(0, 32, b).astype(np.int64)
+            ts = i * 1000 + rng.integers(0, 1000, b).astype(np.int64)
+            return {"k": keys}, ts
+        return gen
+
+    def _golden(self):
+        from flink_tpu.api.environment import StreamExecutionEnvironment
+        from flink_tpu.api.sinks import FnSink
+        from flink_tpu.api.sources import GeneratorSource
+        from flink_tpu.api.windowing import TumblingEventTimeWindows
+        from flink_tpu.config import Configuration
+        from flink_tpu.time.watermarks import WatermarkStrategy
+
+        rows = []
+        env = StreamExecutionEnvironment(Configuration({
+            "state.num-key-shards": 8, "state.slots-per-shard": 64,
+            "pipeline.microbatch-size": self.B}))
+        (env.from_source(GeneratorSource(self._gen(), n_splits=2),
+                         WatermarkStrategy.for_bounded_out_of_orderness(
+                             1000))
+         .key_by("k")
+         .window(TumblingEventTimeWindows.of(1000))
+         .count()
+         .add_sink(FnSink(lambda b: rows.extend(
+             zip(np.asarray(b["key"]).tolist(),
+                 np.asarray(b["window_end"]).tolist(),
+                 np.asarray(b["count"]).tolist())) if b else None)))
+        env.execute("golden")
+        return sorted(rows)
+
+    def _two_proc(self, extra_conf):
+        import threading
+
+        from flink_tpu.api.environment import StreamExecutionEnvironment
+        from flink_tpu.api.sinks import FnSink
+        from flink_tpu.api.sources import GeneratorSource
+        from flink_tpu.api.windowing import TumblingEventTimeWindows
+        from flink_tpu.config import Configuration
+        from flink_tpu.time.watermarks import WatermarkStrategy
+
+        ports = _free_ports(2)
+        peers = ",".join(f"127.0.0.1:{p}" for p in ports)
+        per_pid = [[], []]
+        errs = [None, None]
+
+        def run(pid):
+            rows = per_pid[pid]
+            conf = {
+                "state.num-key-shards": 8, "state.slots-per-shard": 64,
+                "pipeline.microbatch-size": self.B,
+                "cluster.num-processes": 2, "cluster.process-id": pid,
+                "cluster.dcn-peers": peers,
+                "cluster.dcn-port": ports[pid],
+            }
+            conf.update(extra_conf)
+            env = StreamExecutionEnvironment(Configuration(conf))
+            (env.from_source(GeneratorSource(self._gen(), n_splits=2),
+                             WatermarkStrategy
+                             .for_bounded_out_of_orderness(1000))
+             .key_by("k")
+             .window(TumblingEventTimeWindows.of(1000))
+             .count()
+             .add_sink(FnSink(lambda b: rows.extend(
+                 zip(np.asarray(b["key"]).tolist(),
+                     np.asarray(b["window_end"]).tolist(),
+                     np.asarray(b["count"]).tolist())) if b else None)))
+            try:
+                env.execute(f"subbatch-p{pid}")
+            except BaseException as e:  # surfaced by the caller
+                errs[pid] = e
+
+        ths = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in ths), "2-proc run hung"
+        for pid, e in enumerate(errs):
+            assert e is None, f"p{pid} failed: {e!r}"
+        return [sorted(r) for r in per_pid]
+
+    def test_sub_batches_no_longer_rejected_and_byte_identical(self):
+        """K=4 cross-host runs (was a hard NotImplementedError at the
+        driver) and every process emits EXACTLY the rows its K=1 twin
+        does — the global watermark still advances once per rendezvous,
+        so fire content, ownership, and late classification are
+        untouched by the sub-batch slicing."""
+        golden = self._golden()
+        k1 = self._two_proc({"pipeline.sub-batches": 1})
+        k4 = self._two_proc({"pipeline.sub-batches": 4})
+        assert sorted(k1[0] + k1[1]) == golden
+        assert k4 == k1  # per-process byte-identity, not just the union
+
+    def test_overlap_without_drain_completes_and_matches(self, tmp_path):
+        """The analyzer-warned loss mode (overlap on, barrier drain
+        off) under checkpointing, with NO faults: nothing is in flight
+        at end-of-input, so output still matches — and the undrained
+        step's STALE ckpt flag is absorbed exactly once (it rode
+        behind the snapshot), so the fleet stays in lockstep instead
+        of double-checkpointing every interval."""
+        rows = self._two_proc({
+            "cluster.dcn-overlap-drain": False,
+            "execution.checkpointing.interval": 25,
+            "execution.checkpointing.dir": str(tmp_path / "ckpt")})
+        assert sorted(rows[0] + rows[1]) == self._golden()
+
+    def test_overlap_off_matches_overlap_on(self):
+        """cluster.dcn-overlap moves the barrier, not the semantics:
+        lockstep (off) and overlapped (on, the default) runs emit
+        identical rows per process."""
+        on = self._two_proc({})
+        off = self._two_proc({"cluster.dcn-overlap": False})
+        assert on == off
+        assert sorted(on[0] + on[1]) == self._golden()
+
+
 class TestExchangeSecurity:
     """ADVICE r5 medium: the exchange port was an unauthenticated RCE
     surface on cross-host (0.0.0.0) deployments — frames decode through
@@ -360,8 +506,7 @@ class TestExchangeSecurity:
 
         # attacker: well-formed keyed hello, garbage MAC
         bad = _socket.create_connection(("127.0.0.1", exs[0].port))
-        bad.sendall(bytes([1]) + _struct.pack(">I", 1) + b"\x01"
-                    + b"\x00" * 32)
+        bad.sendall(_hello(1, 1, auth=1) + b"\x00" * 32)
         time.sleep(0.1)
 
         out = []
@@ -392,7 +537,7 @@ class TestExchangeSecurity:
 
         ex = DcnExchange(0, 2, attempt=1, secret="job-secret")
         legacy = _socket.create_connection(("127.0.0.1", ex.port))
-        legacy.sendall(bytes([1]) + _struct.pack(">I", 1) + b"\x00")
+        legacy.sendall(_hello(1, 1))
         raw = blobformat.encode({"data": None, "meta": {}})
         legacy.sendall(_struct.pack(">Q", len(raw)) + raw)
         legacy.settimeout(2)
@@ -416,9 +561,7 @@ class TestExchangeSecurity:
 
         ex = DcnExchange(0, 2, attempt=1)  # no secret
         keyed = _socket.create_connection(("127.0.0.1", ex.port))
-        hello = bytes([1]) + _struct.pack(">I", 1) + b"\x01"
-        keyed.sendall(hello + _hmac2.new(b"other-secret", hello,
-                                         "sha256").digest())
+        keyed.sendall(_hello(1, 1, auth=1, secret=b"other-secret"))
         keyed.settimeout(2)
         try:
             got = keyed.recv(1)
@@ -430,8 +573,10 @@ class TestExchangeSecurity:
         ex.close()
 
     def test_pickle_escape_frame_rejected(self):
-        """A frame smuggling a __pickle__ escape must fail the decode
-        loudly instead of deserializing attacker-controlled pickle."""
+        """A legacy frame smuggling a __pickle__ escape must fail the
+        decode loudly instead of deserializing attacker-controlled
+        pickle (the legacy codec survives as the benchmark baseline —
+        it keeps the rejection)."""
         import socket as _socket
         import struct as _struct
 
@@ -441,10 +586,9 @@ class TestExchangeSecurity:
         raw = blobformat.encode({"data": evil, "meta": {}})
         assert b"__pickle__" in raw  # the attack vector exists in-band
 
-        ex = DcnExchange(0, 2, attempt=1)
+        ex = DcnExchange(0, 2, attempt=1, codec="legacy")
         s = _socket.create_connection(("127.0.0.1", ex.port))
-        s.sendall(bytes([1]) + _struct.pack(">I", 1)
-                  + b"\x00")  # valid unkeyed hello
+        s.sendall(_hello(1, 1, codec=0))  # valid unkeyed legacy hello
         deadline = time.time() + 5
         while 1 not in ex._in and time.time() < deadline:
             time.sleep(0.02)
@@ -454,6 +598,111 @@ class TestExchangeSecurity:
             ex.exchange({}, {})
         s.close()
         ex.close()
+
+    def test_binary_frame_has_no_pickle_vector(self):
+        """The binary wire rejects foreign objects AT ENCODE — there is
+        no pickle escape for a hostile frame to smuggle through, and a
+        corrupt frame fails the CRC, not the keyspace."""
+        evil = np.array([{"x": 1}], dtype=object)
+        with pytest.raises(frames.FrameError, match="no pickle escape"):
+            frames.encode_bytes(0, 0, {}, {"data": evil})
+
+    def test_corrupt_binary_frame_fails_loudly_at_the_barrier(self):
+        """Garbage after a valid binary hello must surface as a loud
+        FrameError at the exchange barrier — never a silent partial
+        decode into operator state."""
+        import socket as _socket
+
+        ex = DcnExchange(0, 2, attempt=1)
+        s = _socket.create_connection(("127.0.0.1", ex.port))
+        s.sendall(_hello(1, 1))
+        deadline = time.time() + 5
+        while 1 not in ex._in and time.time() < deadline:
+            time.sleep(0.02)
+        assert 1 in ex._in
+        ex._start_io()  # the mesh is "up" for this half-duplex probe
+        s.sendall(b"\x00" * frames.HEADER_LEN)
+        with pytest.raises(frames.FrameError, match="magic"):
+            ex.exchange_async({}, {"wm": 0}).result()
+        s.close()
+        ex.close()
+
+    def test_legacy_v0_hello_rejected_at_handshake(self):
+        """A pre-binary-wire peer (the v0 6-byte hello: no magic) must
+        be fenced out AT THE HELLO with a recorded reason — a
+        mixed-version fleet fails at admission, never by misparsing a
+        foreign frame mid-stream."""
+        import socket as _socket
+        import struct as _struct
+
+        ex = DcnExchange(0, 2, attempt=1)
+        old = _socket.create_connection(("127.0.0.1", ex.port))
+        # the exact v0 hello wire shape + enough follow-on bytes that
+        # the 9-byte v2 read never blocks on a short hello
+        old.sendall(bytes([1]) + _struct.pack(">I", 1) + b"\x00"
+                    + b"\x00" * 8)
+        old.settimeout(5)
+        try:
+            got = old.recv(1)
+        except (ConnectionResetError, _socket.timeout):
+            got = b""
+        assert got == b"", "v0 hello not dropped"
+        assert 1 not in ex._in
+        assert any("wire version" in r for r in ex.hello_rejects), (
+            ex.hello_rejects)
+        old.close()
+        ex.close()
+
+    def test_codec_mismatch_rejected_at_handshake(self):
+        """A peer pinned to the LEGACY codec dialing a binary listener
+        (or vice versa) is rejected at the hello — a frame-format split
+        brain would otherwise corrupt mid-stream."""
+        import socket as _socket
+
+        ex = DcnExchange(0, 2, attempt=1)  # binary listener
+        peer = _socket.create_connection(("127.0.0.1", ex.port))
+        peer.sendall(_hello(1, 1, codec=0))  # legacy dialer
+        peer.settimeout(5)
+        try:
+            got = peer.recv(1)
+        except (ConnectionResetError, _socket.timeout):
+            got = b""
+        assert got == b"", "codec-mismatched hello not dropped"
+        assert 1 not in ex._in
+        assert any("codec mismatch" in r for r in ex.hello_rejects), (
+            ex.hello_rejects)
+        peer.close()
+        ex.close()
+
+    def test_mixed_codec_fleet_fails_loudly_at_connect(self):
+        """Fleet-level interop: one binary and one legacy process can
+        never form a mesh — connect() times out with the listener's
+        reject recorded, instead of the fleet limping into mid-frame
+        garbage."""
+        import threading
+
+        a = DcnExchange(0, 2, attempt=1, codec="binary")
+        b = DcnExchange(1, 2, attempt=1, codec="legacy")
+        peers = [f"127.0.0.1:{a.port}", f"127.0.0.1:{b.port}"]
+        errs = {}
+
+        def run(ex, i):
+            try:
+                ex.connect(peers, timeout_s=3)
+            except TimeoutError as e:
+                errs[i] = e
+
+        ths = [threading.Thread(target=run, args=(ex, i))
+               for i, ex in enumerate((a, b))]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=20)
+        assert set(errs) == {0, 1}, "mixed fleet formed a mesh"
+        assert any("codec mismatch" in r for r in a.hello_rejects)
+        assert any("codec mismatch" in r for r in b.hello_rejects)
+        a.close()
+        b.close()
 
     def test_numeric_frames_unaffected_by_pickle_rejection(self):
         """The production payload shape (numeric arrays + scalar meta)

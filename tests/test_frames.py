@@ -1,0 +1,242 @@
+"""Binary DCN frame codec edge cases (ISSUE 12 satellite, mirroring
+the columnar-format discipline of tests/test_formats_columnar.py):
+randomized round-trip vs the old blobformat frames as oracle,
+truncation mid-header and mid-array, CRC corruption, and version/magic
+mismatch — every failure LOUD, never a silent partial decode.
+
+ref role: the serialization tests of the reference's network stack
+(NettyMessage framing + TypeSerializer round trips, SURVEY §3.6) —
+except this wire format is self-contained (pure struct+numpy+zlib)."""
+import struct
+
+import numpy as np
+import pytest
+
+from flink_tpu.checkpoint import blobformat
+from flink_tpu.exchange import frames
+from flink_tpu.exchange.frames import FrameError
+
+
+def _share(rng, n):
+    """The production exchange payload shape: routed record columns +
+    timestamps."""
+    return {
+        "data": {
+            "auction": rng.integers(-2**40, 2**40, n).astype(np.int64),
+            "price": rng.random(n).astype(np.float32),
+            "d": rng.random(n).astype(np.float64),
+            "flag": rng.integers(0, 2, n).astype(bool),
+            "line": np.array(
+                ["w" + str(int(v)) + ("é" if v % 3 == 0 else "")
+                 for v in rng.integers(0, 1000, n)], dtype=object),
+        },
+        "ts": rng.integers(0, 2**42, n).astype(np.int64),
+    }
+
+
+PROD_META = {"wm": 12345, "done": False, "ckpt": True, "persisted": -1}
+
+
+class TestRoundTrip:
+    def test_production_shape_round_trip(self):
+        rng = np.random.default_rng(0)
+        payload = _share(rng, 257)
+        raw = frames.encode_bytes(3, 9, PROD_META, payload)
+        sender, step, meta, got = frames.decode(raw)
+        assert (sender, step) == (3, 9)
+        assert meta == PROD_META
+        np.testing.assert_array_equal(got["ts"], payload["ts"])
+        for name, col in payload["data"].items():
+            np.testing.assert_array_equal(got["data"][name], col)
+
+    def test_property_round_trip_vs_blobformat_oracle(self):
+        """Randomized payloads: the binary frame and the legacy
+        blobformat wire must reconstruct the SAME arrays from the same
+        share — blobformat is the established oracle (it carried every
+        DCN byte before this PR), binary must agree bit-exactly."""
+        rng = np.random.default_rng(42)
+        for trial in range(20):
+            n = int(rng.integers(0, 200))
+            payload = _share(rng, n)
+            meta = {"wm": int(rng.integers(-2**60, 2**60)),
+                    "done": bool(rng.integers(0, 2)),
+                    "ckpt": bool(rng.integers(0, 2)),
+                    "persisted": int(rng.integers(-1, 100))}
+            _, _, via_bin_meta, via_bin = frames.decode(
+                frames.encode_bytes(0, trial, meta, payload))
+            legacy = blobformat.decode(
+                blobformat.encode({"data": payload, "meta": meta}),
+                allow_pickle=False)
+            assert via_bin_meta == meta == legacy["meta"]
+            np.testing.assert_array_equal(via_bin["ts"],
+                                          legacy["data"]["ts"])
+            for name in payload["data"]:
+                np.testing.assert_array_equal(
+                    via_bin["data"][name],
+                    legacy["data"]["data"][name])
+
+    def test_zero_copy_numeric_decode(self):
+        """Numeric array leaves are VIEWS into the received buffer —
+        the no-per-step-copy contract of the binary plane."""
+        raw = frames.encode_bytes(
+            0, 0, {"wm": 1}, {"ts": np.arange(64, dtype=np.int64)})
+        _, _, _, payload = frames.decode(raw)
+        assert np.shares_memory(payload["ts"],
+                                np.frombuffer(raw, np.uint8))
+
+    def test_none_empty_and_bare_payloads(self):
+        """The rendezvous sends None (no share for that peer), {} is
+        distinct from None, and the micro-benchmark ships bare
+        arrays."""
+        for payload in (None, {}, np.arange(5, dtype=np.int64)):
+            raw = frames.encode_bytes(1, 0, {"wm": 0}, payload)
+            _, _, _, got = frames.decode(raw)
+            if payload is None:
+                assert got is None
+            elif isinstance(payload, dict):
+                assert got == {}
+            else:
+                np.testing.assert_array_equal(got, payload)
+
+    def test_meta_presence_exact(self):
+        """Meta round-trips with EXACTLY the keys the sender set (the
+        header flags carry presence, not just values) and non-standard
+        keys ride the extras section."""
+        for meta in ({}, {"wm": 7}, {"done": True}, {"latest": 3},
+                     {"wm": 2**62, "persisted": 10, "latest": -1},
+                     PROD_META):
+            raw = frames.encode_bytes(0, 0, meta, None)
+            _, _, got, _ = frames.decode(raw)
+            assert got == meta
+        # the hot-path production meta must produce NO extras JSON
+        raw = frames.encode_bytes(0, 0, PROD_META, None)
+        (extras_len,) = struct.unpack_from(">I", raw, frames.HEADER_LEN)
+        assert extras_len == 0
+
+    def test_zero_row_share_round_trips_typed(self):
+        rng = np.random.default_rng(1)
+        payload = _share(rng, 0)
+        _, _, _, got = frames.decode(
+            frames.encode_bytes(0, 0, {"wm": 0}, payload))
+        assert len(got["ts"]) == 0 and got["ts"].dtype == np.int64
+        assert got["data"]["price"].dtype == np.float32
+        assert got["data"]["line"].dtype == object
+
+    def test_any_column_name_round_trips(self):
+        """No reserved characters in column names (the legacy wire
+        carried arbitrary names; the binary path field is
+        length-prefixed SEGMENTS, so separators need no escaping)."""
+        payload = {"data": {"meta/id": np.arange(3, dtype=np.int64),
+                            "a/b/c": np.arange(3, dtype=np.int64),
+                            "": np.arange(3, dtype=np.int64)},
+                   "ts": np.arange(3, dtype=np.int64)}
+        _, _, _, got = frames.decode(
+            frames.encode_bytes(0, 0, {"wm": 1}, payload))
+        assert set(got["data"]) == {"meta/id", "a/b/c", ""}
+        np.testing.assert_array_equal(got["data"]["meta/id"],
+                                      payload["data"]["meta/id"])
+
+    def test_scatter_buffers_equal_joined_bytes(self):
+        """encode() (the sendmsg scatter list) and encode_bytes() are
+        the same wire bytes — what the bench sends is what tests
+        decode."""
+        rng = np.random.default_rng(2)
+        payload = _share(rng, 33)
+        bufs = frames.encode(5, 2, PROD_META, payload)
+        assert b"".join(bytes(b) for b in bufs) == frames.encode_bytes(
+            5, 2, PROD_META, payload)
+
+
+class TestLoudFailures:
+    def _frame(self, n=64):
+        return frames.encode_bytes(
+            0, 0, PROD_META, _share(np.random.default_rng(3), n))
+
+    def test_truncated_mid_header(self):
+        with pytest.raises(FrameError, match="truncated"):
+            frames.decode(self._frame()[:frames.HEADER_LEN // 2])
+
+    def test_truncated_mid_descriptor(self):
+        raw = self._frame()
+        with pytest.raises(FrameError, match="truncated"):
+            frames.decode(raw[:frames.HEADER_LEN + 12])
+
+    def test_truncated_mid_array(self):
+        raw = self._frame()
+        with pytest.raises(FrameError, match="truncated"):
+            frames.decode(raw[:-17])
+
+    def test_crc_corruption_loud(self):
+        raw = bytearray(self._frame())
+        raw[-5] ^= 0xFF  # flip one payload byte in the last section
+        with pytest.raises(FrameError, match="CRC mismatch"):
+            frames.decode(bytes(raw))
+
+    def test_bad_magic_rejected(self):
+        raw = bytearray(self._frame())
+        raw[0:4] = b"NOPE"
+        with pytest.raises(FrameError, match="magic"):
+            frames.decode(bytes(raw))
+
+    def test_legacy_blobformat_frame_rejected_as_magic_mismatch(self):
+        """A v0 wire frame (8-byte length + blobformat) read by the
+        binary decoder fails at the MAGIC, naming the likely cause —
+        the mixed-version tripwire below the hello fence."""
+        legacy = blobformat.encode({"data": None, "meta": {}})
+        wire = struct.pack(">Q", len(legacy)) + legacy
+        with pytest.raises(FrameError, match="legacy blobformat"):
+            frames.decode(wire)
+
+    def test_version_mismatch_rejected(self):
+        raw = bytearray(self._frame())
+        struct.pack_into(">H", raw, 4, frames.VERSION + 1)
+        with pytest.raises(FrameError, match="mixed-version"):
+            frames.decode(bytes(raw))
+
+    def test_hostile_body_len_rejected(self):
+        """A corrupt/hostile header claiming a huge body must be
+        rejected BEFORE any allocation."""
+        raw = bytearray(self._frame())
+        struct.pack_into(">Q", raw, frames.HEADER_LEN - 8, 1 << 60)
+        with pytest.raises(FrameError, match="hostile|corrupt"):
+            frames.decode(bytes(raw))
+
+    def test_object_array_with_foreign_objects_rejected_at_encode(self):
+        """No pickle escape exists in this format BY CONSTRUCTION —
+        foreign objects die at encode, on the sender, loudly."""
+        evil = np.array([{"x": 1}], dtype=object)
+        with pytest.raises(FrameError, match="no pickle escape"):
+            frames.encode_bytes(0, 0, {}, {"data": evil})
+
+    def test_non_utf8_bytes_rejected_at_encode_on_the_sender(self):
+        """A text column carrying non-UTF8 bytes must die at ENCODE on
+        the sender (attributable) — never as a UnicodeDecodeError in
+        the PEER's recv loop, which would be a poison pill every
+        recovery attempt re-triggers."""
+        bad = np.array([b"\xff\xfe"], dtype=object)
+        with pytest.raises(FrameError, match="non-UTF8"):
+            frames.encode_bytes(0, 0, {}, {"data": {"line": bad}})
+
+    def test_utf8_bytes_round_trip_as_decoded_text(self):
+        """np.bytes_/bytes values round-trip as DECODED TEXT, the same
+        rule formats_columnar applies — never the repr "b'...'" and
+        never a silent type flip the receiver can't predict."""
+        b = {"s": np.array([b"abc", "caf\xc3\xa9".encode("latin-1")],
+                           dtype=object)}
+        _, _, _, got = frames.decode(frames.encode_bytes(0, 0, {}, b))
+        assert list(got["s"]) == ["abc", "café"]
+
+    def test_array_section_size_mismatch_rejected(self):
+        """A descriptor whose nbytes disagrees with dtype x shape is a
+        codec error, not a silent reshape."""
+        raw = frames.encode_bytes(0, 0, {"wm": 0},
+                                  {"ts": np.arange(8, dtype=np.int64)})
+        b = bytearray(raw)
+        # descriptor layout after extras: name_len,dtype_len,kind,ndim,
+        # nbytes(u64),crc(u32) — shrink the declared shape's dim
+        desc_off = frames.HEADER_LEN + 4
+        name_len, dtype_len = struct.unpack_from(">HB", b, desc_off)
+        shape_off = desc_off + 17 + name_len + dtype_len
+        struct.pack_into(">I", b, shape_off, 4)  # shape (8,) -> (4,)
+        with pytest.raises(FrameError, match="needs"):
+            frames.decode(bytes(b))

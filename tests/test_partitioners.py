@@ -134,3 +134,154 @@ class TestGraphAndDriver:
             env.execute(f"p-{strat}")
             total = sum(int(r["count"]) for r in sink.rows)
             assert total == 12, strat  # parallelism 1: pass-through
+
+
+class TestHybridRoute:
+    """The two-coordinate keyed assignment of the hybrid ICI×DCN
+    topology (exchange/partitioners.hybrid_route) — the ONE routing
+    truth the host-side DCN router and the in-step local exchange
+    share (ISSUE 12 layer 4)."""
+
+    def test_process_coordinate_matches_contiguous_shard_spans(self):
+        from flink_tpu.exchange.partitioners import (
+            hash_shards,
+            hybrid_route,
+        )
+
+        rng = np.random.default_rng(0)
+        keys = rng.integers(-2**40, 2**40, 4096).astype(np.int64)
+        proc, local = hybrid_route(keys, 128, 4, local_devices=8)
+        shard = hash_shards(keys, 128)
+        np.testing.assert_array_equal(proc, shard // 32)
+        np.testing.assert_array_equal(local, (shard % 32) // 4)
+        assert proc.dtype == np.int32 and local.dtype == np.int32
+        assert set(np.unique(proc)) <= set(range(4))
+        assert set(np.unique(local)) <= set(range(8))
+
+    def test_routing_is_stable_across_calls(self):
+        """Replay determinism: the same keys route identically — the
+        exactly-once replay contract of the exchange."""
+        from flink_tpu.exchange.partitioners import hybrid_route
+
+        keys = np.arange(1000, dtype=np.int64) * 7919
+        a = hybrid_route(keys, 64, 2, local_devices=4)
+        b = hybrid_route(keys, 64, 2, local_devices=4)
+        np.testing.assert_array_equal(a[0], b[0])
+        np.testing.assert_array_equal(a[1], b[1])
+
+    def test_divisibility_enforced_loudly(self):
+        from flink_tpu.exchange.partitioners import hybrid_route
+
+        keys = np.arange(10, dtype=np.int64)
+        with pytest.raises(ValueError, match="n_processes"):
+            hybrid_route(keys, 100, 3)
+        with pytest.raises(ValueError, match="device count"):
+            hybrid_route(keys, 128, 4, local_devices=3)
+
+    def test_cross_slice_fraction(self):
+        from flink_tpu.exchange.partitioners import (
+            cross_slice_fraction,
+            hybrid_route,
+        )
+
+        rng = np.random.default_rng(1)
+        keys = rng.integers(0, 2**40, 1 << 14).astype(np.int64)
+        proc, _ = hybrid_route(keys, 128, 4)
+        frac = cross_slice_fraction(proc, 1)
+        # uniform hash: ~3/4 of the records leave slice 1
+        assert 0.70 < frac < 0.80
+        assert cross_slice_fraction(np.zeros(0, np.int32), 0) == 0.0
+
+
+class TestHybridMeshPlan:
+    def test_local_plan_owns_contiguous_global_span(self):
+        from flink_tpu.parallel.mesh import AXIS, DCN_AXIS, \
+            make_hybrid_mesh_plan
+
+        import jax
+
+        devs = jax.devices()[:4]
+        mp = make_hybrid_mesh_plan(64, 16, n_processes=2, process_id=1,
+                                   devices=devs)
+        assert mp.num_shards == 32            # the LOCAL span
+        assert mp.global_num_shards == 64
+        assert mp.shard_lo == 32
+        assert mp.mesh.axis_names == (DCN_AXIS, AXIS)
+        assert mp.mesh.devices.shape == (1, 4)
+        # owner() delegates to the shared hybrid_route truth
+        keys = np.arange(512, dtype=np.int64) * 104729
+        proc, local = mp.owner(keys)
+        from flink_tpu.exchange.partitioners import hybrid_route
+
+        p2, l2 = hybrid_route(keys, 64, 2, local_devices=4)
+        np.testing.assert_array_equal(proc, p2)
+        np.testing.assert_array_equal(local, l2)
+
+    def test_divisibility_enforced(self):
+        from flink_tpu.parallel.mesh import make_hybrid_mesh_plan
+
+        import jax
+
+        with pytest.raises(ValueError, match="num-processes"):
+            make_hybrid_mesh_plan(63, 16, 2, 0,
+                                  devices=jax.devices()[:2])
+        with pytest.raises(ValueError, match="local device count"):
+            make_hybrid_mesh_plan(64, 16, 2, 0,
+                                  devices=jax.devices()[:3])
+
+
+@pytest.mark.shard_map
+class TestIntraSliceExchange:
+    def test_collective_stays_on_the_inner_axis(self):
+        """On a (DCN_AXIS, AXIS) hybrid mesh, intra_slice_exchange must
+        move records only among the devices of one slice: with 2
+        virtual slices x 2 devices, records bucketed for local device
+        d land on device d OF THE SAME SLICE — the outer (DCN) axis
+        never carries a byte, which is the hybrid topology's point."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from flink_tpu.exchange.keyby import intra_slice_exchange
+        from flink_tpu.parallel.mesh import AXIS, DCN_AXIS
+        from flink_tpu.utils.jaxcompat import hybrid_device_mesh, shard_map
+
+        devs = jax.devices()[:4]
+        arr = hybrid_device_mesh((1, 2), (2, 1), devs)  # 2 slices x 2
+        mesh = Mesh(arr, (DCN_AXIS, AXIS))
+        n_local, cap = 2, 8
+        b = 4 * cap  # per-device rows x 4 devices
+        rng = np.random.default_rng(7)
+        # tag every record with its ORIGIN slice (axis_index over the
+        # outer axis inside the step) and a payload naming its row
+        dest = jnp.asarray(rng.integers(0, n_local, b).astype(np.int32))
+        valid = jnp.ones(b, bool)
+        payload = {"row": jnp.arange(b, dtype=jnp.int64)}
+
+        def step(dest, valid, payload):
+            from jax import lax
+
+            slice_id = lax.axis_index(DCN_AXIS)
+            tagged = dict(payload)
+            tagged["origin_slice"] = jnp.full(
+                dest.shape, slice_id, jnp.int64)
+            recv, rv, ov = intra_slice_exchange(
+                dest, valid, tagged, n_local=n_local, capacity=cap)
+            # every received record's origin slice must equal OURS
+            same = jnp.where(rv, recv["origin_slice"] == slice_id, True)
+            # rank-1 per-device cells so out_specs can concatenate them
+            return (jnp.all(same)[None], jnp.sum(rv)[None],
+                    jnp.sum(ov)[None])
+
+        fn = jax.jit(shard_map(
+            step, mesh=mesh,
+            in_specs=(P((DCN_AXIS, AXIS)), P((DCN_AXIS, AXIS)),
+                      {"row": P((DCN_AXIS, AXIS))}),
+            out_specs=(P((DCN_AXIS, AXIS)), P((DCN_AXIS, AXIS)),
+                       P((DCN_AXIS, AXIS)))))
+        all_same, n_recv, n_over = fn(dest, valid, payload)
+        assert bool(np.all(np.asarray(all_same))), (
+            "a record crossed the DCN axis inside the step")
+        # nothing lost: every valid record landed somewhere in its slice
+        assert int(np.asarray(n_recv).sum()) + int(
+            np.asarray(n_over).sum()) == b
