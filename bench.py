@@ -91,7 +91,14 @@ def _q5_backfill_conf(batch_size: int) -> dict:
             "pipeline.microbatch-size": batch_size,
             "log.group.name": "q5-backfill",
             "log.compaction.key-field": "event_id",
-            "log.compaction.min-segments": 1}
+            "log.compaction.min-segments": 1,
+            # the perf-tier read/write knobs ARE part of the benched
+            # config (ISSUE 13): group fsync on the producer, read
+            # batches coalesced to the microbatch size, double-buffered
+            # segment readahead (zero-copy decode is the default)
+            "log.fsync-mode": "group",
+            "log.read-batch-records": batch_size,
+            "log.prefetch-segments": 1}
 
 
 def job_confs() -> dict:
@@ -479,7 +486,8 @@ def run_wordcount_log_fed(batch_size: int, n_batches: int) -> float:
 
 
 def run_q5_backfill(batch_size: int = 1 << 18, n_hist: int = 8,
-                    n_live: int = 4) -> None:
+                    n_live: int = 4,
+                    artifact: "str | None" = None) -> None:
     """Backfill-then-live Q5 (ISSUE 9, ROADMAP item 4's day-scale
     replay shape): a producer commits bid HISTORY into a durable-log
     topic, the topic is KEY-COMPACTED (keyed on the unique event id —
@@ -573,7 +581,7 @@ def run_q5_backfill(batch_size: int = 1 << 18, n_hist: int = 8,
 
         matches = (rows_b == ref_b and rows_l == ref_l
                    and n_b == ref_nb and n_l == ref_nl)
-        print(json.dumps({
+        line = {
             "metric": "nexmark_q5_backfill_then_live_events_per_sec",
             "unit": "events/sec/chip",
             "value": round(n_b / el_b),  # headline = the backfill
@@ -582,6 +590,22 @@ def run_q5_backfill(batch_size: int = 1 << 18, n_hist: int = 8,
             "batch": batch_size,
             "history_batches": n_hist,
             "live_batches": n_live,
+            # the perf-tier knobs this number was measured under
+            # (ISSUE 13 — the conf record is confs/bench_q5_backfill)
+            "log_tier": {"fsync_mode": "group", "zero_copy": True,
+                         "read_batch_records": batch_size,
+                         "prefetch_segments": 1},
+            # the ISSUE 13 acceptance bar: >= 3x the r09-committed
+            # backfill number (~104k ev/s on this container class) —
+            # only meaningful at the committed conf's shape, so a
+            # differently-parameterized run carries no verdict
+            **({"target": ">= 312000 ev/s backfill (3x the r09 "
+                          "artifact)",
+                "target_met": (n_b / el_b) >= 312_000}
+               if (batch_size, n_hist, n_live) == (1 << 18, 8, 4)
+               else {"target": "n/a (non-default shape; the bar is "
+                               "defined at batch=2^18, hist=8, "
+                               "live=4)"}),
             "compaction": {"gen": comp["gen"],
                            "rows_in": sum(
                                e["rows_in"]
@@ -592,7 +616,12 @@ def run_q5_backfill(batch_size: int = 1 << 18, n_hist: int = 8,
             # the acceptance contract: committed output equals the
             # never-compacted reference run's, both phases
             "matches_reference": matches,
-        }))
+        }
+        print(json.dumps(line))
+        if artifact:
+            with open(artifact, "w", encoding="utf-8") as f:
+                json.dump(line, f, indent=1)
+            print(f"# backfill artifact -> {artifact}")
         assert matches, "backfill-then-live output diverged from the " \
                         "never-compacted reference"
     finally:
@@ -909,7 +938,7 @@ if __name__ == "__main__":
             raise SystemExit("--concurrent-jobs needs a count, e.g. 2")
         concurrent_jobs_bench(int(sys.argv[ix + 1]))
     elif "--backfill" in sys.argv:
-        run_q5_backfill()
+        run_q5_backfill(artifact="BENCH_BACKFILL.json")
     elif "--sub-batches" in sys.argv:
         ix = sys.argv.index("--sub-batches")
         if ix + 1 >= len(sys.argv):

@@ -281,6 +281,61 @@ def log_retention_unsafe(plan, config) -> Iterable[Finding]:
                 "checkpoint interval, or disable time retention")
 
 
+@config_rule("LOG_PREFETCH_INVALID", "warn",
+             fix="log.prefetch-segments >= 0, log.read-batch-records "
+                 ">= 0, log.fsync-mode in {group, segment}; set "
+                 "log.prefetch-segments=0 when auditing a savepoint "
+                 "rewind")
+def log_prefetch_invalid(plan, config) -> Iterable[Finding]:
+    """A misconfigured perf-grade log read/write path: a negative
+    prefetch depth or coalescing target would only fail at LogSource
+    construction deep inside the job build, an unknown fsync-mode at
+    the first stage — and prefetch combined with an EXPLICIT replay
+    rewind (a configured restore path on a consumer-group job) makes
+    a rewind audit's batch boundaries nondeterministic (the readahead
+    re-reads rows past the frozen barrier; positions stay exact, but a
+    side-by-side diff of delivered batches won't line up run to run)."""
+    from flink_tpu.config import CheckpointingOptions, LogOptions
+
+    prefetch = int(config.get(LogOptions.PREFETCH_SEGMENTS))
+    batch_records = int(config.get(LogOptions.READ_BATCH_RECORDS))
+    fsync_mode = str(config.get(LogOptions.FSYNC_MODE))
+    if prefetch < 0:
+        yield _f(
+            f"log.prefetch-segments={prefetch} is negative: LogSource "
+            "rejects it at construction, deep inside the job build — "
+            "0 disables readahead, >= 1 sets the decode-ahead depth",
+            fix="set log.prefetch-segments >= 0")
+    if batch_records < 0:
+        yield _f(
+            f"log.read-batch-records={batch_records} is negative: "
+            "LogSource rejects it at construction — 0 reads per "
+            "on-disk block, >= 1 coalesces blocks to that many rows",
+            fix="set log.read-batch-records >= 0")
+    if fsync_mode not in ("group", "segment"):
+        yield _f(
+            f"log.fsync-mode={fsync_mode!r} is not a known mode: the "
+            "sink rejects it at construction, deep inside the job "
+            "build",
+            fix="use 'group' (batched pre-marker fsync pass) or "
+                "'segment' (legacy fsync-per-file)")
+    restore = str(config.get(CheckpointingOptions.RESTORE) or "").strip()
+    group = str(config.get(LogOptions.GROUP_NAME) or "").strip()
+    if (prefetch > 0 and group and restore
+            and restore not in ("", "latest")):
+        yield _f(
+            f"log.prefetch-segments={prefetch} with an explicit "
+            f"replay rewind (execution.checkpointing.restore="
+            f"{restore!r}) on consumer group {group!r}: the rewound "
+            "position is authoritative and re-delivers rows below the "
+            "group's committed offset, and readahead makes the "
+            "re-delivered batch boundaries nondeterministic run to "
+            "run — exactly-once is unaffected, but a rewind AUDIT "
+            "(diffing delivered batches) should read inline",
+            fix="set log.prefetch-segments=0 for the audit run, or "
+                "drop the explicit restore path")
+
+
 @config_rule("FAULT_POINT_UNKNOWN", "error",
              fix="match a faults.KNOWN_FAULT_POINTS entry")
 def fault_point_unknown(plan, config) -> Iterable[Finding]:

@@ -355,6 +355,44 @@ class LogOptions:
         "a new file within one transaction. Every segment is written "
         "sealed (columnar footer + fsync) at pre-commit, so this is "
         "also the recovery/replay granularity of a topic partition.")
+    FSYNC_MODE = ConfigOption(
+        "log.fsync-mode", "group",
+        "Segment durability discipline at transaction pre-commit: "
+        "'group' (default) writes every staged segment first and runs "
+        "ONE group-commit fsync pass over all of them strictly before "
+        "the pre-commit marker publishes (fsyncs overlap through the "
+        "host pool on multi-partition topics); 'segment' is the legacy "
+        "fsync-per-file-at-write discipline. The 2PC crash-window "
+        "semantics are identical: the marker rename — the point after "
+        "which a transaction is recoverable — always strictly follows "
+        "every segment fsync.")
+    ZERO_COPY = ConfigOption(
+        "log.zero-copy", True,
+        "LogSource decode mode: true mmaps sealed local-fs segments "
+        "and returns fixed-width columns as read-only np.frombuffer "
+        "views (no read() image copy, no per-column decode copy; "
+        "block CRCs still verified, corruption/truncation exactly as "
+        "loud). false is the legacy copying decode. Non-local schemes "
+        "and big-endian hosts degrade to copying automatically.")
+    READ_BATCH_RECORDS = ConfigOption(
+        "log.read-batch-records", 262_144,
+        "LogSource read-batch coalescing target: on-disk blocks merge "
+        "until a batch holds at least this many rows before entering "
+        "the pipeline — small sealed blocks otherwise starve the "
+        "device path with tiny dispatches (the backfill bench's "
+        "dominant cost on this container, PROFILE.md §11). Replay "
+        "positions advance at merged-batch boundaries and stay "
+        "checkpoint-exact. 0 = per-block reads (the legacy "
+        "granularity).")
+    PREFETCH_SEGMENTS = ConfigOption(
+        "log.prefetch-segments", 1,
+        "Merged read batches the LogSource decodes ahead on a feeder "
+        "thread while the pipeline consumes the current one (the "
+        "cluster.dcn-overlap shape at the segment-read seam; 1 = "
+        "double-buffered). 0 disables — reads run inline on the "
+        "consuming thread. Positions stay checkpoint-exact: only "
+        "consumed batches advance them, a restore re-reads from the "
+        "frozen offset.")
     COMPACTION_KEY_FIELD = ConfigOption(
         "log.compaction.key-field", "",
         "Key column for latest-wins key compaction (log/bus.py "

@@ -18,6 +18,14 @@ file holds a disjoint key range and per-key record order is preserved
 process-function consumers rely on. Timestamps ride as a reserved
 ``__ts__`` column. Truncated/corrupt partitions fail the read loudly
 (ColumnarError) — a blocking exchange may never drop records.
+
+Checksums: this plane rides ``formats_columnar``'s writers/readers,
+whose block CRCs all run through the ONE shared helper
+``native_codec.crc32`` — GIL-free and PCLMUL-folded where the CPU has
+it, bit-identical to ``zlib.crc32`` (the cutover threshold between the
+stdlib and native paths is single-sourced there, so the batch
+exchange, the durable log, and the DCN wire can never disagree on when
+or how bytes are checksummed).
 """
 from __future__ import annotations
 

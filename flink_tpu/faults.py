@@ -208,6 +208,7 @@ KNOWN_FAULT_POINTS = frozenset((
     "log.lease.acquire",
     "log.lease.renew",
     "log.group.commit",
+    "log.prefetch.read",
     "host.pool.task",
     "session.admit",
     "ha.lease.renew",

@@ -34,21 +34,50 @@ inconsistent footer (a partial write that lost its tail), and schema
 mismatch in either direction (a reader bound to schema A refuses a
 file written as B; a writer refuses a batch whose columns don't match
 its schema). Zero-row batches round-trip as schema-typed empty columns.
+
+Perf grade (the exchange/frames.py data-plane treatment applied at
+rest): block checksums run through ``native_codec.crc32`` — GIL-free,
+PCLMUL-folded where the CPU has it, BIT-IDENTICAL to ``zlib.crc32``
+(old files verify unchanged, and files written here verify on an
+unbuilt-fallback reader); ``write_batch`` emits SCATTER buffers
+(writev-style — fixed-width columns go to the file as memoryviews of
+the caller's arrays, never ``tobytes()`` + payload-concat copies; the
+chained CRC over the parts equals the CRC of the concatenation, so
+the bytes on disk are identical to version 1 files); and
+``iter_blocks(..., zero_copy=True)`` returns read-only
+``np.frombuffer`` VIEWS into the file image (one contiguous read or
+an mmap) instead of per-column ``astype`` copies — decode bandwidth
+becomes CRC bandwidth. Zero-copy decode needs a little-endian host
+(the file byte order); elsewhere it degrades to the copying path with
+identical results.
 """
 from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import struct
-import zlib
-from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+import sys
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from flink_tpu.formats import Format
+# THE shared checksum helper: native GIL-free CRC-32 with the zlib
+# fallback and the small-buffer cutover single-sourced in
+# native_codec.crc32 — the columnar format, the blocking shuffle
+# (exchange/blocking.py rides these writers/readers), and the DCN
+# frame codec all checksum through it, so the cutover threshold and
+# the bit-identity contract live in exactly one place.
+from flink_tpu.native_codec import crc32 as _crc32
 
 __all__ = ["ColumnarError", "ColumnarFormat", "ColumnarWriter",
            "infer_schema", "iter_blocks", "iter_file_blocks"]
+
+#: zero-copy views reinterpret little-endian file bytes in place —
+#: only valid when the host IS little-endian (x86/arm64; the copying
+#: path byte-swaps via astype on anything else)
+_ZERO_COPY_HOST = sys.byteorder == "little"
 
 Batch = Dict[str, np.ndarray]
 
@@ -103,8 +132,14 @@ def _check_schema(schema) -> Tuple[Tuple[str, str], ...]:
     return out
 
 
-def _encode_column(name: str, typ: str, col: np.ndarray,
-                   nrows: int) -> bytes:
+def _encode_column_parts(name: str, typ: str, col: np.ndarray,
+                         nrows: int) -> List[Any]:
+    """One column → a list of write buffers (the scatter-write path:
+    a fixed-width column that is already contiguous in the file dtype
+    goes out as a MEMORYVIEW of the caller's array — no ``tobytes()``
+    copy, no payload concatenation). The chained block CRC over these
+    parts equals the CRC of their concatenation, so the file bytes are
+    unchanged."""
     a = np.asarray(col)
     if len(a) != nrows:
         raise ColumnarError(
@@ -138,7 +173,7 @@ def _encode_column(name: str, typ: str, col: np.ndarray,
         offsets = np.zeros(nrows + 1, np.uint32)
         if nrows:
             offsets[1:] = ends
-        return offsets.astype("<u4").tobytes() + b"".join(items)
+        return [offsets.astype("<u4").data.cast("B"), b"".join(items)]
     if typ in ("i64",) and a.dtype.kind not in ("i", "u", "b"):
         raise ColumnarError(
             f"schema mismatch on write: column {name!r} is declared "
@@ -147,7 +182,11 @@ def _encode_column(name: str, typ: str, col: np.ndarray,
         raise ColumnarError(
             f"schema mismatch on write: column {name!r} is declared "
             f"{typ} but the batch carries dtype {a.dtype}")
-    return np.ascontiguousarray(a, _FIXED_DTYPES[typ]).tobytes()
+    # no-op when the array is already contiguous in the file dtype —
+    # the common hot path hands its bytes straight to the file; the
+    # cast('B') byte view is what write()/crc32 accept without copying
+    fixed = np.ascontiguousarray(a, _FIXED_DTYPES[typ])
+    return [fixed.data.cast("B") if fixed.nbytes else b""]
 
 
 class ColumnarWriter:
@@ -167,7 +206,7 @@ class ColumnarWriter:
             separators=(",", ":")).encode("utf-8")
         head = (_MAGIC + struct.pack("<BBH", _VERSION, 0, len(self.schema))
                 + struct.pack("<I", len(header)) + header
-                + struct.pack("<I", zlib.crc32(header)))
+                + struct.pack("<I", _crc32(header)))
         f.write(head)
         self.bytes_written += len(head)
 
@@ -180,12 +219,27 @@ class ColumnarWriter:
                 f"unexpected columns {extra} "
                 f"(schema: {[n for n, _ in self.schema]})")
         nrows = len(np.asarray(batch[self.schema[0][0]]))
-        payload = b"".join(
-            _encode_column(n, t, batch[n], nrows) for n, t in self.schema)
-        blk = (_BLOCK_MAGIC + struct.pack("<II", nrows, len(payload))
-               + payload + struct.pack("<I", zlib.crc32(payload)))
-        self._f.write(blk)
-        self.bytes_written += len(blk)
+        # scatter write: column buffers go to the file one by one (the
+        # sendmsg discipline of exchange/frames.py applied to a file) —
+        # no b"".join payload image, no per-column tobytes. The CRC
+        # chains across the parts, which for CRC-32 equals the CRC of
+        # the concatenation: the on-disk bytes are IDENTICAL to the
+        # copying writer's.
+        parts: List[Any] = []
+        for n, t in self.schema:
+            parts.extend(_encode_column_parts(n, t, batch[n], nrows))
+        payload_len = sum(
+            p.nbytes if isinstance(p, memoryview) else len(p)
+            for p in parts)
+        crc = 0
+        for p in parts:
+            crc = _crc32(p, crc)
+        self._f.write(_BLOCK_MAGIC + struct.pack("<II", nrows,
+                                                 payload_len))
+        for p in parts:
+            self._f.write(p)
+        self._f.write(struct.pack("<I", crc))
+        self.bytes_written += 12 + payload_len + 4
         self._nblocks += 1
         self._nrows += nrows
 
@@ -197,15 +251,18 @@ class ColumnarWriter:
 
 class _Cursor:
     """Bounds-checked byte reader: every overrun is a loud truncation
-    error naming the structure that was cut short."""
+    error naming the structure that was cut short. Accepts bytes OR a
+    memoryview (the zero-copy path slices VIEWS out of one contiguous
+    file image — an mmap or a single read — instead of copying)."""
 
-    def __init__(self, data: bytes) -> None:
-        self.data = data
+    def __init__(self, data) -> None:
+        self.data = memoryview(data) if not isinstance(data, bytes) \
+            else data
         self.pos = 0
 
-    def take(self, n: int, what: str) -> bytes:
+    def take(self, n: int, what: str):
         if self.pos + n > len(self.data):
-            if self.pos == 0 and not self.data:
+            if self.pos == 0 and not len(self.data):
                 raise ColumnarError("empty columnar file (0 bytes)")
             raise ColumnarError(
                 f"truncated columnar file: needed {n} bytes for {what} "
@@ -244,7 +301,7 @@ class _FileCursor:
 
 
 def _read_header(cur) -> Tuple[Tuple[str, str], ...]:
-    magic = cur.take(4, "magic")
+    magic = bytes(cur.take(4, "magic"))
     if magic != _MAGIC:
         raise ColumnarError(
             f"not a flink-tpu columnar file (magic {magic!r}, "
@@ -253,9 +310,9 @@ def _read_header(cur) -> Tuple[Tuple[str, str], ...]:
     if version != _VERSION:
         raise ColumnarError(f"unsupported columnar version {version}")
     (hlen,) = struct.unpack("<I", cur.take(4, "header length"))
-    header = cur.take(hlen, "schema header")
+    header = bytes(cur.take(hlen, "schema header"))
     (crc,) = struct.unpack("<I", cur.take(4, "header crc"))
-    if zlib.crc32(header) != crc:
+    if _crc32(header) != crc:
         raise ColumnarError("schema header CRC mismatch (corrupt file)")
     try:
         fields = json.loads(header.decode("utf-8"))["fields"]
@@ -269,7 +326,14 @@ def _read_header(cur) -> Tuple[Tuple[str, str], ...]:
     return schema
 
 
-def _decode_block(schema, nrows: int, payload: bytes) -> Batch:
+def _decode_block(schema, nrows: int, payload,
+                  zero_copy: bool = False) -> Batch:
+    """``zero_copy`` (little-endian hosts only): fixed-width columns
+    come back as READ-ONLY ``np.frombuffer`` views into ``payload`` —
+    no per-column copy; the view keeps the underlying file image (or
+    mmap) alive through its ``.base`` chain. String columns always
+    materialize object arrays (utf-8 decode is inherently a copy)."""
+    zero_copy = zero_copy and _ZERO_COPY_HOST
     cur = _Cursor(payload)
     out: Batch = {}
     for name, typ in schema:
@@ -277,14 +341,19 @@ def _decode_block(schema, nrows: int, payload: bytes) -> Batch:
             raw = cur.take(4 * (nrows + 1), f"column {name!r} offsets")
             offsets = np.frombuffer(raw, "<u4")
             blob = cur.take(int(offsets[-1]), f"column {name!r} bytes")
+            if not isinstance(blob, bytes):
+                blob = bytes(blob)
             out[name] = np.array(
                 [blob[offsets[i]:offsets[i + 1]].decode("utf-8")
                  for i in range(nrows)], dtype=object)
         else:
             dt = _FIXED_DTYPES[typ]
             raw = cur.take(dt.itemsize * nrows, f"column {name!r}")
-            out[name] = np.frombuffer(raw, dt).astype(
-                dt.newbyteorder("="), copy=True)
+            if zero_copy:
+                out[name] = np.frombuffer(raw, dt)
+            else:
+                out[name] = np.frombuffer(raw, dt).astype(
+                    dt.newbyteorder("="), copy=True)
     if cur.pos != len(payload):
         raise ColumnarError(
             f"block payload has {len(payload) - cur.pos} trailing bytes "
@@ -292,7 +361,8 @@ def _decode_block(schema, nrows: int, payload: bytes) -> Batch:
     return out
 
 
-def _iter_cursor(cur, expect_schema, skip: int = 0) -> Iterator[Batch]:
+def _iter_cursor(cur, expect_schema, skip: int = 0,
+                 zero_copy: bool = False) -> Iterator[Batch]:
     schema = _read_header(cur)
     if expect_schema is not None:
         want = _check_schema(expect_schema)
@@ -303,7 +373,7 @@ def _iter_cursor(cur, expect_schema, skip: int = 0) -> Iterator[Batch]:
     nblocks = 0
     nrows_total = 0
     while True:
-        magic = cur.take(4, "block or footer magic")
+        magic = bytes(cur.take(4, "block or footer magic"))
         if magic == _FOOTER_MAGIC:
             fblocks, frows = struct.unpack("<IQ", cur.take(12, "footer"))
             if fblocks != nblocks or frows != nrows_total:
@@ -321,7 +391,7 @@ def _iter_cursor(cur, expect_schema, skip: int = 0) -> Iterator[Batch]:
         nrows, plen = struct.unpack("<II", cur.take(8, "block frame"))
         payload = cur.take(plen, f"block {nblocks} payload")
         (crc,) = struct.unpack("<I", cur.take(4, f"block {nblocks} crc"))
-        if zlib.crc32(payload) != crc:
+        if _crc32(payload) != crc:
             raise ColumnarError(
                 f"block {nblocks} CRC mismatch (corrupt file)")
         idx = nblocks
@@ -331,17 +401,24 @@ def _iter_cursor(cur, expect_schema, skip: int = 0) -> Iterator[Batch]:
             # already-consumed blocks (checkpoint replay) skip the
             # expensive numpy/utf-8 materialization; the frame walk +
             # CRC still validate the file end to end
-            yield _decode_block(schema, nrows, payload)
+            yield _decode_block(schema, nrows, payload,
+                                zero_copy=zero_copy)
 
 
-def iter_blocks(data: bytes, expect_schema=None,
-                skip: int = 0) -> Iterator[Batch]:
+def iter_blocks(data, expect_schema=None, skip: int = 0,
+                zero_copy: bool = False) -> Iterator[Batch]:
     """Validated block-at-a-time read of a complete file image. The
     footer is checked after the last block — consuming the iterator to
     exhaustion proves the file was complete and uncorrupted. ``skip``
     elides decoding (not validation) of the first N blocks — the
-    replay-position fast path."""
-    return _iter_cursor(_Cursor(data), expect_schema, skip)
+    replay-position fast path. ``zero_copy`` returns fixed-width
+    columns as read-only views into ``data`` (pass the image as a
+    memoryview/mmap to avoid even the initial read copy); truncation,
+    CRC, footer and schema failures are EXACTLY as loud either way —
+    every block's checksum is verified before its views are handed
+    out."""
+    return _iter_cursor(_Cursor(data), expect_schema, skip,
+                        zero_copy=zero_copy)
 
 
 def iter_file_blocks(f, expect_schema=None,
@@ -356,6 +433,26 @@ def iter_file_blocks(f, expect_schema=None,
 def read_schema(data: bytes) -> Tuple[Tuple[str, str], ...]:
     """Schema of a file image (header only — no block validation)."""
     return _read_header(_Cursor(data))
+
+
+def map_file_image(path: str) -> memoryview:
+    """Read-only memoryview over a SEALED local columnar file via
+    mmap — the zero-copy read path's input: ``iter_blocks(view,
+    zero_copy=True)`` then decodes straight out of the page cache
+    (no read() image copy at all). The returned view keeps the mmap
+    alive through every array sliced from it (numpy ``.base`` chain);
+    the mapping closes when the last view is garbage-collected. Only
+    for sealed files (segments are written complete + renamed —
+    the mmap never observes a growing file)."""
+    import mmap
+
+    with open(path, "rb") as f:
+        if os.fstat(f.fileno()).st_size == 0:
+            # 0-byte files can't mmap; the empty-file error must be
+            # the ordinary loud ColumnarError, not a ValueError
+            return memoryview(b"")
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    return memoryview(mm)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -59,7 +59,8 @@ class LogSink(TwoPhaseCommitSink):
                  segment_records: int = 65536,
                  owned_partitions: Optional[List[int]] = None,
                  producer_id: Optional[str] = None,
-                 lease_ttl_ms: int = 30_000) -> None:
+                 lease_ttl_ms: int = 30_000,
+                 fsync_mode: str = "group") -> None:
         if partitions > 1 and not key_field:
             raise LogError(
                 "a multi-partition LogSink needs key_field: records "
@@ -86,7 +87,8 @@ class LogSink(TwoPhaseCommitSink):
             else None,
             owned_partitions=(list(owned_partitions)
                               if owned_partitions is not None else None),
-            lease=self._lease, key_field=key_field)
+            lease=self._lease, key_field=key_field,
+            fsync_mode=fsync_mode)
         self._opened = self._lease is None
         if self._lease is None:
             # legacy single-writer: recovery at construction (the
@@ -132,7 +134,16 @@ class LogSink(TwoPhaseCommitSink):
                    owned_partitions=owned_partitions,
                    producer_id=producer_id,
                    lease_ttl_ms=int(
-                       config.get(LogOptions.LEASE_TTL_MS)))
+                       config.get(LogOptions.LEASE_TTL_MS)),
+                   fsync_mode=str(config.get(LogOptions.FSYNC_MODE)))
+
+    def set_host_pool(self, pool) -> None:
+        """Driver seam (announced next to ``set_attempt_epoch``): the
+        run's shared HostPool — multi-partition stage() routes
+        per-partition segment writes and the group-fsync pass through
+        it so partition I/O scales with cores. Safe to never call:
+        the appender's serial path is the exact legacy behavior."""
+        self._appender.host_pool = pool
 
     def set_attempt_epoch(self, epoch: int) -> None:
         self._appender.epoch = int(epoch)
@@ -219,6 +230,133 @@ class LogSink(TwoPhaseCommitSink):
             self._lease.release()
 
 
+class _ReadAhead:
+    """Bounded background readahead at the log-read seam: a feeder
+    thread pulls (and therefore DECODES) the next merged read batch
+    while the pipeline consumes the current one — double-buffered at
+    ``depth=1``, the ``cluster.dcn-overlap`` shape applied to segment
+    I/O. Sits BELOW the driver's generic ``pipeline.source-prefetch``
+    batch buffer (which overlaps the loop's keying/dispatch work);
+    this stage overlaps the segment read+CRC+decode itself. Errors
+    from the feeder surface on the consuming side at the batch where
+    they occurred; ``close()`` unblocks and joins the feeder (the
+    driver's failed-run cleanup calls it through the iterator-close
+    seam). Checkpoint positions are untouched: readahead batches not
+    yet CONSUMED are invisible to position bookkeeping — a restore
+    simply rebuilds the source and re-reads from the frozen offset."""
+
+    def __init__(self, it, depth: int = 1) -> None:
+        import queue
+        import threading
+
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._it = it
+        self._done = False
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._feed, name="log-readahead", daemon=True)
+        self._thread.start()
+
+    def _feed(self) -> None:
+        try:
+            for item in self._it:
+                if self._closed:
+                    return
+                self._q.put(item)
+                if self._closed:
+                    return
+            self._q.put(StopIteration())
+        except BaseException as e:  # surfaced on consume
+            self._q.put(e)
+
+    def close(self) -> None:
+        self._closed = True
+        self._done = True
+        while True:  # empty the queue so a blocked put() completes
+            try:
+                self._q.get_nowait()
+            except Exception:
+                break
+        self._thread.join(timeout=1.0)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        item = self._q.get()
+        if isinstance(item, StopIteration):
+            self._done = True
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._done = True
+            raise item
+        return item
+
+
+class _SplitIter:
+    """The iterator ``LogSource.open_split`` returns: stamps event
+    time, keeps the replay-position side table, and owns the readahead
+    thread's lifecycle (``close()`` — the driver's cleanup seam)."""
+
+    def __init__(self, src: "LogSource", p: int, inner,
+                 readahead) -> None:
+        from flink_tpu import faults
+
+        self._src = src
+        self._p = p
+        self._inner = inner
+        self._readahead = readahead
+        # captured on the OPENING thread (the driver loop, which the
+        # runner scoped to its tenant): the driver's generic
+        # source-prefetch may consume this iterator on an unscoped
+        # feeder thread, and the tenant's fault plan must still govern
+        # its own prefetch seam
+        self._fault_scope = faults.current_scope()
+
+    def close(self) -> None:
+        if self._readahead is not None:
+            self._readahead.close()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from flink_tpu import faults
+
+        if self._readahead is not None:
+            # the prefetch handoff seam: fires once per consumed batch
+            # where a real readahead failure also surfaces, under the
+            # opening thread's fault scope (the consuming thread may be
+            # the driver's generic source-prefetch feeder, which is
+            # unscoped). Per-split firing order is the batch order;
+            # with multiple prefetched splits the cross-split
+            # interleave is scheduling-dependent — the dcn.send.partial
+            # discipline, not the host.pool.task submit-seam one.
+            import os
+
+            with faults.job_scope(self._fault_scope):
+                faults.fire("log.prefetch.read", exc=OSError,
+                            topic=os.path.basename(
+                                os.path.normpath(self._src.path)),
+                            partition=self._p)
+        _offset, nxt, data = next(self._inner)
+        src = self._src
+        if src.ts_field is not None:
+            if src.ts_field not in data:
+                raise LogError(
+                    f"LogSource ts_field {src.ts_field!r} missing "
+                    f"from topic columns {sorted(data)}")
+            ts = np.asarray(data[src.ts_field], np.int64)
+        else:
+            now = np.int64(time.time() * 1000)
+            ts = np.full(len(next(iter(data.values()), ())),
+                         now, np.int64)
+        src._next_pos[id(data)] = (len(ts), int(nxt))
+        return data, ts
+
+
 class LogSource(Source):
     """FLIP-27-style replayable reads of a topic's COMMITTED prefix:
     one split per (assigned) partition; the replay position is the
@@ -250,11 +388,44 @@ class LogSource(Source):
     ingest-time stamps like FileSource. Bounded: a split ends at the
     committed offset observed at open (chained jobs run producer then
     consumer; tailing a live topic is a broker's job, not this
-    embedded log's)."""
+    embedded log's).
+
+    Perf-grade read path (all declared in the ``log.*`` grammar):
+    ``zero_copy`` (``log.zero-copy``) mmaps sealed local segments and
+    decodes fixed-width columns as read-only views — CRC still
+    verified per block; ``batch_records`` (``log.read-batch-records``)
+    COALESCES on-disk blocks into merged batches of at least that many
+    rows before they enter the pipeline (small blocks otherwise starve
+    the device path with tiny dispatches); ``prefetch_segments``
+    (``log.prefetch-segments``) decodes the next merged batch on a
+    feeder thread while the pipeline consumes the current one
+    (0 = inline, the legacy path; positions stay checkpoint-exact
+    because only CONSUMED batches advance them). The prefetch handoff
+    carries the ``log.prefetch.read`` fault point."""
 
     def __init__(self, path: str, ts_field: Optional[str] = None,
                  group: Optional[str] = None, member_index: int = 0,
-                 members: int = 1) -> None:
+                 members: int = 1, zero_copy: bool = True,
+                 batch_records: int = 262_144,
+                 prefetch_segments: int = 1) -> None:
+        # perf-grade read defaults (class defaults mirror the declared
+        # log.* option defaults — direct construction and from_config
+        # agree): zero-copy mmap decode, read batches COALESCED to
+        # batch_records rows (small on-disk blocks otherwise starve
+        # the device pipeline with tiny dispatches — the measured 2.6x
+        # on the backfill bench, PROFILE.md §11), one merged batch of
+        # readahead decoded while the pipeline consumes the previous
+        if batch_records < 0:
+            raise LogError(
+                f"LogSource batch_records must be >= 0 (0 = per-block "
+                f"reads), got {batch_records}")
+        if prefetch_segments < 0:
+            raise LogError(
+                f"LogSource prefetch_segments must be >= 0 (0 = "
+                f"inline reads), got {prefetch_segments}")
+        self.zero_copy = bool(zero_copy)
+        self.batch_records = int(batch_records)
+        self.prefetch_segments = int(prefetch_segments)
         self.path = path
         self.ts_field = ts_field
         self.group = group or None
@@ -293,7 +464,12 @@ class LogSource(Source):
         return cls(os.path.join(str(config.get(LogOptions.DIR)), name),
                    ts_field=ts_field, group=group or None,
                    member_index=int(config.get(LogOptions.GROUP_MEMBER)),
-                   members=int(config.get(LogOptions.GROUP_MEMBERS)))
+                   members=int(config.get(LogOptions.GROUP_MEMBERS)),
+                   zero_copy=bool(config.get(LogOptions.ZERO_COPY)),
+                   batch_records=int(
+                       config.get(LogOptions.READ_BATCH_RECORDS)),
+                   prefetch_segments=int(
+                       config.get(LogOptions.PREFETCH_SEGMENTS)))
 
     def _get_reader(self) -> TopicReader:
         # one reader per source instance, shared by all splits: the
@@ -303,7 +479,8 @@ class LogSource(Source):
         # restore re-creates the source (build_env per attempt), so
         # the snapshot refreshes per attempt, not per split.
         if self._reader is None:
-            self._reader = TopicReader(self.path)
+            self._reader = TopicReader(self.path,
+                                       zero_copy=self.zero_copy)
         return self._reader
 
     def assigned_partitions(self) -> List[int]:
@@ -328,9 +505,55 @@ class LogSource(Source):
         return int(ConsumerGroups.committed(
             self.path, self.group).get(p, 0))
 
+    def _coalesced(self, p: int,
+                   start: int) -> Iterator[Any]:
+        """``read3`` blocks merged up to ``batch_records`` rows per
+        yielded batch (0 = per-block, the legacy granularity).
+        Position-exact: each merged batch carries the NEXT-POSITION of
+        its last constituent block, so replay positions advance at
+        merged-batch boundaries and sparse (compacted) gaps are still
+        jumped correctly. A single block already at or above the
+        target passes through without a copy (the zero-copy views
+        survive; merging is the one place the read path copies, and
+        only when on-disk blocks are smaller than the pipeline wants)."""
+        reader = self._get_reader()
+        target = self.batch_records
+        pend: list = []
+        first = nxt = None
+        rows = 0
+        for off, nx, data in reader.read3(p, start_offset=start):
+            if target <= 0:
+                yield off, nx, data
+                continue
+            if first is None:
+                first = off
+            pend.append(data)
+            rows += len(next(iter(data.values()), ()))
+            nxt = nx
+            if rows >= target:
+                yield first, nxt, self._merge(pend)
+                pend, first, rows = [], None, 0
+        if pend:
+            yield first, nxt, self._merge(pend)
+
+    def _merge(self, pend: list) -> Any:
+        if len(pend) == 1:
+            return pend[0]
+        out = {k: np.concatenate([d[k] for d in pend])
+               for k in pend[0]}
+        if self.zero_copy:
+            # uniformity over speed-of-discovery: single-block batches
+            # are read-only views, so merged batches are marked
+            # read-only too — a consumer mutating its input in place
+            # fails DETERMINISTICALLY on its first batch, not
+            # intermittently on whichever tail batch happened to be a
+            # lone block
+            for arr in out.values():
+                arr.flags.writeable = False
+        return out
+
     def open_split(self, split: str,
                    start_pos: int = 0) -> Iterator[Any]:
-        reader = self._get_reader()
         p = int(split)
         # group bootstrap applies ONLY to a fresh split (position 0 —
         # nothing consumed yet, so the group's committed offset is the
@@ -342,19 +565,12 @@ class LogSource(Source):
         # regress, so the maintenance floor is unaffected).
         start = (self._bootstrap_offset(p) if int(start_pos) == 0
                  else int(start_pos))
-        for _offset, nxt, data in reader.read3(p, start_offset=start):
-            if self.ts_field is not None:
-                if self.ts_field not in data:
-                    raise LogError(
-                        f"LogSource ts_field {self.ts_field!r} missing "
-                        f"from topic columns {sorted(data)}")
-                ts = np.asarray(data[self.ts_field], np.int64)
-            else:
-                now = np.int64(time.time() * 1000)
-                ts = np.full(len(next(iter(data.values()), ())),
-                             now, np.int64)
-            self._next_pos[id(data)] = (len(ts), int(nxt))
-            yield data, ts
+        inner = self._coalesced(p, start)
+        readahead = None
+        if self.prefetch_segments > 0:
+            inner = readahead = _ReadAhead(
+                inner, depth=self.prefetch_segments)
+        return _SplitIter(self, p, inner, readahead)
 
     def position_after(self, pos: int, data, ts) -> int:
         # offsets, not batch indices: replay-exact regardless of how

@@ -94,6 +94,7 @@ from flink_tpu.formats_columnar import (
     ColumnarWriter,
     infer_schema,
     iter_blocks,
+    map_file_image,
 )
 from flink_tpu.fs import get_filesystem
 from flink_tpu.obs.metrics import MetricRegistry
@@ -432,10 +433,24 @@ class TopicAppender:
                  writer_id: Optional[str] = None,
                  owned_partitions: Optional[List[int]] = None,
                  lease: Any = None,
-                 key_field: Optional[str] = None) -> None:
+                 key_field: Optional[str] = None,
+                 fsync_mode: str = "group",
+                 host_pool: Any = None) -> None:
         if segment_records < 1:
             raise LogError(
                 f"log segment-records must be >= 1, got {segment_records}")
+        if fsync_mode not in ("group", "segment"):
+            raise LogError(
+                f"log fsync-mode must be 'group' or 'segment', "
+                f"got {fsync_mode!r}")
+        if fsync_mode == "group" and _local_path(path) is None:
+            # non-local schemes have no plain-OS path to re-open for
+            # the group pass; 'segment' mode fsyncs through the write
+            # handle's fileno (when the plugin exposes one), so it is
+            # the durability-preserving degrade — silently SKIPPING
+            # the syncs would weaken the 2PC chain on exactly the
+            # storage least likely to forgive it
+            fsync_mode = "segment"
         if writer_id is not None and not _WRITER_RE.match(writer_id):
             raise LogError(
                 f"writer id {writer_id!r} must match [A-Za-z0-9_.-]+ "
@@ -467,6 +482,21 @@ class TopicAppender:
                 f"owned partitions {bad} outside topic range "
                 f"[0, {partitions})")
         self.lease = lease
+        # "group": segments are written WITHOUT per-file fsync and one
+        # group-commit pass fsyncs every staged file just before the
+        # pre-commit marker publishes — the 2PC crash-window semantics
+        # are unchanged by construction (the marker rename is what
+        # makes a transaction recoverable, and it still strictly
+        # follows every fsync; a crash anywhere earlier leaves only
+        # unreferenced debris the recovery sweep removes). "segment"
+        # is the legacy fsync-per-file-at-write discipline.
+        self.fsync_mode = fsync_mode
+        # the driver's shared HostPool (set via LogSink.set_host_pool):
+        # multi-partition stage() routes per-partition segment
+        # encode/write — and the group fsync pass — through it, so
+        # partition I/O scales with cores. None / parallelism 1 is the
+        # exact serial path.
+        self.host_pool = host_pool
         self._fs = get_filesystem(path)
         # cids THIS writer staged rows for: commit() uses it to tell a
         # genuinely-empty epoch (no marker was ever written — no-op by
@@ -554,16 +584,74 @@ class TopicAppender:
                         topic=self.topic, partition=p, cid=cid)
             w.close()  # footer — the completeness tripwire
             f.flush()
-            faults.fire("log.segment.fsync", exc=OSError,
-                        topic=self.topic, partition=p, cid=cid)
-            try:
-                os.fsync(f.fileno())
-            except (AttributeError, OSError):
-                pass
+            if self.fsync_mode == "segment":
+                faults.fire("log.segment.fsync", exc=OSError,
+                            topic=self.topic, partition=p, cid=cid)
+                try:
+                    os.fsync(f.fileno())
+                except (AttributeError, OSError):
+                    pass
         self._fs.rename(tmp, os.path.join(pdir, name))
         _count(self.topic, "segments_sealed")
         _count(self.topic, "records_appended", rows)
         return {"name": name, "base": int(base), "rows": int(rows)}
+
+    def _group_fsync(self, staged: List[Tuple[int, int, str]]) -> None:
+        """The group-commit pass of ``fsync_mode='group'``: fsync every
+        segment file this transaction staged, in one sweep, strictly
+        BEFORE the pre-commit marker publishes — the marker rename (the
+        point after which the transaction is recoverable) never lands
+        over un-durable segment bytes, so the crash-window semantics
+        equal the per-segment mode's. The ``log.segment.fsync`` fault
+        point fires once per segment HERE (same count as per-segment
+        mode, deterministic partition-then-offset order, on the caller
+        thread); the fsyncs themselves route through the host pool when
+        one is attached — fsync drops the GIL, so per-partition syncs
+        overlap on real I/O."""
+        from flink_tpu import faults
+
+        paths: List[str] = []
+        for p, cid, name in staged:
+            faults.fire("log.segment.fsync", exc=OSError,
+                        topic=self.topic, partition=p, cid=cid)
+            local = _local_path(
+                os.path.join(_partition_dir(self.path, p), name))
+            if local is not None:
+                paths.append(local)
+
+        def _sync(path: str):
+            def run() -> None:
+                fd = os.open(path, os.O_RDONLY)
+                try:
+                    os.fsync(fd)
+                except OSError:
+                    pass  # non-fsyncable mount: same tolerance as the
+                    # per-segment mode's except clause
+                finally:
+                    os.close(fd)
+            return run
+
+        pool = self.host_pool
+        if pool is not None and getattr(pool, "parallelism", 1) > 1 \
+                and len(paths) > 1:
+            scope = faults.current_scope()
+
+            def _scoped(path):
+                run = _sync(path)
+
+                def wrapped() -> None:
+                    # pool workers carry no fault scope of their own
+                    # (the driver only scopes threads IT owns) — a
+                    # session tenant's scoped plan must still govern
+                    # work done on its behalf
+                    with faults.job_scope(scope):
+                        run()
+                return wrapped
+
+            pool.run_tasks([_scoped(path) for path in paths])
+        else:
+            for path in paths:
+                _sync(path)()
 
     def stage(self, cid: int,
               pending: Dict[int, List[Dict[str, np.ndarray]]]) -> bool:
@@ -589,6 +677,11 @@ class TopicAppender:
                 "resume the original checkpoint dir so ids continue")
         per_part: Dict[str, List[Dict[str, Any]]] = {}
         staged_next = dict(self._next)
+        # plan first, write second: each partition's segment cuts and
+        # base offsets are fixed here, so the writes are independent
+        # per-partition jobs — routable through the host pool with
+        # byte-identical files regardless of scheduling
+        part_jobs: List[Tuple[int, List[Tuple[int, List[Dict[str, np.ndarray]]]]]] = []
         for p in sorted(pending):
             batches = [b for b in pending[p]
                        if len(next(iter(b.values()), ()))]
@@ -603,7 +696,7 @@ class TopicAppender:
             for b in batches:
                 self._check_schema(b)
             base = staged_next[p]
-            segs: List[Dict[str, Any]] = []
+            jobs: List[Tuple[int, List[Dict[str, np.ndarray]]]] = []
             chunks: List[Dict[str, np.ndarray]] = []
             n_chunk = 0
             for b in batches:
@@ -616,17 +709,59 @@ class TopicAppender:
                     n_chunk += take
                     lo += take
                     if n_chunk == self.segment_records:
-                        segs.append(self._write_segment(
-                            p, base, cid, chunks))
+                        jobs.append((base, chunks))
                         base += n_chunk
                         chunks, n_chunk = [], 0
             if chunks:
-                segs.append(self._write_segment(p, base, cid, chunks))
+                jobs.append((base, chunks))
                 base += n_chunk
-            per_part[str(p)] = segs
+            part_jobs.append((p, jobs))
             staged_next[p] = base
-        if not per_part:
+        if not part_jobs:
             return False
+
+        def _writer(p: int, jobs):
+            def run() -> List[Dict[str, Any]]:
+                return [self._write_segment(p, b, cid, ch)
+                        for b, ch in jobs]
+            return run
+
+        pool = self.host_pool
+        if pool is not None and getattr(pool, "parallelism", 1) > 1 \
+                and len(part_jobs) > 1:
+            # parallel partition I/O: one pool task per partition, in
+            # submission (partition) order. Encode+write of different
+            # partitions overlap; a task failure drains its siblings
+            # before raising (the pool's no-orphan contract), leaving
+            # only marker-less debris the recovery sweep removes. The
+            # log.segment.* fault points then fire on worker threads
+            # UNDER THE CALLER'S FAULT SCOPE (pool workers carry none
+            # of their own — a session tenant's scoped plan must still
+            # govern its segment writes): per-partition order is
+            # preserved, cross-partition interleave is scheduling-
+            # dependent (the serial path — pool absent or parallelism
+            # 1 — keeps the exact legacy deterministic order chaos
+            # schedules were seeded on).
+            from flink_tpu import faults
+
+            scope = faults.current_scope()
+
+            def _scoped_writer(p, jobs):
+                run = _writer(p, jobs)
+
+                def wrapped():
+                    with faults.job_scope(scope):
+                        return run()
+                return wrapped
+
+            results = pool.run_tasks(
+                [_scoped_writer(p, jobs) for p, jobs in part_jobs])
+        else:
+            results = [_writer(p, jobs)() for p, jobs in part_jobs]
+        staged_files: List[Tuple[int, int, str]] = []
+        for (p, _jobs), segs in zip(part_jobs, results):
+            per_part[str(p)] = segs
+            staged_files.extend((p, int(cid), s["name"]) for s in segs)
         marker = {
             "cid": int(cid), "epoch": self.epoch,
             "segments": per_part,
@@ -638,6 +773,12 @@ class TopicAppender:
         if self.lease is not None:
             marker["lease_epochs"] = {
                 str(p): int(self.lease.epochs[int(p)]) for p in per_part}
+        # group-commit durability: every staged segment is fsynced
+        # BEFORE the marker rename below — the 2PC visibility chain
+        # (durable segments -> pre marker -> commit marker) is
+        # identical to per-segment mode, just batched
+        if self.fsync_mode == "group":
+            self._group_fsync(staged_files)
         # fencing gate, then the pre-commit marker: after this rename
         # the transaction is recoverable (re-commit or roll back),
         # before it the segments are unreferenced debris the cleanup
@@ -948,10 +1089,20 @@ class TopicReader:
     Offset-addressed: ``read(p, start_offset)`` resumes mid-partition —
     whole segments before the offset are skipped without opening,
     already-consumed leading rows of the boundary block are sliced
-    off."""
+    off.
 
-    def __init__(self, path: str) -> None:
+    ``zero_copy=True`` (the perf-grade read mode): sealed local-fs
+    segments are MMAPPED and every fixed-width column comes back as a
+    read-only ``np.frombuffer`` view into the mapping — one page-cache
+    walk, no read() image copy, no per-column decode copy. Every
+    block's CRC is still verified before its views are yielded, and
+    truncation/corruption raise exactly the same loud errors as the
+    copying mode. Non-local schemes keep a single contiguous read per
+    segment and return views into that image."""
+
+    def __init__(self, path: str, zero_copy: bool = False) -> None:
         self.path = path
+        self.zero_copy = bool(zero_copy)
         self._fs = get_filesystem(path)
         self.partitions = topic_partitions(path)
         manifest = load_manifest(self._fs, path)
@@ -1073,14 +1224,25 @@ class TopicReader:
             if seg.end <= start_offset:
                 continue
             path = os.path.join(_partition_dir(self.path, p), seg.name)
-            with self._fs.open_read(path) as f:
-                data = f.read()
-            if isinstance(data, str):
-                data = data.encode("utf-8")
+            zc = self.zero_copy
+            local = _local_path(path) if zc else None
+            if local is not None:
+                # sealed segment on a local filesystem: decode straight
+                # out of the page cache (segments are renamed into
+                # place complete, so the mapping never sees a growing
+                # file; a view outliving a retention delete keeps its
+                # pages via the mapping — POSIX unlink semantics)
+                data = map_file_image(local)
+            else:
+                with self._fs.open_read(path) as f:
+                    data = f.read()
+                if isinstance(data, str):
+                    data = data.encode("utf-8")
             rows_seen = 0
             if seg.sparse:
                 for block in iter_blocks(
-                        data, expect_schema=self._sparse_schema()):
+                        data, expect_schema=self._sparse_schema(),
+                        zero_copy=zc):
                     offs = np.asarray(block[OFFSET_COL], np.int64)
                     rows_seen += len(offs)
                     if not len(offs) or int(offs[-1]) < start_offset:
@@ -1092,7 +1254,8 @@ class TopicReader:
             else:
                 offset = seg.base
                 for block in iter_blocks(data,
-                                         expect_schema=self._schema):
+                                         expect_schema=self._schema,
+                                         zero_copy=zc):
                     n = len(next(iter(block.values()), ()))
                     rows_seen += n
                     if offset + n <= start_offset:

@@ -1269,6 +1269,13 @@ class Driver:
                 setter = getattr(n.sink, "set_attempt_epoch", None)
                 if setter is not None:
                     setter(attempt_epoch)
+                # the shared HostPool rides the same announcement seam:
+                # transactional log sinks route per-partition segment
+                # writes + the group-fsync pass through it so a
+                # multi-partition stage() scales with cores
+                pool_setter = getattr(n.sink, "set_host_pool", None)
+                if pool_setter is not None:
+                    pool_setter(self.host_pool)
         from concurrent.futures import ThreadPoolExecutor
 
         from flink_tpu import faults
@@ -1358,11 +1365,15 @@ class Driver:
             # .cleanUpInternal aborts pending transactions in cleanup)
             self._abort_sinks()
             # unblock + join prefetch feeders: one blocked thread and
-            # `depth` buffered batches would leak per split per attempt
+            # `depth` buffered batches would leak per split per attempt.
+            # Duck-typed: covers _Prefetcher AND source iterators that
+            # own background work of their own (LogSource's segment
+            # readahead exposes close() on its split iterator)
             for its in getattr(self, "_srcs", {}).values():
                 for it in its.values():
-                    if isinstance(it, _Prefetcher):
-                        it.close()
+                    closer = getattr(it, "close", None)
+                    if closer is not None:
+                        closer()
             if self._metrics_server is not None:
                 self._metrics_server.close()
             for nid, op in self._ops.items():
@@ -2450,6 +2461,17 @@ class _Prefetcher:
             except Exception:
                 break
         self._thread.join(timeout=1.0)
+        # a wrapped iterator with its OWN background work (LogSource
+        # segment readahead) must be closed through this prefetcher,
+        # or its feeder thread outlives the attempt
+        inner_close = getattr(self._it, "close", None)
+        if inner_close is not None:
+            try:
+                inner_close()
+            except ValueError:
+                pass  # a plain generator still executing on the
+                # feeder thread refuses close(); the feeder is ending
+                # anyway (_closed is set)
         return not self._thread.is_alive()
 
     def __iter__(self):

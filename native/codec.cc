@@ -660,7 +660,7 @@ static int crc_tables_init() {
 }
 static const int g_crc_ready = crc_tables_init();  // load-time init
 
-uint32_t crc32_zlib(const uint8_t* p, int64_t len, uint32_t init) {
+static uint32_t crc32_slice8(const uint8_t* p, int64_t len, uint32_t init) {
   (void)g_crc_ready;
   uint32_t c = ~init;
   while (len > 0 && ((uintptr_t)p & 7)) {
@@ -681,6 +681,142 @@ uint32_t crc32_zlib(const uint8_t* p, int64_t len, uint32_t init) {
   }
   while (len-- > 0) c = g_crc_tab[0][(c ^ *p++) & 0xff] ^ (c >> 8);
   return ~c;
+}
+
+#if defined(__x86_64__) || defined(__i386__)
+// PCLMULQDQ-folded CRC-32 (same ISO-HDLC polynomial, reflected) —
+// the Intel "Fast CRC Computation Using PCLMULQDQ" folding scheme for
+// the IEEE polynomial, as shipped in zlib-ng / Chromium zlib / the
+// Linux kernel. Folding constants are x^n mod P in the reflected
+// domain; a load-time SELF-CHECK against the table path (below)
+// guards the constants — a mismatch disables this path entirely, so
+// a wrong constant can only ever cost speed, never correctness.
+// Measured here: slice-by-8 ~1.8 GB/s, PCLMUL ~10+ GB/s — the log
+// tier's decode bandwidth is CRC-bound without it (PROFILE.md §11).
+#include <immintrin.h>
+
+__attribute__((target("pclmul,sse4.1")))
+static uint32_t crc32_pclmul(const uint8_t* p, int64_t len, uint32_t init) {
+  // k1 = x^(4*128+32) mod P, k2 = x^(4*128-32) mod P  (64B fold)
+  // k3 = x^(128+32)  mod P, k4 = x^(128-32)  mod P  (16B fold)
+  // k5 = x^64 mod P; poly/mu: Barrett reduction pair
+  const __m128i k1k2 = _mm_set_epi64x(0x00000001c6e41596ll,
+                                      0x0000000154442bd4ll);
+  const __m128i k3k4 = _mm_set_epi64x(0x00000000ccaa009ell,
+                                      0x00000001751997d0ll);
+  const __m128i k5 = _mm_set_epi64x(0, 0x0000000163cd6124ll);
+  const __m128i pmu = _mm_set_epi64x(0x00000001f7011641ll,
+                                     0x00000001db710641ll);
+  const __m128i mask32 = _mm_setr_epi32(-1, 0, -1, 0);
+  uint32_t c = ~init;
+  __m128i x0, x1, x2, x3, y;
+  // seed: first 64 bytes, crc folded into the low lane
+  x0 = _mm_loadu_si128((const __m128i*)(p + 0));
+  x1 = _mm_loadu_si128((const __m128i*)(p + 16));
+  x2 = _mm_loadu_si128((const __m128i*)(p + 32));
+  x3 = _mm_loadu_si128((const __m128i*)(p + 48));
+  x0 = _mm_xor_si128(x0, _mm_cvtsi32_si128((int)c));
+  p += 64;
+  len -= 64;
+  while (len >= 64) {  // fold 4 lanes by 64 bytes
+    __m128i t;
+    t = _mm_clmulepi64_si128(x0, k1k2, 0x00);
+    x0 = _mm_clmulepi64_si128(x0, k1k2, 0x11);
+    x0 = _mm_xor_si128(_mm_xor_si128(x0, t),
+                       _mm_loadu_si128((const __m128i*)(p + 0)));
+    t = _mm_clmulepi64_si128(x1, k1k2, 0x00);
+    x1 = _mm_clmulepi64_si128(x1, k1k2, 0x11);
+    x1 = _mm_xor_si128(_mm_xor_si128(x1, t),
+                       _mm_loadu_si128((const __m128i*)(p + 16)));
+    t = _mm_clmulepi64_si128(x2, k1k2, 0x00);
+    x2 = _mm_clmulepi64_si128(x2, k1k2, 0x11);
+    x2 = _mm_xor_si128(_mm_xor_si128(x2, t),
+                       _mm_loadu_si128((const __m128i*)(p + 32)));
+    t = _mm_clmulepi64_si128(x3, k1k2, 0x00);
+    x3 = _mm_clmulepi64_si128(x3, k1k2, 0x11);
+    x3 = _mm_xor_si128(_mm_xor_si128(x3, t),
+                       _mm_loadu_si128((const __m128i*)(p + 48)));
+    p += 64;
+    len -= 64;
+  }
+  // reduce 4 lanes -> 1 (fold by 16 bytes each step)
+  y = _mm_clmulepi64_si128(x0, k3k4, 0x00);
+  x0 = _mm_clmulepi64_si128(x0, k3k4, 0x11);
+  x1 = _mm_xor_si128(x1, _mm_xor_si128(x0, y));
+  y = _mm_clmulepi64_si128(x1, k3k4, 0x00);
+  x1 = _mm_clmulepi64_si128(x1, k3k4, 0x11);
+  x2 = _mm_xor_si128(x2, _mm_xor_si128(x1, y));
+  y = _mm_clmulepi64_si128(x2, k3k4, 0x00);
+  x2 = _mm_clmulepi64_si128(x2, k3k4, 0x11);
+  x3 = _mm_xor_si128(x3, _mm_xor_si128(x2, y));
+  while (len >= 16) {  // remaining whole 16B blocks
+    y = _mm_clmulepi64_si128(x3, k3k4, 0x00);
+    x3 = _mm_clmulepi64_si128(x3, k3k4, 0x11);
+    x3 = _mm_xor_si128(_mm_xor_si128(x3, y),
+                       _mm_loadu_si128((const __m128i*)p));
+    p += 16;
+    len -= 16;
+  }
+  // 128 -> 64 bits
+  y = _mm_clmulepi64_si128(x3, k3k4, 0x10);
+  x3 = _mm_srli_si128(x3, 8);
+  x3 = _mm_xor_si128(x3, y);
+  // 64 -> 32 bits
+  y = _mm_srli_si128(x3, 4);
+  x3 = _mm_and_si128(x3, mask32);
+  x3 = _mm_clmulepi64_si128(x3, k5, 0x00);
+  x3 = _mm_xor_si128(x3, y);
+  // Barrett reduction
+  y = _mm_and_si128(x3, mask32);
+  y = _mm_clmulepi64_si128(y, pmu, 0x10);
+  y = _mm_and_si128(y, mask32);
+  y = _mm_clmulepi64_si128(y, pmu, 0x00);
+  x3 = _mm_xor_si128(x3, y);
+  c = (uint32_t)_mm_extract_epi32(x3, 1);
+  // tail (<16B): continue from raw register c — slice8 seeds ~init,
+  // so ~c hands it exactly c, and its return is already final-inverted
+  if (len > 0) return crc32_slice8(p, len, ~c);
+  return ~c;
+}
+
+// -1 = unprobed, 0 = unavailable/failed self-check, 1 = verified good.
+// The self-check runs the first time a large-enough buffer arrives:
+// both paths checksum a 256B counter pattern at several offsets — a
+// wrong fold constant or a CPU lying about pclmul support disables
+// the fast path for the process lifetime (correctness never depends
+// on the constants being right).
+static int g_pclmul_state = -1;
+static int pclmul_ok() {
+  if (g_pclmul_state >= 0) return g_pclmul_state;
+#if defined(__x86_64__) || defined(__i386__)
+  if (__builtin_cpu_supports("pclmul") && __builtin_cpu_supports("sse4.1")) {
+    uint8_t buf[256 + 7];
+    for (int i = 0; i < 256 + 7; ++i) buf[i] = (uint8_t)(i * 73 + 11);
+    int good = 1;
+    for (int off = 0; off < 8 && good; ++off)
+      for (int n = 64; n <= 256 && good; n += 13)
+        for (uint32_t seed = 0; seed < 2 && good; ++seed)
+          if (crc32_pclmul(buf + off, n, seed ? 0xDEADBEEFu : 0) !=
+              crc32_slice8(buf + off, n, seed ? 0xDEADBEEFu : 0))
+            good = 0;
+    g_pclmul_state = good;
+  } else {
+    g_pclmul_state = 0;
+  }
+#else
+  g_pclmul_state = 0;
+#endif
+  return g_pclmul_state;
+}
+#else
+static int pclmul_ok() { return 0; }
+#endif
+
+uint32_t crc32_zlib(const uint8_t* p, int64_t len, uint32_t init) {
+#if defined(__x86_64__) || defined(__i386__)
+  if (len >= 64 && pclmul_ok()) return crc32_pclmul(p, len, init);
+#endif
+  return crc32_slice8(p, len, init);
 }
 
 }  // extern "C"

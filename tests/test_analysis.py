@@ -143,6 +143,12 @@ def _retention_below_checkpoint_interval(tmp_path):
         "log.retention.ms": 100}))
 
 
+@seed("LOG_PREFETCH_INVALID")
+def _log_prefetch_invalid(tmp_path):
+    return analyze_config(Configuration({
+        "log.prefetch-segments": -1}))
+
+
 @seed("FAULT_POINT_UNKNOWN")
 def _fault_point_unknown(tmp_path):
     env = clean_pipeline({"faults.inject": "bogus.point=raise @1.0"})

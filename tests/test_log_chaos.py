@@ -553,6 +553,60 @@ class TestTwoProducersTwoGroups:
                    d["groups"]["grp-a"].values()) == len(expected)
 
 
+class TestPrefetchChaos:
+    """ISSUE 13: the prefetch seam (``log.prefetch.read``, fired at
+    the readahead handoff of every consumed LogSource batch) under
+    injection — the consumer crash-restarts through checkpoint
+    recovery and its committed output equals the fault-free run
+    exactly once. Runs with the perf-tier defaults live: group fsync
+    on the producer, zero-copy + coalescing + readahead on the
+    consumer."""
+
+    def test_prefetch_read_crash_recovers_exactly_once(self, tmp_path):
+        from flink_tpu.api.sinks import FileTransactionalSink
+
+        topic = str(tmp_path / "topic")
+        produce(tmp_path, topic, "prefetch")  # fault-free history
+
+        def consume_recovering(tag, plan=None):
+            def build_env(conf):
+                env = StreamExecutionEnvironment(conf)
+                env.from_source(
+                    LogSource(topic, ts_field="ts_ms",
+                              prefetch_segments=2, batch_records=96)
+                ).add_sink(FileTransactionalSink(
+                    str(tmp_path / f"out-{tag}")))
+                return env
+
+            conf = Configuration({
+                "pipeline.microbatch-size": BATCH,
+                "execution.checkpointing.dir": str(
+                    tmp_path / f"ckpt-{tag}"),
+                "execution.checkpointing.interval": 1,
+                "restart-strategy.type": "fixed-delay",
+                "restart-strategy.fixed-delay.attempts": 20,
+                "restart-strategy.fixed-delay.delay": 1,
+            })
+            ctx = plan.activate() if plan else contextlib.nullcontext()
+            with ctx:
+                run_with_recovery(build_env, conf,
+                                  job_name=f"prefetch-{tag}")
+            return sorted(
+                (int(r["word"]), int(r["ts_ms"]))
+                for r in FileTransactionalSink.committed_rows(
+                    str(tmp_path / f"out-{tag}")))
+
+        golden = consume_recovering("golden")
+        assert len(golden) == N_BATCHES * BATCH
+        plan = faults.FaultPlan(seed=CHAOS_SEED).rule(
+            "log.prefetch.read", "raise", count=1, after=2)
+        with replayable(plan):
+            got = consume_recovering("chaos", plan)
+            assert [x[:2] for x in plan.log] == [("log.prefetch.read",
+                                                  "raise")]
+            assert got == golden
+
+
 @pytest.mark.slow
 class TestLogChaosSoak:
     """Randomized multi-seed soak over every log fault point — the

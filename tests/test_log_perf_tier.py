@@ -1,0 +1,328 @@
+"""Perf-grade durable-log tier (ISSUE 13): group fsync ordering,
+parallel partition I/O determinism, the zero-copy/mmap reader vs the
+legacy copying reader (byte-identical, incl. str columns and sparse
+``__offset`` compacted segments), read-batch coalescing position
+exactness, and prefetch on/off equivalence.
+
+The byte-identity discipline: every fast path here must produce — or
+read back — EXACTLY what the legacy path does; speed may never change
+bytes (the PR-5 HostPool determinism contract applied to the log
+tier)."""
+import os
+
+import numpy as np
+import pytest
+
+from flink_tpu import faults
+from flink_tpu.log import LogSink, LogSource, TopicReader, create_topic
+from flink_tpu.log.bus import Compactor
+from flink_tpu.log.topic import TopicAppender, _list_markers
+from flink_tpu.fs import get_filesystem
+
+pytestmark = [pytest.mark.log]
+
+
+def _batch(rng, n, base=0):
+    return {
+        "k": (base + rng.integers(0, 50, n)).astype(np.int64),
+        "seq": np.arange(base, base + n, dtype=np.int64),
+        "v": rng.random(n).astype(np.float64),
+        "tag": np.array([f"t{int(x)}" for x in rng.integers(0, 9, n)],
+                        dtype=object),
+    }
+
+
+def _read_all(path, zero_copy):
+    """Every committed row of every partition, fully materialized."""
+    r = TopicReader(path, zero_copy=zero_copy)
+    out = {}
+    for p in range(r.partitions):
+        rows = []
+        for off, b in r.read(p):
+            rows.append((off, {k: np.asarray(v).tolist()
+                               for k, v in b.items()}))
+        out[p] = rows
+    return out
+
+
+class TestGroupFsync:
+    """fsync-mode=group: ONE fsync pass over all staged segments that
+    completes strictly BEFORE the pre-commit marker publishes —
+    asserted by injection, not by comment."""
+
+    def test_fsync_fault_leaves_no_pre_marker(self, tmp_path):
+        """An injected fsync failure in the group pass must abort the
+        stage BEFORE the pre-commit marker exists: the 2PC visibility
+        chain (durable segments -> marker) is ordered, so a crashed
+        group pass can never leave a recoverable transaction over
+        un-durable bytes."""
+        topic = str(tmp_path / "t")
+        ap = TopicAppender(topic, 2, fsync_mode="group")
+        rng = np.random.default_rng(0)
+        plan = faults.FaultPlan(seed=1).rule(
+            "log.segment.fsync", "raise", count=1, after=0)
+        with plan.activate():
+            with pytest.raises(OSError):
+                ap.stage(1, {0: [_batch(rng, 16)],
+                             1: [_batch(rng, 16, base=100)]})
+        assert [x[:2] for x in plan.log] == [("log.segment.fsync",
+                                              "raise")]
+        fs = get_filesystem(topic)
+        assert _list_markers(fs, topic, "pre") == {}, (
+            "group fsync must complete before the pre-commit marker "
+            "publishes")
+        # recovery sweeps the un-markered debris; a clean restage works
+        ap.recover()
+        assert ap.stage(1, {0: [_batch(rng, 16)]})
+        ap.commit(1)
+
+    def test_group_fsync_fires_once_per_segment(self, tmp_path):
+        """Same log.segment.fsync count as per-segment mode — chaos
+        schedules seeded on the legacy cadence keep their meaning."""
+        rng = np.random.default_rng(1)
+        pending = {0: [_batch(rng, 40)], 1: [_batch(rng, 40, 100)]}
+        counts = {}
+        for mode in ("group", "segment"):
+            topic = str(tmp_path / mode)
+            ap = TopicAppender(topic, 2, segment_records=16,
+                               fsync_mode=mode)
+            plan = faults.FaultPlan(seed=2).rule(
+                "log.segment.fsync", "delay", delay_ms=0.0, after=0)
+            with plan.activate():
+                assert ap.stage(1, pending)
+            counts[mode] = len(plan.log)
+        assert counts["group"] == counts["segment"] > 0
+
+    def test_modes_produce_identical_bytes(self, tmp_path):
+        rng = np.random.default_rng(2)
+        pending = {0: [_batch(rng, 33)], 1: [_batch(rng, 21, 500)]}
+        reads = {}
+        for mode in ("group", "segment"):
+            topic = str(tmp_path / mode)
+            ap = TopicAppender(topic, 2, segment_records=16,
+                               fsync_mode=mode)
+            assert ap.stage(1, pending)
+            ap.commit(1)
+            reads[mode] = _read_all(topic, zero_copy=False)
+        assert reads["group"] == reads["segment"]
+
+    def test_bad_mode_rejected(self, tmp_path):
+        from flink_tpu.log.topic import LogError
+
+        with pytest.raises(LogError, match="fsync-mode"):
+            TopicAppender(str(tmp_path / "t"), 1, fsync_mode="bogus")
+
+
+class TestParallelPartitionIO:
+    """stage() through the driver's HostPool: per-partition segment
+    writes overlap, files stay byte-identical to the serial path."""
+
+    def test_pool_stage_matches_serial(self, tmp_path):
+        from flink_tpu.parallel.hostpool import HostPool
+
+        rng = np.random.default_rng(3)
+        pending = {p: [_batch(rng, 50, base=1000 * p)]
+                   for p in range(4)}
+        pool = HostPool(4)
+        try:
+            ap_par = TopicAppender(str(tmp_path / "par"), 4,
+                                   segment_records=16, host_pool=pool)
+            assert ap_par.stage(1, pending)
+            ap_par.commit(1)
+        finally:
+            pool.close()
+        ap_ser = TopicAppender(str(tmp_path / "ser"), 4,
+                               segment_records=16)
+        assert ap_ser.stage(1, pending)
+        ap_ser.commit(1)
+        par = _read_all(str(tmp_path / "par"), zero_copy=False)
+        ser = _read_all(str(tmp_path / "ser"), zero_copy=False)
+        assert par == ser
+        # the segment FILES are byte-identical too, not just the reads
+        for p in range(4):
+            names_par = sorted(os.listdir(tmp_path / "par" / f"p{p}"))
+            names_ser = sorted(os.listdir(tmp_path / "ser" / f"p{p}"))
+            assert names_par == names_ser
+            for n in names_par:
+                a = (tmp_path / "par" / f"p{p}" / n).read_bytes()
+                b = (tmp_path / "ser" / f"p{p}" / n).read_bytes()
+                assert a == b
+
+    def test_logsink_host_pool_seam(self, tmp_path):
+        from flink_tpu.parallel.hostpool import HostPool
+
+        sink = LogSink(str(tmp_path / "t"), key_field="k",
+                       partitions=2)
+        pool = HostPool(2)
+        try:
+            sink.set_host_pool(pool)
+            assert sink._appender.host_pool is pool
+            rng = np.random.default_rng(4)
+            sink.write(_batch(rng, 64))
+            assert sink.stage_transaction(1)
+            sink.commit_transaction(1)
+        finally:
+            pool.close()
+        got = _read_all(str(tmp_path / "t"), zero_copy=True)
+        assert sum(len(rows) for rows in got.values()) == 2
+
+
+class TestZeroCopyReader:
+    """The mmap/view read mode returns byte-identical batches to the
+    copying reader — raw topics, compacted (sparse __offset) topics,
+    str columns — and keeps every corruption loud."""
+
+    def _make_topic(self, tmp_path, compact=False):
+        topic = str(tmp_path / "t")
+        ap = TopicAppender(topic, 2, segment_records=16, key_field="k")
+        rng = np.random.default_rng(5)
+        for cid in (1, 2, 3):
+            assert ap.stage(cid, {0: [_batch(rng, 40)],
+                                  1: [_batch(rng, 24, base=777)]})
+            ap.commit(cid)
+        if compact:
+            res = Compactor(topic, min_segments=1).compact()
+            assert res["gen"] == 1
+        return topic
+
+    @pytest.mark.parametrize("compact", [False, True])
+    def test_randomized_round_trip_matches_legacy(self, tmp_path,
+                                                  compact):
+        topic = self._make_topic(tmp_path, compact=compact)
+        assert _read_all(topic, True) == _read_all(topic, False)
+
+    def test_decode_performs_no_payload_copy(self, tmp_path):
+        """Regression guard: fixed-width columns come back as VIEWS
+        (``.base`` chains to the file image) and are read-only — a
+        future change silently reintroducing the copy fails here."""
+        topic = self._make_topic(tmp_path)
+        r = TopicReader(topic, zero_copy=True)
+        _, batch = next(iter(r.read(0)))
+        for name in ("k", "seq", "v"):
+            arr = batch[name]
+            assert arr.base is not None, (
+                f"column {name} was copied, not viewed")
+            assert not arr.flags.writeable
+        # and the copying reader really copies (the control)
+        r2 = TopicReader(topic, zero_copy=False)
+        _, batch2 = next(iter(r2.read(0)))
+        assert batch2["k"].base is None
+
+    def test_corruption_truncation_footer_loss_still_loud(self,
+                                                          tmp_path):
+        from flink_tpu.formats_columnar import ColumnarError
+        from flink_tpu.log.topic import LogError
+
+        topic = self._make_topic(tmp_path)
+        pdir = tmp_path / "t" / "p0"
+        seg = sorted(p for p in pdir.iterdir()
+                     if p.name.endswith(".colb"))[0]
+        golden = seg.read_bytes()
+
+        def read_all():
+            return _read_all(topic, zero_copy=True)
+
+        # CRC corruption: flip one payload byte mid-file
+        seg.write_bytes(golden[:200] + bytes([golden[200] ^ 0xFF])
+                        + golden[201:])
+        with pytest.raises(ColumnarError, match="CRC"):
+            read_all()
+        # truncation: cut mid-block
+        seg.write_bytes(golden[:len(golden) // 2])
+        with pytest.raises((ColumnarError, LogError)):
+            read_all()
+        # footer loss: chop exactly the footer (16 bytes)
+        seg.write_bytes(golden[:-16])
+        with pytest.raises(ColumnarError):
+            read_all()
+        seg.write_bytes(golden)
+        read_all()  # restored: clean again
+
+
+class TestCoalescingAndPrefetch:
+    """Read-batch coalescing + segment readahead: same rows, same
+    replay positions, bigger batches."""
+
+    def _topic(self, tmp_path, compact=False):
+        topic = str(tmp_path / "t")
+        ap = TopicAppender(topic, 1, segment_records=8, key_field="k")
+        rng = np.random.default_rng(6)
+        for cid in (1, 2):
+            assert ap.stage(cid, {0: [_batch(rng, 40)]})
+            ap.commit(cid)
+        if compact:
+            assert Compactor(topic, min_segments=1).compact()["gen"] == 1
+        return topic
+
+    def _drain(self, src, start=0, stop_after=None):
+        """(rows, positions) — positions advanced per consumed batch
+        exactly as the driver does (position_after on the identical
+        dict)."""
+        it = src.open_split("0", start)
+        rows, pos, batches = [], start, 0
+        try:
+            for data, ts in it:
+                pos = src.position_after(pos, data, ts)
+                rows.extend(np.asarray(data["seq"]).tolist())
+                batches += 1
+                if stop_after is not None and batches >= stop_after:
+                    break
+        finally:
+            close = getattr(it, "close", None)
+            if close:
+                close()
+        return rows, pos, batches
+
+    @pytest.mark.parametrize("compact", [False, True])
+    def test_coalesced_resume_is_position_exact(self, tmp_path,
+                                                compact):
+        topic = self._topic(tmp_path, compact=compact)
+        full, _, _ = self._drain(
+            LogSource(topic, ts_field="seq", batch_records=0,
+                      prefetch_segments=0))
+        # consume ONE coalesced batch, then resume at its position:
+        # head + tail must equal the full read, no gap, no re-delivery
+        src = LogSource(topic, ts_field="seq", batch_records=24,
+                        prefetch_segments=0)
+        head, pos, batches = self._drain(src, stop_after=1)
+        assert batches == 1 and len(head) >= 24, (
+            "coalescing must merge the 8-row blocks")
+        tail, _, _ = self._drain(
+            LogSource(topic, ts_field="seq", batch_records=24,
+                      prefetch_segments=0), start=pos)
+        assert head + tail == full
+
+    def test_prefetch_on_off_identical(self, tmp_path):
+        topic = self._topic(tmp_path)
+        base, pos0, _ = self._drain(
+            LogSource(topic, ts_field="seq", prefetch_segments=0))
+        pref, pos1, _ = self._drain(
+            LogSource(topic, ts_field="seq", prefetch_segments=2))
+        assert base == pref and pos0 == pos1
+
+    def test_readahead_close_joins_feeder(self, tmp_path):
+        import threading
+
+        topic = self._topic(tmp_path)
+        src = LogSource(topic, ts_field="seq", prefetch_segments=1)
+        before = threading.active_count()
+        it = src.open_split("0", 0)
+        next(it)  # feeder live
+        it.close()
+        # bounded wait: the feeder must exit once closed
+        deadline = 50
+        while threading.active_count() > before and deadline:
+            import time
+
+            time.sleep(0.02)
+            deadline -= 1
+        assert threading.active_count() <= before
+
+    def test_negative_knobs_rejected(self, tmp_path):
+        from flink_tpu.log.topic import LogError
+
+        topic = self._topic(tmp_path)
+        with pytest.raises(LogError, match="batch_records"):
+            LogSource(topic, batch_records=-1)
+        with pytest.raises(LogError, match="prefetch_segments"):
+            LogSource(topic, prefetch_segments=-2)
