@@ -249,6 +249,48 @@ def log_topic_multi_writer(plan, config) -> Iterable[Finding]:
                 node=node.id, node_name=node.name)
 
 
+@config_rule("STORAGE_LOCAL_LOCKS_ON_REMOTE", "warn",
+             fix="keep high-availability.dir and log.dir on local "
+                 "(file://) paths, or accept the documented "
+                 "degradation: read-check-write acquisition races are "
+                 "then bounded only by epoch fencing at the next "
+                 "verify, not prevented")
+def storage_local_locks_on_remote(plan, config) -> Iterable[Finding]:
+    """Lock-dependent storage on a non-``file`` scheme: the O_EXCL +
+    rename-first lock discipline (HA leader-election leases, the log
+    tier's writer-lease acquisition locks and maintenance locks) is
+    LOCAL-filesystem-only — ``os.open(O_CREAT|O_EXCL)`` has no remote
+    equivalent here, so on any other scheme acquisition degrades to
+    read-check-write (PR 9/11 honest residue). Two racing acquirers
+    can then both believe they won until the next epoch verify rejects
+    one — bounded, but no longer prevented. Flag the intent early, at
+    submit, instead of as a once-a-month double-leader incident."""
+    from flink_tpu.config import HighAvailabilityOptions, LogOptions
+
+    checks = (
+        ("high-availability.dir",
+         str(config.get(HighAvailabilityOptions.HA_DIR)),
+         "leader-election lease steals + the durable session registry"),
+        ("log.dir", str(config.get(LogOptions.DIR)),
+         "per-partition writer-lease acquisition locks and topic "
+         "maintenance locks"),
+    )
+    for key, value, what in checks:
+        v = value.strip()
+        scheme, sep, _ = v.partition("://")
+        if not sep or scheme == "file":
+            continue
+        yield _f(
+            f"{key}={v!r} resolves to scheme {scheme!r}: the O_EXCL + "
+            f"rename-first lock discipline protecting {what} is "
+            "local-filesystem-only — on this scheme acquisition "
+            "degrades to read-check-write, fenced only after the "
+            "fact by lease epochs",
+            fix="move the directory to a shared LOCAL filesystem "
+                "(file:// / bare path), or accept the degradation "
+                "knowingly (single-acquirer operational discipline)")
+
+
 @config_rule("LOG_RETENTION_UNSAFE", "warn",
              fix="set log.retention.ms >= "
                  "execution.checkpointing.interval (or disable one)")

@@ -334,11 +334,14 @@ class FileTransactionalSink(Sink):
     aborts the crashed attempt's epochs from disk."""
 
     def __init__(self, directory: str) -> None:
+        from flink_tpu.fs import get_filesystem
+
         self.dir = directory
+        self._fs = get_filesystem(directory)
         self._staged_dir = os.path.join(directory, "staged")
         self._committed_dir = os.path.join(directory, "committed")
-        os.makedirs(self._staged_dir, exist_ok=True)
-        os.makedirs(self._committed_dir, exist_ok=True)
+        self._fs.mkdirs(self._staged_dir)
+        self._fs.mkdirs(self._committed_dir)
         self._pending: List[Dict[str, Any]] = []
 
     @staticmethod
@@ -359,29 +362,27 @@ class FileTransactionalSink(Sink):
             for row in rows_of(batch))
 
     def prepare_commit(self, checkpoint_id: int) -> None:
-        path = self._staged_path(checkpoint_id)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            for row in self._pending:
-                f.write(json.dumps(row) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        from flink_tpu.fs import write_atomic
+
+        payload = "".join(
+            json.dumps(row) + "\n" for row in self._pending)
+        write_atomic(self._fs, self._staged_path(checkpoint_id),
+                     payload.encode("utf-8"))
         self._pending = []
 
     def _commit_epoch(self, cid: int) -> None:
         sp, cp = self._staged_path(cid), self._committed_path(cid)
-        if os.path.exists(cp):
+        if self._fs.exists(cp):
             # already committed (restore replays the commit idempotently)
-            if os.path.exists(sp):
-                os.remove(sp)
-        elif os.path.exists(sp):
-            os.replace(sp, cp)  # atomic: the commit point
+            if self._fs.exists(sp):
+                self._fs.delete(sp)
+        elif self._fs.exists(sp):
+            self._fs.rename(sp, cp)  # atomic: the commit point
 
     def _staged_cids(self) -> List[int]:
         return sorted(
             int(f[len("epoch-"):-len(".jsonl")])
-            for f in os.listdir(self._staged_dir)
+            for f in self._fs.listdir(self._staged_dir)
             if f.startswith("epoch-") and f.endswith(".jsonl"))
 
     def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
@@ -398,9 +399,13 @@ class FileTransactionalSink(Sink):
         # rows are gone (sources replay only post-checkpoint)
         epochs = {}
         for cid in self._staged_cids():
-            with open(self._staged_path(cid)) as f:
-                epochs[str(cid)] = [
-                    json.loads(line) for line in f if line.strip()]
+            with self._fs.open_read(self._staged_path(cid)) as f:
+                raw = f.read()
+            if isinstance(raw, bytes):
+                raw = raw.decode("utf-8")
+            epochs[str(cid)] = [
+                json.loads(line) for line in raw.splitlines()
+                if line.strip()]
         return {"epochs": epochs}
 
     def restore_staged(self, staged: Any, checkpoint_id: int) -> None:
@@ -413,20 +418,20 @@ class FileTransactionalSink(Sink):
             # visible even though the commit round never ran; if the
             # staged file was deleted in the meantime, rebuild it from
             # the payload before committing
-            if not os.path.exists(self._committed_path(cid)):
-                if not os.path.exists(self._staged_path(cid)):
+            if not self._fs.exists(self._committed_path(cid)):
+                if not self._fs.exists(self._staged_path(cid)):
                     self._pending = rows
                     self.prepare_commit(cid)
                 self._commit_epoch(cid)
         # anything still staged on disk is either uncovered (replays
         # from source positions) or a later attempt's leftovers — drop
         for cid in self._staged_cids():
-            os.remove(self._staged_path(cid))
+            self._fs.delete(self._staged_path(cid))
 
     def abort_uncommitted(self) -> None:
         self._pending = []
         for cid in self._staged_cids():
-            os.remove(self._staged_path(cid))
+            self._fs.delete(self._staged_path(cid))
 
     @classmethod
     def committed_rows(cls, directory: str) -> List[Dict[str, Any]]:

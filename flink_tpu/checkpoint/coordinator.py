@@ -194,10 +194,18 @@ class CheckpointCoordinator:
 
                     faults.fire("checkpoint.upload", exc=OSError,
                                 checkpoint_id=cid)
+                    from flink_tpu.fs import enospc_retry
+
                     mat = materialize_snapshot(payload)
                     ops = mat.pop("operators", None)
                     if ops is None:
-                        h = self.storage.save(cid, mat, savepoint=savepoint)
+                        # whole-save ENOSPC retry (storage.enospc-
+                        # policy=retry): each attempt writes a FRESH
+                        # unique tmp dir, so a failed attempt leaves
+                        # only sweepable debris — retention freeing
+                        # space between attempts is the degrade path
+                        h = enospc_retry(lambda: self.storage.save(
+                            cid, mat, savepoint=savepoint))
                     else:
                         blobs: Dict[str, bytes] = {}
                         reuse: Dict[str, ReusedOpState] = {}
@@ -210,8 +218,8 @@ class CheckpointCoordinator:
                                 # self-describing v3 blob, not pickle
                                 # (schema evolution; SURVEY §3.1)
                                 blobs[str(nid)] = blobformat.encode(snap)
-                        h = self.storage.save_v2(
-                            cid, mat, blobs, reuse, savepoint=savepoint)
+                        h = enospc_retry(lambda: self.storage.save_v2(
+                            cid, mat, blobs, reuse, savepoint=savepoint))
                     psp.set("bytes", getattr(h, "size_bytes", None))
                     return h
             finally:

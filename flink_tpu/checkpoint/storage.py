@@ -44,7 +44,7 @@ import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 from flink_tpu import faults
-from flink_tpu.fs import FileSystem, get_filesystem
+from flink_tpu.fs import FileSystem, get_filesystem, open_write_sync
 
 
 @dataclasses.dataclass
@@ -165,10 +165,19 @@ class FsCheckpointStorage:
                     checkpoint_id=checkpoint_id)
         faults.fire("checkpoint.storage.write", exc=OSError,
                     checkpoint_id=checkpoint_id)
-        with self.fs.open_write(os.path.join(tmp, "state.blob")) as f:
+        # sync-on-close (the fs seam's durability barrier): every byte
+        # of the checkpoint is on stable storage BEFORE the rename
+        # publishes the directory — a power cut can lose the rename
+        # (the checkpoint never existed; restore takes the previous
+        # one) but can never publish torn content at the final name
+        with open_write_sync(self.fs, os.path.join(tmp, "state.blob"),
+                             sync=True) as f:
             f.write(self._pack(blobformat.encode(payload)))
         ts = int(time.time() * 1000)
-        with self.fs.open_write(os.path.join(tmp, "MANIFEST.json")) as f:
+        faults.fire("checkpoint.storage.fsync", exc=OSError,
+                    checkpoint_id=checkpoint_id)
+        with open_write_sync(self.fs, os.path.join(tmp, "MANIFEST.json"),
+                             sync=True) as f:
             f.write(json.dumps({
                 "checkpoint_id": checkpoint_id,
                 "timestamp_ms": ts,
@@ -179,12 +188,15 @@ class FsCheckpointStorage:
                 "compression": self.compression,
                 "epoch": self.epoch,
             }).encode())
-        faults.fire("checkpoint.storage.fsync", exc=OSError,
-                    checkpoint_id=checkpoint_id)
         try:
             self._check_fence()
         except StaleCheckpointWriter:
-            self.fs.delete(tmp, recursive=True)
+            try:
+                self.fs.delete(tmp, recursive=True)
+            except OSError:
+                pass  # the FENCE is the signal — a failed tmp sweep
+                # (now loud at the fs layer) must not replace it with a
+                # generic persist error the retry machinery would chase
             raise
         # a rename fault here is the TORN-manifest scenario: the tmp dir
         # is fully written (manifest included) but never reaches its
@@ -194,6 +206,10 @@ class FsCheckpointStorage:
         if self.fs.exists(d):
             self.fs.delete(d, recursive=True)
         self.fs.rename(tmp, d)
+        # entry durability: the rename that published the checkpoint is
+        # a directory mutation — fsync the job dir so 'save returned'
+        # implies 'restore will find it' across a power cut
+        self.fs.fsync(self.job_dir)
         if not savepoint:
             self._retire_old()
         return CheckpointHandle(checkpoint_id, d, ts, savepoint,
@@ -218,7 +234,8 @@ class FsCheckpointStorage:
         op_files: Dict[str, str] = {}
         for nid, blob in op_blobs.items():
             fn = f"op-{nid}.blob"
-            with self.fs.open_write(os.path.join(tmp, fn)) as f:
+            with open_write_sync(self.fs, os.path.join(tmp, fn),
+                                 sync=True) as f:
                 f.write(self._pack(blob))
             op_files[nid] = fn
             versions[nid] = meta_payload.get(
@@ -230,10 +247,23 @@ class FsCheckpointStorage:
             self.fs.link_or_copy(ref.file, os.path.join(tmp, fn))
             op_files[nid] = fn
             versions[nid] = ref.version
-        with self.fs.open_write(os.path.join(tmp, "meta.blob")) as f:
+        if op_reuse:
+            # entry durability for the REUSE links: a hardlink is a
+            # directory mutation the blobs' content fsyncs never cover
+            # — without this dir barrier a power cut after save_v2
+            # returned could keep the (durable) manifest while the
+            # linked op-blob entry vanished, leaving an acked
+            # checkpoint that cannot load (the crash explorer's
+            # CheckpointTier.check_image guards this)
+            self.fs.fsync(tmp)
+        with open_write_sync(self.fs, os.path.join(tmp, "meta.blob"),
+                             sync=True) as f:
             f.write(self._pack(blobformat.encode(meta_payload)))
         ts = int(time.time() * 1000)
-        with self.fs.open_write(os.path.join(tmp, "MANIFEST.json")) as f:
+        faults.fire("checkpoint.storage.fsync", exc=OSError,
+                    checkpoint_id=checkpoint_id)
+        with open_write_sync(self.fs, os.path.join(tmp, "MANIFEST.json"),
+                             sync=True) as f:
             f.write(json.dumps({
                 "checkpoint_id": checkpoint_id,
                 "timestamp_ms": ts,
@@ -245,18 +275,20 @@ class FsCheckpointStorage:
                         for nid, fn in op_files.items()},
                 "epoch": self.epoch,
             }).encode())
-        faults.fire("checkpoint.storage.fsync", exc=OSError,
-                    checkpoint_id=checkpoint_id)
         try:
             self._check_fence()
         except StaleCheckpointWriter:
-            self.fs.delete(tmp, recursive=True)
+            try:
+                self.fs.delete(tmp, recursive=True)
+            except OSError:
+                pass  # keep the fence signal (see save())
             raise
         faults.fire("checkpoint.storage.rename", exc=OSError,
                     checkpoint_id=checkpoint_id)
         if self.fs.exists(d):
             self.fs.delete(d, recursive=True)
         self.fs.rename(tmp, d)
+        self.fs.fsync(self.job_dir)  # entry durability (see save())
         if not savepoint:
             self._retire_old()
         return CheckpointHandle(checkpoint_id, d, ts, savepoint,

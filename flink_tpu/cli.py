@@ -18,6 +18,7 @@ script. Here::
     python -m flink_tpu lint [paths ...] [--json]
     python -m flink_tpu log TOPIC_DIR [--compact] [--retain] \
         [--conf key=value ...]
+    python -m flink_tpu fsck PATH [--repair] [--json]
     python -m flink_tpu list --coordinator H:P
     python -m flink_tpu status --coordinator H:P JOB_ID
     python -m flink_tpu cancel --coordinator H:P JOB_ID
@@ -502,6 +503,31 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp_.add_argument("--ha-dir", default=None, metavar="DIR",
                      help=_HA_HELP)
 
+    fsck = sub.add_parser(
+        "fsck",
+        help="offline storage integrity check: walk a log topic or a "
+             "checkpoint directory verifying segment CRCs/footers, "
+             "marker/manifest/lease coherence, and orphan debris "
+             "(flink_tpu/fsck.py)",
+        epilog="exit codes: 0 = clean, 1 = findings remain, 2 = "
+               "usage/path error (not a recognizable topic or "
+               "checkpoint dir). --json prints one finding object per "
+               "line (rule, severity, path, message, repairable, "
+               "repaired).")
+    fsck.add_argument("path", metavar="PATH",
+                      help="topic dir (meta.json) or checkpoint dir "
+                           "(chk-*/savepoint-* children; a single "
+                           "checkpoint or a whole storage root also "
+                           "work) — autodetected")
+    fsck.add_argument("--repair", action="store_true",
+                      help="apply the already-safe sweeps only "
+                           "(delete .tmp debris, unreferenced "
+                           "segments, orphaned in-progress checkpoint "
+                           "dirs); never touches markers, leases, or "
+                           "referenced files")
+    fsck.add_argument("--json", action="store_true",
+                      help="one JSON object per finding")
+
     logp = sub.add_parser(
         "log",
         help="inspect a durable log topic (committed offsets, staged "
@@ -563,6 +589,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         _print_findings(findings, as_json=args.json)
         return 1 if findings else 0
+
+    if args.cmd == "fsck":
+        from flink_tpu.fsck import main as fsck_main
+
+        return fsck_main(args)
 
     if args.cmd == "log":
         import os

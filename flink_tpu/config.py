@@ -486,6 +486,34 @@ class StateOptions:
         "exact host-side degradation.")
 
 
+class StorageOptions:
+    """The durable-storage degradation grammar (flink_tpu/fs.py): how
+    the FileSystem seam behaves when the disk itself fails under a
+    write — the crash-consistency plane's runtime half."""
+
+    ENOSPC_POLICY = ConfigOption(
+        "storage.enospc-policy", "retry",
+        "How a durable write seam (checkpoint persist, log segment "
+        "stage, sink part write — everything routed through "
+        "fs.write_atomic/enospc_retry) handles OSError(ENOSPC): "
+        "'retry' (default) re-attempts the whole-file write with "
+        "bounded backoff (retention/rotation may free space between "
+        "attempts; every re-attempt counts on the "
+        "storage.enospc_retries metric, exhausted budgets count toward "
+        "execution.checkpointing.tolerable-failures like any persist "
+        "failure) or 'fail' (propagate immediately). Either way the "
+        "tmp+fsync+rename discipline guarantees no torn file at a "
+        "final name.")
+    ENOSPC_RETRIES = ConfigOption(
+        "storage.enospc-retries", 4,
+        "Bounded retry budget per whole-file write under "
+        "storage.enospc-policy=retry (0 behaves like 'fail').")
+    ENOSPC_BACKOFF_MS = ConfigOption(
+        "storage.enospc-backoff-ms", 50.0,
+        "First retry delay in ms under storage.enospc-policy=retry; "
+        "doubles per attempt.")
+
+
 class CheckpointingOptions:
     INTERVAL = duration_option(
         "execution.checkpointing.interval", 0,

@@ -180,14 +180,13 @@ class FileSink(TwoPhaseCommitSink):
             chunk = {k: v[lo:lo + self.rolling_records]
                      for k, v in data.items()}
             payload = self.format.serialize(chunk)
-            path = os.path.join(self._staged_dir,
-                                self._part_name(cid, part))
-            tmp = path + ".tmp"
-            with self._fs.open_write(tmp) as f:
-                f.write(payload)
-                f.flush()
-                os.fsync(f.fileno())
-            self._fs.rename(tmp, path)
+            from flink_tpu.fs import write_atomic
+
+            # tmp + fsync + rename through the seam (ENOSPC-retried,
+            # CrashFS-recorded): the staged part is durable before the
+            # pre-commit state references it
+            write_atomic(self._fs, os.path.join(
+                self._staged_dir, self._part_name(cid, part)), payload)
             part += 1
         return True
 
@@ -254,14 +253,13 @@ class FileSink(TwoPhaseCommitSink):
         return {"parts": parts}
 
     def rebuild_transaction(self, cid: int, payload: Any) -> None:
+        from flink_tpu.fs import write_atomic
+
         for name, data in (payload or {}).get("parts", {}).items():
             path = os.path.join(self._staged_dir, name)
             if self._fs.exists(path):
                 continue
-            tmp = path + ".tmp"
-            with self._fs.open_write(tmp) as f:
-                f.write(data)
-            self._fs.rename(tmp, path)
+            write_atomic(self._fs, path, data)
 
     # -- reading back (tests / consumers) -------------------------------
     def committed_batches(self) -> List[Dict[str, np.ndarray]]:

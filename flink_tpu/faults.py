@@ -61,6 +61,13 @@ chaos harness.
 
 Instrumented points (the stack's recovery-critical seams):
 
+    fs.write.enospc / fs.fsync / fs.rename                 fs.py
+        (the FileSystem seam itself — EVERY durable tier routes
+        writes/fsyncs/renames through it, so one glob targets the
+        whole storage plane: fs.write.enospc is the disk filling up
+        at open-for-write (the storage.enospc-policy drill),
+        fs.fsync a durability barrier dying, fs.rename an atomic
+        publish dying before the rename lands)
     checkpoint.storage.stall / .write / .fsync / .rename   storage.py
     checkpoint.upload                                      coordinator.py
     rpc.client.send / rpc.client.recv / rpc.server.dispatch  rpc.py
@@ -180,6 +187,9 @@ FAULT_INJECT = ConfigOption(
 # injects nothing is worse than no chaos at all. Keep in sync with the
 # point list in the module docstring above.
 KNOWN_FAULT_POINTS = frozenset((
+    "fs.write.enospc",
+    "fs.fsync",
+    "fs.rename",
     "checkpoint.storage.stall",
     "checkpoint.storage.write",
     "checkpoint.storage.fsync",

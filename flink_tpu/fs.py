@@ -12,13 +12,35 @@ interface every storage path uses, and a scheme registry that plugins
 extend either programmatically (``register_filesystem``) or by naming
 modules in ``plugins.modules`` config (each module's
 ``register(registry)`` hook runs at load, the PluginManager analogue).
+
+Durability contract (the crash-consistency plane, fs_crash.py):
+EVERY durable write in the stack routes through this seam — the write
+handle (``open_write(path, sync=True)`` fsyncs before close returns),
+the explicit barrier (``fsync(path)``), the atomic publish
+(``write_atomic``: tmp + fsync + rename) and ``rename`` itself. No
+durable tier calls raw ``open()``/``os.fsync``/``os.replace`` (gated
+by tests/test_architecture.py TestDurableWriteSeam), so a recording
+wrapper like CrashFS observes the COMPLETE mutation/durability order
+and can materialize any POSIX-legal post-crash image.
+
+ENOSPC degradation (``storage.enospc-policy``): a full disk surfaces
+as ``OSError(ENOSPC)`` mid-write. Under the default ``retry`` policy
+the whole-file write attempts again with bounded backoff (counted on
+the ``storage.enospc_retries`` metric); ``fail`` propagates
+immediately — either way the tmp+rename discipline means no torn file
+ever reaches its final name.
 """
 from __future__ import annotations
 
+import errno
 import importlib
 import os
 import shutil
-from typing import Callable, Dict, Iterable, List, Optional, Tuple
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from flink_tpu.obs.metrics import MetricRegistry
 
 
 class FileSystem:
@@ -28,8 +50,16 @@ class FileSystem:
     def open_read(self, path: str):
         raise NotImplementedError
 
-    def open_write(self, path: str):
+    def open_write(self, path: str, sync: bool = False):
+        """Write handle; ``sync=True`` makes close() a durability
+        barrier (flush + fsync before it returns) — the segment/blob
+        write discipline of every transactional tier."""
         raise NotImplementedError
+
+    def fsync(self, path: str) -> None:
+        """Durability barrier on an already-closed file (the group-
+        commit fsync pass). Default no-op: non-local backends own their
+        durability (a PUT that returned IS durable on object stores)."""
 
     def mkdirs(self, path: str) -> None:
         raise NotImplementedError
@@ -70,8 +100,27 @@ class LocalFileSystem(FileSystem):
     def open_read(self, path: str):
         return open(self._strip(path), "rb")
 
-    def open_write(self, path: str):
-        return open(self._strip(path), "wb")
+    def open_write(self, path: str, sync: bool = False):
+        from flink_tpu import faults
+
+        # the disk-full seam: an ENOSPC here is the write dying at
+        # open/allocate time — the enospc_retry policy wraps callers
+        faults.fire("fs.write.enospc", exc=OSError, path=path)
+        f = open(self._strip(path), "wb")
+        return _SyncOnClose(f) if sync else f
+
+    def fsync(self, path: str) -> None:
+        from flink_tpu import faults
+
+        faults.fire("fs.fsync", exc=OSError, path=path)
+        fd = os.open(self._strip(path), os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        except OSError:
+            pass  # non-fsyncable mount (proc/overlay): the write
+            # handle's own close-time sync already did what it could
+        finally:
+            os.close(fd)
 
     def mkdirs(self, path: str) -> None:
         os.makedirs(self._strip(path), exist_ok=True)
@@ -87,11 +136,18 @@ class LocalFileSystem(FileSystem):
         if os.path.isdir(p) and not os.path.islink(p):
             if not recursive:
                 raise IsADirectoryError(p)
-            shutil.rmtree(p, ignore_errors=True)
+            # NOT ignore_errors: a retention/abort pass that silently
+            # fails to delete violates the loud-failure convention —
+            # callers that genuinely tolerate sweep failures (retention,
+            # best-effort cleanup) catch OSError themselves
+            shutil.rmtree(p)
         elif os.path.exists(p):
             os.remove(p)
 
     def rename(self, src: str, dst: str) -> None:
+        from flink_tpu import faults
+
+        faults.fire("fs.rename", exc=OSError, src=src, dst=dst)
         os.rename(self._strip(src), self._strip(dst))
 
     def link_or_copy(self, src: str, dst: str) -> None:
@@ -99,12 +155,222 @@ class LocalFileSystem(FileSystem):
             os.link(self._strip(src), self._strip(dst))
         except OSError:
             shutil.copyfile(self._strip(src), self._strip(dst))
+            # the COPY branch writes fresh bytes (a hardlink shares the
+            # source's already-durable content; a copy does not) —
+            # fsync them so callers may treat link_or_copy results as
+            # content-durable either way
+            self.fsync(dst)
 
     def size(self, path: str) -> int:
         return os.path.getsize(self._strip(path))
 
     def is_dir(self, path: str) -> bool:
         return os.path.isdir(self._strip(path))
+
+
+class _SyncOnClose:
+    """Write handle whose close() is a durability barrier: flush +
+    fsync strictly before close returns (``open_write(sync=True)``).
+    Wraps rather than subclasses — ``open()`` returns a C-implemented
+    BufferedWriter."""
+
+    def __init__(self, f) -> None:
+        self._f = f
+
+    def write(self, data) -> int:
+        return self._f.write(data)
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def fileno(self) -> int:
+        return self._f.fileno()
+
+    def close(self) -> None:
+        if self._f.closed:
+            return
+        self._f.flush()
+        from flink_tpu import faults
+
+        faults.fire("fs.fsync", exc=OSError)
+        try:
+            os.fsync(self._f.fileno())
+        except OSError:
+            pass  # non-fsyncable mount — same tolerance as fsync()
+        self._f.close()
+
+    def __enter__(self) -> "_SyncOnClose":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        # an erroring with-block must not fsync garbage it already
+        # knows is partial — plain close, the tmp never renames
+        if exc and exc[0] is not None:
+            self._f.close()
+        else:
+            self.close()
+
+
+# -- ENOSPC degradation policy (storage.enospc-policy) -------------------
+
+_ENOSPC_ERRNOS = (errno.ENOSPC, getattr(errno, "EDQUOT", errno.ENOSPC))
+
+# process-global storage metrics (the faults.py registry pattern):
+# storage.enospc_retries counts every backed-off re-attempt so a
+# degrading disk is visible before it becomes a failed job
+registry = MetricRegistry()
+_policy_lock = threading.Lock()
+_enospc_policy: Dict[str, Any] = {
+    "mode": "retry", "retries": 4, "backoff_ms": 50.0}
+
+
+def is_enospc(exc: BaseException) -> bool:
+    """Disk-full classification: real ``OSError(ENOSPC/EDQUOT)`` plus
+    injected faults at the ``fs.write.enospc`` point (the message names
+    the point — faults.fire cannot carry an errno)."""
+    if not isinstance(exc, OSError):
+        return False
+    return exc.errno in _ENOSPC_ERRNOS or "enospc" in str(exc).lower()
+
+
+def install_enospc_policy(mode: str = "retry", retries: int = 4,
+                          backoff_ms: float = 50.0) -> None:
+    if mode not in ("retry", "fail"):
+        raise ValueError(
+            f"storage.enospc-policy must be 'retry' or 'fail', "
+            f"got {mode!r}")
+    with _policy_lock:
+        _enospc_policy.update(mode=mode, retries=max(0, int(retries)),
+                              backoff_ms=float(backoff_ms))
+
+
+def install_enospc_policy_from_config(config) -> None:
+    """The driver's deploy-time install (the faults.install_from_config
+    shape). The policy is PROCESS-global — like the faults plan and for
+    the same reason: the disk filling up is a property of the machine,
+    not attributable to one tenant from inside the write seam. So a
+    config that does not EXPLICITLY set any ``storage.enospc*`` key is
+    a no-op here (the installed policy — the declared default, or a
+    co-resident job's explicit choice — stays), and co-scheduling two
+    jobs with CONFLICTING explicit policies on one runner process is
+    last-writer-wins, the documented faults-plane discipline: give
+    policy-sensitive jobs their own runner."""
+    from flink_tpu.config import StorageOptions
+
+    keys = set(config.keys())
+    if not any(opt.key in keys for opt in (
+            StorageOptions.ENOSPC_POLICY, StorageOptions.ENOSPC_RETRIES,
+            StorageOptions.ENOSPC_BACKOFF_MS)):
+        return
+    install_enospc_policy(
+        str(config.get(StorageOptions.ENOSPC_POLICY)).strip().lower(),
+        int(config.get(StorageOptions.ENOSPC_RETRIES)),
+        float(config.get(StorageOptions.ENOSPC_BACKOFF_MS)))
+
+
+def enospc_policy() -> Dict[str, Any]:
+    with _policy_lock:
+        return dict(_enospc_policy)
+
+
+_enospc_counter = None
+
+
+def _count_enospc_retry() -> None:
+    # MetricGroup.counter() REGISTERS A FRESH Counter per call — cache
+    # one instance or every retry would reset the count (the faults.py
+    # counter-cache discipline)
+    global _enospc_counter
+    if _enospc_counter is None:
+        with _policy_lock:
+            if _enospc_counter is None:
+                _enospc_counter = registry.group(
+                    "storage").counter("enospc_retries")
+    _enospc_counter.inc()
+
+
+def enospc_retry(fn: Callable[[], Any], what: str = "") -> Any:
+    """Run a WHOLE-FILE write attempt under the installed policy:
+    ``retry`` re-runs it with bounded backoff on an ENOSPC-classed
+    OSError (a retention pass or log rotation may free space between
+    attempts); ``fail`` — or an exhausted budget — propagates. Retry is
+    safe exactly because every caller is an idempotent tmp-write
+    (write_atomic, segment writes, checkpoint persists): a failed
+    attempt leaves only an unreferenced tmp the recovery sweep
+    removes."""
+    pol = enospc_policy()
+    attempts = pol["retries"] + 1 if pol["mode"] == "retry" else 1
+    delay = pol["backoff_ms"] / 1000.0
+    for i in range(attempts):
+        try:
+            return fn()
+        except OSError as e:
+            if not is_enospc(e) or i >= attempts - 1:
+                raise
+            _count_enospc_retry()
+            time.sleep(delay)
+            delay *= 2
+
+
+# per-class capability memo for open_write_sync (one signature
+# inspection per FileSystem implementation, ever)
+_SYNC_CAPABLE: Dict[type, bool] = {}
+
+
+def open_write_sync(fs: "FileSystem", path: str, sync: bool = False):
+    """Open a write handle through the seam, tolerating LEGACY plugin
+    filesystems whose ``open_write(self, path)`` predates the ``sync``
+    keyword: those get a plain handle and the durability barrier falls
+    back to ``fs.fsync(path)`` after close (base-class no-op — such
+    backends own their durability, the tolerance the old log-tier
+    ``_write_atomic`` extended to them). Every sync=True call site
+    routes through here so a third-party plugin keeps working instead
+    of dying on a TypeError mid-write."""
+    if sync_capable(fs):
+        return fs.open_write(path, sync=sync)
+    return fs.open_write(path)
+
+
+def sync_capable(fs: "FileSystem") -> bool:
+    """Whether this backend's ``open_write`` takes the ``sync``
+    keyword (memoized per class)."""
+    cls = type(fs)
+    cap = _SYNC_CAPABLE.get(cls)
+    if cap is None:
+        import inspect
+
+        try:
+            cap = "sync" in inspect.signature(cls.open_write).parameters
+        except (TypeError, ValueError):
+            cap = True
+        _SYNC_CAPABLE[cls] = cap
+    return cap
+
+
+def write_atomic(fs: "FileSystem", path: str, payload,
+                 durable: bool = True) -> None:
+    """THE shared atomic-publish helper every durable tier uses:
+    tmp + write + fsync + atomic rename + PARENT-DIR fsync (when
+    ``durable``) — readers observe the old or the new file whole, never
+    a torn write at the final name, and the rename itself survives a
+    power cut (fsyncing the file alone does NOT persist its directory
+    entry; the dir fsync is what makes 'it returned, so it is durable'
+    true — the classic fsync-the-file-forget-the-dir hole, closed).
+    ENOSPC mid-write retries whole-file under the installed policy
+    (the tmp is rewritten from scratch each attempt)."""
+
+    def attempt() -> None:
+        tmp = path + ".tmp"
+        with open_write_sync(fs, tmp, sync=durable) as f:
+            f.write(payload)
+        if durable and not sync_capable(fs):
+            fs.fsync(tmp)  # legacy-plugin fallback barrier (base-class
+            # no-op where the backend owns its durability)
+        fs.rename(tmp, path)
+        if durable:
+            fs.fsync(os.path.dirname(path) or ".")
+
+    enospc_retry(attempt, what=path)
 
 
 class FileSystemRegistry:

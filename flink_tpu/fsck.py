@@ -1,0 +1,545 @@
+"""Storage fsck — offline integrity verification for the durable tiers.
+
+``python -m flink_tpu fsck PATH [--repair] [--json]`` walks a log
+TOPIC directory or a CHECKPOINT directory (a job dir of ``chk-*``
+children, a single checkpoint dir, or a storage root of job dirs —
+autodetected) and verifies what the online readers assume:
+
+- **segments**: every committed/compacted columnar file decodes whole —
+  block CRCs (the ``native_codec.crc32`` path ``formats_columnar``
+  verifies with), footer tripwire, row counts vs the commit marker's
+  promise;
+- **coherence**: committed offset ranges contiguous above the floor,
+  compaction manifest generation sane (referenced files exist, cover
+  the declared ranges), marker pairs (a pre without a commit is a
+  staged transaction — suspicious in a quiesced topic), lease files
+  parseable with un-expired deadlines;
+- **orphans**: ``.tmp`` debris, segments no marker/manifest references,
+  ``.inprogress`` checkpoint dirs, manifest-less final-name checkpoint
+  dirs.
+
+``--repair`` applies ONLY the already-safe sweeps — exactly what the
+online recovery paths (``TopicAppender.sweep_orphans``, checkpoint
+``_retire_old``) would do: delete ``.tmp`` debris, unreferenced
+segment/cmp files, ``.inprogress`` and manifest-less checkpoint dirs.
+It never touches markers, leases, group offsets, or any file a marker
+or manifest references: those repairs need the owning writer's context
+(a deleted pre marker aborts someone's live transaction).
+
+Exit contract (the analyze/lint CLI shape, asserted in tests/
+test_cli.py): 0 = clean, 1 = findings, 2 = usage/path error.
+
+Finding shape (one JSON object per line under ``--json``): ``rule``,
+``severity`` (error|warn), ``path``, ``message``, ``repairable``,
+and ``repaired`` after a ``--repair`` pass.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from flink_tpu.formats_columnar import ColumnarError, iter_blocks
+from flink_tpu.fs import get_filesystem
+from flink_tpu.log.topic import (
+    GROUP_DIR,
+    LEASE_DIR,
+    OFFSET_COL,
+    _CMP_RE,
+    _SEG_RE,
+    LogError,
+    _list_markers,
+    _partition_dir,
+    _read_json,
+    _txn_dir,
+    load_manifest,
+)
+
+__all__ = ["fsck_path", "fsck_topic", "fsck_checkpoints", "main"]
+
+
+# a repairable topic FILE younger than this is skipped by --repair:
+# between a live producer's segment rename and its pre-commit marker
+# the file is indistinguishable from debris (the stage-window grace)
+REPAIR_MIN_AGE_S = 60.0
+
+
+def _older_than(path: str, age_s: float) -> bool:
+    from flink_tpu.log.topic import _local_path
+
+    local = _local_path(path)
+    if local is None:
+        return True  # non-local: no mtime to consult — lease guard
+        # and the maintenance lock remain the protections
+    try:
+        return (time.time() - os.path.getmtime(local)) > age_s
+    except OSError:
+        return False  # vanished/unstattable: do not touch it
+
+
+def _f(rule: str, severity: str, path: str, message: str,
+       repairable: bool = False) -> Dict[str, Any]:
+    return {"rule": rule, "severity": severity, "path": path,
+            "message": message, "repairable": repairable,
+            "repaired": False}
+
+
+def _classify_columnar(e: Exception) -> str:
+    msg = str(e).lower()
+    if "crc" in msg:
+        return "SEGMENT_CRC"
+    if "truncat" in msg or "footer" in msg or "empty columnar" in msg:
+        return "SEGMENT_TRUNCATED"
+    return "SEGMENT_CORRUPT"
+
+
+def _verify_segment(fs, path: str, schema, promised_rows: Optional[int],
+                    findings: List[Dict[str, Any]]) -> None:
+    """Full decode pass: header + every block CRC + footer; row count
+    vs the marker/manifest promise."""
+    try:
+        with fs.open_read(path) as f:
+            data = f.read()
+        if isinstance(data, str):
+            data = data.encode("utf-8")
+        rows = 0
+        for block in iter_blocks(data, expect_schema=schema):
+            rows += len(next(iter(block.values()), ()))
+        if promised_rows is not None and rows != promised_rows:
+            findings.append(_f(
+                "SEGMENT_ROWS_MISMATCH", "error", path,
+                f"segment holds {rows} rows, its marker promised "
+                f"{promised_rows}"))
+    except OSError as e:
+        findings.append(_f("SEGMENT_MISSING", "error", path,
+                           f"referenced segment unreadable: {e}"))
+    except ColumnarError as e:
+        findings.append(_f(_classify_columnar(e), "error", path,
+                           f"segment fails verification: {e}"))
+
+
+# -- topic --------------------------------------------------------------
+
+def fsck_topic(path: str) -> List[Dict[str, Any]]:
+    fs = get_filesystem(path)
+    findings: List[Dict[str, Any]] = []
+    try:
+        meta = _read_json(fs, os.path.join(path, "meta.json"),
+                          "topic meta")
+        partitions = int(meta["partitions"])
+    except (LogError, OSError, KeyError, ValueError) as e:
+        return [_f("CORRUPT_CONTROL", "error",
+                   os.path.join(path, "meta.json"),
+                   f"unparseable topic meta: {e}")]
+
+    # markers (loud parse -> finding, not a crash)
+    try:
+        pres = _list_markers(fs, path, "pre")
+        commits = _list_markers(fs, path, "commit")
+    except LogError as e:
+        return findings + [_f("CORRUPT_CONTROL", "error",
+                              _txn_dir(path),
+                              f"unparseable transaction marker: {e}")]
+
+    schema = None
+    for key in sorted(commits):
+        if commits[key].get("schema"):
+            schema = tuple((str(n), str(t))
+                           for n, t in commits[key]["schema"])
+    sparse_schema = ((OFFSET_COL, "i64"),) + schema if schema else None
+
+    # compaction manifest FIRST: it defines the per-partition floor
+    # below which commit-marker segments are legitimately superseded
+    try:
+        manifest = load_manifest(fs, path)
+    except LogError as e:
+        manifest = None
+        findings.append(_f("CORRUPT_CONTROL", "error",
+                           os.path.join(path, "manifest.json"),
+                           f"unparseable compaction manifest: {e}"))
+    live_cmp: Dict[int, set] = {p: set() for p in range(partitions)}
+    floor: Dict[int, int] = {p: 0 for p in range(partitions)}
+    if manifest is not None:
+        for p, entry in manifest.get("partitions", {}).items():
+            p = int(p)
+            floor[p] = max(int(entry.get("start", 0)),
+                           int(entry.get("compacted_end", 0)))
+            at = int(entry.get("start", 0))
+            for s in entry.get("segments", []):
+                live_cmp.setdefault(p, set()).add(s["name"])
+                seg = os.path.join(_partition_dir(path, p), s["name"])
+                if not fs.exists(seg):
+                    findings.append(_f(
+                        "MANIFEST_SEGMENT_MISSING", "error", seg,
+                        f"manifest gen {manifest['gen']} references a "
+                        "compacted segment that does not exist"))
+                else:
+                    _verify_segment(fs, seg, sparse_schema,
+                                    int(s["rows"]), findings)
+                if int(s["base"]) < at:
+                    findings.append(_f(
+                        "MANIFEST_INCOHERENT", "error", seg,
+                        f"compacted segment covers [{s['base']}, "
+                        f"{s['end']}) below the running floor {at}"))
+                at = int(s["end"])
+
+    # committed segments: existence + CRC/footer + row promise —
+    # EXCEPT ranges wholly below the compaction/retention floor, whose
+    # raw files were superseded by the manifest generation (a still-
+    # present superseded file is droppable debris, reported as orphan
+    # below, not verified as live data)
+    referenced: Dict[int, set] = {p: set() for p in range(partitions)}
+    for (cid, writer), marker in sorted(commits.items()):
+        for p_s, segs in marker.get("segments", {}).items():
+            p = int(p_s)
+            for s in segs:
+                end = int(s["base"]) + int(s["rows"])
+                if end <= floor.get(p, 0):
+                    continue  # superseded by the manifest generation:
+                    # the raw file may legitimately be gone; if still
+                    # present it reports as a repairable orphan below
+                referenced.setdefault(p, set()).add(s["name"])
+                _verify_segment(
+                    fs,
+                    os.path.join(_partition_dir(path, p), s["name"]),
+                    schema, int(s["rows"]), findings)
+
+    # staged (pre-without-commit) markers: orphan candidates
+    for (cid, writer), marker in sorted(pres.items()):
+        if (cid, writer) in commits:
+            continue
+        missing = []
+        for p_s, segs in marker.get("segments", {}).items():
+            for s in segs:
+                referenced.setdefault(int(p_s), set()).add(s["name"])
+                seg = os.path.join(_partition_dir(path, int(p_s)),
+                                   s["name"])
+                if not fs.exists(seg):
+                    missing.append(s["name"])
+        mpath = os.path.join(_txn_dir(path), f"pre-{cid:010d}"
+                             + (f"-w.{writer}" if writer else "")
+                             + ".json")
+        findings.append(_f(
+            "ORPHAN_PRE_MARKER", "warn", mpath,
+            f"pre-commit marker cid={cid} writer={writer or '<single>'} "
+            f"has no commit marker"
+            + (f" and {len(missing)} of its staged segments are "
+               f"missing ({missing[:3]}...)" if missing else
+               " (staged transaction — live producer, or a crashed "
+               "attempt recovery will roll back)")))
+
+    # offset-chain coherence above the floor (the TopicReader contract)
+    try:
+        from flink_tpu.log.topic import TopicReader
+
+        TopicReader(path)
+    except (LogError, ColumnarError) as e:
+        findings.append(_f("OFFSETS_BROKEN", "error", path,
+                           f"committed offset chain is broken: {e}"))
+    except OSError:
+        pass  # per-segment findings above already name the files
+
+    # orphans: tmp debris + unreferenced segment/cmp files
+    for p in range(partitions):
+        pdir = _partition_dir(path, p)
+        if not fs.exists(pdir):
+            continue
+        for name in sorted(fs.listdir(pdir)):
+            fpath = os.path.join(pdir, name)
+            if name.endswith(".tmp"):
+                findings.append(_f(
+                    "ORPHAN_FILE", "warn", fpath,
+                    "write-in-progress debris (crashed writer)",
+                    repairable=True))
+            elif _SEG_RE.match(name):
+                if name not in referenced.get(p, set()):
+                    findings.append(_f(
+                        "ORPHAN_FILE", "warn", fpath,
+                        "segment referenced by no pre/commit marker "
+                        "(torn prepare or superseded by compaction)",
+                        repairable=True))
+            elif _CMP_RE.match(name):
+                if name not in live_cmp.get(p, set()):
+                    findings.append(_f(
+                        "ORPHAN_FILE", "warn", fpath,
+                        "compacted segment outside the current "
+                        "manifest generation (crashed or superseded "
+                        "pass)", repairable=True))
+
+    # leases: parseable, not silently expired
+    ldir = os.path.join(path, LEASE_DIR)
+    if fs.exists(ldir):
+        now = int(time.time() * 1000)
+        for name in sorted(fs.listdir(ldir)):
+            # the .json suffix also excludes "pN.json.lock" acquire locks
+            if not name.endswith(".json"):
+                continue
+            lpath = os.path.join(ldir, name)
+            try:
+                rec = _read_json(fs, lpath, "lease file")
+            except LogError as e:
+                findings.append(_f("CORRUPT_CONTROL", "error", lpath,
+                                   f"unparseable lease: {e}"))
+                continue
+            if (not rec.get("released")
+                    and int(rec.get("deadline_ms", 0)) < now):
+                findings.append(_f(
+                    "STALE_LEASE", "warn", lpath,
+                    f"lease held by {rec.get('owner')!r} (epoch "
+                    f"{rec.get('epoch')}) expired at "
+                    f"{rec.get('deadline_ms')} without release — "
+                    "crashed producer; the next acquirer takes over "
+                    "at epoch+1"))
+
+    # consumer-group offsets: parseable, within the committed range
+    gdir = os.path.join(path, GROUP_DIR)
+    if fs.exists(gdir):
+        for gname in sorted(fs.listdir(gdir)):
+            sub = os.path.join(gdir, gname)
+            if not fs.is_dir(sub):
+                continue
+            for name in sorted(fs.listdir(sub)):
+                if not name.endswith(".json"):
+                    continue
+                opath = os.path.join(sub, name)
+                try:
+                    rec = _read_json(fs, opath, "group-offset file")
+                    int(rec["offset"])
+                except (LogError, KeyError, ValueError, TypeError) as e:
+                    findings.append(_f(
+                        "CORRUPT_CONTROL", "error", opath,
+                        f"unparseable group offset: {e}"))
+    return findings
+
+
+# -- checkpoints --------------------------------------------------------
+
+def _fsck_one_checkpoint(fs, d: str,
+                         findings: List[Dict[str, Any]]) -> None:
+    from flink_tpu.checkpoint import blobformat
+
+    mf = os.path.join(d, "MANIFEST.json")
+    if not fs.exists(mf):
+        findings.append(_f(
+            "CHECKPOINT_MANIFEST_MISSING", "error", d,
+            "final-name checkpoint dir without MANIFEST.json — "
+            "invisible to restore (a power cut between content and "
+            "manifest can not produce this under manifest-last; "
+            "likely a partially deleted or hand-damaged checkpoint)",
+            repairable=True))
+        return
+    try:
+        manifest = _read_json(fs, mf, "checkpoint manifest")
+    except LogError as e:
+        findings.append(_f("CORRUPT_CONTROL", "error", mf,
+                           f"unparseable checkpoint manifest: {e}"))
+        return
+
+    def _check_blob(fpath: str) -> None:
+        try:
+            with fs.open_read(fpath) as f:
+                raw = f.read()
+        except OSError as e:
+            findings.append(_f("CHECKPOINT_BLOB_MISSING", "error",
+                               fpath, f"manifest references a missing "
+                               f"blob: {e}"))
+            return
+        if isinstance(raw, str):
+            raw = raw.encode()
+        comp = manifest.get("compression", "none")
+        if comp == "zlib":
+            import zlib
+
+            try:
+                raw = zlib.decompress(raw)
+            except zlib.error as e:
+                findings.append(_f("CHECKPOINT_BLOB_CORRUPT", "error",
+                                   fpath, f"undecompressable blob: {e}"))
+                return
+        if blobformat.is_v3(raw):
+            try:
+                blobformat.decode(raw)
+            except Exception as e:  # noqa: BLE001 — any decode death
+                findings.append(_f(
+                    "CHECKPOINT_BLOB_CORRUPT", "error", fpath,
+                    f"blob fails decode: {type(e).__name__}: {e}"))
+        elif not raw:
+            findings.append(_f("CHECKPOINT_BLOB_CORRUPT", "error",
+                               fpath, "zero-byte blob"))
+
+    fmt = int(manifest.get("format_version", 1))
+    if fmt == 1 or manifest.get("layout") == "single":
+        name = "state.blob" if fmt >= 3 else "state.pkl"
+        _check_blob(os.path.join(d, name))
+    else:
+        _check_blob(os.path.join(
+            d, "meta.blob" if fmt >= 3 else "meta.pkl"))
+        for nid, entry in manifest.get("ops", {}).items():
+            _check_blob(os.path.join(d, entry["file"]))
+
+
+def fsck_checkpoints(path: str) -> List[Dict[str, Any]]:
+    """``path`` is a job dir (chk-* children), one checkpoint dir, or
+    a storage root (job dirs of chk-* children)."""
+    fs = get_filesystem(path)
+    findings: List[Dict[str, Any]] = []
+
+    def _walk_job_dir(jdir: str) -> None:
+        for name in sorted(fs.listdir(jdir)):
+            d = os.path.join(jdir, name)
+            if ".inprogress." in name:
+                findings.append(_f(
+                    "CHECKPOINT_INPROGRESS_ORPHAN", "warn", d,
+                    "abandoned in-progress checkpoint dir (crashed or "
+                    "fenced writer)", repairable=True))
+            elif name.endswith(".tmp"):
+                findings.append(_f("ORPHAN_FILE", "warn", d,
+                                   "write-in-progress debris",
+                                   repairable=True))
+            elif (name.startswith("chk-")
+                  or name.startswith("savepoint-")) and fs.is_dir(d):
+                _fsck_one_checkpoint(fs, d, findings)
+
+    base = os.path.basename(os.path.normpath(path))
+    if base.startswith("chk-") or base.startswith("savepoint-"):
+        _fsck_one_checkpoint(fs, path, findings)
+        return findings
+    names = fs.listdir(path)
+    if any(n.startswith(("chk-", "savepoint-")) or ".inprogress." in n
+           for n in names):
+        _walk_job_dir(path)
+        return findings
+    # storage root: every child holding chk-* dirs is a job dir
+    for name in sorted(names):
+        jdir = os.path.join(path, name)
+        if fs.is_dir(jdir) and any(
+                n.startswith(("chk-", "savepoint-"))
+                or ".inprogress." in n for n in fs.listdir(jdir)):
+            _walk_job_dir(jdir)
+    return findings
+
+
+# -- entry points -------------------------------------------------------
+
+def detect_kind(path: str) -> Optional[str]:
+    """'topic' | 'checkpoint' | None (unrecognizable)."""
+    fs = get_filesystem(path)
+    if not fs.exists(path) or not fs.is_dir(path):
+        return None
+    if fs.exists(os.path.join(path, "meta.json")):
+        return "topic"
+    base = os.path.basename(os.path.normpath(path))
+    if base.startswith(("chk-", "savepoint-")):
+        return "checkpoint"
+    names = fs.listdir(path)
+    if any(n.startswith(("chk-", "savepoint-")) or ".inprogress." in n
+           for n in names):
+        return "checkpoint"
+    for name in names:
+        sub = os.path.join(path, name)
+        try:
+            if fs.is_dir(sub) and any(
+                    n.startswith(("chk-", "savepoint-"))
+                    or ".inprogress." in n for n in fs.listdir(sub)):
+                return "checkpoint"
+        except OSError:
+            continue
+    return None
+
+
+def fsck_path(path: str, repair: bool = False) -> List[Dict[str, Any]]:
+    """Run the appropriate scan; with ``repair``, apply the safe sweeps
+    (delete repairable orphans) and mark them ``repaired``. Raises
+    ValueError for an unrecognizable path (the CLI's exit-2 leg)."""
+    kind = detect_kind(path)
+    if kind is None:
+        raise ValueError(
+            f"{path!r} is neither a log topic (no meta.json) nor a "
+            "checkpoint directory (no chk-*/savepoint-* children)")
+    findings = (fsck_topic(path) if kind == "topic"
+                else fsck_checkpoints(path))
+    if repair:
+        fs = get_filesystem(path)
+        # topic repairs run under the maintenance lock: an unreferenced
+        # cmp file may be a LIVE pass's pre-swap output
+        maint_fd = None
+        live_leased: set = set()
+        if kind == "topic":
+            from flink_tpu.log.topic import (
+                list_leases, release_maintenance_lock,
+                try_maintenance_lock)
+
+            maint_fd = try_maintenance_lock(path)
+            now = int(time.time() * 1000)
+            live_leased = {
+                p for p, rec in list_leases(path).items()
+                if not rec.get("released")
+                and int(rec.get("deadline_ms", 0)) >= now}
+        try:
+            for f in findings:
+                if not f["repairable"]:
+                    continue
+                base = os.path.basename(f["path"])
+                if kind == "topic":
+                    # LIVE-PRODUCER guards: fsck has no writer identity
+                    # (sweep_orphans restricts itself to OWNED
+                    # partitions for the same window), so an offline
+                    # sweep must not race a live stage — between a
+                    # segment's rename and its pre marker the file
+                    # looks orphaned, and a .tmp may be mid-write.
+                    # Skip (a) any partition under a LIVE lease,
+                    # (b) files younger than the stage-window grace,
+                    # (c) cmp files when the maintenance lock is busy.
+                    if maint_fd is None and base.startswith("cmp-"):
+                        continue
+                    pdir = os.path.basename(os.path.dirname(f["path"]))
+                    if (pdir.startswith("p")
+                            and pdir[1:].isdigit()
+                            and int(pdir[1:]) in live_leased):
+                        continue
+                    if not _older_than(f["path"], REPAIR_MIN_AGE_S):
+                        continue
+                try:
+                    fs.delete(f["path"], recursive=fs.is_dir(f["path"]))
+                    f["repaired"] = True
+                except OSError:
+                    pass  # report stays repairable-but-unrepaired
+        finally:
+            if kind == "topic" and maint_fd is not None:
+                from flink_tpu.log.topic import release_maintenance_lock
+
+                release_maintenance_lock(path, maint_fd)
+    return findings
+
+
+def render(findings: List[Dict[str, Any]]) -> str:
+    if not findings:
+        return "fsck: clean (no findings)"
+    lines = []
+    for f in findings:
+        tag = " [repaired]" if f["repaired"] else (
+            " [repairable]" if f["repairable"] else "")
+        lines.append(f"{f['severity'].upper():5s} {f['rule']}{tag} "
+                     f"{f['path']}: {f['message']}")
+    return "\n".join(lines)
+
+
+def main(args) -> int:
+    """CLI half (wired from flink_tpu/cli.py): 0 clean / 1 findings /
+    2 usage-or-path error."""
+    import sys
+
+    try:
+        findings = fsck_path(args.path, repair=args.repair)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        for f in findings:
+            print(json.dumps(f))
+    else:
+        print(render(findings))
+    # after a repair pass, fully-repaired findings no longer count
+    open_findings = [f for f in findings if not f["repaired"]]
+    return 1 if open_findings else 0

@@ -66,7 +66,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from flink_tpu.formats_columnar import ColumnarWriter, iter_blocks
-from flink_tpu.fs import get_filesystem
+from flink_tpu.fs import get_filesystem, open_write_sync
 from flink_tpu.log.topic import (
     GROUP_DIR,
     LEASE_DIR,
@@ -533,7 +533,9 @@ class Compactor:
             name = compacted_seg_name(gen, int(offs[lo]))
             pdir = _partition_dir(self.path, p)
             tmp = os.path.join(pdir, name + ".tmp")
-            with self._fs.open_write(tmp) as f:
+            # sync-on-close: the compacted segment is durable before
+            # the rename publishes it to the (imminent) manifest swap
+            with open_write_sync(self._fs, tmp, sync=True) as f:
                 w = ColumnarWriter(f, sparse_schema)
                 faults.fire("log.compact.rewrite", exc=OSError,
                             topic=self.topic, partition=p, gen=gen)
@@ -541,15 +543,20 @@ class Compactor:
                                **{k: v[lo:hi] for k, v in cols.items()}})
                 w.close()
                 f.flush()
-                try:
-                    os.fsync(f.fileno())
-                except (AttributeError, OSError):
-                    pass
             self._fs.rename(tmp, os.path.join(pdir, name))
             seg_end = int(offs[hi - 1]) + 1 if hi < n else end
             segs.append({"name": name, "base": cover, "end": seg_end,
                          "rows": hi - lo})
             cover = seg_end
+        if n:
+            # ENTRY durability before the manifest swap references
+            # these files: without the dir fsync a power cut could
+            # lose the cmp renames AFTER the (durable) manifest swap
+            # and post-swap deletes land — the new generation would
+            # point at vanished files with the raw history already
+            # gone, PERMANENT loss (found by the crash explorer,
+            # tests/test_crash_consistency.py CompactionTier)
+            self._fs.fsync(_partition_dir(self.path, p))
         return segs
 
     def compact(self) -> Dict[str, Any]:

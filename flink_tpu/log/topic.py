@@ -96,7 +96,8 @@ from flink_tpu.formats_columnar import (
     iter_blocks,
     map_file_image,
 )
-from flink_tpu.fs import get_filesystem
+from flink_tpu.fs import (FileSystem, get_filesystem, open_write_sync,
+                          write_atomic)
 from flink_tpu.obs.metrics import MetricRegistry
 
 __all__ = ["LogError", "TopicAppender", "TopicReader", "create_topic",
@@ -269,16 +270,11 @@ def _read_json(fs, path: str, what: str) -> Dict[str, Any]:
 
 
 def _write_atomic(fs, path: str, payload: bytes, fsync: bool = True) -> None:
-    tmp = path + ".tmp"
-    with fs.open_write(tmp) as f:
-        f.write(payload)
-        if fsync:
-            f.flush()
-            try:
-                os.fsync(f.fileno())
-            except (AttributeError, OSError):
-                pass  # non-local filesystems own their durability
-    fs.rename(tmp, path)
+    """Atomic durable publish — delegates to THE shared helper on the
+    FileSystem seam (fs.write_atomic: tmp + fsync + rename, ENOSPC
+    policy applied), kept under its historical name because every
+    control-file writer in the log tier calls it."""
+    write_atomic(fs, path, payload, durable=fsync)
 
 
 def create_topic(path: str, partitions: int,
@@ -443,13 +439,15 @@ class TopicAppender:
             raise LogError(
                 f"log fsync-mode must be 'group' or 'segment', "
                 f"got {fsync_mode!r}")
-        if fsync_mode == "group" and _local_path(path) is None:
-            # non-local schemes have no plain-OS path to re-open for
-            # the group pass; 'segment' mode fsyncs through the write
-            # handle's fileno (when the plugin exposes one), so it is
-            # the durability-preserving degrade — silently SKIPPING
-            # the syncs would weaken the 2PC chain on exactly the
-            # storage least likely to forgive it
+        if (fsync_mode == "group"
+                and type(get_filesystem(path)).fsync is FileSystem.fsync):
+            # a backend that never overrode the fsync barrier (base
+            # no-op) cannot run the group pass; 'segment' mode syncs
+            # through the write handle at close, so it is the
+            # durability-preserving degrade — silently SKIPPING the
+            # syncs would weaken the 2PC chain on exactly the storage
+            # least likely to forgive it. Local fs and CrashFS both
+            # implement the barrier, so group stays the default there.
             fsync_mode = "segment"
         if writer_id is not None and not _WRITER_RE.match(writer_id):
             raise LogError(
@@ -564,13 +562,29 @@ class TopicAppender:
 
     def _write_segment(self, p: int, base: int, cid: int,
                        batches: List[Dict[str, np.ndarray]]) -> Dict[str, Any]:
+        from flink_tpu.fs import enospc_retry
+
+        # whole-segment ENOSPC retry (storage.enospc-policy=retry):
+        # each attempt rewrites the tmp from scratch and the rename is
+        # the only publish point, so a failed attempt leaves only
+        # marker-less debris the recovery sweep removes
+        return enospc_retry(
+            lambda: self._write_segment_once(p, base, cid, batches))
+
+    def _write_segment_once(self, p: int, base: int, cid: int,
+                            batches: List[Dict[str, np.ndarray]]
+                            ) -> Dict[str, Any]:
         from flink_tpu import faults
 
         name = _seg_name(base, cid, self.epoch)
         pdir = _partition_dir(self.path, p)
         tmp = os.path.join(pdir, name + ".tmp")
         rows = 0
-        with self._fs.open_write(tmp) as f:
+        # sync-on-close IS the per-segment fsync of 'segment' mode;
+        # 'group' mode writes plain and the group pass syncs before
+        # the pre-commit marker (fs.open_write seam, CrashFS-recorded)
+        with open_write_sync(
+                self._fs, tmp, sync=self.fsync_mode == "segment") as f:
             w = ColumnarWriter(f, self._schema)
             for b in batches:
                 # torn-append seam: a raise here leaves a footerless
@@ -587,10 +601,6 @@ class TopicAppender:
             if self.fsync_mode == "segment":
                 faults.fire("log.segment.fsync", exc=OSError,
                             topic=self.topic, partition=p, cid=cid)
-                try:
-                    os.fsync(f.fileno())
-                except (AttributeError, OSError):
-                    pass
         self._fs.rename(tmp, os.path.join(pdir, name))
         _count(self.topic, "segments_sealed")
         _count(self.topic, "records_appended", rows)
@@ -614,21 +624,14 @@ class TopicAppender:
         for p, cid, name in staged:
             faults.fire("log.segment.fsync", exc=OSError,
                         topic=self.topic, partition=p, cid=cid)
-            local = _local_path(
-                os.path.join(_partition_dir(self.path, p), name))
-            if local is not None:
-                paths.append(local)
+            paths.append(os.path.join(_partition_dir(self.path, p), name))
 
         def _sync(path: str):
             def run() -> None:
-                fd = os.open(path, os.O_RDONLY)
-                try:
-                    os.fsync(fd)
-                except OSError:
-                    pass  # non-fsyncable mount: same tolerance as the
-                    # per-segment mode's except clause
-                finally:
-                    os.close(fd)
+                # the seam's durability barrier (fs.fsync): local fs
+                # opens+fsyncs, CrashFS additionally journals it —
+                # non-fsyncable mounts are tolerated inside
+                self._fs.fsync(path)
             return run
 
         pool = self.host_pool
@@ -779,6 +782,13 @@ class TopicAppender:
         # identical to per-segment mode, just batched
         if self.fsync_mode == "group":
             self._group_fsync(staged_files)
+        # ENTRY durability: content fsyncs (above / sync-on-close) make
+        # the segment BYTES durable, but the tmp->final renames are
+        # directory mutations — fsync each touched partition dir so a
+        # power cut after the marker publishes can never leave the
+        # marker pointing at vanished segment entries
+        for p in sorted({pp for pp, _, _ in staged_files}):
+            self._fs.fsync(_partition_dir(self.path, p))
         # fencing gate, then the pre-commit marker: after this rename
         # the transaction is recoverable (re-commit or roll back),
         # before it the segments are unreferenced debris the cleanup
@@ -910,15 +920,21 @@ class TopicAppender:
 
     def rebuild(self, cid: int, payload: Dict[str, Any]) -> None:
         """Re-create staged transaction ``cid`` from its checkpoint
-        payload where absent (idempotent; a commit follows)."""
+        payload (idempotent; a commit follows). Segment files are
+        rewritten UNCONDITIONALLY: under ``fsync_mode='group'`` a
+        power cut can leave a TORN segment at its final name (the
+        rename applied, the content fsync never ran — possible only
+        before the pre-commit marker, so the 2PC chain is intact, but
+        an exists-check here would adopt the torn file and the
+        committed range would read back corrupt). The payload is the
+        authoritative bytes; rewriting identical content is free."""
         cpath = self._marker_path("commit", cid)
         if self._fs.exists(cpath):
             return  # already committed — nothing to rebuild
         for key, data in payload.get("segments", {}).items():
             p_s, _, name = key.partition("/")
             dst = os.path.join(_partition_dir(self.path, int(p_s)), name)
-            if not self._fs.exists(dst):
-                _write_atomic(self._fs, dst, data)
+            _write_atomic(self._fs, dst, data)
         ppath = self._marker_path("pre", cid)
         if not self._fs.exists(ppath):
             _write_atomic(self._fs, ppath,

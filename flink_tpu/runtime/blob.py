@@ -29,12 +29,17 @@ def digest_of(data: bytes) -> str:
 
 
 class BlobStore:
-    """Server-side store: one file per digest, atomic writes
-    (ref: BlobServer's storage layout)."""
+    """Server-side store: one file per digest, atomic durable writes
+    through the FileSystem seam (ref: BlobServer's storage layout —
+    a job's code artifact must survive a power cut once the submission
+    referencing its digest was acked)."""
 
     def __init__(self, directory: Optional[str] = None) -> None:
+        from flink_tpu.fs import get_filesystem
+
         self.dir = directory or tempfile.mkdtemp(prefix="flink_tpu_blobs_")
-        os.makedirs(self.dir, exist_ok=True)
+        self._fs = get_filesystem(self.dir)
+        self._fs.mkdirs(self.dir)
 
     def _path(self, digest: str) -> str:
         if not digest.isalnum():
@@ -42,27 +47,27 @@ class BlobStore:
         return os.path.join(self.dir, digest)
 
     def put(self, data: bytes) -> str:
+        from flink_tpu.fs import write_atomic
+
         digest = digest_of(data)
         path = self._path(digest)
-        if not os.path.exists(path):
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(data)
-            os.replace(tmp, path)
+        if not self._fs.exists(path):
+            write_atomic(self._fs, path, data)
         return digest
 
     def get(self, digest: str) -> Optional[bytes]:
         try:
-            with open(self._path(digest), "rb") as f:
-                return f.read()
+            with self._fs.open_read(self._path(digest)) as f:
+                data = f.read()
+            return data if isinstance(data, bytes) else data.encode()
         except OSError:
             return None
 
     def has(self, digest: str) -> bool:
-        return os.path.exists(self._path(digest))
+        return self._fs.exists(self._path(digest))
 
     def list(self) -> List[str]:
-        return sorted(d for d in os.listdir(self.dir)
+        return sorted(d for d in self._fs.listdir(self.dir)
                       if not d.endswith(".tmp"))
 
 
@@ -72,9 +77,12 @@ class BlobCache:
     digest of fetched bytes — a corrupt transfer must not get cached."""
 
     def __init__(self, coord_client, cache_dir: Optional[str] = None) -> None:
+        from flink_tpu.fs import get_filesystem
+
         self._coord = coord_client
         self.dir = cache_dir or tempfile.mkdtemp(prefix="flink_tpu_blobcache_")
-        os.makedirs(self.dir, exist_ok=True)
+        self._fs = get_filesystem(self.dir)
+        self._fs.mkdirs(self.dir)
 
     def rebind(self, coord_client) -> None:
         """Point the cache at a new coordinator (leader failover) —
@@ -94,24 +102,24 @@ class BlobCache:
         data = base64.b64decode(resp["data_b64"])
         if digest_of(data) != digest:
             raise IOError(f"blob {digest} digest mismatch after transfer")
+        # pid-unique tmp (two runners on one cache dir must not
+        # interleave), atomic durable publish through the seam
         tmp = path + f".{os.getpid()}.tmp"
-        with open(tmp, "wb") as f:
+        from flink_tpu.fs import open_write_sync
+
+        with open_write_sync(self._fs, tmp, sync=True) as f:
             f.write(data)
-        os.replace(tmp, path)
+        self._fs.rename(tmp, path)
         return path
 
     def materialize(self, digest: str, directory: str, name: str) -> str:
         """Place the blob under ``directory/name`` (hardlink when
         possible) — the per-job import dir (ref: per-job classloader
         isolation: each job attempt stages its own view of the code)."""
-        os.makedirs(directory, exist_ok=True)
+        self._fs.mkdirs(directory)
         src = self.fetch(digest)
         dst = os.path.join(directory, name)
-        if os.path.exists(dst):
-            os.remove(dst)
-        try:
-            os.link(src, dst)
-        except OSError:
-            with open(src, "rb") as f, open(dst, "wb") as g:
-                g.write(f.read())
+        if self._fs.exists(dst):
+            self._fs.delete(dst)
+        self._fs.link_or_copy(src, dst)
         return dst

@@ -609,7 +609,15 @@ class JobCoordinator(RpcEndpoint):
                 jj = self.jobs.get(job_id)
                 if jj is not None and jj.egraph is not None:
                     jj.egraph.transition("RUNNING", attempt=attempt)
-        except RpcError as e:
+        except (RpcError, ConnectionError) as e:
+            # ConnectionError too (the PR-11 flake class): faults
+            # `drop`-kind rules raise ConnectionError, NOT RpcError —
+            # the coordinator.deploy point fires BEFORE the client's
+            # RpcError wrapping, so an RpcError-only catch here let an
+            # injected transport drop kill the deploy thread silently
+            # and park the job forever (regression:
+            # tests/test_control_plane.py
+            # test_deploy_transport_drop_routes_failure)
             decision: Dict[str, Any] = {}
             with self._lock:
                 jj = self.jobs.get(job_id)

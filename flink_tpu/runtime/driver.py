@@ -1279,7 +1279,13 @@ class Driver:
         from concurrent.futures import ThreadPoolExecutor
 
         from flink_tpu import faults
+        from flink_tpu.fs import install_enospc_policy_from_config
 
+        # the disk-full degradation policy (storage.enospc-policy):
+        # installed process-wide at run start so every durable write
+        # seam — checkpoint persists, log segment stages, sink part
+        # writes — follows the job's declared retry/fail behavior
+        install_enospc_policy_from_config(self.config)
         # fault-scope propagation (session tenant isolation): the run
         # executes on a thread the runner already scoped to this job;
         # the threads the DRIVER owns — drain, checkpoint executor —

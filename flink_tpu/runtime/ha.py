@@ -54,6 +54,19 @@ class LeaderElection:
     def __init__(self, ha_dir: str, address: str,
                  lease_timeout_s: float = 10.0,
                  leader_id: Optional[str] = None) -> None:
+        # normalize a file:// spelling up front: the election mixes the
+        # FileSystem seam (hwm/counter writes) with raw O_EXCL lock
+        # primitives (os.open has no scheme stripping) — one plain OS
+        # path keeps both sides in ONE directory tree. Non-file schemes
+        # are rejected loudly: O_EXCL leases are local-fs-only (the
+        # analyzer's STORAGE_LOCAL_LOCKS_ON_REMOTE rule says so too).
+        if ha_dir.startswith("file://"):
+            ha_dir = ha_dir[len("file://"):]
+        if "://" in ha_dir:
+            raise ValueError(
+                f"high-availability.dir {ha_dir!r}: leader-election "
+                "leases use O_CREAT|O_EXCL, a local-filesystem "
+                "primitive — point the HA dir at a shared LOCAL path")
         self.ha_dir = ha_dir
         self.address = address
         self.leader_id = leader_id or f"coord-{uuid.uuid4().hex[:8]}"
@@ -152,9 +165,17 @@ class LeaderElection:
         path = os.path.join(self.ha_dir, "takeovers.count")
         tmp = path + f".{self.leader_id}.tmp"
         try:
-            with open(tmp, "w") as f:
-                f.write(str(takeover_count(self.ha_dir) + 1))
-            os.replace(tmp, path)
+            # writer-unique tmp then atomic rename (two racing stealers
+            # must never interleave into one tmp); through the seam so
+            # the counter is fsynced — entry fsync included — like
+            # every other durable write
+            from flink_tpu.fs import get_filesystem, open_write_sync
+
+            fs = get_filesystem(self.ha_dir)
+            with open_write_sync(fs, tmp, sync=True) as f:
+                f.write(str(takeover_count(self.ha_dir) + 1).encode())
+            fs.rename(tmp, path)
+            fs.fsync(self.ha_dir)
         except OSError:
             pass  # observability counter: never fail a takeover over it
 
@@ -183,10 +204,21 @@ class LeaderElection:
     def _record_hwm(self, epoch: int) -> None:
         if epoch <= self._epoch_hwm():
             return
+        from flink_tpu.fs import get_filesystem, open_write_sync
+
+        fs = get_filesystem(self.ha_dir)
         tmp = self._hwm_path + f".{self.leader_id}.tmp"
-        with open(tmp, "w") as f:
-            f.write(str(epoch))
-        os.replace(tmp, self._hwm_path)
+        # the fencing-token floor MUST survive a power cut — a lost hwm
+        # could let a fresh claim REGRESS epochs below a dead leader's.
+        # Leader-id-unique tmp (racing contenders must not interleave
+        # into one tmp — write_atomic's shared-name tmp would), then
+        # the full durable-publish discipline INCLUDING the parent-dir
+        # fsync: content fsync alone never persists the rename's
+        # directory entry (the write_atomic rule, applied by hand)
+        with open_write_sync(fs, tmp, sync=True) as f:
+            f.write(str(epoch).encode())
+        fs.rename(tmp, self._hwm_path)
+        fs.fsync(self.ha_dir)
 
     # -- contender loop -------------------------------------------------
     def start(self) -> None:
@@ -318,10 +350,13 @@ class JobStore:
     TERMINAL = ("FINISHED", "FAILED", "CANCELED")
 
     def __init__(self, ha_dir: str) -> None:
+        from flink_tpu.fs import get_filesystem
+
         self.dir = os.path.join(ha_dir, "jobs")
         self.archive_dir = os.path.join(ha_dir, "jobs-archive")
-        os.makedirs(self.dir, exist_ok=True)
-        os.makedirs(self.archive_dir, exist_ok=True)
+        self._fs = get_filesystem(ha_dir)
+        self._fs.mkdirs(self.dir)
+        self._fs.mkdirs(self.archive_dir)
 
     def _path(self, job_id: str) -> str:
         return os.path.join(self.dir, f"{job_id}.json")
@@ -356,37 +391,47 @@ class JobStore:
                "py_blobs": list(py_blobs or []),
                "submitted_at": submitted_at,
                "assigned_runners": list(assigned_runners or [])}
-        tmp = dst + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(rec, f)
-        os.replace(tmp, dst)
+        # through the seam (tmp + FSYNC + rename): a power cut right
+        # after admission acked must not leave a torn registry record
+        # a recovering leader silently skips — write_atomic makes the
+        # record durable-whole or absent, never garbage
+        from flink_tpu.fs import write_atomic
+
+        write_atomic(self._fs, dst, json.dumps(rec).encode("utf-8"))
         if terminal:
             self.remove(job_id)
 
     def get(self, job_id: str) -> Optional[Dict]:
         for path in (self._path(job_id), self._archive_path(job_id)):
             try:
-                with open(path) as f:
-                    return json.load(f)
+                with self._fs.open_read(path) as f:
+                    raw = f.read()
+                return json.loads(
+                    raw.decode("utf-8") if isinstance(raw, bytes)
+                    else raw)
             except (OSError, ValueError):
                 continue
         return None
 
     def remove(self, job_id: str) -> None:
         try:
-            os.remove(self._path(job_id))
+            self._fs.delete(self._path(job_id))
         except OSError:
             pass
 
     def recoverable(self) -> List[Dict]:
         """Non-terminal deployable jobs a new leader must resume."""
         out = []
-        for name in sorted(os.listdir(self.dir)):
+        for name in sorted(self._fs.listdir(self.dir)):
             if not name.endswith(".json"):
                 continue
             try:
-                with open(os.path.join(self.dir, name)) as f:
-                    rec = json.load(f)
+                with self._fs.open_read(
+                        os.path.join(self.dir, name)) as f:
+                    raw = f.read()
+                rec = json.loads(
+                    raw.decode("utf-8") if isinstance(raw, bytes)
+                    else raw)
             except (OSError, ValueError):
                 continue
             if (rec.get("entry")

@@ -183,6 +183,17 @@ def _session_checkpointing_without_ha(tmp_path):
         "execution.checkpointing.interval": 500}))
 
 
+@seed("STORAGE_LOCAL_LOCKS_ON_REMOTE")
+def _local_locks_on_remote_scheme(tmp_path):
+    # lease dirs / HA dir / log topics on a non-file scheme: the
+    # O_EXCL + rename-first lock discipline is local-fs-only (PR 9/11
+    # honest residue) — acquisition degrades to read-check-write.
+    # Clean negatives in TestStorageLocalLocksOnRemote below.
+    return analyze_config(Configuration({
+        "high-availability.dir": "s3://bucket/ha",
+        "log.dir": "hdfs://nn/flink-log"}))
+
+
 @seed("HOST_PARALLELISM_INVALID")
 def _host_parallelism_invalid(tmp_path):
     # below 1: the driver rejects it at build; the analyzer must flag
@@ -579,3 +590,32 @@ class TestDogfoodGate:
         assert committed == render_rules_md(), (
             "RULES.md is stale — regenerate with "
             "`python tools/gen_rules.py`")
+
+
+class TestStorageLocalLocksOnRemote:
+    """PR-14 satellite: STORAGE_LOCAL_LOCKS_ON_REMOTE clean negatives
+    (the seeded violation lives in SEEDS)."""
+
+    def _rules(self, conf):
+        return [f.rule for f in analyze_config(Configuration(conf))]
+
+    def test_local_paths_are_quiet(self, tmp_path):
+        assert "STORAGE_LOCAL_LOCKS_ON_REMOTE" not in self._rules({
+            "high-availability.dir": str(tmp_path / "ha"),
+            "log.dir": str(tmp_path / "log")})
+
+    def test_explicit_file_scheme_is_quiet(self, tmp_path):
+        assert "STORAGE_LOCAL_LOCKS_ON_REMOTE" not in self._rules({
+            "high-availability.dir": f"file://{tmp_path}/ha",
+            "log.dir": f"file://{tmp_path}/log"})
+
+    def test_unset_dirs_are_quiet(self):
+        assert "STORAGE_LOCAL_LOCKS_ON_REMOTE" not in self._rules({})
+
+    def test_each_key_flags_independently(self, tmp_path):
+        findings = [f for f in analyze_config(Configuration({
+            "high-availability.dir": "s3://bucket/ha",
+            "log.dir": str(tmp_path / "log")}))
+            if f.rule == "STORAGE_LOCAL_LOCKS_ON_REMOTE"]
+        assert len(findings) == 1
+        assert "high-availability.dir" in findings[0].message

@@ -180,3 +180,70 @@ class TestPublicSurface:
                     "flink_tpu.api.functions", "flink_tpu.cli",
                     "flink_tpu.state_processor", "flink_tpu.fs"):
             importlib.import_module(mod)
+
+
+class TestDurableWriteSeam:
+    """PR 14's crash-consistency contract: every DURABLE tier routes
+    its writes through the FileSystem seam (flink_tpu/fs.py) — write
+    handles with the sync discipline, fs.fsync barriers, fs.rename,
+    write_atomic. A raw ``open(..., 'w')`` / ``os.fsync`` /
+    ``os.replace`` in a durable module bypasses CrashFS recording and
+    the ENOSPC policy, silently re-opening the power-cut hole the
+    crash explorer (tests/test_crash_consistency.py) verifies closed.
+
+    Allowed residue: ``os.open(O_CREAT|O_EXCL)`` + ``os.fdopen`` —
+    the local-fs LOCK primitives (lease claims, maintenance locks),
+    which the analyzer's STORAGE_LOCAL_LOCKS_ON_REMOTE rule documents
+    as local-filesystem-only."""
+
+    # the tiers whose on-disk state must survive a power cut
+    DURABLE_MODULES = (
+        "log/topic.py", "log/bus.py", "log/connectors.py",
+        "checkpoint/storage.py", "checkpoint/coordinator.py",
+        "api/sinks.py", "connectors.py",
+        "runtime/ha.py", "runtime/blob.py", "runtime/session.py",
+        "fsck.py",
+    )
+
+    @staticmethod
+    def _violations(path: str) -> List[str]:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        bad: List[str] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            # builtin open(...) in a write/append mode
+            if isinstance(fn, ast.Name) and fn.id == "open":
+                mode = ""
+                if len(node.args) >= 2 and isinstance(
+                        node.args[1], ast.Constant):
+                    mode = str(node.args[1].value)
+                for kw in node.keywords:
+                    if kw.arg == "mode" and isinstance(kw.value,
+                                                      ast.Constant):
+                        mode = str(kw.value.value)
+                if "w" in mode or "a" in mode or "+" in mode:
+                    bad.append(f"line {node.lineno}: open(..., {mode!r})")
+            # os.fsync / os.replace / os.rename bypassing the seam
+            if (isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "os"
+                    and fn.attr in ("fsync", "replace")):
+                bad.append(f"line {node.lineno}: os.{fn.attr}(...)")
+        return bad
+
+    def test_no_raw_durable_writes_outside_the_seam(self):
+        findings = {}
+        for rel in self.DURABLE_MODULES:
+            path = os.path.join(PKG, rel)
+            if not os.path.exists(path):
+                continue
+            bad = self._violations(path)
+            if bad:
+                findings[rel] = bad
+        assert not findings, (
+            "raw durable-write call sites outside the FileSystem seam "
+            f"(route through fs.open_write(sync=)/fs.fsync/"
+            f"fs.write_atomic): {findings}")

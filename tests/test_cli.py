@@ -69,6 +69,43 @@ class TestExitCodeContract:
             assert set(f) == self.FINDING_KEYS, f
             assert f["severity"] in ("error", "warn")
 
+    def test_fsck_clean_is_0_findings_1_bad_path_2(self, tmp_path,
+                                                   capsys):
+        """The storage fsck joins the CI exit contract (PR 14): 0 =
+        clean, 1 = findings remain, 2 = usage/path error — with one
+        JSON finding object per line under --json."""
+        import numpy as np
+
+        from flink_tpu.log.topic import TopicAppender
+
+        topic = str(tmp_path / "topic")
+        ap = TopicAppender(topic, partitions=1, segment_records=4)
+        b = {"k": np.arange(4, dtype=np.int64),
+             "v": np.arange(4, dtype=np.float64)}
+        ap.stage(1, {0: [b]})
+        ap.commit(1)
+        assert cli_main(["fsck", topic]) == 0
+        # seed a finding: tmp debris (back-dated past --repair's
+        # live-stage grace window)
+        debris = os.path.join(topic, "p0", "seg-x.colb.tmp")
+        with open(debris, "wb") as f:
+            f.write(b"torn")
+        old = time.time() - 3600
+        os.utime(debris, (old, old))
+        assert cli_main(["fsck", topic]) == 1
+        capsys.readouterr()
+        cli_main(["fsck", topic, "--json"])
+        lines = capsys.readouterr().out.strip().splitlines()
+        for line in lines:
+            f = json.loads(line)
+            assert {"rule", "severity", "path", "message",
+                    "repairable", "repaired"} <= set(f)
+        # repair sweeps it; the topic is clean again
+        assert cli_main(["fsck", topic, "--repair"]) == 0
+        assert cli_main(["fsck", topic]) == 0
+        assert cli_main(["fsck", str(tmp_path / "absent")]) == 2
+        capsys.readouterr()
+
 
 class TestSessionHaCli:
     """ISSUE 11 satellite: the session CLI resolves the leader through

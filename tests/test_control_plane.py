@@ -106,6 +106,57 @@ class TestCoordinator:
         finally:
             srv.close()
 
+    def test_deploy_transport_drop_routes_failure(self):
+        """PR-14 chaos-seam audit regression: faults `drop`-kind rules
+        raise ConnectionError, NOT RpcError — the coordinator.deploy
+        point fires before the client's RpcError wrapping, so the
+        deploy catch must handle both or an injected transport drop
+        kills the deploy thread silently and the job parks forever
+        (the PR-11 flake class)."""
+        from flink_tpu import faults
+
+        class _Gw(RpcEndpoint):
+            def __init__(self):
+                self.jobs = []
+
+            def rpc_run_job(self, **kw):
+                self.jobs.append(kw)
+                return {"accepted": True}
+
+        # two gateways: the failure handler EXCLUDES the runner whose
+        # push died, so the routed restart lands on the second
+        gws = [_Gw(), _Gw()]
+        gw_srvs = [RpcServer(g) for g in gws]
+        srv = start_coordinator(Configuration({
+            "heartbeat.timeout": 60_000,  # fake runners never beat
+            "restart-strategy.type": "fixed-delay",
+            "restart-strategy.fixed-delay.attempts": 3,
+            "restart-strategy.fixed-delay.delay": 10}))
+        plan = faults.FaultPlan(seed=1).rule(
+            "coordinator.deploy", "drop", count=1)
+        try:
+            c = RpcClient("127.0.0.1", srv.port)
+            for i, gs in enumerate(gw_srvs):
+                c.call("register_runner", runner_id=f"r{i}",
+                       host="127.0.0.1", n_devices=1, port=gs.port)
+            with plan.activate():
+                c.call("submit_job", job_id="j-drop",
+                       entry="runner_job:build")
+                deadline = time.time() + 5
+                while (time.time() < deadline
+                       and not any(g.jobs for g in gws)):
+                    time.sleep(0.05)
+            assert plan.log, "the drop never fired"
+            landed = [kw for g in gws for kw in g.jobs]
+            assert landed, (
+                "deploy thread died on the injected ConnectionError — "
+                "the failure was never routed to a restart")
+            assert landed[0]["job_id"] == "j-drop"
+        finally:
+            srv.close()
+            for gs in gw_srvs:
+                gs.close()
+
     def test_report_failure_restart_then_fail(self):
         srv = start_coordinator(Configuration({
             "restart-strategy.type": "fixed-delay",

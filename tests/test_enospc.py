@@ -1,0 +1,175 @@
+"""ENOSPC graceful degradation (storage.enospc-policy, PR 14).
+
+Acceptance: ENOSPC injected mid-checkpoint and mid-segment-write under
+``retry`` completes with committed output equal to the fault-free
+golden (retries visible on the storage.enospc_retries metric); under
+``fail`` it fails loudly with no torn committed artifact — the
+storage fsck-s clean afterwards."""
+import os
+
+import numpy as np
+import pytest
+
+from flink_tpu import faults
+from flink_tpu import fs as fsmod
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.api.sinks import TransactionalCollectSink
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.api.windowing import TumblingEventTimeWindows
+from flink_tpu.config import Configuration
+from flink_tpu.log.topic import TopicAppender, TopicReader
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _default_policy():
+    """Every test leaves the process on the declared default."""
+    yield
+    fsmod.install_enospc_policy("retry")
+
+
+def _source(n_batches, batch=64, n_keys=8):
+    def gen(split, i):
+        if i >= n_batches:
+            return None
+        rng = np.random.default_rng(7000 + i)
+        keys = rng.integers(0, n_keys, batch).astype(np.int64)
+        ts = np.sort(rng.integers(i * 500, i * 500 + 1000,
+                                  batch)).astype(np.int64)
+        return {"k": keys}, ts
+
+    return gen
+
+
+def _conf(tmp_path, sub, extra=None):
+    c = {
+        "state.num-key-shards": 8, "state.slots-per-shard": 64,
+        "pipeline.microbatch-size": 128,
+        "execution.checkpointing.dir": str(tmp_path / sub),
+        "execution.checkpointing.interval": 1,
+    }
+    c.update(extra or {})
+    return Configuration(c)
+
+
+def _run(tmp_path, sub, extra=None, plan=None):
+    sink = TransactionalCollectSink()
+    env = StreamExecutionEnvironment(_conf(tmp_path, sub, extra))
+    (env.from_source(GeneratorSource(_source(6)),
+                     WatermarkStrategy.for_bounded_out_of_orderness(1000))
+     .key_by("k").window(TumblingEventTimeWindows.of(1000)).count()
+     .add_sink(sink))
+    if plan is None:
+        env.execute("enospc-job")
+    else:
+        with plan.activate():
+            env.execute("enospc-job")
+    return sorted((int(r["key"]), int(r["window_start"]), int(r["count"]))
+                  for r in sink.committed)
+
+
+def _retries() -> int:
+    return int(fsmod.registry.snapshot().get(
+        "storage.enospc_retries", 0))
+
+
+class TestRetryPolicy:
+    def test_mid_checkpoint_enospc_retries_to_golden(self, tmp_path):
+        golden = _run(tmp_path, "golden")
+        before = _retries()
+        # two injections at the fs write seam, landing in checkpoint
+        # blob/manifest writes (the only fs.open_write calls this
+        # pipeline makes); the per-write retry budget absorbs both
+        plan = faults.FaultPlan(seed=3).rule(
+            "fs.write.enospc", "raise", count=2, after=2)
+        got = _run(tmp_path, "retry", extra={
+            "storage.enospc-policy": "retry",
+            "storage.enospc-backoff-ms": 1,
+        }, plan=plan)
+        assert got == golden
+        assert len(plan.log) == 2, "schedule injected nothing"
+        assert _retries() >= before + 2, (
+            "retries must be visible on storage.enospc_retries")
+
+    def test_mid_segment_write_enospc_retries_to_golden(self, tmp_path):
+        def stage_all(topic_dir, plan=None):
+            fsmod.install_enospc_policy("retry", retries=4, backoff_ms=1)
+            ap = TopicAppender(topic_dir, partitions=2,
+                               segment_records=4)
+            b = {"k": np.arange(10, dtype=np.int64),
+                 "v": np.arange(10, dtype=np.float64)}
+            ctx = plan.activate() if plan else None
+            if ctx:
+                ctx.__enter__()
+            try:
+                ap.stage(1, {0: [b], 1: [b]})
+                ap.commit(1)
+            finally:
+                if ctx:
+                    ctx.__exit__(None, None, None)
+            r = TopicReader(topic_dir)
+            return {p: [(o, {k: v.tolist() for k, v in blk.items()})
+                        for o, blk in r.read(p)] for p in range(2)}
+
+        golden = stage_all(os.path.join(str(tmp_path), "g"))
+        before = _retries()
+        plan = faults.FaultPlan(seed=5).rule(
+            "fs.write.enospc", "raise", count=1, after=3)
+        got = stage_all(os.path.join(str(tmp_path), "c"), plan)
+        assert got == golden
+        assert plan.log, "schedule injected nothing"
+        assert _retries() >= before + 1
+
+    def test_invalid_policy_is_loud(self):
+        with pytest.raises(ValueError):
+            fsmod.install_enospc_policy("yolo")
+        with pytest.raises(ValueError):
+            fsmod.install_enospc_policy_from_config(Configuration(
+                {"storage.enospc-policy": "bogus"}))
+
+
+class TestFailPolicy:
+    def test_mid_checkpoint_enospc_fails_loud_and_fsck_clean(
+            self, tmp_path):
+        from flink_tpu.fsck import fsck_path
+
+        plan = faults.FaultPlan(seed=3).rule(
+            "fs.write.enospc", "raise", count=1, after=2)
+        with pytest.raises(Exception) as ei:
+            _run(tmp_path, "fail", extra={
+                "storage.enospc-policy": "fail"}, plan=plan)
+        assert "enospc" in str(ei.value).lower()
+        # no torn committed artifact: whatever checkpoints completed
+        # before the failure verify clean
+        ckpt = str(tmp_path / "fail")
+        if os.path.isdir(ckpt):
+            findings = [f for f in fsck_path(ckpt)
+                        if f["severity"] == "error"]
+            assert findings == [], f"torn committed artifact: {findings}"
+
+    def test_mid_segment_write_enospc_fails_loud_and_fsck_clean(
+            self, tmp_path):
+        from flink_tpu.fsck import fsck_path
+
+        fsmod.install_enospc_policy("fail")
+        topic = os.path.join(str(tmp_path), "t")
+        ap = TopicAppender(topic, partitions=1, segment_records=4)
+        b = {"k": np.arange(6, dtype=np.int64),
+             "v": np.arange(6, dtype=np.float64)}
+        ap.stage(1, {0: [b]})
+        ap.commit(1)
+        plan = faults.FaultPlan(seed=9).rule(
+            "fs.write.enospc", "raise", count=1)
+        with plan.activate():
+            with pytest.raises(OSError):
+                ap.stage(2, {0: [b]})
+        # recovery sweeps the debris; the committed prefix is intact
+        ap2 = TopicAppender(topic, partitions=1, segment_records=4)
+        ap2.recover()
+        findings = [f for f in fsck_path(topic)
+                    if f["severity"] == "error"]
+        assert findings == [], f"torn committed artifact: {findings}"
+        r = TopicReader(topic)
+        assert r.committed_offsets() == {0: 6}

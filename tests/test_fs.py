@@ -37,6 +37,49 @@ class TestLocalFs:
         with pytest.raises(ValueError, match="no filesystem registered"):
             get_filesystem("s3://bucket/x")
 
+    def test_recursive_delete_propagates_failures(self, tmp_path,
+                                                  monkeypatch):
+        """PR-14 satellite regression: delete(recursive=True) used
+        ``shutil.rmtree(ignore_errors=True)`` — a retention or abort
+        pass that silently failed to delete violated the loud-failure
+        convention. A filesystem error during the tree walk must now
+        propagate (callers that tolerate sweep failures catch OSError
+        themselves)."""
+        fs = get_filesystem(str(tmp_path))
+        d = tmp_path / "victim"
+        d.mkdir()
+        (d / "f").write_bytes(b"x")
+        real_rmdir = os.rmdir
+
+        def failing_rmdir(path, *a, **kw):
+            if os.path.basename(str(path)) == "victim":
+                raise OSError(5, "Input/output error", str(path))
+            return real_rmdir(path, *a, **kw)
+
+        monkeypatch.setattr(os, "rmdir", failing_rmdir)
+        with pytest.raises(OSError, match="Input/output error"):
+            fs.delete(str(d), recursive=True)
+        monkeypatch.undo()
+        fs.delete(str(d), recursive=True)  # now it works — and is gone
+        assert not d.exists()
+
+    def test_sync_write_and_fsync_barrier(self, tmp_path):
+        """The PR-14 durability seam: open_write(sync=True) fsyncs
+        before close returns; fsync(path) is the explicit barrier
+        (files AND directories); write_atomic publishes whole."""
+        from flink_tpu.fs import write_atomic
+
+        fs = get_filesystem(str(tmp_path))
+        p = str(tmp_path / "durable.bin")
+        with fs.open_write(p, sync=True) as f:
+            f.write(b"payload")
+        assert open(p, "rb").read() == b"payload"
+        fs.fsync(p)                 # file barrier
+        fs.fsync(str(tmp_path))     # directory barrier
+        write_atomic(fs, str(tmp_path / "pub.json"), b"{}")
+        assert (tmp_path / "pub.json").read_bytes() == b"{}"
+        assert not (tmp_path / "pub.json.tmp").exists()
+
 
 class TestPluginLoader:
     def test_register_and_resolve_custom_scheme(self, tmp_path):

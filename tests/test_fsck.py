@@ -1,0 +1,212 @@
+"""Storage fsck (flink_tpu/fsck.py + the ``fsck`` CLI): the five
+seeded corruption classes each detected with exit 1 and a named
+finding, clean topic + clean checkpoint dir exit 0, and ``--repair``
+applying only the already-safe sweeps (tier-1 CLI smoke, PR 14)."""
+import glob
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu.checkpoint.storage import FsCheckpointStorage
+from flink_tpu.cli import main as cli_main
+from flink_tpu.fsck import detect_kind, fsck_path
+from flink_tpu.log.bus import Compactor
+from flink_tpu.log.topic import TopicAppender, TopicReader, create_topic
+
+
+def make_topic(root, rows=8, partitions=2, commit=True):
+    topic = os.path.join(str(root), "topic")
+    ap = TopicAppender(topic, partitions=partitions, segment_records=4)
+    b = {"k": np.arange(rows, dtype=np.int64),
+         "v": np.arange(rows, dtype=np.float64)}
+    ap.stage(1, {p: [b] for p in range(partitions)})
+    if commit:
+        ap.commit(1)
+    return topic
+
+
+def make_checkpoints(root):
+    st = FsCheckpointStorage(os.path.join(str(root), "chk"), "job")
+    st.save(1, {"sources": {"0": 1}, "operators": {}})
+    st.save_v2(2, {"op_versions": {"7": 1}},
+               {"7": b"legacy-opaque-bytes"}, {})
+    return os.path.join(str(root), "chk", "job")
+
+
+def rules_of(findings):
+    return {f["rule"] for f in findings}
+
+
+def age(path, seconds=3600):
+    """Back-date a seeded debris file past --repair's stage-window
+    grace (a live producer's fresh files are deliberately skipped)."""
+    t = time.time() - seconds
+    os.utime(path, (t, t))
+
+
+def cli(capsys, *argv):
+    rc = cli_main(list(argv))
+    out = capsys.readouterr().out
+    return rc, out
+
+
+class TestCleanStorage:
+    def test_clean_topic_exits_0(self, tmp_path, capsys):
+        topic = make_topic(tmp_path)
+        rc, out = cli(capsys, "fsck", topic)
+        assert rc == 0 and "clean" in out
+
+    def test_clean_checkpoint_dir_exits_0(self, tmp_path, capsys):
+        jdir = make_checkpoints(tmp_path)
+        rc, _ = cli(capsys, "fsck", jdir)
+        assert rc == 0
+        # the storage root above the job dir autodetects too
+        rc, _ = cli(capsys, "fsck", os.path.dirname(jdir))
+        assert rc == 0
+
+    def test_unrecognizable_path_exits_2(self, tmp_path, capsys):
+        rc = cli_main(["fsck", str(tmp_path / "nope")])
+        assert rc == 2
+        (tmp_path / "plain").mkdir()
+        assert cli_main(["fsck", str(tmp_path / "plain")]) == 2
+        capsys.readouterr()
+
+
+class TestSeededCorruption:
+    """The five acceptance corruption classes, each by name."""
+
+    def test_crc_flip_detected(self, tmp_path, capsys):
+        topic = make_topic(tmp_path)
+        seg = sorted(glob.glob(os.path.join(topic, "p0", "seg-*.colb")))[0]
+        data = bytearray(open(seg, "rb").read())
+        data[-20] ^= 0xFF
+        open(seg, "wb").write(bytes(data))
+        findings = fsck_path(topic)
+        assert "SEGMENT_CRC" in rules_of(findings)
+        rc, out = cli(capsys, "fsck", topic, "--json")
+        assert rc == 1
+        assert any(json.loads(ln)["rule"] == "SEGMENT_CRC"
+                   for ln in out.strip().splitlines())
+
+    def test_truncated_segment_detected(self, tmp_path, capsys):
+        topic = make_topic(tmp_path)
+        seg = sorted(glob.glob(os.path.join(topic, "p1", "seg-*.colb")))[0]
+        data = open(seg, "rb").read()
+        open(seg, "wb").write(data[: len(data) // 2])
+        findings = fsck_path(topic)
+        assert "SEGMENT_TRUNCATED" in rules_of(findings)
+        assert cli_main(["fsck", topic]) == 1
+        capsys.readouterr()
+
+    def test_missing_checkpoint_manifest_detected_and_repaired(
+            self, tmp_path, capsys):
+        jdir = make_checkpoints(tmp_path)
+        os.remove(os.path.join(jdir, "chk-2", "MANIFEST.json"))
+        findings = fsck_path(jdir)
+        assert "CHECKPOINT_MANIFEST_MISSING" in rules_of(findings)
+        assert cli_main(["fsck", jdir]) == 1
+        capsys.readouterr()
+        # repair: the manifest-less dir is invisible to restore —
+        # deleting it is the safe sweep; afterwards the dir is clean
+        repaired = fsck_path(jdir, repair=True)
+        assert any(f["rule"] == "CHECKPOINT_MANIFEST_MISSING"
+                   and f["repaired"] for f in repaired)
+        assert not os.path.exists(os.path.join(jdir, "chk-2"))
+        assert fsck_path(jdir) == []
+        # chk-1 still restores
+        st = FsCheckpointStorage(os.path.dirname(jdir), "job")
+        assert st.latest().checkpoint_id == 1
+
+    def test_orphan_pre_marker_detected(self, tmp_path, capsys):
+        topic = make_topic(tmp_path, commit=False)  # staged, no commit
+        findings = fsck_path(topic)
+        assert "ORPHAN_PRE_MARKER" in rules_of(findings)
+        assert cli_main(["fsck", topic]) == 1
+        capsys.readouterr()
+
+    def test_stale_lease_detected(self, tmp_path, capsys):
+        topic = make_topic(tmp_path)
+        ldir = os.path.join(topic, "leases")
+        os.makedirs(ldir)
+        with open(os.path.join(ldir, "p0.json"), "w") as f:
+            json.dump({"owner": "dead-producer", "epoch": 3,
+                       "acquired_ms": 1000,
+                       "deadline_ms": int(time.time() * 1000) - 60_000},
+                      f)
+        findings = fsck_path(topic)
+        assert "STALE_LEASE" in rules_of(findings)
+        assert cli_main(["fsck", topic]) == 1
+        capsys.readouterr()
+
+
+class TestRepairSafety:
+    def test_repair_sweeps_orphans_only(self, tmp_path, capsys):
+        topic = make_topic(tmp_path)
+        # seed repairable debris: a .tmp leftover and an unreferenced
+        # segment (torn prepare)
+        tmp_file = os.path.join(topic, "p0", "seg-junk.colb.tmp")
+        open(tmp_file, "wb").write(b"torn")
+        age(tmp_file)
+        orphan = os.path.join(
+            topic, "p0", "seg-000000000099-c0000000099-e0.colb")
+        open(orphan, "wb").write(b"unreferenced")
+        age(orphan)
+        # and an UNSAFE finding: a staged pre marker (not repairable)
+        ap = TopicAppender(topic, partitions=2, segment_records=4)
+        b = {"k": np.arange(4, dtype=np.int64),
+             "v": np.arange(4, dtype=np.float64)}
+        ap.stage(2, {0: [b]})
+        findings = fsck_path(topic, repair=True)
+        swept = {f["path"] for f in findings if f["repaired"]}
+        assert tmp_file in swept and orphan in swept
+        assert not os.path.exists(tmp_file)
+        assert not os.path.exists(orphan)
+        # the live staged transaction survived the repair pass
+        assert ap.staged_ids() == [2]
+        assert any(f["rule"] == "ORPHAN_PRE_MARKER"
+                   and not f["repaired"] for f in findings)
+        # committed data untouched
+        r = TopicReader(topic)
+        assert r.committed_offsets() == {0: 8, 1: 8}
+        # repairable-swept findings no longer fail the exit code once
+        # the unsafe ones are resolved (commit the staged txn)
+        ap.commit(2)
+        assert cli_main(["fsck", topic]) == 0
+        capsys.readouterr()
+
+    def test_repair_after_compaction_crash_debris(self, tmp_path,
+                                                  capsys):
+        topic = os.path.join(str(tmp_path), "keyed")
+        create_topic(topic, 1, key_field="k")
+        ap = TopicAppender(topic, partitions=1, segment_records=6)
+        for cid in (1, 2):
+            ap.stage(cid, {0: [{
+                "k": np.arange(6, dtype=np.int64) % 3,
+                "v": np.arange(6, dtype=np.int64) + cid * 10}]})
+            ap.commit(cid)
+        Compactor(topic, min_segments=2).compact()
+        # superseded raw segments linger when the post-swap delete
+        # crashed — simulate by re-creating one
+        stray = os.path.join(
+            topic, "p0", "seg-000000000000-c0000000001-e0.colb")
+        open(stray, "wb").write(b"superseded debris")
+        age(stray)
+        findings = fsck_path(topic, repair=True)
+        assert any(f["path"] == stray and f["repaired"]
+                   for f in findings)
+        assert cli_main(["fsck", topic]) == 0
+        capsys.readouterr()
+
+
+class TestDetect:
+    def test_kind_autodetection(self, tmp_path):
+        topic = make_topic(tmp_path)
+        jdir = make_checkpoints(tmp_path)
+        assert detect_kind(topic) == "topic"
+        assert detect_kind(jdir) == "checkpoint"
+        assert detect_kind(os.path.dirname(jdir)) == "checkpoint"
+        assert detect_kind(glob.glob(jdir + "/chk-1")[0]) == "checkpoint"
+        assert detect_kind(str(tmp_path)) is None

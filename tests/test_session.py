@@ -684,9 +684,12 @@ class TestMetricsIsolation:
         # process-global by design: injections/recoveries are process
         # events (faults.py docstring), topic metrics are per-topic
         # groups and LOG_TOPIC_MULTI_WRITER forbids two jobs sharing a
-        # topic writer
+        # topic writer; storage.enospc_retries (PR 14) counts a
+        # PROCESS-level condition — the disk filling up is not
+        # attributable to one tenant from inside the write seam
         "flink_tpu.faults",
         "flink_tpu.log.topic",
+        "flink_tpu.fs",
     }
 
     def test_no_module_level_registry_outside_allowlist(self):
