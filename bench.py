@@ -35,6 +35,28 @@ SLIDE_MS = 1_000
 # gate separately keeps these pipelines/configs at zero findings).
 BENCH_CONF = {"analysis.fail-on": "off"}
 
+# CLI A/B axes (--fire-gate on|off, --readiness piggyback|probe):
+# merged into every run's conf AFTER the per-config builders, so the
+# COMMITTED confs (job_confs/--dump-confs, exercised with no overrides)
+# stay byte-stable while a measurement run can flip the control-plane
+# knobs without editing code (PROFILE.md §12's before/after axis).
+CONTROL_OVERRIDES: dict = {}
+
+def _phase_summary(metrics: dict, wall_s: float) -> dict:
+    """Per-trial phase breakdown, derived from the JobResult's
+    profile.phase.* keys — driver.phase_breakdown() is the ONE shared
+    accounting, so the artifact mirrors whatever phases it emits
+    (hardcoding the list here would silently drop a future phase) —
+    plus the throttle-wait share of batch wall, the §8.3 attribution
+    line the §12 acceptance bar reads."""
+    pref = "profile.phase."
+    ph = {k[len(pref):]: round(float(v), 3)
+          for k, v in sorted(metrics.items()) if k.startswith(pref)}
+    ph["wall_s"] = round(wall_s, 3)
+    ph["throttle_share_pct"] = round(
+        100.0 * ph.get("throttle", 0.0) / max(wall_s, 1e-9), 1)
+    return ph
+
 
 # -- committed job confs -----------------------------------------------------
 # One conf builder per benched config; `job_confs()` instantiates each
@@ -171,7 +193,8 @@ def run_q5(batch_size: int, n_batches: int, *, shards: int, slots: int,
     # sub-batch fire/emit decoupling (PROFILE.md §8.6): fires reach
     # the host at ~batch_wall/K cadence instead of riding the drain
     # behind one full logical-batch device step
-    conf = _q5_conf(batch_size, shards, slots, sub_batches)
+    conf = {**_q5_conf(batch_size, shards, slots, sub_batches),
+            **CONTROL_OVERRIDES}
     if profile_dir:
         # per-op device trace of N warm steps (obs/profiling.py); the
         # summary rides JobResult.metrics["profile.trace_summary"]
@@ -209,10 +232,17 @@ def _q5_trial(batch, n_meas, sub_batches, profile_dir=""):
         "events_per_sec": round(batch * n_meas / elapsed),
         "batch": batch,
         "sub_batches": sub_batches,
+        "fire_gate": bool(CONTROL_OVERRIDES.get(
+            "pipeline.fire-gate", True)),
+        "readiness": str(CONTROL_OVERRIDES.get(
+            "pipeline.readiness", "piggyback")),
         "p50_latency_ms": round(metrics.get("driver.emit_latency_ms.p50", 0.0), 1),
         "p90_latency_ms": round(metrics.get("driver.emit_latency_ms.p90", 0.0), 1),
         "p99_latency_ms": round(metrics.get("driver.emit_latency_ms.p99", 0.0), 1),
         "max_latency_ms": round(metrics.get("driver.emit_latency_ms.max", 0.0), 1),
+        # per-phase wall attribution (dispatch/throttle/drain/advance/
+        # fire) — the win is attributed, not asserted (PROFILE.md §12)
+        "phase_breakdown": _phase_summary(metrics, elapsed),
     }
     return trial, metrics
 
@@ -292,6 +322,12 @@ def main() -> None:
         # delivery — see driver._note_ring_latency.
         "p99_latency_ms": med["p99_latency_ms"],
         "p50_latency_ms": med["p50_latency_ms"],
+        # control-plane config + the median trial's per-phase wall
+        # attribution (throttle/drain/advance vs dispatch/fire) — the
+        # §12 acceptance bar reads throttle_share_pct off this field
+        "fire_gate": med["fire_gate"],
+        "readiness": med["readiness"],
+        "phase_breakdown": med["phase_breakdown"],
         # per-op device-time summary from one short profiled run: the
         # §8.5 anomaly hunt ships IN the artifact (jax.profiler.trace
         # via pipeline.profile-dir; obs/profiling.py)
@@ -325,8 +361,9 @@ def sub_batch_sweep(spec: str) -> None:
             "unit": "events/sec/chip",
             "value": trial["events_per_sec"],
             **{f: trial[f] for f in (
-                "batch", "sub_batches", "p50_latency_ms",
-                "p90_latency_ms", "p99_latency_ms", "max_latency_ms")},
+                "batch", "sub_batches", "fire_gate", "readiness",
+                "p50_latency_ms", "p90_latency_ms", "p99_latency_ms",
+                "max_latency_ms", "phase_breakdown")},
         }))
 
 
@@ -727,7 +764,11 @@ def suite() -> None:
     print(json.dumps({
         "metric": "nexmark_q5_hot_items_host_fed_events_per_sec",
         "value": round((1 << 20) * 24 / el5h),
-        "unit": "events/sec/chip"}))
+        "unit": "events/sec/chip",
+        # the §8.3 attribution on the HOST-FED plane: the throttle-wait
+        # share of batch wall is the number the §12 acceptance bar
+        # compares (≥2× reduction vs the separate-probe control plane)
+        "phase_breakdown": _phase_summary(m5h, el5h)}))
     main()  # Q5 headline last (its line is the one the driver records)
 
 
@@ -920,6 +961,35 @@ def host_parallelism_sweep(spec: str) -> None:
 if __name__ == "__main__":
     import sys
 
+    # control-plane A/B axes for the Q5 runs (run_q5 merges
+    # CONTROL_OVERRIDES): the default headline, `--sub-batches` sweeps,
+    # and `--suite`'s Q5 lines honor them — e.g. `--sub-batches 1,2,4
+    # --fire-gate off` measures the ungated sweep for PROFILE.md §12's
+    # before/after table. Modes whose confs never pass through run_q5
+    # REJECT the flags loudly rather than silently ignoring them.
+    if "--fire-gate" in sys.argv or "--readiness" in sys.argv:
+        for mode in ("--backfill", "--host-parallelism",
+                     "--concurrent-jobs", "--dump-confs"):
+            if mode in sys.argv:
+                raise SystemExit(
+                    f"--fire-gate/--readiness only apply to the Q5 "
+                    f"paths (headline, --sub-batches, --suite); {mode} "
+                    "would silently ignore them — set pipeline.fire-"
+                    "gate / pipeline.readiness in the job conf instead")
+    if "--fire-gate" in sys.argv:
+        ix = sys.argv.index("--fire-gate")
+        val = sys.argv[ix + 1] if ix + 1 < len(sys.argv) else ""
+        if val not in ("on", "off"):
+            raise SystemExit("--fire-gate needs on|off")
+        CONTROL_OVERRIDES["pipeline.fire-gate"] = val == "on"
+        del sys.argv[ix:ix + 2]
+    if "--readiness" in sys.argv:
+        ix = sys.argv.index("--readiness")
+        val = sys.argv[ix + 1] if ix + 1 < len(sys.argv) else ""
+        if val not in ("piggyback", "probe"):
+            raise SystemExit("--readiness needs piggyback|probe")
+        CONTROL_OVERRIDES["pipeline.readiness"] = val
+        del sys.argv[ix:ix + 2]
     if "--dump-confs" in sys.argv:
         ix = sys.argv.index("--dump-confs")
         if ix + 1 >= len(sys.argv):

@@ -242,8 +242,11 @@ async function tick(){
         ' rec/s</b> &nbsp; records in/out: '+m.records_in+"/"+
         m.records_out+' &nbsp; watermark lag: '+
         Math.round(m.wm_lag_ms||0)+'ms</div>';
+      const dp=Math.min(100,Math.round(m.drain_busy_pct||0));
       html+='<div class="kv">backpressure: <span class="gauge">'+
-        '<i style="width:'+bp+'%"></i></span> '+bp+"%</div>";
+        '<i style="width:'+bp+'%"></i></span> '+bp+
+        "% &nbsp; drain link: <span class=\"gauge\">"+
+        '<i style="width:'+dp+'%"></i></span> '+dp+"%</div>";
       if(m.checkpoints&&m.checkpoints.length){
         html+="<table><tr><th>checkpoint</th><th>time</th>"+
           "<th>size</th></tr>"+m.checkpoints.map(c=>

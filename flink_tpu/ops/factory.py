@@ -48,6 +48,12 @@ class OperatorBuildContext:
     # host.fold-chunk-records, the spill store's tree-fold batch floor;
     # None = the declared config default
     fold_chunk_records: Optional[int] = None
+    # pipeline.fire-gate: device-side conditional around the fire/top-n/
+    # ring-append subgraph of the fused step programs (PROFILE.md §12)
+    fire_gate: bool = True
+    # pipeline.readiness: 'piggyback' (throttle consumes an announced
+    # per-step token) or 'probe' (legacy is_ready spin)
+    readiness: str = "piggyback"
 
 
 OperatorFactory = Callable[[Any, OperatorBuildContext], Any]
@@ -88,6 +94,8 @@ def _window_factory(node, ctx: OperatorBuildContext):
         exchange_impl=ctx.exchange_impl,
         host_pool=ctx.host_pool,
         fold_chunk_records=ctx.fold_chunk_records,
+        fire_gate=ctx.fire_gate,
+        readiness=ctx.readiness,
     )
     op.max_inflight_steps = ctx.max_inflight_steps
     # backpressure blocks happen OUTSIDE the push lock (the ingest loop

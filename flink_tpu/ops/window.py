@@ -577,6 +577,11 @@ def _ring_append_topn_core(
 # threshold (~100 bytes measured); 128 words = 512 bytes
 FUSED_HDR = 128
 _DELTA_SENTINEL = -(2**30)
+# fire params are sentinel-padded to at least this many window ends in
+# the HEADER (sub-100-byte uploads hit the transport's tiny-transfer
+# stall — see clear_kernel); the KERNEL reads only its static fire_pad
+# prefix of them (pow2-bucketed to the real end count, _fire_pad_bucket)
+MIN_FIRE_PAD = 64
 
 
 def fused_step_kernel(
@@ -592,7 +597,9 @@ def fused_step_kernel(
     by: str,
     topn: int,
     dump_row: int,
-) -> Tuple[PaneState, jax.Array]:
+    fire_gate: bool = False,
+    fire_pad: int = MIN_FIRE_PAD,
+) -> Tuple[PaneState, jax.Array, jax.Array]:
     """ONE device dispatch per microbatch: pre-aggregated apply +
     watermark fire (top-n ring append) + pane clear, with the fire
     parameters riding in the SAME upload as the pair list. On the
@@ -601,15 +608,21 @@ def fused_step_kernel(
     stream traffic to one upload + one launch (+ the cadenced ring
     announce); an A/B against a split header + stash-time pair upload
     measured WORSE (two transfer ops beat one combined even with
-    overlap). ref: 4.B/4.D hot paths, dispatched as one program."""
+    overlap). ref: 4.B/4.D hot paths, dispatched as one program.
+
+    Third output: the emit ring's HEAD ROW after this step's fire —
+    the piggybacked readiness/ring-header token (announced at dispatch;
+    the throttle consumes it instead of is_ready-probing, and its
+    [total, truncated] words stand in for a ring-header poll)."""
     hdr = buf[:FUSED_HDR]
     pairs = buf[FUSED_HDR:]
     state = _apply_preagg_u32_core(
         state, pairs, ring=ring, dump_row=dump_row)
-    return _fused_fire_clear(
+    state, emit_ring = _fused_fire_clear(
         state, emit_ring, hdr, used_mask, agg=agg,
         panes_per_window=panes_per_window, ring=ring, sel_cap=sel_cap,
-        by=by, topn=topn)
+        by=by, topn=topn, fire_gate=fire_gate, fire_pad=fire_pad)
+    return state, emit_ring, emit_ring[0]
 
 
 def _hdr_i64(hdr: jax.Array, i: int) -> jax.Array:
@@ -618,22 +631,55 @@ def _hdr_i64(hdr: jax.Array, i: int) -> jax.Array:
 
 
 def _fused_fire_clear(state, emit_ring, hdr, used_mask, *, agg,
-                      panes_per_window, ring, sel_cap, by, topn):
+                      panes_per_window, ring, sel_cap, by, topn,
+                      fire_gate=False, fire_pad=MIN_FIRE_PAD):
     """Shared fire + clear tail of the one-dispatch step kernels: the
-    fire parameters and the purge mask ride the FUSED_HDR header."""
+    fire parameters and the purge mask ride the FUSED_HDR header.
+
+    ``fire_gate`` (pipeline.fire-gate, PROFILE.md §12): the fire/top-n/
+    ring-append subgraph — whose stable argsort + top_k IS the CPU step
+    cost and was measured on every dispatch whether or not any window
+    fires (§8.6) — runs under a ``lax.cond`` keyed on the header's
+    window-end list, and the pane purge under a second cond keyed on
+    the clear words. The host fills both header fields before dispatch
+    (``_fused_fill_header``), so a non-firing sub-batch skips the sort
+    entirely. Byte-identical by construction: with no valid ends the
+    ungated core selects zero rows and leaves ring bytes and head
+    counters unchanged, and a zero clear mask is the identity — the
+    cond only skips provably-no-op work. fire_gate=False is the exact
+    pre-gate graph.
+
+    ``fire_pad``: how many of the header's MIN_FIRE_PAD window-end
+    slots this program READS — the static width of the whole fire
+    subgraph (fire_kernel's rows×W reductions, the rows×W selection
+    argsort, the W-way top_k). The host buckets it to the next power
+    of two ≥ the sub-batch's real end count (``_fire_pad_bucket``), so
+    K sub-batch dispatches of ~W/K ends each cost ≈ ONE W-wide fire —
+    without this, every dispatch paid the full 64-wide subgraph and
+    sub-batching traded throughput ∝ K for its p99 win (§8.6's
+    measured tax). Sentinel-padded slots never select rows, so the
+    bucket width never changes bytes, only skipped work."""
     pane_lo = _hdr_i64(hdr, 0)
     pane_hi = _hdr_i64(hdr, 2)
     anchor = _hdr_i64(hdr, 4)
     clear_lo = hdr[7]
     clear_hi = hdr[6]
-    deltas = hdr[8:8 + MIN_FIRE_PAD]
+    deltas = hdr[8:8 + fire_pad]
     w_valid = deltas > _DELTA_SENTINEL
     end_panes = jnp.where(w_valid, pane_lo + deltas.astype(jnp.int64),
                           _END_SENTINEL)
-    emit_ring = _ring_append_topn_core(
-        state, emit_ring, pane_lo, pane_hi, anchor, end_panes, w_valid,
-        used_mask, agg=agg, panes_per_window=panes_per_window, ring=ring,
-        sel_cap=sel_cap, by=by, topn=topn)
+
+    def _fire(ring_in):
+        return _ring_append_topn_core(
+            state, ring_in, pane_lo, pane_hi, anchor, end_panes, w_valid,
+            used_mask, agg=agg, panes_per_window=panes_per_window,
+            ring=ring, sel_cap=sel_cap, by=by, topn=topn)
+
+    if fire_gate:
+        emit_ring = lax.cond(jnp.any(w_valid), _fire,
+                             lambda ring_in: ring_in, emit_ring)
+    else:
+        emit_ring = _fire(emit_ring)
     # 64-bit clear mask split over header words [7] (columns 0-31)
     # and [6] (columns 32-63) — rings up to 64 stay on the one-dispatch
     # fused paths (a 2^22-record batch's event span outgrows 32)
@@ -647,7 +693,13 @@ def _fused_fire_clear(state, emit_ring, hdr, used_mask, *, agg,
         cm = jnp.concatenate([cm, cm_hi])
     if ring > 64:
         cm = jnp.concatenate([cm, jnp.zeros(ring - 64, bool)])
-    state = clear_kernel(state, cm.astype(jnp.int32))
+    if fire_gate:
+        state = lax.cond(
+            (clear_lo != 0) | (clear_hi != 0),
+            lambda s: clear_kernel(s, cm.astype(jnp.int32)),
+            lambda s: s, state)
+    else:
+        state = clear_kernel(state, cm.astype(jnp.int32))
     return state, emit_ring
 
 
@@ -674,6 +726,8 @@ def devgen_step_kernel(
     dump_row: int,
     pane_ms: int,
     offset_ms: int,
+    fire_gate: bool = False,
+    fire_pad: int = MIN_FIRE_PAD,
 ) -> Tuple[PaneState, jax.Array, jax.Array]:
     """Device-chained generator ingest: ONE dispatch synthesizes the
     microbatch ON DEVICE, maps keys to slots, segment-sums the panes,
@@ -694,9 +748,12 @@ def devgen_step_kernel(
     stats output; the host re-synthesizes the batch bit-exactly (the
     generator contract), registers the new keys, and applies just those
     records through the pair path. The third output is an int32 stats
-    vector: [n_valid, n_late, n_miss, 0, n_refire, pad...8] ++
-    refire-candidate bitmap over panes [dead_below, dead_below +
-    DEVGEN_REFIRE_BITS)."""
+    vector: [n_valid, n_late, n_miss, ring_total, n_refire,
+    ring_truncated, 0, 0] ++ refire-candidate bitmap over panes
+    [dead_below, dead_below + DEVGEN_REFIRE_BITS) — words 3/5 carry
+    the emit ring's POST-FIRE head counters, so the announced stats
+    copy doubles as the piggybacked readiness token AND a ring-header
+    poll (no separate fetch; PROFILE.md §12)."""
     hdr = buf[:FUSED_HDR]
     batch_index = _hdr_i64(hdr, DEVGEN_HDR_OFF)
     dead_below = _hdr_i64(hdr, DEVGEN_HDR_OFF + 2)
@@ -726,10 +783,6 @@ def devgen_step_kernel(
     rbm = jax.ops.segment_sum(
         jnp.ones_like(roff), roff,
         num_segments=DEVGEN_REFIRE_BITS + 1)[:DEVGEN_REFIRE_BITS]
-    stats = jnp.concatenate([
-        jnp.stack([valid.sum(), late.sum(), miss.sum(), 0,
-                   refire.sum(), 0, 0, 0]).astype(jnp.int32),
-        (rbm > 0).astype(jnp.int32)])
     # materialize the ingest before the fire reads it: without the
     # barrier XLA fuses the segment_sum into the fire path's many
     # reads of counts and re-evaluates it per read (measured 170ms vs
@@ -740,20 +793,32 @@ def devgen_step_kernel(
     state, emit_ring = _fused_fire_clear(
         state, emit_ring, hdr, used_mask, agg=agg,
         panes_per_window=panes_per_window, ring=ring, sel_cap=sel_cap,
-        by=by, topn=topn)
+        by=by, topn=topn, fire_gate=fire_gate, fire_pad=fire_pad)
+    # stats words 3/5 = the POST-FIRE ring head [total, truncated]:
+    # the one announced copy carries ingest accounting, step readiness,
+    # AND the ring header in a single transfer
+    stats = jnp.concatenate([
+        jnp.stack([valid.sum().astype(jnp.int32),
+                   late.sum().astype(jnp.int32),
+                   miss.sum().astype(jnp.int32),
+                   emit_ring[0, 0],
+                   refire.sum().astype(jnp.int32),
+                   emit_ring[0, 1],
+                   jnp.int32(0), jnp.int32(0)]).astype(jnp.int32),
+        (rbm > 0).astype(jnp.int32)])
     return state, emit_ring, stats
 
 
 _JIT_FUSED_STEP = jax.jit(
     fused_step_kernel,
     static_argnames=("agg", "panes_per_window", "ring", "sel_cap", "by",
-                     "topn", "dump_row"),
+                     "topn", "dump_row", "fire_gate", "fire_pad"),
     donate_argnums=(0,))
 _JIT_DEVGEN_STEP = jax.jit(
     devgen_step_kernel,
     static_argnames=("gen", "key_domain", "agg", "panes_per_window",
                      "ring", "sel_cap", "by", "topn", "dump_row",
-                     "pane_ms", "offset_ms"),
+                     "pane_ms", "offset_ms", "fire_gate", "fire_pad"),
     donate_argnums=(0,))
 
 
@@ -855,10 +920,6 @@ MAX_FIRE_CHUNK = 4
 # the ring/top-n path appends in HBM (no per-fire fetch buffer), so it
 # takes a steady advance's whole window list in ONE dispatch
 MAX_FIRE_CHUNK_RING = 16
-# fire params are sentinel-padded to at least this many window ends:
-# sub-100-byte uploads hit the transport's tiny-transfer stall (see
-# clear_kernel), and the padding costs only masked lanes in the kernel
-MIN_FIRE_PAD = 64
 # devgen header params (batch_index, dead_below, refire_below as i64)
 # start right after the fire-delta region; must stay inside FUSED_HDR
 DEVGEN_HDR_OFF = 8 + MIN_FIRE_PAD
@@ -1065,11 +1126,41 @@ class WindowOperator:
         exchange_impl: str = "all-to-all",
         host_pool: Optional[Any] = None,
         fold_chunk_records: Optional[int] = None,
+        fire_gate: bool = True,
+        readiness: str = "piggyback",
     ) -> None:
         self.assigner = assigner
         self.agg = agg
         self.mesh_plan = mesh_plan
         self.exchange_impl = exchange_impl
+        # fire-gated dispatch (pipeline.fire-gate, PROFILE.md §12): the
+        # fused/devgen step programs run the fire/top-n/ring-append
+        # subgraph (and the pane purge) under lax.cond, so a dispatch
+        # whose header carries no fireable window end skips the
+        # dominant sort instead of paying it every sub-batch (§8.6).
+        # False = the exact pre-gate graphs (the A/B axis).
+        self.fire_gate = bool(fire_gate)
+        # step-readiness plumbing (pipeline.readiness): 'piggyback'
+        # derives throttle readiness from a tiny ANNOUNCED per-step
+        # output (the devgen stats vector / the fused kernel's ring-head
+        # row) — the wait is a consume of an in-flight transfer, never a
+        # separate is_ready relay round trip (§8.3 lever a); 'probe' is
+        # the legacy is_ready spin on the in-flight marker.
+        if readiness not in ("piggyback", "probe"):
+            raise ValueError(
+                f"pipeline.readiness must be 'piggyback' or 'probe', "
+                f"got {readiness!r}")
+        self.readiness = readiness
+        # piggybacked ring-header knowledge (coalesced readback): tokens
+        # carry the emit ring's [total, truncated] head words; once a
+        # token AT OR AFTER the last row-carrying fire has landed, an
+        # opportunistic drain poll whose known total equals the drained
+        # count skips the ring fetch outright (see drain_ring).
+        self._token_seq = 0
+        self._rowfire_token_seq = 0  # tokens below this predate a fire
+        self._ring_head_seq = 0
+        self._ring_head_known = False
+        self._ring_head_total = 0
         # processing-time mode (ref: TumblingProcessingTimeWindows +
         # ProcessingTimeTrigger + the proc-time half of the timer
         # service): records are stamped with the operator clock at
@@ -1303,10 +1394,27 @@ class WindowOperator:
                 by=by,
                 topn=n,
                 dump_row=self.layout.slots,
+                fire_gate=self.fire_gate,
             ) if self.plan.ring <= 64 else None)
         else:
             self._fused_step = None
         self._clear = _JIT_CLEAR
+
+    def _fire_pad_bucket(self, n_ends: int) -> int:
+        """Static width of a fused dispatch's fire subgraph: the pow2
+        bucket ≥ the sub-batch's real end count — at most
+        log2(MIN_FIRE_PAD)+1 compiled buckets, shared process-wide
+        through the module-level jit cache, and a steady cadence hits
+        one or two of them. The fire cost (fire_kernel's rows×W
+        reductions, the rows×W selection argsort, the W-way top_k)
+        scales with the bucket, so K sub-batch dispatches of ~W/K real
+        ends each cost ≈ one W-wide fire instead of K full-pad fires —
+        the other half of the §8.6 tax next to the zero-end cond skip.
+        Gating off keeps the full MIN_FIRE_PAD width (the exact
+        pre-gate program, the A/B axis)."""
+        if not self.fire_gate:
+            return MIN_FIRE_PAD
+        return min(MIN_FIRE_PAD, _next_pow2(max(n_ends, 1)))
 
     def _topn_cap(self, w: int) -> int:
         """Winner-buffer capacity: n rows per window plus generous tie
@@ -1623,7 +1731,7 @@ class WindowOperator:
         if self.mesh_plan is None and self._preagg_dispatch(
                 slots, panes, valid, data):
             self.prof["pb_preagg"] += time.perf_counter() - t2
-            self._inflight.append(self.state.counts[0, 0])
+            self._note_dispatch(self.state.counts[0, 0])
             if not self.external_throttle:
                 self.throttle()
             return
@@ -1703,7 +1811,7 @@ class WindowOperator:
         # inflight marker: a tiny scalar DERIVED from the new state — the
         # state buffers themselves are donated to the next step, so
         # holding them would read deleted buffers
-        self._inflight.append(self.state.counts[0, 0])
+        self._note_dispatch(self.state.counts[0, 0])
         if not self.external_throttle:
             self.throttle()
 
@@ -1829,7 +1937,7 @@ class WindowOperator:
                 buf = preagg_encode_i32(pairs, cnts, [], cap)
                 self.state = self._preagg_i32(self.state, jnp.asarray(buf))
         self.prof["pb_preagg"] += time.perf_counter() - tc
-        self._inflight.append(self.state.counts[0, 0])
+        self._note_dispatch(self.state.counts[0, 0])
         if not self.external_throttle:
             self.throttle()
         return True
@@ -1845,7 +1953,7 @@ class WindowOperator:
         self._stash_u32 = None
         self.state = self._preagg_u32(
             self.state, jnp.asarray(buf[FUSED_HDR:]))
-        self._inflight.append(self.state.counts[0, 0])
+        self._note_dispatch(self.state.counts[0, 0])
 
     def _preagg_dispatch(
         self,
@@ -1941,6 +2049,76 @@ class WindowOperator:
             ring = (self.EMIT_RING_ROWS + 2) * cols * 4
         return state + ring
 
+    def _note_dispatch(self, marker, token=None, head=None) -> None:
+        """Record one dispatched device step on the in-flight credit
+        deque. ``marker``: a non-donated output of the step (the legacy
+        is_ready probe target). ``token``: a tiny ANNOUNCED
+        (copy_to_host_async) output of the same step — piggybacked
+        readiness retires the step by CONSUMING its in-flight copy
+        instead of probing; ``head=(i_total, i_trunc)`` names the emit-
+        ring header words the token carries (coalesced readback).
+
+        THE announce happens here, once, for every token (re-announcing
+        an already-announced array is a no-op, so callers whose token
+        was announced for other reasons — the devgen stats copy under
+        need_stats — never double-pay): a token that skipped its
+        announce would silently turn the throttle's consume into the
+        unannounced blocking round trip piggyback exists to remove.
+        Token-less callers (the preagg/apply/stash ingest dispatches)
+        under piggyback readiness announce their MARKER instead — it is
+        already a tiny derived scalar (``state.counts[0, 0]``), so the
+        throttle's wait stays a transfer consume on EVERY dispatch
+        plane, not just the fused/devgen advances. Probe mode announces
+        nothing (zero per-step d2h, the documented trade)."""
+        seq = 0
+        if token is None and self.readiness == "piggyback":
+            token = marker  # consume-only: carries no ring-head words
+        if token is not None:
+            if hasattr(token, "copy_to_host_async"):
+                token.copy_to_host_async()
+            self._token_seq += 1
+            seq = self._token_seq
+        self._inflight.append((marker, token, head, seq))
+
+    def _retire_step(self) -> None:
+        """Retire the oldest in-flight step: consume its announced
+        readiness token when it has one (a wait on an in-flight
+        transfer, not an extra control round trip), else fall back to
+        the is_ready spin on the marker."""
+        marker, token, head, seq = self._inflight.popleft()
+        if token is not None:
+            arr = np.asarray(token)  # blocks on the announced copy only
+            if head is not None:
+                self._note_ring_head(arr, head, seq)
+        else:
+            ready_wait(marker)
+
+    @staticmethod
+    def _raise_truncation(truncated: int) -> None:
+        """The ONE top-n winner-buffer overflow error — raised from the
+        ring fetch (drain_ring) and from a landed readiness token's
+        head words, which detect it without a fetch."""
+        raise RuntimeError(
+            f"top-n winner-buffer truncation: {truncated} selected "
+            "rows exceeded the per-fire selection capacity (tie "
+            "explosion at the n-th value); raise n or aggregate "
+            "first")
+
+    def _note_ring_head(self, arr: np.ndarray, head, seq: int) -> None:
+        """Fold a landed token's emit-ring head words into host
+        knowledge: loud truncation detection without a ring fetch, and
+        the drain-skip fact (see drain_ring) — the head is trusted only
+        once the token postdates every row-carrying fire."""
+        total = int(arr[head[0]])
+        truncated = int(arr[head[1]])
+        if truncated > 0:
+            self._raise_truncation(truncated)
+        with self._ring_lock:
+            if seq >= self._rowfire_token_seq and seq > self._ring_head_seq:
+                self._ring_head_seq = seq
+                self._ring_head_known = True
+                self._ring_head_total = total
+
     def throttle(self) -> None:
         """Apply ingest backpressure: block on the oldest outstanding
         step once more than ``max_inflight_steps`` are in flight. The
@@ -1950,7 +2128,7 @@ class WindowOperator:
         the drain thread's deliveries behind it (emit latency)."""
         t0 = time.perf_counter()
         while len(self._inflight) > self.max_inflight_steps:
-            ready_wait(self._inflight.popleft())
+            self._retire_step()
         # overflow markers older than the steps just retired are ready
         # (int() is a cheap host read); draining to the same bound keeps
         # the deque finite in jobs that never checkpoint
@@ -1967,7 +2145,7 @@ class WindowOperator:
             self._reconcile_devstats()
         self._flush_stash()
         while self._inflight:
-            ready_wait(self._inflight.popleft())
+            self._retire_step()
         ready_wait(self.state.counts)
         self._resolve_overflow()
 
@@ -2247,15 +2425,22 @@ class WindowOperator:
         ends_f, cleared_after = hdr
         self._stash_u32 = None
         used = self._used_mask_device()
-        self.state, self._emit_ring = self._fused_step(
+        self.state, self._emit_ring, token = self._fused_step(
             self.state, self._ensure_ring(), jnp.asarray(buf), used,
-            sel_cap=self._topn_cap(MIN_FIRE_PAD))
+            sel_cap=self._topn_cap(MIN_FIRE_PAD),
+            fire_pad=self._fire_pad_bucket(len(ends_f)))
         # the NON-donated emit-ring output doubles as the completion
         # marker — no extra gather launch, and it survives the next
-        # step's donation of the state buffers
-        self._inflight.append(self._emit_ring)
+        # step's donation of the state buffers. Piggyback readiness
+        # additionally registers the kernel's ring-head token
+        # (_note_dispatch announces it) so the throttle's wait is a
+        # consume of that in-flight copy.
+        if self.readiness == "piggyback":
+            self._note_dispatch(self._emit_ring, token=token, head=(0, 1))
+        else:
+            self._note_dispatch(self._emit_ring)
         self._cleared_below = cleared_after
-        return self._ring_after_fire(len(ends_f))
+        return self._ring_after_fire(len(ends_f), covered=True)
 
     # -- device-chained generator ingest (see devgen_step_kernel) --------
 
@@ -2347,7 +2532,8 @@ class WindowOperator:
         return True
 
     def _dispatch_devgen(self, buf: np.ndarray, batch_index: int,
-                         dead: int, need_stats: bool = True) -> None:
+                         dead: int, need_stats: bool = True,
+                         fire_pad: int = MIN_FIRE_PAD) -> None:
         by, n = self._topn
         step = functools.partial(
             _JIT_DEVGEN_STEP, gen=self._devgen_spec.device_keys_ts,
@@ -2355,21 +2541,29 @@ class WindowOperator:
             agg=self.agg, panes_per_window=self.plan.panes_per_window,
             ring=self.plan.ring, by=by, topn=n,
             dump_row=self.layout.slots, pane_ms=self.plan.pane_ms,
-            offset_ms=self.plan.offset_ms)
+            offset_ms=self.plan.offset_ms, fire_gate=self.fire_gate)
         used = self._used_mask_device()
         self.state, self._emit_ring, stats = step(
             self.state, self._ensure_ring(), jnp.asarray(buf), used,
-            sel_cap=self._topn_cap(MIN_FIRE_PAD))
+            sel_cap=self._topn_cap(MIN_FIRE_PAD), fire_pad=fire_pad)
+        # the stats lane rides home asynchronously and reconciles at a
+        # later advance; under probe readiness, when the spec PROVES
+        # the key bound and the batch's pane range rules out
+        # late/refire work, the whole transfer is skipped (every
+        # per-step transfer is ~tens of ms of in-situ relay service).
+        # Piggyback readiness registers it as the step's token instead
+        # (_note_dispatch announces it): the landed copy carries the
+        # post-fire ring head in words 3/5 — one transfer serves
+        # accounting, the throttle, and the ring-header poll.
         if need_stats:
-            # the stats lane rides home asynchronously and reconciles
-            # at a later advance; when the spec PROVES the bound and
-            # the batch's pane range rules out late/refire work, the
-            # whole round trip is skipped (every per-step transfer is
-            # ~tens of ms of in-situ relay service)
-            if hasattr(stats, "copy_to_host_async"):
+            if self.readiness != "piggyback" \
+                    and hasattr(stats, "copy_to_host_async"):
                 stats.copy_to_host_async()
             self._devstats_pending.append((batch_index, dead, stats))
-        self._inflight.append(self._emit_ring)
+        if self.readiness == "piggyback":
+            self._note_dispatch(self._emit_ring, token=stats, head=(3, 5))
+        else:
+            self._note_dispatch(self._emit_ring)
 
     def _advance_fused_devgen(self, wm: int,
                               ends: List[int]) -> Optional["FiredWindows"]:
@@ -2385,9 +2579,10 @@ class WindowOperator:
         self._stash_devgen = None
         buf[DEVGEN_HDR_OFF:DEVGEN_HDR_OFF + 6] = np.array(
             [batch_index, dead, refire_below], np.int64).view(np.int32)
-        self._dispatch_devgen(buf, batch_index, dead, need_stats)
+        self._dispatch_devgen(buf, batch_index, dead, need_stats,
+                              fire_pad=self._fire_pad_bucket(len(ends_f)))
         self._cleared_below = cleared_after
-        return self._ring_after_fire(len(ends_f))
+        return self._ring_after_fire(len(ends_f), covered=True)
 
     def _flush_devgen(self) -> None:
         """Dispatch a pending device-generated batch as a fire-less
@@ -2410,7 +2605,8 @@ class WindowOperator:
                                           np.int64).astype(np.int32)
         buf[DEVGEN_HDR_OFF:DEVGEN_HDR_OFF + 6] = np.array(
             [batch_index, dead, refire_below], np.int64).view(np.int32)
-        self._dispatch_devgen(buf, batch_index, dead, need_stats)
+        self._dispatch_devgen(buf, batch_index, dead, need_stats,
+                              fire_pad=self._fire_pad_bucket(0))
 
     # how many un-reconciled device steps may accumulate before an
     # advance force-blocks on the oldest one's stats: at steady state
@@ -2464,10 +2660,15 @@ class WindowOperator:
                 if redo.any():
                     self.process_batch(keys[out][redo], ts[out][redo], {})
 
-    def _ring_after_fire(self, n_ends: int) -> "FiredWindows":
+    def _ring_after_fire(self, n_ends: int,
+                         covered: bool = False) -> "FiredWindows":
         """Post-fire ring bookkeeping shared by the fused and chunked
         top-n paths: version bump + cadenced announce (see
-        _ring_versions)."""
+        _ring_versions). ``covered``: this fire rode a dispatch whose
+        readiness token carries the POST-fire ring head (the fused/
+        devgen paths) — that token (or any later one) re-validates the
+        piggybacked head; a chunked fire has no token of its own, so
+        only a FUTURE dispatch's token can."""
         with self._ring_lock:
             self._ring_version_no += 1
             if n_ends > 0:
@@ -2475,6 +2676,11 @@ class WindowOperator:
                 # latency attribution (see _fire_stamps above)
                 self._fire_stamps.append(
                     (self._ring_version_no, time.time()))
+                # rows may have been appended: the piggybacked ring head
+                # goes stale until a token at/after this fire lands
+                self._ring_head_known = False
+                self._rowfire_token_seq = (
+                    self._token_seq if covered else self._token_seq + 1)
             self._rows_bound_since_announce += max(n_ends, 0) * (
                 self._topn[1] * 8)
             now = time.perf_counter()
@@ -2662,6 +2868,25 @@ class WindowOperator:
             self._pending_ring_extras.clear()
             if self._emit_ring is None or self._ring_anchor is None:
                 arr = None
+            elif (min_no == 0 and self.mesh_plan is None
+                  and self._ring_head_known
+                  and self._ring_head_total == self._ring_drained):
+                # coalesced readback: a landed step token that postdates
+                # every row-carrying fire says the ring's appended total
+                # equals what this host already drained — there is
+                # provably nothing to fetch, so the opportunistic poll
+                # skips the device round trip outright. Barrier drains
+                # (min_no > 0 / None) always fetch. The same proof
+                # covers every pending fire stamp (a stamped fire
+                # postdating the trusted token would have invalidated
+                # the head): their rows are already host-visible, so
+                # deliver the stamps NOW — a zero-row fire cohort's
+                # latency sample must not age across skipped polls.
+                while self._fire_stamps:
+                    self._delivered_stamps.append(
+                        self._fire_stamps.popleft())
+                self.prof["drain_skips"] += 1
+                arr = None
             else:
                 tdr = time.perf_counter()
                 # fetch the newest ANNOUNCED version whose async copy
@@ -2731,11 +2956,7 @@ class WindowOperator:
             total = int(block[0, 0])
             truncated = int(block[0, 1])
             if truncated > 0:
-                raise RuntimeError(
-                    f"top-n winner-buffer truncation: {truncated} selected "
-                    "rows exceeded the per-fire selection capacity (tie "
-                    "explosion at the n-th value); raise n or aggregate "
-                    "first")
+                self._raise_truncation(truncated)
             new = total - drained
             if new > row_cap:
                 raise RuntimeError(
@@ -2911,9 +3132,12 @@ class WindowOperator:
         self._refire = set(snap["refire"])
         self.late_records = snap["late_records"]
         self.records_dropped_full = snap.get("records_dropped_full", 0)
-        # pre-restore device steps are from a dead timeline
+        # pre-restore device steps are from a dead timeline (their
+        # in-flight markers/tokens included — a stale token's ring head
+        # must never be folded into the restored timeline's facts)
         self._stash_devgen = None
         self._devstats_pending.clear()
+        self._inflight.clear()
         snap_spill = snap.get("spill")
         if self._spill is not None and snap_spill is not None:
             self._spill.restore(snap_spill)
@@ -2934,6 +3158,10 @@ class WindowOperator:
         self._ring_versions.clear()
         self._fire_stamps.clear()
         self._delivered_stamps.clear()
+        # piggybacked ring-head facts describe the pre-restore timeline
+        self._ring_head_known = False
+        self._ring_head_seq = self._token_seq
+        self._rowfire_token_seq = self._token_seq + 1
         # a stash from the pre-restore attempt belongs to a replayed
         # stream position — never apply it to restored state
         self._stash_u32 = None

@@ -253,6 +253,17 @@ class Driver:
         # reset to the base credit at chain attach (the scaled credit
         # there would queue K× the bytes, not the same bytes).
         inflight = self._base_inflight * self._sub_batches
+        # control-plane knobs (PROFILE.md §12): fire-gated dispatch and
+        # the readiness mechanism the throttle uses. Validated here so a
+        # typo fails at build, not deep inside the first throttle.
+        self._fire_gate = bool(self.config.get(PipelineOptions.FIRE_GATE))
+        self._readiness = str(
+            self.config.get(PipelineOptions.READINESS)).strip().lower()
+        if self._readiness not in ("piggyback", "probe"):
+            raise ValueError(
+                f"pipeline.readiness must be 'piggyback' or 'probe', "
+                f"got {self._readiness!r} (the plan analyzer flags this "
+                "at submit: READINESS_INVALID)")
         xcap = self.config.get(PipelineOptions.EXCHANGE_CAPACITY)
         if xcap < 0:
             raise ValueError(
@@ -317,6 +328,8 @@ class Driver:
             shard_range=shard_range,
             host_pool=self.host_pool,
             fold_chunk_records=fold_chunk,
+            fire_gate=self._fire_gate,
+            readiness=self._readiness,
         )
         allow_drops = bool(self.config.get(StateOptions.ALLOW_DROPS))
         for n in self.plan.nodes.values():
@@ -1562,15 +1575,22 @@ class Driver:
                                 self.metrics["records_in"] += nxt.n
                                 self.metrics["batches"] += 1
                         if ok:
-                            # throttle probes cost a relay round trip
-                            # each — amortize them at LOGICAL-batch
-                            # granularity: only the last sub-batch of
-                            # its logical group rate-matches (the
-                            # in-flight credit was scaled by the same
-                            # factor in _build_ops, so depth in bytes
-                            # is unchanged)
+                            # probe readiness: throttle waits cost a
+                            # relay round trip each, so they amortize
+                            # at LOGICAL-batch granularity — only the
+                            # last sub-batch of a logical group
+                            # rate-matches (the in-flight credit was
+                            # scaled by the same factor in _build_ops,
+                            # so depth in bytes is unchanged).
+                            # Piggybacked readiness makes each wait a
+                            # consume of an already-announced transfer
+                            # (no extra round trip), so the throttle
+                            # rate-matches at EVERY sub-batch — the
+                            # credit accounting scales with the finer
+                            # cadence instead of batching it.
                             f = self._sub_factor.get(sid, 1)
-                            if f == 1 or (nxt.index + 1) % f == 0:
+                            if (f == 1 or self._readiness == "piggyback"
+                                    or (nxt.index + 1) % f == 0):
                                 for op2 in self._ops.values():
                                     if hasattr(op2, "throttle"):
                                         op2.throttle()
@@ -1734,6 +1754,12 @@ class Driver:
         final.update(self.registry.snapshot())
         for k, v in self.prof.items():
             final[f"profile.driver.{k}"] = v
+        # the per-phase breakdown (dispatch/throttle/drain/advance/fire)
+        # under the ONE shared accounting (phase_breakdown) — bench
+        # artifacts embed these next to profile_top_ops so control-
+        # plane wins are attributed, not asserted (PROFILE.md §12)
+        for k, v in self.phase_breakdown().items():
+            final[f"profile.phase.{k}"] = round(v, 6)
         if self._profiler is not None:
             summary = self._profiler.close()
             if summary is not None:
@@ -2003,18 +2029,74 @@ class Driver:
         self._positions[sid][split_ix] = src.position_after(pos, data, ts)
 
     # -- data plane ------------------------------------------------------
+    def phase_breakdown(self) -> Dict[str, float]:
+        """Cumulative per-phase wall seconds of this run — ONE
+        accounting shared by the bench artifacts (per-trial
+        ``phase_breakdown``), the JobResult (``profile.phase.*``), and
+        the web-UI backpressure gauge, so the §8.3 cost attribution
+        (throttle / drain / advance / fire) is measured the same way
+        everywhere instead of each consumer summing its own subset.
+
+        Phases (best-effort attribution from the always-on prof
+        accumulators, clamped non-negative):
+          source   — source iterator next() (decode/generate)
+          dispatch — ingest push + device-step dispatch, MINUS the
+                     throttle share accrued inside it (push timing
+                     wraps the throttle loop)
+          throttle — backpressure waits (pb_throttle_wait)
+          drain    — emit-ring/pack fetch time: the drain thread's
+                     link-held window plus ring fetches made outside
+                     it (the sync spill drain runs on the loop thread)
+          advance  — watermark-advance bookkeeping minus the fire
+                     dispatch it wraps
+          fire     — fire-path dispatch inside advance_watermark
+                     (aw_dispatch)"""
+        def opsum(key: str) -> float:
+            return sum(getattr(op, "prof", {}).get(key, 0.0)
+                       for op in self._ops.values())
+
+        prof = self.prof
+        throttle = opsum("pb_throttle_wait")
+        fire = opsum("aw_dispatch")
+        drain_thread = prof.get("drain_link_held", 0.0)
+        # drain_fetch accrues inside the drain thread's link window on
+        # the async path; count only the excess (sync drains on the
+        # loop thread) so the two never double-count
+        drain = drain_thread + max(0.0, opsum("drain_fetch") - drain_thread)
+        return {
+            "source": prof.get("source_next", 0.0),
+            "dispatch": max(0.0, prof.get("push", 0.0)
+                            + prof.get("link_lock_wait", 0.0) - throttle),
+            "throttle": throttle,
+            "drain": drain,
+            "advance": max(0.0, prof.get("advance_wm", 0.0) - fire),
+            "fire": fire,
+        }
+
     def live_metrics(self) -> Dict[str, Any]:
         """Racy-read live counters for the heartbeat-carried job
         metrics (cluster web UI gauges; ref: the TaskManager metric
         report feeding the REST vertices/backpressure endpoints)."""
-        tw = sum(getattr(op, "prof", {}).get("pb_throttle_wait", 0.0)
-                 for op in self._ops.values())
+        ph = self.phase_breakdown()
+        # the gauges read the SAME phase accounting as the artifacts
+        # (phase_breakdown), split per THREAD so each busy fraction is
+        # a share of one thread's wall: backpressure = the INGEST
+        # loop's waits (throttle + advance bookkeeping — pre-§12 only
+        # pb_throttle_wait, so advance stalls were invisible); the
+        # drain thread's link-held time is its own gauge — folding it
+        # into the ingest fraction would read ~100% backpressure on a
+        # healthy pipeline whose drain merely holds the link.
+        tw = ph["throttle"] + ph["advance"]
+        dw = ph["drain"]
         now = time.perf_counter()
-        last_t, last_w = getattr(self, "_lm_prev", (now - 1e-9, tw))
-        self._lm_prev = (now, tw)
+        last_t, last_w, last_d = getattr(
+            self, "_lm_prev", (now - 1e-9, tw, dw))
+        self._lm_prev = (now, tw, dw)
         # DELTA busy fraction since the previous sample — a cumulative
         # counter over heartbeat age would peg at 100% forever
-        bp = max(0.0, min(1.0, (tw - last_w) / max(now - last_t, 1e-9)))
+        span = max(now - last_t, 1e-9)
+        bp = max(0.0, min(1.0, (tw - last_w) / span))
+        dp = max(0.0, min(1.0, (dw - last_d) / span))
         out: Dict[str, Any] = {
             "records_in": int(self.metrics.get("records_in", 0)),
             "records_out": int(self.metrics.get("records_out", 0)),
@@ -2022,6 +2104,7 @@ class Driver:
             "eps": round(self._eps_meter.rate, 1),
             "wm_lag_ms": float(getattr(self._wm_lag, "value", 0.0) or 0),
             "backpressure_pct": round(100 * bp),
+            "drain_busy_pct": round(100 * dp),
         }
         if self._coordinator is not None:
             # in-memory stats, NOT a storage listing: this runs on the

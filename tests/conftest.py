@@ -87,3 +87,9 @@ def pytest_configure(config):
         "runtime/session.py) — slot quotas, FIFO admission queue, fair "
         "drain scheduling, autoscaler, per-job isolation, multi-tenant "
         "chaos, and the `session` CLI smoke (tier-1)")
+    config.addinivalue_line(
+        "markers", "firegate: fire-gated dispatch + piggybacked "
+        "readiness (pipeline.fire-gate / pipeline.readiness, PROFILE.md "
+        "§12) — gate-on/off byte-identity at K∈{1,2,4}, the host-fed "
+        "late-refire gate predicate, readiness-mode parity, and the "
+        "FIRE_GATE_INVALID / READINESS_INVALID analyzer rules (tier-1)")

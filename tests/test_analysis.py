@@ -213,6 +213,25 @@ def _subbatch_indivisible(tmp_path):
         "pipeline.sub-batches": 3}))
 
 
+@seed("FIRE_GATE_INVALID")
+def _fire_gate_off_under_subbatching(tmp_path):
+    # gating forced off under the config that needs it: K sub-batch
+    # dispatches per logical batch each pay the full fire/top-n select
+    # sort (the §8.6 tax)
+    return analyze_config(Configuration({
+        "pipeline.fire-gate": False,
+        "pipeline.sub-batches": 4}))
+
+
+@seed("READINESS_INVALID")
+def _readiness_unknown_mode(tmp_path):
+    # build-rejected config (Driver._build_ops ValueError) must block
+    # at submit under the default fail-on=error — hence error severity,
+    # unlike FIRE_GATE_INVALID's legitimate-A/B warn
+    return analyze_config(Configuration({
+        "pipeline.readiness": "telepathy"}))
+
+
 @seed("DCN_OVERLAP_UNSAFE")
 def _dcn_overlap_without_drain(tmp_path):
     # the loss-tolerant perf trade made silently: overlapped cross-host
