@@ -724,6 +724,122 @@ def checkpoint_in_batch(plan, config) -> Iterable[Finding]:
             fix="drop the restore path, or run in streaming mode")
 
 
+@config_rule("RESCALE_INVALID", "error",
+             fix="make the rescale.* config self-consistent")
+def rescale_invalid(plan, config) -> Iterable[Finding]:
+    """Rescale config that can never work (error) or that will thrash
+    (warn), caught at submit instead of at the first arm:
+
+    - reactive mode without checkpointing is an ERROR: the handshake is
+      savepoint-based (stop-with-savepoint → key-group repartition →
+      redeploy), so the controller would arm rescales whose savepoints
+      the runner rejects, forever.
+    - device bounds that violate the key-group discipline are an
+      ERROR: the per-process shard share must stay divisible by every
+      width the controller may pick, and an empty [min, max] range can
+      pick none.
+    - an inverted pressure band (low >= high) is an ERROR: the
+      hysteresis dead zone is empty, so one sample can sit on both
+      sides and the controller flaps by construction.
+
+    The thrash-but-legal shapes warn instead (RESCALE_COOLDOWN_THRASH
+    below)."""
+    from flink_tpu.config import CheckpointingOptions, RescaleOptions
+
+    mode = str(config.get(RescaleOptions.MODE)).strip().lower()
+    if mode not in ("off", "reactive"):
+        yield _f(
+            f"rescale.mode={mode!r} is not a known mode",
+            fix="use 'off' (manual RPC/CLI only) or 'reactive'")
+        return
+    if mode != "reactive":
+        return
+    interval = int(config.get(CheckpointingOptions.INTERVAL))
+    if interval <= 0:
+        yield _f(
+            "rescale.mode=reactive without checkpointing: the rescale "
+            "handshake is savepoint-based, so every controller-armed "
+            "rescale would dispatch a stop-with-savepoint the runner "
+            "rejects (no checkpoint storage) and disarm — an arm/"
+            "disarm loop that never rescales",
+            fix="set execution.checkpointing.interval (and .dir), or "
+                "rescale.mode=off")
+    hi = float(config.get(RescaleOptions.TARGET_PRESSURE_HIGH))
+    lo = float(config.get(RescaleOptions.TARGET_PRESSURE_LOW))
+    if lo >= hi:
+        yield _f(
+            f"rescale.target-pressure-low={lo:g} >= "
+            f"rescale.target-pressure-high={hi:g}: the hysteresis dead "
+            "zone is empty, so the controller classifies one pressure "
+            "sample as both scale-out and scale-in and flaps",
+            fix="keep low strictly below high (defaults 20/70)")
+    try:
+        shards = int(config.get_raw("state.num-key-shards", 128) or 128)
+    except (TypeError, ValueError):
+        shards = 128
+    nproc = max(1, int(config.get_raw("cluster.num-processes", 1) or 1))
+    share = shards // nproc if shards % nproc == 0 else 0
+    mn = int(config.get(RescaleOptions.MIN_DEVICES))
+    mx = int(config.get(RescaleOptions.MAX_DEVICES))
+    if mn < 1:
+        yield _f(
+            f"rescale.min-devices={mn} is below 1",
+            fix="set rescale.min-devices >= 1")
+    elif mx and mx < mn:
+        yield _f(
+            f"rescale.max-devices={mx} < rescale.min-devices={mn}: "
+            "the legal width range is empty — the controller can "
+            "never pick a target",
+            fix="widen the range (0 max = current fleet capacity)")
+    if share:
+        for opt, v in (("rescale.min-devices", mn),
+                       ("rescale.max-devices", mx)):
+            if v > 0 and share % v != 0:
+                yield _f(
+                    f"{opt}={v} does not divide the per-process shard "
+                    f"share ({shards} shards / {nproc} processes = "
+                    f"{share}): the key-group discipline (contiguous "
+                    "equal ranges per device) is unsatisfiable at that "
+                    "width, so the controller would clamp against a "
+                    "bound it can never reach",
+                    fix=f"pick a divisor of {share} (powers of two "
+                        "divide the default 128)")
+
+
+@config_rule("RESCALE_COOLDOWN_THRASH", "warn",
+             fix="keep rescale.cooldown above "
+                 "execution.checkpointing.interval")
+def rescale_cooldown_thrash(plan, config) -> Iterable[Finding]:
+    """A reactive rescale cooldown below the checkpoint interval: the
+    controller can re-arm before the first post-rescale checkpoint
+    publishes, so every rescale restores from the previous rescale's
+    savepoint floor instead of fresh progress — legal (exactly-once
+    holds), but under sustained pressure the job spends its life
+    savepointing and restoring rather than processing. Warn, not
+    error: a one-shot burst workload may want an aggressive cooldown
+    and accept the tax."""
+    from flink_tpu.config import CheckpointingOptions, RescaleOptions
+
+    mode = str(config.get(RescaleOptions.MODE)).strip().lower()
+    if mode != "reactive":
+        return
+    interval = int(config.get(CheckpointingOptions.INTERVAL))
+    if interval <= 0:
+        return  # RESCALE_INVALID owns the no-checkpointing error
+    cooldown = int(config.get(RescaleOptions.COOLDOWN))
+    if cooldown < interval:
+        yield _f(
+            f"rescale.cooldown={cooldown}ms is below "
+            f"execution.checkpointing.interval={interval}ms: the "
+            "controller can re-arm before the first post-rescale "
+            "checkpoint publishes, so back-to-back rescales keep "
+            "restoring the previous savepoint floor — the job "
+            "thrashes between savepoint and restore under sustained "
+            "pressure",
+            fix=f"set rescale.cooldown >= {interval}ms (and ideally "
+                "several checkpoint intervals)")
+
+
 def load_option_grammar() -> None:
     """Import every module that declares ConfigOptions so the registry
     is complete before a key-validity check (options register at module

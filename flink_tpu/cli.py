@@ -254,6 +254,12 @@ def _session(args) -> int:
             resp = c.call("cancel_job", job_id=args.job_id)
             print(json.dumps(resp))
             return 0 if resp.get("ok") else 1
+        if args.session_cmd == "rescale":
+            resp = c.call("rescale_job", job_id=args.job_id,
+                          devices=args.devices,
+                          processes=args.processes)
+            print(json.dumps(resp))
+            return 0 if resp.get("ok") else 1
         # stop
         resp = c.call("stop_session")
         print(json.dumps(resp))
@@ -496,6 +502,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     sc.add_argument("--ha-dir", default=None, metavar="DIR",
                     help=_HA_HELP)
     sc.add_argument("job_id")
+    sr = ssub.add_parser(
+        "rescale", help="live-rescale one session job: savepoint + "
+                        "restart at a new device width / process count "
+                        "(exit 0 = dispatched, 1 = refused)")
+    sr.add_argument("--session", metavar="HOST:PORT")
+    sr.add_argument("--ha-dir", default=None, metavar="DIR",
+                    help=_HA_HELP)
+    sr.add_argument("--devices", type=int, required=True,
+                    help="per-process mesh width after the rescale")
+    sr.add_argument("--processes", type=int, default=None, metavar="M",
+                    help="host-process count after the rescale "
+                         "(default: keep the current count)")
+    sr.add_argument("job_id")
     sp_ = ssub.add_parser(
         "stop", help="shut the cluster down (cancels every "
                      "non-terminal job, then the dispatcher exits)")
@@ -566,9 +585,15 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     rs = sub.add_parser("rescale",
                         help="savepoint + restart the job at a new "
-                             "device width")
+                             "device width (and optionally a new "
+                             "process count — the restore repartitions "
+                             "every keyed op's key-group ranges)")
     rs.add_argument("--coordinator", required=True, metavar="HOST:PORT")
-    rs.add_argument("--devices", type=int, required=True)
+    rs.add_argument("--devices", type=int, required=True,
+                    help="per-process mesh width after the rescale")
+    rs.add_argument("--processes", type=int, default=None, metavar="M",
+                    help="host-process count after the rescale "
+                         "(default: keep the current count)")
     rs.add_argument("job_id")
 
     args = p.parse_args(argv)
@@ -683,7 +708,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             resp = c.call("trigger_savepoint", job_id=args.job_id)
         elif args.cmd == "rescale":
             resp = c.call("rescale_job", job_id=args.job_id,
-                          devices=args.devices)
+                          devices=args.devices,
+                          processes=args.processes)
         else:  # pragma: no cover
             raise SystemExit(f"unknown command {args.cmd}")
     finally:

@@ -705,6 +705,19 @@ class ClusterOptions:
         "'coordinator' lets a multi-process job discover DCN peers "
         "through the coordinator instead of a static cluster.dcn-peers "
         "list; stamped into the attempt config at deploy.")
+    RESCALE_FROM = ConfigOption(
+        "cluster.rescale-from", "",
+        "Deploy-injected by the coordinator after a process-level "
+        "rescale: the savepoint path (p0's, for multi-process "
+        "savepoints) the new topology was restored from. When a later "
+        "attempt restores with execution.checkpointing.restore=latest "
+        "and finds NO checkpoint newer than this savepoint (or none at "
+        "all — the crash landed before the first post-rescale "
+        "checkpoint published), the driver falls back to this path so "
+        "recovery never resurrects a pre-rescale checkpoint written "
+        "for the OLD key-group ownership, and never replays from "
+        "scratch duplicating committed output. User configs never set "
+        "it.")
     RESTART_STRATEGY = ConfigOption(
         "restart-strategy.type", "exponential-delay",
         "fixed-delay | exponential-delay | failure-rate | none (ref: "
@@ -839,6 +852,61 @@ class SessionOptions:
         "preserved). Lower it when runners re-resolve the leader fast "
         "(small heartbeat.interval); raise it on congested fleets "
         "where a blind double-deploy is costlier than a slow failover.")
+
+
+class RescaleOptions:
+    """Reactive elastic rescaling (runtime/coordinator.py, ref: the
+    AdaptiveScheduler / reactive mode, FLIP-159/160): the coordinator
+    watches the heartbeat-carried backpressure/drain gauges and, when
+    pressure stays outside the configured band for a sustained window,
+    arms the SAME stop-with-savepoint → repartition → redeploy
+    handshake `rescale JOB --devices N` drives manually. Key-group
+    discipline (state.num-key-shards at a fixed max-parallelism) makes
+    the N→M state move legal; cooldown + the two-sided band give
+    hysteresis, so the controller cannot flap by construction."""
+
+    MODE = ConfigOption(
+        "rescale.mode", "off",
+        "'off' (default) = rescale only via the manual RPC/CLI; "
+        "'reactive' = the coordinator's policy loop arms rescales "
+        "automatically from observed pressure. Reactive mode requires "
+        "checkpointing (the handshake is savepoint-based) — the plan "
+        "analyzer rejects it otherwise (RESCALE_INVALID).")
+    TARGET_PRESSURE_HIGH = ConfigOption(
+        "rescale.target-pressure-high", 70,
+        "Upper bound of the target pressure band, in percent of the "
+        "job's max(backpressure_pct, drain_busy_pct) heartbeat gauge. "
+        "Pressure sustained ABOVE it arms a scale-OUT to the next "
+        "legal width (divisibility-preserving doubling, clamped by "
+        "rescale.max-devices).")
+    TARGET_PRESSURE_LOW = ConfigOption(
+        "rescale.target-pressure-low", 20,
+        "Lower bound of the band: pressure sustained BELOW it arms a "
+        "scale-IN to the previous legal width (halving, floored at "
+        "rescale.min-devices). The gap between low and high is the "
+        "hysteresis dead zone — a signal oscillating inside it never "
+        "triggers.")
+    SUSTAINED_WINDOW = duration_option(
+        "rescale.sustained-window", 30_000,
+        "How long pressure must stay continuously outside the band "
+        "before the controller arms a rescale. One in-band sample "
+        "resets the clock, so transient spikes (a slow checkpoint, a "
+        "GC pause) never rescale the job.")
+    COOLDOWN = duration_option(
+        "rescale.cooldown", 120_000,
+        "Minimum time between controller-armed rescales of one job, "
+        "measured from the last rescale COMPLETING (redeploy at the "
+        "new width). Keep it above the checkpoint interval — a "
+        "cooldown shorter than execution.checkpointing.interval "
+        "re-arms before the first post-rescale checkpoint publishes "
+        "(RESCALE_INVALID warns).")
+    MIN_DEVICES = ConfigOption(
+        "rescale.min-devices", 1,
+        "Floor the reactive controller never scales below.")
+    MAX_DEVICES = ConfigOption(
+        "rescale.max-devices", 0,
+        "Ceiling the reactive controller never scales above. 0 = the "
+        "job's current fleet capacity (largest registered runner).")
 
 
 class AnalysisOptions:

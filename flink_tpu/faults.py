@@ -140,6 +140,14 @@ Instrumented points (the stack's recovery-critical seams):
         a drop/raise is a lost re-attach — the next heartbeat miss
         retries, so live executions still re-adopt instead of being
         redeployed blind)
+    rescale.arm / rescale.savepoint / rescale.redeploy
+                                               runtime/coordinator.py
+        (the three phases of the live-rescale handshake: arming the
+        durable intent, pushing the stop-with-savepoint triggers, and
+        redeploying at the new width after the savepoints land — a
+        raise/crash at each is a coordinator dying mid-phase, the
+        chaos gates proving a takeover resumes or cleanly disarms an
+        in-flight rescale and the job is never stranded)
 
 Job-scoped plans (the session-cluster isolation contract): a runner
 process hosting N concurrent jobs cannot use the process-global plan —
@@ -225,6 +233,9 @@ KNOWN_FAULT_POINTS = frozenset((
     "ha.store.write",
     "session.failover.takeover",
     "runner.reattach",
+    "rescale.arm",
+    "rescale.savepoint",
+    "rescale.redeploy",
 ))
 
 # process-global fault/recovery metrics — chaos tests assert every

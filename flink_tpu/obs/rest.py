@@ -126,6 +126,7 @@ class RestServer:
                     st = self._call("job_status", job_id=parts[1])
                     g["state"] = st.get("state")
                     g["metrics"] = st.get("metrics")
+                    g["rescale"] = st.get("rescale")
                     return 200, g
                 if parts == ["taskmanagers"]:
                     return 200, {"taskmanagers": self._call("list_runners")}
@@ -156,8 +157,14 @@ class RestServer:
                         devices = int(q.get("devices", [""])[0])
                     except ValueError:
                         return 400, {"error": "rescale needs devices=N"}
+                    try:
+                        processes = (int(q["processes"][0])
+                                     if "processes" in q else None)
+                    except ValueError:
+                        return 400, {"error": "processes must be an int"}
                     resp = self._call("rescale_job", job_id=parts[1],
-                                      devices=devices)
+                                      devices=devices,
+                                      processes=processes)
                     return (202 if resp.get("ok") else 409), resp
                 return 400, {"error": f"unsupported mode {mode!r}"}
             if (method == "POST" and len(parts) == 3 and parts[0] == "jobs"
@@ -247,6 +254,21 @@ async function tick(){
         '<i style="width:'+bp+'%"></i></span> '+bp+
         "% &nbsp; drain link: <span class=\"gauge\">"+
         '<i style="width:'+dp+'%"></i></span> '+dp+"%</div>";
+      const rc=g.rescale||{};const rm=rc.metrics||{};
+      if(rc.pending_devices!=null){
+        html+='<div class="kv">rescale: <b>in flight</b> &#8594; '+
+          rc.pending_devices+' dev &times; '+
+          (rc.pending_processes||1)+' proc ('+
+          (rc.savepoints_collected||0)+' savepoints in)</div>';
+      }else if(rm["coordinator.rescale.armed"]){
+        html+='<div class="kv">rescale: '+
+          rm["coordinator.rescale.armed"]+' armed / '+
+          (rm["coordinator.rescale.redeploy"]||0)+' completed / '+
+          (rm["coordinator.rescale.disarmed"]||0)+
+          ' disarmed &nbsp; time-to-rescale p50: '+
+          Math.round(rm["coordinator.rescale.duration_ms.p50"]||0)+
+          'ms</div>';
+      }
       if(m.checkpoints&&m.checkpoints.length){
         html+="<table><tr><th>checkpoint</th><th>time</th>"+
           "<th>size</th></tr>"+m.checkpoints.map(c=>

@@ -67,6 +67,21 @@ class JobInfo:
     pending_rescale: Optional[int] = None
     rescale_token: Optional[str] = None
     restore_path: Optional[str] = None
+    # process-level rescale: target host-process count (None = keep the
+    # current cluster.num-processes), and the per-process savepoint
+    # paths collected so far — a cross-host rescale consumes only once
+    # EVERY process's savepoint has landed (the paths travel to the new
+    # topology via cluster.rescale-from for the key-group repartition)
+    pending_rescale_procs: Optional[int] = None
+    rescale_paths: List[str] = dataclasses.field(default_factory=list)
+    # time-to-rescale clock: stamped at arm, observed into the
+    # rescale.duration_ms histogram when the redeploy lands
+    rescale_started_at: Optional[float] = None
+    last_rescale_done_at: Optional[float] = None
+    # reactive controller bookkeeping: when the pressure signal left
+    # the target band, and on which side (one in-band sample resets it)
+    pressure_out_since: Optional[float] = None
+    pressure_side: Optional[str] = None
     # scale-in drain: runner the post-savepoint redeploy must avoid
     drain_exclude: Optional[str] = None
     # per-runner completion of the CURRENT attempt: the job finishes
@@ -119,6 +134,22 @@ class JobCoordinator(RpcEndpoint):
         from flink_tpu.runtime.provisioner import StandaloneProvisioner
 
         self.provisioner = StandaloneProvisioner()
+        # coordinator-scoped metrics (the SessionDispatcher adds its
+        # session-plane gauges to the SAME registry, so session info /
+        # REST surface both). Time-to-rescale + per-phase counters live
+        # here: the handshake spans attempts and runners, so only the
+        # coordinator can clock it end to end.
+        from flink_tpu.obs.metrics import MetricRegistry
+
+        self.registry = MetricRegistry()
+        g = self.registry.group("coordinator", "rescale")
+        self._m_rescale = {
+            "armed": g.counter("armed"),
+            "savepoint": g.counter("savepoint"),
+            "redeploy": g.counter("redeploy"),
+            "disarmed": g.counter("disarmed"),
+            "duration_ms": g.histogram("duration_ms"),
+        }
         # (job_id, attempt) -> {process_id: "host:port"} — the DCN
         # exchange rendezvous for cross-host jobs
         self._dcn_table: Dict[tuple, Dict[int, str]] = {}
@@ -192,6 +223,21 @@ class JobCoordinator(RpcEndpoint):
                 egraph=ExecutionGraph(job_id, required))
             if rec.get("submitted_at") is not None:
                 j.submitted_at = float(rec["submitted_at"])
+            rsc = rec.get("rescale")
+            if rsc and was_live:
+                # re-arm the stored in-flight rescale: once the runner
+                # re-attaches the live execution, _reattach_locked
+                # re-triggers the stop-with-savepoint under the SAME
+                # token (the runner's dedup absorbs the duplicate); a
+                # redeploy path instead disarms it in _deploy — the
+                # savepoint died with the attempt
+                j.pending_rescale = (int(rsc["devices"])
+                                     if rsc.get("devices") else None)
+                j.pending_rescale_procs = rsc.get("processes")
+                j.rescale_token = rsc.get("token")
+                j.rescale_paths = list(rsc.get("paths") or [])
+                j.rescale_started_at = rsc.get("started_at")
+                j.drain_exclude = rsc.get("drain_exclude")
             if was_live:
                 j.reattach_attempt = stored_attempts
                 j.reattach_until = now + grace
@@ -220,11 +266,41 @@ class JobCoordinator(RpcEndpoint):
             return
         if j.entry is None:
             return  # bookkeeping-only jobs are not recoverable
+        # an armed rescale rides the record: a dispatcher takeover must
+        # resume (or cleanly disarm) the in-flight handshake, never
+        # forget it with the dead leader's memory
+        rescale = None
+        if j.pending_rescale is not None:
+            rescale = {"devices": j.pending_rescale,
+                       "processes": j.pending_rescale_procs,
+                       "token": j.rescale_token,
+                       "paths": list(j.rescale_paths),
+                       "started_at": j.rescale_started_at,
+                       "drain_exclude": j.drain_exclude}
         self._store.put(j.job_id, entry=j.entry, config=j.config,
                         state=j.state, attempts=j.attempts,
                         py_blobs=j.py_blobs,
                         submitted_at=j.submitted_at,
-                        assigned_runners=j.assigned_runners)
+                        assigned_runners=j.assigned_runners,
+                        rescale=rescale)
+
+    def _disarm_rescale_locked(self, j: JobInfo,
+                               persist: bool = True) -> None:
+        """Clear an armed-but-unconsumed rescale (lock held). Every
+        disarm path funnels here so the phase counter and the durable
+        record stay truthful; a consumed rescale (savepoints landed,
+        redeploy dispatched) is NOT a disarm and never calls this."""
+        if j.pending_rescale is None and j.rescale_token is None:
+            return
+        j.pending_rescale = None
+        j.rescale_token = None
+        j.pending_rescale_procs = None
+        j.rescale_paths = []
+        j.rescale_started_at = None
+        j.drain_exclude = None
+        self._m_rescale["disarmed"].inc()
+        if persist:
+            self._persist_locked(j)
 
     # -- rpc methods -----------------------------------------------------
     def rpc_register_runner(self, runner_id: str, host: str, n_devices: int,
@@ -319,6 +395,21 @@ class JobCoordinator(RpcEndpoint):
                     j.egraph.start_attempt(j.attempts, runner_id)
                     j.egraph.transition("RUNNING", attempt=j.attempts)
                 self._persist_locked(j)
+                if j.pending_rescale is not None and j.rescale_token:
+                    # resume the takeover-recovered rescale: re-trigger
+                    # the stop-with-savepoint under the stored token
+                    # once the re-adopted execution is RUNNING. Same
+                    # token pending on the runner → idempotent ack; an
+                    # already-completed-but-unreported savepoint →
+                    # a fresh one supersedes it. Off-thread: we hold
+                    # the coordinator lock here.
+                    tok = j.rescale_token
+                    t = threading.Timer(
+                        0.3, self.rpc_trigger_savepoint,
+                        args=(j.job_id,),
+                        kwargs={"stop": True, "token": tok})
+                    t.daemon = True
+                    t.start()
             elif runner_id in j.reattach_runners:
                 j.reattach_attempt = None
                 j.reattach_until = None
@@ -465,6 +556,12 @@ class JobCoordinator(RpcEndpoint):
                 j.state = "WAITING_FOR_RESOURCES"
                 j.failure = self._admit_refusal(j)
                 return
+            # a rescale still ARMED when a redeploy proceeds is stale:
+            # the stop-with-savepoint it was waiting on died with the
+            # old attempt (runner loss, reattach expiry) — recovery
+            # keeps the old width and the intent disarms cleanly
+            if j.pending_rescale is not None:
+                self._disarm_rescale_locked(j, persist=False)
             # slot allocation: best-fit over free device counts; a retry
             # releases the previous allocation first (ref:
             # ExecutionSlotAllocator + FineGrainedSlotManager matching).
@@ -546,9 +643,13 @@ class JobCoordinator(RpcEndpoint):
             # job's own config, untouched
             config = self._deploy_config_locked(j, dict(j.config), target)
             blobs = list(j.py_blobs)
+            rescale_deploy = j.rescale_started_at is not None
             if j.restore_path:
                 # one-shot explicit restore (rescale savepoint); a later
-                # crash-recovery falls back to 'latest' as usual
+                # crash-recovery falls back to 'latest' as usual — and
+                # cluster.rescale-from (stamped at consume) floors that
+                # fallback at the savepoint, so a crash in this window
+                # can never resurrect a pre-rescale checkpoint
                 config["execution.checkpointing.restore"] = j.restore_path
                 j.restore_path = None
             elif attempt > 1:
@@ -572,6 +673,16 @@ class JobCoordinator(RpcEndpoint):
             # (epoch > 0) so non-HA wire traffic is unchanged.
             fence = ({"leader_epoch": self.leader_epoch}
                      if self.leader_epoch > 0 else {})
+            if rescale_deploy:
+                from flink_tpu import faults
+
+                # the redeploy phase of the rescale handshake: a crash
+                # here is the coordinator dying between consuming the
+                # savepoints and pushing the new topology — the durable
+                # RESTARTING record + cluster.rescale-from carry the
+                # takeover; a raise routes through the normal deploy
+                # failure handling (retry / park)
+                faults.fire("rescale.redeploy", exc=RpcError, job=job_id)
             for i, t in enumerate(push_targets):
                 deploy_target = t
                 pconf = dict(config)
@@ -609,6 +720,12 @@ class JobCoordinator(RpcEndpoint):
                 jj = self.jobs.get(job_id)
                 if jj is not None and jj.egraph is not None:
                     jj.egraph.transition("RUNNING", attempt=attempt)
+                if jj is not None and jj.rescale_started_at is not None:
+                    # time-to-rescale: arm → new topology accepted
+                    self._m_rescale["duration_ms"].update(
+                        (time.time() - jj.rescale_started_at) * 1000.0)
+                    jj.rescale_started_at = None
+                    jj.last_rescale_done_at = time.time()
         except (RpcError, ConnectionError) as e:
             # ConnectionError too (the PR-11 flake class): faults
             # `drop`-kind rules raise ConnectionError, NOT RpcError —
@@ -644,9 +761,19 @@ class JobCoordinator(RpcEndpoint):
                                 "attempts": rec.get("attempts", 0),
                                 "failure": None, "archived": True}
                 return {"state": "UNKNOWN"}
+            rescale = {
+                "pending_devices": j.pending_rescale,
+                "pending_processes": j.pending_rescale_procs,
+                "savepoints_collected": len(j.rescale_paths),
+                "last_completed_at": j.last_rescale_done_at,
+                "metrics": {
+                    k: v for k, v in self.registry.snapshot().items()
+                    if k.startswith("coordinator.rescale.")},
+            }
             return {"state": j.state, "attempts": j.attempts,
                     "failure": j.failure,
                     "last_savepoint": getattr(j, "last_savepoint", None),
+                    "rescale": rescale,
                     "metrics": getattr(j, "last_metrics", None)}
 
     def _job_runners_locked(self, j: "JobInfo") -> List["RunnerInfo"]:
@@ -669,8 +796,7 @@ class JobCoordinator(RpcEndpoint):
                     "RUNNING", "RESTARTING", "WAITING_FOR_RESOURCES"):
                 j.state = "CANCELED"
                 j.finished_at = time.time()
-                j.pending_rescale = None
-                j.rescale_token = None
+                self._disarm_rescale_locked(j, persist=False)
                 # a cancel during the takeover re-attach window closes
                 # it: the returning runner's inventory must not
                 # resurrect the job, and the monitor must not kick a
@@ -743,8 +869,7 @@ class JobCoordinator(RpcEndpoint):
             if j is not None and j.state in ("RUNNING", "RESTARTING"):
                 j.state = "FINISHED"
                 j.finished_at = time.time()
-                j.pending_rescale = None
-                j.rescale_token = None
+                self._disarm_rescale_locked(j, persist=False)
                 self._slots.release(job_id)
                 if j.egraph is not None:
                     j.egraph.transition("FINISHED")
@@ -787,8 +912,7 @@ class JobCoordinator(RpcEndpoint):
         # an armed-but-unfinished rescale dies with the attempt: the
         # recovery deploy keeps the old width, and a routine savepoint
         # days later must not consume a stale rescale request
-        j.pending_rescale = None
-        j.rescale_token = None
+        self._disarm_rescale_locked(j, persist=False)
         if j.state == "RESTARTING" and j.entry is not None:
             # one incident, one restart (coordinator-DEPLOYED jobs only —
             # _deploy owns the RESTARTING→RUNNING transition): the
@@ -846,30 +970,58 @@ class JobCoordinator(RpcEndpoint):
         def push() -> None:
             from flink_tpu.runtime.rpc import RpcClient, RpcError
 
-            for r in targets:
-                try:
-                    c = RpcClient(r.host, r.port, timeout_s=5.0)
+            # a cross-host job's savepoint must trigger on EVERY
+            # process (the DCN all-set consensus fires it only once all
+            # of them carry the request; a first-acceptor return would
+            # leave N-1 untriggered and the savepoint would never
+            # fire). Single-runner jobs keep first-acceptor semantics —
+            # the two are the same thing at N=1.
+            require_all = len(targets) > 1
+            accepted = 0
+            try:
+                if token is not None:
+                    from flink_tpu import faults
+
+                    # the savepoint phase of the rescale handshake: a
+                    # crash here is the coordinator dying with the
+                    # intent durable but the triggers (partially)
+                    # undispatched — takeover re-triggers under the
+                    # same token
+                    faults.fire("rescale.savepoint", exc=RpcError,
+                                job=job_id)
+                for r in targets:
                     try:
-                        resp = c.call("trigger_savepoint", job_id=job_id,
-                                      stop=stop, token=token, **fence)
-                    finally:
-                        c.close()
-                    if resp.get("ok"):
-                        return
-                except RpcError:
-                    continue
-            # NO runner accepted (e.g. checkpointing not configured):
-            # savepoint_complete will never arrive. Disarm ONLY when
-            # this push WAS the rescale's own savepoint (token match) —
-            # an unrelated routine savepoint failing must not kill an
-            # in-flight rescale
+                        c = RpcClient(r.host, r.port, timeout_s=5.0)
+                        try:
+                            resp = c.call(
+                                "trigger_savepoint", job_id=job_id,
+                                stop=stop, token=token, **fence)
+                        finally:
+                            c.close()
+                        if resp.get("ok"):
+                            accepted += 1
+                            if not require_all:
+                                return
+                    except RpcError:
+                        if require_all:
+                            break
+                        continue
+            except (RpcError, ConnectionError):
+                accepted = 0
+            if require_all and accepted == len(targets):
+                return
+            # not every needed runner accepted (e.g. checkpointing not
+            # configured): savepoint_complete will never arrive (or
+            # never on all processes). Disarm ONLY when this push WAS
+            # the rescale's own savepoint (token match) — an unrelated
+            # routine savepoint failing must not kill an in-flight
+            # rescale
             if token is None:
                 return
             with self._lock:
                 jj = self.jobs.get(job_id)
                 if jj is not None and jj.rescale_token == token:
-                    jj.pending_rescale = None
-                    jj.rescale_token = None
+                    self._disarm_rescale_locked(jj)
 
         threading.Thread(target=push, daemon=True).start()
         return {"ok": True, "dispatched": True,
@@ -944,6 +1096,16 @@ class JobCoordinator(RpcEndpoint):
         snap["found"] = True
         return snap
 
+    @staticmethod
+    def _savepoint_pid(path: str) -> int:
+        """Process id a per-process savepoint path belongs to (the
+        driver's per-pid storage name ``<job>-p<K>``; a single-process
+        savepoint has no suffix → pid 0)."""
+        import re as _re
+
+        m = _re.findall(r"-p(\d+)/", path.replace(os.sep, "/"))
+        return int(m[-1]) if m else 0
+
     def rpc_savepoint_complete(self, job_id: str, path: str,
                                token: Optional[str] = None) -> dict:
         rescale_targets: List[RunnerInfo] = []
@@ -954,17 +1116,40 @@ class JobCoordinator(RpcEndpoint):
             j.last_savepoint = path
             if (j.pending_rescale is not None and j.state == "RUNNING"
                     and token is not None and token == j.rescale_token):
-                # rescale phase 2: savepoint durable → stop the old
-                # width, redeploy at the new one restoring from it
-                # (ref: AdaptiveScheduler rescale = savepoint + restart
-                # with re-split key-group ranges; the reshard happens in
-                # the state restore path)
+                # rescale savepoint landed on ONE process. A cross-host
+                # job consumes only once every process's savepoint is
+                # durable — each process snapshots its own key-group
+                # range, and the repartition needs all of them
+                self._m_rescale["savepoint"].inc()
+                if path not in j.rescale_paths:
+                    j.rescale_paths.append(path)
+                nproc_old = max(
+                    1, int(j.config.get("cluster.num-processes", 1)))
+                if len(j.rescale_paths) < nproc_old:
+                    self._persist_locked(j)  # partial set is durable
+                    return {"ok": True, "pending_savepoints":
+                            nproc_old - len(j.rescale_paths)}
+                # rescale phase 2: all savepoints durable → stop the
+                # old topology, redeploy at the new one restoring from
+                # them (ref: AdaptiveScheduler rescale = savepoint +
+                # restart with re-split key-group ranges; the reshard
+                # happens in the state restore path)
                 new = j.pending_rescale
+                new_procs = j.pending_rescale_procs or nproc_old
+                paths = sorted(j.rescale_paths, key=self._savepoint_pid)
                 j.pending_rescale = None
                 j.rescale_token = None
+                j.pending_rescale_procs = None
+                j.rescale_paths = []
                 j.required_devices = new
                 j.config["cluster.mesh-devices"] = str(new)
-                j.restore_path = path
+                j.config["cluster.num-processes"] = new_procs
+                # every new process restores from paths[0] and finds
+                # its siblings (old pids 1..N-1) here; doubles as the
+                # restore=latest fallback FLOOR for a crash before the
+                # first post-rescale checkpoint publishes
+                j.config["cluster.rescale-from"] = ",".join(paths)
+                j.restore_path = paths[0]
                 j.state = "RESTARTING"
                 old_attempt = j.attempts
                 j.attempts += 1
@@ -982,17 +1167,30 @@ class JobCoordinator(RpcEndpoint):
             # same runner before this cancel does
             self._push_cancel_async(r, job_id, attempt=old_attempt)
         if redeploy:
+            self._m_rescale["redeploy"].inc()
             self._deploy_async(job_id, delay_s=0.2)
         return {"ok": True}
 
-    def rpc_rescale_job(self, job_id: str, devices: int) -> dict:
+    def rpc_rescale_job(self, job_id: str, devices: int,
+                        processes: Optional[int] = None) -> dict:
         """Live rescale: savepoint → stop → restore at the new width
-        (ref: the REST rescale endpoint / reactive mode). The ack means
-        the rescale is DISPATCHED; progress shows in job_status (state
-        RESTARTING once the savepoint lands, RUNNING at the new width
-        after redeploy)."""
+        (ref: the REST rescale endpoint / reactive mode). ``devices``
+        is the PER-PROCESS mesh width; ``processes`` changes the
+        host-process count (N→M key-group repartition on restore),
+        None keeps it. The ack means the rescale is DISPATCHED;
+        progress shows in job_status (state RESTARTING once the
+        savepoints land, RUNNING at the new topology after redeploy).
+        The target must keep the key-group discipline legal:
+        num-key-shards % processes == 0 and the per-process shard
+        share % devices == 0 — the same contract hybrid_route enforces
+        at runtime, refused here before any state moves."""
+        from flink_tpu import faults
+        from flink_tpu.runtime.rpc import RpcError
+
         if devices < 1:
             return {"ok": False, "reason": "devices must be >= 1"}
+        if processes is not None and processes < 1:
+            return {"ok": False, "reason": "processes must be >= 1"}
         with self._lock:
             j = self.jobs.get(job_id)
             if j is None or j.entry is None or j.state != "RUNNING":
@@ -1000,11 +1198,53 @@ class JobCoordinator(RpcEndpoint):
                         "reason": "job not running (or not deployable)"}
             if j.pending_rescale is not None:
                 return {"ok": False, "reason": "rescale already in flight"}
+            nproc_old = max(
+                1, int(j.config.get("cluster.num-processes", 1)))
+            procs = int(processes) if processes is not None else nproc_old
+            try:
+                shards = int(j.config.get("state.num-key-shards", 128)
+                             or 128)
+            except (TypeError, ValueError):
+                shards = 128
+            if shards % procs != 0:
+                return {"ok": False, "reason":
+                        f"state.num-key-shards ({shards}) is not "
+                        f"divisible by {procs} processes — key-group "
+                        "ranges cannot be contiguous"}
+            if (shards // procs) % devices != 0:
+                return {"ok": False, "reason":
+                        f"per-process shard share ({shards // procs}) "
+                        f"is not divisible by {devices} devices"}
+            if procs > 1:
+                fleet = [r for r in self.runners.values()
+                         if r.alive and not r.draining
+                         and r.n_devices >= devices]
+                if len(fleet) < procs:
+                    return {"ok": False, "reason":
+                            f"need {procs} runners with >= {devices} "
+                            f"devices, have {len(fleet)}"}
             import uuid as _uuid
 
             token = f"rescale-{_uuid.uuid4().hex[:12]}"
             j.pending_rescale = devices
+            j.pending_rescale_procs = procs
             j.rescale_token = token
+            j.rescale_paths = []
+            j.rescale_started_at = time.time()
+            self._m_rescale["armed"].inc()
+            # durable BEFORE the trigger dispatch: a takeover from here
+            # on resumes (or cleanly disarms) the handshake
+            self._persist_locked(j)
+        try:
+            # the arm phase of the handshake: a crash here is the
+            # coordinator dying right after the intent became durable
+            faults.fire("rescale.arm", exc=RpcError, job=job_id)
+        except (RpcError, ConnectionError) as e:
+            with self._lock:
+                jj = self.jobs.get(job_id)
+                if jj is not None and jj.rescale_token == token:
+                    self._disarm_rescale_locked(jj)
+            return {"ok": False, "reason": f"arm failed: {e}"}
         # stop-with-savepoint (ref: `flink stop --savepoint`): the old
         # attempt halts the moment the savepoint is durable, so it
         # cannot keep committing past the state the new width restores
@@ -1013,10 +1253,10 @@ class JobCoordinator(RpcEndpoint):
             with self._lock:
                 jj = self.jobs.get(job_id)
                 if jj is not None and jj.rescale_token == token:
-                    jj.pending_rescale = None
-                    jj.rescale_token = None
+                    self._disarm_rescale_locked(jj)
             return resp
-        return {"ok": True, "dispatched": True, "devices": devices}
+        return {"ok": True, "dispatched": True, "devices": devices,
+                "processes": procs}
 
     def rpc_dcn_register(self, job_id: str, attempt: int, process_id: int,
                          host: str, port: int) -> dict:
@@ -1067,8 +1307,12 @@ class JobCoordinator(RpcEndpoint):
                     continue  # an in-flight rescale already moves it
                 token = f"drain-{_uuid.uuid4().hex[:12]}"
                 j.pending_rescale = j.required_devices  # same width
+                j.pending_rescale_procs = None  # keep process count
                 j.rescale_token = token
+                j.rescale_paths = []
+                j.rescale_started_at = time.time()
                 j.drain_exclude = runner_id
+                self._persist_locked(j)
                 victims.append((job_id, token))
         dispatched = []
         for job_id, token in victims:
@@ -1080,9 +1324,7 @@ class JobCoordinator(RpcEndpoint):
                 with self._lock:
                     jj = self.jobs.get(job_id)
                     if jj is not None and jj.rescale_token == token:
-                        jj.pending_rescale = None
-                        jj.rescale_token = None
-                        jj.drain_exclude = None
+                        self._disarm_rescale_locked(jj)
         return {"ok": True, "draining": runner_id,
                 "moving_jobs": dispatched}
 
@@ -1132,6 +1374,107 @@ class JobCoordinator(RpcEndpoint):
                                         r.runner_id))
             for job_id, delay_ms, lost in redeploys:
                 self._deploy_async(job_id, delay_ms / 1000, exclude=[lost])
+            self._rescale_tick()
+
+    # -- reactive rescale controller --------------------------------------
+    def _rescale_tick(self, now: Optional[float] = None) -> None:
+        """One evaluation of the reactive rescale policy (ref: the
+        AdaptiveScheduler / reactive mode resource-driven rescaling,
+        driven here by OBSERVED load): for every RUNNING job whose
+        config opts in (rescale.mode: reactive), compare the heartbeat-
+        carried pressure signal — max(backpressure_pct, drain_busy_pct),
+        the PR-15 phase accounting — against the target band.
+
+        No flapping by construction: (1) the two-sided band is a
+        hysteresis dead zone — a signal oscillating inside it never
+        triggers, and ONE in-band sample resets the sustained clock;
+        (2) pressure must stay outside the band continuously for
+        rescale.sustained-window; (3) rescale.cooldown gates re-arming
+        from the last COMPLETED rescale; (4) scale-out targets the next
+        divisibility-legal width (doubling) and defers while the fleet
+        has queued demand (the autoscaler's queue-depth signal — a
+        scale-out that would starve parked jobs waits its turn).
+
+        ``now`` is injectable for deterministic controller tests."""
+        from flink_tpu.config import RescaleOptions
+
+        now = time.time() if now is None else now
+        arm: List[tuple] = []
+        with self._lock:
+            queued = len(self._waiting_locked())
+            for j in self.jobs.values():
+                if j.entry is None or j.state != "RUNNING":
+                    continue
+                conf = Configuration(j.config)
+                if str(conf.get(RescaleOptions.MODE)).strip() != "reactive":
+                    continue
+                if j.pending_rescale is not None:
+                    continue  # handshake already in flight
+                m = j.last_metrics or {}
+                try:
+                    pressure = max(
+                        float(m.get("backpressure_pct") or 0.0),
+                        float(m.get("drain_busy_pct") or 0.0))
+                except (TypeError, ValueError):
+                    pressure = None
+                if not m or pressure is None:
+                    j.pressure_out_since = None
+                    j.pressure_side = None
+                    continue
+                hi = float(conf.get(RescaleOptions.TARGET_PRESSURE_HIGH))
+                lo = float(conf.get(RescaleOptions.TARGET_PRESSURE_LOW))
+                side = ("high" if pressure > hi
+                        else "low" if pressure < lo else None)
+                if side is None:
+                    j.pressure_out_since = None
+                    j.pressure_side = None
+                    continue
+                if side != j.pressure_side:
+                    j.pressure_side = side
+                    j.pressure_out_since = now
+                    continue
+                sustained = conf.get(
+                    RescaleOptions.SUSTAINED_WINDOW) / 1000.0
+                if now - (j.pressure_out_since or now) < sustained:
+                    continue
+                cooldown = conf.get(RescaleOptions.COOLDOWN) / 1000.0
+                anchor = (j.last_rescale_done_at or j.started_at
+                          or j.submitted_at)
+                if now - anchor < cooldown:
+                    continue
+                cur = j.required_devices
+                if cur == SlotPool.ALL:
+                    continue  # 'all' width is not reactively resizable
+                nproc = max(
+                    1, int(j.config.get("cluster.num-processes", 1)))
+                try:
+                    shards = int(j.config.get("state.num-key-shards",
+                                              128) or 128)
+                except (TypeError, ValueError):
+                    shards = 128
+                share = shards // max(1, nproc)
+                mn = max(1, int(conf.get(RescaleOptions.MIN_DEVICES)))
+                mx = int(conf.get(RescaleOptions.MAX_DEVICES)) or max(
+                    (r.n_devices for r in self.runners.values()
+                     if r.alive), default=cur)
+                if side == "high":
+                    if queued > 0:
+                        continue
+                    target = cur * 2
+                    if target > mx or share % target != 0:
+                        continue
+                else:
+                    target = max(1, cur // 2)
+                    if (target < mn or target == cur
+                            or share % target != 0):
+                        continue
+                j.pressure_out_since = None
+                j.pressure_side = None
+                arm.append((j.job_id, target))
+        for job_id, target in arm:
+            # outside the lock: arming runs the full manual-RPC path
+            # (validation, durable intent, stop-with-savepoint)
+            self.rpc_rescale_job(job_id, devices=target)
 
     def close(self) -> None:
         self._closed = True

@@ -509,7 +509,21 @@ class Driver:
                 and (staged := n.sink.snapshot_staged()) is not None
             },
             "metrics": dict(self.metrics),
+            # key-group identity of the writing process: restore checks
+            # it against the restoring process's shape and routes a
+            # mismatch through checkpoint/repartition.py (the
+            # StateAssignmentOperation role — see _load_repartitioned)
+            "rescale": self._rescale_identity(),
         }
+
+    def _rescale_identity(self) -> Dict[str, Any]:
+        nproc = int(self.config.get(ClusterOptions.NUM_PROCESSES))
+        pid = (int(self.config.get(ClusterOptions.PROCESS_ID))
+               if nproc > 1 else 0)
+        num_shards = int(self.config.get(StateOptions.NUM_KEY_SHARDS))
+        spp = num_shards // max(nproc, 1)
+        return {"nproc": nproc, "pid": pid, "num_shards": num_shards,
+                "shard_range": [pid * spp, (pid + 1) * spp]}
 
     def _restore(self, payload: Dict[str, Any]) -> None:
         self._positions = {sid: dict(pos)
@@ -573,6 +587,55 @@ class Driver:
         for n in self.plan.nodes.values():
             if n.kind == "sink" and hasattr(n.sink, "abort_uncommitted"):
                 n.sink.abort_uncommitted()
+
+    # -- rescale restore -------------------------------------------------
+    def _rescale_from_paths(self) -> List[str]:
+        """The savepoint set (one per OLD process, pid order) the last
+        rescale redeploy restored from — injected by the coordinator as
+        cluster.rescale-from so EVERY later attempt, not just the first,
+        can find the pre-rescale cut (see the restore floor below)."""
+        raw = str(self.config.get(ClusterOptions.RESCALE_FROM) or "")
+        return [p.strip() for p in raw.split(",") if p.strip()]
+
+    @staticmethod
+    def _savepoint_seq(path: str) -> int:
+        """Checkpoint-sequence number a savepoint directory was written
+        under (paths end in savepoint-<n>; ids are fleet-aligned)."""
+        import re
+
+        m = re.findall(r"savepoint-(\d+)", str(path).replace("\\", "/"))
+        return int(m[-1]) if m else -1
+
+    def _load_repartitioned(self, primary: str) -> Dict[str, Any]:
+        """Load an explicit restore path; when its key-group identity
+        (writer nproc/pid) differs from this process's, load the FULL
+        savepoint set named by cluster.rescale-from and merge it down to
+        this process's shard range (checkpoint/repartition.py)."""
+        from flink_tpu.checkpoint.storage import FsCheckpointStorage
+
+        payload = FsCheckpointStorage.load(primary)
+        me = self._rescale_identity()
+        ident = payload.get("rescale")
+        if ident is None or (
+                int(ident.get("nproc", 1)) == me["nproc"]
+                and int(ident.get("pid", 0)) == me["pid"]):
+            # same shape (or a pre-identity snapshot): plain restore
+            return payload
+        from flink_tpu.checkpoint.repartition import merge_payloads
+
+        paths = self._rescale_from_paths() or [primary]
+        payloads = [payload if p == primary else FsCheckpointStorage.load(p)
+                    for p in paths]
+        payloads.sort(
+            key=lambda pl: int((pl.get("rescale") or {}).get("pid", 0)))
+        op_kinds = {nid: n.kind for nid, n in self.plan.nodes.items()
+                    if nid in payload.get("operators", {})}
+        return merge_payloads(
+            payloads, new_pid=me["pid"], new_nproc=me["nproc"],
+            num_shards=me["num_shards"],
+            slots_per_shard=int(self.config.get(
+                StateOptions.SLOTS_PER_SHARD)),
+            op_kinds=op_kinds)
 
     def checkpoint_now(self, savepoint: bool = False):
         """Trigger one SYNCHRONOUS checkpoint at the current step
@@ -748,7 +811,8 @@ class Driver:
             f"negotiated checkpoint id {common} is missing locally — "
             "retention removed it; raise state.checkpoints.num-retained")
 
-    def _ingest_loop_dcn(self, srcs, interval_ms: int) -> None:
+    def _ingest_loop_dcn(self, srcs, interval_ms: int,
+                         job_name: str = "job") -> None:
         """The cross-host step loop: ingest a local batch, route records
         to their shard owners, RENDEZVOUS (the step barrier carrying
         watermark / termination / checkpoint consensus), then run the
@@ -796,7 +860,15 @@ class Driver:
         # its ckpt flag is stale — absorb it once (symmetric: every
         # process just checkpointed at the same boundary), or the
         # fleet double-checkpoints back-to-back every interval
+        stale_sp = False        # same staleness for the savepoint flag:
+        # the in-flight step's meta predates the savepoint just served
         while True:
+            if self._cancel is not None and self._cancel.is_set():
+                # stop-with-savepoint (rescale) sets cancel from the
+                # savepoint completion callback; exit symmetrically at
+                # the next boundary — every process served the request
+                # at the SAME rendezvous, so the fleet leaves together
+                raise JobCancelledError(job_name)
             batch = None
             batch_ix = None
             while order:
@@ -838,8 +910,16 @@ class Driver:
                          and interval_ms > 0
                          and (time.time() - st.last_chk) * 1000
                          >= interval_ms)
+            sp_rq = self._savepoint_request
             meta = {"wm": int(local_wm), "done": batch is None,
                     "ckpt": bool(want_ckpt),
+                    # savepoint consensus: the coordinator triggers the
+                    # request on EVERY process (require-all push); the
+                    # flag rides the rendezvous so the fleet serves it
+                    # at ONE common step boundary — the savepoint set
+                    # is a globally consistent cut, like "ckpt" but
+                    # all-set instead of any-set (no clock owner)
+                    "sp": bool(sp_rq is not None and sp_rq.is_set()),
                     # 2PC phase-2 ack: the id this process has DURABLY
                     # persisted (commit waits until everyone has it —
                     # the reference's all-acks-then-notifyComplete rule,
@@ -851,12 +931,15 @@ class Driver:
                 pending_x = h
                 continue
             target, pending_x = (pending_x, h) if overlap else (h, None)
-            all_done, ckpt_req = self._dcn_consume_step(
+            all_done, ckpt_req, sp_req = self._dcn_consume_step(
                 sid, target, st, deferred=overlap)
             if stale_ckpt:
                 ckpt_req = False
                 stale_ckpt = False
-            if not (all_done or ckpt_req):
+            if stale_sp:
+                sp_req = False
+                stale_sp = False
+            if not (all_done or ckpt_req or sp_req):
                 continue
             if pending_x is not None and (all_done or drain_at_barrier):
                 # drain the in-flight step so the snapshot cut (or the
@@ -864,9 +947,9 @@ class Driver:
                 # consensus flags are ABSORBED — metas are identical
                 # fleet-wide, so every process absorbs the same ones —
                 # except termination, which must still be honored.
-                done2, _ = self._dcn_consume_step(sid, pending_x, st,
-                                                  absorb=True,
-                                                  deferred=True)
+                done2, _, _ = self._dcn_consume_step(sid, pending_x, st,
+                                                     absorb=True,
+                                                     deferred=True)
                 all_done = all_done or done2
                 pending_x = None
             if ckpt_req:
@@ -888,6 +971,20 @@ class Driver:
                 # without the drain, the in-flight step still carries
                 # its pre-snapshot ckpt flag — consume it ABSORBED
                 stale_ckpt = pending_x is not None
+            if sp_req:
+                # every process has the pending request (all-set above):
+                # serve it HERE, at the common boundary, each with its
+                # own token/stop identity. The savepoint commits
+                # synchronously fleet-wide — symmetric, so no ack dance.
+                self._maybe_take_savepoint()
+                if (st.pending is not None
+                        and self._ckpt_pending is not st.pending):
+                    # the savepoint path completed the in-flight
+                    # periodic checkpoint (checkpoint_now waits on it);
+                    # forgetting that here would double-complete it at
+                    # the next persisted-ack consensus
+                    st.pending = None
+                stale_sp = pending_x is not None
             if all_done:
                 if st.pending is not None:
                     # end of input doubles as the final barrier: every
@@ -902,8 +999,9 @@ class Driver:
         """Consume ONE rendezvous step: barrier on the handle, push the
         merged share through the local pipeline, apply the global
         watermark, and run the 2PC persisted-ack check. Returns
-        (all_done, ckpt_requested); ``absorb`` suppresses the ckpt
-        flag (the drained step rides the barrier that drained it);
+        (all_done, ckpt_requested, savepoint_requested); ``absorb``
+        suppresses the ckpt and savepoint flags
+        (the drained step rides the barrier that drained it);
         ``deferred`` marks an OVERLAPPED consume — the only place the
         dcn.overlap.consume fault point fires, so a chaos bisect of
         the overlap seam stays quiet on lockstep runs."""
@@ -941,7 +1039,12 @@ class Driver:
             self._ckpt_pending = None
             st.pending = None
         ckpt_req = (not absorb) and any(bool(m.get("ckpt")) for m in metas)
-        return all(bool(m["done"]) for m in metas), ckpt_req
+        # all-set (vs ckpt's any-set): a savepoint is triggered per
+        # process over RPC, so the LAST process to receive it gates the
+        # barrier — serving before everyone holds the request would cut
+        # at different steps and the set would not be a consistent cut
+        sp_req = (not absorb) and all(bool(m.get("sp")) for m in metas)
+        return all(bool(m["done"]) for m in metas), ckpt_req, sp_req
 
     def _push_dcn_merged(self, sid: int, md, mts) -> None:
         """Push this process's merged exchange share downstream — as
@@ -1449,14 +1552,29 @@ class Driver:
         # run or pre-sub-batch checkpoint (factor 1 everywhere)
         self._restored_sub_factors: Dict[int, int] = {}
         if restore:
-            from flink_tpu.checkpoint.storage import FsCheckpointStorage
-
-            if self._dcn is not None and restore == "latest":
-                payload = self._dcn_negotiated_restore()
-            elif restore == "latest":
-                payload = self._coordinator.restore_latest()
+            if restore == "latest":
+                payload = (self._dcn_negotiated_restore()
+                           if self._dcn is not None
+                           else self._coordinator.restore_latest())
+                # durable rescale floor: cluster.rescale-from names the
+                # savepoint set the last rescale redeploy restored from.
+                # A checkpoint OLDER than that set predates the cut —
+                # at 1->2->1 the final process count reuses the original
+                # (unsuffixed) checkpoint directory, whose latest entry
+                # is PRE-rescale state; resurrecting it would replay
+                # records both savepoint cuts already cover, at a stale
+                # key-group geometry. The savepoints win unless a
+                # checkpoint at least as new exists.
+                paths = self._rescale_from_paths()
+                if paths:
+                    floor = max(self._savepoint_seq(p) for p in paths)
+                    have = (int(payload.get("checkpoint_id", -1))
+                            if payload is not None else -1)
+                    if have < floor:
+                        payload = self._load_repartitioned(paths[0])
+                        self._coordinator.resume_numbering(payload)
             else:
-                payload = FsCheckpointStorage.load(restore)
+                payload = self._load_repartitioned(restore)
                 self._coordinator.resume_numbering(payload)
             if payload is not None:
                 self._restore(payload)
@@ -1540,7 +1658,7 @@ class Driver:
         prof = self.prof
         if self._dcn is not None:
             try:
-                self._ingest_loop_dcn(srcs, interval_ms)
+                self._ingest_loop_dcn(srcs, interval_ms, job_name)
             finally:
                 self._dcn.close()
                 self._dcn = None

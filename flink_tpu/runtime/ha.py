@@ -368,7 +368,8 @@ class JobStore:
             state: str, attempts: int,
             py_blobs: Optional[List[Dict]] = None,
             submitted_at: Optional[float] = None,
-            assigned_runners: Optional[List[str]] = None) -> None:
+            assigned_runners: Optional[List[str]] = None,
+            rescale: Optional[Dict] = None) -> None:
         """Active jobs live in jobs/; a terminal write MOVES the record
         to jobs-archive/ so leader recovery never scans or parses
         finished history (ref: JobGraphStore removes terminal graphs;
@@ -379,7 +380,12 @@ class JobStore:
         order); ``assigned_runners`` records WHERE a RUNNING job lives
         so the new leader can wait for that runner to re-attach it
         instead of redeploying blind (tmp + rename keeps every write
-        atomic — readers see the old or new record whole)."""
+        atomic — readers see the old or new record whole).
+
+        ``rescale`` carries an in-flight rescale's armed intent
+        ({devices, processes, token, phase, ...}) so a dispatcher
+        takeover can resume or cleanly disarm the handshake instead of
+        forgetting it with the dead leader's memory."""
         from flink_tpu import faults
 
         faults.fire("ha.store.write", exc=OSError, job=job_id,
@@ -390,7 +396,8 @@ class JobStore:
                "state": state, "attempts": attempts,
                "py_blobs": list(py_blobs or []),
                "submitted_at": submitted_at,
-               "assigned_runners": list(assigned_runners or [])}
+               "assigned_runners": list(assigned_runners or []),
+               "rescale": rescale}
         # through the seam (tmp + FSYNC + rename): a power cut right
         # after admission acked must not leave a torn registry record
         # a recovering leader silently skips — write_atomic makes the

@@ -239,12 +239,11 @@ class SessionDispatcher(JobCoordinator):
         self.stop_event = threading.Event()
         self._closing = False
         self._idle_since: Dict[str, float] = {}
-        from flink_tpu.obs.metrics import MetricRegistry
-
-        # dispatcher-scoped registry (session-plane gauges; per-JOB
-        # metrics stay on each driver's own registry and arrive here
-        # only as heartbeat-carried snapshots on JobInfo.last_metrics)
-        self.registry = MetricRegistry()
+        # session-plane gauges ride the coordinator's own registry
+        # (created in JobCoordinator.__init__) so one snapshot serves
+        # both planes — rescale phase counters next to slot pressure;
+        # per-JOB metrics stay on each driver's own registry and arrive
+        # here only as heartbeat-carried snapshots on JobInfo.last_metrics
         g = self.registry.group("session")
         self._g_running = g.gauge("running_jobs")
         self._g_queued = g.gauge("queued_jobs")

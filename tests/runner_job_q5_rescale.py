@@ -1,0 +1,75 @@
+"""Deployable Q5 hot-path job for the process-level rescale e2e: the
+nexmark bid stream → keyBy(auction) → sliding COUNT per auction →
+file-backed 2PC sink, same "job jar" contract as runner_job_dcn.py.
+
+The device top-1 stage of the full Q5 is deliberately omitted here:
+top-1 folds an argmax over the PROCESS-LOCAL key range, so at nproc > 1
+its committed rows are per-process candidates, not the global hot item
+— that plane does not redistribute byte-identically and rescaling it is
+an honest residue (COMPONENTS.md). The per-auction count plane below IS
+the Q5 device hot path the north-star measures, and it must come out
+byte-identical to the unrescaled golden across any 1→2→1 rescale cut.
+"""
+import dataclasses
+import time
+
+from flink_tpu.api.sinks import FileTransactionalSink
+from flink_tpu.api.windowing import SlidingEventTimeWindows
+from flink_tpu.nexmark.generator import NexmarkConfig, bid_stream
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+WINDOW_MS = 2_000
+SLIDE_MS = 1_000
+
+
+def _cfg(n_batches: int, batch_size: int) -> NexmarkConfig:
+    # events_per_ms=4 stretches the event-time axis so a short run still
+    # spans many slide panes; 64 active auctions keep every shard's live
+    # key set well under slots-per-shard at num-key-shards=8
+    return NexmarkConfig(batch_size=batch_size, n_batches=n_batches,
+                         n_splits=2, events_per_ms=4,
+                         num_active_auctions=64, num_active_people=32)
+
+
+def golden_counts(n_batches: int, batch_size: int):
+    """Pure-host reference: replay the SAME deterministic generator and
+    count bids per (auction, window_start) with the repo's assigner."""
+    assigner = SlidingEventTimeWindows.of(WINDOW_MS, SLIDE_MS)
+    cfg = _cfg(n_batches, batch_size)
+    src = bid_stream(cfg)
+    expect = {}
+    for split in range(cfg.n_splits):
+        for i in range(cfg.n_batches):
+            data, ts = src.gen(str(split), i)
+            for a, t in zip(data["auction"], ts):
+                for w in assigner.assign_windows(int(t)):
+                    kk = (int(a), int(w.start))
+                    expect[kk] = expect.get(kk, 0) + 1
+    return expect
+
+
+def build(env):
+    n_batches = int(env.config.get_raw("test.n-batches", 12))
+    batch_size = int(env.config.get_raw("test.batch-size", 512))
+    sleep_ms = int(env.config.get_raw("test.batch-sleep-ms", 0))
+    sink_dir = env.config.get_raw("test.sink-dir")
+    assert sink_dir, "test.sink-dir must be set"
+    pid = int(env.config.get_raw("cluster.process-id", 0))
+
+    cfg = _cfg(n_batches, batch_size)
+    src = bid_stream(cfg)
+    inner = src.gen
+
+    def gen(split, i):
+        b = inner(split, i)
+        if b is not None and sleep_ms:
+            time.sleep(sleep_ms / 1000.0)
+        return b
+
+    stream = env.from_source(
+        dataclasses.replace(src, gen=gen),
+        WatermarkStrategy.for_bounded_out_of_orderness(1000))
+    (stream.key_by("auction")
+           .window(SlidingEventTimeWindows.of(WINDOW_MS, SLIDE_MS))
+           .count()
+           .add_sink(FileTransactionalSink(f"{sink_dir}-p{pid}")))

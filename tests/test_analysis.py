@@ -252,6 +252,21 @@ def _checkpoint_in_batch(tmp_path):
         "execution.checkpointing.interval": 500}))
 
 
+@seed("RESCALE_INVALID")
+def _reactive_rescale_without_checkpointing(tmp_path):
+    # reactive mode with no checkpoint interval: every controller-armed
+    # rescale's stop-with-savepoint would be rejected — arm/disarm loop
+    return analyze_config(Configuration({"rescale.mode": "reactive"}))
+
+
+@seed("RESCALE_COOLDOWN_THRASH")
+def _rescale_cooldown_below_checkpoint_interval(tmp_path):
+    return analyze_config(Configuration({
+        "rescale.mode": "reactive",
+        "execution.checkpointing.interval": "30s",
+        "rescale.cooldown": "5s"}))
+
+
 # -- dataflow-plane seeds (the propagated lattices; full coverage and
 # clean negatives live in tests/test_dataflow.py) ---------------------------
 
@@ -638,3 +653,69 @@ class TestStorageLocalLocksOnRemote:
             if f.rule == "STORAGE_LOCAL_LOCKS_ON_REMOTE"]
         assert len(findings) == 1
         assert "high-availability.dir" in findings[0].message
+
+
+class TestRescaleRule:
+    """ISSUE 16: RESCALE_INVALID / RESCALE_COOLDOWN_THRASH — the
+    rescale.* grammar's unsatisfiable shapes error at submit, the
+    thrash-but-legal shapes warn, and legal configs stay silent."""
+
+    def _rules(self, conf):
+        return [(f.rule, f.severity) for f in analyze_config(
+            Configuration(conf))
+            if f.rule.startswith("RESCALE")]
+
+    def test_reactive_without_checkpointing_errors(self):
+        assert ("RESCALE_INVALID", "error") in self._rules(
+            {"rescale.mode": "reactive"})
+
+    def test_unknown_mode_errors(self):
+        assert ("RESCALE_INVALID", "error") in self._rules(
+            {"rescale.mode": "adaptive"})
+
+    def test_inverted_pressure_band_errors(self):
+        assert ("RESCALE_INVALID", "error") in self._rules(
+            {"rescale.mode": "reactive",
+             "execution.checkpointing.interval": "1s",
+             "rescale.target-pressure-high": 30,
+             "rescale.target-pressure-low": 40})
+
+    def test_bounds_violating_key_group_discipline_error(self):
+        # 8 shards / 1 process = share 8; min-devices 3 divides nothing
+        assert ("RESCALE_INVALID", "error") in self._rules(
+            {"rescale.mode": "reactive",
+             "execution.checkpointing.interval": "1s",
+             "state.num-key-shards": "8",
+             "rescale.min-devices": 3})
+
+    def test_empty_width_range_errors(self):
+        assert ("RESCALE_INVALID", "error") in self._rules(
+            {"rescale.mode": "reactive",
+             "execution.checkpointing.interval": "1s",
+             "rescale.min-devices": 4,
+             "rescale.max-devices": 2})
+
+    def test_cooldown_below_checkpoint_interval_warns(self):
+        rules = self._rules({
+            "rescale.mode": "reactive",
+            "execution.checkpointing.interval": "30s",
+            "rescale.cooldown": "5s"})
+        assert ("RESCALE_COOLDOWN_THRASH", "warn") in rules
+        assert ("RESCALE_INVALID", "error") not in rules
+
+    def test_legal_reactive_config_is_silent(self):
+        assert self._rules({
+            "rescale.mode": "reactive",
+            "execution.checkpointing.interval": "30s",
+            "rescale.cooldown": "120s",
+            "state.num-key-shards": "128",
+            "rescale.min-devices": 2,
+            "rescale.max-devices": 8}) == []
+
+    def test_mode_off_never_fires_regardless_of_knobs(self):
+        # manual-only mode: the controller never reads the band/bounds,
+        # so even a nonsense band must not block a manual-rescale user
+        assert self._rules({
+            "rescale.target-pressure-high": 10,
+            "rescale.target-pressure-low": 90,
+            "rescale.cooldown": "0ms"}) == []

@@ -388,3 +388,106 @@ class TestClusterFlow:
                 proc.wait(timeout=10)
             srv.close()
             coord.close()
+
+
+class TestRescaleCli:
+    """ISSUE 16 satellite: `flink_tpu rescale JOB --devices N
+    [--processes M]` + `session rescale`, same 0/1/2 exit contract as
+    every other verb: 0 = dispatched, 1 = the coordinator refused
+    (divisibility / unknown job / not running), 2 = usage error."""
+
+    def _coord(self):
+        class Gw:
+            def __init__(self):
+                self.deployed = []
+                self.savepoints = []
+
+            def rpc_run_job(self, job_id, entry, config=None, attempt=1,
+                            py_blobs=None, **kw):
+                self.deployed.append((job_id, attempt))
+                return {"accepted": True}
+
+            def rpc_cancel_job(self, job_id, attempt=None, **kw):
+                return {"ok": True}
+
+            def rpc_trigger_savepoint(self, job_id, stop=False,
+                                      token=None, **kw):
+                self.savepoints.append((job_id, stop, token))
+                return {"ok": True}
+
+        gw = Gw()
+        gwsrv = RpcServer(gw)
+        coord = JobCoordinator(Configuration({}))
+        srv = RpcServer(coord)
+        coord.rpc_register_runner("r1", "127.0.0.1", 8, port=gwsrv.port)
+        coord.rpc_register_runner("r2", "127.0.0.1", 8, port=gwsrv.port)
+        return gw, gwsrv, coord, srv
+
+    def test_dispatched_0_refused_1_usage_2(self, capsys):
+        gw, gwsrv, coord, srv = self._coord()
+        addr = f"127.0.0.1:{srv.port}"
+        try:
+            coord.rpc_submit_job(
+                "j", entry="x:y",
+                config={"cluster.mesh-devices": "2",
+                        "state.num-key-shards": "8"})
+            wait_until(lambda: gw.deployed, what="deploy")
+
+            # 1: refused — 8 shards are not divisible by 3 processes
+            # (key-group ranges could not be contiguous)
+            rc, out = cli(capsys, "rescale", "--coordinator", addr,
+                          "--devices", "1", "--processes", "3", "j")
+            assert rc == 1 and not out["ok"]
+            assert "divisible" in out["reason"]
+
+            # 1: refused — unknown job
+            rc, out = cli(capsys, "rescale", "--coordinator", addr,
+                          "--devices", "2", "ghost")
+            assert rc == 1 and not out["ok"]
+
+            # 0: a process rescale dispatches (8 shards / 2 procs = 4,
+            # 4 % 4 devices == 0) and the wire carried --processes
+            rc, out = cli(capsys, "rescale", "--coordinator", addr,
+                          "--devices", "4", "--processes", "2", "j")
+            assert rc == 0 and out["ok"] and out["processes"] == 2
+            wait_until(lambda: gw.savepoints, what="stop-with-savepoint")
+
+            # 2: usage — --devices is required
+            with pytest.raises(SystemExit) as e:
+                cli_main(["rescale", "--coordinator", addr, "j"])
+            assert e.value.code == 2
+        finally:
+            srv.close(); gwsrv.close(); coord.close()
+
+    def test_session_rescale_same_contract(self, tmp_path, capsys):
+        from flink_tpu.runtime.session import LocalSessionCluster
+
+        with LocalSessionCluster(Configuration(
+                {"session.autoscale": False})) as c:
+            sink = str(tmp_path / "sink")
+            r = c.submit("runner_job:build", job_id="sj", config={
+                "test.n-batches": "60", "test.batch-sleep-ms": "100",
+                f"test.sink-dir": sink,
+                "execution.checkpointing.dir": str(tmp_path / "chk"),
+                "execution.checkpointing.interval": "300ms",
+                "state.num-key-shards": "4",
+                "state.slots-per-shard": "16",
+                "pipeline.microbatch-size": "64"})
+            assert r.get("admitted")
+            wait_until(lambda: c.dispatcher.jobs["sj"].state == "RUNNING",
+                       60, what="session job running")
+            # 0: dispatched against the session leader
+            rc, out = cli(capsys, "session", "rescale",
+                          "--session", c.address, "--devices", "1", "sj")
+            assert rc == 0 and out["ok"]
+            # 1: refused — unknown job
+            rc, out = cli(capsys, "session", "rescale",
+                          "--session", c.address, "--devices", "1",
+                          "ghost")
+            assert rc == 1 and not out["ok"]
+            # 2: usage — --devices required
+            with pytest.raises(SystemExit) as e:
+                cli_main(["session", "rescale", "--session", c.address,
+                          "sj"])
+            assert e.value.code == 2
+            c.dispatcher.rpc_cancel_job("sj")
