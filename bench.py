@@ -102,6 +102,20 @@ def _sessions_conf(batch_size: int) -> dict:
             "pipeline.max-inflight-steps": 1}
 
 
+def _q5_lsm_conf(batch_size: int) -> dict:
+    # Q5 on the DISK state tier (ISSUE 17, flink_tpu/state/lsm.py): a
+    # 1 MiB delta budget far below the key domain's footprint, so the
+    # run exercises seal → compact → changelog-checkpoint end to end
+    # rather than staying RAM-resident
+    return {**BENCH_CONF,
+            "state.num-key-shards": 128,
+            "state.slots-per-shard": 256,
+            "state.backend": "lsm",
+            "state.memory-budget-bytes": 1 << 20,
+            "pipeline.microbatch-size": batch_size,
+            "pipeline.sub-batches": 1}
+
+
 def _q5_backfill_conf(batch_size: int) -> dict:
     # the backfill-then-live consumer's conf (ISSUE 9): a consumer
     # group over a key-compacted topic — compaction keyed on the
@@ -136,6 +150,7 @@ def job_confs() -> dict:
         "bench_wordcount_log_fed": _wordcount_conf(1 << 18),
         "bench_sessions": _sessions_conf(1 << 20),
         "bench_q5_backfill": _q5_backfill_conf(1 << 18),
+        "bench_q5_lsm": _q5_lsm_conf(1 << 18),
     }
 
 
@@ -1183,6 +1198,179 @@ def rescale_bench(at_batch: int, to_procs: int, *,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def state_backend_bench(backend: str, key_domain: int,
+                        artifact: str = "BENCH_STATE.json") -> None:
+    """``python bench.py --state-backend lsm --key-domain N``: the
+    keyed-state tier microbench (ISSUE 17). Drives one spill store —
+    'lsm' (disk tier, state/lsm.py) or 'spill' (RAM ledger) — through
+    the three access shapes the window operator issues:
+
+    - **put**: absorb batches uniform over a key domain far beyond the
+      lsm delta budget (seal + compact on the real durable path);
+    - **get**: fire complete sliding windows (the pane-range-pruned
+      run fold);
+    - **scan**: a full fold of every live run + delta (the restore /
+      key_count shape).
+
+    Then two changelog checkpoints through the REAL storage plane
+    (save_v2 + op_aux hardlinks) measure what the tier is for:
+    ``checkpoint_fresh_bytes`` (delta blob + manifest — the bytes the
+    second checkpoint actually wrote, st_nlink==1) vs
+    ``full_state_bytes`` (the store's whole footprint) — incremental
+    cost tracks the write rate, not the key domain.
+
+    CORE-COUNT CONSTRAINT: this container runs 1–2 CPU cores, so the
+    ev/s figures are single-host, contended-core numbers — valid for
+    the delta-vs-full ratio and lsm/spill RELATIVE comparison, not as
+    steady-state throughput claims (the ``cores`` field rides the
+    artifact so readers can tell)."""
+    import shutil
+    import tempfile
+
+    from flink_tpu.checkpoint import blobformat
+    from flink_tpu.checkpoint.storage import FsCheckpointStorage
+    from flink_tpu.state.lsm import LsmSpillStore
+    from flink_tpu.state.spill import HostSpillStore
+
+    if backend not in ("lsm", "spill"):
+        raise SystemExit("--state-backend needs lsm|spill")
+
+    class _BenchAgg:
+        # the Q5 lane shape: one f32 value lane in each monoid + count
+        sum_width, max_width, min_width = 1, 1, 1
+
+        def lift_masked(self, data, valid):
+            v = np.asarray(data["v"], np.float32)[:, None]
+            return v, v, v
+
+        def finalize(self, s, x, n, c):
+            return {"sum_v": s[:, 0], "max_v": x[:, 0],
+                    "min_v": n[:, 0], "count": c}
+
+    budget = 1 << 20  # the committed bench_q5_lsm.conf budget
+    rows_per_batch = 1 << 15
+    n_batches = 48
+    panes = 24  # sliding 8-pane windows over these fire 17 full ends
+    tmp = tempfile.mkdtemp(prefix="bench-state-")
+    rng = np.random.default_rng(17)
+    try:
+        if backend == "lsm":
+            store = LsmSpillStore(
+                _BenchAgg(), store_dir=os.path.join(tmp, "store"),
+                memory_budget_bytes=budget, num_shards=128)
+        else:
+            store = HostSpillStore(_BenchAgg())
+
+        # put: uniform keys over the domain, pane-stamped round-robin
+        t0 = time.perf_counter()
+        for b in range(n_batches):
+            keys = rng.integers(0, key_domain,
+                                rows_per_batch).astype(np.int64)
+            pane = np.full(rows_per_batch, b % panes, np.int64)
+            store.absorb(keys, pane,
+                         {"v": rng.normal(
+                             size=rows_per_batch).astype(np.float32)})
+        put_wall = time.perf_counter() - t0
+        put_eps = rows_per_batch * n_batches / put_wall
+
+        # get: fire every complete 8-pane window once (Q5's shape)
+        ppw = 8
+        ends = list(range(ppw, panes + 1))
+        t0 = time.perf_counter()
+        fired = store.fire(ends, ppw, 1_000, 0, ppw * 1_000)
+        get_wall = time.perf_counter() - t0
+        fired_rows = 0 if fired is None else len(fired["key"])
+        get_eps = fired_rows / max(get_wall, 1e-9)
+
+        # scan: the full fold every key passes through (restore shape)
+        t0 = time.perf_counter()
+        n_keys = store.key_count
+        scan_wall = time.perf_counter() - t0
+        if backend == "lsm":
+            stored_rows = (sum(r["rows"] for r in store._runs)
+                           + sum(len(t[0])
+                                 for t in store._delta.panes.values()))
+        else:
+            stored_rows = sum(len(t[0]) for t in store.panes.values())
+        scan_rps = stored_rows / max(scan_wall, 1e-9)
+
+        # changelog checkpoints through the real storage plane: ckpt 1
+        # seals the baseline, more puts, ckpt 2's FRESH bytes (delta
+        # blob + manifests + runs sealed since ckpt 1) are the
+        # incremental cost the tier exists to bound. Compact first so
+        # the gap churn stays below compact_min_runs — a compaction
+        # inside the gap rewrites the whole keyspace and would measure
+        # compaction cost, not checkpoint cost
+        if backend == "lsm":
+            store.compact()
+        storage = FsCheckpointStorage(os.path.join(tmp, "chk"), "bench")
+        full_bytes = int(store.bytes_used())
+        chk_bytes = {}
+        prev_aux: set = set()
+        for cid in (1, 2):
+            snap = store.snapshot()
+            aux = (snap.pop("aux_files", None)
+                   if isinstance(snap, dict) else None) or {}
+            h = storage.save_v2(
+                cid, {"checkpoint_id": cid},
+                {"1": blobformat.encode(snap)}, {},
+                op_aux=({"1": aux} if aux else None))
+            # fresh = bytes this checkpoint introduced: the delta blob
+            # + manifests (st_nlink==1) plus runs sealed SINCE the
+            # previous checkpoint (hardlinked, but new writes — runs
+            # already in the prior cut cost nothing again)
+            carried = {f"st-1-{name}" for name in prev_aux}
+            prev_aux = set(aux)
+            total = fresh = 0
+            for name in os.listdir(h.path):
+                st = os.stat(os.path.join(h.path, name))
+                total += st.st_size
+                if st.st_nlink == 1 or name not in carried:
+                    fresh += st.st_size
+            chk_bytes[cid] = {"total": total, "fresh": fresh}
+            if cid == 1:
+                for b in range(2):  # ~2 budget-fills of fresh writes
+                    keys = rng.integers(0, key_domain,
+                                        rows_per_batch).astype(np.int64)
+                    store.absorb(
+                        keys, np.full(rows_per_batch, panes, np.int64),
+                        {"v": rng.normal(
+                            size=rows_per_batch).astype(np.float32)})
+                full_bytes = int(store.bytes_used())
+
+        line = {
+            "metric": "keyed_state_backend_bench",
+            "backend": backend,
+            "key_domain": key_domain,
+            "memory_budget_bytes": budget if backend == "lsm" else None,
+            "put_events_per_sec": round(put_eps),
+            "get_events_per_sec": round(get_eps),
+            "get_fired_rows": fired_rows,
+            "scan_rows_per_sec": round(scan_rps),
+            "scanned_keys": int(n_keys),
+            "stored_rows": int(stored_rows),
+            "runs_sealed": getattr(store, "seals", 0),
+            "compactions": getattr(store, "compactions", 0),
+            "live_runs": getattr(store, "run_count", 0),
+            "full_state_bytes": full_bytes,
+            "checkpoint_total_bytes": chk_bytes[2]["total"],
+            "checkpoint_fresh_bytes": chk_bytes[2]["fresh"],
+            "delta_vs_full_ratio": round(
+                chk_bytes[2]["fresh"] / max(full_bytes, 1), 6),
+            "cores": os.cpu_count(),
+            "constraint": "1-2 core container: single-host contended-"
+                          "core rates — read the delta_vs_full_ratio "
+                          "and lsm/spill relative numbers, not the "
+                          "absolute ev/s",
+        }
+        print(json.dumps(line))
+        if artifact:
+            with open(artifact, "w") as f:
+                json.dump(line, f, indent=1)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 if __name__ == "__main__":
     import sys
 
@@ -1195,7 +1383,7 @@ if __name__ == "__main__":
     if "--fire-gate" in sys.argv or "--readiness" in sys.argv:
         for mode in ("--backfill", "--host-parallelism",
                      "--concurrent-jobs", "--dump-confs",
-                     "--rescale-at-batch"):
+                     "--rescale-at-batch", "--state-backend"):
             if mode in sys.argv:
                 raise SystemExit(
                     f"--fire-gate/--readiness only apply to the Q5 "
@@ -1246,6 +1434,18 @@ if __name__ == "__main__":
                              "integer values")
         rescale_bench(int(sys.argv[ib + 1]), int(sys.argv[it + 1]),
                       artifact="BENCH_RESCALE.json")
+    elif "--state-backend" in sys.argv:
+        ix = sys.argv.index("--state-backend")
+        if ix + 1 >= len(sys.argv):
+            raise SystemExit("--state-backend needs lsm|spill")
+        kd = 1 << 20
+        if "--key-domain" in sys.argv:
+            ik = sys.argv.index("--key-domain")
+            if ik + 1 >= len(sys.argv):
+                raise SystemExit("--key-domain needs a count, "
+                                 "e.g. 1048576")
+            kd = int(sys.argv[ik + 1])
+        state_backend_bench(sys.argv[ix + 1], kd)
     elif "--backfill" in sys.argv:
         run_q5_backfill(artifact="BENCH_BACKFILL.json")
     elif "--sub-batches" in sys.argv:

@@ -840,6 +840,91 @@ def rescale_cooldown_thrash(plan, config) -> Iterable[Finding]:
                 "several checkpoint intervals)")
 
 
+@config_rule("STATE_BUDGET_INVALID", "error",
+             fix="make the state.* backend config self-consistent")
+def state_budget_invalid(plan, config) -> Iterable[Finding]:
+    """State-backend config that can never work (error) or that does
+    nothing (warn), caught at submit:
+
+    - an unknown ``state.backend`` is an ERROR: the driver rejects the
+      job at build (runtime/driver.py validates against hbm/spill/lsm).
+    - an lsm memory budget below ``state.lsm.run-floor-bytes`` is an
+      ERROR: the delta would seal a degenerate run on nearly every
+      batch, turning every absorb into an fsync — the disk tier
+      becomes a write amplifier instead of a spill tier.
+    - ``state.lsm.compact-min-runs`` below 2 is an ERROR: a compaction
+      of fewer than two runs merges nothing, and the store would arm
+      it after every seal.
+
+    The does-nothing shape warns instead (STATE_BUDGET_IGNORED
+    below)."""
+    from flink_tpu.config import StateOptions
+
+    backend = str(config.get(StateOptions.BACKEND)).strip().lower()
+    if backend not in ("hbm", "spill", "lsm"):
+        yield _f(
+            f"state.backend={backend!r} is not a known backend — the "
+            "driver rejects the job at build",
+            fix="use 'hbm' (dense device panes), 'spill' (RAM host "
+                "offload) or 'lsm' (disk tier)")
+        return
+    if backend != "lsm":
+        return
+    try:
+        budget = int(config.get(StateOptions.MEMORY_BUDGET_BYTES))
+        floor = int(config.get(StateOptions.LSM_RUN_FLOOR_BYTES))
+    except (TypeError, ValueError):
+        yield _f(
+            "state.memory-budget-bytes / state.lsm.run-floor-bytes do "
+            "not parse as integers",
+            fix="set byte counts (default budget 64 MiB, floor 64 KiB)")
+        return
+    if budget < floor:
+        yield _f(
+            f"state.memory-budget-bytes={budget} is below "
+            f"state.lsm.run-floor-bytes={floor}: the lsm delta would "
+            "seal a degenerate run on nearly every batch — every "
+            "absorb becomes an fsync and the disk tier amplifies "
+            "writes instead of spilling them",
+            fix=f"raise the budget to >= {floor} bytes (or lower the "
+                "floor if tiny runs are intended, e.g. crash tests)")
+    try:
+        cmin = int(config.get(StateOptions.LSM_COMPACT_MIN_RUNS))
+    except (TypeError, ValueError):
+        yield _f(
+            "state.lsm.compact-min-runs does not parse as an integer",
+            fix="set an integer >= 2 (default 4)")
+        return
+    if cmin < 2:
+        yield _f(
+            f"state.lsm.compact-min-runs={cmin} is below 2 — a "
+            "compaction of fewer than two runs merges nothing, and "
+            "the store would arm one after every seal",
+            fix="set state.lsm.compact-min-runs >= 2 (default 4)")
+
+
+@config_rule("STATE_BUDGET_IGNORED", "warn",
+             fix="set state.backend=lsm, or drop the key")
+def state_budget_ignored(plan, config) -> Iterable[Finding]:
+    """``state.memory-budget-bytes`` explicitly set while the backend
+    is not 'lsm': hbm/spill hold all state resident and ignore the
+    key, so the bound the operator thinks they configured does not
+    exist — the job OOMs exactly as if the key were absent."""
+    from flink_tpu.config import StateOptions
+
+    backend = str(config.get(StateOptions.BACKEND)).strip().lower()
+    if backend == "lsm" or backend not in ("hbm", "spill"):
+        return  # STATE_BUDGET_INVALID owns the unknown-backend error
+    if "state.memory-budget-bytes" in config.keys():
+        yield _f(
+            "state.memory-budget-bytes is set but "
+            f"state.backend={backend!r} ignores it — only the 'lsm' "
+            "backend bounds its in-memory delta; this job holds all "
+            "state resident",
+            fix="set state.backend=lsm to enable the disk tier, or "
+                "drop the key")
+
+
 def load_option_grammar() -> None:
     """Import every module that declares ConfigOptions so the registry
     is complete before a key-validity check (options register at module

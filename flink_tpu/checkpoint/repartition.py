@@ -37,10 +37,14 @@ Merge rules by operator layout:
   survive the splice unchanged; filtering to the new range is what
   prevents two new processes from both firing the same key's timer.
 
-Spilled window state (state.backend='spill' with live host panes) does
-not repartition in v1 — the spill ledger is keyed by local pane id and
+RAM-spilled window state (state.backend='spill' with live host panes)
+does not repartition — the spill ledger is keyed by local pane id and
 has no shard-major layout to splice; merge_payloads raises rather than
-silently dropping it (see COMPONENTS.md for the residue).
+silently dropping it (see COMPONENTS.md for the residue). The DISK
+tier (state.backend='lsm') DOES repartition: run rows carry their
+key-group shard, so the merge filters each old process's runs + delta
+to the new range and emits a pure-delta lsm snapshot
+(_merge_lsm_spill / state/lsm.py merge_rescale_spill).
 """
 from __future__ import annotations
 
@@ -208,15 +212,30 @@ def _merge_window(snaps: Sequence[Dict[str, Any]], g: _Geo,
                   tgt_ranged: bool) -> Dict[str, Any]:
     from flink_tpu.state.keyed import PaneState
 
+    lsm_parts = []
     for s in snaps:
         sp = s.get("spill")
-        if sp and sp.get("panes"):
+        if sp and sp.get("kind") == "lsm":
+            # key-group-addressed tier (state/lsm.py): run rows carry
+            # their shard, so the spill merges by filtering — see
+            # _merge_lsm_spill below
+            if int(sp.get("num_shards", g.num_shards)) != g.num_shards:
+                raise RescaleError(
+                    f"lsm spill was written with num_shards="
+                    f"{sp['num_shards']} but the merge targets "
+                    f"{g.num_shards} — state.num-key-shards is the "
+                    "maxParallelism contract and cannot change")
+            lsm_parts.append((sp, {**(sp.get("aux_files") or {}),
+                                   **(s.get("__aux_files__") or {}),
+                                   **(s.get("__aux_paths__") or {})}))
+        elif sp and sp.get("panes"):
             raise RescaleError(
                 "cannot repartition spilled window state "
-                f"({len(sp['panes'])} live host pane(s)): the spill "
+                f"({len(sp['panes'])} live host pane(s)): the RAM spill "
                 "ledger has no shard-major layout to re-split. Let the "
                 "spill drain (lateness horizon) before rescaling, or "
-                "run with state.backend='hbm'.")
+                "use state.backend='lsm' (key-group-addressed runs "
+                "rescale) or 'hbm'.")
     rings = sorted({int(s["ring"]) for s in snaps})
     if len(rings) != 1:
         raise RescaleError(
@@ -255,7 +274,7 @@ def _merge_window(snaps: Sequence[Dict[str, Any]], g: _Geo,
         dump = np.full((1,) + glob.shape[1:], fill, dtype=glob.dtype)
         merged[f] = np.concatenate([glob, dump])
     return {
-        "spill": None,
+        "spill": _merge_lsm_spill(lsm_parts, g),
         "n_dev": 1,  # restore re-blocks to the restoring mesh
         "ring": rings[0],
         "panes": PaneState(sums=merged["sums"], maxs=merged["maxs"],
@@ -276,6 +295,22 @@ def _merge_window(snaps: Sequence[Dict[str, Any]], g: _Geo,
         "records_dropped_full": sum(
             int(s.get("records_dropped_full", 0)) for s in snaps),
     }
+
+
+def _merge_lsm_spill(parts, g: _Geo) -> Optional[Dict[str, Any]]:
+    """Fuse the old processes' lsm spill tiers into one pure-delta lsm
+    snapshot for the new range (state/lsm.py merge_rescale_spill): run
+    rows filter by their stored key-group column, delta keys re-hash —
+    the disk tier rescales where the RAM spill ledger cannot."""
+    if not parts:
+        return None
+    from flink_tpu.state.lsm import merge_rescale_spill
+
+    try:
+        return merge_rescale_spill(parts, num_shards=g.num_shards,
+                                   shard_lo=g.new_lo, shard_hi=g.new_hi)
+    except (ValueError, OSError) as e:
+        raise RescaleError(f"lsm spill merge failed: {e}") from e
 
 
 def _merge_session(snaps: Sequence[Dict[str, Any]], g: _Geo) -> Dict[str, Any]:

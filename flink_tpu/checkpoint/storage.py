@@ -61,6 +61,13 @@ class CheckpointHandle:
     # reuse base must reference the ACTUAL names — a reused blob keeps
     # its lineage's extension across format upgrades
     op_files: Optional[Dict[str, str]] = None
+    # per-op changelog aux files as written (save_v2 only): nid →
+    # {logical name → file name under path}. The lsm state tier's
+    # sealed runs ride checkpoints as hardlinks of immutable files;
+    # the next checkpoint's reuse base links THESE, not the store's
+    # live files, so aux survives the base's retirement (inode
+    # refcount, same rule as op blob reuse).
+    op_aux: Optional[Dict[str, Dict[str, str]]] = None
 
 
 @dataclasses.dataclass
@@ -69,10 +76,12 @@ class ReusedOpState:
     unchanged since the base checkpoint — reuse (hardlink) its blob
     instead of re-serializing. ``file`` is the absolute path of the base
     checkpoint's op blob; ``version`` the operator state_version it
-    captured."""
+    captured; ``aux`` the base's changelog aux files (logical name →
+    absolute path) to re-link alongside the blob."""
 
     file: str
     version: int
+    aux: Optional[Dict[str, str]] = None
 
 
 class StaleCheckpointWriter(RuntimeError):
@@ -218,10 +227,17 @@ class FsCheckpointStorage:
     def save_v2(self, checkpoint_id: int, meta_payload: Dict[str, Any],
                 op_blobs: Dict[str, bytes],
                 op_reuse: Dict[str, "ReusedOpState"],
-                savepoint: bool = False) -> CheckpointHandle:
+                savepoint: bool = False,
+                op_aux: Optional[Dict[str, Dict[str, str]]] = None
+                ) -> CheckpointHandle:
         """Incremental format: per-operator blob files; unchanged
-        operators hardlink the base checkpoint's blob. Manifest lands
-        last, exactly like v1."""
+        operators hardlink the base checkpoint's blob. ``op_aux`` (nid
+        → {logical name → source path}) is the changelog plane: each
+        named file — an lsm state tier's sealed, immutable, already-
+        durable run — is hardlinked into the checkpoint instead of
+        re-serialized, so checkpoint bytes scale with the write rate,
+        not the state size (the flink-dstl role). Manifest lands last,
+        exactly like v1."""
         from flink_tpu.checkpoint import blobformat
 
         d = self._dir(checkpoint_id, savepoint)
@@ -240,6 +256,18 @@ class FsCheckpointStorage:
             op_files[nid] = fn
             versions[nid] = meta_payload.get(
                 "op_versions", {}).get(nid, -1)
+        aux_links: Dict[str, Dict[str, str]] = {}
+
+        def _link_aux(nid: str, mapping: Dict[str, str]) -> None:
+            for logical, src in sorted(mapping.items()):
+                fn = f"st-{nid}-{logical}"
+                faults.fire("state.changelog.link", exc=OSError,
+                            checkpoint_id=checkpoint_id, file=logical)
+                self.fs.link_or_copy(src, os.path.join(tmp, fn))
+                aux_links.setdefault(nid, {})[logical] = fn
+
+        for nid, mapping in (op_aux or {}).items():
+            _link_aux(nid, mapping)
         for nid, ref in op_reuse.items():
             # reuse keeps the BASE's file name (it may be a v2 .pkl
             # pickle blob — the loader dispatches on magic bytes)
@@ -247,7 +275,11 @@ class FsCheckpointStorage:
             self.fs.link_or_copy(ref.file, os.path.join(tmp, fn))
             op_files[nid] = fn
             versions[nid] = ref.version
-        if op_reuse:
+            if ref.aux:
+                # an idle operator's changelog is its base's aux set,
+                # re-linked so this checkpoint stays self-locating
+                _link_aux(nid, ref.aux)
+        if op_reuse or aux_links:
             # entry durability for the REUSE links: a hardlink is a
             # directory mutation the blobs' content fsyncs never cover
             # — without this dir barrier a power cut after save_v2
@@ -273,6 +305,7 @@ class FsCheckpointStorage:
                 "compression": self.compression,
                 "ops": {nid: {"file": fn, "version": versions[nid]}
                         for nid, fn in op_files.items()},
+                "aux": aux_links,
                 "epoch": self.epoch,
             }).encode())
         try:
@@ -293,7 +326,9 @@ class FsCheckpointStorage:
             self._retire_old()
         return CheckpointHandle(checkpoint_id, d, ts, savepoint,
                                 epoch=self.epoch, size_bytes=_dir_size(d),
-                                op_files=dict(op_files))
+                                op_files=dict(op_files),
+                                op_aux={n: dict(m)
+                                        for n, m in aux_links.items()})
 
     def list_complete(self) -> List[CheckpointHandle]:
         out = []
@@ -365,6 +400,18 @@ class FsCheckpointStorage:
         payload["op_files"] = {
             int(nid): os.path.join(path, e["file"])
             for nid, e in manifest.get("ops", {}).items()}
+        # changelog aux (lsm runs): resolve to absolute paths and
+        # inject into each op snapshot so BOTH restore paths — the
+        # driver's plain restore_state and repartition's merge — can
+        # find the run files without re-reading the manifest
+        aux_paths = {
+            int(nid): {logical: os.path.join(path, fn)
+                       for logical, fn in m.items()}
+            for nid, m in manifest.get("aux", {}).items()}
+        for nid, m in aux_paths.items():
+            if isinstance(ops.get(nid), dict):
+                ops[nid]["__aux_paths__"] = m
+        payload["op_aux_paths"] = aux_paths
         return payload
 
     def _pack(self, raw: bytes) -> bytes:

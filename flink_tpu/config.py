@@ -499,8 +499,33 @@ class StateOptions:
     BACKEND = ConfigOption(
         "state.backend", "hbm",
         "Keyed state backend: 'hbm' (dense pane tensors, the "
-        "HeapKeyedStateBackend analogue) or 'spill' (host offload, the "
-        "RocksDB analogue).")
+        "HeapKeyedStateBackend analogue), 'spill' (RAM-resident host "
+        "offload, the RocksDB analogue) or 'lsm' (disk-backed spill "
+        "tier: memtable delta bounded by state.memory-budget-bytes, "
+        "sealed into CRC'd columnar runs with changelog checkpoints — "
+        "the RocksDB + flink-dstl analogue, flink_tpu/state/lsm.py).")
+    MEMORY_BUDGET_BYTES = ConfigOption(
+        "state.memory-budget-bytes", 64 * 1024 * 1024,
+        "RAM ceiling for the in-memory delta (memtable) of the 'lsm' "
+        "backend, per windowed operator; when the delta's pane tables "
+        "exceed it, the delta is sealed into a sorted on-disk run. "
+        "Ignored by 'hbm' and 'spill' (those hold all state resident). "
+        "Must be at least state.lsm.run-floor-bytes.")
+    LSM_DIR = ConfigOption(
+        "state.lsm.dir", "/tmp/flink-tpu-state",
+        "Root directory for 'lsm' backend run files; each operator "
+        "instance gets a unique store subdirectory. Local filesystem "
+        "only (runs are mmap'd for zero-copy scans).")
+    LSM_COMPACT_MIN_RUNS = ConfigOption(
+        "state.lsm.compact-min-runs", 4,
+        "Sealed-run count that triggers a leveled compaction pass "
+        "(k-way monoid merge of all live runs into one higher-level "
+        "run, under the store's maintenance lock). Minimum 2.")
+    LSM_RUN_FLOOR_BYTES = ConfigOption(
+        "state.lsm.run-floor-bytes", 65536,
+        "Smallest useful sealed-run size; a memory budget below this "
+        "floor would seal degenerate runs on nearly every batch and is "
+        "rejected at analysis time (STATE_BUDGET_INVALID).")
     ALLOW_DROPS = ConfigOption(
         "state.allow-drops", False,
         "When a key-directory shard fills under state.backend='hbm', "

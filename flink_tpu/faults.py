@@ -148,6 +148,22 @@ Instrumented points (the stack's recovery-critical seams):
         raise/crash at each is a coordinator dying mid-phase, the
         chaos gates proving a takeover resumes or cleanly disarms an
         in-flight rescale and the job is never stranded)
+    state.run.seal / state.run.fsync               state/lsm.py
+        (the LSM tier's memtable-seal seam: .seal is the run write
+        dying before any bytes land, .fsync the durability barrier
+        dying AFTER the run bytes are staged but before the run is
+        published — either way the store manifest still names only
+        whole, durable runs and recovery replays the unsealed delta)
+    state.compact.swap                             state/lsm.py
+        (leveled run compaction's manifest-generation publish: a raise
+        there IS "crash between compaction rewrite and manifest swap"
+        — readers must observe the OLD run set whole, and the orphaned
+        compacted run is sweepable debris, mirroring log.compact.swap)
+    state.changelog.link                           checkpoint/storage.py
+        (the changelog-checkpoint hardlink seam: sealed run files ride
+        the incremental checkpoint plane by link_or_copy — a raise is
+        the link dying mid-checkpoint, the persist fails LOUDLY and
+        the previous completed checkpoint remains the restore point)
 
 Job-scoped plans (the session-cluster isolation contract): a runner
 process hosting N concurrent jobs cannot use the process-global plan —
@@ -236,6 +252,10 @@ KNOWN_FAULT_POINTS = frozenset((
     "rescale.arm",
     "rescale.savepoint",
     "rescale.redeploy",
+    "state.run.seal",
+    "state.run.fsync",
+    "state.compact.swap",
+    "state.changelog.link",
 ))
 
 # process-global fault/recovery metrics — chaos tests assert every

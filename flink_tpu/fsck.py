@@ -1,9 +1,11 @@
 """Storage fsck — offline integrity verification for the durable tiers.
 
 ``python -m flink_tpu fsck PATH [--repair] [--json]`` walks a log
-TOPIC directory or a CHECKPOINT directory (a job dir of ``chk-*``
-children, a single checkpoint dir, or a storage root of job dirs —
-autodetected) and verifies what the online readers assume:
+TOPIC directory, a CHECKPOINT directory (a job dir of ``chk-*``
+children, a single checkpoint dir, or a storage root of job dirs), or
+an LSM STATE STORE directory (``MANIFEST.json`` with format
+``lsm-state``, ``state/lsm.py``) — autodetected — and verifies what
+the online readers assume:
 
 - **segments**: every committed/compacted columnar file decodes whole —
   block CRCs (the ``native_codec.crc32`` path ``formats_columnar``
@@ -16,7 +18,13 @@ autodetected) and verifies what the online readers assume:
   parseable with un-expired deadlines;
 - **orphans**: ``.tmp`` debris, segments no marker/manifest references,
   ``.inprogress`` checkpoint dirs, manifest-less final-name checkpoint
-  dirs.
+  dirs;
+- **lsm state stores**: every manifest-listed run file exists and
+  decodes whole with the promised row count, the seq counter covers
+  every run (a lower counter would re-mint a live run's name), run
+  names unique; ``.tmp`` debris and unreferenced ``run-*.seg``
+  (crashed seal/compact pre-swap output, or compaction-replaced files
+  awaiting their grace sweep) report as repairable orphans.
 
 ``--repair`` applies ONLY the already-safe sweeps — exactly what the
 online recovery paths (``TopicAppender.sweep_orphans``, checkpoint
@@ -313,6 +321,65 @@ def fsck_topic(path: str) -> List[Dict[str, Any]]:
     return findings
 
 
+# -- lsm state store ----------------------------------------------------
+
+def fsck_lsm(path: str) -> List[Dict[str, Any]]:
+    """Verify an lsm state store directory (state/lsm.py) against its
+    manifest — the run files are immutable once published, so a full
+    decode pass is exactly what a restoring store would read."""
+    findings: List[Dict[str, Any]] = []
+    fs = get_filesystem(path)
+    mpath = os.path.join(path, "MANIFEST.json")
+    try:
+        man = _read_json(fs, mpath, "lsm-state manifest")
+    except (LogError, OSError) as e:
+        return [_f("CORRUPT_CONTROL", "error", mpath,
+                   f"unparseable lsm-state manifest: {e}")]
+    runs = man.get("runs", [])
+    seq = int(man.get("seq", 0))
+    seen: set = set()
+    for meta in runs:
+        name = meta.get("name", "?")
+        rpath = os.path.join(path, name)
+        if name in seen:
+            findings.append(_f(
+                "LSM_MANIFEST_INCOHERENT", "error", rpath,
+                f"run {name!r} listed twice in the manifest"))
+        seen.add(name)
+        if int(meta.get("seq", 0)) > seq:
+            findings.append(_f(
+                "LSM_MANIFEST_INCOHERENT", "error", rpath,
+                f"run seq {meta.get('seq')} exceeds the manifest seq "
+                f"counter {seq} — a restarting store would re-mint "
+                "this live run's name"))
+        if not fs.exists(rpath):
+            findings.append(_f(
+                "LSM_RUN_MISSING", "error", rpath,
+                f"manifest gen {man.get('gen')} references a run that "
+                "does not exist — the published state is unreadable"))
+        else:
+            # schema rides the run file itself (run_schema widths are
+            # the aggregate's business, not the manifest's)
+            _verify_segment(fs, rpath, None,
+                            int(meta["rows"]) if "rows" in meta else None,
+                            findings)
+    for name in sorted(fs.listdir(path)):
+        fpath = os.path.join(path, name)
+        if name.endswith(".tmp"):
+            findings.append(_f(
+                "ORPHAN_FILE", "warn", fpath,
+                "write-in-progress debris (crashed seal/compact)",
+                repairable=True))
+        elif (name.startswith("run-") and name.endswith(".seg")
+              and name not in seen):
+            findings.append(_f(
+                "ORPHAN_FILE", "warn", fpath,
+                "run referenced by no manifest generation (crashed "
+                "pre-swap output, or compaction-replaced and awaiting "
+                "the grace sweep)", repairable=True))
+    return findings
+
+
 # -- checkpoints --------------------------------------------------------
 
 def _fsck_one_checkpoint(fs, d: str,
@@ -423,12 +490,25 @@ def fsck_checkpoints(path: str) -> List[Dict[str, Any]]:
 # -- entry points -------------------------------------------------------
 
 def detect_kind(path: str) -> Optional[str]:
-    """'topic' | 'checkpoint' | None (unrecognizable)."""
+    """'topic' | 'checkpoint' | 'lsm' | None (unrecognizable)."""
     fs = get_filesystem(path)
     if not fs.exists(path) or not fs.is_dir(path):
         return None
     if fs.exists(os.path.join(path, "meta.json")):
         return "topic"
+    mpath = os.path.join(path, "MANIFEST.json")
+    if fs.exists(mpath):
+        try:
+            if _read_json(fs, mpath, "manifest").get(
+                    "format") == "lsm-state":
+                return "lsm"
+        except (LogError, OSError):
+            # damaged manifest: run files identify the tier anyway so
+            # the lsm scan can REPORT the corruption instead of the
+            # path reading as unrecognizable
+            if any(n.startswith("run-") and n.endswith(".seg")
+                   for n in fs.listdir(path)):
+                return "lsm"
     base = os.path.basename(os.path.normpath(path))
     if base.startswith(("chk-", "savepoint-")):
         return "checkpoint"
@@ -455,14 +535,16 @@ def fsck_path(path: str, repair: bool = False) -> List[Dict[str, Any]]:
     kind = detect_kind(path)
     if kind is None:
         raise ValueError(
-            f"{path!r} is neither a log topic (no meta.json) nor a "
-            "checkpoint directory (no chk-*/savepoint-* children)")
+            f"{path!r} is neither a log topic (no meta.json), a "
+            "checkpoint directory (no chk-*/savepoint-* children), "
+            "nor an lsm state store (no lsm-state MANIFEST.json)")
     findings = (fsck_topic(path) if kind == "topic"
+                else fsck_lsm(path) if kind == "lsm"
                 else fsck_checkpoints(path))
     if repair:
         fs = get_filesystem(path)
-        # topic repairs run under the maintenance lock: an unreferenced
-        # cmp file may be a LIVE pass's pre-swap output
+        # topic/lsm repairs run under the maintenance lock: an
+        # unreferenced cmp/run file may be a LIVE pass's pre-swap output
         maint_fd = None
         live_leased: set = set()
         if kind == "topic":
@@ -476,6 +558,12 @@ def fsck_path(path: str, repair: bool = False) -> List[Dict[str, Any]]:
                 p for p, rec in list_leases(path).items()
                 if not rec.get("released")
                 and int(rec.get("deadline_ms", 0)) >= now}
+        elif kind == "lsm":
+            from flink_tpu.log.topic import try_maintenance_lock
+
+            maint_fd = try_maintenance_lock(path)
+            if maint_fd is None:
+                return findings  # live seal/compact: nothing is safe
         try:
             for f in findings:
                 if not f["repairable"]:
@@ -500,13 +588,19 @@ def fsck_path(path: str, repair: bool = False) -> List[Dict[str, Any]]:
                         continue
                     if not _older_than(f["path"], REPAIR_MIN_AGE_S):
                         continue
+                elif kind == "lsm":
+                    # seal does not hold the maintenance lock — the
+                    # age grace is what protects a live store's
+                    # rename-pending tmp and pre-manifest run
+                    if not _older_than(f["path"], REPAIR_MIN_AGE_S):
+                        continue
                 try:
                     fs.delete(f["path"], recursive=fs.is_dir(f["path"]))
                     f["repaired"] = True
                 except OSError:
                     pass  # report stays repairable-but-unrepaired
         finally:
-            if kind == "topic" and maint_fd is not None:
+            if kind in ("topic", "lsm") and maint_fd is not None:
                 from flink_tpu.log.topic import release_maintenance_lock
 
                 release_maintenance_lock(path, maint_fd)

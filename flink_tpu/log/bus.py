@@ -352,23 +352,29 @@ class ConsumerGroups:
 
 
 @contextlib.contextmanager
-def _maintenance_pass(path: str):
+def maintenance_pass(path: str):
     """Serialize maintenance: one compaction/retention pass at a time
-    per topic (last-rename-wins on manifest.json would otherwise let
-    two concurrent passes delete each other's referenced files), and
-    the lock's presence tells a racing producer-recovery sweep that
+    per store directory (last-rename-wins on manifest.json would
+    otherwise let two concurrent passes delete each other's referenced
+    files), and the lock's presence tells a racing recovery sweep that
     unreferenced cmp files may be a live pass's PRE-swap output —
-    sweep_orphans skips cmp cleanup while it is held."""
+    sweep_orphans skips cmp cleanup while it is held. Public seam: the
+    LSM state tier (flink_tpu/state/lsm.py) runs its leveled run
+    compaction under the same discipline, one lock file per store."""
     fd = try_maintenance_lock(path)
     if fd is None:
         raise LogError(
-            f"another maintenance pass is running on topic {path!r} "
+            f"another maintenance pass is running on {path!r} "
             "(maintenance.lock held) — compaction/retention passes "
-            "are one-at-a-time per topic; retry when it finishes")
+            "are one-at-a-time per directory; retry when it finishes")
     try:
         yield
     finally:
         release_maintenance_lock(path, fd)
+
+
+# internal alias kept for the log tier's own call sites
+_maintenance_pass = maintenance_pass
 
 
 def _staged_floor(fs, path: str, partitions: int) -> Dict[int, int]:

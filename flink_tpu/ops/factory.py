@@ -54,6 +54,11 @@ class OperatorBuildContext:
     # pipeline.readiness: 'piggyback' (throttle consumes an announced
     # per-step token) or 'probe' (legacy is_ready spin)
     readiness: str = "piggyback"
+    # state.backend='lsm' (disk spill tier, state/lsm.py): memtable
+    # budget, run-file root, and the compaction trigger
+    memory_budget_bytes: int = 64 * 1024 * 1024
+    lsm_dir: str = "/tmp/flink-tpu-state"
+    lsm_compact_min_runs: int = 4
 
 
 OperatorFactory = Callable[[Any, OperatorBuildContext], Any]
@@ -80,6 +85,26 @@ def _window_factory(node, ctx: OperatorBuildContext):
     from flink_tpu.ops.window import WindowOperator
 
     t = node.window_transform
+    spill_store = None
+    if ctx.backend == "lsm":
+        import os
+        import uuid
+
+        from flink_tpu.state.lsm import LsmSpillStore
+
+        # unique per operator INSTANCE: run files are owned by one
+        # store for its lifetime (checkpoints hardlink them out; a
+        # restore links them back into the successor's fresh dir)
+        store_dir = os.path.join(
+            ctx.lsm_dir,
+            f"op{node.id}-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+        spill_store = LsmSpillStore(
+            t.aggregate, store_dir=store_dir,
+            memory_budget_bytes=ctx.memory_budget_bytes,
+            num_shards=ctx.num_shards,
+            compact_min_runs=ctx.lsm_compact_min_runs,
+            pool=ctx.host_pool,
+            fold_chunk_records=ctx.fold_chunk_records)
     op = WindowOperator(
         t.assigner, t.aggregate,
         num_shards=ctx.num_shards,
@@ -91,6 +116,7 @@ def _window_factory(node, ctx: OperatorBuildContext):
         top_n=t.top_n,
         exchange_capacity=ctx.exchange_capacity,
         spill=(ctx.backend == "spill"),
+        spill_store=spill_store,
         exchange_impl=ctx.exchange_impl,
         host_pool=ctx.host_pool,
         fold_chunk_records=ctx.fold_chunk_records,

@@ -1123,6 +1123,7 @@ class WindowOperator:
         exchange_capacity: Optional[int] = None,
         top_n: Optional[Tuple[str, int]] = None,
         spill: bool = False,
+        spill_store: Optional[Any] = None,
         exchange_impl: str = "all-to-all",
         host_pool: Optional[Any] = None,
         fold_chunk_records: Optional[int] = None,
@@ -1251,9 +1252,14 @@ class WindowOperator:
         # host (exact, slower) instead of dropping with a counter; the
         # shared host pool parallelizes its per-pane merges and
         # per-window fires (PROFILE §9.3)
-        self._spill = (HostSpillStore(
-            agg, pool=host_pool, fold_chunk_records=fold_chunk_records)
-            if spill else None)
+        # state.backend='lsm' passes an externally-built disk-tier
+        # store (state/lsm.py, duck-type-compatible) via spill_store;
+        # plain 'spill' builds the RAM store here
+        self._spill = (spill_store if spill_store is not None
+                       else HostSpillStore(
+                           agg, pool=host_pool,
+                           fold_chunk_records=fold_chunk_records)
+                       if spill else None)
         # top-n + spill: host rows can't ride per-fire markers because
         # device rows flow through the SHARED emit ring (a coalesced
         # drain would re-rank against the wrong fires). They queue here
@@ -3072,9 +3078,16 @@ class WindowOperator:
             self._reconcile_devstats()
         self._flush_stash()
         self._resolve_overflow()  # a checkpoint must not hide pending loss
-        return {
-            "spill": (self._spill.snapshot()
-                      if self._spill is not None else None),
+        spill_snap = (self._spill.snapshot()
+                      if self._spill is not None else None)
+        # lsm changelog cut: sealed-run files ride the checkpoint as
+        # hardlinks, not serialized state — lift their name→path map to
+        # the top level where the coordinator pops it for storage's
+        # op_aux plane (checkpoint/storage.py save_v2)
+        aux_files = (spill_snap.pop("aux_files", None)
+                     if isinstance(spill_snap, dict) else None)
+        out = {
+            "spill": spill_snap,
             "n_dev": self.mesh_plan.n_devices if self.mesh_plan else 1,
             "ring": self.plan.ring,
             # on-device CLONE, not a fetch: the freeze stays in-loop and
@@ -3095,6 +3108,9 @@ class WindowOperator:
             "late_records": self.late_records,
             "records_dropped_full": self.records_dropped_full,
         }
+        if aux_files:
+            out["__aux_files__"] = aux_files
+        return out
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
         panes = snap["panes"]
@@ -3140,15 +3156,35 @@ class WindowOperator:
         self._inflight.clear()
         snap_spill = snap.get("spill")
         if self._spill is not None and snap_spill is not None:
-            self._spill.restore(snap_spill)
-        elif self._spill is None and snap_spill and snap_spill.get("panes"):
+            if isinstance(self._spill, HostSpillStore):
+                if snap_spill.get("kind") == "lsm":
+                    # lsm→spill flip: the delta restores (same pane
+                    # form) but sealed runs hold state a RAM store has
+                    # no files for — refuse rather than silently drop
+                    if snap_spill.get("runs"):
+                        raise ValueError(
+                            "snapshot carries "
+                            f"{len(snap_spill['runs'])} sealed lsm "
+                            "run(s) the RAM spill store cannot adopt; "
+                            "restore with state.backend='lsm'")
+                    self._spill.restore(snap_spill["delta"])
+                else:
+                    self._spill.restore(snap_spill)
+            else:
+                # disk tier: accepts both the lsm form (aux maps run
+                # name → checkpoint hardlink, injected by storage.load)
+                # and a plain spill snapshot (spill→lsm backend flip)
+                self._spill.restore(
+                    snap_spill, aux_paths=snap.get("__aux_paths__"))
+        elif self._spill is None and snap_spill and (
+                snap_spill.get("panes") or snap_spill.get("runs")
+                or (snap_spill.get("delta") or {}).get("panes")):
             # the snapshot carries live host-resident aggregates this
             # operator (state.backend='hbm') cannot hold — restoring
             # would silently lose them
             raise ValueError(
-                "snapshot contains host-spill state for "
-                f"{len(snap_spill['panes'])} pane(s) but state.backend "
-                "is 'hbm'; restore with state.backend='spill'")
+                "snapshot contains host-spill state but state.backend "
+                "is 'hbm'; restore with state.backend='spill' or 'lsm'")
         self._used_pushed = -1  # directory changed: invalidate device used-mask
         # emit ring resets: everything it held was delivered before the
         # snapshot (checkpoint flushes emits first); replay re-fires

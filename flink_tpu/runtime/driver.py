@@ -271,9 +271,10 @@ class Driver:
                 f"got {xcap}")
         xcap = xcap or None
         backend = self.config.get(StateOptions.BACKEND)
-        if backend not in ("hbm", "spill"):
+        if backend not in ("hbm", "spill", "lsm"):
             raise ValueError(
-                f"state.backend must be 'hbm' or 'spill', got {backend!r}")
+                f"state.backend must be 'hbm', 'spill' or 'lsm', "
+                f"got {backend!r}")
         # pane-ring sizing must cover the worst watermark lag of ANY
         # source feeding the job (per-source strategies override the
         # plan default)
@@ -330,6 +331,11 @@ class Driver:
             fold_chunk_records=fold_chunk,
             fire_gate=self._fire_gate,
             readiness=self._readiness,
+            memory_budget_bytes=int(
+                self.config.get(StateOptions.MEMORY_BUDGET_BYTES)),
+            lsm_dir=str(self.config.get(StateOptions.LSM_DIR)),
+            lsm_compact_min_runs=int(
+                self.config.get(StateOptions.LSM_COMPACT_MIN_RUNS)),
         )
         allow_drops = bool(self.config.get(StateOptions.ALLOW_DROPS))
         for n in self.plan.nodes.values():
@@ -479,7 +485,12 @@ class Driver:
             if (v is not None and base is not None
                     and base["versions"].get(nid) == v
                     and nid in base["files"]):
-                ops[nid] = ReusedOpState(base["files"][nid], int(v))
+                ops[nid] = ReusedOpState(
+                    base["files"][nid], int(v),
+                    # changelog aux (lsm runs) re-links from the BASE
+                    # checkpoint's own hardlinks, never the store's
+                    # live files — reuse must survive store compaction
+                    aux=(base.get("aux") or {}).get(nid))
             else:
                 ops[nid] = op.snapshot_state()
         self._last_freeze_versions = {
@@ -566,6 +577,8 @@ class Driver:
             self._ckpt_base = {
                 "files": dict(payload.get("op_files", {})),
                 "versions": dict(file_versions),
+                "aux": {nid: dict(m) for nid, m in
+                        (payload.get("op_aux_paths") or {}).items()},
             }
         self.metrics.update(payload["metrics"])
         staged_sinks = payload.get("sinks", {})
@@ -1299,11 +1312,17 @@ class Driver:
         self._ckpt_pending = None
         if not p.is_savepoint:
             names = handle.op_files or {}
+            aux_names = handle.op_aux or {}
             self._ckpt_base = {
                 "files": {nid: _os.path.join(
                     handle.path, names.get(str(nid), f"op-{nid}.blob"))
                     for nid in self._ops},
                 "versions": dict(p.frozen_versions),
+                "aux": {nid: {logical: _os.path.join(handle.path, fn)
+                              for logical, fn in
+                              aux_names.get(str(nid), {}).items()}
+                        for nid in self._ops
+                        if aux_names.get(str(nid))},
             }
         return handle
 

@@ -4,5 +4,8 @@ from flink_tpu.state.keyed import (
     KeyDirectory,
     init_state,
 )
+from flink_tpu.state.lsm import LsmSpillStore
+from flink_tpu.state.spill import HostSpillStore
 
-__all__ = ["PaneStateLayout", "PaneState", "KeyDirectory", "init_state"]
+__all__ = ["PaneStateLayout", "PaneState", "KeyDirectory", "init_state",
+           "HostSpillStore", "LsmSpillStore"]

@@ -267,6 +267,22 @@ def _rescale_cooldown_below_checkpoint_interval(tmp_path):
         "rescale.cooldown": "5s"}))
 
 
+@seed("STATE_BUDGET_INVALID")
+def _lsm_budget_below_run_floor(tmp_path):
+    # budget below the run floor: every absorb seals a degenerate run
+    return analyze_config(Configuration({
+        "state.backend": "lsm",
+        "state.memory-budget-bytes": 4096}))
+
+
+@seed("STATE_BUDGET_IGNORED")
+def _budget_set_on_resident_backend(tmp_path):
+    # hbm/spill ignore the budget key — the bound does not exist
+    return analyze_config(Configuration({
+        "state.backend": "spill",
+        "state.memory-budget-bytes": 1 << 20}))
+
+
 # -- dataflow-plane seeds (the propagated lattices; full coverage and
 # clean negatives live in tests/test_dataflow.py) ---------------------------
 
@@ -719,3 +735,61 @@ class TestRescaleRule:
             "rescale.target-pressure-high": 10,
             "rescale.target-pressure-low": 90,
             "rescale.cooldown": "0ms"}) == []
+
+
+class TestStateBudgetRule:
+    """ISSUE 17: STATE_BUDGET_INVALID / STATE_BUDGET_IGNORED — the
+    state.* backend grammar's can-never-work shapes error at submit,
+    the does-nothing shape warns, and legal configs stay silent."""
+
+    def _rules(self, conf):
+        return [(f.rule, f.severity) for f in analyze_config(
+            Configuration(conf))
+            if f.rule.startswith("STATE_BUDGET")]
+
+    def test_unknown_backend_errors(self):
+        assert ("STATE_BUDGET_INVALID", "error") in self._rules(
+            {"state.backend": "rocksdb"})
+
+    def test_lsm_budget_below_run_floor_errors(self):
+        # default floor is 64 KiB; a 4 KiB budget seals per batch
+        assert ("STATE_BUDGET_INVALID", "error") in self._rules(
+            {"state.backend": "lsm",
+             "state.memory-budget-bytes": 4096})
+
+    def test_unparseable_budget_errors(self):
+        assert ("STATE_BUDGET_INVALID", "error") in self._rules(
+            {"state.backend": "lsm",
+             "state.memory-budget-bytes": "lots"})
+
+    def test_compact_min_runs_below_two_errors(self):
+        assert ("STATE_BUDGET_INVALID", "error") in self._rules(
+            {"state.backend": "lsm",
+             "state.lsm.compact-min-runs": 1})
+
+    def test_budget_on_resident_backend_warns_not_errors(self):
+        rules = self._rules({"state.backend": "spill",
+                             "state.memory-budget-bytes": 1 << 20})
+        assert ("STATE_BUDGET_IGNORED", "warn") in rules
+        assert ("STATE_BUDGET_INVALID", "error") not in rules
+
+    def test_lowered_floor_makes_tiny_budget_legal(self):
+        # the crash-test shape: tiny runs on purpose, floor lowered to
+        # match — self-consistent, must stay silent
+        assert self._rules({
+            "state.backend": "lsm",
+            "state.memory-budget-bytes": 4096,
+            "state.lsm.run-floor-bytes": 4096}) == []
+
+    def test_legal_lsm_config_is_silent(self):
+        assert self._rules({
+            "state.backend": "lsm",
+            "state.memory-budget-bytes": 64 << 20,
+            "state.lsm.compact-min-runs": 4}) == []
+
+    def test_default_config_is_silent(self):
+        assert self._rules({}) == []
+
+    def test_budget_unset_on_resident_backend_is_silent(self):
+        # hbm with no budget key: nothing to warn about
+        assert self._rules({"state.backend": "hbm"}) == []

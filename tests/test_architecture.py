@@ -202,7 +202,7 @@ class TestDurableWriteSeam:
         "checkpoint/storage.py", "checkpoint/coordinator.py",
         "api/sinks.py", "connectors.py",
         "runtime/ha.py", "runtime/blob.py", "runtime/session.py",
-        "fsck.py",
+        "fsck.py", "state/lsm.py",
     )
 
     @staticmethod

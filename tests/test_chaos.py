@@ -830,6 +830,56 @@ class TestHostPoolChaos:
             assert 1 <= len(recoveries) <= 3
 
 
+class TestLsmChaos:
+    """Chaos at the DISK tier's own durable seams (ISSUE 17): run seal,
+    run fsync, compaction swap, and the checkpoint changelog hardlink.
+    The spill-overflow pipeline runs with ``state.backend=lsm`` and a
+    budget tiny enough that every batch seals — committed output must
+    stay byte-identical to the fault-free golden of the same lsm job.
+    A fault mid-seal or mid-compact kills the attempt; recovery builds
+    a FRESH store dir and replays from the last checkpoint, so torn
+    tmp files in the dead store's dir are abandoned debris (fsck's
+    territory), never adopted state."""
+
+    def _conf(self, tmp_path):
+        return {"state.backend": "lsm", "state.slots-per-shard": 4,
+                "state.memory-budget-bytes": 4096,
+                "state.lsm.run-floor-bytes": 4096,
+                "state.lsm.dir": str(tmp_path / "lsm"),
+                "host.parallelism": 1}
+
+    def _drive(self, tmp_path, point, after, extra=None):
+        t = TestHostPoolChaos()
+        conf = {**self._conf(tmp_path), **(extra or {})}
+        golden = t._golden(t._spill_builder, committed_view, tmp_path,
+                           extra=conf)
+        plan = (faults.FaultPlan(seed=CHAOS_SEED)
+                .rule(point, "raise", count=1, after=after))
+        got, recoveries, fault_spans = t._chaos(
+            t._spill_builder, committed_view, tmp_path, plan,
+            extra=conf)
+        with replayable(plan):
+            assert got == golden
+            assert [x[:2] for x in plan.log] == [(point, "raise")]
+            assert len(fault_spans) == 1
+            assert len(recoveries) >= 1
+
+    def test_seal_fault_exactly_once(self, tmp_path):
+        self._drive(tmp_path, "state.run.seal", after=3)
+
+    def test_run_fsync_fault_exactly_once(self, tmp_path):
+        self._drive(tmp_path, "state.run.fsync", after=2)
+
+    def test_compact_swap_fault_exactly_once(self, tmp_path):
+        # tumbling purge retires runs fast; compact at 2 so the pass
+        # actually happens inside an 8-batch run
+        self._drive(tmp_path, "state.compact.swap", after=0,
+                    extra={"state.lsm.compact-min-runs": 2})
+
+    def test_changelog_link_fault_exactly_once(self, tmp_path):
+        self._drive(tmp_path, "state.changelog.link", after=2)
+
+
 @pytest.mark.slow
 class TestHostPoolChaosSoak:
     """Randomized multi-seed soak of the pool-on spill overflow and

@@ -106,6 +106,55 @@ class TestExitCodeContract:
         assert cli_main(["fsck", str(tmp_path / "absent")]) == 2
         capsys.readouterr()
 
+    def test_fsck_lsm_store_exit_contract(self, tmp_path, capsys):
+        """ISSUE 17: the lsm state tier joins the same fsck contract —
+        a healthy store is 0, seeded debris (orphan run + tmp, both
+        back-dated past the live-seal grace) is 1, --repair sweeps the
+        debris back to 0, and a run the manifest promises but the disk
+        lost stays a non-repairable 1."""
+        import numpy as np
+
+        from flink_tpu.state.lsm import LsmSpillStore
+
+        class _Agg:
+            sum_width = max_width = min_width = 1
+
+            def lift_masked(self, data, valid):
+                v = np.asarray(data["v"], np.float32)[:, None]
+                return v, v, v
+
+        store_dir = str(tmp_path / "store")
+        store = LsmSpillStore(_Agg(), store_dir=store_dir,
+                              memory_budget_bytes=0, num_shards=8,
+                              compact_min_runs=99)
+        store.absorb(np.arange(8, dtype=np.int64),
+                     np.zeros(8, dtype=np.int64),
+                     {"v": np.arange(8, dtype=np.float32)})
+        assert cli_main(["fsck", store_dir]) == 0
+        # seed repairable debris: an unreferenced run + seal tmp
+        old = time.time() - 3600
+        for name in ("run-000099.seg", "run-000100.seg.tmp"):
+            p = os.path.join(store_dir, name)
+            with open(p, "wb") as f:
+                f.write(b"debris")
+            os.utime(p, (old, old))
+        assert cli_main(["fsck", store_dir]) == 1
+        capsys.readouterr()
+        cli_main(["fsck", store_dir, "--json"])
+        for line in capsys.readouterr().out.strip().splitlines():
+            f = json.loads(line)
+            assert {"rule", "severity", "path", "message",
+                    "repairable", "repaired"} <= set(f)
+        assert cli_main(["fsck", store_dir, "--repair"]) == 0
+        assert cli_main(["fsck", store_dir]) == 0
+        # a manifest-promised run the disk lost is loud and NOT
+        # repairable — fsck must never "fix" state loss by forgetting
+        live_run = store._runs[0]["name"]
+        os.unlink(os.path.join(store_dir, live_run))
+        assert cli_main(["fsck", store_dir]) == 1
+        assert cli_main(["fsck", store_dir, "--repair"]) == 1
+        capsys.readouterr()
+
 
 class TestSessionHaCli:
     """ISSUE 11 satellite: the session CLI resolves the leader through

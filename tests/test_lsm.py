@@ -1,0 +1,326 @@
+"""Spill-tiered LSM keyed-state backend (flink_tpu/state/lsm.py, ISSUE
+17): keyed state beyond the in-memory budget degrades to DISK, never
+wrong — the RocksDB + flink-dstl changelog analogue. The golden
+contract extends test_spill.py's: a run with ``state.backend='lsm'``
+and a budget ~100x below the working set must produce byte-identical
+results to a roomy in-memory run, the restore path must be
+byte-identical across the spill/no-spill config flip, and compaction
+must never change fired bytes (one shared fold order: runs in seal
+order, delta last)."""
+import os
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.api.sinks import CollectSink
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.api.windowing import (
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+)
+from flink_tpu.config import Configuration
+from flink_tpu.ops import aggregates
+from flink_tpu.ops.window import WindowOperator
+from flink_tpu.state.lsm import LsmSpillStore, merge_rescale_spill
+from flink_tpu.state.spill import HostSpillStore
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+from tests.test_spill import churn_source, rows_of, run_pipeline
+
+
+def make_env(tmp_path, slots=4, backend="lsm", budget=4096, extra=None):
+    conf = {
+        "state.num-key-shards": 4,
+        "state.slots-per-shard": slots,
+        "state.backend": backend,
+        "pipeline.microbatch-size": 256,
+    }
+    if backend == "lsm":
+        # tiny-run shape on purpose: floor lowered to match (the
+        # STATE_BUDGET_INVALID self-consistency contract)
+        conf.update({
+            "state.memory-budget-bytes": budget,
+            "state.lsm.run-floor-bytes": min(budget, 65536),
+            "state.lsm.dir": str(tmp_path / "lsm"),
+        })
+    conf.update(extra or {})
+    return StreamExecutionEnvironment(Configuration(conf))
+
+
+def _mk_store(tmp_path, name="store", budget=0, agg=None, **kw):
+    return LsmSpillStore(
+        agg or aggregates.multi(aggregates.sum_of("v"),
+                                aggregates.max_of("v")),
+        store_dir=str(tmp_path / name), memory_budget_bytes=budget,
+        num_shards=4, **kw)
+
+
+def _churn(store, n_batches=6, n_keys=400, b=128):
+    for i in range(n_batches):
+        rng = np.random.default_rng(42 + i)
+        store.absorb(rng.integers(0, n_keys, b).astype(np.int64),
+                     rng.integers(0, 4, b).astype(np.int64),
+                     {"v": rng.integers(1, 100, b).astype(np.int64)})
+
+
+def _fired(store):
+    rows = store.fire([4], panes_per_window=4, pane_ms=1000,
+                      offset_ms=0, size_ms=4000)
+    return {k: np.asarray(v) for k, v in dict(rows).items()}
+
+
+class TestLsmGolden:
+    def test_count_100x_budget_exact(self, tmp_path):
+        """1600 distinct keys through a 4 KiB delta budget (the working
+        set is ~100x larger): disk-tiered run == roomy in-memory run."""
+        roomy, _ = run_pipeline(
+            StreamExecutionEnvironment(Configuration({
+                "state.num-key-shards": 4,
+                "state.slots-per-shard": 2048,
+                "pipeline.microbatch-size": 256})),
+            lambda s: s.count(), TumblingEventTimeWindows.of(1_000))
+        tiny, res = run_pipeline(make_env(tmp_path),
+                                 lambda s: s.count(),
+                                 TumblingEventTimeWindows.of(1_000))
+        assert rows_of(roomy) == rows_of(tiny)
+        assert res.metrics["records_spilled"] > 0
+
+    def test_multi_lane_sliding_exact(self, tmp_path):
+        agg = aggregates.multi(
+            aggregates.sum_of("v"), aggregates.max_of("v"),
+            aggregates.avg_of("v"))
+        roomy, _ = run_pipeline(
+            StreamExecutionEnvironment(Configuration({
+                "state.num-key-shards": 4,
+                "state.slots-per-shard": 2048,
+                "pipeline.microbatch-size": 256})),
+            lambda s: s.aggregate(agg),
+            SlidingEventTimeWindows.of(2_000, 1_000))
+        tiny, res = run_pipeline(make_env(tmp_path),
+                                 lambda s: s.aggregate(agg),
+                                 SlidingEventTimeWindows.of(2_000, 1_000))
+        assert rows_of(roomy) == rows_of(tiny)
+        assert res.metrics["records_spilled"] > 0
+
+    def test_budget_flip_is_byte_identical(self, tmp_path):
+        """The spill/no-spill flip: a budget large enough that nothing
+        ever seals vs one that seals constantly — same bytes out (the
+        tiering decision is invisible to results)."""
+        never, _ = run_pipeline(
+            make_env(tmp_path / "roomy", budget=1 << 30),
+            lambda s: s.count(), TumblingEventTimeWindows.of(1_000))
+        always, _ = run_pipeline(
+            make_env(tmp_path / "tiny", budget=4096),
+            lambda s: s.count(), TumblingEventTimeWindows.of(1_000))
+        assert rows_of(never) == rows_of(always)
+
+    def test_ram_spill_backend_unchanged(self, tmp_path):
+        """The RAM tier and the disk tier agree row-for-row on the
+        same churn (the tiers share the HostSpillStore fold)."""
+        ram, _ = run_pipeline(
+            StreamExecutionEnvironment(Configuration({
+                "state.num-key-shards": 4, "state.slots-per-shard": 4,
+                "state.backend": "spill",
+                "pipeline.microbatch-size": 256})),
+            lambda s: s.count(), TumblingEventTimeWindows.of(1_000))
+        disk, _ = run_pipeline(make_env(tmp_path),
+                               lambda s: s.count(),
+                               TumblingEventTimeWindows.of(1_000))
+        assert rows_of(ram) == rows_of(disk)
+
+
+class TestLsmCheckpoint:
+    def _op(self, tmp_path, name):
+        store = LsmSpillStore(
+            aggregates.count(), store_dir=str(tmp_path / name),
+            memory_budget_bytes=0, num_shards=4)
+        return WindowOperator(
+            TumblingEventTimeWindows.of(1_000), aggregates.count(),
+            num_shards=4, slots_per_shard=2,
+            max_out_of_orderness_ms=500, spill_store=store), store
+
+    def test_snapshot_restore_roundtrip_with_runs(self, tmp_path):
+        """Snapshot mid-stream with SEALED RUNS on disk, restore into a
+        fresh operator with a fresh store dir (runs adopted via the
+        aux-path hardlink map), continue — results match an
+        uninterrupted twin."""
+        keys1 = np.arange(40, dtype=np.int64)
+        ts1 = np.full(40, 300, np.int64)
+        keys2 = np.arange(40, dtype=np.int64)
+        ts2 = np.full(40, 700, np.int64)
+
+        straight, _ = self._op(tmp_path, "straight")
+        straight.process_batch(keys1, ts1, {})
+        straight.process_batch(keys2, ts2, {})
+        want = dict(straight.advance_watermark(2_000))
+
+        a, sa = self._op(tmp_path, "a")
+        a.process_batch(keys1, ts1, {})
+        snap = a.snapshot_state()
+        assert snap["__aux_files__"], "no sealed runs rode the snapshot"
+        # what storage.load() does: aux logical names -> on-disk paths
+        snap["__aux_paths__"] = snap["__aux_files__"]
+        b, sb = self._op(tmp_path, "b")
+        b.restore_state(snap)
+        b.process_batch(keys2, ts2, {})
+        got = dict(b.advance_watermark(2_000))
+
+        ow = np.lexsort((np.asarray(want["key"]),
+                         np.asarray(want["window_end"])))
+        og = np.lexsort((np.asarray(got["key"]),
+                         np.asarray(got["window_end"])))
+        for f in want:
+            np.testing.assert_array_equal(
+                np.asarray(want[f])[ow], np.asarray(got[f])[og],
+                err_msg=f)
+
+    def test_restore_with_runs_into_ram_spill_refuses(self, tmp_path):
+        a, _ = self._op(tmp_path, "a")
+        a.process_batch(np.arange(10, dtype=np.int64),
+                        np.full(10, 100, np.int64), {})
+        snap = a.snapshot_state()
+        assert snap["spill"]["runs"]
+        b = WindowOperator(
+            TumblingEventTimeWindows.of(1_000), aggregates.count(),
+            num_shards=4, slots_per_shard=2, max_out_of_orderness_ms=500,
+            spill=True)
+        with pytest.raises(ValueError, match="lsm"):
+            b.restore_state(snap)
+
+    def test_restore_into_hbm_refuses(self, tmp_path):
+        a, _ = self._op(tmp_path, "a")
+        a.process_batch(np.arange(10, dtype=np.int64),
+                        np.full(10, 100, np.int64), {})
+        snap = a.snapshot_state()
+        b = WindowOperator(
+            TumblingEventTimeWindows.of(1_000), aggregates.count(),
+            num_shards=4, slots_per_shard=2, max_out_of_orderness_ms=500)
+        with pytest.raises(ValueError, match="spill"):
+            b.restore_state(snap)
+
+    def test_ram_spill_snapshot_restores_into_lsm(self, tmp_path):
+        """The spill→lsm backend flip: a plain RAM spill snapshot
+        restores into the disk tier (it becomes the delta)."""
+        a = WindowOperator(
+            TumblingEventTimeWindows.of(1_000), aggregates.count(),
+            num_shards=4, slots_per_shard=2, max_out_of_orderness_ms=500,
+            spill=True)
+        a.process_batch(np.arange(40, dtype=np.int64),
+                        np.full(40, 300, np.int64), {})
+        snap = a.snapshot_state()
+        assert snap["spill"]["panes"]
+        b, sb = self._op(tmp_path, "b")
+        b.restore_state(snap)
+        got = dict(b.advance_watermark(2_000))
+        assert sorted(int(k) for k in got["key"]) == list(range(40))
+
+
+class TestLsmStoreUnit:
+    def test_tiered_fire_matches_ram_fire_bitwise(self, tmp_path):
+        """Every absorb seals (budget 0) and the fire must still be
+        bit-identical to the all-RAM store fed the same churn: one
+        shared fold order (runs in seal order, delta last)."""
+        ram = HostSpillStore(aggregates.multi(
+            aggregates.sum_of("v"), aggregates.max_of("v")))
+        disk = _mk_store(tmp_path, budget=0)
+        _churn(ram)
+        _churn(disk)
+        assert disk.seals > 0
+        want, got = _fired(ram), _fired(disk)
+        assert set(want) == set(got)
+        for f in want:
+            np.testing.assert_array_equal(want[f], got[f], err_msg=f)
+
+    def test_compaction_preserves_fired_bytes(self, tmp_path):
+        disk = _mk_store(tmp_path, budget=0, compact_min_runs=99)
+        _churn(disk)
+        before = _fired(disk)
+        n_before = len(disk._runs)
+        assert disk.compact()
+        assert len(disk._runs) < n_before
+        after = _fired(disk)
+        for f in before:
+            np.testing.assert_array_equal(before[f], after[f],
+                                          err_msg=f)
+
+    def test_purge_drops_dead_runs_and_floor_persists(self, tmp_path):
+        disk = _mk_store(tmp_path, budget=0)
+        _churn(disk)
+        disk.purge_below(4)
+        assert disk.fire([4], 4, 1000, 0, 4000) is None
+        # dead runs left the manifest; a warm restart keeps the floor
+        again = _mk_store(tmp_path, budget=0)
+        assert again._floor == 4
+        assert again.fire([4], 4, 1000, 0, 4000) is None
+
+    def test_warm_restart_adopts_manifest(self, tmp_path):
+        a = _mk_store(tmp_path, budget=0)
+        _churn(a)
+        want = _fired(a)
+        b = _mk_store(tmp_path, budget=0)  # same dir: manifest is truth
+        got = _fired(b)
+        for f in want:
+            np.testing.assert_array_equal(want[f], got[f], err_msg=f)
+
+    def test_orphan_run_swept_on_open(self, tmp_path):
+        a = _mk_store(tmp_path, budget=0)
+        _churn(a, n_batches=2)
+        orphan = os.path.join(a.dir, "run-000099.seg")
+        with open(orphan, "wb") as f:
+            f.write(b"crashed seal")
+        _mk_store(tmp_path, budget=0)
+        assert not os.path.exists(orphan)
+
+
+class TestLsmRescale:
+    def test_full_range_merge_matches_own_fold_bitwise(self, tmp_path):
+        """merge_rescale_spill over the store's full shard range must
+        reproduce the store's OWN fold exactly — the fold order (seal
+        order, delta last) is shared, so not a single float moves."""
+        store = _mk_store(tmp_path, budget=4096)
+        _churn(store)
+        assert store._runs, "churn never sealed — test is vacuous"
+        snap = store.snapshot()
+        merged = merge_rescale_spill(
+            [(snap, snap.get("aux_files") or {})],
+            num_shards=4, shard_lo=0, shard_hi=4)
+        own = store._fold_runs(store._live_runs(), include_delta=True)
+        got = {int(p): t for p, t in merged["delta"]["panes"].items()}
+        assert set(got) == set(int(p) for p in own.panes)
+        for p, want in own.panes.items():
+            for i in range(5):
+                np.testing.assert_array_equal(
+                    np.asarray(want[i]), np.asarray(got[int(p)][i]),
+                    err_msg=f"pane {p} lane {i}")
+
+    def test_half_range_merge_filters_by_stored_shard(self, tmp_path):
+        from flink_tpu.exchange.partitioners import hash_shards
+
+        store = _mk_store(tmp_path, budget=4096)
+        _churn(store)
+        snap = store.snapshot()
+        merged = merge_rescale_spill(
+            [(snap, snap.get("aux_files") or {})],
+            num_shards=4, shard_lo=0, shard_hi=2)
+        own = store._fold_runs(store._live_runs(), include_delta=True)
+        for p, want in own.panes.items():
+            keys = np.asarray(want[0])
+            keep = hash_shards(keys, 4) < 2
+            got = merged["delta"]["panes"].get(int(p))
+            if not keep.any():
+                assert got is None or len(got[0]) == 0
+                continue
+            for i in range(5):
+                np.testing.assert_array_equal(
+                    np.asarray(want[i])[keep] if i else keys[keep],
+                    np.asarray(got[i]), err_msg=f"pane {p} lane {i}")
+
+    def test_missing_aux_is_loud(self, tmp_path):
+        store = _mk_store(tmp_path, budget=0)
+        _churn(store, n_batches=2)
+        snap = store.snapshot()
+        assert snap["runs"]
+        with pytest.raises(ValueError, match="aux"):
+            merge_rescale_spill([(snap, {})],
+                                num_shards=4, shard_lo=0, shard_hi=4)

@@ -168,6 +168,60 @@ class TestWindowRescale:
         with pytest.raises(RescaleError, match="spill"):
             _merge(payloads, 0, 1, {"w": "window"})
 
+    def test_lsm_spilled_state_repartitions(self, tmp_path):
+        """ISSUE 17: the DISK tier's snapshot repartitions where the
+        RAM tier refuses — run rows carry their key-group shard, so
+        merge-down (2 -> 1) continues the reference timeline with
+        host-spilled aggregates intact."""
+        def mk(name, shard_range=None):
+            from flink_tpu.state.lsm import LsmSpillStore
+
+            store = LsmSpillStore(
+                sum_of("v"), store_dir=str(tmp_path / name),
+                memory_budget_bytes=0, num_shards=NS)
+            return WindowOperator(
+                TumblingEventTimeWindows.of(1000), sum_of("v"),
+                num_shards=NS, slots_per_shard=SPS,
+                shard_range=shard_range, spill_store=store)
+
+        ref = mk("ref")
+        olds = [mk("old0", (0, 4)), mk("old1", (4, 8))]
+        for seed, t0 in [(1, 0), (2, 1000)]:
+            # ~5x the resident capacity: most keys spill to the tier
+            keys, ts, data = _batch(seed, t0, n=512, n_keys=600)
+            ref.process_batch(keys, ts, data)
+            for op, (k, t, d) in zip(olds, _route(keys, ts, data, 2)):
+                op.process_batch(k, t, d)
+
+        payloads = [_payload({"w": op.snapshot_state()}, pid, 2)
+                    for pid, op in enumerate(olds)]
+        assert any(p["operators"]["w"]["spill"]["runs"]
+                   for p in payloads), "nothing sealed — vacuous"
+        merged = _merge(payloads, 0, 1, {"w": "window"})
+        new = mk("new")
+        new.restore_state(merged["operators"]["w"])
+
+        keys, ts, data = _batch(3, 2000, n=512, n_keys=600)
+        ref.process_batch(keys, ts, data)
+        new.process_batch(keys, ts, data)
+        assert (_rows(new.advance_watermark(5000))
+                == _rows(ref.advance_watermark(5000)))
+
+    def test_lsm_num_shards_mismatch_refuses(self, tmp_path):
+        from flink_tpu.state.lsm import LsmSpillStore
+
+        olds = [self._mk((0, 4)), self._mk((4, 8))]
+        snaps = [op.snapshot_state() for op in olds]
+        store = LsmSpillStore(sum_of("v"),
+                              store_dir=str(tmp_path / "s"),
+                              memory_budget_bytes=1 << 30,
+                              num_shards=NS * 2)  # different key space
+        snaps[1]["spill"] = store.snapshot()
+        payloads = [_payload({"w": s}, pid, 2)
+                    for pid, s in enumerate(snaps)]
+        with pytest.raises(RescaleError, match="num_shards"):
+            _merge(payloads, 0, 1, {"w": "window"})
+
     def test_diverged_pane_rings_refuse_to_splice(self):
         olds = [self._mk((0, 4)), self._mk((4, 8))]
         snaps = [op.snapshot_state() for op in olds]
