@@ -251,21 +251,30 @@ def log_topic_multi_writer(plan, config) -> Iterable[Finding]:
 
 @config_rule("STORAGE_LOCAL_LOCKS_ON_REMOTE", "warn",
              fix="keep high-availability.dir and log.dir on local "
-                 "(file://) paths, or accept the documented "
+                 "(file://) paths or a conditional-put scheme "
+                 "(objstore://), or accept the documented "
                  "degradation: read-check-write acquisition races are "
                  "then bounded only by epoch fencing at the next "
                  "verify, not prevented")
 def storage_local_locks_on_remote(plan, config) -> Iterable[Finding]:
-    """Lock-dependent storage on a non-``file`` scheme: the O_EXCL +
-    rename-first lock discipline (HA leader-election leases, the log
-    tier's writer-lease acquisition locks and maintenance locks) is
-    LOCAL-filesystem-only — ``os.open(O_CREAT|O_EXCL)`` has no remote
-    equivalent here, so on any other scheme acquisition degrades to
-    read-check-write (PR 9/11 honest residue). Two racing acquirers
-    can then both believe they won until the next epoch verify rejects
-    one — bounded, but no longer prevented. Flag the intent early, at
-    submit, instead of as a once-a-month double-leader incident."""
+    """Lock-dependent storage on a non-``file`` scheme WITHOUT
+    conditional writes: the O_EXCL + rename-first lock discipline (HA
+    leader-election leases, the log tier's writer-lease acquisition
+    locks and maintenance locks) is LOCAL-filesystem-only —
+    ``os.open(O_CREAT|O_EXCL)`` has no remote equivalent here. A
+    scheme whose registered driver advertises ``conditional_put``
+    (``fs.cas_capable`` — the objstore driver's ``put_if`` CAS) is
+    QUIET: every lock-dependent path ports onto compare-and-swap
+    there, which PREVENTS the race rather than bounding it. On any
+    other remote scheme acquisition degrades to read-check-write
+    (PR 9/11 honest residue): two racing acquirers can both believe
+    they won until the next epoch verify rejects one. Flag the intent
+    early, at submit, instead of as a once-a-month double-leader
+    incident. Driver-aware: probes the scheme's REGISTERED filesystem,
+    so an out-of-tree driver that grows CAS silences this rule by
+    declaring it."""
     from flink_tpu.config import HighAvailabilityOptions, LogOptions
+    from flink_tpu.fs import cas_capable, get_filesystem
 
     checks = (
         ("high-availability.dir",
@@ -280,15 +289,22 @@ def storage_local_locks_on_remote(plan, config) -> Iterable[Finding]:
         scheme, sep, _ = v.partition("://")
         if not sep or scheme == "file":
             continue
+        try:
+            if cas_capable(get_filesystem(v)):
+                continue  # CAS replaces the lock: race PREVENTED
+        except ValueError:
+            pass  # unregistered scheme: fails later, warn here too
         yield _f(
-            f"{key}={v!r} resolves to scheme {scheme!r}: the O_EXCL + "
-            f"rename-first lock discipline protecting {what} is "
-            "local-filesystem-only — on this scheme acquisition "
-            "degrades to read-check-write, fenced only after the "
-            "fact by lease epochs",
+            f"{key}={v!r} resolves to scheme {scheme!r}, whose driver "
+            f"offers no conditional-put: the O_EXCL + rename-first "
+            f"lock discipline protecting {what} is local-filesystem-"
+            "only — on this scheme acquisition degrades to "
+            "read-check-write, fenced only after the fact by lease "
+            "epochs",
             fix="move the directory to a shared LOCAL filesystem "
-                "(file:// / bare path), or accept the degradation "
-                "knowingly (single-acquirer operational discipline)")
+                "(file:// / bare path) or a conditional-put scheme "
+                "(objstore://), or accept the degradation knowingly "
+                "(single-acquirer operational discipline)")
 
 
 @config_rule("LOG_RETENTION_UNSAFE", "warn",
@@ -321,6 +337,59 @@ def log_retention_unsafe(plan, config) -> Iterable[Finding]:
             "bootstrap from",
             fix=f"raise log.retention.ms to >= {interval}, lower the "
                 "checkpoint interval, or disable time retention")
+
+
+@config_rule("CLEANER_DISABLED_WITH_RETENTION", "warn",
+             fix="set log.cleaner.enabled=true (the driver then runs "
+                 "compaction + retention at log.cleaner.interval-ms "
+                 "under the fenced cleaner lease), or schedule "
+                 "explicit `log TOPIC_DIR --retain` passes")
+def cleaner_disabled_with_retention(plan, config) -> Iterable[Finding]:
+    """A retention policy with no executor: ``log.retention.ms`` /
+    ``log.retention.bytes`` describe WHAT to drop, but nothing in the
+    runtime drops it unless the background cleaner is enabled
+    (``log.cleaner.enabled``) or an operator runs explicit
+    maintenance passes. A topic configured this way grows without
+    bound while its owner believes retention is active — the classic
+    silently-ignored-config failure, surfaced at submit instead of at
+    the disk-full incident. Fires only when the plan actually
+    PRODUCES into a topic (a LogSink node): a consume-only job
+    inherits the producer's maintenance regime."""
+    from flink_tpu.config import LogOptions
+
+    if bool(config.get(LogOptions.CLEANER_ENABLED)):
+        return
+    retention_ms = int(config.get(LogOptions.RETENTION_MS))
+    retention_bytes = int(config.get(LogOptions.RETENTION_BYTES))
+    if retention_ms <= 0 and retention_bytes <= 0:
+        return
+    if not _has_log_sink(plan):
+        return
+    configured = ", ".join(
+        f"{k}={v}" for k, v in (("log.retention.ms", retention_ms),
+                                ("log.retention.bytes", retention_bytes))
+        if v > 0)
+    yield _f(
+        f"{configured} configured but log.cleaner.enabled=false: "
+        "retention policy has NO executor — nothing in the runtime "
+        "applies it, so the topic grows without bound unless explicit "
+        "maintenance passes run out of band",
+        fix="enable log.cleaner.enabled (leased background "
+            "compaction + retention per producing topic), or drop the "
+            "retention keys if out-of-band `log --retain` passes are "
+            "the plan")
+
+
+def _has_log_sink(plan) -> bool:
+    from flink_tpu.log.connectors import LogSink
+
+    if plan is None:
+        # config-only analysis (analyze_config / `analyze --conf`):
+        # no plan to inspect — retention keys alone signal log-tier
+        # intent, so warn conservatively
+        return True
+    return any(n.kind == "sink" and isinstance(n.sink, LogSink)
+               for n in plan.nodes.values())
 
 
 @config_rule("LOG_PREFETCH_INVALID", "warn",

@@ -552,11 +552,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="inspect a durable log topic (committed offsets, staged "
              "transactions, segments, compaction generation, "
              "retention floor, active writer leases with epochs, "
-             "per-consumer-group committed offsets) — optionally run "
-             "a maintenance pass first",
+             "per-consumer-group committed offsets + membership "
+             "generations, background-cleaner lease/status) — "
+             "optionally run a maintenance pass first",
         epilog="exit codes: 0 = ok, 1 = topic/maintenance error "
-               "(corrupt state, compaction failure), 2 = usage/path "
-               "error (no such topic).")
+               "(corrupt state, compaction failure, or a live "
+               "background cleaner owns the topic and --compact/"
+               "--retain must not race it), 2 = usage/path error "
+               "(no such topic).")
     logp.add_argument("topic", metavar="TOPIC_DIR",
                       help="topic directory (<log.dir>/<name>)")
     logp.add_argument("--compact", action="store_true",
@@ -644,7 +647,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             if args.compact or args.retain:
                 from flink_tpu.config import Configuration
                 from flink_tpu.log.bus import TopicMaintenance
+                from flink_tpu.log.cleaner import check_manual_maintenance
 
+                # a live background cleaner service owns maintenance
+                # on this topic — a manual pass must refuse loudly
+                # (exit 1) instead of fighting it for the maintenance
+                # lock mid-cadence
+                check_manual_maintenance(args.topic)
                 config = Configuration(_parse_conf(args.conf))
                 if args.compact:
                     out["compaction"] = (

@@ -474,6 +474,42 @@ class LogOptions:
         "Total members in the consumer group; together with "
         "log.group.member this fixes the partition assignment. All "
         "members of one group must agree on this count.")
+    GROUP_MEMBER_ID = ConfigOption(
+        "log.group.member-id", "",
+        "DYNAMIC membership: a non-empty id makes LogSource.from_config "
+        "join the group's durable membership manifest at open "
+        "(idempotent re-join on restart) and derive its partition "
+        "assignment from the manifest's sorted member list — the "
+        "generation-fenced rebalance protocol, instead of the static "
+        "log.group.member/members pair. Offset commits are keyed by "
+        "the joined generation; a member deposed by a rebalance it "
+        "missed has its late commit rejected at the fence. Members "
+        "leave explicitly (ConsumerGroups.leave / the log CLI), not on "
+        "close — a restart must keep its seat.")
+    CLEANER_ENABLED = ConfigOption(
+        "log.cleaner.enabled", False,
+        "Run the driver-owned background cleaner service "
+        "(log/cleaner.py): one maintenance thread per log topic the "
+        "job writes, executing compaction + retention per the "
+        "log.compaction.*/log.retention.* grammar at "
+        "log.cleaner.interval-ms cadence under a fenced cleaner lease "
+        "and the per-topic maintenance lock. False (default) keeps "
+        "maintenance an explicit CLI invocation (`log TOPIC --compact/"
+        "--retain`).")
+    CLEANER_INTERVAL_MS = ConfigOption(
+        "log.cleaner.interval-ms", 30_000,
+        "Cadence of the background cleaner's maintenance passes per "
+        "topic (the Kafka log.cleaner backoff role). Each pass runs "
+        "compaction then retention below the safety floor; readers "
+        "and leased producers race it freely — the manifest-swap "
+        "discipline keeps their reads byte-identical.")
+    CLEANER_LEASE_TTL_MS = ConfigOption(
+        "log.cleaner.lease-ttl-ms", 60_000,
+        "Time-to-live of the fenced cleaner lease (cleaner.lease in "
+        "the topic dir): exactly one cleaner service owns a topic's "
+        "maintenance at a time, a crashed cleaner's lease expires "
+        "after this, and a deposed cleaner's late pass dies at its "
+        "next lease verify (the writer-lease epoch discipline).")
 
 
 class CoreOptions:

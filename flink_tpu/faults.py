@@ -164,6 +164,27 @@ Instrumented points (the stack's recovery-critical seams):
         the incremental checkpoint plane by link_or_copy — a raise is
         the link dying mid-checkpoint, the persist fails LOUDLY and
         the previous completed checkpoint remains the restore point)
+    fs.cas.put                                     fs_objstore.py
+        (the conditional-write seam of the object-store driver: every
+        CAS lock/lease/offset publication routes through put_if — a
+        raise there is a 412 Precondition Failed, i.e. losing the
+        conditional-write race to a contending writer; the recovery
+        discipline re-reads, re-decides, and retries or stands down)
+    log.cleaner.pass                               log/cleaner.py
+        (the background cleaner's per-pass seam, fired after the
+        fenced cleaner lease is held but before compaction/retention
+        run: a raise is the cleaner dying mid-pass — the maintenance
+        lock and manifest discipline keep readers on the old
+        generation whole, and the next pass re-runs idempotently)
+    log.group.rebalance                            log/bus.py
+        (the membership-manifest publish of a consumer-group
+        join/leave: a raise is a member dying mid-rebalance — the
+        manifest keeps the OLD generation whole and the member
+        retries; a later success bumps the generation exactly once)
+    log.group.fence                                log/bus.py
+        (the generation fence at offset commit: fired when a DEPOSED
+        member's late commit is rejected — chaos schedules assert the
+        rejection surfaces loudly instead of corrupting the floor)
 
 Job-scoped plans (the session-cluster isolation contract): a runner
 process hosting N concurrent jobs cannot use the process-global plan —
@@ -256,6 +277,10 @@ KNOWN_FAULT_POINTS = frozenset((
     "state.run.fsync",
     "state.compact.swap",
     "state.changelog.link",
+    "fs.cas.put",
+    "log.cleaner.pass",
+    "log.group.rebalance",
+    "log.group.fence",
 ))
 
 # process-global fault/recovery metrics — chaos tests assert every

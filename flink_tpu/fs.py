@@ -43,9 +43,21 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 from flink_tpu.obs.metrics import MetricRegistry
 
 
+class CASConflictError(OSError):
+    """Conditional put lost the race: the object's current ETag no
+    longer matches the expected one (the 412 Precondition Failed of
+    real object stores). Callers treat it like any other lock-
+    acquisition failure — re-read, re-decide, retry or give up."""
+
+
 class FileSystem:
     """Minimal filesystem contract (ref: core/fs/FileSystem.java —
     subset actually used by checkpoint storage and file sinks)."""
+
+    #: True when this backend implements ``put_if``/``etag`` — the
+    #: conditional-write capability the lock/lease tiers probe via
+    #: ``cas_capable`` to pick CAS records over O_EXCL lock files.
+    conditional_put = False
 
     def open_read(self, path: str):
         raise NotImplementedError
@@ -88,6 +100,32 @@ class FileSystem:
 
     def is_dir(self, path: str) -> bool:
         raise NotImplementedError
+
+    # -- conditional-write extension (object-store CAS) ------------------
+
+    def etag(self, path: str) -> Optional[str]:
+        """Current ETag/generation of the object, ``None`` when absent.
+        Only meaningful on backends advertising ``conditional_put``."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support conditional put")
+
+    def put_if(self, path: str, data: bytes,
+               expected_etag: Optional[str] = None) -> str:
+        """Atomic compare-and-swap publish: write ``data`` whole iff the
+        object's current ETag equals ``expected_etag`` (``None`` =
+        create-only, the object must not exist). Returns the new ETag;
+        raises :class:`CASConflictError` when the precondition fails.
+        This is the lock primitive on object stores — the O_EXCL +
+        rename-first discipline's replacement where neither exists."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support conditional put")
+
+
+def cas_capable(fs: "FileSystem") -> bool:
+    """Whether this backend advertises the conditional-put extension
+    (the lock tiers' capability probe — also what makes the analyzer's
+    STORAGE_LOCAL_LOCKS_ON_REMOTE rule driver-aware)."""
+    return bool(getattr(fs, "conditional_put", False))
 
 
 class LocalFileSystem(FileSystem):
@@ -373,6 +411,16 @@ def write_atomic(fs: "FileSystem", path: str, payload,
     enospc_retry(attempt, what=path)
 
 
+def _objstore_factory() -> "FileSystem":
+    # in-tree fake conditional-put store (fs_objstore.py) — registered
+    # by default like "file" so objstore:// paths resolve everywhere
+    # (CLI, analyzer capability probe) without plugins.modules config;
+    # deferred import breaks the fs <-> fs_objstore cycle
+    from flink_tpu.fs_objstore import ObjectStoreFileSystem
+
+    return ObjectStoreFileSystem()
+
+
 class FileSystemRegistry:
     """Scheme → FileSystem factory (ref: FileSystem.FS_FACTORIES +
     getUnguardedFileSystem). ``get`` resolves a path's scheme; bare
@@ -382,6 +430,7 @@ class FileSystemRegistry:
         self._factories: Dict[str, Callable[[], FileSystem]] = {}
         self._instances: Dict[str, FileSystem] = {}
         self.register("file", LocalFileSystem)
+        self.register("objstore", _objstore_factory)
 
     def register(self, scheme: str,
                  factory: Callable[[], FileSystem]) -> None:

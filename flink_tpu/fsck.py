@@ -16,9 +16,18 @@ the online readers assume:
   the declared ranges), marker pairs (a pre without a commit is a
   staged transaction — suspicious in a quiesced topic), lease files
   parseable with un-expired deadlines;
+- **coordination records** (PR 18): consumer-group membership
+  manifests parse and generation-keyed offset commits never run AHEAD
+  of their group's manifest generation (the fence admits only the
+  current generation — an offset beyond it means manifest rollback or
+  hand damage); the background cleaner's lease parses and is flagged
+  when expired without release (crashed cleaner service — the next
+  acquirer takes over at epoch+1);
 - **orphans**: ``.tmp`` debris, segments no marker/manifest references,
   ``.inprogress`` checkpoint dirs, manifest-less final-name checkpoint
-  dirs;
+  dirs, objstore conditional-put serialization scratch (``*.lock~``
+  on the raw backing directory — a crashed ``put_if`` leaves at most
+  one; swept only under the maintenance lock and past the age grace);
 - **lsm state stores**: every manifest-listed run file exists and
   decodes whole with the promised row count, the seq counter covers
   every run (a lower counter would re-mint a live run's name), run
@@ -300,15 +309,68 @@ def fsck_topic(path: str) -> List[Dict[str, Any]]:
                     "crashed producer; the next acquirer takes over "
                     "at epoch+1"))
 
-    # consumer-group offsets: parseable, within the committed range
+    # cleaner service records: lease parseable and not silently
+    # expired, published status parseable (a torn status would be a
+    # PUT-atomicity violation — the cleaner publishes both via
+    # CAS/atomic-rename)
+    from flink_tpu.log.cleaner import CLEANER_LEASE, CLEANER_STATUS
+
+    cl_path = os.path.join(path, CLEANER_LEASE)
+    if fs.exists(cl_path):
+        try:
+            rec = _read_json(fs, cl_path, "cleaner lease")
+        except LogError as e:
+            findings.append(_f("CORRUPT_CONTROL", "error", cl_path,
+                               f"unparseable cleaner lease: {e}"))
+        else:
+            now = int(time.time() * 1000)
+            if (not rec.get("released")
+                    and int(rec.get("deadline_ms", 0)) < now):
+                findings.append(_f(
+                    "STALE_CLEANER_LEASE", "warn", cl_path,
+                    f"cleaner lease held by {rec.get('owner')!r} "
+                    f"(epoch {rec.get('epoch')}) expired at "
+                    f"{rec.get('deadline_ms')} without release — "
+                    "crashed cleaner service; the next service takes "
+                    "over at epoch+1 and its first verify() deposes "
+                    "any zombie pass"))
+    cs_path = os.path.join(path, CLEANER_STATUS)
+    if fs.exists(cs_path):
+        try:
+            _read_json(fs, cs_path, "cleaner status")
+        except LogError as e:
+            findings.append(_f("CORRUPT_CONTROL", "error", cs_path,
+                               f"unparseable cleaner status: {e}"))
+
+    # consumer-group offsets: parseable, and generation-keyed commits
+    # coherent with the group's membership manifest — an offset
+    # recorded at a generation the manifest has never reached means
+    # the fence was bypassed (or the manifest was rolled back by
+    # hand), and the exactly-once handover accounting is suspect
+    from flink_tpu.log.bus import ConsumerGroups
+
     gdir = os.path.join(path, GROUP_DIR)
     if fs.exists(gdir):
         for gname in sorted(fs.listdir(gdir)):
             sub = os.path.join(gdir, gname)
             if not fs.is_dir(sub):
                 continue
+            manifest_gen: Optional[int] = None
+            mpath = os.path.join(sub, ConsumerGroups.MEMBERSHIP)
+            if fs.exists(mpath):
+                try:
+                    mrec = _read_json(fs, mpath,
+                                      "group membership manifest")
+                    manifest_gen = int(mrec["generation"])
+                    if not isinstance(mrec.get("members"), list):
+                        raise KeyError("members")
+                except (LogError, KeyError, ValueError, TypeError) as e:
+                    findings.append(_f(
+                        "CORRUPT_CONTROL", "error", mpath,
+                        f"unparseable group membership manifest: {e}"))
             for name in sorted(fs.listdir(sub)):
-                if not name.endswith(".json"):
+                if (not name.endswith(".json")
+                        or name == ConsumerGroups.MEMBERSHIP):
                     continue
                 opath = os.path.join(sub, name)
                 try:
@@ -318,7 +380,57 @@ def fsck_topic(path: str) -> List[Dict[str, Any]]:
                     findings.append(_f(
                         "CORRUPT_CONTROL", "error", opath,
                         f"unparseable group offset: {e}"))
+                    continue
+                if "generation" not in rec:
+                    continue
+                ogen = int(rec["generation"])
+                if manifest_gen is None:
+                    findings.append(_f(
+                        "GROUP_GENERATION_INCOHERENT", "error", opath,
+                        f"offset committed at generation {ogen} but "
+                        f"group {gname!r} has no membership manifest "
+                        "— a generation-keyed commit cannot pass the "
+                        "fence without one"))
+                elif ogen > manifest_gen:
+                    findings.append(_f(
+                        "GROUP_GENERATION_INCOHERENT", "error", opath,
+                        f"offset committed at generation {ogen} ahead "
+                        f"of the membership manifest's {manifest_gen} "
+                        "— the fence admits only the current "
+                        "generation, so the manifest regressed "
+                        "(rolled back or hand-damaged)"))
+
+    # objstore serialization-lock scratch: a crashed conditional put
+    # leaves at most one `.lock~` beside the object it was publishing.
+    # The fake's listdir hides them (server internals), so the scan
+    # walks the raw backing directory; sweepable once the holder is
+    # provably gone (maintenance lock + age grace, applied by repair)
+    _scan_lock_debris(fs, path, findings)
     return findings
+
+
+def _scan_lock_debris(fs, path: str,
+                      findings: List[Dict[str, Any]]) -> None:
+    from flink_tpu.log.topic import _local_path
+
+    local = _local_path(path)
+    if local is None:
+        backing = getattr(fs, "_backing", None)
+        real = getattr(fs, "_real", None)
+        if backing is None or real is None:
+            return  # remote scheme without a reachable backing dir
+        local = real(backing(path))
+    if not os.path.isdir(local):
+        return
+    for dirpath, _dirs, files in os.walk(local):
+        for name in sorted(files):
+            if name.endswith(".lock~"):
+                findings.append(_f(
+                    "OBJSTORE_LOCK_DEBRIS", "warn",
+                    os.path.join(dirpath, name),
+                    "conditional-put serialization scratch left by a "
+                    "crashed put_if (server-emulation lock, not a "
+                    "durability structure)", repairable=True))
 
 
 # -- lsm state store ----------------------------------------------------
@@ -569,6 +681,22 @@ def fsck_path(path: str, repair: bool = False) -> List[Dict[str, Any]]:
                 if not f["repairable"]:
                     continue
                 base = os.path.basename(f["path"])
+                if f["rule"] == "OBJSTORE_LOCK_DEBRIS":
+                    # raw backing-path debris: a live put_if may hold
+                    # the lock this instant — sweep only under the
+                    # maintenance lock and past the age grace, and
+                    # unlink directly (the path is beneath the scheme,
+                    # so the topic's fs must not re-map it)
+                    if maint_fd is None:
+                        continue
+                    if not _older_than(f["path"], REPAIR_MIN_AGE_S):
+                        continue
+                    try:
+                        os.unlink(f["path"])
+                        f["repaired"] = True
+                    except OSError:
+                        pass
+                    continue
                 if kind == "topic":
                     # LIVE-PRODUCER guards: fsck has no writer identity
                     # (sweep_orphans restricts itself to OWNED

@@ -10,6 +10,13 @@ from flink_tpu.log.bus import (
     Retention,
     TopicMaintenance,
 )
+from flink_tpu.log.cleaner import (
+    CleanerLease,
+    LogCleaner,
+    check_manual_maintenance,
+    cleaner_status,
+    live_cleaner_owner,
+)
 from flink_tpu.log.connectors import LogSink, LogSource
 from flink_tpu.log.topic import (
     LogError,
@@ -28,4 +35,6 @@ __all__ = ["LogError", "LogSink", "LogSource", "TopicAppender",
            "topic_partitions", "topic_key_field", "list_leases",
            "list_group_offsets", "Compactor", "ConsumerGroups",
            "LeaseError", "LeaseManager", "Retention",
-           "TopicMaintenance"]
+           "TopicMaintenance", "LogCleaner", "CleanerLease",
+           "cleaner_status", "live_cleaner_owner",
+           "check_manual_maintenance"]

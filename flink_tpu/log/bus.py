@@ -32,8 +32,11 @@ publication, so a deposed holder (another producer took the expired
 partition over, bumping the epoch) raises instead of publishing — the
 PR-3 attempt-epoch fencing discipline applied to partition ownership.
 Acquisition is serialized by an O_EXCL lock file on local filesystems;
-on non-local schemes it degrades to read-check-write (the epoch fence
-still rejects the loser's writes at the next verify — honest scope).
+on a conditional-put scheme (``fs.cas_capable`` — the objstore
+driver) every lease write is a compare-and-swap at the etag the
+decision read, so the race is PREVENTED, not bounded; on any other
+remote scheme it degrades to read-check-write (the epoch fence still
+rejects the loser's writes at the next verify — honest scope).
 
 **Consumer groups** (``ConsumerGroups``): per-group, per-partition
 committed-offset files (``groups/<name>/p<k>.json``), max-merged
@@ -44,16 +47,29 @@ bound and the cross-generation resume point: a NEW job joining group G
 bootstraps from G's committed offsets — reading compacted history
 first, then the live tail (the backfill-then-live shape).
 
+**Dynamic membership + rebalance** (PR 18): a durable group manifest
+(``groups/<name>/membership.json``) carrying the sorted member list +
+a GENERATION that bumps on every join/leave; assignment is
+``partition % len(members)`` over the sorted list, and offset commits
+may be KEYED by the generation the member joined at — a commit at a
+stale generation is rejected at the fence (a deposed member's late
+offsets can never interleave with the new generation's), the
+writer-lease epoch discipline applied to group membership.
+
 Fault points (registered in ``faults.KNOWN_FAULT_POINTS``):
 ``log.compact.rewrite`` / ``log.compact.swap`` /
 ``log.retention.drop`` / ``log.lease.acquire`` / ``log.lease.renew`` /
-``log.group.commit`` — chaos gates in tests/test_log_chaos.py.
+``log.group.commit`` / ``log.group.rebalance`` / ``log.group.fence``
+— chaos gates in tests/test_log_chaos.py.
 
-Honest scope: no broker process — compaction/retention run as explicit
-maintenance invocations (``TopicMaintenance``), not a background
-cleaner; all participants share one filesystem; a reader holding a
-pre-swap snapshot whose files a later swap deleted fails loudly and
-retries with a fresh snapshot.
+Honest scope: no broker process — all participants share one
+filesystem (or one fake object store); background maintenance exists
+(``log/cleaner.py``'s leased cleaner, driver-owned) but is a thread in
+a participant process, not a broker; a reader holding a pre-swap
+snapshot whose files a later swap deleted fails loudly and retries
+with a fresh snapshot; dynamic-group members are never auto-evicted —
+a crashed member stays in the manifest until it re-joins or an
+operator removes it.
 """
 from __future__ import annotations
 
@@ -66,7 +82,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from flink_tpu.formats_columnar import ColumnarWriter, iter_blocks
-from flink_tpu.fs import get_filesystem, open_write_sync
+from flink_tpu.fs import (CASConflictError, cas_capable, get_filesystem,
+                          open_write_sync)
 from flink_tpu.log.topic import (
     GROUP_DIR,
     LEASE_DIR,
@@ -105,6 +122,41 @@ def _now_ms() -> int:
     return int(time.time() * 1000)
 
 
+# a join/leave is sub-second; a membership lock older than this is a
+# crashed member's leftover and is broken (rename-first, racing-safe)
+_MEMBERSHIP_LOCK_STALE_MS = 15_000
+
+
+@contextlib.contextmanager
+def _membership_lock(local_manifest: str):
+    """O_EXCL serialization of membership read-mutate-publish on local
+    filesystems (conditional-put schemes use the CAS loop instead)."""
+    lock = local_manifest + ".lock"
+    fd = None
+    for _ in range(3):
+        try:
+            fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            break
+        except FileExistsError:
+            try:
+                age_ms = (time.time() - os.path.getmtime(lock)) * 1000
+            except OSError:
+                continue  # vanished under us — retry
+            if age_ms > _MEMBERSHIP_LOCK_STALE_MS:
+                _break_stale_lock(lock)
+                continue
+            raise LogError(
+                f"another member is rebalancing right now "
+                f"({lock} held) — retry the join/leave")
+    if fd is None:
+        raise LogError(
+            f"could not take the membership lock at {lock}")
+    try:
+        yield
+    finally:
+        _unlink_if_ours(lock, fd)
+
+
 class LeaseManager:
     """Fenced per-partition writer leases for one producer.
 
@@ -131,6 +183,12 @@ class LeaseManager:
         self.ttl_ms = int(ttl_ms)
         self._now = now_fn or _now_ms
         self._fs = get_filesystem(path)
+        # conditional-put schemes serialize the read-decide-write via
+        # CAS on the lease file itself (etag captured at read, checked
+        # at publish) — no O_EXCL lock file, no fence degradation
+        self._cas = (_local_path(path) is None
+                     and cas_capable(self._fs))
+        self._etags: Dict[int, Optional[str]] = {}
         self.epochs: Dict[int, int] = {}
 
     def _lease_path(self, p: int) -> str:
@@ -138,12 +196,48 @@ class LeaseManager:
 
     def _read(self, p: int) -> Optional[Dict[str, Any]]:
         lp = self._lease_path(p)
+        if self._cas:
+            # etag-consistent read: the captured etag must describe the
+            # exact bytes the decision is made on, or the later put_if
+            # could succeed against a record we never saw
+            for _ in range(3):
+                tag = self._fs.etag(lp)
+                if tag is None:
+                    self._etags[p] = None
+                    return None
+                try:
+                    rec = _read_json(self._fs, lp, "lease file")
+                except OSError:
+                    continue  # replaced under us — retry
+                if self._fs.etag(lp) == tag:
+                    self._etags[p] = tag
+                    return rec
+            raise LeaseError(
+                f"partition p{p} of topic {self.path!r}: lease file "
+                "churning under concurrent writers — retry")
         if not self._fs.exists(lp):
             return None
         return _read_json(self._fs, lp, "lease file")
 
+    def _publish(self, p: int, payload: bytes) -> None:
+        """Publish one lease record: conditional put against the etag
+        the decision was read at (CAS schemes — a conflict means we
+        lost the race and the acquire/renew must die loudly), plain
+        atomic write elsewhere (serialized by ``_acquire_lock``)."""
+        if self._cas:
+            try:
+                self._etags[p] = self._fs.put_if(
+                    self._lease_path(p), payload, self._etags.get(p))
+            except CASConflictError as e:
+                raise LeaseError(
+                    f"partition p{p} of topic {self.path!r}: lost the "
+                    f"conditional-write race ({e}) — another producer "
+                    "published the lease first") from e
+            return
+        _write_atomic(self._fs, self._lease_path(p), payload)
+
     def _write(self, p: int, epoch: int, now: int) -> None:
-        _write_atomic(self._fs, self._lease_path(p), json.dumps({
+        self._publish(p, json.dumps({
             "owner": self.owner, "epoch": int(epoch),
             "acquired_ms": int(now),
             "deadline_ms": int(now + self.ttl_ms),
@@ -153,7 +247,10 @@ class LeaseManager:
     def _acquire_lock(self, p: int):
         """O_EXCL serialization of the read-decide-write acquire on
         local filesystems; a crashed acquirer's stale lock (older than
-        the ttl) is broken. Non-local schemes skip the lock — the
+        the ttl) is broken. Conditional-put schemes need no lock file:
+        ``_publish`` CAS-checks the etag captured at read, so of two
+        racing acquirers exactly one lands and the loser raises.
+        Non-local schemes WITHOUT conditional put skip the lock — the
         epoch fence still rejects a race loser's writes at its next
         verify (documented degradation, not silent corruption)."""
         lock = self._lease_path(p) + ".lock"
@@ -281,11 +378,14 @@ class LeaseManager:
             cur = self._read(p)
             if (cur is not None and cur.get("owner") == self.owner
                     and int(cur.get("epoch", -1)) == self.epochs[p]):
-                _write_atomic(self._fs, self._lease_path(p), json.dumps({
-                    "owner": self.owner, "epoch": self.epochs[p],
-                    "acquired_ms": int(cur.get("acquired_ms", now)),
-                    "deadline_ms": 0, "released": True,
-                }).encode("utf-8"))
+                with contextlib.suppress(LeaseError):
+                    # a release racing our own deposition is moot —
+                    # the successor's record stands either way
+                    self._publish(p, json.dumps({
+                        "owner": self.owner, "epoch": self.epochs[p],
+                        "acquired_ms": int(cur.get("acquired_ms", now)),
+                        "deadline_ms": 0, "released": True,
+                    }).encode("utf-8"))
         self.epochs = {}
 
 
@@ -294,22 +394,189 @@ class ConsumerGroups:
     file per (group, partition) so concurrent members (disjoint
     partitions) never read-modify-write each other's commits. Offsets
     MAX-MERGE: a replayed commit (restore re-runs the commit round)
-    can never regress the group floor."""
+    can never regress the group floor.
+
+    DYNAMIC MEMBERSHIP (the rebalance protocol): a group may keep a
+    durable manifest (``groups/<g>/membership.json`` — sorted member
+    ids + a monotone GENERATION). ``join``/``leave`` bump the
+    generation and re-partition ``p % len(members)`` by sorted index;
+    a commit keyed by a deposed generation is REJECTED at the fence
+    (the PR 9/11 epoch discipline applied to membership), so a member
+    that missed a rebalance can never move the floor with offsets it
+    no longer owns. Groups without a manifest stay static — the
+    legacy ``log.group.member/members`` config path, unchanged."""
+
+    MEMBERSHIP = "membership.json"
 
     @staticmethod
-    def commit(path: str, group: str, offsets: Dict[int, int]) -> None:
-        from flink_tpu import faults
-
+    def _validate(group: str) -> None:
         if not _WRITER_RE.match(group or ""):
             raise LogError(
                 f"consumer-group name {group!r} must match "
                 "[A-Za-z0-9_.-]+ (it becomes a directory name)")
+
+    @staticmethod
+    def _membership_path(path: str, group: str) -> str:
+        return os.path.join(path, GROUP_DIR, group,
+                            ConsumerGroups.MEMBERSHIP)
+
+    @staticmethod
+    def read_membership(path: str,
+                        group: str) -> Optional[Dict[str, Any]]:
+        """{"generation", "members"} of a dynamic group, or None for
+        a static group (no manifest on file)."""
+        fs = get_filesystem(path)
+        mp = ConsumerGroups._membership_path(path, group)
+        if not fs.exists(mp):
+            return None
+        rec = _read_json(fs, mp, "group membership manifest")
+        return {"generation": int(rec.get("generation", 0)),
+                "members": [str(m) for m in rec.get("members", [])]}
+
+    @staticmethod
+    def _update_membership(path: str, group: str, mutate):
+        """Serialized read-mutate-publish of the membership manifest:
+        CAS loop on conditional-put schemes, O_EXCL + stale-break on
+        local filesystems (the LeaseManager discipline). ``mutate``
+        returns the new record or None for a no-op; the caller's
+        record is returned either way."""
+        from flink_tpu import faults
+
+        ConsumerGroups._validate(group)
         fs = get_filesystem(path)
         gdir = os.path.join(path, GROUP_DIR, group)
         fs.mkdirs(gdir)
+        mp = os.path.join(gdir, ConsumerGroups.MEMBERSHIP)
+        topic = os.path.basename(os.path.normpath(path))
+
+        def _norm(cur):
+            if cur is None:
+                return {"generation": 0, "members": []}
+            return {"generation": int(cur.get("generation", 0)),
+                    "members": [str(m) for m in cur.get("members", [])]}
+
+        if _local_path(path) is None and cas_capable(fs):
+            for _ in range(5):
+                tag = fs.etag(mp)
+                cur = (_read_json(fs, mp, "group membership manifest")
+                       if tag is not None else None)
+                rec = mutate(_norm(cur))
+                if rec is None:
+                    return _norm(cur)
+                faults.fire("log.group.rebalance", exc=OSError,
+                            topic=topic, group=group,
+                            generation=rec["generation"])
+                try:
+                    fs.put_if(mp, json.dumps(
+                        rec, sort_keys=True).encode("utf-8"), tag)
+                    return rec
+                except CASConflictError:
+                    continue  # lost the rebalance race — re-read
+            raise LogError(
+                f"group {group!r} membership manifest churning under "
+                f"concurrent join/leave on topic {path!r} — retry")
+        local = _local_path(mp)
+        with (_membership_lock(local) if local is not None
+              else contextlib.nullcontext()):
+            cur = (_read_json(fs, mp, "group membership manifest")
+                   if fs.exists(mp) else None)
+            rec = mutate(_norm(cur))
+            if rec is None:
+                return _norm(cur)
+            faults.fire("log.group.rebalance", exc=OSError,
+                        topic=topic, group=group,
+                        generation=rec["generation"])
+            _write_atomic(fs, mp, json.dumps(
+                rec, sort_keys=True).encode("utf-8"))
+            return rec
+
+    @staticmethod
+    def join(path: str, group: str,
+             member: str) -> Tuple[int, int, int]:
+        """Add ``member`` to the group's durable manifest (bumping the
+        generation; idempotent re-join keeps it) and return
+        (generation, member index, member count). Every live member
+        re-derives its assignment from the bumped generation at its
+        next fence check — that is the whole rebalance."""
+        if not _WRITER_RE.match(member or ""):
+            raise LogError(
+                f"group member id {member!r} must match [A-Za-z0-9_.-]+")
+
+        def mutate(cur):
+            if member in cur["members"]:
+                return None  # idempotent re-join: same generation
+            return {"generation": cur["generation"] + 1,
+                    "members": sorted(cur["members"] + [member])}
+
+        rec = ConsumerGroups._update_membership(path, group, mutate)
+        members = rec["members"]
+        return (rec["generation"], members.index(member), len(members))
+
+    @staticmethod
+    def leave(path: str, group: str, member: str) -> int:
+        """Remove ``member`` (bumping the generation; unknown member
+        is a no-op) and return the resulting generation. The departed
+        member's own late commits die at the fence from here on."""
+
+        def mutate(cur):
+            if member not in cur["members"]:
+                return None
+            return {"generation": cur["generation"] + 1,
+                    "members": [m for m in cur["members"]
+                                if m != member]}
+
+        return ConsumerGroups._update_membership(
+            path, group, mutate)["generation"]
+
+    @staticmethod
+    def assignment_for(path: str, group: str, member: str,
+                       partitions: int) -> Tuple[int, List[int]]:
+        """A dynamic member's current (generation, partitions): the
+        sorted-index ``p % len(members)`` re-partition of the
+        manifest's CURRENT generation. A member not in the manifest
+        (deposed by leave, or never joined) fails loudly."""
+        m = ConsumerGroups.read_membership(path, group)
+        if m is None or member not in m["members"]:
+            raise LogError(
+                f"member {member!r} is not in consumer-group "
+                f"{group!r} of topic {path!r} (members: "
+                f"{(m or {}).get('members', [])}) — join() first")
+        ix = m["members"].index(member)
+        n = len(m["members"])
+        return (m["generation"],
+                [p for p in range(partitions) if p % n == ix])
+
+    @staticmethod
+    def commit(path: str, group: str, offsets: Dict[int, int],
+               generation: Optional[int] = None) -> None:
+        from flink_tpu import faults
+
+        ConsumerGroups._validate(group)
+        fs = get_filesystem(path)
+        gdir = os.path.join(path, GROUP_DIR, group)
+        fs.mkdirs(gdir)
+        topic = os.path.basename(os.path.normpath(path))
         faults.fire("log.group.commit", exc=OSError,
-                    topic=os.path.basename(os.path.normpath(path)),
-                    group=group)
+                    topic=topic, group=group)
+        if generation is not None:
+            # THE FENCE: a generation-keyed commit must match the
+            # manifest's current generation — a deposed member (a
+            # rebalance it missed bumped past it) no longer owns the
+            # partitions it is trying to commit, and letting the
+            # write through would double-count its rows against the
+            # new owner's. Loud rejection; the member re-derives its
+            # assignment and replays from committed offsets.
+            faults.fire("log.group.fence", exc=OSError,
+                        topic=topic, group=group, generation=generation)
+            m = ConsumerGroups.read_membership(path, group)
+            current_gen = 0 if m is None else m["generation"]
+            if generation != current_gen:
+                raise LogError(
+                    f"consumer-group {group!r} commit at DEPOSED "
+                    f"generation {generation} (current "
+                    f"{current_gen}) on topic {path!r} — rejected at "
+                    "the fence; re-derive the assignment and retry")
+        cas = _local_path(path) is None and cas_capable(fs)
         # targeted read: the per-checkpoint commit round must cost
         # O(this group's partitions), not O(all groups x partitions)
         current = list_group_offsets(path, group=group).get(group, {})
@@ -317,9 +584,42 @@ class ConsumerGroups:
             p, off = int(p), int(off)
             if off <= current.get(p, 0) and p in current:
                 continue  # never regress, skip no-op rewrites
-            _write_atomic(fs, os.path.join(gdir, f"p{p}.json"),
-                          json.dumps({"offset": max(
-                              off, current.get(p, 0))}).encode("utf-8"))
+            opath = os.path.join(gdir, f"p{p}.json")
+            rec = {"offset": max(off, current.get(p, 0))}
+            if generation is not None:
+                rec["generation"] = int(generation)
+            if cas:
+                ConsumerGroups._cas_commit_one(fs, opath, rec)
+            else:
+                _write_atomic(fs, opath,
+                              json.dumps(rec).encode("utf-8"))
+
+    @staticmethod
+    def _cas_commit_one(fs, opath: str, rec: Dict[str, Any]) -> None:
+        """One offset file's max-merge publish as a CAS loop: re-read
+        at the current etag, merge, conditional put — two members
+        handing a partition over mid-rebalance can race this file and
+        neither's progress may be lost."""
+        for _ in range(4):
+            tag = fs.etag(opath)
+            if tag is not None:
+                cur = _read_json(fs, opath, "group-offset file")
+                merged = dict(rec)
+                merged["offset"] = max(int(rec["offset"]),
+                                       int(cur.get("offset", 0)))
+                if "generation" not in merged and "generation" in cur:
+                    merged["generation"] = cur["generation"]
+            else:
+                merged = rec
+            try:
+                fs.put_if(opath, json.dumps(merged).encode("utf-8"),
+                          tag)
+                return
+            except CASConflictError:
+                continue
+        raise LogError(
+            f"group-offset file {opath!r} churning under concurrent "
+            "committers — retry the commit round")
 
     @staticmethod
     def committed(path: str, group: str) -> Dict[int, int]:
@@ -433,8 +733,22 @@ def _swap_manifest(fs, path: str, topic: str, gen: int,
             for p, e in sorted(partitions.items())},
     }
     faults.fire("log.compact.swap", exc=OSError, topic=topic, gen=gen)
-    _write_atomic(fs, os.path.join(path, MANIFEST),
-                  json.dumps(payload).encode("utf-8"))
+    mpath = os.path.join(path, MANIFEST)
+    if _local_path(path) is None and cas_capable(fs):
+        # conditional-put swap: published against the manifest's etag
+        # as read under the (CAS-held) maintenance lock — a conflict
+        # means a pass raced us despite the lock (a broken-stale edge)
+        # and MUST die loudly rather than last-rename-wins
+        try:
+            fs.put_if(mpath, json.dumps(payload).encode("utf-8"),
+                      fs.etag(mpath))
+        except CASConflictError as e:
+            raise LogError(
+                f"manifest swap of topic {path!r} lost the "
+                f"conditional-write race at gen {gen} ({e}) — "
+                "another maintenance pass published first") from e
+        return
+    _write_atomic(fs, mpath, json.dumps(payload).encode("utf-8"))
 
 
 def _manifest_entries(reader: TopicReader) -> Dict[int, Dict[str, Any]]:

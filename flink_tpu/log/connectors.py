@@ -384,6 +384,17 @@ class LogSource(Source):
     backfill-then-live shape), exactly once per group across consumer
     generations.
 
+    DYNAMIC membership (``member_id`` / ``log.group.member-id``):
+    instead of a static ``member_index``/``members`` split, the member
+    joins the group's durable membership manifest at first assignment
+    (generation-bumping when the set changes, idempotent when not),
+    reads its assignment from the sorted member list at that
+    generation, and keys every offset commit by it — after any
+    join/leave the old generation's late commits are REJECTED at the
+    fence (bus.py ConsumerGroups), so a rebalance can never interleave
+    two generations' offsets. ``leave_group()`` is the planned
+    departure.
+
     ``ts_field`` names the event-time column (ms); absent, batches get
     ingest-time stamps like FileSource. Bounded: a split ends at the
     committed offset observed at open (chained jobs run producer then
@@ -405,7 +416,8 @@ class LogSource(Source):
 
     def __init__(self, path: str, ts_field: Optional[str] = None,
                  group: Optional[str] = None, member_index: int = 0,
-                 members: int = 1, zero_copy: bool = True,
+                 members: int = 1, member_id: Optional[str] = None,
+                 zero_copy: bool = True,
                  batch_records: int = 262_144,
                  prefetch_segments: int = 1) -> None:
         # perf-grade read defaults (class defaults mirror the declared
@@ -441,6 +453,24 @@ class LogSource(Source):
                     "[A-Za-z0-9_.-]+ (it becomes a directory name)")
         self.member_index = int(member_index)
         self.members = int(members)
+        # dynamic membership (``log.group.member-id``): the member
+        # JOINS the group's durable manifest lazily at first
+        # assignment (construction is side-effect-free — the LogSink
+        # _ensure_open discipline: building a plan must not bump the
+        # group generation), caches the generation it joined at, and
+        # keys every offset commit by it — a deposed member's late
+        # commit (the generation moved: someone joined/left) is
+        # REJECTED at the fence, never merged. A restore re-creates
+        # the source, so the member re-joins (idempotent: same
+        # membership set keeps the generation) and re-reads its
+        # possibly-changed assignment.
+        self.member_id = (member_id or None)
+        if self.member_id is not None and self.group is None:
+            raise LogError(
+                "member_id needs a consumer group: dynamic membership "
+                "is a property of the group manifest")
+        self._generation: Optional[int] = None
+        self._assigned: Optional[List[int]] = None
         self._reader: Optional[TopicReader] = None
         # per-batch replay positions for sparse (compacted) reads,
         # keyed by batch-dict identity: open_split records each
@@ -461,10 +491,12 @@ class LogSource(Source):
         from flink_tpu.config import LogOptions
 
         group = str(config.get(LogOptions.GROUP_NAME)).strip()
+        member_id = str(config.get(LogOptions.GROUP_MEMBER_ID)).strip()
         return cls(os.path.join(str(config.get(LogOptions.DIR)), name),
                    ts_field=ts_field, group=group or None,
                    member_index=int(config.get(LogOptions.GROUP_MEMBER)),
                    members=int(config.get(LogOptions.GROUP_MEMBERS)),
+                   member_id=member_id or None,
                    zero_copy=bool(config.get(LogOptions.ZERO_COPY)),
                    batch_records=int(
                        config.get(LogOptions.READ_BATCH_RECORDS)),
@@ -485,12 +517,44 @@ class LogSource(Source):
 
     def assigned_partitions(self) -> List[int]:
         n = topic_partitions(self.path)
+        if self.member_id is not None:
+            # dynamic membership: join (idempotent) at first
+            # assignment, then read the manifest-driven assignment at
+            # the generation this source instance observed — cached
+            # per instance so splits, bootstrap and commits all agree
+            # on ONE membership snapshot (a membership change after
+            # this point deposes the member at the commit fence, and
+            # the resulting restart re-joins at the new generation)
+            if self._assigned is None:
+                from flink_tpu.log.bus import ConsumerGroups
+
+                ConsumerGroups.join(self.path, self.group,
+                                    self.member_id)
+                gen, parts = ConsumerGroups.assignment_for(
+                    self.path, self.group, self.member_id, n)
+                self._generation, self._assigned = gen, parts
+            return list(self._assigned)
         if self.group is None and self.members == 1:
             return list(range(n))
         from flink_tpu.log.bus import ConsumerGroups
 
         return ConsumerGroups.assignment(
             n, self.member_index, self.members)
+
+    def leave_group(self) -> None:
+        """EXPLICIT departure from a dynamic group (bumps the
+        generation, shrinking the membership — the planned-scale-down
+        path; a crashed member simply stays in the manifest and its
+        partitions stall until it re-joins or an operator removes it,
+        which is the honest embedded-tier trade against a broker's
+        heartbeat eviction)."""
+        if self.member_id is None:
+            return
+        from flink_tpu.log.bus import ConsumerGroups
+
+        ConsumerGroups.leave(self.path, self.group, self.member_id)
+        self._generation = None
+        self._assigned = None
 
     def splits(self) -> List[str]:
         return [str(p) for p in self.assigned_partitions()]
@@ -604,7 +668,12 @@ class LogSource(Source):
             if 0 <= int(split_ix) < len(parts) and int(pos) > 0:
                 offsets[parts[int(split_ix)]] = int(pos)
         if offsets:
-            ConsumerGroups.commit(self.path, self.group, offsets)
+            # dynamic members key the commit by the generation they
+            # joined at — a rebalance since then REJECTS this late
+            # commit (LogError), failing the attempt so the restart
+            # re-joins and re-reads its new assignment
+            ConsumerGroups.commit(self.path, self.group, offsets,
+                                  generation=self._generation)
 
     @property
     def bounded(self) -> bool:

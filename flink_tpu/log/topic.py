@@ -224,14 +224,20 @@ def try_maintenance_lock(path: str) -> Optional[int]:
     loss). Returns an fd to pass to ``release_maintenance_lock``, or
     None when another pass holds it. A lock older than
     MAINT_LOCK_STALE_MS is a crashed pass's leftover and is broken.
-    Non-local schemes return a sentinel fd (no O_EXCL there — the
+    Non-local schemes with a conditional-put filesystem take a REAL
+    CAS lock record (token = its nonce string); schemes with neither
+    O_EXCL nor CAS return a sentinel fd (best-effort — the
     single-maintenance-invoker discipline is operational, honest
     scope)."""
     import time as _time
 
     lock = _local_path(os.path.join(path, MAINT_LOCK))
     if lock is None:
-        return -1  # non-local: best-effort (documented degradation)
+        from flink_tpu.fs import cas_capable, get_filesystem
+
+        if cas_capable(get_filesystem(path)):
+            return _try_cas_maintenance_lock(path)
+        return -1  # non-local, no CAS: best-effort (documented)
     for _ in range(2):
         try:
             return os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
@@ -247,8 +253,74 @@ def try_maintenance_lock(path: str) -> Optional[int]:
     return None
 
 
-def release_maintenance_lock(path: str, fd: int) -> None:
-    if fd is None or fd < 0:
+def _try_cas_maintenance_lock(path: str) -> Optional[str]:
+    """The maintenance lock on a conditional-put scheme: a CAS-
+    published lock RECORD instead of an O_EXCL file. The nonce is the
+    release token — only the pass that published the record may delete
+    it (the _unlink_if_ours inode compare, in CAS clothing). Staleness
+    uses the record's own acquired_ms (object stores have no usable
+    mtime); a crashed pass's record past MAINT_LOCK_STALE_MS is
+    replaced via CAS on its etag, so two racing breakers elect exactly
+    one winner."""
+    import time as _time
+    import uuid
+
+    from flink_tpu.fs import CASConflictError, get_filesystem
+
+    fs = get_filesystem(path)
+    lock = os.path.join(path, MAINT_LOCK)
+    nonce = uuid.uuid4().hex
+    rec = json.dumps({"owner": f"pid-{os.getpid()}", "nonce": nonce,
+                      "acquired_ms": int(_time.time() * 1000)},
+                     sort_keys=True).encode()
+    for _ in range(2):
+        try:
+            cur_tag = fs.etag(lock)
+        except OSError:
+            return None
+        if cur_tag is None:
+            try:
+                fs.put_if(lock, rec, None)
+                return nonce
+            except CASConflictError:
+                continue  # lost the create race — re-read, maybe stale
+        try:
+            with fs.open_read(lock) as f:
+                held = json.loads(f.read().decode("utf-8"))
+            age_ms = (int(_time.time() * 1000)
+                      - int(held.get("acquired_ms", 0)))
+        except (OSError, ValueError):
+            continue  # vanished or torn under us — retry
+        if age_ms > MAINT_LOCK_STALE_MS:
+            try:
+                fs.put_if(lock, rec, cur_tag)  # break = replace-at-etag
+                return nonce
+            except CASConflictError:
+                continue  # another breaker won
+        return None
+    return None
+
+
+def release_maintenance_lock(path: str, fd) -> None:
+    if fd is None:
+        return
+    if isinstance(fd, str):
+        # CAS token: delete the lock record only if it is still OURS
+        # (nonce compare — a broken-and-replaced stale record must not
+        # take the new holder's lock with it)
+        from flink_tpu.fs import get_filesystem
+
+        fs = get_filesystem(path)
+        lock = os.path.join(path, MAINT_LOCK)
+        try:
+            with fs.open_read(lock) as f:
+                held = json.loads(f.read().decode("utf-8"))
+            if held.get("nonce") == fd:
+                fs.delete(lock)
+        except (OSError, ValueError):
+            pass
+        return
+    if fd < 0:
         return
     lock = _local_path(os.path.join(path, MAINT_LOCK))
     if lock is None:
@@ -1354,7 +1426,14 @@ def describe_topic(path: str) -> Dict[str, Any]:
     transactions, per-partition segment counts — plus the message-bus
     tier's state: compaction generation, retention floor, active
     writer leases with fencing epochs, per-consumer-group committed
-    offsets."""
+    offsets + membership generations (dynamic groups), and the
+    background cleaner's lease/status (log/cleaner.py)."""
+    # deferred: cleaner.py imports this module at load time
+    from flink_tpu.log.cleaner import (
+        cleaner_status,
+        live_cleaner_owner,
+        read_cleaner_lease,
+    )
     fs = get_filesystem(path)
     reader = TopicReader(path)
     pres = _list_markers(fs, path, "pre")
@@ -1399,4 +1478,30 @@ def describe_topic(path: str) -> Dict[str, Any]:
                    for p, lease in sorted(list_leases(path).items())},
         "groups": {g: {str(p): off for p, off in sorted(offs.items())}
                    for g, offs in sorted(list_group_offsets(path).items())},
+        "group_generations": _group_generations(fs, path),
+        "cleaner": {
+            "status": cleaner_status(path),
+            "lease": read_cleaner_lease(path),
+            "live_owner": live_cleaner_owner(path),
+        },
     }
+
+
+def _group_generations(fs, path: str) -> Dict[str, int]:
+    """Per-group membership generation (dynamic groups only — a
+    static group has no manifest and is simply absent here)."""
+    gdir = os.path.join(path, GROUP_DIR)
+    out: Dict[str, int] = {}
+    if not fs.exists(gdir):
+        return out
+    for gname in sorted(fs.listdir(gdir)):
+        mpath = os.path.join(gdir, gname, "membership.json")
+        sub = os.path.join(gdir, gname)
+        if not fs.is_dir(sub) or not fs.exists(mpath):
+            continue
+        try:
+            out[gname] = int(_read_json(
+                fs, mpath, "group membership").get("generation", 0))
+        except (OSError, ValueError, LogError):
+            continue
+    return out

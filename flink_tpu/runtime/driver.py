@@ -1451,6 +1451,35 @@ class Driver:
         from flink_tpu.obs.profiling import StepProfiler
 
         self._profiler = StepProfiler.from_config(self.config)
+        # self-maintaining bus tier (log.cleaner.enabled): one leased
+        # background cleaner service per LogSink topic, running
+        # compaction + retention at log.cleaner.interval-ms under the
+        # cleaner lease + the per-topic maintenance lock — racing this
+        # run's own producer/consumers by design (the manifest-swap
+        # discipline keeps reads byte-identical). A second driver on
+        # the same topic fails the acquire and runs WITHOUT a cleaner
+        # (the lease's point: exactly one cleaner per topic).
+        self._cleaners = []
+        from flink_tpu.config import LogOptions
+
+        if bool(self.config.get(LogOptions.CLEANER_ENABLED)):
+            from flink_tpu.log.cleaner import LogCleaner
+            from flink_tpu.log.connectors import LogSink
+            from flink_tpu.log.topic import LogError
+
+            seen = set()
+            for n in self.plan.nodes.values():
+                if n.kind != "sink" or not isinstance(n.sink, LogSink):
+                    continue
+                if n.sink.path in seen:
+                    continue
+                seen.add(n.sink.path)
+                cleaner = LogCleaner(n.sink.path, self.config)
+                try:
+                    cleaner.start()
+                except LogError:
+                    continue  # a live cleaner service owns this topic
+                self._cleaners.append(cleaner)
         drain = threading.Thread(target=self._drain_entry, daemon=True)
         drain.start()
         try:
@@ -1526,6 +1555,16 @@ class Driver:
                 self._profiler.close()
             raise
         finally:
+            # cleaners die with the run, releasing their leases so a
+            # successor (or a manual pass) acquires immediately — on
+            # EVERY exit path (a crashed process skips this; ttl
+            # expiry + epoch bump is that takeover path)
+            for cleaner in getattr(self, "_cleaners", []):
+                try:
+                    cleaner.stop()
+                except Exception:
+                    pass  # teardown must not mask the run's outcome
+            self._cleaners = []
             if self._ckpt_executor is not None:
                 # non-blocking: an abandoned persist may still be
                 # writing; letting it finish is safe (manifest-last)

@@ -38,6 +38,10 @@ class LeaderRecord:
     address: str          # host:port of the leader's RPC gateway
     epoch: int            # fencing token: increases on every takeover
     claimed_at: float
+    # CAS-mode renewal stamp: object stores have no usable mtime, so
+    # the lease's age lives IN the record (rewritten on every renew);
+    # 0.0 on local filesystems where os.utime + mtime carry the age
+    renewed_at: float = 0.0
 
 
 class LeaderElection:
@@ -58,15 +62,28 @@ class LeaderElection:
         # FileSystem seam (hwm/counter writes) with raw O_EXCL lock
         # primitives (os.open has no scheme stripping) — one plain OS
         # path keeps both sides in ONE directory tree. Non-file schemes
-        # are rejected loudly: O_EXCL leases are local-fs-only (the
-        # analyzer's STORAGE_LOCAL_LOCKS_ON_REMOTE rule says so too).
+        # are accepted ONLY when their filesystem advertises
+        # conditional put (objstore-class CAS replaces every O_EXCL /
+        # rename-first primitive below); anything else is rejected
+        # loudly (the analyzer's STORAGE_LOCAL_LOCKS_ON_REMOTE rule
+        # says so too).
         if ha_dir.startswith("file://"):
             ha_dir = ha_dir[len("file://"):]
+        self._cas = False
+        self._fs = None
         if "://" in ha_dir:
-            raise ValueError(
-                f"high-availability.dir {ha_dir!r}: leader-election "
-                "leases use O_CREAT|O_EXCL, a local-filesystem "
-                "primitive — point the HA dir at a shared LOCAL path")
+            from flink_tpu.fs import cas_capable, get_filesystem
+
+            fs = get_filesystem(ha_dir)
+            if not cas_capable(fs):
+                raise ValueError(
+                    f"high-availability.dir {ha_dir!r}: leader-election "
+                    "leases use O_CREAT|O_EXCL, a local-filesystem "
+                    "primitive, and this scheme's filesystem offers no "
+                    "conditional-put replacement — point the HA dir at "
+                    "a shared LOCAL path or a CAS-capable store")
+            self._cas = True
+            self._fs = fs
         self.ha_dir = ha_dir
         self.address = address
         self.leader_id = leader_id or f"coord-{uuid.uuid4().hex[:8]}"
@@ -77,7 +94,10 @@ class LeaderElection:
         self.on_revoke: Optional[Callable[[], None]] = None
         self._closed = False
         self._thread: Optional[threading.Thread] = None
-        os.makedirs(ha_dir, exist_ok=True)
+        if self._cas:
+            self._fs.mkdirs(ha_dir)
+        else:
+            os.makedirs(ha_dir, exist_ok=True)
 
     @property
     def _lease(self) -> str:
@@ -85,25 +105,70 @@ class LeaderElection:
 
     # -- lease file primitives ------------------------------------------
     def _read(self) -> Optional[LeaderRecord]:
+        if self._cas:
+            rec, _ = self._read_cas()
+            return rec
         return self._read_path(self._lease)
+
+    def _read_cas(self):
+        """(record, etag) with etag-consistent capture — the etag must
+        describe the exact bytes the decision is made on (the bus-tier
+        LeaseManager discipline)."""
+        for _ in range(3):
+            try:
+                tag = self._fs.etag(self._lease)
+            except OSError:
+                return None, None
+            if tag is None:
+                return None, None
+            try:
+                with self._fs.open_read(self._lease) as f:
+                    raw = f.read()
+                d = json.loads(raw.decode("utf-8")
+                               if isinstance(raw, bytes) else raw)
+                rec = LeaderRecord(
+                    d["leader_id"], d["address"], int(d["epoch"]),
+                    float(d["claimed_at"]),
+                    float(d.get("renewed_at", d["claimed_at"])))
+            except (OSError, ValueError, KeyError):
+                continue  # replaced/torn under us — retry
+            try:
+                if self._fs.etag(self._lease) == tag:
+                    return rec, tag
+            except OSError:
+                return None, None
+        return None, None
 
     @staticmethod
     def _read_path(path: str) -> Optional[LeaderRecord]:
         try:
             with open(path) as f:
                 d = json.load(f)
-            return LeaderRecord(d["leader_id"], d["address"],
-                                int(d["epoch"]), float(d["claimed_at"]))
+            return LeaderRecord(
+                d["leader_id"], d["address"], int(d["epoch"]),
+                float(d["claimed_at"]),
+                float(d.get("renewed_at", d["claimed_at"])))
         except (OSError, ValueError, KeyError):
             return None
 
     def _claim_exclusive(self, rec: LeaderRecord) -> bool:
-        """Claim an ABSENT lease with O_CREAT|O_EXCL (atomic on POSIX):
-        of N racing claimers exactly one wins. The written record
-        (leader_id + epoch) is the claim's identity — release and
-        revoke checks compare content, never inodes (which local
-        filesystems recycle instantly)."""
+        """Claim an ABSENT lease with O_CREAT|O_EXCL (atomic on POSIX)
+        or a create-only conditional put (CAS mode — the same
+        exactly-one-winner guarantee, server-side): of N racing
+        claimers exactly one wins. The written record (leader_id +
+        epoch) is the claim's identity — release and revoke checks
+        compare content, never inodes (which local filesystems recycle
+        instantly)."""
+        rec.renewed_at = rec.claimed_at
         payload = json.dumps(dataclasses.asdict(rec)).encode()
+        if self._cas:
+            from flink_tpu.fs import CASConflictError
+
+            try:
+                self._fs.put_if(self._lease, payload, None)
+                return True
+            except CASConflictError:
+                return False
         try:
             fd = os.open(self._lease,
                          os.O_CREAT | os.O_EXCL | os.O_WRONLY)
@@ -112,6 +177,33 @@ class LeaderElection:
         with os.fdopen(fd, "wb") as f:
             f.write(payload)
         return True
+
+    def _steal_stale_cas(self, cur: LeaderRecord) -> None:
+        """CAS-mode steal: replace the stale record AT ITS ETAG — the
+        conditional put is the whole rename-grave/identity-check/
+        link-restore dance in one primitive. Of two racing breakers
+        exactly one's put lands; the loser's 412 means a peer already
+        broke + re-claimed, and it simply stands down."""
+        from flink_tpu.fs import CASConflictError
+
+        self._record_hwm(cur.epoch)
+        took, tag = self._read_cas()
+        if (took is None or took.leader_id != cur.leader_id
+                or took.epoch != cur.epoch
+                or took.claimed_at != cur.claimed_at):
+            return  # already broken/re-claimed by a faster breaker
+        now = time.time()
+        epoch = max(cur.epoch, self._epoch_hwm()) + 1
+        rec = LeaderRecord(self.leader_id, self.address, epoch,
+                           now, now)
+        try:
+            self._fs.put_if(
+                self._lease,
+                json.dumps(dataclasses.asdict(rec)).encode(), tag)
+        except CASConflictError:
+            return  # lost the steal race — the winner's claim stands
+        self._bump_takeovers()
+        self._granted(epoch)
 
     def _steal_stale(self, cur: LeaderRecord) -> None:
         """Break a stale incumbent's lease with the rename-first
@@ -124,6 +216,8 @@ class LeaderElection:
         observed (a peer already broke + re-claimed), it is restored
         via link() — which cannot clobber an even newer claim — and
         the steal aborts."""
+        if self._cas:
+            return self._steal_stale_cas(cur)
         # floor the fencing token BEFORE the lease disappears: a third
         # contender claiming the now-absent lease continues from the
         # high-water mark, never below the stale incumbent's epoch
@@ -180,10 +274,38 @@ class LeaderElection:
             pass  # observability counter: never fail a takeover over it
 
     def _lease_age(self) -> float:
+        if self._cas:
+            rec = self._read()
+            if rec is None:
+                return float("inf")
+            return time.time() - (rec.renewed_at or rec.claimed_at)
         try:
             return time.time() - os.path.getmtime(self._lease)
         except OSError:
             return float("inf")
+
+    def _renew(self) -> None:
+        """Extend our lease: mtime touch on local filesystems; in CAS
+        mode a conditional rewrite of the record's renewed_at stamp at
+        the etag we just read it under — a 412 means we were deposed
+        between read and renew, surfaced as OSError so the next
+        contention pass observes the thief's record and revokes."""
+        if not self._cas:
+            os.utime(self._lease)
+            return
+        from flink_tpu.fs import CASConflictError
+
+        rec, tag = self._read_cas()
+        if (rec is None or rec.leader_id != self.leader_id
+                or rec.epoch != self.epoch):
+            return  # deposed — _contend_once's next read revokes
+        rec.renewed_at = time.time()
+        try:
+            self._fs.put_if(
+                self._lease,
+                json.dumps(dataclasses.asdict(rec)).encode(), tag)
+        except CASConflictError as e:
+            raise OSError(f"lease renewal lost a CAS race: {e}") from e
 
     @property
     def _hwm_path(self) -> str:
@@ -191,6 +313,13 @@ class LeaderElection:
 
     def _epoch_hwm(self) -> int:
         try:
+            if self._cas:
+                if not self._fs.exists(self._hwm_path):
+                    return 0
+                with self._fs.open_read(self._hwm_path) as f:
+                    raw = f.read()
+                return int((raw.decode("utf-8") if isinstance(raw, bytes)
+                            else raw).strip() or 0)
             with open(self._hwm_path) as f:
                 return int(f.read().strip() or 0)
         except FileNotFoundError:
@@ -255,7 +384,7 @@ class LeaderElection:
                 # guard) but the lease ages toward a standby's steal
                 faults.fire("ha.lease.renew", exc=OSError,
                             leader=self.leader_id)
-                os.utime(self._lease)  # renew
+                self._renew()
         else:
             cur = self._read()
             if cur is None:
@@ -294,7 +423,22 @@ class LeaderElection:
         so content is the identity; a blind remove could unlink the
         fresh lease of a contender that stole ours while we stalled).
         Rename-first like the steal, with a post-rename re-check that
-        restores a raced replacement."""
+        restores a raced replacement. CAS mode deletes after an
+        etag-consistent identity check — a thief's replacement between
+        check and delete is the same razor-thin window the local path
+        closes with link-restore; the epoch high-water mark keeps the
+        fencing token monotone even if that window fires, so the thief
+        re-claims at hwm+1 rather than regressing."""
+        if self._cas:
+            try:
+                rec, _ = self._read_cas()
+                if (rec is not None
+                        and rec.leader_id == self.leader_id
+                        and rec.epoch == self.epoch):
+                    self._fs.delete(self._lease)
+            except OSError:
+                pass
+            return
         try:
             rec = self._read()
             if (rec is None or rec.leader_id != self.leader_id
@@ -325,18 +469,37 @@ def takeover_count(ha_dir: str) -> int:
     arithmetic over-reports; this durable counter is what `session
     info`/`list` surface as ``takeovers``."""
     try:
-        with open(os.path.join(ha_dir, "takeovers.count")) as f:
-            return int(f.read().strip() or 0)
+        raw = _read_ha_file(ha_dir, "takeovers.count")
+        return int(raw.strip() or 0) if raw is not None else 0
     except (OSError, ValueError):
         return 0
+
+
+def _read_ha_file(ha_dir: str, name: str) -> Optional[str]:
+    """One HA-dir control file's text, through the fs seam for
+    scheme'd dirs (objstore HA) and raw open() for local ones."""
+    path = os.path.join(ha_dir, name)
+    if "://" in ha_dir:
+        from flink_tpu.fs import get_filesystem
+
+        fs = get_filesystem(ha_dir)
+        if not fs.exists(path):
+            return None
+        with fs.open_read(path) as f:
+            raw = f.read()
+        return raw.decode("utf-8") if isinstance(raw, bytes) else raw
+    with open(path) as f:
+        return f.read()
 
 
 def leader_address(ha_dir: str) -> Optional[str]:
     """Resolve the current leader's RPC address from the lease file
     (what CLI/clients use instead of a fixed --coordinator)."""
     try:
-        with open(os.path.join(ha_dir, "leader.lease")) as f:
-            return json.load(f)["address"]
+        raw = _read_ha_file(ha_dir, "leader.lease")
+        if raw is None:
+            return None
+        return json.loads(raw)["address"]
     except (OSError, ValueError, KeyError):
         return None
 

@@ -143,6 +143,22 @@ def _retention_below_checkpoint_interval(tmp_path):
         "log.retention.ms": 100}))
 
 
+@seed("CLEANER_DISABLED_WITH_RETENTION")
+def _retention_with_no_executor(tmp_path):
+    # a producing topic with a retention POLICY but no EXECUTOR: the
+    # background cleaner is off and nothing else in the runtime
+    # applies log.retention.* — the topic grows without bound while
+    # its owner believes retention is active. Clean negatives in
+    # TestCleanerDisabledWithRetention below.
+    from flink_tpu.log.connectors import LogSink
+
+    topic = str(tmp_path / "topic")
+    env = make_env({"log.retention.ms": 60_000})
+    ds = env.from_source(GeneratorSource(gen), WM())
+    ds.add_sink(LogSink(topic), name="writer")
+    return env.analyze()
+
+
 @seed("LOG_PREFETCH_INVALID")
 def _log_prefetch_invalid(tmp_path):
     return analyze_config(Configuration({
@@ -669,6 +685,57 @@ class TestStorageLocalLocksOnRemote:
             if f.rule == "STORAGE_LOCAL_LOCKS_ON_REMOTE"]
         assert len(findings) == 1
         assert "high-availability.dir" in findings[0].message
+
+    def test_conditional_put_scheme_is_quiet(self):
+        """PR-18 driver-awareness: a scheme whose registered driver
+        advertises conditional_put (the objstore CAS driver) ports
+        every lock-dependent path onto compare-and-swap — the race
+        the rule warns about is PREVENTED there, not bounded."""
+        assert "STORAGE_LOCAL_LOCKS_ON_REMOTE" not in self._rules({
+            "high-availability.dir": "objstore://ha",
+            "log.dir": "objstore://flink-log"})
+
+    def test_non_cas_remote_still_flags(self):
+        rules = self._rules({"log.dir": "hdfs://nn/flink-log"})
+        assert "STORAGE_LOCAL_LOCKS_ON_REMOTE" in rules
+
+
+class TestCleanerDisabledWithRetention:
+    """PR-18 satellite: CLEANER_DISABLED_WITH_RETENTION clean
+    negatives (the seeded violation lives in SEEDS)."""
+
+    def _analyze(self, conf, with_sink=True):
+        env = make_env(conf)
+        ds = env.from_source(GeneratorSource(gen), WM())
+        if with_sink:
+            from flink_tpu.log.connectors import LogSink
+
+            ds.add_sink(LogSink(str(env.config.get_raw(
+                "test.topic", "/tmp/_t"))), name="writer")
+        else:
+            ds.collect()
+        return [f.rule for f in env.analyze()]
+
+    def test_cleaner_enabled_is_quiet(self, tmp_path):
+        assert "CLEANER_DISABLED_WITH_RETENTION" not in self._analyze({
+            "test.topic": str(tmp_path / "t"),
+            "log.retention.ms": 60_000,
+            "log.cleaner.enabled": True})
+
+    def test_no_retention_is_quiet(self, tmp_path):
+        assert "CLEANER_DISABLED_WITH_RETENTION" not in self._analyze({
+            "test.topic": str(tmp_path / "t")})
+
+    def test_consume_only_plan_is_quiet(self):
+        """No LogSink in the plan: the consumer inherits the
+        producer's maintenance regime — nothing to warn."""
+        assert "CLEANER_DISABLED_WITH_RETENTION" not in self._analyze(
+            {"log.retention.ms": 60_000}, with_sink=False)
+
+    def test_bytes_retention_alone_fires(self, tmp_path):
+        rules = self._analyze({"test.topic": str(tmp_path / "t"),
+                               "log.retention.bytes": 1_000_000})
+        assert "CLEANER_DISABLED_WITH_RETENTION" in rules
 
 
 class TestRescaleRule:

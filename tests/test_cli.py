@@ -318,6 +318,116 @@ class TestLogCli:
         assert cli_main(["log", topic, "--compact"]) == 1
         assert "key" in capsys.readouterr().err
 
+    def test_live_cleaner_lease_refuses_manual_maintenance(
+            self, tmp_path, capsys):
+        """PR 18 exit contract: while a live cleaner service owns the
+        topic (cleaner.lease unexpired, unreleased), a manual
+        --compact/--retain exits 1 instead of fighting the service
+        for the maintenance lock; a released lease lifts the gate."""
+        topic = self._seed_topic(tmp_path)
+        now = int(time.time() * 1000)
+        lease = os.path.join(topic, "cleaner.lease")
+        with open(lease, "w") as f:
+            json.dump({"owner": "cleaner-svc", "epoch": 1,
+                       "pid": os.getpid(), "acquired_ms": now,
+                       "deadline_ms": now + 60_000}, f)
+        assert cli_main(["log", topic, "--compact"]) == 1
+        err = capsys.readouterr().err
+        assert "cleaner" in err and "cleaner-svc" in err
+        # plain describe (no maintenance) still works and surfaces it
+        rc, out = cli(capsys, "log", topic)
+        assert rc == 0
+        assert out["cleaner"]["live_owner"] == "cleaner-svc"
+        assert out["cleaner"]["lease"]["epoch"] == 1
+        # released lease: the manual pass proceeds
+        with open(lease, "w") as f:
+            json.dump({"owner": "cleaner-svc", "epoch": 1,
+                       "pid": os.getpid(), "acquired_ms": now,
+                       "deadline_ms": now + 60_000,
+                       "released": True}, f)
+        rc, out = cli(capsys, "log", topic, "--compact")
+        assert rc == 0
+        assert out["compaction_generation"] == 1
+
+    def test_describe_surfaces_group_generations(self, tmp_path,
+                                                 capsys):
+        from flink_tpu.log import ConsumerGroups
+
+        topic = self._seed_topic(tmp_path)
+        gen, _ix, _n = ConsumerGroups.join(topic, "dyn", "m1")
+        ConsumerGroups.join(topic, "dyn", "m2")
+        rc, out = cli(capsys, "log", topic)
+        assert rc == 0
+        # static group "readers" (no manifest) is absent; the dynamic
+        # group reports its current membership generation
+        assert out["group_generations"] == {"dyn": 2}
+
+
+class TestObjstoreCliChain:
+    """PR 18 tier-1 CLI smoke: two ``run --local`` jobs chained
+    through an ``objstore://`` topic — every commit marker, lease,
+    group offset, and manifest rides the conditional-put driver — with
+    the background cleaner enabled on the producing job (lease
+    acquired, passes published, released with the job)."""
+
+    def test_chain_with_cleaner_enabled(self, tmp_path, capsys):
+        import runner_job_log_chain as jobs
+
+        log_dir = "objstore://" + str(tmp_path / "logroot")
+        sink_dir = str(tmp_path / "sink")
+        n = 5
+        rc, out = cli(
+            capsys, "run", "--local",
+            "--entry", "runner_job_log_chain:produce",
+            "--job-id", "obj-chain-a",
+            "--conf", f"log.dir={log_dir}",
+            "--conf", "log.partitions=2",
+            "--conf", "log.cleaner.enabled=true",
+            "--conf", "log.cleaner.interval-ms=10",
+            "--conf", f"test.n-batches={n}")
+        assert rc == 0 and out["state"] == "FINISHED"
+        assert out["records_in"] == n * jobs.BATCH
+
+        # the driver-owned cleaner ran under its lease and released
+        # it at job teardown — no live owner survives the process
+        from flink_tpu.log.cleaner import (cleaner_status,
+                                           live_cleaner_owner,
+                                           read_cleaner_lease)
+
+        topic = os.path.join(log_dir, jobs.TOPIC)
+        status = cleaner_status(topic)
+        assert status is not None and status["passes"] >= 1
+        assert live_cleaner_owner(topic) is None
+        assert read_cleaner_lease(topic)["released"]
+
+        rc, out = cli(
+            capsys, "run", "--local",
+            "--entry", "runner_job_log_chain:consume",
+            "--job-id", "obj-chain-b",
+            "--conf", f"log.dir={log_dir}",
+            "--conf", f"test.sink-dir={sink_dir}",
+            "--conf", "state.num-key-shards=8",
+            "--conf", "state.slots-per-shard=64")
+        assert rc == 0 and out["state"] == "FINISHED"
+        assert out["records_in"] == n * jobs.BATCH
+
+        # the log CLI reads the object-store topic and surfaces the
+        # cleaner lifecycle next to the bus state
+        rc, info = cli(capsys, "log", topic)
+        assert rc == 0
+        assert info["partitions"] == 2
+        assert info["committed_records"] == n * jobs.BATCH
+        assert info["cleaner"]["status"]["passes"] >= 1
+        assert info["cleaner"]["live_owner"] is None
+
+        # consumer output diffs clean against the independent golden
+        got = jobs.read_committed_counts(sink_dir)
+        assert got == jobs.expected_counts(n) and len(got) > 0
+
+        # and fsck blesses the whole topic through the driver
+        assert cli_main(["fsck", topic]) == 0
+        capsys.readouterr()
+
 
 class TestLocalRun:
     def test_run_local_executes_entry(self, tmp_path, capsys):

@@ -47,6 +47,17 @@ pytestmark = pytest.mark.chaos
 LOUD = (ValueError, OSError)
 
 
+@pytest.fixture(autouse=True)
+def _restore_objstore_default():
+    """The objstore-bus tier re-registers ``objstore://`` over each
+    crash image's root; put the default (prefix-free) registration
+    back so later test modules resolve objstore paths verbatim."""
+    yield
+    import flink_tpu.fs_objstore as fso
+
+    fso.install(inner_prefix="")
+
+
 def _canon(obj):
     """Numpy-free canonical form for golden comparison."""
     if isinstance(obj, dict):
@@ -412,9 +423,99 @@ class LsmTier:
                 f"promised {meta['rows']}")
 
 
+class ObjstoreBusTier:
+    """PR 18: the bus tier served THROUGH the objstore CAS driver
+    composed over CrashFS (``install(inner_prefix="crash://<root>/")``
+    — every object put becomes a journaled atomic publish): a
+    committed 2PC transaction, CAS writer leases, dynamic-group
+    membership (two joins → generation 2) + a generation-keyed offset
+    commit, and a compaction pass whose manifest swap is a
+    conditional put. Recovery re-runs the idempotent sequence
+    (rebuild+re-commit, keep-epoch re-acquire, idempotent re-join,
+    max-merge re-commit at the CURRENT generation, re-compact) on
+    whatever objects the cut left visible."""
+
+    name = "objstore-bus"
+    TOPIC = "objstore://t"
+
+    def _install(self, root):
+        import flink_tpu.fs_objstore as fso
+
+        fso.install(inner_prefix=root.rstrip("/") + "/")
+
+    def _batch(self, lo):
+        return {"k": (np.arange(lo, lo + 8, dtype=np.int64) % 4),
+                "v": np.arange(lo, lo + 8, dtype=np.float64)}
+
+    def setup(self, root):
+        self._install(root)
+        create_topic(self.TOPIC, 2, key_field="k")
+        ap = TopicAppender(self.TOPIC, partitions=2, segment_records=4)
+        for cid in (1, 2):
+            b = self._batch(cid * 10)
+            ap.stage(cid, {0: [b], 1: [b]})
+            ap.commit(cid)
+
+    def mutate(self, root):
+        self._install(root)
+        ap = TopicAppender(self.TOPIC, partitions=2, segment_records=4)
+        b = self._batch(30)
+        ap.stage(3, {0: [b], 1: [b]})
+        aux = {"3": ap.snapshot(3)}
+        ap.commit(3)
+        LeaseManager(self.TOPIC, "producer-a", [0, 1],
+                     ttl_ms=3_600_000).acquire()
+        ConsumerGroups.join(self.TOPIC, "g1", "m1")
+        ConsumerGroups.join(self.TOPIC, "g1", "m2")
+        ConsumerGroups.commit(self.TOPIC, "g1", {0: 5, 1: 3},
+                              generation=2)
+        Compactor(self.TOPIC, min_segments=2).compact()
+        return aux
+
+    def recover(self, root, aux):
+        self._install(root)
+        ap = TopicAppender(self.TOPIC, partitions=2, segment_records=4)
+        ap.rebuild(3, aux["3"])
+        ap.commit(3)
+        LeaseManager(self.TOPIC, "producer-a", [0, 1],
+                     ttl_ms=3_600_000).acquire()
+        ConsumerGroups.join(self.TOPIC, "g1", "m1")
+        ConsumerGroups.join(self.TOPIC, "g1", "m2")
+        gen = ConsumerGroups.read_membership(
+            self.TOPIC, "g1")["generation"]
+        ConsumerGroups.commit(self.TOPIC, "g1", {0: 5, 1: 3},
+                              generation=gen)
+        Compactor(self.TOPIC, min_segments=2).compact()
+        ap.sweep_orphans()
+
+    def observe(self, root):
+        self._install(root)
+        view = _read_topic(self.TOPIC)
+        leases = {p: {"owner": rec.get("owner"),
+                      "epoch": rec.get("epoch"),
+                      "released": rec.get("released", False)}
+                  for p, rec in list_leases(self.TOPIC).items()}
+        return {"topic": view,
+                "groups": _canon(list_group_offsets(self.TOPIC)),
+                "membership": _canon(ConsumerGroups.read_membership(
+                    self.TOPIC, "g1")),
+                "leases": _canon(leases)}
+
+    def check_image(self, root):
+        """PUT-is-durable, asserted BEFORE recovery: an object either
+        exists whole or not at all — every .json object in the image
+        must parse (a torn one would mean the buffered-put publish
+        leaked a partial object through the crash)."""
+        for dirpath, _dirs, files in os.walk(os.path.join(root, "t")):
+            for name in files:
+                if name.endswith(".json"):
+                    with open(os.path.join(dirpath, name)) as f:
+                        json.load(f)
+
+
 TIERS = (CheckpointTier(), LogTxnTier(), CompactionTier(),
          LeaseGroupTier(), FileSinkTier(), HaRegistryTier(),
-         LsmTier())
+         LsmTier(), ObjstoreBusTier())
 
 
 # -- the explorer ---------------------------------------------------------

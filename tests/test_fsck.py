@@ -210,3 +210,122 @@ class TestDetect:
         assert detect_kind(os.path.dirname(jdir)) == "checkpoint"
         assert detect_kind(glob.glob(jdir + "/chk-1")[0]) == "checkpoint"
         assert detect_kind(str(tmp_path)) is None
+
+
+class TestCoordinationRecords:
+    """PR 18: fsck learns the bus-tier coordination records — group
+    membership generations (offset commits never run AHEAD of the
+    manifest the fence admitted them against), the background
+    cleaner's lease, and objstore conditional-put serialization
+    scratch (swept only under the maintenance lock + age grace)."""
+
+    def _bus_topic(self, tmp_path, scheme_prefix=""):
+        from flink_tpu.log.bus import ConsumerGroups
+
+        topic = scheme_prefix + os.path.join(str(tmp_path), "bus")
+        ap = TopicAppender(topic, partitions=2, segment_records=4,
+                           key_field="k")
+        ap.stage(1, {p: [{"k": np.arange(8, dtype=np.int64),
+                          "v": np.arange(8, dtype=np.float64)}]
+                     for p in range(2)})
+        ap.commit(1)
+        gen, _ix, _n = ConsumerGroups.join(topic, "g", "m1")
+        ConsumerGroups.commit(topic, "g", {0: 8}, generation=gen)
+        return topic
+
+    def test_coherent_bus_topic_is_clean(self, tmp_path):
+        topic = self._bus_topic(tmp_path, "objstore://")
+        assert fsck_path(topic) == []
+
+    def test_offset_generation_ahead_of_manifest(self, tmp_path,
+                                                 capsys):
+        topic = self._bus_topic(tmp_path)
+        opath = os.path.join(topic, "groups", "g", "p1.json")
+        with open(opath, "w") as f:
+            json.dump({"offset": 4, "generation": 7}, f)
+        findings = fsck_path(topic)
+        assert rules_of(findings) == {"GROUP_GENERATION_INCOHERENT"}
+        assert "ahead" in findings[0]["message"]
+        assert cli_main(["fsck", topic]) == 1
+        capsys.readouterr()
+
+    def test_generation_keyed_offset_without_manifest(self, tmp_path):
+        topic = self._bus_topic(tmp_path)
+        os.unlink(os.path.join(topic, "groups", "g",
+                               "membership.json"))
+        findings = fsck_path(topic)
+        assert rules_of(findings) == {"GROUP_GENERATION_INCOHERENT"}
+        assert "no membership manifest" in findings[0]["message"]
+
+    def test_torn_membership_manifest(self, tmp_path):
+        topic = self._bus_topic(tmp_path)
+        with open(os.path.join(topic, "groups", "g",
+                               "membership.json"), "w") as f:
+            f.write('{"generation": 1, "mem')
+        assert "CORRUPT_CONTROL" in rules_of(fsck_path(topic))
+
+    def test_stale_cleaner_lease_flagged_live_and_released_quiet(
+            self, tmp_path):
+        topic = self._bus_topic(tmp_path)
+        lease = os.path.join(topic, "cleaner.lease")
+        now = int(time.time() * 1000)
+        # live (unexpired) lease: healthy running service, no finding
+        with open(lease, "w") as f:
+            json.dump({"owner": "svc", "epoch": 1, "pid": os.getpid(),
+                       "acquired_ms": now, "deadline_ms": now + 60_000},
+                      f)
+        assert fsck_path(topic) == []
+        # released lease: clean shutdown, no finding
+        with open(lease, "w") as f:
+            json.dump({"owner": "svc", "epoch": 1, "pid": os.getpid(),
+                       "acquired_ms": now, "deadline_ms": now + 60_000,
+                       "released": True}, f)
+        assert fsck_path(topic) == []
+        # expired without release: crashed cleaner service
+        with open(lease, "w") as f:
+            json.dump({"owner": "svc", "epoch": 2, "pid": os.getpid(),
+                       "acquired_ms": 0, "deadline_ms": 5}, f)
+        findings = fsck_path(topic)
+        assert rules_of(findings) == {"STALE_CLEANER_LEASE"}
+        assert findings[0]["severity"] == "warn"
+        assert "epoch+1" in findings[0]["message"]
+
+    def test_lock_debris_found_through_objstore_and_local(
+            self, tmp_path):
+        topic = self._bus_topic(tmp_path, "objstore://")
+        local = os.path.join(str(tmp_path), "bus")
+        debris = os.path.join(local, "groups", "g", "p0.json.lock~")
+        open(debris, "w").close()
+        # the objstore fs hides the scratch from listdir, the local
+        # view shows it raw — fsck reports it either way
+        for path in (topic, local):
+            findings = fsck_path(path)
+            assert rules_of(findings) == {"OBJSTORE_LOCK_DEBRIS"}
+            assert findings[0]["repairable"]
+
+    def test_lock_debris_repair_respects_grace_and_maintenance_lock(
+            self, tmp_path):
+        from flink_tpu.log.topic import (release_maintenance_lock,
+                                         try_maintenance_lock)
+
+        topic = self._bus_topic(tmp_path, "objstore://")
+        local = os.path.join(str(tmp_path), "bus")
+        debris = os.path.join(local, "cleaner.lease.lock~")
+        open(debris, "w").close()
+        # fresh: a put_if may hold it this instant — kept
+        findings = fsck_path(topic, repair=True)
+        assert not findings[0]["repaired"] and os.path.exists(debris)
+        # aged past the grace but the maintenance lock is busy — kept
+        age(debris)
+        fd = try_maintenance_lock(topic)
+        assert fd is not None
+        try:
+            findings = fsck_path(topic, repair=True)
+            assert (not findings[0]["repaired"]
+                    and os.path.exists(debris))
+        finally:
+            release_maintenance_lock(topic, fd)
+        # aged + lock free: swept
+        findings = fsck_path(topic, repair=True)
+        assert findings[0]["repaired"] and not os.path.exists(debris)
+        assert fsck_path(topic) == []

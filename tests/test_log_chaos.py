@@ -20,7 +20,9 @@ crash between pre-commit and commit):
 Every failure prints the fault seed + injection log for exact replay
 (the test_chaos.py discipline)."""
 import contextlib
+import os
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -605,6 +607,164 @@ class TestPrefetchChaos:
             assert [x[:2] for x in plan.log] == [("log.prefetch.read",
                                                   "raise")]
             assert got == golden
+
+
+class TestObjstoreCasChaos:
+    """PR 18: injection at the conditional-write, rebalance and
+    cleaner seams. The fake object store replaces every O_EXCL lock
+    with compare-and-swap, so the new crash windows are (a) a CAS
+    conflict landing mid-lease-takeover, (b) the cleaner dying
+    between compaction rewrite and manifest swap on ``objstore://``,
+    and (c) a membership/fence update dying mid-flight — in every
+    case committed reads stay byte-identical and a fault-free retry
+    converges."""
+
+    @pytest.fixture()
+    def objstore_topic(self, kv_golden, tmp_path):
+        """The golden keyed topic's bytes served through the objstore
+        CAS driver (the driver's backing store is a local prefix, so
+        a tree copy IS an object-for-object upload)."""
+        import shutil
+
+        import flink_tpu.fs_objstore as fso
+
+        objroot = str(tmp_path / "objstore-backing")
+        shutil.copytree(kv_golden["dir"],
+                        os.path.join(objroot, "topic"))
+        fso.install(inner_prefix=objroot + "/")
+        try:
+            yield "objstore://topic"
+        finally:
+            fso.install(inner_prefix="")
+
+    def test_cas_conflict_mid_lease_takeover(self, tmp_path,
+                                             kv_golden,
+                                             objstore_topic):
+        """Producer A dies holding CAS leases; successor B's takeover
+        publish loses the conditional write (injected 412 at
+        fs.cas.put) — the takeover fails LOUDLY, leaves A's lease
+        record intact, and B's fault-free retry takes over at a
+        bumped epoch with reads byte-identical throughout."""
+        from flink_tpu.log import LeaseError, LeaseManager
+
+        a = LeaseManager(objstore_topic, "prod-a", [0, 1], ttl_ms=1)
+        epochs_a = a.acquire()
+        assert set(epochs_a) == {0, 1}
+        # A crashes: no release — B must wait out the 1ms ttl, then
+        # steal via CAS-at-the-etag-it-read
+        time.sleep(0.01)
+        b = LeaseManager(objstore_topic, "prod-b", [0, 1],
+                         ttl_ms=30_000)
+        plan = faults.FaultPlan(seed=CHAOS_SEED).rule(
+            "fs.cas.put", "raise", count=1)
+        with plan.activate(), replayable(plan):
+            with pytest.raises(LeaseError):
+                b.acquire()
+            assert [x[:2] for x in plan.log] == [("fs.cas.put",
+                                                  "raise")]
+        # the failed takeover left the topic readable and A's records
+        # in place (a lost CAS writes NOTHING — no torn lease)
+        assert read_everything(objstore_topic) == kv_golden["full"]
+        epochs_b = b.acquire()  # fault-free retry: the real takeover
+        assert all(epochs_b[p] > epochs_a[p] for p in (0, 1))
+        assert read_everything(objstore_topic) == kv_golden["full"]
+        b.release()
+
+    def test_cleaner_crash_between_rewrite_and_swap(
+            self, tmp_path, kv_golden, objstore_topic):
+        """THE cleaner crash window on objstore://: compaction rewrote
+        the new generation's objects but died before the manifest
+        CAS swap — readers observe the OLD generation whole
+        (byte-identical to golden), and the retried pass converges to
+        the same table a fault-free pass produces."""
+        from flink_tpu.log import LogCleaner
+        from flink_tpu.log.cleaner import cleaner_status
+
+        cfg = Configuration({"log.compaction.min-segments": 1})
+        cleaner = LogCleaner(objstore_topic, cfg, owner="svc")
+        plan = faults.FaultPlan(seed=CHAOS_SEED).rule(
+            "log.compact.swap", "raise", count=1)
+        with plan.activate(), replayable(plan):
+            with pytest.raises(OSError, match="injected fault"):
+                cleaner.run_pass()
+            assert ("log.compact.swap", "raise") in [
+                x[:2] for x in plan.log]
+        # pre-swap crash: old generation whole, no status published
+        assert TopicReader(objstore_topic).generation == 0
+        assert read_everything(objstore_topic) == kv_golden["full"]
+        assert cleaner_status(objstore_topic) is None
+        # the retried pass (same lease, same epoch) converges
+        res = cleaner.run_pass()
+        assert res["compacted"]["gen"] == 1
+        assert res["passes"] == 1
+        assert latest_table(objstore_topic) == kv_golden["latest"]
+        cleaner.stop()
+
+    def test_cleaner_pass_point_kills_before_mutation(
+            self, tmp_path, kv_golden, objstore_topic):
+        """log.cleaner.pass fires at the top of every held-lease pass
+        — an injected raise there proves the pass dies before ANY
+        maintenance mutation."""
+        from flink_tpu.log import LogCleaner
+
+        cleaner = LogCleaner(objstore_topic,
+                             Configuration({}), owner="svc")
+        plan = faults.FaultPlan(seed=CHAOS_SEED).rule(
+            "log.cleaner.pass", "raise", count=1)
+        with plan.activate(), replayable(plan):
+            with pytest.raises(OSError, match="injected fault"):
+                cleaner.run_pass()
+            assert [x[:2] for x in plan.log] == [("log.cleaner.pass",
+                                                  "raise")]
+        assert TopicReader(objstore_topic).generation == 0
+        assert read_everything(objstore_topic) == kv_golden["full"]
+        cleaner.stop()
+
+    def test_rebalance_crash_leaves_membership_whole(
+            self, tmp_path, kv_golden):
+        """log.group.rebalance fires before the membership manifest
+        publish: a join dying there changes NOTHING (no generation
+        bump, no member), and the retry converges to exactly one
+        bump."""
+        from flink_tpu.log import ConsumerGroups
+
+        topic = _copy_topic(kv_golden, tmp_path)
+        ConsumerGroups.join(topic, "g", "m1")
+        plan = faults.FaultPlan(seed=CHAOS_SEED).rule(
+            "log.group.rebalance", "raise", count=1)
+        with plan.activate(), replayable(plan):
+            with pytest.raises(OSError, match="injected fault"):
+                ConsumerGroups.join(topic, "g", "m2")
+            assert [x[:2] for x in plan.log] == [
+                ("log.group.rebalance", "raise")]
+        m = ConsumerGroups.read_membership(topic, "g")
+        assert m == {"generation": 1, "members": ["m1"]}
+        gen, ix, n = ConsumerGroups.join(topic, "g", "m2")  # retry
+        assert (gen, n) == (2, 2)
+        assert ConsumerGroups.read_membership(topic, "g") == {
+            "generation": 2, "members": ["m1", "m2"]}
+
+    def test_fence_crash_leaves_offsets_whole(self, tmp_path,
+                                              kv_golden):
+        """log.group.fence fires at the generation gate of every
+        generation-keyed commit: a raise there dies BEFORE any offset
+        file is touched, and the retry lands the exact same
+        offsets."""
+        from flink_tpu.log import ConsumerGroups
+
+        topic = _copy_topic(kv_golden, tmp_path)
+        ConsumerGroups.join(topic, "g", "m1")
+        plan = faults.FaultPlan(seed=CHAOS_SEED).rule(
+            "log.group.fence", "raise", count=1)
+        with plan.activate(), replayable(plan):
+            with pytest.raises(OSError, match="injected fault"):
+                ConsumerGroups.commit(topic, "g", {0: 5, 1: 7},
+                                      generation=1)
+            assert ("log.group.fence", "raise") in [
+                x[:2] for x in plan.log]
+        assert ConsumerGroups.committed(topic, "g") == {}
+        ConsumerGroups.commit(topic, "g", {0: 5, 1: 7}, generation=1)
+        assert ConsumerGroups.committed(topic, "g") == {0: 5, 1: 7}
 
 
 @pytest.mark.slow

@@ -1,0 +1,341 @@
+"""PR 18 — the self-maintaining bus tier: the leased background
+cleaner racing LIVE leased producers (reads byte-identical to a
+never-cleaned golden above the group floor, latest-per-key identical
+below it), cleaner-lease fencing (single owner, epoch takeover,
+deposed pass rejected), the driver-owned cleaner lifecycle, and
+consumer-group REBALANCE — members joining AND leaving mid-stream
+with generation-fenced offset commits, exactly-once against a
+static-membership golden."""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.api.sinks import FileTransactionalSink
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.config import Configuration, LogOptions
+from flink_tpu.log import (
+    ConsumerGroups,
+    LeaseManager,
+    LogCleaner,
+    LogSink,
+    LogSource,
+    TopicAppender,
+    TopicReader,
+    cleaner_status,
+    describe_topic,
+    live_cleaner_owner,
+)
+from flink_tpu.log.cleaner import CleanerLease, check_manual_maintenance
+from flink_tpu.log.topic import LogError
+from flink_tpu.runtime.supervisor import run_with_recovery
+
+pytestmark = pytest.mark.log
+
+PARTS = 2
+ROWS = 16
+KEYS = 6
+
+
+def _round_batch(r, p=0):
+    """Round r's keyed upsert batch for partition p: per-partition
+    key domains (the per-key order contract) and globally distinct
+    seq — latest-per-key changes every round, and every (k, seq) row
+    in the topic is unique (exactly-once accounting can use sets)."""
+    seq = (r * PARTS + p) * ROWS + np.arange(ROWS, dtype=np.int64)
+    return {"k": seq % KEYS + p * 100, "seq": seq, "ts_ms": seq * 10}
+
+
+def _produce_rounds(topic, rounds, leased=False, start=1):
+    lease = None
+    if leased:
+        lease = LeaseManager(topic, "prod", [0, 1], ttl_ms=3_600_000)
+        lease.acquire()
+    ap = TopicAppender(
+        topic, PARTS, segment_records=8, key_field="k",
+        writer_id="prod" if leased else None,
+        owned_partitions=[0, 1] if leased else None, lease=lease)
+    for cid in range(start, start + rounds):
+        assert ap.stage(cid, {p: [_round_batch(cid, p)]
+                              for p in range(PARTS)})
+        ap.commit(cid)
+    if lease is not None:
+        lease.release()
+
+
+def _read_from(topic, offsets):
+    """Reads from the given per-partition offsets — the view a group
+    pinned at those offsets observes (must be byte-identical whether
+    or not the cleaner ran; the safety floor's contract)."""
+    r = TopicReader(topic)
+    out = {}
+    for p in range(r.partitions):
+        rows = []
+        for _off, _nxt, b in r.read3(p, int(offsets.get(p, 0))):
+            rows.extend(zip(b["k"].tolist(), b["seq"].tolist()))
+        out[p] = rows
+    return out
+
+
+def _latest(topic):
+    table = {}
+    for p in range(TopicReader(topic).partitions):
+        for rows in _read_from(topic, {}).values():
+            for k, seq in rows:
+                if k not in table or seq > table[k]:
+                    table[k] = seq
+    return dict(sorted(table.items()))
+
+
+class TestCleanerRacesLiveProducer:
+    """The tentpole proof: N rounds of a LIVE leased producer racing
+    the background cleaner — after every round the group-floor view
+    and the latest-per-key table are byte-identical to a topic that
+    was NEVER cleaned."""
+
+    ROUNDS = 5
+
+    def test_reads_byte_identical_to_never_cleaned_golden(
+            self, tmp_path):
+        golden = str(tmp_path / "golden")
+        raced = str(tmp_path / "raced")
+        cfg = Configuration({
+            LogOptions.CLEANER_INTERVAL_MS.key: 5,
+            LogOptions.COMPACTION_MIN_SEGMENTS.key: 1,
+        })
+        # golden: all rounds, never cleaned
+        _produce_rounds(golden, 2, leased=True)
+        _produce_rounds(raced, 2, leased=True)
+        # a consumer group pins the floor mid-history on BOTH topics:
+        # everything above it must stay raw and byte-identical
+        floor = dict(TopicReader(raced).committed_offsets())
+        ConsumerGroups.commit(golden, "g", dict(floor))
+        ConsumerGroups.commit(raced, "g", dict(floor))
+        _produce_rounds(golden, self.ROUNDS, leased=True, start=3)
+
+        cleaner = LogCleaner(raced, cfg, owner="svc")
+        cleaner.start()
+        try:
+            # the live race: one producer round at a time, cleaner
+            # cadence (5ms) interleaving maintenance passes throughout
+            lease = LeaseManager(raced, "prod", [0, 1],
+                                 ttl_ms=3_600_000)
+            lease.acquire()
+            ap = TopicAppender(raced, PARTS, segment_records=8,
+                               key_field="k", writer_id="prod",
+                               owned_partitions=[0, 1], lease=lease)
+            for cid in range(3, 3 + self.ROUNDS):
+                assert ap.stage(cid, {p: [_round_batch(cid, p)]
+                                      for p in range(PARTS)})
+                ap.commit(cid)
+                time.sleep(0.012)  # let >= 2 cleaner passes land
+            lease.release()
+        finally:
+            cleaner.stop()
+        assert cleaner.passes >= 2, (
+            "the race never actually interleaved a cleaner pass")
+        # above the group floor: byte-identical raw history
+        assert _read_from(raced, floor) == _read_from(golden, floor)
+        # whole-topic semantics: identical latest-per-key + identical
+        # committed ends (compaction preserves offsets; only
+        # overwritten rows below the floor may differ)
+        assert _latest(raced) == _latest(golden)
+        assert (TopicReader(raced).committed_offsets()
+                == TopicReader(golden).committed_offsets())
+        st = cleaner_status(raced)
+        assert st is not None and st["passes"] == cleaner.passes
+        assert live_cleaner_owner(raced) is None  # stop released it
+
+
+class TestCleanerLeaseFencing:
+    def _topic(self, tmp_path):
+        topic = str(tmp_path / "t")
+        _produce_rounds(topic, 2)
+        return topic
+
+    def test_single_owner_per_topic(self, tmp_path):
+        topic = self._topic(tmp_path)
+        cfg = Configuration({})
+        a = LogCleaner(topic, cfg, owner="svc-a")
+        a.lease.acquire()
+        with pytest.raises(LogError, match="owned by cleaner"):
+            LogCleaner(topic, cfg, owner="svc-b").lease.acquire()
+        a.stop()
+
+    def test_expired_lease_takeover_bumps_epoch(self, tmp_path):
+        topic = self._topic(tmp_path)
+        a = CleanerLease(topic, "svc-a", ttl_ms=1)
+        e1 = a.acquire()
+        time.sleep(0.01)  # a "crashes": ttl expires, no release
+        b = CleanerLease(topic, "svc-b", ttl_ms=60_000)
+        e2 = b.acquire()
+        assert e2 == e1 + 1
+        # the deposed service's next pass dies at the verify fence
+        with pytest.raises(LogError, match="DEPOSED"):
+            a.verify()
+
+    def test_manual_maintenance_gate(self, tmp_path):
+        topic = self._topic(tmp_path)
+        c = LogCleaner(topic, Configuration({}), owner="svc")
+        c.lease.acquire()
+        with pytest.raises(LogError, match="live cleaner service"):
+            check_manual_maintenance(topic)
+        c.stop()
+        check_manual_maintenance(topic)  # released: manual pass ok
+
+    def test_describe_topic_surfaces_cleaner(self, tmp_path):
+        topic = self._topic(tmp_path)
+        c = LogCleaner(topic, Configuration(
+            {LogOptions.COMPACTION_MIN_SEGMENTS.key: 1}), owner="svc")
+        c.run_pass()
+        d = describe_topic(topic)
+        assert d["cleaner"]["live_owner"] == "svc"
+        assert d["cleaner"]["status"]["passes"] == 1
+        assert d["cleaner"]["lease"]["epoch"] == 1
+        c.stop()
+        assert describe_topic(topic)["cleaner"]["live_owner"] is None
+
+
+class TestDriverOwnedCleaner:
+    def test_cleaner_runs_and_releases_with_the_job(self, tmp_path):
+        topic = str(tmp_path / "t")
+
+        def gen(split, i):
+            if i >= 6:
+                return None
+            b = _round_batch(i + 1)
+            return b, b["ts_ms"]
+
+        env = StreamExecutionEnvironment(Configuration({
+            LogOptions.CLEANER_ENABLED.key: True,
+            LogOptions.CLEANER_INTERVAL_MS.key: 10,
+        }))
+        env.from_source(GeneratorSource(gen)).add_sink(
+            LogSink(topic, key_field="k", partitions=PARTS))
+        env.execute("producer-with-cleaner")
+        st = cleaner_status(topic)
+        assert st is not None and st["passes"] >= 1
+        assert live_cleaner_owner(topic) is None  # released at finish
+
+    def test_second_driver_degrades_without_cleaner(self, tmp_path):
+        """A live cleaner service on the topic: a second cleaner-
+        enabled run must NOT fight it — it degrades to no cleaner of
+        its own and the job still completes."""
+        topic = str(tmp_path / "t")
+        _produce_rounds(topic, 1)
+        held = LogCleaner(topic, Configuration({}), owner="other-svc")
+        held.lease.acquire()
+
+        def gen(split, i):
+            if i >= 2:
+                return None
+            b = _round_batch(i + 10)
+            return b, b["ts_ms"]
+
+        env = StreamExecutionEnvironment(Configuration({
+            LogOptions.CLEANER_ENABLED.key: True,
+            LogOptions.CLEANER_INTERVAL_MS.key: 10,
+        }))
+        env.from_source(GeneratorSource(gen)).add_sink(
+            LogSink(topic, key_field="k", partitions=PARTS))
+        env.execute("producer-vs-held-lease")
+        assert live_cleaner_owner(topic) == "other-svc"  # untouched
+        held.stop()
+
+
+def _consume(topic, out_dir, ckpt_dir, member):
+    """One dynamic-membership consumer job: joins at open, reads its
+    manifest assignment from the group's committed offsets, commits
+    generation-keyed offsets at every checkpoint."""
+
+    def build_env(conf):
+        env = StreamExecutionEnvironment(conf)
+        env.from_source(LogSource(topic, ts_field="ts_ms", group="g",
+                                  member_id=member)
+                        ).add_sink(FileTransactionalSink(str(out_dir)))
+        return env
+
+    conf = Configuration({
+        "pipeline.microbatch-size": ROWS,
+        "execution.checkpointing.dir": str(ckpt_dir),
+        "execution.checkpointing.interval": 1,
+        "restart-strategy.type": "fixed-delay",
+        "restart-strategy.fixed-delay.attempts": 10,
+        "restart-strategy.fixed-delay.delay": 1,
+    })
+    run_with_recovery(build_env, conf, job_name=f"member-{member}")
+    return sorted((int(r["k"]), int(r["seq"]))
+                  for r in FileTransactionalSink.committed_rows(
+                      str(out_dir)))
+
+
+class TestRebalanceMidStreamExactlyOnce:
+    """The tentpole proof: a member JOINS mid-stream (generation
+    bump, the deposed generation's late commit rejected) and a member
+    LEAVES mid-stream (same fence, other direction) — the union of
+    everything the members' jobs committed equals the static-
+    membership golden exactly once."""
+
+    def test_join_and_leave_exactly_once(self, tmp_path):
+        topic = str(tmp_path / "t")
+        # static-membership golden: the whole topic, consumed once
+        _produce_rounds(topic, 2)
+
+        # phase 1: member a alone (gen 1 — every partition is a's)
+        rows_a1 = _consume(topic, tmp_path / "out-a1",
+                           tmp_path / "ck-a1", "a")
+        assert ConsumerGroups.read_membership(topic, "g") == {
+            "generation": 1, "members": ["a"]}
+        committed_after_1 = ConsumerGroups.committed(topic, "g")
+
+        # JOIN mid-stream: b arrives -> generation 2; the deposed
+        # generation's late commit is rejected at the fence and
+        # changes nothing
+        gen, ix, n = ConsumerGroups.join(topic, "g", "b")
+        assert (gen, n) == (2, 2)
+        with pytest.raises(LogError, match="DEPOSED generation"):
+            ConsumerGroups.commit(topic, "g", {0: 10 ** 6},
+                                  generation=1)
+        assert ConsumerGroups.committed(topic, "g") == committed_after_1
+
+        # the stream continues: two more rounds land
+        _produce_rounds(topic, 2, start=3)
+
+        # phase 2: a and b each run their (rebalanced) assignment —
+        # a owns p0, b owns p1 (sorted-index p % 2); each bootstraps
+        # from the group's committed offsets, so nothing replays
+        rows_a2 = _consume(topic, tmp_path / "out-a2",
+                           tmp_path / "ck-a2", "a")
+        rows_b2 = _consume(topic, tmp_path / "out-b2",
+                           tmp_path / "ck-b2", "b")
+        assert ConsumerGroups.read_membership(topic, "g") == {
+            "generation": 2, "members": ["a", "b"]}
+
+        # LEAVE mid-stream: a departs -> generation 3; a's (now
+        # stale) generation-2 commit is rejected the same way
+        assert ConsumerGroups.leave(topic, "g", "a") == 3
+        with pytest.raises(LogError, match="DEPOSED generation"):
+            ConsumerGroups.commit(topic, "g", {0: 10 ** 6},
+                                  generation=2)
+
+        # the stream continues again; b (sole member, gen 3) now owns
+        # BOTH partitions and picks up p0 from a's committed offset
+        _produce_rounds(topic, 1, start=5)
+        rows_b3 = _consume(topic, tmp_path / "out-b3",
+                           tmp_path / "ck-b3", "b")
+
+        # exactly-once across the whole membership history: the
+        # union of every member's committed output IS the topic,
+        # no duplicates, no gaps
+        golden = sorted(
+            (k, seq) for rows in _read_from(topic, {}).values()
+            for k, seq in rows)
+        got = sorted(rows_a1 + rows_a2 + rows_b2 + rows_b3)
+        assert got == golden
+        assert len(got) == len(set(got))  # no duplicates
+        # and the group floor covers the whole topic
+        assert (ConsumerGroups.committed(topic, "g")
+                == dict(TopicReader(topic).committed_offsets()))

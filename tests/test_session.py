@@ -690,6 +690,11 @@ class TestMetricsIsolation:
         "flink_tpu.faults",
         "flink_tpu.log.topic",
         "flink_tpu.fs",
+        # cleaner metrics are per-topic groups like log.topic's, and
+        # the fenced cleaner.lease means at most one cleaner service
+        # maintains a topic at a time (PR 18) — process-plane, not
+        # per-job
+        "flink_tpu.log.cleaner",
     }
 
     def test_no_module_level_registry_outside_allowlist(self):
