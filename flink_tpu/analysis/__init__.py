@@ -25,15 +25,25 @@ validation, §3.6 — generalized into a rule engine):
   legs, exactly-once taint through log topics, state budgets) read the
   propagated facts; ``analyze --explain`` prints them per node.
 
-- **Repo AST lints** (``pylints.py``): a pure-stdlib ``ast`` pass over
-  the codebase itself — tracer leaks in jit kernels (host conversions /
-  Python branches on traced values, the failure class PROFILE §8.1's
-  design rules exist to prevent), fault-point literals drifting from
-  the ``faults.py`` registry, config/metric name drift, and unlocked
-  shared-state writes in HostPool task closures (the concurrency
-  plane). Run via ``python -m flink_tpu lint`` or ``tools/lint.py``;
-  the dogfood gate (tests/test_analysis.py) keeps the shipped tree at
-  zero findings.
+- **Repo AST lints** (``pylints.py`` over ``callgraph.py``): a
+  pure-stdlib INTERPROCEDURAL pass over the codebase itself — the
+  linted files are indexed into one project-wide call graph (defs,
+  methods resolved through the receiver's inferred self-type,
+  module-qualified calls, lock/lease binding types) and the protocol
+  rules walk its edges: tracer leaks in jit kernels (host conversions /
+  Python branches on traced values, followed through the helpers the
+  traced arguments flow into — the failure class PROFILE §8.1's design
+  rules exist to prevent), fault-point drift in BOTH directions
+  (unknown ``faults.fire`` literals and registered points nothing
+  fires), config/metric name drift, unlocked shared-state writes in
+  HostPool task closures at any call depth (lock guards recognized by
+  binding type), raw durable writes bypassing the fs.py seam,
+  lock-order (ABBA) cycles with both acquisition paths named, and
+  fenced-record publications a deposed leaseholder could still make
+  (no lease verify()/renew on the path). Run via ``python -m flink_tpu
+  lint [--plane NAME]`` or ``tools/lint.py``; the dogfood gate
+  (tests/test_analysis.py) keeps the shipped tree at zero findings and
+  the full pass under a 3 s wall-clock budget.
 
 RULES.md is GENERATED from the registrations (``docs.py`` +
 ``tools/gen_rules.py``) with a tier-1 staleness gate, so a rule cannot
@@ -43,9 +53,12 @@ Honest scope: the dataflow plane has no cross-function taint (a field
 smuggled through opaque user state is invisible), no symbolic shapes
 (state estimates use declared config geometry, not data), and schema
 facts stop at the first chain that is opaque to empty-batch
-evaluation; the tracer-leak lint tracks only direct uses of a
-jit-traced parameter inside its own kernel body, and the concurrency
-lint sees one call hop from the submitted closure.
+evaluation. The repo lints DO cross functions, but the walks are
+capped (8 call hops for tracer taint, 6 for pool writes and fence
+walks), only name / self-method / module-qualified calls resolve (no
+duck-typed dispatch), and lock identity is syntactic — a lock aliased
+through a variable or passed as a bare parameter falls back to
+name-substring recognition.
 """
 from flink_tpu.analysis.core import (
     AnalysisError,

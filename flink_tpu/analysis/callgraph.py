@@ -1,0 +1,413 @@
+"""Project call graph — the interprocedural substrate of the lint plane.
+
+``build_graph({relpath: ast.Module})`` indexes every module of the
+linted set into one :class:`CallGraph`: functions (module-level and
+nested), classes with their methods and ``self``-attribute bindings,
+import aliases, and module-level string/lock constants. ``resolve()``
+then maps a call expression to the function definitions it can reach —
+the name-resolution forms the interprocedural rules key on:
+
+- **bare names** — ``helper(x)`` to a def in the same module (any
+  nesting depth; shadowing is ignored, a documented approximation), or
+  through ``from mod import helper``;
+- **methods via self-type** — ``self._bump(c)`` to the enclosing
+  class's method (base classes resolved when they name a project
+  class), and ``self.lease.verify()`` through the recorded binding
+  ``self.lease = LeaseManager(...)``;
+- **module-qualified calls** — ``bus.commit(...)`` through ``import
+  flink_tpu.log.bus as bus`` / ``from flink_tpu.log import bus``, and
+  ``ClassName.method(...)`` staticmethod-style calls.
+
+Binding-type tracking rides on the same index: ``x =
+threading.Lock()`` / ``self._mu = threading.RLock()`` register *lock
+bindings* (module names / class attrs), which the concurrency and
+lock-order rules use instead of the retired name-substring-only
+heuristic; ``NAME = "literal"`` module constants feed fault-point
+liveness resolution.
+
+Honest scope (syntactic, flow-insensitive): no inheritance across
+unresolvable bases, no tracking of functions passed as values (other
+than the hostpool rule's own closure binding walk), no conditional
+rebinding — the LAST textual ``self.attr = Cls(...)`` wins. That is
+the precision the protocol lints need; it is not a type checker.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+# constructor names that bind a mutual-exclusion guard — binding-type
+# lock recognition (threading.Lock/RLock assignment tracking)
+LOCK_CONSTRUCTORS = frozenset(
+    ("Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"))
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function/method definition in the linted set."""
+
+    node: ast.AST                   # FunctionDef / AsyncFunctionDef
+    module: str                     # dotted module name
+    file: str                       # relpath the findings cite
+    name: str
+    cls: Optional[str] = None       # enclosing class, if any
+    # True only for a DIRECT class-body method (reached via self./Class.
+    # paths, never by bare name); nested defs inside a method keep the
+    # cls tag but stay bare-name-resolvable closures
+    is_method: bool = False
+
+    @property
+    def qname(self) -> str:
+        base = f"{self.cls}.{self.name}" if self.cls else self.name
+        return f"{self.module}:{base}"
+
+    def params(self) -> List[str]:
+        a = self.node.args
+        return [p.arg for p in a.posonlyargs + a.args]
+
+    def body(self) -> Sequence[ast.stmt]:
+        return self.node.body
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    module: str
+    bases: List[str] = dataclasses.field(default_factory=list)
+    methods: Dict[str, FuncInfo] = dataclasses.field(default_factory=dict)
+    # self.<attr> = SomeClass(...) — attr -> (module_hint, class_name);
+    # module_hint "" means "resolve in the binding module's namespace"
+    attr_types: Dict[str, Tuple[str, str]] = dataclasses.field(
+        default_factory=dict)
+    # self.<attr> = threading.Lock()/RLock()/... (binding-type locks)
+    lock_attrs: Set[str] = dataclasses.field(default_factory=set)
+    # some method calls self.<attr>.verify(...) — the syntactic
+    # signature of holding an epoch-fenced lease (fencing lint keys
+    # on this; detected during indexing so no rule re-walks the class)
+    leased: bool = False
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    name: str                       # dotted ("flink_tpu.log.topic")
+    file: str                       # relpath
+    tree: ast.Module
+    # every def keyed by bare name, any nesting depth (the bare-name
+    # fallback the hostpool closure walk has always used)
+    functions: Dict[str, List[FuncInfo]] = dataclasses.field(
+        default_factory=dict)
+    classes: Dict[str, ClassInfo] = dataclasses.field(default_factory=dict)
+    # `import a.b.c as x` / `import a.b.c` -> {"x"/"a": "a.b.c"/"a"}
+    import_aliases: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # `from m import n as x` -> {"x": ("m", "n")}
+    from_imports: Dict[str, Tuple[str, str]] = dataclasses.field(
+        default_factory=dict)
+    # module-level NAME = "literal"
+    str_constants: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # module-level NAME = threading.Lock()/...
+    lock_names: Set[str] = dataclasses.field(default_factory=set)
+    # ast.walk(tree) flattened ONCE at index time — every full-tree
+    # rule scan iterates this list instead of re-walking (the lint
+    # pass runs ~10 rules per module; re-walking dominated its cost)
+    nodes: List[ast.AST] = dataclasses.field(default_factory=list)
+    # type-bucketed views of `nodes` (same order): most rules only
+    # inspect call sites / with statements, a small fraction of nodes
+    calls: List[ast.Call] = dataclasses.field(default_factory=list)
+    withs: List[ast.AST] = dataclasses.field(default_factory=list)
+
+
+def _call_ctor_name(value: ast.AST) -> str:
+    """The trailing constructor name of ``x = Name(...)`` /
+    ``x = mod.Name(...)`` bindings, else ''."""
+    if not isinstance(value, ast.Call):
+        return ""
+    fn = value.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+def module_name_for(relpath: str) -> str:
+    """Dotted module name for a repo-relative path (``a/b.py`` ->
+    ``a.b``; ``a/__init__.py`` -> ``a``)."""
+    mod = relpath.replace("\\", "/")
+    if mod.endswith(".py"):
+        mod = mod[:-3]
+    mod = mod.strip("/").replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def _index_module(name: str, file: str, tree: ast.Module) -> ModuleInfo:
+    mi = ModuleInfo(name=name, file=file, tree=tree,
+                    nodes=list(ast.walk(tree)))
+
+    def add_func(node: ast.AST, cls: Optional[str],
+                 is_method: bool = False) -> FuncInfo:
+        fi = FuncInfo(node=node, module=name, file=file,
+                      name=node.name, cls=cls, is_method=is_method)
+        mi.functions.setdefault(node.name, []).append(fi)
+        return fi
+
+    class_nodes = set()
+
+    def walk_class(cnode: ast.ClassDef) -> None:
+        ci = ClassInfo(name=cnode.name, module=name)
+        for b in cnode.bases:
+            if isinstance(b, ast.Name):
+                ci.bases.append(b.id)
+            elif isinstance(b, ast.Attribute):
+                ci.bases.append(b.attr)
+        for sub in cnode.body:
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ci.methods[sub.name] = add_func(sub, cnode.name,
+                                                is_method=True)
+                class_nodes.add(id(sub))
+                # one subtree walk per method: nested defs (kept
+                # bare-name-resolvable with the class tag, so closures
+                # can resolve self.*), self-attribute bindings, and the
+                # self.<attr>.verify(...) lease signature
+                for node in ast.walk(sub):
+                    if node is not sub and isinstance(
+                            node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        add_func(node, cnode.name)
+                        class_nodes.add(id(node))
+                    elif isinstance(node, ast.Assign):
+                        for t in node.targets:
+                            if (isinstance(t, ast.Attribute)
+                                    and isinstance(t.value, ast.Name)
+                                    and t.value.id == "self"):
+                                ctor = _call_ctor_name(node.value)
+                                if ctor in LOCK_CONSTRUCTORS:
+                                    ci.lock_attrs.add(t.attr)
+                                elif ctor and ctor[:1].isupper():
+                                    ci.attr_types[t.attr] = ("", ctor)
+                    elif (not ci.leased and isinstance(node, ast.Call)
+                          and isinstance(node.func, ast.Attribute)
+                          and node.func.attr == "verify"
+                          and isinstance(node.func.value, ast.Attribute)
+                          and isinstance(node.func.value.value, ast.Name)
+                          and node.func.value.value.id == "self"):
+                        ci.leased = True
+            else:
+                # defs hiding under any other class-body statement
+                # (nested classes, conditional blocks) are not
+                # module-level functions either
+                class_nodes.update(
+                    id(n) for n in ast.walk(sub)
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)))
+        mi.classes[cnode.name] = ci
+
+    # one bucketing pass: imports anywhere (top level or lazy, inside
+    # a function) feed alias resolution, calls/withs feed the rules;
+    # constants / module-level locks are top level only
+    for node in mi.nodes:
+        if isinstance(node, ast.Call):
+            mi.calls.append(node)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            mi.withs.append(node)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                mi.import_aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+                if a.asname:
+                    mi.import_aliases[a.asname] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                mi.from_imports[a.asname or a.name] = (node.module, a.name)
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            ctor = _call_ctor_name(node.value)
+            for t in node.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if (isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)):
+                    mi.str_constants[t.id] = node.value.value
+                elif ctor in LOCK_CONSTRUCTORS:
+                    mi.lock_names.add(t.id)
+
+    # defs: top-level, nested, and methods (methods via walk_class so
+    # they are tagged with their class)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            walk_class(node)
+    for node in mi.nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and id(node) not in class_nodes:
+            add_func(node, None)
+    return mi
+
+
+class CallGraph:
+    """The indexed module set plus call resolution."""
+
+    def __init__(self, modules: Dict[str, ModuleInfo]) -> None:
+        self.modules = modules                      # by dotted name
+        self.by_file = {m.file: m for m in modules.values()}
+        self._by_node: Dict[int, FuncInfo] = {}
+        for m in modules.values():
+            for fns in m.functions.values():
+                for fi in fns:
+                    self._by_node[id(fi.node)] = fi
+
+    # -- lookups ----------------------------------------------------------
+
+    def func_of_node(self, node: ast.AST) -> Optional[FuncInfo]:
+        return self._by_node.get(id(node))
+
+    def iter_functions(self) -> Iterator[FuncInfo]:
+        for m in self.modules.values():
+            for fns in m.functions.values():
+                yield from fns
+
+    def class_of(self, ctx: Optional[FuncInfo]) -> Optional[ClassInfo]:
+        if ctx is None or ctx.cls is None:
+            return None
+        mi = self.modules.get(ctx.module)
+        return mi.classes.get(ctx.cls) if mi else None
+
+    def _resolve_class(self, mi: ModuleInfo,
+                       name: str) -> Optional[ClassInfo]:
+        if name in mi.classes:
+            return mi.classes[name]
+        fi = mi.from_imports.get(name)
+        if fi and fi[0] in self.modules:
+            return self.modules[fi[0]].classes.get(fi[1])
+        return None
+
+    def _method(self, ci: Optional[ClassInfo], name: str,
+                depth: int = 0) -> List[FuncInfo]:
+        """Method lookup with project-resolvable base-class walk."""
+        if ci is None or depth > 4:
+            return []
+        if name in ci.methods:
+            return [ci.methods[name]]
+        mi = self.modules.get(ci.module)
+        if mi is None:
+            return []
+        for b in ci.bases:
+            hit = self._method(self._resolve_class(mi, b), name, depth + 1)
+            if hit:
+                return hit
+        return []
+
+    # -- call resolution --------------------------------------------------
+
+    def _mi(self, ctx: Optional[FuncInfo],
+            module: Optional[ModuleInfo] = None) -> Optional[ModuleInfo]:
+        if ctx is not None:
+            return self.modules.get(ctx.module)
+        if module is not None:
+            return module
+        if len(self.modules) == 1:
+            return next(iter(self.modules.values()))
+        return None
+
+    def resolve(self, call: ast.Call, ctx: Optional[FuncInfo],
+                module: Optional[ModuleInfo] = None) -> List[FuncInfo]:
+        """Function definitions this call expression can reach (empty
+        when the callee is external / dynamic)."""
+        return self.resolve_name(call.func, ctx, module)
+
+    def resolve_name(self, fn: ast.AST, ctx: Optional[FuncInfo],
+                     module: Optional[ModuleInfo] = None) -> List[FuncInfo]:
+        mi = self._mi(ctx, module)
+        if isinstance(fn, ast.Name):
+            return self._resolve_bare(mi, fn.id)
+        if isinstance(fn, ast.Attribute):
+            base = fn.value
+            # self.method(...)
+            if isinstance(base, ast.Name) and base.id == "self":
+                return self._method(self.class_of(ctx), fn.attr)
+            # self.attr.method(...) via the recorded self-type binding
+            if (isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self"):
+                ci = self.class_of(ctx)
+                if ci and base.attr in ci.attr_types:
+                    _, cls_name = ci.attr_types[base.attr]
+                    owner = self._resolve_class(
+                        self.modules.get(ci.module), cls_name) \
+                        if ci.module in self.modules else None
+                    return self._method(owner, fn.attr)
+                return []
+            if isinstance(base, ast.Name) and mi is not None:
+                # ClassName.method(...) (staticmethod-style)
+                ci = self._resolve_class(mi, base.id)
+                if ci is not None:
+                    return self._method(ci, fn.attr)
+                # module-alias call: bus.commit(...) / np.asarray(...)
+                target = mi.import_aliases.get(base.id)
+                if target is None and base.id in mi.from_imports:
+                    fmod, orig = mi.from_imports[base.id]
+                    target = f"{fmod}.{orig}"
+                if target and target in self.modules:
+                    tm = self.modules[target]
+                    return [f for f in tm.functions.get(fn.attr, ())
+                            if not f.is_method]
+        return []
+
+    def _resolve_bare(self, mi: Optional[ModuleInfo],
+                      name: str) -> List[FuncInfo]:
+        if mi is None:
+            return []
+        if name in mi.functions:
+            return [f for f in mi.functions[name] if not f.is_method]
+        fi = mi.from_imports.get(name)
+        if fi and fi[0] in self.modules:
+            return [f for f in self.modules[fi[0]].functions.get(fi[1], ())
+                    if not f.is_method]
+        return []
+
+    # -- binding-type lock recognition ------------------------------------
+
+    def is_lock_expr(self, expr: ast.AST, ctx: Optional[FuncInfo],
+                     local_locks: Optional[Set[str]] = None,
+                     module: Optional[ModuleInfo] = None) -> bool:
+        """Is this with-item context expression a tracked lock binding
+        (module-level name, ``self.<attr>`` bound to a Lock/RLock/...,
+        or a function-local binding recorded in ``local_locks``)?"""
+        if isinstance(expr, ast.Name):
+            if local_locks and expr.id in local_locks:
+                return True
+            mi = self._mi(ctx, module)
+            return bool(mi and expr.id in mi.lock_names)
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            ci = self.class_of(ctx)
+            return bool(ci and expr.attr in ci.lock_attrs)
+        return False
+
+    def lock_id(self, expr: ast.AST, ctx: Optional[FuncInfo],
+                module: Optional[ModuleInfo] = None) -> Optional[str]:
+        """Stable identity for a tracked lock expression (the node of
+        the lock-order graph), or None when the expression is not an
+        unambiguous tracked binding."""
+        if isinstance(expr, ast.Name):
+            mi = self._mi(ctx, module)
+            if mi and expr.id in mi.lock_names:
+                return f"{mi.name}:{expr.id}"
+            return None
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            ci = self.class_of(ctx)
+            if ci and expr.attr in ci.lock_attrs:
+                return f"{ci.module}:{ci.name}.{expr.attr}"
+        return None
+
+
+def build_graph(trees: Dict[str, ast.Module]) -> CallGraph:
+    """Index ``{relpath: parsed module}`` into one CallGraph."""
+    modules: Dict[str, ModuleInfo] = {}
+    for relpath, tree in trees.items():
+        name = module_name_for(relpath)
+        modules[name] = _index_module(name, relpath, tree)
+    return CallGraph(modules)

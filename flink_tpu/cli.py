@@ -15,7 +15,7 @@ script. Here::
         (--session H:P | --ha-dir D) [...]
     python -m flink_tpu analyze [job.conf] [--entry pkg.mod:build] \
         [--json] [--explain] [--fail-on error|warn|off]
-    python -m flink_tpu lint [paths ...] [--json]
+    python -m flink_tpu lint [paths ...] [--json] [--plane <name>]
     python -m flink_tpu log TOPIC_DIR [--compact] [--retain] \
         [--conf key=value ...]
     python -m flink_tpu fsck PATH [--repair] [--json]
@@ -417,18 +417,27 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     lint = sub.add_parser(
         "lint",
-        help="repo AST lints: tracer leaks in jit kernels, fault-point "
-             "/ config-key / metric-name drift, unlocked shared writes "
-             "in host-pool task closures (pure-stdlib ast pass; zero "
-             "findings on the shipped tree is a tier-1 gate)",
+        help="repo AST lints over the project call graph: tracer taint "
+             "in jit kernels and their helpers, fault-point / "
+             "config-key / metric-name drift, unlocked shared writes "
+             "in host-pool task closures, durability-seam bypasses, "
+             "lock-order cycles, unverified fenced publications "
+             "(pure-stdlib ast pass; zero findings on the shipped "
+             "tree is a tier-1 gate)",
         epilog="exit codes: 0 = clean, 1 = findings, 2 = usage/path "
-               "error. --json prints one Finding.to_dict object per "
-               "line (same shape as `analyze --json`).")
+               "error (including an unknown --plane). --json prints "
+               "one Finding.to_dict object per line (same shape as "
+               "`analyze --json`).")
     lint.add_argument("paths", nargs="*", metavar="PATH",
                       help="files or directories (default: the shipped "
                            "flink_tpu tree + tools + bench scripts)")
     lint.add_argument("--json", action="store_true",
                       help="one JSON object per finding")
+    lint.add_argument("--plane", default=None, metavar="NAME",
+                      help="only report findings of one lint plane "
+                           "(tracer, registry, config, metrics, "
+                           "concurrency, durability, locking, "
+                           "fencing); unknown names exit 2")
 
     sess = sub.add_parser(
         "session",
@@ -608,13 +617,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _session(args)
 
     if args.cmd == "lint":
-        from flink_tpu.analysis.pylints import lint_paths
+        from flink_tpu.analysis.pylints import LINT_PLANES, lint_paths
 
+        if args.plane is not None \
+                and args.plane not in set(LINT_PLANES.values()):
+            # an unknown plane silently reporting NOTHING would leave
+            # a CI gate green while checking nothing — usage error
+            print(f"error: unknown lint plane {args.plane!r} "
+                  f"(known: {', '.join(sorted(set(LINT_PLANES.values())))})",
+                  file=sys.stderr)
+            return 2
         try:
             findings = lint_paths(args.paths or None)
         except ValueError as e:  # typo'd path: fail loudly, not green
             print(f"error: {e}", file=sys.stderr)
             return 2
+        if args.plane is not None:
+            findings = [f for f in findings
+                        if LINT_PLANES.get(f.rule) == args.plane]
         _print_findings(findings, as_json=args.json)
         return 1 if findings else 0
 

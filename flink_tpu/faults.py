@@ -283,6 +283,14 @@ KNOWN_FAULT_POINTS = frozenset((
     "log.group.fence",
 ))
 
+# Points intentionally registered BEFORE their seam is instrumented
+# (registry-first workflow). The reverse-drift lint
+# (analysis/pylints.py FAULT_POINT_UNFIRED) warns on any
+# KNOWN_FAULT_POINTS entry with no ``faults.fire`` site in the linted
+# tree unless it is listed here; keep this empty unless a point is
+# genuinely staged ahead of its instrumentation.
+UNFIRED_ALLOWLIST = frozenset(())
+
 # process-global fault/recovery metrics — chaos tests assert every
 # injection and every recovery attempt is visible here and on the tracer
 registry = MetricRegistry()

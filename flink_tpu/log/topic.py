@@ -1003,6 +1003,11 @@ class TopicAppender:
         cpath = self._marker_path("commit", cid)
         if self._fs.exists(cpath):
             return  # already committed — nothing to rebuild
+        # fencing gate: restore republishes the pre marker below — a
+        # DEPOSED leaseholder's recovery must raise here, not re-stage
+        # rows a successor already owns (same discipline as stage()/
+        # commit(); a no-op for lease-less appenders)
+        self._verify_lease()
         for key, data in payload.get("segments", {}).items():
             p_s, _, name = key.partition("/")
             dst = os.path.join(_partition_dir(self.path, int(p_s)), name)

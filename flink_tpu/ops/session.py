@@ -151,8 +151,10 @@ class SessionOperator:
         ts = np.asarray(ts, np.int64)
         valid = np.ones(len(ts), bool) if valid is None else np.asarray(valid, bool)
         if self._pool is None:
-            self.late_records += self._process_shard(
+            late, refire = self._process_shard(
                 self._shards[0], keys, ts, data, valid)
+            self.late_records += late
+            self._has_refire = self._has_refire or refire
             return
         # partition by key shard; per-key work is identical to serial
         # (no session logic crosses keys), so per-shard passes compose
@@ -168,13 +170,20 @@ class SessionOperator:
             tasks.append(lambda st=self._shards[w], m=m: self._process_shard(
                 st, keys[m], ts[m],
                 {k: v[m] for k, v in data.items()}, valid[m]))
-        self.late_records += sum(self._pool.run_tasks(tasks))
+        results = self._pool.run_tasks(tasks)
+        self.late_records += sum(late for late, _ in results)
+        self._has_refire = self._has_refire or any(
+            refire for _, refire in results)
 
     def _process_shard(self, st: _SpanStore, keys, ts,
-                       data: Dict[str, np.ndarray], valid) -> int:
+                       data: Dict[str, np.ndarray], valid
+                       ) -> Tuple[int, bool]:
         """Full ingest pass for one shard's records against its store;
-        returns the shard's beyond-lateness drop count. At
-        host.parallelism=1 this IS the whole batch — the serial path."""
+        returns (beyond-lateness drop count, refire-pending flag). At
+        host.parallelism=1 this IS the whole batch — the serial path.
+        The flag rides the return value rather than being written to
+        ``self`` so pool-shard passes never touch shared state; the
+        caller folds the per-shard flags on its own thread."""
         late_count = 0
         # drop beyond-lateness records (side output accounting): a record
         # is late iff its singleton session is dead AND it cannot merge
@@ -225,7 +234,7 @@ class SessionOperator:
             late_count = int(late.sum())
             valid = valid & ~late
         if not valid.any():
-            return late_count
+            return late_count, False
         keys = keys[valid]
         ts = ts[valid]
         data = {k: np.asarray(v)[valid] for k, v in data.items()}
@@ -264,11 +273,11 @@ class SessionOperator:
         seg_min = (np.minimum.reduceat(mn_l, seg_starts, axis=0)
                    if mn_l.shape[1] else np.zeros((G, 0), np.float32))
         seg_ends = np.append(seg_starts[1:], len(sk))
-        self._merge_segments(
+        refire = self._merge_segments(
             st, sk[seg_starts], st_[seg_starts], st_[seg_ends - 1],
             seg_sum, seg_max, seg_min,
             (seg_ends - seg_starts).astype(np.int64))
-        return late_count
+        return late_count, refire
 
     def _host_lift(self, data, valid) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Run the aggregate's lift on the host CPU backend (session lane
@@ -285,7 +294,7 @@ class SessionOperator:
             return np.asarray(s), np.asarray(mx), np.asarray(mn)
 
     def _merge_segments(self, st: _SpanStore, seg_key, seg_tmin, seg_tmax,
-                        seg_sum, seg_max, seg_min, seg_count) -> None:
+                        seg_sum, seg_max, seg_min, seg_count) -> bool:
         """Merge batch segments into shard registry ``st`` — the
         MergingWindowSet role, fully vectorized: pull every touched
         key's spans, run one interval-union scan over (touched ∪ new)
@@ -383,11 +392,9 @@ class SessionOperator:
         m_fired = np.where(passthrough, fired_any, False)
         m_refire = np.where(passthrough, refire_any,
                             fired_any | refire_any | complete_now)
-        if bool(m_refire.any()):
-            self._has_refire = True
-
         st.insert_sorted((m_key, m_start, m_last, m_sum, m_max, m_min,
                           m_count, m_fired, m_refire))
+        return bool(m_refire.any())
 
     # -- time ------------------------------------------------------------
     def advance_watermark(self, wm: int):

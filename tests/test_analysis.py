@@ -640,6 +640,25 @@ class TestDogfoodGate:
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "no findings" in proc.stdout
 
+    def test_full_pass_fits_the_wallclock_budget(self):
+        """PR 19 perf gate: the WHOLE default lint pass — call-graph
+        index plus every interprocedural plane (taint, pool writes,
+        lock order, fences, unfired registry) — stays under 3 s, so
+        the dogfood gate remains cheap enough to run on every commit.
+        The call-graph architecture this budget bought: one flattened
+        ast.walk per module at index time, type-bucketed call/with
+        views, and per-module prefilters on the lock-order walk."""
+        import time
+
+        from flink_tpu.analysis.pylints import lint_paths
+
+        t0 = time.perf_counter()
+        lint_paths()
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 3.0, (
+            f"full lint pass took {elapsed:.2f}s (budget 3.0s) — the "
+            "interprocedural planes must stay commit-hook cheap")
+
     def test_rules_md_is_current(self):
         """RULES.md staleness gate: the committed catalog doc must be
         byte-identical to what the registrations render — a new rule

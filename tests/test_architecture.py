@@ -8,7 +8,7 @@ user surface that the runtime may load, never the reverse except
 through declared seams."""
 import ast
 import os
-from typing import Dict, List, Set
+from typing import Dict, Set
 
 import pytest
 
@@ -186,64 +186,25 @@ class TestDurableWriteSeam:
     """PR 14's crash-consistency contract: every DURABLE tier routes
     its writes through the FileSystem seam (flink_tpu/fs.py) — write
     handles with the sync discipline, fs.fsync barriers, fs.rename,
-    write_atomic. A raw ``open(..., 'w')`` / ``os.fsync`` /
-    ``os.replace`` in a durable module bypasses CrashFS recording and
-    the ENOSPC policy, silently re-opening the power-cut hole the
-    crash explorer (tests/test_crash_consistency.py) verifies closed.
+    write_atomic.
 
-    Allowed residue: ``os.open(O_CREAT|O_EXCL)`` + ``os.fdopen`` —
-    the local-fs LOCK primitives (lease claims, maintenance locks),
-    which the analyzer's STORAGE_LOCAL_LOCKS_ON_REMOTE rule documents
-    as local-filesystem-only."""
-
-    # the tiers whose on-disk state must survive a power cut
-    DURABLE_MODULES = (
-        "log/topic.py", "log/bus.py", "log/connectors.py",
-        "checkpoint/storage.py", "checkpoint/coordinator.py",
-        "api/sinks.py", "connectors.py",
-        "runtime/ha.py", "runtime/blob.py", "runtime/session.py",
-        "fsck.py", "state/lsm.py",
-    )
-
-    @staticmethod
-    def _violations(path: str) -> List[str]:
-        with open(path, "r", encoding="utf-8") as f:
-            tree = ast.parse(f.read())
-        bad: List[str] = []
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            fn = node.func
-            # builtin open(...) in a write/append mode
-            if isinstance(fn, ast.Name) and fn.id == "open":
-                mode = ""
-                if len(node.args) >= 2 and isinstance(
-                        node.args[1], ast.Constant):
-                    mode = str(node.args[1].value)
-                for kw in node.keywords:
-                    if kw.arg == "mode" and isinstance(kw.value,
-                                                      ast.Constant):
-                        mode = str(kw.value.value)
-                if "w" in mode or "a" in mode or "+" in mode:
-                    bad.append(f"line {node.lineno}: open(..., {mode!r})")
-            # os.fsync / os.replace / os.rename bypassing the seam
-            if (isinstance(fn, ast.Attribute)
-                    and isinstance(fn.value, ast.Name)
-                    and fn.value.id == "os"
-                    and fn.attr in ("fsync", "replace")):
-                bad.append(f"line {node.lineno}: os.{fn.attr}(...)")
-        return bad
+    PR 19 promoted the scan itself into the lint catalog as
+    DURABILITY_SEAM_BYPASS (flink_tpu/analysis/pylints.py): the
+    construct set, the DURABLE_MODULES roster, and the allowed residue
+    (os.open(O_CREAT|O_EXCL)+os.fdopen lock primitives, os.rename of
+    lock/lease -> grave files) now live in ONE place, and the rule's
+    own fixtures ride in tests/test_pylints.py. This gate is the thin
+    architecture-level assertion: zero findings over the durable
+    roster as shipped."""
 
     def test_no_raw_durable_writes_outside_the_seam(self):
-        findings = {}
-        for rel in self.DURABLE_MODULES:
-            path = os.path.join(PKG, rel)
-            if not os.path.exists(path):
-                continue
-            bad = self._violations(path)
-            if bad:
-                findings[rel] = bad
-        assert not findings, (
+        from flink_tpu.analysis.pylints import DURABLE_MODULES, lint_paths
+
+        roster = sorted(DURABLE_MODULES)
+        assert len(roster) >= 12  # the PR-14 durable tiers, all of them
+        findings = [f for f in lint_paths(roster)
+                    if f.rule == "DURABILITY_SEAM_BYPASS"]
+        assert findings == [], (
             "raw durable-write call sites outside the FileSystem seam "
             f"(route through fs.open_write(sync=)/fs.fsync/"
-            f"fs.write_atomic): {findings}")
+            f"fs.write_atomic): {[f.render() for f in findings]}")

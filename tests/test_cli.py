@@ -53,6 +53,32 @@ class TestExitCodeContract:
         assert cli_main(["lint", str(tmp_path / "absent.py")]) == 2
         capsys.readouterr()
 
+    def test_lint_plane_filter_keeps_the_exit_contract(self, tmp_path,
+                                                       capsys):
+        """PR 19: `lint --plane NAME` keeps 0/1/2 — 0 when the named
+        plane is clean (even if OTHER planes have findings), 1 when it
+        has findings, 2 for an unknown plane name."""
+        dirty = tmp_path / "dirty.py"
+        # one tracer-plane finding + one metrics-plane finding
+        dirty.write_text(
+            "import jax\n\n@jax.jit\ndef k(x):\n    return float(x)\n\n\n"
+            "def reg(group):\n    group.counter('camelCase')\n")
+        assert cli_main(["lint", str(dirty)]) == 1
+        assert cli_main(["lint", str(dirty), "--plane", "tracer"]) == 1
+        assert cli_main(["lint", str(dirty), "--plane", "metrics"]) == 1
+        # the locking plane is clean in this file: filtered exit is 0
+        assert cli_main(["lint", str(dirty), "--plane", "locking"]) == 0
+        capsys.readouterr()
+        # unknown plane = usage error, naming the known planes
+        assert cli_main(["lint", str(dirty), "--plane", "bogus"]) == 2
+        err = capsys.readouterr().err
+        assert "unknown lint plane" in err and "locking" in err
+        # --json emits only the filtered plane's findings
+        cli_main(["lint", str(dirty), "--plane", "tracer", "--json"])
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert [json.loads(x)["rule"] for x in lines] == [
+            "TRACER_HOST_CALL"]
+
     def test_both_clis_share_the_finding_json_shape(self, tmp_path,
                                                     capsys):
         conf = tmp_path / "job.conf"
