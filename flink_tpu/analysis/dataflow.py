@@ -9,7 +9,8 @@ second plane: ONE topological walk (`propagate`) that interprets the
 plan edge-by-edge over three lattices, with the registered dataflow
 rules (FIELD_NOT_IN_SCHEMA, SCHEMA_MISMATCH_UNION,
 UNBOUNDED_STATE_GROWTH, STALLED_WATERMARK_LEG, NON_TXN_SINK_IN_CHAIN,
-STATE_BYTES_EXCEEDED) reading the propagated facts — the
+STATE_BYTES_EXCEEDED, CHANGELOG_SINK_MISMATCH) reading the propagated
+facts — the
 graph-compilation-time validation role of the reference's
 Transformation → StreamGraph translation (PAPER §2 layer L6), extended
 with the state/time facts the multi-tenant admission path needs.
@@ -116,6 +117,10 @@ class NodeFacts:
     wm_note: str = ""
     log_tainted: bool = False      # downstream of a LogSource
     bounded_input: bool = True     # every upstream source is bounded
+    # changelog axis: output rows are op-typed (records.OP_FIELD) — set
+    # at retract-mode operators, carried through pass-through nodes,
+    # reset at re-aggregating operators (their fired rows are fresh)
+    changelog: bool = False
 
 
 @dataclasses.dataclass
@@ -495,6 +500,21 @@ def _propagate(plan, config) -> PlanFacts:
         else:  # partition, sink: pass-through
             nf.schema = ups[0].schema if ups else None
             nf.schema_note = ups[0].schema_note if ups else ""
+
+        # changelog axis: retract-mode ops MINT op-typed output;
+        # pass-through nodes carry it; every other stateful operator
+        # emits fresh fired rows (the axis resets there — a window agg
+        # over changelog input FOLDS the retractions, it does not
+        # forward them)
+        wt = getattr(node, "window_transform", None)
+        if (node.kind in ("global_agg", "session")
+                and getattr(wt, "retract", False)):
+            nf.changelog = True
+        elif node.kind in ("chain", "partition", "union", "sink"):
+            nf.changelog = any(u.changelog for u in ups)
+            if (nf.changelog and node.kind == "chain"
+                    and nf.schema is not None and "__op__" not in nf.schema):
+                nf.changelog = False  # a map projected the op column away
         facts[nid] = nf
 
     return PlanFacts(nodes=facts, upstream=upstream, findings=out)
@@ -610,6 +630,33 @@ def non_txn_sink_in_chain(plan, config) -> Iterable[Finding]:
                 node=nf.node_id, node_name=nf.name)
 
 
+@plan_rule("CHANGELOG_SINK_MISMATCH", "error", plane="dataflow",
+           fix="use a changelog-capable sink (RetractSink / UpsertSink)")
+def changelog_sink_mismatch(plan, config) -> Iterable[Finding]:
+    """A retract-producing operator (retract-mode GROUP BY / session
+    aggregation) feeds an append-only sink: the sink appends -U/+U
+    pairs as if they were independent inserts, so every key update
+    lands TWICE and the materialized result silently double-counts —
+    the op-typed rows only mean something to a sink that folds them
+    (``Sink.changelog_capable``)."""
+    facts = propagate(plan, config)
+    for nf in facts.nodes.values():
+        node = plan.nodes[nf.node_id]
+        if node.kind != "sink" or node.sink is None or not nf.changelog:
+            continue
+        if not getattr(node.sink, "changelog_capable", False):
+            yield _f(
+                f"sink {nf.name!r} ({type(node.sink).__name__}) receives "
+                "an op-typed changelog stream (a retract-mode aggregation "
+                "is upstream) but is append-only — every -U/+U update "
+                "pair is appended as two inserts, silently "
+                "double-counting each key update",
+                fix="materialize through a changelog-capable sink "
+                    "(RetractSink, UpsertSink) or drop retract mode if "
+                    "append semantics are intended",
+                node=nf.node_id, node_name=nf.name)
+
+
 @plan_rule("STATE_BYTES_EXCEEDED", "warn", plane="dataflow",
            fix="shrink the window/lateness geometry or raise the budget")
 def state_bytes_exceeded(plan, config) -> Iterable[Finding]:
@@ -663,6 +710,8 @@ def explain_plan(plan, config) -> str:
         if nf.state_bytes_per_key is not None:
             state += f" ~{nf.state_bytes_per_key} B/key"
         wm = nf.wm + (f" ({nf.wm_note})" if nf.wm_note else "")
+        if nf.changelog:
+            wm += " | changelog (op-typed rows)"
         lines.append(f"node {nid} {nf.kind} {nf.name!r}:")
         lines.append(f"  schema    {_fmt_schema(nf.schema, nf.schema_note)}")
         lines.append(f"  watermark {wm}")

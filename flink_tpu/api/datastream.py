@@ -212,21 +212,30 @@ class KeyedStream(DataStream):
         for the documented batching semantics."""
         return CountWindowedStream(self, size, purge=True)
 
-    def running_aggregate(self, agg,
-                          name: str = "running_agg") -> "DataStream":
+    def running_aggregate(self, agg, name: str = "running_agg",
+                          retract: bool = False) -> "DataStream":
         """Unwindowed keyed running aggregation emitting an UPSERT
         stream: each microbatch emits updated (key, aggregates) rows
         for every key it touched, each row replacing the previous one
         for its key (ref: table-runtime GroupAggFunction — the
         retract/changelog model degenerated to upserts for insert-only
         input; see ops/global_agg.py). Materialize latest-by-key with
-        ``UpsertSink``."""
+        ``UpsertSink``.
+
+        ``retract=True`` emits the full CHANGELOG instead: updates
+        become -U (stale row out) / +U (replacement in) pairs, first
+        results are +I, op-typed in the ``__op__`` int8 column
+        (records.OP_FIELD). Downstream consumers must fold retractions
+        — ``RetractSink`` materializes exactly-once, and the
+        ``changelog_*`` lanes of ops/aggregates.py subtract -U rows in
+        a downstream window aggregation."""
         from flink_tpu.graph.transformations import (
             GlobalAggregateTransformation)
 
         kt = self.transform
         t = GlobalAggregateTransformation(
-            name, (kt,), aggregate=agg, key_field=kt.key_field)
+            name, (kt,), aggregate=agg, key_field=kt.key_field,
+            retract=retract)
         self.env._register(t)
         return DataStream(self.env, t)
 
@@ -527,13 +536,19 @@ class WindowedAggregateStream(DataStream):
 
 
 class SessionWindowedStream(WindowedStream):
-    def aggregate(self, agg: LaneAggregate, name: str = "session_agg") -> DataStream:
+    def aggregate(self, agg: LaneAggregate, name: str = "session_agg",
+                  retract: bool = False) -> DataStream:
+        """``retract=True``: session-merge refires op-type their rows —
+        a merge consuming an already-fired span emits -U for the stale
+        (key, window) row before the merged session fires +I/+U (see
+        ops/session.py retract mode)."""
         self._check_trigger()
         kt = self.keyed.transform
         assert isinstance(kt, KeyByTransformation)
         t = SessionAggregateTransformation(
             name, (kt,), gap_ms=self.assigner.gap, aggregate=agg,
-            allowed_lateness_ms=self._lateness, key_field=kt.key_field)
+            allowed_lateness_ms=self._lateness, key_field=kt.key_field,
+            retract=retract)
         self.keyed.env._register(t)
         return DataStream(self.keyed.env, t)
 

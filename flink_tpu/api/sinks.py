@@ -27,6 +27,13 @@ def rows_of(batch: Dict[str, np.ndarray]) -> List[Dict[str, Any]]:
 
 
 class Sink:
+    # Whether this sink understands op-typed changelog rows
+    # (records.OP_FIELD): folding -U/-D retractions instead of appending
+    # them as if they were inserts. Append-only sinks fed a retract
+    # stream silently double-count — the analyzer rule
+    # CHANGELOG_SINK_MISMATCH keys on this attribute.
+    changelog_capable = False
+
     def write(self, batch: Dict[str, np.ndarray]) -> None:
         raise NotImplementedError
 
@@ -243,9 +250,12 @@ class PrintSink(Sink):
 
 @dataclasses.dataclass
 class FnSink(Sink):
-    """Adapter for a plain callable(batch_dict)."""
+    """Adapter for a plain callable(batch_dict). The callable receives
+    raw batches — op columns included — so it is trusted to handle
+    changelog streams (it sees records.OP_FIELD and can fold)."""
 
     fn: Callable[[Dict[str, np.ndarray]], None]
+    changelog_capable = True
 
     def write(self, batch: Dict[str, np.ndarray]) -> None:
         self.fn(batch)
@@ -254,21 +264,109 @@ class FnSink(Sink):
 @dataclasses.dataclass
 class UpsertSink(Sink):
     """Materialize an UPSERT stream as latest-row-by-key (ref: the
-    upsert-kafka/table sink contract for changelog streams without
-    DELETEs — each arriving row replaces the previous row with the
-    same key tuple). ``view()`` returns the current table."""
+    upsert-kafka/table sink contract — each arriving row replaces the
+    previous row with the same key tuple). Op-typed changelog rows
+    (records.OP_FIELD) fold: +I/+U replace the key's row, -U/-D delete
+    it (deleting on -U is safe AND necessary: in a full changelog the
+    superseding +U follows in order and re-inserts, while after a
+    HAVING-style filter a surviving -U with no +U partner IS the key
+    leaving the view). ``view()`` returns the current table."""
 
     key_fields: Tuple[str, ...] = ("key",)
     state: Dict[Any, Dict[str, Any]] = dataclasses.field(
         default_factory=dict)
+    changelog_capable = True
 
     def write(self, batch: Dict[str, np.ndarray]) -> None:
+        from flink_tpu.records import OP_DELETE, OP_FIELD, OP_UPDATE_BEFORE
+
+        has_op = OP_FIELD in batch
         for row in rows_of(batch):
+            op = int(row.pop(OP_FIELD)) if has_op else None
             k = tuple(row[f] for f in self.key_fields)
-            self.state[k] = row
+            if op in (OP_UPDATE_BEFORE, OP_DELETE):
+                self.state.pop(k, None)
+            else:
+                self.state[k] = row
 
     def view(self) -> List[Dict[str, Any]]:
         return list(self.state.values())
+
+
+@dataclasses.dataclass
+class RetractSink(Sink):
+    """Exactly-once changelog materialization: op-typed rows fold into a
+    keyed table, and the table advances only when an epoch's checkpoint
+    completes (ref: the table-runtime retract sink contract riding the
+    TwoPhaseCommitSinkFunction protocol). -U/-D remove the key's row;
+    +I/+U (re)place it; rows without an op column are upserts. Arrival
+    order within an epoch is preserved, so a -U/+U pair nets to the
+    update. Uncommitted epochs are discarded on restore — after
+    recovery the table equals exactly what the restored checkpoint
+    proved, then re-evolves from replayed input."""
+
+    key_fields: Tuple[str, ...] = ("key",)
+    table: Dict[Any, Dict[str, Any]] = dataclasses.field(
+        default_factory=dict)
+    changelog_capable = True
+
+    def __post_init__(self) -> None:
+        self._pending: List[Dict[str, Any]] = []
+        self._staged: Dict[int, List[Dict[str, Any]]] = {}
+        self._last_committed = 0
+
+    def write(self, batch: Dict[str, np.ndarray]) -> None:
+        self._pending.extend(rows_of(batch))
+
+    def _apply(self, rows: List[Dict[str, Any]]) -> None:
+        from flink_tpu.records import OP_DELETE, OP_FIELD, OP_UPDATE_BEFORE
+
+        for row in rows:
+            row = dict(row)
+            op = int(row.pop(OP_FIELD)) if OP_FIELD in row else None
+            k = tuple(row[f] for f in self.key_fields)
+            if op in (OP_UPDATE_BEFORE, OP_DELETE):
+                self.table.pop(k, None)
+            else:
+                self.table[k] = row
+
+    def view(self) -> List[Dict[str, Any]]:
+        """The committed table, ordered by key tuple (deterministic
+        across runs/restores — insertion order is an epoch artifact)."""
+        return [self.table[k] for k in sorted(self.table)]
+
+    # -- exactly-once protocol (TransactionalCollectSink's shape) ---------
+    def prepare_commit(self, checkpoint_id: int) -> None:
+        self._staged[checkpoint_id] = self._pending
+        self._pending = []
+
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        for cid in sorted([c for c in self._staged if c <= checkpoint_id]):
+            self._apply(self._staged.pop(cid))
+            self._last_committed = max(self._last_committed, cid)
+
+    def snapshot_staged(self) -> Any:
+        # called AFTER prepare_commit(cid): the in-flight checkpoint's
+        # own epoch rides inside its payload
+        return {cid: list(rows) for cid, rows in self._staged.items()}
+
+    def restore_staged(self, staged: Any, checkpoint_id: int) -> None:
+        self._pending = []
+        self._staged = {}
+        for cid in sorted(staged):
+            if cid <= checkpoint_id:
+                # checkpoint N completing proves epoch N folds in;
+                # the re-commit guard keeps the replay idempotent when
+                # the same instance survives the restore
+                if cid > self._last_committed:
+                    self._apply(staged[cid])
+                    self._last_committed = cid
+            # epochs staged after the restored checkpoint replay from
+            # source positions — drop them
+
+    def abort_uncommitted(self) -> None:
+        self._staged.clear()
+        self._pending = []
 
 
 @dataclasses.dataclass

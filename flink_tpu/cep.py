@@ -21,13 +21,33 @@ reference's full NFA):
   skipped), with vectorized ``where`` predicates per stage;
 - ``within(ms)``: a partial older than the window resets (the event
   that broke it may immediately start a new partial);
-- after-match skipping: SKIP_PAST_LAST_EVENT (default — each event
-  belongs to at most one match, matches never overlap) or
-  ``after_match("NO_SKIP")`` — overlapping matches enumerated from a
-  BOUNDED per-key partial buffer (``max_partials`` columns, loud
-  overflow; linear patterns only — quantified patterns with NO_SKIP
-  would need the reference's exponential SharedBuffer branch
-  enumeration and are refused at build);
+- negation (ref: Pattern.notNext / Pattern.notFollowedBy):
+  ``not_next(name)`` — the key's immediately-next event must NOT match
+  (a match kills the partial; any other event satisfies the constraint
+  and is immediately re-tested against the following stage);
+  ``not_followed_by(name)`` — NO matching event may occur strictly
+  between the surrounding stages (an event matching both the forbidden
+  predicate and the following stage counts as the following stage — no
+  forbidden event occurred strictly between). A TRAILING
+  not_followed_by turns ``within(ms)`` into an absence window: the
+  match completes when the watermark (or a later in-stream event of
+  the same key) passes ``match_start + within`` with no forbidden
+  event seen; ``match_end`` is that deadline and the negated stage's
+  ``<name>_ts`` column reads -1. Negated stages cannot be quantified,
+  cannot begin a pattern, and run on the default single-partial engine
+  only (the multi-partial after-match modes below refuse them);
+- after-match skipping (ref: cep/nfa/aftermatch/AfterMatchSkipStrategy):
+  SKIP_PAST_LAST_EVENT (default — each event belongs to at most one
+  match, matches never overlap); ``after_match("NO_SKIP")`` —
+  overlapping matches enumerated from a BOUNDED per-key partial buffer
+  (``max_partials`` columns, loud overflow; linear patterns only —
+  quantified patterns with NO_SKIP would need the reference's
+  exponential SharedBuffer branch enumeration and are refused at
+  build); ``after_match("SKIP_TO_FIRST", "b")`` /
+  ``after_match("SKIP_TO_LAST", "b")`` — run on the same multi-partial
+  engine, but each completed match prunes every partial (and any
+  not-yet-emitted same-event completion) whose start precedes the
+  first/last event the match mapped to stage ``b``;
 - default mode keeps one active partial per key (greedy earliest): no
   simultaneous alternative partials. A failed strict transition
   re-tests the breaking event against stage 0.
@@ -54,6 +74,7 @@ class _Stage:
     times: int = 1        # expand into this many copies (times(n))
     loop: bool = False    # oneOrMore: greedy unbounded repetition
     optional: bool = False  # may be skipped when the NEXT stage matches
+    negated: bool = False   # not_next / not_followed_by: a match KILLS
 
 
 class Pattern:
@@ -61,10 +82,16 @@ class Pattern:
 
     def __init__(self, stages: Tuple[_Stage, ...],
                  within_ms: Optional[int] = None,
-                 after_match_mode: str = "SKIP_PAST_LAST_EVENT"):
+                 after_match_mode: str = "SKIP_PAST_LAST_EVENT",
+                 after_match_stage: Optional[str] = None):
         self._stages = stages
         self.within_ms = within_ms
         self.after_match_mode = after_match_mode
+        self.after_match_stage = after_match_stage
+
+    def _with(self, stages: Tuple[_Stage, ...]) -> "Pattern":
+        return Pattern(stages, self.within_ms, self.after_match_mode,
+                       self.after_match_stage)
 
     @classmethod
     def begin(cls, name: str) -> "Pattern":
@@ -72,39 +99,82 @@ class Pattern:
 
     def where(self, pred: Callable[[Dict[str, np.ndarray]], np.ndarray]) -> "Pattern":
         """Vectorized predicate over the batch's field arrays → (B,)
-        bool. Applies to the most recent stage."""
+        bool. Applies to the most recent stage (for a negated stage it
+        is the FORBIDDEN shape)."""
         last = self._stages[-1]
-        return Pattern(self._stages[:-1]
-                       + (_Stage(last.name, pred, last.strict),),
-                       self.within_ms, self.after_match_mode)
+        return self._with(self._stages[:-1]
+                          + (dataclasses.replace(last, where=pred),))
 
     def next(self, name: str) -> "Pattern":
         """STRICT contiguity: the key's immediately-next event."""
-        return Pattern(self._stages + (_Stage(name, None, strict=True),),
-                       self.within_ms, self.after_match_mode)
+        return self._with(self._stages
+                          + (_Stage(name, None, strict=True),))
 
     def followed_by(self, name: str) -> "Pattern":
         """RELAXED contiguity: later event, intervening ones skipped."""
-        return Pattern(self._stages + (_Stage(name, None, strict=False),),
-                       self.within_ms, self.after_match_mode)
+        return self._with(self._stages
+                          + (_Stage(name, None, strict=False),))
+
+    # -- negation (ref: Pattern.notNext / Pattern.notFollowedBy) --------
+
+    def not_next(self, name: str) -> "Pattern":
+        """STRICT negation: the key's immediately-next event must NOT
+        match this stage's where(). A match kills the partial (the
+        killing event re-tests stage 0); any other event satisfies the
+        constraint and is immediately re-tested against the following
+        stage. Cannot end a pattern (there is no 'next event' deadline
+        at the tail — use not_followed_by(...).within(ms))."""
+        return self._with(self._stages
+                          + (_Stage(name, None, strict=True,
+                                    negated=True),))
+
+    def not_followed_by(self, name: str) -> "Pattern":
+        """RELAXED negation: NO event matching this stage's where()
+        may occur strictly between the surrounding stages. An event
+        matching both the forbidden predicate and the FOLLOWING stage
+        counts as the following stage. As the LAST stage it needs
+        ``within(ms)``: the absence window — the match completes when
+        event time passes ``match_start + within`` with no forbidden
+        event, ``match_end`` is that deadline, and the stage's
+        ``<name>_ts`` column reads -1."""
+        return self._with(self._stages
+                          + (_Stage(name, None, strict=False,
+                                    negated=True),))
 
     def within(self, ms: int) -> "Pattern":
-        return Pattern(self._stages, int(ms), self.after_match_mode)
+        return Pattern(self._stages, int(ms), self.after_match_mode,
+                       self.after_match_stage)
 
-    def after_match(self, mode: str) -> "Pattern":
+    def after_match(self, mode: str,
+                    stage_name: Optional[str] = None) -> "Pattern":
         """After-match skip strategy (ref: cep/nfa/aftermatch/
         AfterMatchSkipStrategy): SKIP_PAST_LAST_EVENT (default —
-        deterministic, each event in at most one match) or NO_SKIP
+        deterministic, each event in at most one match); NO_SKIP
         (the reference default — overlapping matches enumerated from a
         BOUNDED per-key partial buffer, cap + loud overflow; linear
         patterns only — quantifiers with NO_SKIP are refused at build
         because the branch enumeration is exactly the exponential
-        SharedBuffer this design trades away)."""
-        if mode not in ("SKIP_PAST_LAST_EVENT", "NO_SKIP"):
+        SharedBuffer this design trades away); SKIP_TO_FIRST /
+        SKIP_TO_LAST (``stage_name`` required) — same multi-partial
+        engine, but each completed match prunes every partial whose
+        start precedes the first/last event the match mapped to that
+        stage (a ``times(n)`` stage resolves to its ``<name>_1`` /
+        ``<name>_n`` expansion)."""
+        modes = ("SKIP_PAST_LAST_EVENT", "NO_SKIP",
+                 "SKIP_TO_FIRST", "SKIP_TO_LAST")
+        if mode not in modes:
             raise ValueError(
                 f"after_match mode {mode!r}: supported modes are "
-                "SKIP_PAST_LAST_EVENT and NO_SKIP")
-        return Pattern(self._stages, self.within_ms, mode)
+                + ", ".join(modes))
+        if mode in ("SKIP_TO_FIRST", "SKIP_TO_LAST"):
+            if stage_name is None:
+                raise ValueError(
+                    f"after_match({mode!r}) needs the stage name the "
+                    "skip anchors to: after_match(mode, 'stage')")
+        elif stage_name is not None:
+            raise ValueError(
+                f"after_match({mode!r}) takes no stage name")
+        return Pattern(self._stages, self.within_ms, mode, stage_name)
 
     # -- quantifiers (ref: cep/pattern/Quantifier.java) -----------------
 
@@ -121,9 +191,11 @@ class Pattern:
         if last.loop or last.optional:
             raise ValueError(
                 f"stage {last.name!r} already has a quantifier")
-        return Pattern(self._stages[:-1]
-                       + (dataclasses.replace(last, times=n),),
-                       self.within_ms, self.after_match_mode)
+        if last.negated:
+            raise ValueError(
+                f"negated stage {last.name!r} cannot be quantified")
+        return self._with(self._stages[:-1]
+                          + (dataclasses.replace(last, times=n),))
 
     def one_or_more(self) -> "Pattern":
         """GREEDY unbounded repetition of the most recent stage
@@ -142,9 +214,11 @@ class Pattern:
         if last.times != 1 or last.optional:
             raise ValueError(
                 f"stage {last.name!r} already has a quantifier")
-        return Pattern(self._stages[:-1]
-                       + (dataclasses.replace(last, loop=True),),
-                       self.within_ms, self.after_match_mode)
+        if last.negated:
+            raise ValueError(
+                f"negated stage {last.name!r} cannot be quantified")
+        return self._with(self._stages[:-1]
+                          + (dataclasses.replace(last, loop=True),))
 
     def optional(self) -> "Pattern":
         """The most recent stage may be absent: when an event matches
@@ -155,9 +229,11 @@ class Pattern:
         if last.loop or last.times != 1:
             raise ValueError(
                 f"stage {last.name!r} already has a quantifier")
-        return Pattern(self._stages[:-1]
-                       + (dataclasses.replace(last, optional=True),),
-                       self.within_ms, self.after_match_mode)
+        if last.negated:
+            raise ValueError(
+                f"negated stage {last.name!r} cannot be quantified")
+        return self._with(self._stages[:-1]
+                          + (dataclasses.replace(last, optional=True),))
 
     @property
     def stages(self) -> Tuple[_Stage, ...]:
@@ -181,6 +257,38 @@ class Pattern:
                 raise ValueError(
                     "optional() on the first stage is not supported — "
                     "the match start would be undefined when skipped")
+            if s.negated and i == 0:
+                raise ValueError(
+                    "a pattern cannot begin with a negation — the "
+                    "match start would be undefined (ref refuses "
+                    "notFollowedBy as the first pattern too)")
+            if s.negated and s.strict and is_last:
+                raise ValueError(
+                    "a trailing not_next() is not supported — there is "
+                    "no 'next event' to wait for at the tail; use "
+                    "not_followed_by(...) with within(ms) for an "
+                    "absence window")
+            if s.negated and not s.strict and is_last \
+                    and self.within_ms is None:
+                raise ValueError(
+                    "a trailing not_followed_by() needs within(ms) — "
+                    "the absence window that decides when 'it never "
+                    "came' becomes a match")
+            if s.negated and self._stages[i - 1].negated:
+                raise ValueError(
+                    "adjacent negated stages are not supported — merge "
+                    "the forbidden predicates into one negated stage")
+            if s.negated and (self._stages[i - 1].loop
+                              or self._stages[i - 1].optional):
+                raise ValueError(
+                    f"negated stage {s.name!r} directly after a "
+                    "quantified stage is not supported (the quantifier "
+                    "exit would have to test the forbidden predicate)")
+            if s.negated and not is_last and self._stages[i + 1].strict:
+                raise ValueError(
+                    f"stage after negated {s.name!r} must use "
+                    "followed_by() (the negated stage consumes no "
+                    "event, so strict next() contiguity is undefined)")
             if (s.loop or s.optional) and not is_last \
                     and self._stages[i + 1].strict:
                 raise ValueError(
@@ -224,6 +332,12 @@ class CepOperator:
         # one one_or_more stage per pattern — validated at build)
         self._is_loop = np.array([s.loop for s in self.stages], bool)
         self._is_opt = np.array([s.optional for s in self.stages], bool)
+        self._is_neg = np.array([s.negated for s in self.stages], bool)
+        # trailing relaxed negation = absence pattern: a partial parked
+        # at stage S-1 completes when event time passes
+        # match_start + within with no forbidden event (build validated
+        # within is set and the stage is relaxed)
+        self._trail_neg = bool(self._is_neg[-1])
         self._loop_idx = (int(np.nonzero(self._is_loop)[0][0])
                           if self._is_loop.any() else -1)
         self.loop_cnt = np.zeros(cap, np.int32)
@@ -241,21 +355,47 @@ class CepOperator:
         self.records_dropped_full = 0
         self.state_version = 0
         self._matches: List[Dict[str, np.ndarray]] = []
-        # NO_SKIP: a BOUNDED partial-match buffer per key — the
-        # SharedBuffer role (ref: cep/nfa/sharedbuffer) capped at
-        # ``max_partials`` columns with loud overflow. Linear patterns
-        # only: quantifiers would need branch enumeration (the
-        # exponential part this design refuses).
-        self.no_skip = pattern.after_match_mode == "NO_SKIP"
+        # NO_SKIP / SKIP_TO_FIRST / SKIP_TO_LAST: a BOUNDED
+        # partial-match buffer per key — the SharedBuffer role (ref:
+        # cep/nfa/sharedbuffer) capped at ``max_partials`` columns with
+        # loud overflow. Linear patterns only: quantifiers would need
+        # branch enumeration (the exponential part this design
+        # refuses). ``no_skip`` names the ENGINE (multi-partial) — the
+        # skip-to modes run on it with post-completion pruning.
+        mode = pattern.after_match_mode
+        self.no_skip = mode in ("NO_SKIP", "SKIP_TO_FIRST",
+                                "SKIP_TO_LAST")
         self.max_partials = 8
+        # SKIP_TO_FIRST/LAST anchor: index (in EXPANDED stages) of the
+        # referenced stage — FIRST takes the earliest expansion
+        # (<name>_1), LAST the latest (<name>_n)
+        self._skip_ref: Optional[int] = None
+        if mode in ("SKIP_TO_FIRST", "SKIP_TO_LAST"):
+            ref_name = pattern.after_match_stage
+            cands = [i for i, s in enumerate(self.stages)
+                     if s.name == ref_name
+                     or (s.name.rsplit("_", 1)[0] == ref_name
+                         and s.name.rsplit("_", 1)[-1].isdigit())]
+            if not cands:
+                raise ValueError(
+                    f"after_match({mode!r}, {ref_name!r}): no stage "
+                    f"named {ref_name!r} (stages: "
+                    f"{[s.name for s in self.stages]})")
+            self._skip_ref = (cands[0] if mode == "SKIP_TO_FIRST"
+                              else cands[-1])
         if self.no_skip:
             if self._is_loop.any() or self._is_opt.any():
                 raise NotImplementedError(
-                    "after_match('NO_SKIP') supports linear patterns "
+                    f"after_match({mode!r}) supports linear patterns "
                     "(next/followed_by/times) only; one_or_more and "
                     "optional need the exponential branch enumeration "
                     "of the reference's SharedBuffer — use the default "
                     "SKIP_PAST_LAST_EVENT for quantified patterns")
+            if self._is_neg.any():
+                raise NotImplementedError(
+                    f"after_match({mode!r}) does not support negated "
+                    "stages — negation runs on the default "
+                    "single-partial engine (SKIP_PAST_LAST_EVENT)")
             P = self.max_partials
             self.p_stage = np.full((cap, P), -1, np.int8)
             self.p_ts = np.zeros((cap, P, self.S), np.int64)
@@ -313,7 +453,7 @@ class CepOperator:
 
         within = self.pattern.within_ms
         strict = np.array([s.strict for s in self.stages], bool)
-        is_loop, is_opt = self._is_loop, self._is_opt
+        is_loop, is_opt, is_neg = self._is_loop, self._is_opt, self._is_neg
         for r in range(max_rank):
             m = rank == r                    # one event per key this step
             s_r = sl[m]
@@ -322,6 +462,22 @@ class CepOperator:
             k = len(s_r)
             ar = np.arange(k)
             cur = self.stage[s_r]            # (k,) next stage to match
+
+            # trailing absence: a partial parked at the negated tail
+            # whose deadline (match_start + within) the current event's
+            # ts has passed COMPLETES — no forbidden event arrived in
+            # the window (events arrive in ts order per key). Must run
+            # BEFORE the expiry reset below, which tests the very same
+            # age condition. The completing event then starts fresh
+            # against stage 0 in this step.
+            if self._trail_neg:
+                due = ((cur == self.S - 1)
+                       & (t_r - self.stage_ts[s_r, 0] > within))
+                if due.any():
+                    f = np.nonzero(due)[0]
+                    self._matches.append(
+                        self._absence_rows(s_r[f], kk[m][f]))
+                    cur = np.where(due, 0, cur)
 
             # within-window expiry: partial too old resets to stage 0
             if within is not None:
@@ -337,6 +493,8 @@ class CepOperator:
             hit_next = p_r[nxtc, ar] & has_next
             lp = is_loop[curc] & (cur < self.S)
             op_ = is_opt[curc] & (cur < self.S)
+            ng = is_neg[curc] & (cur < self.S)
+            ng_strict = ng & strict[curc]
             in_loop = lp & (self.loop_cnt[s_r] > 0)
 
             # decision precedence (greedy loop first):
@@ -346,19 +504,32 @@ class CepOperator:
             b_exit = in_loop & ~hit_cur & hit_next
             # C. optional skip: next stage's event while optional pends
             c_skip = op_ & ~hit_cur & hit_next
+            # N. negation: the forbidden event KILLS the partial.
+            #    not_next: any hit on the immediately-next event kills;
+            #    not_followed_by: a hit kills UNLESS the same event
+            #    matches the FOLLOWING stage (then no forbidden event
+            #    occurred strictly between — the event IS the next
+            #    stage). A non-killing event at a negated stage either
+            #    passes over it (hit_next → +2; not_next with no next
+            #    hit → +1, the constraint is spent on this one event)
+            #    or, for relaxed negation, is skipped (stay).
+            n_kill = ng & hit_cur & (ng_strict | ~hit_next)
+            n_pass2 = ng & ~n_kill & hit_next
+            n_pass1 = ng_strict & ~n_kill & ~hit_next
             # D. plain advance
-            d_adv = ~lp & ~c_skip & hit_cur
+            d_adv = ~lp & ~c_skip & ~ng & hit_cur
             # E. strict miss -> partial dies (breaking event re-tests
             #    stage 0)
-            miss_strict = (~a_loop & ~b_exit & ~c_skip & ~d_adv
+            miss_strict = (~a_loop & ~b_exit & ~c_skip & ~d_adv & ~ng
                            & ~hit_cur & strict[curc] & (cur > 0))
-            restart = miss_strict & p_r[0, ar]
+            die = miss_strict | n_kill
+            restart = die & p_r[0, ar]
 
             new_stage = np.where(
                 a_loop, cur,
-                np.where(b_exit | c_skip, cur + 2,
-                         np.where(d_adv, cur + 1,
-                                  np.where(miss_strict,
+                np.where(b_exit | c_skip | n_pass2, cur + 2,
+                         np.where(d_adv | n_pass1, cur + 1,
+                                  np.where(die,
                                            np.where(restart, 1, 0),
                                            cur))))
 
@@ -374,11 +545,13 @@ class CepOperator:
             w_cur = d_adv | enter_loop | restart
             st_cur = np.where(restart, 0, cur)
             self.stage_ts[s_r[w_cur], st_cur[w_cur]] = t_r[w_cur]
-            w_nxt = b_exit | c_skip
+            w_nxt = b_exit | c_skip | n_pass2
             self.stage_ts[s_r[w_nxt], np.minimum(cur[w_nxt] + 1,
                                                  self.S - 1)] = t_r[w_nxt]
-            # a skipped optional stage reads -1 in the match row
-            self.stage_ts[s_r[c_skip], curc[c_skip]] = -1
+            # a skipped optional / passed negated stage reads -1 in the
+            # match row (the stage consumed no event)
+            w_abs = c_skip | n_pass2 | n_pass1
+            self.stage_ts[s_r[w_abs], curc[w_abs]] = -1
 
             done = new_stage >= self.S
             if done.any():
@@ -398,6 +571,28 @@ class CepOperator:
 
             self.stage[s_r] = new_stage.astype(np.int32)
             self._last_ts[s_r] = t_r
+
+    def _absence_rows(self, slots, keys) -> Dict[str, np.ndarray]:
+        """Complete trailing-absence partials: the window
+        [match_start, match_start + within] closed with no forbidden
+        event. Builds the match rows (match_end = the deadline; the
+        negated tail's ts column = -1) and resets the partials."""
+        within = self.pattern.within_ms
+        start = self.stage_ts[slots, 0].copy()
+        row = {"key": np.asarray(keys, np.int64).copy(),
+               "match_start": start,
+               "match_end": start + within}
+        for si, stg in enumerate(self.stages):
+            row[f"{stg.name}_ts"] = (
+                np.full(len(slots), -1, np.int64) if stg.negated
+                else self.stage_ts[slots, si].copy())
+        if self._loop_idx >= 0:
+            ln = self.stages[self._loop_idx].name
+            row[f"{ln}_last_ts"] = self.loop_last[slots].copy()
+            row[f"{ln}_count"] = self.loop_cnt[slots].copy()
+            self.loop_cnt[slots] = 0
+        self.stage[slots] = 0
+        return row
 
     def _steps_no_skip(self, sl, tt, kk, pr, rank, max_rank) -> None:
         """NO_SKIP rank-step engine: every key advances ALL its live
@@ -464,13 +659,44 @@ class CepOperator:
             compl = st >= S
             if compl.any():
                 ci, cp = np.nonzero(compl)
-                row = {"key": kk[m][ci],
-                       "match_start": self.p_ts[s_r[ci], cp, 0].copy(),
-                       "match_end": t_r[ci].copy()}
-                for si, stg in enumerate(self.stages):
-                    row[f"{stg.name}_ts"] = self.p_ts[
-                        s_r[ci], cp, si].copy()
-                self._matches.append(row)
+                if self._skip_ref is None:
+                    # NO_SKIP: every completion emits
+                    row = {"key": kk[m][ci],
+                           "match_start": self.p_ts[s_r[ci], cp, 0].copy(),
+                           "match_end": t_r[ci].copy()}
+                    for si, stg in enumerate(self.stages):
+                        row[f"{stg.name}_ts"] = self.p_ts[
+                            s_r[ci], cp, si].copy()
+                    self._matches.append(row)
+                else:
+                    # SKIP_TO_FIRST/LAST: per key, completions resolve
+                    # in ascending match_start; each emitted match
+                    # prunes every partial — and every not-yet-emitted
+                    # completion — whose start precedes the ts of the
+                    # event it mapped to the referenced stage.
+                    # Completions are rare; this stays scalar.
+                    ref = self._skip_ref
+                    for i in np.unique(ci):
+                        pps = cp[ci == i]
+                        starts = self.p_ts[s_r[i], pps, 0]
+                        cut = None
+                        for p in pps[np.argsort(starts, kind="stable")]:
+                            if cut is not None \
+                                    and self.p_ts[s_r[i], p, 0] < cut:
+                                continue  # pruned by an earlier match
+                            row = {
+                                "key": kk[m][[i]].copy(),
+                                "match_start": self.p_ts[
+                                    s_r[i], p, [0]].copy(),
+                                "match_end": t_r[[i]].copy()}
+                            for si, stg in enumerate(self.stages):
+                                row[f"{stg.name}_ts"] = self.p_ts[
+                                    s_r[i], p, [si]].copy()
+                            self._matches.append(row)
+                            cut = int(self.p_ts[s_r[i], p, ref])
+                        live = (st[i] >= 0) & (st[i] < S)
+                        st[i, live
+                           & (self.p_ts[s_r[i], :, 0] < cut)] = -1
                 st = np.where(compl, -1, st)
             # spawn: stage-0 match starts a NEW partial (even when the
             # same event extended others — the overlap contract)
@@ -518,10 +744,33 @@ class CepOperator:
 
         if wm > self.watermark:
             self.watermark = wm
+        # trailing absence: the watermark passing a pending partial's
+        # deadline PROVES no forbidden event with ts <= deadline is
+        # still coming — the match completes on time progress alone
+        # (the in-stream path in process_batch only helps keys that
+        # keep receiving events)
+        if self._trail_neg and self.watermark != LONG_MIN:
+            within = self.pattern.within_ms
+            pend = self.stage == self.S - 1
+            due = pend & (self.stage_ts[:, 0] + within <= self.watermark)
+            if due.any():
+                self.state_version += 1
+                slots = np.nonzero(due)[0]
+                keys = self.directory.key_of_slots(slots)
+                row = self._absence_rows(slots, keys)
+                row["__ts__"] = row["match_end"].astype(np.int64).copy()
+                return FiredWindows(data=row)
         return FiredWindows(data={"__ts__": np.zeros(0, np.int64)})
 
     def final_watermark(self) -> int:
-        return self.watermark if self.watermark != LONG_MIN else 0
+        base = self.watermark if self.watermark != LONG_MIN else 0
+        if self._trail_neg:
+            # flush every pending absence window at end of input
+            pend = self.stage == self.S - 1
+            if pend.any():
+                base = max(base, int(self.stage_ts[pend, 0].max())
+                           + self.pattern.within_ms)
+        return base
 
     def quiesce(self) -> None:
         pass

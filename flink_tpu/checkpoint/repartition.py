@@ -437,7 +437,7 @@ def _merge_count_window(snaps: Sequence[Dict[str, Any]],
 
 def _merge_global_agg(snaps: Sequence[Dict[str, Any]],
                       g: _Geo) -> Dict[str, Any]:
-    return {
+    out = {
         "kind": "global_agg",
         "directory": _merge_directory(
             [s["directory"] for s in snaps], g,
@@ -450,6 +450,13 @@ def _merge_global_agg(snaps: Sequence[Dict[str, Any]],
         "records_dropped_full": sum(
             int(s.get("records_dropped_full", 0)) for s in snaps),
     }
+    # retract mode adds last-emitted bookkeeping; absent on append-mode
+    # snapshots (and pre-retract checkpoints), so splice conditionally
+    for field in ("prev_counts", "prev_sums", "prev_maxs", "prev_mins",
+                  "emitted"):
+        if field in snaps[0]:
+            out[field] = _splice_slots([s[field] for s in snaps], g)
+    return out
 
 
 def _merge_evicting(snaps: Sequence[Dict[str, Any]], g: _Geo) -> Dict[str, Any]:

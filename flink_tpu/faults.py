@@ -185,6 +185,13 @@ Instrumented points (the stack's recovery-critical seams):
         (the generation fence at offset commit: fired when a DEPOSED
         member's late commit is rejected — chaos schedules assert the
         rejection surfaces loudly instead of corrupting the floor)
+    changelog.retract.emit                         ops/global_agg.py
+        (the retract-mode emission seam of the unwindowed aggregation,
+        fired BEFORE the -U/+U pair is built and before the emitted-
+        view bookkeeping mutates: a raise is the attempt dying between
+        fold and emission — recovery restores the last checkpoint's
+        (prev, emitted) view and the re-emitted changelog folds to the
+        same materialized state, the exactly-once retraction gate)
 
 Job-scoped plans (the session-cluster isolation contract): a runner
 process hosting N concurrent jobs cannot use the process-global plan —
@@ -281,6 +288,7 @@ KNOWN_FAULT_POINTS = frozenset((
     "log.cleaner.pass",
     "log.group.rebalance",
     "log.group.fence",
+    "changelog.retract.emit",
 ))
 
 # Points intentionally registered BEFORE their seam is instrumented

@@ -176,6 +176,8 @@ def _op_out_schema(node: ExecNode) -> Optional[Dict[str, str]]:
             out = {"key": "int64", "window_start": "int64",
                    "window_end": "int64", "count": "int64"}
             out.update(_probe_result_schema(wt.aggregate))
+            if getattr(wt, "retract", False):
+                out["__op__"] = "int8"  # records.OP_FIELD changelog lane
             return out
         if node.kind == "window_all":
             out = {"window_start": "int64", "window_end": "int64",
@@ -185,6 +187,8 @@ def _op_out_schema(node: ExecNode) -> Optional[Dict[str, str]]:
         if node.kind == "global_agg":
             out = {"key": "int64", "count": "int64"}
             out.update(_probe_result_schema(wt.aggregate))
+            if getattr(wt, "retract", False):
+                out["__op__"] = "int8"  # records.OP_FIELD changelog lane
             return out
         if node.kind == "join":
             out = {"key": "int64", "window_start": "int64",

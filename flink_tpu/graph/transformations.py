@@ -171,10 +171,12 @@ class GlobalAggregateTransformation(Transformation):
     """Unwindowed keyed running aggregation emitting an upsert stream
     (ref: table-runtime GroupAggFunction / retract-changelog semantics
     degenerated to upserts for insert-only input — see
-    ops/global_agg.py)."""
+    ops/global_agg.py). ``retract=True`` emits the full op-typed
+    changelog instead (-U/+U pairs, records.OP_FIELD lane)."""
 
     aggregate: Optional[LaneAggregate] = None
     key_field: str = "key"
+    retract: bool = False
 
 
 @dataclasses.dataclass(eq=False)
@@ -194,12 +196,16 @@ class WindowJoinTransformation(Transformation):
 @dataclasses.dataclass(eq=False)
 class SessionAggregateTransformation(Transformation):
     """Keyed session windows (ref: EventTimeSessionWindows +
-    MergingWindowSet) — host span registry + device accumulators."""
+    MergingWindowSet) — host span registry + device accumulators.
+    ``retract=True`` op-types the output: a merge that consumes an
+    already-fired span retracts its stale row (-U) before the merged
+    session (re)fires (+U)."""
 
     gap_ms: int = 0
     aggregate: Optional[LaneAggregate] = None
     allowed_lateness_ms: int = 0
     key_field: str = "key"
+    retract: bool = False
 
 
 @dataclasses.dataclass(eq=False)

@@ -258,6 +258,111 @@ def multi(*aggs: LaneAggregate) -> LaneAggregate:
 
 
 # ---------------------------------------------------------------------------
+# Changelog-consuming lanes: windowed aggregation over op-typed input.
+# ---------------------------------------------------------------------------
+
+def _op_sign(data: Arrays) -> jax.Array:
+    """Per-record +1/-1 from the changelog op column (records.OP_FIELD):
+    +I/+U add, -U/-D subtract — retraction folding as arithmetic, the
+    table-runtime ``retract()`` call vectorized into the lift (ref:
+    table/runtime AggsHandleFunction.retract)."""
+    from flink_tpu.records import OP_DELETE, OP_FIELD, OP_UPDATE_BEFORE
+
+    ops = data[OP_FIELD].astype(jnp.int32)
+    return jnp.where((ops == OP_UPDATE_BEFORE) | (ops == OP_DELETE),
+                     -1.0, 1.0).astype(jnp.float32)
+
+
+@_cached
+def changelog_count(result_field: str = "count") -> LaneAggregate:
+    """COUNT(*) over a changelog stream — each -U/-D row erases the +I/+U
+    it supersedes, so the count is the SUM OF SIGNS, not the row count
+    (the built-in count lane would double-count every update pair).
+    Opaque lift (``sum_fields=None``): the sign is derived, not an
+    identity field read, so the host bincount pre-agg stays off."""
+    from flink_tpu.records import OP_FIELD
+
+    def lift(data: Arrays):
+        s = _op_sign(data)[:, None]
+        z = _empty_lanes(s[:, 0])
+        return s, z, z
+
+    def finalize(sums, maxs, mins, counts):
+        return {result_field: jnp.round(sums[..., 0]).astype(jnp.int32)}
+
+    return LaneAggregate(1, 0, 0, lift, finalize, name="changelog_count",
+                         fields=(OP_FIELD,))
+
+
+@_cached
+def changelog_sum_of(field: str,
+                     result_field: Optional[str] = None) -> LaneAggregate:
+    """SUM(field) over a changelog stream: sign-weighted values, so a
+    -U retraction subtracts exactly what its +I/+U contributed."""
+    from flink_tpu.records import OP_FIELD
+
+    out = result_field or f"sum_{field}"
+
+    def lift(data: Arrays):
+        s = (data[field].astype(jnp.float32) * _op_sign(data))[:, None]
+        z = _empty_lanes(data[field])
+        return s, z, z
+
+    def finalize(sums, maxs, mins, counts):
+        return {out: sums[..., 0]}
+
+    return LaneAggregate(1, 0, 0, lift, finalize,
+                         name=f"changelog_sum({field})",
+                         fields=(field, OP_FIELD))
+
+
+@_cached
+def changelog_avg_of(field: str,
+                     result_field: Optional[str] = None) -> LaneAggregate:
+    """AVG(field) over a changelog stream: signed sum / signed count —
+    the operator's built-in count lane counts ROWS (retractions
+    included), so the divisor must be a dedicated signed lane."""
+    from flink_tpu.records import OP_FIELD
+
+    out = result_field or f"avg_{field}"
+
+    def lift(data: Arrays):
+        sign = _op_sign(data)
+        s = jnp.stack([data[field].astype(jnp.float32) * sign, sign],
+                      axis=-1)
+        z = _empty_lanes(data[field])
+        return s, z, z
+
+    def finalize(sums, maxs, mins, counts):
+        c = jnp.maximum(jnp.round(sums[..., 1]), 1.0)
+        return {out: sums[..., 0] / c}
+
+    return LaneAggregate(2, 0, 0, lift, finalize,
+                         name=f"changelog_avg({field})",
+                         fields=(field, OP_FIELD))
+
+
+def changelog_max_of(field: str, result_field: Optional[str] = None) -> None:
+    """Refused: max is a monoid fold — it cannot retract. Once a value
+    has raised the lane, subtracting its -U row cannot lower it back
+    (that needs the full value multiset, i.e. an evicting window)."""
+    raise NotImplementedError(
+        "MAX over a changelog stream cannot retract: max(a, b) forgets "
+        "the loser, so a -U row cannot undo its +U. Materialize the "
+        "stream first (RetractSink / UpsertSink) or keep the raw rows "
+        "with an evicting window.")
+
+
+def changelog_min_of(field: str, result_field: Optional[str] = None) -> None:
+    """Refused for the same reason as :func:`changelog_max_of`."""
+    raise NotImplementedError(
+        "MIN over a changelog stream cannot retract: min(a, b) forgets "
+        "the loser, so a -U row cannot undo its +U. Materialize the "
+        "stream first (RetractSink / UpsertSink) or keep the raw rows "
+        "with an evicting window.")
+
+
+# ---------------------------------------------------------------------------
 # Lowering reference-style AggregateFunction classes.
 # ---------------------------------------------------------------------------
 

@@ -5,9 +5,17 @@ stream model (SURVEY §3.8): `SELECT k, agg FROM t GROUP BY k` with no
 window emits an ever-updating result per key. For INSERT-ONLY input
 (the streaming source contract here) the changelog degenerates to an
 UPSERT stream — each emitted row REPLACES the previous row for its
-key, and no DELETE/retraction records are needed. Sinks consume it
-either raw (`FnSink` sees every upsert — the kafka-upsert shape) or
-materialized (`UpsertSink` keeps latest-by-key).
+key. Sinks consume it either raw (`FnSink` sees every upsert — the
+kafka-upsert shape) or materialized (`UpsertSink` keeps latest-by-key).
+
+``retract=True`` emits the FULL changelog instead (ref: the retract
+stream of SURVEY §3.8, RowKind-typed rows): each update becomes a
+``-U`` row carrying the previously emitted values followed by a
+``+U`` replacement (first emission: ``+I``), op-typed via the
+``records.OP_FIELD`` int8 lane. This is what downstream changelog
+consumers need — window aggregation that SUBTRACTS retracted rows
+(ops/aggregates.changelog_* lanes), `RetractSink`, and the SQL
+HAVING-over-unwindowed-aggregation rewrite all fold these rows.
 
 TPU-first shape: per-key accumulators live in flat host arrays behind
 the same KeyDirectory slot map the pane backend uses; a batch folds in
@@ -23,24 +31,54 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from flink_tpu import faults
 from flink_tpu.ops.window import FiredWindows, account_full_drop
+from flink_tpu.records import (
+    OP_DTYPE,
+    OP_FIELD,
+    OP_INSERT,
+    OP_UPDATE_AFTER,
+    OP_UPDATE_BEFORE,
+)
 from flink_tpu.state.keyed import KeyDirectory
 from flink_tpu.time.watermarks import LONG_MIN
 
 
 class GlobalAggregateOperator:
     """Driver-protocol operator: per-step upsert emission via
-    ``take_fired`` (the count_window/process emission pattern)."""
+    ``take_fired`` (the count_window/process emission pattern).
+
+    ``retract=True`` switches the output from the degenerate upsert
+    stream to the full changelog (ref: GroupAggFunction's
+    generateUpdateBefore path): a touched key whose result was emitted
+    before first RETRACTS the stale row (``-U``, finalized from the
+    accumulators as they stood at the previous emission) and then emits
+    the replacement (``+U``); a key's first result is ``+I``. Rows carry
+    the op type in the ``__op__`` int8 column (records.OP_FIELD). The
+    ``-U`` block precedes the ``+I/+U`` block within one emission — a
+    key appears at most once in each, so per-key changelog order holds.
+    """
 
     def __init__(self, agg, *, num_shards: int,
-                 slots_per_shard: int) -> None:
+                 slots_per_shard: int, retract: bool = False) -> None:
         self.agg = agg
+        self.retract = bool(retract)
         self.directory = KeyDirectory(num_shards, slots_per_shard)
         n = self.directory.local_slots
         self.counts = np.zeros(n, np.int64)
         self.sums = np.zeros((n, agg.sum_width), np.float64)
         self.maxs = np.full((n, agg.max_width), -np.inf, np.float32)
         self.mins = np.full((n, agg.min_width), np.inf, np.float32)
+        if self.retract:
+            # accumulators AS EMITTED — the -U row's payload; a slot
+            # retracts only after its first emission (emitted mask)
+            self.prev_counts = np.zeros(n, np.int64)
+            self.prev_sums = np.zeros((n, agg.sum_width), np.float64)
+            self.prev_maxs = np.full((n, agg.max_width), -np.inf,
+                                     np.float32)
+            self.prev_mins = np.full((n, agg.min_width), np.inf,
+                                     np.float32)
+            self.emitted = np.zeros(n, bool)
         self.watermark = LONG_MIN
         self.late_records = 0          # unwindowed: nothing is late
         self.records_dropped_full = 0
@@ -97,24 +135,69 @@ class GlobalAggregateOperator:
                          else np.union1d(self._touched, uslots))
 
     def take_fired(self) -> Optional["FiredWindows"]:
-        """Emit the upsert rows for every key this step touched."""
+        """Emit the upsert rows for every key this step touched (or the
+        -U/+U changelog pairs in retract mode)."""
         if self._touched is None or not len(self._touched):
             self._touched = None
             return None
         sl = self._touched
         self._touched = None
+        wm = self.watermark if self.watermark != LONG_MIN else 0
+        if not self.retract:
+            res = self.agg.finalize(
+                self.sums[sl].astype(np.float32), self.maxs[sl],
+                self.mins[sl], self.counts[sl])
+            out: Dict[str, np.ndarray] = {
+                "key": self.directory.key_of_slots(sl)}
+            out["count"] = self.counts[sl]
+            for k, v in res.items():
+                out[k] = np.asarray(v)
+            # upserts carry the emission-time watermark as their
+            # timestamp (the process-function emission contract,
+            # driver _emit_fired)
+            out["__ts__"] = np.full(len(sl), wm, np.int64)
+            return FiredWindows(data=out)
+        # retract mode: fired BEFORE any emission bookkeeping mutates,
+        # so an injected failure here leaves (prev_*, emitted) exactly
+        # as the last successful emission left them — recovery replays
+        # the whole step and the changelog stays consistent
+        faults.fire("changelog.retract.emit", exc=RuntimeError,
+                    touched=len(sl))
+        retr = sl[self.emitted[sl]]
+        keys_new = self.directory.key_of_slots(sl)
+        blocks = []
+        if len(retr):
+            res_old = self.agg.finalize(
+                self.prev_sums[retr].astype(np.float32),
+                self.prev_maxs[retr], self.prev_mins[retr],
+                self.prev_counts[retr])
+            old: Dict[str, np.ndarray] = {
+                "key": self.directory.key_of_slots(retr),
+                "count": self.prev_counts[retr]}
+            for k, v in res_old.items():
+                old[k] = np.asarray(v)
+            old[OP_FIELD] = np.full(len(retr), OP_UPDATE_BEFORE,
+                                    OP_DTYPE)
+            blocks.append(old)
         res = self.agg.finalize(
             self.sums[sl].astype(np.float32), self.maxs[sl],
             self.mins[sl], self.counts[sl])
-        out: Dict[str, np.ndarray] = {
-            "key": self.directory.key_of_slots(sl)}
-        out["count"] = self.counts[sl]
+        new: Dict[str, np.ndarray] = {"key": keys_new,
+                                      "count": self.counts[sl]}
         for k, v in res.items():
-            out[k] = np.asarray(v)
-        # upserts carry the emission-time watermark as their timestamp
-        # (the process-function emission contract, driver _emit_fired)
-        wm = self.watermark if self.watermark != LONG_MIN else 0
-        out["__ts__"] = np.full(len(sl), wm, np.int64)
+            new[k] = np.asarray(v)
+        new[OP_FIELD] = np.where(self.emitted[sl], OP_UPDATE_AFTER,
+                                 OP_INSERT).astype(OP_DTYPE)
+        blocks.append(new)
+        out = {k: np.concatenate([b[k] for b in blocks])
+               for k in blocks[-1]}
+        out["__ts__"] = np.full(len(out["key"]), wm, np.int64)
+        # the emitted view is now the current accumulators
+        self.prev_counts[sl] = self.counts[sl]
+        self.prev_sums[sl] = self.sums[sl]
+        self.prev_maxs[sl] = self.maxs[sl]
+        self.prev_mins[sl] = self.mins[sl]
+        self.emitted[sl] = True
         return FiredWindows(data=out)
 
     # -- time plane ------------------------------------------------------
@@ -134,6 +217,8 @@ class GlobalAggregateOperator:
                "count": np.zeros(0, np.int64)}
         for k, v in res.items():
             out[k] = np.asarray(v)
+        if self.retract:
+            out[OP_FIELD] = np.zeros(0, OP_DTYPE)
         return out
 
     def final_watermark(self) -> int:
@@ -148,7 +233,7 @@ class GlobalAggregateOperator:
     # -- snapshot seam ---------------------------------------------------
 
     def snapshot_state(self) -> Dict[str, Any]:
-        return {
+        snap = {
             "kind": "global_agg",
             "directory": self.directory.snapshot(),
             "counts": self.counts.copy(),
@@ -158,6 +243,13 @@ class GlobalAggregateOperator:
             "watermark": self.watermark,
             "records_dropped_full": self.records_dropped_full,
         }
+        if self.retract:
+            snap["prev_counts"] = self.prev_counts.copy()
+            snap["prev_sums"] = self.prev_sums.copy()
+            snap["prev_maxs"] = self.prev_maxs.copy()
+            snap["prev_mins"] = self.prev_mins.copy()
+            snap["emitted"] = self.emitted.copy()
+        return snap
 
     def restore_state(self, snap: Dict[str, Any]) -> None:
         self.directory = KeyDirectory.restore(
@@ -168,6 +260,20 @@ class GlobalAggregateOperator:
         self.sums = np.asarray(snap["sums"]).copy()
         self.maxs = np.asarray(snap["maxs"]).copy()
         self.mins = np.asarray(snap["mins"]).copy()
+        if self.retract:
+            # a pre-retract snapshot restoring into a retract-mode op:
+            # treat the restored view as already emitted so the first
+            # post-restore update retracts it (no double +I)
+            self.prev_counts = np.asarray(snap.get(
+                "prev_counts", self.counts)).copy()
+            self.prev_sums = np.asarray(snap.get(
+                "prev_sums", self.sums)).copy()
+            self.prev_maxs = np.asarray(snap.get(
+                "prev_maxs", self.maxs)).copy()
+            self.prev_mins = np.asarray(snap.get(
+                "prev_mins", self.mins)).copy()
+            self.emitted = np.asarray(snap.get(
+                "emitted", self.counts > 0)).copy()
         self.watermark = snap["watermark"]
         self.records_dropped_full = snap.get("records_dropped_full", 0)
         self._touched = None

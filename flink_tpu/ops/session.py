@@ -37,6 +37,13 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from flink_tpu.ops.aggregates import LaneAggregate
+from flink_tpu.records import (
+    OP_DTYPE,
+    OP_FIELD,
+    OP_INSERT,
+    OP_UPDATE_AFTER,
+    OP_UPDATE_BEFORE,
+)
 from flink_tpu.time.watermarks import LONG_MIN
 
 
@@ -58,12 +65,15 @@ class _SpanStore:
         self.count = np.zeros(0, np.int64)
         self.fired = np.zeros(0, bool)
         self.refire = np.zeros(0, bool)
+        # retract mode: True after a -U was emitted for a consumed
+        # predecessor — the span's next fire is +U, not +I
+        self.retracted = np.zeros(0, bool)
 
     def __len__(self) -> int:
         return len(self.key)
 
     _COLS = ("key", "start", "last", "sums", "maxs", "mins", "count",
-             "fired", "refire")
+             "fired", "refire", "retracted")
 
     def _take(self, idx) -> Tuple[np.ndarray, ...]:
         return tuple(getattr(self, c)[idx] for c in self._COLS)
@@ -125,11 +135,18 @@ class SessionOperator:
         slots_per_shard: int = 1024,
         max_out_of_orderness_ms: int = 0,
         host_pool: Optional[Any] = None,
+        retract: bool = False,
     ) -> None:
         if gap_ms <= 0:
             raise ValueError("session gap must be positive")
         self.gap = int(gap_ms)
         self.agg = agg
+        self.retract = bool(retract)
+        # retract rows produced by merges this step, drained by
+        # take_fired immediately after each process_batch — the buffer
+        # is always empty at checkpoint boundaries (snapshots happen
+        # between steps, after emission), so it carries no state
+        self._pending_retracts: List[Dict[str, np.ndarray]] = []
         self.lateness = int(allowed_lateness_ms)
         self.watermark = LONG_MIN
         self.late_records = 0
@@ -151,10 +168,12 @@ class SessionOperator:
         ts = np.asarray(ts, np.int64)
         valid = np.ones(len(ts), bool) if valid is None else np.asarray(valid, bool)
         if self._pool is None:
-            late, refire = self._process_shard(
+            late, refire, retr = self._process_shard(
                 self._shards[0], keys, ts, data, valid)
             self.late_records += late
             self._has_refire = self._has_refire or refire
+            if retr is not None:
+                self._pending_retracts.append(retr)
             return
         # partition by key shard; per-key work is identical to serial
         # (no session logic crosses keys), so per-shard passes compose
@@ -171,19 +190,22 @@ class SessionOperator:
                 st, keys[m], ts[m],
                 {k: v[m] for k, v in data.items()}, valid[m]))
         results = self._pool.run_tasks(tasks)
-        self.late_records += sum(late for late, _ in results)
+        self.late_records += sum(late for late, _, _ in results)
         self._has_refire = self._has_refire or any(
-            refire for _, refire in results)
+            refire for _, refire, _ in results)
+        self._pending_retracts.extend(
+            retr for _, _, retr in results if retr is not None)
 
     def _process_shard(self, st: _SpanStore, keys, ts,
                        data: Dict[str, np.ndarray], valid
-                       ) -> Tuple[int, bool]:
+                       ) -> Tuple[int, bool, Optional[Dict[str, np.ndarray]]]:
         """Full ingest pass for one shard's records against its store;
-        returns (beyond-lateness drop count, refire-pending flag). At
+        returns (beyond-lateness drop count, refire-pending flag,
+        retract rows from consumed fired spans or None). At
         host.parallelism=1 this IS the whole batch — the serial path.
-        The flag rides the return value rather than being written to
+        The results ride the return value rather than being written to
         ``self`` so pool-shard passes never touch shared state; the
-        caller folds the per-shard flags on its own thread."""
+        caller folds the per-shard results on its own thread."""
         late_count = 0
         # drop beyond-lateness records (side output accounting): a record
         # is late iff its singleton session is dead AND it cannot merge
@@ -234,7 +256,7 @@ class SessionOperator:
             late_count = int(late.sum())
             valid = valid & ~late
         if not valid.any():
-            return late_count, False
+            return late_count, False, None
         keys = keys[valid]
         ts = ts[valid]
         data = {k: np.asarray(v)[valid] for k, v in data.items()}
@@ -273,11 +295,11 @@ class SessionOperator:
         seg_min = (np.minimum.reduceat(mn_l, seg_starts, axis=0)
                    if mn_l.shape[1] else np.zeros((G, 0), np.float32))
         seg_ends = np.append(seg_starts[1:], len(sk))
-        refire = self._merge_segments(
+        refire, retr = self._merge_segments(
             st, sk[seg_starts], st_[seg_starts], st_[seg_ends - 1],
             seg_sum, seg_max, seg_min,
             (seg_ends - seg_starts).astype(np.int64))
-        return late_count, refire
+        return late_count, refire, retr
 
     def _host_lift(self, data, valid) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Run the aggregate's lift on the host CPU backend (session lane
@@ -294,12 +316,17 @@ class SessionOperator:
             return np.asarray(s), np.asarray(mx), np.asarray(mn)
 
     def _merge_segments(self, st: _SpanStore, seg_key, seg_tmin, seg_tmax,
-                        seg_sum, seg_max, seg_min, seg_count) -> bool:
+                        seg_sum, seg_max, seg_min, seg_count
+                        ) -> Tuple[bool, Optional[Dict[str, np.ndarray]]]:
         """Merge batch segments into shard registry ``st`` — the
         MergingWindowSet role, fully vectorized: pull every touched
         key's spans, run one interval-union scan over (touched ∪ new)
         sorted by (key, start), combine groups with reduceat, splice
-        the results back."""
+        the results back. In retract mode, returns a -U row for every
+        FIRED registry span a merge consumes: its emitted (key,
+        window_start, window_end, aggregates) row is now stale and the
+        accumulators still hold exactly the values it fired with (a
+        fired span only changes by being consumed, which destroys it)."""
         gap = self.gap
         uk, first = np.unique(seg_key, return_index=True)
         touched_idx = st.rows_for(uk)
@@ -316,7 +343,7 @@ class SessionOperator:
             touched_idx = touched_idx[
                 st.last[touched_idx] + gap >= key_min[kr]]
         (tk, tstart, tlast, tsum, tmax, tmin, tcount, tfired,
-         trefire) = st._take(touched_idx)
+         trefire, tretr) = st._take(touched_idx)
         if len(touched_idx):
             keep = np.ones(len(st), bool)
             keep[touched_idx] = False
@@ -332,6 +359,7 @@ class SessionOperator:
         all_count = np.concatenate([tcount, seg_count])
         all_fired = np.concatenate([tfired, np.zeros(len(seg_key), bool)])
         all_refire = np.concatenate([trefire, np.zeros(len(seg_key), bool)])
+        all_retr = np.concatenate([tretr, np.zeros(len(seg_key), bool)])
         is_new = np.concatenate(
             [np.zeros(n_t, bool), np.ones(len(seg_key), bool)])
 
@@ -379,6 +407,7 @@ class SessionOperator:
         m_count = np.add.reduceat(all_count[order], gs)
         fired_any = np.logical_or.reduceat(all_fired[order], gs)
         refire_any = np.logical_or.reduceat(all_refire[order], gs)
+        retr_any = np.logical_or.reduceat(all_retr[order], gs)
         new_any = np.logical_or.reduceat(is_new[order], gs)
         size1 = np.append(gs[1:], len(order)) - gs == 1
 
@@ -392,9 +421,27 @@ class SessionOperator:
         m_fired = np.where(passthrough, fired_any, False)
         m_refire = np.where(passthrough, refire_any,
                             fired_any | refire_any | complete_now)
+        # a merged span whose constituents emitted (and now retract) a
+        # row, or that inherited a still-pending retraction, (re)fires
+        # as +U rather than +I
+        m_retr = np.where(passthrough, retr_any, fired_any | retr_any)
+        retract_rows = None
+        if self.retract:
+            # -U one row per consumed FIRED registry span (member-level
+            # mask: registry member, fired, in a non-passthrough group)
+            grp_sizes = np.append(gs[1:], len(order)) - gs
+            pass_m = np.repeat(passthrough, grp_sizes)
+            rm = ~is_new[order] & all_fired[order] & ~pass_m
+            if rm.any():
+                retract_rows = self._emit((
+                    k_o[rm], s_o[rm], l_o[rm], all_sum[order][rm],
+                    all_max[order][rm], all_min[order][rm],
+                    all_count[order][rm]))
+                retract_rows[OP_FIELD] = np.full(
+                    int(rm.sum()), OP_UPDATE_BEFORE, OP_DTYPE)
         st.insert_sorted((m_key, m_start, m_last, m_sum, m_max, m_min,
-                          m_count, m_fired, m_refire))
-        return bool(m_refire.any())
+                          m_count, m_fired, m_refire, m_retr))
+        return bool(m_refire.any()), retract_rows
 
     # -- time ------------------------------------------------------------
     def advance_watermark(self, wm: int):
@@ -435,8 +482,18 @@ class SessionOperator:
         end1 = st.last + self.gap - 1
         complete = end1 <= self.watermark
         emit = complete & (~st.fired | st.refire)
-        rows = (self._emit(st._take(np.nonzero(emit)[0]))
-                if emit.any() else None)
+        rows = None
+        if emit.any():
+            idx = np.nonzero(emit)[0]
+            rows = self._emit(st._take(idx))
+            if self.retract:
+                # spans whose predecessors were retracted (re)fire as
+                # +U; first firings are +I — the row now stands, so the
+                # pending-retraction flag clears
+                rows[OP_FIELD] = np.where(
+                    st.retracted[idx], OP_UPDATE_AFTER,
+                    OP_INSERT).astype(OP_DTYPE)
+                st.retracted[idx] = False
         st.fired |= complete
         st.refire[:] = False
         dead = end1 + self.lateness <= self.watermark
@@ -447,7 +504,7 @@ class SessionOperator:
     def _emit(self, cols: Tuple[np.ndarray, ...]) -> Dict[str, np.ndarray]:
         import jax
 
-        key, start, last, sums, maxs, mins, count, _, _ = cols
+        key, start, last, sums, maxs, mins, count = cols[:7]
         cpu = jax.local_devices(backend="cpu")[0]
         with jax.default_device(cpu):
             import jax.numpy as jnp
@@ -476,8 +533,31 @@ class SessionOperator:
                 np.zeros(0, np.int64), np.zeros((0, w.sum_width), np.float32),
                 np.zeros((0, w.max_width), np.float32),
                 np.zeros((0, w.min_width), np.float32),
-                np.zeros(0, np.int64), np.zeros(0, bool), np.zeros(0, bool)))
+                np.zeros(0, np.int64)))
+            if self.retract:
+                self._empty_cache[OP_FIELD] = np.zeros(0, OP_DTYPE)
         return dict(self._empty_cache)
+
+    # -- per-step retraction drain ---------------------------------------
+    def take_fired(self):
+        """Drain the -U rows merges produced this step (retract mode;
+        None otherwise). Called by the driver right after each
+        process_batch, so a consumed fired span's retraction reaches
+        the sink BEFORE the merged session's eventual (re)fire."""
+        from flink_tpu.ops.window import FiredWindows
+
+        if not self._pending_retracts:
+            return None
+        parts = self._pending_retracts
+        self._pending_retracts = []
+        if len(parts) == 1:
+            rows = parts[0]
+        else:
+            rows = {k: np.concatenate([p[k] for p in parts])
+                    for k in parts[0]}
+        # deterministic emission order across host-pool shard counts
+        order = np.lexsort((rows["window_start"], rows["key"]))
+        return FiredWindows(data={k: v[order] for k, v in rows.items()})
 
     def final_watermark(self) -> int:
         lasts = [int(st.last.max()) for st in self._shards if len(st)]
@@ -512,7 +592,13 @@ class SessionOperator:
         st = _SpanStore(self.agg.sum_width, self.agg.max_width,
                         self.agg.min_width)
         if "columns" in snap:
+            n = len(snap["columns"]["key"])
             for c in st._COLS:
+                if c == "retracted" and c not in snap["columns"]:
+                    # snapshots predating retract mode: nothing fired as
+                    # +I has been merged away yet
+                    setattr(st, c, np.zeros(n, bool))
+                    continue
                 # copy: advance_watermark mutates columns in place
                 # (fired |= ..., refire[:] = ...); aliasing the caller's
                 # snapshot would corrupt it for reuse (recovery retries,
@@ -533,6 +619,7 @@ class SessionOperator:
                 st.count = np.array([r[6] for r in rows], np.int64)
                 st.fired = np.array([r[7] for r in rows], bool)
                 st.refire = np.array([r[8] for r in rows], bool)
+                st.retracted = np.zeros(len(rows), bool)
         self._install_store(st)
         self._has_refire = bool(st.refire.any())
 

@@ -28,6 +28,37 @@ import numpy as np
 TS_DTYPE = np.int64
 MIN_TS = np.int64(np.iinfo(np.int64).min)
 
+# -- changelog plane: RowKind as a small-int lane -------------------------
+# ref: org.apache.flink.types.RowKind — the op type of a changelog row.
+# The reference carries it as a header byte on every StreamRecord; here
+# it is an ordinary int8 data column (``__op__``) that exists ONLY on
+# changelog streams (retract-mode unwindowed aggregation, session-merge
+# refires). Insert-only streams carry no ``__op__`` column at all, so
+# the plane costs nothing until a retract-producing op creates it.
+OP_FIELD = "__op__"
+OP_DTYPE = np.int8
+OP_INSERT = 0         # +I  first result for its key
+OP_UPDATE_BEFORE = 1  # -U  retraction of the previously emitted row
+OP_UPDATE_AFTER = 2   # +U  the replacement row
+OP_DELETE = 3         # -D  final deletion for its key
+OP_NAMES = ("+I", "-U", "+U", "-D")
+
+# RowKind → accumulation sign: +1 for rows that ADD to a downstream
+# fold (+I/+U), -1 for rows that SUBTRACT (-U/-D). Kept as a lookup
+# table so both host (numpy) and device (jax take) use the same map.
+_OP_SIGNS = (1, -1, 1, -1)
+
+
+def op_sign(ops) -> np.ndarray:
+    """(B,) accumulation signs of an ``__op__`` column (host side)."""
+    return np.asarray(_OP_SIGNS, np.int64)[np.asarray(ops, np.int64)]
+
+
+def is_retraction(ops) -> np.ndarray:
+    """(B,) bool — True for -U/-D rows (host side)."""
+    ops = np.asarray(ops, np.int64)
+    return (ops == OP_UPDATE_BEFORE) | (ops == OP_DELETE)
+
 
 @dataclasses.dataclass(frozen=True)
 class Schema:

@@ -396,7 +396,8 @@ class Driver:
                 t = n.window_transform
                 self._ops[n.id] = GlobalAggregateOperator(
                     t.aggregate, num_shards=num_shards,
-                    slots_per_shard=slots)
+                    slots_per_shard=slots,
+                    retract=getattr(t, "retract", False))
             elif n.kind == "session":
                 from flink_tpu.ops.session import SessionOperator
 
@@ -407,6 +408,7 @@ class Driver:
                     num_shards=num_shards, slots_per_shard=slots,
                     max_out_of_orderness_ms=max(wm.max_out_of_orderness_ms, 0),
                     host_pool=self.host_pool,
+                    retract=getattr(t, "retract", False),
                 )
             elif n.kind == "evicting_window":
                 from flink_tpu.ops.evicting_window import (
@@ -2347,8 +2349,10 @@ class Driver:
                         if np.asarray(v).dtype != object}
             op.process_batch(keys, ts, dev_data, valid)
             if n.kind in ("count_window", "process", "cep",
-                          "evicting_window", "global_agg"):
+                          "evicting_window", "global_agg", "session"):
                 # these emit per-step, not (only) per-watermark
+                # (session: retract-mode -U rows from merges that
+                # consumed an already-fired span)
                 fired = op.take_fired()
                 if fired is not None:
                     self._emit_fired(nid, fired)

@@ -13,10 +13,13 @@ lowers onto the device pane-state WindowOperator exactly like
 these Table operations; both APIs meet the runtime at one seam.
 
 Streaming semantics: a bare (non-windowed) GROUP BY over an unbounded
-stream would need retraction streams (continuous per-key updates);
-v1 requires a window for grouped aggregation and raises a clear error
-otherwise (ref: Flink's update/changelog tables, out of scope per
-SURVEY §8.5).
+stream produces a CHANGELOG — continuous per-key updates. It lowers
+onto the retract-mode running aggregation (ops/global_agg.py): each
+emission retracts the key's previous row (-U) and asserts the new one
+(+U), op-typed via records.OP_FIELD (ref: Flink's update/changelog
+tables, table-runtime GroupAggFunction). Materialize the result
+through a changelog-capable sink (RetractSink / UpsertSink) — the
+analyzer's CHANGELOG_SINK_MISMATCH rule enforces this.
 """
 from __future__ import annotations
 
@@ -327,11 +330,6 @@ class GroupedTable:
         ``pairs`` maps each call's runtime result field to its SELECT
         alias (two aliases may share a runtime field: duplicate
         aggregates are computed once and fanned out at projection)."""
-        if self.wdef is None:
-            raise ValueError(
-                "non-windowed GROUP BY over an unbounded stream needs "
-                "retraction semantics (not in v1) — add a window "
-                "(TUMBLE/HOP/SESSION TVF or .window(...))")
         if not aggs:
             raise ValueError("aggregate() needs at least one AggCall")
         uniq: Dict[Tuple[str, Optional[str]], AggCall] = {}
@@ -340,6 +338,19 @@ class GroupedTable:
         lanes = [a.build() for a in uniq.values()]
         lane = lanes[0] if len(lanes) == 1 else aggregates.multi(*lanes)
         stream = self.table.stream
+        if self.wdef is None:
+            # unwindowed GROUP BY → retract-mode running aggregation:
+            # a changelog stream of op-typed rows, one -U/+U pair per
+            # per-key update (the table-runtime GroupAggFunction shape)
+            if not self.keys:
+                raise ValueError(
+                    "non-windowed aggregation without GROUP BY (a single "
+                    "global running row) is not supported — group by a "
+                    "key column, or add a window for append output")
+            pairs = [(a.runtime_field, a.out_name) for a in aggs]
+            agg_stream = (stream.key_by(self.keys[0])
+                          .running_aggregate(lane, retract=True))
+            return agg_stream, pairs, self.keys[0]
         ta = self.wdef.time_attr
         schema = self.table.schema
         if ta != schema.time_attr:
@@ -363,8 +374,9 @@ class GroupedTable:
 
     def aggregate(self, *aggs: AggCall) -> Table:
         agg_stream, pairs, key_out = self._aggregate_stream(*aggs)
-        cols = (([key_out] if key_out else [])
-                + ["window_start", "window_end"])
+        cols = [key_out] if key_out else []
+        if self.wdef is not None:
+            cols += ["window_start", "window_end"]
         return finish_projection(
             self.table.t_env, agg_stream, pairs, key_out,
             cols + [name for _, name in pairs])
@@ -372,9 +384,14 @@ class GroupedTable:
 def finish_projection(t_env: TableEnvironment, agg_stream, pairs,
                       key_out: Optional[str],
                       want: Sequence[str]) -> Table:
-    """Shared output projection for windowed aggregations: rename the
-    runtime result fields (key/window_start/window_end/<agg lanes>) to
-    the SELECT aliases, emitting exactly ``want`` columns in order."""
+    """Shared output projection for aggregations: rename the runtime
+    result fields (key/window_start/window_end/<agg lanes>) to the
+    SELECT aliases, emitting exactly ``want`` columns in order — plus
+    the changelog op column when the input carries one (the op lane is
+    runtime metadata riding OUTSIDE the SELECT list; a projection that
+    dropped it would turn retractions back into inserts)."""
+    from flink_tpu.records import OP_FIELD
+
     def finish(data):
         out: Dict[str, np.ndarray] = {}
         for name in want:
@@ -385,6 +402,8 @@ def finish_projection(t_env: TableEnvironment, agg_stream, pairs,
         for rt, name in pairs:
             if name in want:
                 out[name] = data[rt]
+        if OP_FIELD in data:
+            out[OP_FIELD] = data[OP_FIELD]
         return out
 
     return Table(t_env, agg_stream.map(finish, name="sql_agg_project"),

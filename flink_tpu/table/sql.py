@@ -494,9 +494,7 @@ def _plan_join(t_env: "TableEnvironment", q: Query) -> "Table":
 
     src: JoinSource = q.source
     if q.group_by or any(it.agg for it in q.items):
-        raise SqlError(
-            "aggregation over a JOIN is not supported in v1 — join "
-            "first into a view, then aggregate")
+        return _plan_join_aggregate(t_env, q)
     if q.order_by is not None or q.limit is not None:
         raise SqlError("ORDER BY/LIMIT over a JOIN is not supported")
     if not isinstance(src.left, WindowTvf) or not isinstance(
@@ -614,15 +612,131 @@ def _plan_join(t_env: "TableEnvironment", q: Query) -> "Table":
     return table
 
 
+def _plan_join_aggregate(t_env: "TableEnvironment", q: Query) -> "Table":
+    """Aggregation over a window JOIN — ``SELECT k, AGG(x) FROM
+    TABLE(TUMBLE(a)) JOIN TABLE(TUMBLE(b)) ON ... GROUP BY k`` (the
+    Nexmark Q8-then-count shape). Plans as join → derived stream →
+    re-window → aggregate: the joined rows carry the pane they were
+    produced in as their stream timestamp (window_end - 1, the driver's
+    fired-row stamping), so re-assigning them with the SAME tumbling
+    spec lands every row back in its own pane — which is exactly why
+    only TUMBLE qualifies (a sliding assigner would fan each joined row
+    into ``size/slide`` windows, multi-counting it)."""
+    src: JoinSource = q.source
+    l, r = src.left, src.right
+    if not isinstance(l, WindowTvf) or not isinstance(r, WindowTvf):
+        raise SqlError(
+            "streaming JOIN requires a window TVF on BOTH sides "
+            "(an unbounded join has unbounded state); wrap each input "
+            "in TABLE(TUMBLE(...))")
+    if l.kind != "tumble" or r.kind != "tumble":
+        raise SqlError(
+            "aggregation over a JOIN supports TUMBLE windows only: "
+            "joined rows re-window by their pane timestamp, which only "
+            "tumbling panes make unambiguous (a HOP row belongs to "
+            "several windows)")
+    if q.order_by is not None or q.limit is not None:
+        raise SqlError(
+            "ORDER BY/LIMIT over a JOIN aggregation is not supported — "
+            "aggregate into a view first")
+    group_cols = [g for g in q.group_by
+                  if g not in ("window_start", "window_end")]
+    if len(group_cols) != 1:
+        raise SqlError(
+            "aggregation over a JOIN needs exactly one non-window "
+            f"grouping column; got {group_cols}")
+
+    # columns the derived (joined) stream must carry: the grouping
+    # column, every aggregate argument, and WHERE references — each
+    # projected to its UNQUALIFIED name
+    needed: dict = {}  # out name -> (possibly qualified) source ref
+
+    def need(ref: str, ctx: str) -> str:
+        base = ref.split(".", 1)[1] if "." in ref else ref
+        if base in ("window_start", "window_end"):
+            return base  # re-derived by the downstream window
+        prev = needed.get(base)
+        if prev is not None and prev != ref:
+            # an unqualified ref names the same column as its qualified
+            # twin (GROUP BY columns parse unqualified); only two
+            # DIFFERENT qualified refs are a genuine cross-side clash
+            if ref == base:
+                return base
+            if prev != base:
+                raise SqlError(
+                    f"column name {base!r} is needed from both join "
+                    f"sides ({prev} and {ref} in {ctx}) — alias one "
+                    "side's column")
+        needed[base] = ref
+        return base
+
+    items3: List[SelectItem] = []
+    for it in q.items:
+        if it.star:
+            raise SqlError("SELECT * cannot mix with aggregates")
+        if it.agg is not None:
+            fn, arg = it.agg
+            if arg is not None and not isinstance(arg, str):
+                raise SqlError(
+                    f"{fn.upper()}(<expression>) over a JOIN is not "
+                    "supported — aggregate arguments must be plain "
+                    "columns")
+            arg3 = need(arg, "SELECT") if arg is not None else None
+            items3.append(SelectItem(None, (fn, arg3), it.alias))
+        else:
+            if not isinstance(it.expr, Col):
+                raise SqlError(
+                    "non-aggregate SELECT items over a JOIN aggregation "
+                    f"must be plain columns, got {it.expr!r}")
+            items3.append(SelectItem(
+                Col(need(it.expr.name, "SELECT")), None, it.alias))
+    for g in group_cols:
+        need(g, "GROUP BY")
+    if q.where is not None:
+        for f in q.where.fields():
+            need(f, "WHERE")
+
+    # phase 1: the plain window join, projecting exactly the needed
+    # columns under their unqualified names (reuses the whole join
+    # validation/lowering path)
+    q2 = Query(
+        items=[SelectItem(Col(ref), None, out)
+               for out, ref in needed.items()],
+        source=src, where=q.where, group_by=[], having=None,
+        order_by=None, limit=None)
+    joined = _plan_join(t_env, q2)
+
+    # phase 2: re-key and re-window the derived stream with the same
+    # tumbling spec; the synthetic time attribute names the stream
+    # timestamp (joined rows are stamped window_end - 1 by the driver —
+    # no column carries it)
+    from flink_tpu.table.api import Table, TableSchema
+    tbl = Table(t_env, joined.stream,
+                TableSchema(joined.schema.columns, time_attr="__rowtime__"))
+    wdef = Tumble.over_ms(l.intervals[0]).on("__rowtime__")
+    q3 = Query(items=items3, source=l.table, where=None,
+               group_by=q.group_by, having=q.having, order_by=None,
+               limit=None)
+    return _plan_aggregate(q3, tbl, wdef)
+
+
 def _plan_running_aggregate(q: Query, table: "Table", group_cols,
                             calls, plain) -> "Table":
     """`SELECT k, agg FROM t GROUP BY k` with NO window TVF: the
-    canonical streaming-SQL shape emitting updates. Lowers onto
-    KeyedStream.running_aggregate (ops/global_agg.py) — an UPSERT
-    stream where each row replaces the previous result for its key
-    (ref: table-runtime GroupAggFunction; retract/changelog semantics
-    degenerate to upserts for insert-only input). Materialize with
-    ``UpsertSink(key_fields=...)``."""
+    canonical streaming-SQL shape emitting a CHANGELOG. Lowers onto the
+    retract-mode KeyedStream.running_aggregate (ops/global_agg.py): each
+    per-key update emits a -U retraction of the previous row and a +U
+    assertion of the new one, op-typed via records.OP_FIELD (ref:
+    table-runtime GroupAggFunction). Materialize with a
+    changelog-capable sink — ``RetractSink``/``UpsertSink`` — or window
+    the changelog downstream (the changelog_* lanes in ops/aggregates
+    fold retractions).
+
+    HAVING is a per-row filter over the op-typed rows, and the case
+    analysis is exactly why that is correct: a key UPDATING INTO the
+    predicate passes only its +U (an insert to the view); a key
+    updating OUT of it passes only its -U, which changelog-capable
+    sinks treat as the key's deletion."""
     from flink_tpu.ops import aggregates
     from flink_tpu.table.api import finish_projection
 
@@ -630,11 +744,6 @@ def _plan_running_aggregate(q: Query, table: "Table", group_cols,
         raise SqlError(
             "ORDER BY/LIMIT over an unwindowed aggregation would need "
             "a continuously re-ranked changelog; use a window TVF")
-    if q.having is not None:
-        raise SqlError(
-            "HAVING over an unwindowed aggregation needs DELETE "
-            "records (a key can leave the predicate); filter the "
-            "upsert view at the sink, or use a window TVF")
     if len(group_cols) != 1:
         raise SqlError(
             "an unwindowed aggregate needs exactly one grouping "
@@ -645,11 +754,18 @@ def _plan_running_aggregate(q: Query, table: "Table", group_cols,
     lanes = [c.build() for c in uniq.values()]
     lane = lanes[0] if len(lanes) == 1 else aggregates.multi(*lanes)
     key = group_cols[0]
-    agg_stream = table.stream.key_by(key).running_aggregate(lane)
+    agg_stream = table.stream.key_by(key).running_aggregate(
+        lane, retract=True)
     pairs = [(c.runtime_field, c.out_name) for c in calls]
     want = plain + [c.out_name for c in calls]
-    return finish_projection(table.t_env, agg_stream, pairs,
-                             key if key in plain else None, want)
+    result = finish_projection(table.t_env, agg_stream, pairs,
+                               key if key in plain else None, want)
+    if q.having is not None:
+        # row-level filter over the changelog (op column rides through
+        # the filter untouched): -U rows that leave the predicate while
+        # their +U partner stays inside become genuine deletions
+        result = result.filter(q.having)
+    return result
 
 
 def _plan_aggregate(q: Query, table: "Table",

@@ -88,6 +88,11 @@ def pytest_configure(config):
         "drain scheduling, autoscaler, per-job isolation, multi-tenant "
         "chaos, and the `session` CLI smoke (tier-1)")
     config.addinivalue_line(
+        "markers", "changelog: changelog/retraction plane (records."
+        "OP_FIELD) — op-typed retract streams, signed window lanes, "
+        "session -U/+U refires, RetractSink exactly-once under chaos, "
+        "and the lifted SQL shapes (agg-over-join, HAVING) (tier-1)")
+    config.addinivalue_line(
         "markers", "firegate: fire-gated dispatch + piggybacked "
         "readiness (pipeline.fire-gate / pipeline.readiness, PROFILE.md "
         "§12) — gate-on/off byte-identity at K∈{1,2,4}, the host-fed "

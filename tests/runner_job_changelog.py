@@ -1,0 +1,100 @@
+"""Deployable changelog-plane jobs for the CLI smoke (``python -m
+flink_tpu run --local``): the two SQL shapes ISSUE 20 lifted, as
+shippable ``--entry`` modules. Data is derived from fixed seeds so the
+test recomputes the reference independently of the engine (the
+committed-output diff)."""
+import numpy as np
+
+from flink_tpu.api.sinks import FileTransactionalSink, UpsertSink
+from flink_tpu.table.api import TableEnvironment
+
+N = 400
+NK = 6
+
+
+def left_events():
+    rng = np.random.default_rng(99)
+    k = rng.integers(0, NK, N).astype(np.int64)
+    ts = np.sort(rng.integers(0, 4000, N)).astype(np.int64)
+    return k, ts
+
+
+def right_events():
+    rng = np.random.default_rng(100)
+    k = rng.integers(0, NK, N).astype(np.int64)
+    w = rng.integers(1, 50, N).astype(np.int64)
+    ts2 = np.sort(rng.integers(0, 4000, N)).astype(np.int64)
+    return k, w, ts2
+
+
+def reference_join_agg():
+    """O(n^2) pair enumeration of the agg-over-join output — no engine
+    machinery involved."""
+    lk, lts = left_events()
+    rk, rw, rts = right_events()
+    out = {}
+    for i in range(N):
+        for j in range(N):
+            if lk[i] == rk[j] and lts[i] // 1000 == rts[j] // 1000:
+                key = (int(lk[i]), int(lts[i]) // 1000 * 1000)
+                c, s = out.get(key, (0, 0))
+                out[key] = (c + 1, s + int(rw[j]))
+    return out
+
+
+def build_join_agg(env):
+    """Agg-over-join: COUNT/SUM over a tumbling window JOIN, committed
+    through the transactional file sink."""
+    sink_dir = env.config.get_raw("test.sink-dir")
+    assert sink_dir, "test.sink-dir must be set"
+    lk, lts = left_events()
+    rk, rw, rts = right_events()
+    t_env = TableEnvironment.create(env)
+    left = env.from_collection({"k": lk, "ts": lts}, lts, batch_size=100)
+    right = env.from_collection({"k2": rk, "w": rw, "ts2": rts}, rts,
+                                batch_size=100)
+    t_env.create_temporary_view("L", left, ["k", "ts"])
+    t_env.create_temporary_view("R", right, ["k2", "w", "ts2"])
+    t = t_env.sql_query(
+        "SELECT L.k, window_start, COUNT(*) AS c, SUM(R.w) AS sw "
+        "FROM TABLE(TUMBLE(TABLE L, DESCRIPTOR(ts), INTERVAL '1' SECOND)) "
+        "JOIN TABLE(TUMBLE(TABLE R, DESCRIPTOR(ts2), INTERVAL '1' SECOND)) "
+        "ON L.k = R.k2 GROUP BY k, window_start")
+    t.stream.add_sink(FileTransactionalSink(sink_dir))
+
+
+def group_by_events():
+    rng = np.random.default_rng(101)
+    k = rng.integers(0, NK, N).astype(np.int64)
+    v = rng.integers(1, 50, N).astype(np.int64)
+    ts = np.arange(N, dtype=np.int64)
+    return k, v, ts
+
+
+def reference_group_by():
+    """Final per-key (count, sum) — a plain dict fold."""
+    k, v, _ = group_by_events()
+    out = {}
+    for kk, vv in zip(k, v):
+        c, s = out.get(int(kk), (0, 0))
+        out[int(kk)] = (c + 1, s + int(vv))
+    return out
+
+
+# module-level so the --local smoke can read the materialized table
+# back after cli_main returns (the run executes in-process)
+group_by_sink = UpsertSink(key_fields=("k",))
+
+
+def build_group_by(env):
+    """Unwindowed GROUP BY: the retract-mode changelog materialized
+    into an upsert view."""
+    group_by_sink.state.clear()
+    k, v, ts = group_by_events()
+    t_env = TableEnvironment.create(env)
+    stream = env.from_collection({"k": k, "v": v}, ts, batch_size=100)
+    t_env.create_temporary_view(
+        "t", stream, schema=["k", "v", "ts"], time_attr="ts")
+    tbl = t_env.sql_query(
+        "SELECT k, COUNT(*) AS c, SUM(v) AS sv FROM t GROUP BY k")
+    tbl.stream.add_sink(group_by_sink)

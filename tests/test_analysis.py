@@ -379,6 +379,53 @@ def _state_bytes_over_budget(tmp_path):
     return env.analyze()
 
 
+@seed("CHANGELOG_SINK_MISMATCH", node_name="collect")
+def _changelog_into_write_through_sink(tmp_path):
+    # op-typed retract rows (-U/+U) into a blind-append sink: every
+    # retraction materializes as a duplicate record instead of a
+    # deletion — the changelog contract needs an op-aware sink
+    env = make_env()
+    (env.from_source(GeneratorSource(gen), WM())
+        .key_by("word")
+        .running_aggregate(count(), retract=True)
+        .collect())
+    return env.analyze()
+
+
+class TestChangelogSinkMismatchNegatives:
+    """CHANGELOG_SINK_MISMATCH fires ONLY on op-typed rows meeting a
+    changelog-blind sink: each changelog-capable sink, and the
+    insert-only (non-retract) aggregate, keep it quiet (seeded
+    violation in SEEDS above)."""
+
+    def _hits(self, sink=None, retract=True):
+        env = make_env()
+        stream = (env.from_source(GeneratorSource(gen), WM())
+                  .key_by("word")
+                  .running_aggregate(count(), retract=retract))
+        if sink is None:
+            stream.collect()
+        else:
+            stream.add_sink(sink)
+        return [f for f in env.analyze()
+                if f.rule == "CHANGELOG_SINK_MISMATCH"]
+
+    def test_retract_sink_is_clean(self):
+        from flink_tpu.api.sinks import RetractSink
+
+        assert self._hits(RetractSink(key_fields=("key",))) == []
+
+    def test_upsert_sink_is_clean(self):
+        from flink_tpu.api.sinks import UpsertSink
+
+        assert self._hits(UpsertSink(key_fields=("key",))) == []
+
+    def test_insert_only_aggregate_into_collect_is_clean(self):
+        # upsert-shaped rows without the op lane: CollectSink sees
+        # plain rows, nothing to mismatch
+        assert self._hits(sink=None, retract=False) == []
+
+
 class TestSessionHaUnsafeNegatives:
     """SESSION_HA_UNSAFE fires ONLY on the stranding shape: session
     intent + checkpointing + no HA dir. Each leg missing keeps it

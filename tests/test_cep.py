@@ -184,29 +184,41 @@ def scalar_oracle(stages, within, events):
     """Per-key scalar engine over EXPANDED stages: the independent
     reference the vectorized rank-step engine is checked against.
     events: list of (key, ts, {field: value}) in arrival order.
-    Returns list of (key, match_start, match_end)."""
+    Returns list of (key, match_start, match_end). Covers negation:
+    mid-pattern not_followed_by/not_next kills, and a trailing
+    not_followed_by fires its absence matches both in-stream (an event
+    past the deadline) and at end-of-stream (the final-watermark
+    flush), with match_end = match_start + within."""
     S = len(stages)
-    st = {}
     out = []
     by_key = {}
+    trail_neg = stages[-1].negated
     for k, t, d in events:
         by_key.setdefault(k, []).append((t, d))
     for k, evs in by_key.items():
         evs.sort(key=lambda e: e[0])
-        cur, ts0, cnt = 0, None, 0
+        cur, cnt = 0, 0
         stage_ts = [None] * S
         for t, d in evs:
             def hit(i):
                 return bool(stages[i].where(
                     {f: np.asarray([v]) for f, v in d.items()})[0])
 
+            # trailing absence completes BEFORE the expiry reset (the
+            # same age condition) — mirrors the engine's ordering
+            if trail_neg and cur == S - 1 and t - stage_ts[0] > within:
+                out.append((k, stage_ts[0], stage_ts[0] + within))
+                cur, cnt = 0, 0
             if within is not None and cur > 0 and \
                     t - stage_ts[0] > within:
                 cur, cnt = 0, 0
-            lp = stages[min(cur, S - 1)].loop and cur < S
-            op_ = stages[min(cur, S - 1)].optional and cur < S
+            sc = min(cur, S - 1)
+            lp = stages[sc].loop and cur < S
+            op_ = stages[sc].optional and cur < S
+            ng = stages[sc].negated and cur < S
+            ng_strict = ng and stages[sc].strict
             in_loop = lp and cnt > 0
-            h = hit(min(cur, S - 1)) if cur < S else False
+            h = hit(sc) if cur < S else False
             hn = hit(cur + 1) if cur + 1 < S else False
             if lp and h:                       # A: loop enter/continue
                 if cnt == 0:
@@ -219,10 +231,24 @@ def scalar_oracle(stages, within, events):
                 stage_ts[cur] = -1
                 stage_ts[cur + 1] = t
                 cur += 2
-            elif not lp and h:                 # D: plain advance
+            elif ng and h and (ng_strict or not hn):  # N: kill
+                if hit(0):                     # killer re-tests stage 0
+                    stage_ts[0] = t
+                    cur = 1
+                else:
+                    cur = 0
+            elif ng and hn:                    # N: pass over (+2)
+                stage_ts[sc] = -1
+                stage_ts[cur + 1] = t
+                cur += 2
+            elif ng_strict and not hn:         # N: not_next spent (+1)
+                stage_ts[sc] = -1
+                cur += 1
+            elif not lp and not ng and h:      # D: plain advance
                 stage_ts[cur] = t
                 cur += 1
-            elif not h and stages[min(cur, S - 1)].strict and cur > 0:
+            elif not h and not ng and \
+                    stages[sc].strict and cur > 0:
                 if hit(0):                     # E: strict restart
                     stage_ts[0] = t
                     cur = 1
@@ -231,6 +257,8 @@ def scalar_oracle(stages, within, events):
             if cur >= S:
                 out.append((k, stage_ts[0], t))
                 cur, cnt = 0, 0
+        if trail_neg and cur == S - 1:         # end-of-stream flush
+            out.append((k, stage_ts[0], stage_ts[0] + within))
     return sorted(out)
 
 
@@ -567,3 +595,325 @@ class TestNoSkipOverflowAtomicity:
             map(int, want["match_start"]))
         assert sorted(map(int, got["match_end"])) == sorted(
             map(int, want["match_end"]))
+
+
+# ---------------------------------------------------------------------------
+# Negation: not_next / not_followed_by, trailing absence windows —
+# property-tested against the extended scalar oracle above.
+# ---------------------------------------------------------------------------
+
+def run_op_neg(pattern, events):
+    """run_op + the end-of-input watermark flush that fires pending
+    trailing-absence matches (what the driver does at final)."""
+    op = CepOperator(pattern, num_shards=8, slots_per_shard=64)
+    feed_events(op, events)
+    rows = []
+    f = op.take_fired()
+    if f is not None:
+        rows.append(dict(f))
+    d2 = dict(op.advance_watermark(op.final_watermark()))
+    if len(d2["__ts__"]):
+        rows.append(d2)
+    out = []
+    for d in rows:
+        out += zip(map(int, d["key"]), map(int, d["match_start"]),
+                   map(int, d["match_end"]))
+    return sorted(out), op
+
+
+class TestNegation:
+    def test_not_followed_by_mid_pattern(self):
+        p = (Pattern.begin("a").where(lambda d: d["v"] == 1)
+             .not_followed_by("b").where(lambda d: d["v"] == 2)
+             .followed_by("c").where(lambda d: d["v"] == 3))
+        op = CepOperator(p, num_shards=4, slots_per_shard=16)
+        # key 1: a, noise, c -> match; key 2: a, b, c -> killed
+        feed_events(op, [(1, 10, {"v": 1}), (1, 20, {"v": 9}),
+                         (1, 30, {"v": 3}),
+                         (2, 10, {"v": 1}), (2, 20, {"v": 2}),
+                         (2, 30, {"v": 3})])
+        d = dict(op.take_fired())
+        assert list(map(int, d["key"])) == [1]
+        assert list(map(int, d["b_ts"])) == [-1]
+        assert list(map(int, d["c_ts"])) == [30]
+
+    def test_event_matching_both_counts_as_next_stage(self):
+        # v==3 matches BOTH the forbidden (>=3) and the following
+        # (==3) predicate: no forbidden event occurred strictly
+        # between — the match completes
+        p = (Pattern.begin("a").where(lambda d: d["v"] == 1)
+             .not_followed_by("b").where(lambda d: d["v"] >= 3)
+             .followed_by("c").where(lambda d: d["v"] == 3))
+        got, _ = run_op_neg(p, [(1, 10, {"v": 1}), (1, 20, {"v": 3})])
+        assert got == [(1, 10, 20)]
+
+    def test_not_next_kills_on_adjacent_only(self):
+        p = (Pattern.begin("a").where(lambda d: d["v"] == 1)
+             .not_next("b").where(lambda d: d["v"] == 2)
+             .followed_by("c").where(lambda d: d["v"] == 3))
+        # key 1: forbidden event immediately next -> dead
+        # key 2: benign event next, then c -> match
+        # key 3: c itself is the next event (passes not_next AND c)
+        got, _ = run_op_neg(p, [
+            (1, 10, {"v": 1}), (1, 20, {"v": 2}), (1, 30, {"v": 3}),
+            (2, 10, {"v": 1}), (2, 20, {"v": 9}), (2, 30, {"v": 3}),
+            (3, 10, {"v": 1}), (3, 20, {"v": 3})])
+        assert got == [(2, 10, 30), (3, 10, 20)]
+
+    @staticmethod
+    def _absence_pattern():
+        return (Pattern.begin("a").where(lambda d: d["v"] == 1)
+                .followed_by("b").where(lambda d: d["v"] == 2)
+                .not_followed_by("c").where(lambda d: d["v"] == 3)
+                .within(100))
+
+    def test_trailing_absence_fires_on_watermark(self):
+        op = CepOperator(self._absence_pattern(), num_shards=4,
+                         slots_per_shard=16)
+        # key 1: forbidden c inside the window -> killed
+        # key 2: nothing after b -> fires when wm passes start+within
+        feed_events(op, [(1, 10, {"v": 1}), (1, 20, {"v": 2}),
+                         (1, 50, {"v": 3}),
+                         (2, 10, {"v": 1}), (2, 20, {"v": 2})])
+        assert op.take_fired() is None
+        assert len(dict(op.advance_watermark(105))["__ts__"]) == 0
+        d = dict(op.advance_watermark(110))
+        assert sorted(zip(map(int, d["key"]), map(int, d["match_start"]),
+                          map(int, d["match_end"]))) == [(2, 10, 110)]
+        assert list(map(int, d["c_ts"])) == [-1]
+        # idempotent: the partial was consumed
+        assert len(dict(op.advance_watermark(500))["__ts__"]) == 0
+
+    def test_trailing_absence_in_stream_completion(self):
+        op = CepOperator(self._absence_pattern(), num_shards=4,
+                         slots_per_shard=16)
+        feed_events(op, [(7, 10, {"v": 1}), (7, 20, {"v": 2})])
+        assert op.take_fired() is None
+        # a later event of the SAME key past the deadline proves the
+        # absence without any watermark movement
+        feed_events(op, [(7, 300, {"v": 9})])
+        d = dict(op.take_fired())
+        assert list(zip(map(int, d["key"]),
+                        map(int, d["match_end"]))) == [(7, 110)]
+
+    def test_snapshot_restore_pending_absence(self):
+        def mk():
+            return CepOperator(self._absence_pattern(), num_shards=4,
+                               slots_per_shard=16)
+
+        a = mk()
+        feed_events(a, [(1, 10, {"v": 1}), (1, 20, {"v": 2})])
+        b = mk()
+        b.restore_state(a.snapshot_state())
+        d = dict(b.advance_watermark(200))
+        assert list(map(int, d["match_start"])) == [10]
+        assert list(map(int, d["match_end"])) == [110]
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5])
+    def test_property_vs_scalar_oracle(self, seed):
+        """Random keyed streams over negated patterns: the vectorized
+        engine (including the end-of-input absence flush) must agree
+        with the scalar oracle exactly."""
+        rng = np.random.default_rng(100 + seed)
+        variant = seed % 3
+        if variant == 0:
+            p = (Pattern.begin("a").where(lambda d: d["v"] < 3)
+                 .not_followed_by("nb").where(lambda d: (d["v"] >= 3)
+                                              & (d["v"] < 5))
+                 .followed_by("c").where(lambda d: d["v"] >= 7)
+                 .within(80))
+        elif variant == 1:
+            p = (Pattern.begin("a").where(lambda d: d["v"] < 3)
+                 .not_next("nn").where(lambda d: (d["v"] == 5)
+                                       | (d["v"] == 6))
+                 .followed_by("c").where(lambda d: d["v"] >= 7)
+                 .within(60))
+        else:
+            p = (Pattern.begin("a").where(lambda d: d["v"] < 3)
+                 .followed_by("b").where(lambda d: d["v"] >= 7)
+                 .not_followed_by("nc").where(lambda d: (d["v"] >= 3)
+                                              & (d["v"] < 5))
+                 .within(50))
+        n = 400
+        events = [(int(k), int(t), {"v": int(v)})
+                  for k, t, v in zip(rng.integers(0, 12, n),
+                                     np.sort(rng.integers(0, 3000, n)),
+                                     rng.integers(0, 10, n))]
+        seen = set()
+        events = [e for e in events
+                  if (e[0], e[1]) not in seen
+                  and not seen.add((e[0], e[1]))]
+        got, _ = run_op_neg(p, events)
+        want = scalar_oracle(p.stages, p.within_ms, events)
+        assert got == want, f"seed={seed} variant={variant}"
+
+    @pytest.mark.parametrize("build,msg", [
+        (lambda: (Pattern.begin("a").where(lambda d: d["v"] > 0)
+                  .not_followed_by("b").where(lambda d: d["v"] < 0)
+                  ).stages, "needs within"),
+        (lambda: (Pattern.begin("a").where(lambda d: d["v"] > 0)
+                  .not_next("b").where(lambda d: d["v"] < 0)).stages,
+         "trailing not_next"),
+        (lambda: (Pattern.begin("a").where(lambda d: d["v"] > 0)
+                  .not_followed_by("b").where(lambda d: d["v"] < 0)
+                  .next("c").where(lambda d: d["v"] == 0)).stages,
+         "followed_by"),
+        (lambda: (Pattern.begin("a").where(lambda d: d["v"] > 0)
+                  .not_followed_by("b").where(lambda d: d["v"] < 0)
+                  .not_followed_by("c").where(lambda d: d["v"] == 0)
+                  .within(10)).stages, "adjacent negated"),
+        (lambda: (Pattern.begin("a").where(lambda d: d["v"] > 0)
+                  .not_followed_by("b").where(lambda d: d["v"] < 0)
+                  .times(2)), "cannot be quantified"),
+        (lambda: (Pattern.begin("a").where(lambda d: d["v"] > 0)
+                  .one_or_more()
+                  .not_followed_by("b").where(lambda d: d["v"] < 0)
+                  .followed_by("c").where(lambda d: d["v"] == 0)
+                  ).stages, "quantified stage"),
+    ])
+    def test_invalid_negation_shapes_raise(self, build, msg):
+        with pytest.raises(ValueError, match=msg):
+            build()
+
+    def test_negation_refused_on_multi_partial_engine(self):
+        p = (Pattern.begin("a").where(lambda d: d["v"] == 0)
+             .not_followed_by("x").where(lambda d: d["v"] == 5)
+             .followed_by("b").where(lambda d: d["v"] == 1)
+             .after_match("NO_SKIP"))
+        with pytest.raises(NotImplementedError, match="negated"):
+            CepOperator(p, num_shards=4, slots_per_shard=64)
+
+
+class TestSkipToStrategies:
+    """after_match('SKIP_TO_FIRST'/'SKIP_TO_LAST', stage): each match
+    prunes partials starting before the first/last event it mapped to
+    the referenced stage (ref: AfterMatchSkipStrategy.skipToFirst/
+    skipToLast), on the bounded multi-partial engine."""
+
+    @staticmethod
+    def _base():
+        return (Pattern.begin("a").where(lambda d: d["v"] == 0)
+                .followed_by("b").where(lambda d: d["v"] == 1))
+
+    @staticmethod
+    def _run(pattern, keys, ts, fields):
+        op = CepOperator(pattern, num_shards=4, slots_per_shard=64)
+        op.process_batch(np.asarray(keys, np.int64),
+                         np.asarray(ts, np.int64), fields)
+        f = op.take_fired()
+        if f is None:
+            return []
+        d = dict(f)
+        return sorted(zip([int(x) for x in d["key"]],
+                          [int(x) for x in d["match_start"]],
+                          [int(x) for x in d["match_end"]]))
+
+    @staticmethod
+    def _oracle(stages, keys, ts, fields, within=None, ref=None):
+        """TestNoSkip._oracle + skip-to pruning: completions on an
+        event resolve ascending match_start; each emitted match sets
+        the cut to its referenced stage's ts (monotone — a surviving
+        later match starts at/after the previous cut) and partials
+        starting before the final cut are pruned."""
+        from collections import defaultdict
+        parts = defaultdict(list)
+        out = []
+        order = np.lexsort((ts, keys))
+        for i in order:
+            k, t = int(keys[i]), int(ts[i])
+            ev = {f: v[i] for f, v in fields.items()}
+            hits = [bool(np.asarray(st.where(
+                {f: np.asarray([v]) for f, v in ev.items()}))[0])
+                for st in stages]
+            nxt, done = [], []
+            for stage_i, tss in parts[k]:
+                if within is not None and t - tss[0] > within:
+                    continue
+                if hits[stage_i]:
+                    tss = tss + [t]
+                    if stage_i + 1 == len(stages):
+                        done.append(tss)
+                        continue
+                    nxt.append([stage_i + 1, tss])
+                elif stages[stage_i].strict:
+                    continue
+                else:
+                    nxt.append([stage_i, tss])
+            if ref is None:
+                for tss in done:
+                    out.append((k, tss[0], t))
+            else:
+                cut = None
+                for tss in sorted(done, key=lambda x: x[0]):
+                    if cut is not None and tss[0] < cut:
+                        continue
+                    out.append((k, tss[0], t))
+                    cut = tss[ref]
+                if cut is not None:
+                    nxt = [pp for pp in nxt if pp[1][0] >= cut]
+            if hits[0]:
+                if len(stages) == 1:
+                    out.append((k, t, t))
+                else:
+                    nxt.append([1, [t]])
+            parts[k] = nxt
+        return sorted(out)
+
+    def test_skip_to_first_prunes_earlier_starts(self):
+        # a@10 a@20 b@30: NO_SKIP emits both; SKIP_TO_FIRST('b') emits
+        # the earliest, whose b-event ts (30) prunes the other partial
+        fields = {"v": np.array([0, 0, 1])}
+        got = self._run(self._base().after_match("SKIP_TO_FIRST", "b"),
+                        [1, 1, 1], [10, 20, 30], fields)
+        assert got == [(1, 10, 30)]
+        # anchored to 'a' instead: the cut is the match's own start, so
+        # the second partial (started later) survives and also emits
+        got = self._run(self._base().after_match("SKIP_TO_FIRST", "a"),
+                        [1, 1, 1], [10, 20, 30], fields)
+        assert got == [(1, 10, 30), (1, 20, 30)]
+
+    def test_skip_to_last_resolves_times_expansion(self):
+        p = (Pattern.begin("a").where(lambda d: d["v"] == 0)
+             .followed_by("b").where(lambda d: d["v"] == 1).times(2)
+             .after_match("SKIP_TO_LAST", "b"))
+        op = CepOperator(p, num_shards=4, slots_per_shard=64)
+        assert [s.name for s in op.stages] == ["a", "b_1", "b_2"]
+        assert op._skip_ref == 2   # b_2 — the LAST expansion
+        p_first = (Pattern.begin("a").where(lambda d: d["v"] == 0)
+                   .followed_by("b").where(lambda d: d["v"] == 1)
+                   .times(2).after_match("SKIP_TO_FIRST", "b"))
+        assert CepOperator(p_first, num_shards=4,
+                           slots_per_shard=64)._skip_ref == 1
+
+    @pytest.mark.parametrize("seed,mode,ref_name", [
+        (0, "SKIP_TO_FIRST", "b"), (1, "SKIP_TO_LAST", "b"),
+        (2, "SKIP_TO_FIRST", "c"), (3, "SKIP_TO_LAST", "a"),
+    ])
+    def test_property_vs_oracle(self, seed, mode, ref_name):
+        rng = np.random.default_rng(200 + seed)
+        p = (Pattern.begin("a").where(lambda d: d["v"] % 3 == 0)
+             .followed_by("b").where(lambda d: d["v"] % 3 == 1)
+             .followed_by("c").where(lambda d: d["v"] % 3 == 2)
+             .within(40).after_match(mode, ref_name))
+        keys = rng.integers(0, 5, 200)
+        ts = np.sort(rng.integers(0, 400, 200))
+        v = rng.integers(0, 9, 200)
+        got = self._run(p, keys, ts, {"v": v})
+        op = CepOperator(p, num_shards=4, slots_per_shard=64)
+        want = self._oracle(p.stages, keys, ts, {"v": v}, within=40,
+                            ref=op._skip_ref)
+        assert got == want, f"seed={seed} mode={mode} ref={ref_name}"
+        assert len(got) > 0
+
+    def test_unknown_stage_refused(self):
+        with pytest.raises(ValueError, match="no stage named"):
+            CepOperator(self._base().after_match("SKIP_TO_FIRST", "zz"),
+                        num_shards=4, slots_per_shard=64)
+
+    def test_mode_argument_validation(self):
+        with pytest.raises(ValueError, match="needs the stage name"):
+            self._base().after_match("SKIP_TO_FIRST")
+        with pytest.raises(ValueError, match="takes no stage name"):
+            self._base().after_match("NO_SKIP", "b")
+        with pytest.raises(ValueError, match="supported modes"):
+            self._base().after_match("SKIP_TO_NEXT")

@@ -1,0 +1,458 @@
+"""The changelog/retraction plane end-to-end (ISSUE 20): op-typed rows
+(records.OP_FIELD) emitted by retract-mode unwindowed aggregation and
+session refires, folded by changelog-capable sinks, consumed by the
+signed window lanes, and planned by the lifted SQL shapes (agg-over-join,
+HAVING over an unwindowed aggregate).
+
+The exactly-once half rides the chaos layer: a fault on
+``changelog.retract.emit`` kills the job between a -U and its +U, and
+run_with_recovery + RetractSink must still converge to the fault-free
+table (the TwoPhaseCommit epoch discipline over retractions)."""
+import contextlib
+import sys
+
+import numpy as np
+import pytest
+
+from flink_tpu import faults
+from flink_tpu.api.environment import StreamExecutionEnvironment
+from flink_tpu.api.sinks import FnSink, RetractSink, UpsertSink, rows_of
+from flink_tpu.api.sources import GeneratorSource
+from flink_tpu.api.windowing import TumblingEventTimeWindows
+from flink_tpu.config import Configuration
+from flink_tpu.ops import aggregates
+from flink_tpu.ops.session import SessionOperator
+from flink_tpu.records import (
+    OP_DELETE,
+    OP_FIELD,
+    OP_INSERT,
+    OP_UPDATE_AFTER,
+    OP_UPDATE_BEFORE,
+)
+from flink_tpu.runtime.supervisor import run_with_recovery
+from flink_tpu.table.api import TableEnvironment
+from flink_tpu.time.watermarks import WatermarkStrategy
+
+pytestmark = pytest.mark.changelog
+
+
+def _env(extra=None):
+    return StreamExecutionEnvironment(Configuration({
+        "state.num-key-shards": 8, "state.slots-per-shard": 64,
+        "pipeline.microbatch-size": 100, **(extra or {})}))
+
+
+def _data(n=600, nk=8, seed=11):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, nk, n).astype(np.int64),
+            rng.random(n).astype(np.float32),
+            np.arange(n, dtype=np.int64))
+
+
+def _oracle(k, v):
+    out = {}
+    for kk, vv in zip(k, v):
+        c, s = out.get(int(kk), (0, 0.0))
+        out[int(kk)] = (c + 1, s + float(vv))
+    return out
+
+
+class TestOpTypedStream:
+    """The raw changelog contract: every batch carries the op column,
+    -U rows precede their +I/+U replacement, and folding the stream IN
+    ORDER through a keyed table lands on the true finals."""
+
+    def test_retract_stream_folds_to_oracle(self):
+        env = _env()
+        k, v, ts = _data()
+        batches = []
+        (env.from_collection({"k": k, "v": v}, ts, batch_size=100)
+            .key_by("k")
+            .running_aggregate(aggregates.multi(
+                aggregates.count(), aggregates.sum_of("v")), retract=True)
+            .add_sink(FnSink(batches.append)))
+        env.execute("op-stream")
+
+        table = {}
+        seen_ops = set()
+        for b in batches:
+            assert OP_FIELD in b, "retract stream must carry the op lane"
+            for row in rows_of(b):
+                op = int(row[OP_FIELD])
+                seen_ops.add(op)
+                kk = int(row["key"])
+                cur = (int(row["count"]), float(row["sum_v"]))
+                if op == OP_UPDATE_BEFORE:
+                    # a -U retracts EXACTLY the row that stands
+                    prev = table.pop(kk)
+                    assert prev[0] == cur[0]
+                    assert prev[1] == pytest.approx(cur[1], rel=1e-3)
+                elif op == OP_INSERT:
+                    assert kk not in table  # first row for this key
+                    table[kk] = cur
+                elif op == OP_UPDATE_AFTER:
+                    # its -U arrived earlier in the same ordered stream
+                    assert kk not in table
+                    table[kk] = cur
+                else:
+                    raise AssertionError(f"unexpected op {op}")
+        assert {OP_INSERT, OP_UPDATE_BEFORE, OP_UPDATE_AFTER} <= seen_ops
+        want = _oracle(k, v)
+        assert set(table) == set(want)
+        for kk in want:
+            assert table[kk][0] == want[kk][0]
+            assert table[kk][1] == pytest.approx(want[kk][1], rel=1e-3)
+
+
+class TestChangelogWindowLanes:
+    """Windowed aggregation OVER a changelog input: the signed lanes
+    subtract -U/-D contributions instead of double-counting them."""
+
+    def _stream(self, seed=7, n=400, nk=6):
+        rng = np.random.default_rng(seed)
+        k = rng.integers(0, nk, n).astype(np.int64)
+        v = rng.random(n).astype(np.float32)
+        # insert-biased op mix with genuine retractions in every window
+        ops = rng.choice(
+            np.array([OP_INSERT, OP_INSERT, OP_UPDATE_AFTER,
+                      OP_UPDATE_BEFORE, OP_DELETE], np.int8), n)
+        ts = np.sort(rng.integers(0, 2000, n)).astype(np.int64)
+        return k, v, ops, ts
+
+    def test_signed_lanes_match_oracle(self):
+        k, v, ops, ts = self._stream()
+        env = _env()
+        rows = []
+        (env.from_collection({"key": k, "v": v, OP_FIELD: ops}, ts,
+                             batch_size=100)
+            .key_by("key")
+            .window(TumblingEventTimeWindows.of(500))
+            .aggregate(aggregates.multi(
+                aggregates.changelog_count("net"),
+                aggregates.changelog_sum_of("v"),
+                aggregates.changelog_avg_of("v")))
+            .add_sink(FnSink(rows.append)))
+        env.execute("changelog-windows")
+
+        sign = np.where((ops == OP_UPDATE_BEFORE) | (ops == OP_DELETE),
+                        -1.0, 1.0)
+        want = {}
+        for i in range(len(k)):
+            key = (int(k[i]), int(ts[i]) // 500 * 500)
+            c, s = want.get(key, (0.0, 0.0))
+            want[key] = (c + sign[i], s + sign[i] * float(v[i]))
+
+        got = {}
+        for b in rows:
+            for r in rows_of(b):
+                got[(int(r["key"]), int(r["window_start"]))] = (
+                    int(r["net"]), float(r["sum_v"]), float(r["avg_v"]))
+        assert set(got) == set(want)
+        for key, (c, s) in want.items():
+            assert got[key][0] == int(round(c))
+            assert got[key][1] == pytest.approx(s, abs=1e-3)
+            # engine clamps the signed divisor at 1 (net-empty panes)
+            assert got[key][2] == pytest.approx(
+                s / max(round(c), 1.0), abs=1e-3)
+
+    def test_order_sensitive_lanes_refuse_changelog(self):
+        with pytest.raises(NotImplementedError, match="MAX"):
+            aggregates.changelog_max_of("v")
+        with pytest.raises(NotImplementedError, match="MIN"):
+            aggregates.changelog_min_of("v")
+
+
+class TestSessionRetractRefire:
+    """A late event bridging into an already-fired session retracts the
+    stale pane (-U with the OLD accumulators) before the merged session
+    refires as +U — the session half of the changelog plane."""
+
+    def test_merge_emits_minus_u_then_plus_u(self):
+        op = SessionOperator(10, aggregates.sum_of("v"),
+                             allowed_lateness_ms=1000, retract=True)
+        op.process_batch(np.array([7, 7], np.int64),
+                         np.array([0, 5], np.int64),
+                         {"v": np.array([1.0, 2.0], np.float32)})
+        assert op.take_fired() is None  # no merge yet → no retraction
+
+        f1 = dict(op.advance_watermark(16))
+        assert [int(x) for x in f1[OP_FIELD]] == [OP_INSERT]
+        assert float(f1["sum_v"][0]) == pytest.approx(3.0)
+        assert (int(f1["window_start"][0]), int(f1["window_end"][0])) \
+            == (0, 15)
+
+        # late-but-allowed event extends the fired span
+        op.process_batch(np.array([7], np.int64), np.array([12], np.int64),
+                         {"v": np.array([4.0], np.float32)})
+        r = dict(op.take_fired())
+        assert [int(x) for x in r[OP_FIELD]] == [OP_UPDATE_BEFORE]
+        assert float(r["sum_v"][0]) == pytest.approx(3.0)  # the OLD row
+        assert (int(r["window_start"][0]), int(r["window_end"][0])) \
+            == (0, 15)
+
+        f2 = dict(op.advance_watermark(40))
+        assert [int(x) for x in f2[OP_FIELD]] == [OP_UPDATE_AFTER]
+        assert float(f2["sum_v"][0]) == pytest.approx(7.0)
+        assert (int(f2["window_start"][0]), int(f2["window_end"][0])) \
+            == (0, 22)
+
+
+# ---------------------------------------------------------------------------
+# Exactly-once: RetractSink under a mid-retraction crash.
+# ---------------------------------------------------------------------------
+
+CHAOS_SEED = 4321
+N_BATCHES, BATCH, NKEYS = 8, 64, 8
+
+
+def _chaos_source():
+    def gen(split, i):
+        if i >= N_BATCHES:
+            return None
+        rng = np.random.default_rng(7000 + i)
+        return ({"k": rng.integers(0, NKEYS, BATCH).astype(np.int64),
+                 "v": rng.random(BATCH).astype(np.float32)},
+                (i * BATCH + np.arange(BATCH)).astype(np.int64))
+    return gen
+
+
+def _chaos_oracle():
+    ks, vs = [], []
+    for i in range(N_BATCHES):
+        rng = np.random.default_rng(7000 + i)
+        ks.append(rng.integers(0, NKEYS, BATCH).astype(np.int64))
+        vs.append(rng.random(BATCH).astype(np.float32))
+    return _oracle(np.concatenate(ks), np.concatenate(vs))
+
+
+@contextlib.contextmanager
+def _replayable(plan):
+    try:
+        yield
+    except BaseException:
+        print(f"\nCHAOS REPLAY: seed={plan.seed} spec={plan.spec!r} "
+              f"log={plan.log}", file=sys.stderr)
+        raise
+
+
+def _retract_job(conf, sink):
+    env = StreamExecutionEnvironment(conf)
+    (env.from_source(GeneratorSource(_chaos_source()),
+                     WatermarkStrategy.for_monotonous_timestamps())
+        .key_by("k")
+        .running_aggregate(aggregates.multi(
+            aggregates.count(), aggregates.sum_of("v")), retract=True)
+        .add_sink(sink))
+    return env
+
+
+def _check_view(sink):
+    want = _chaos_oracle()
+    got = {int(r["key"]): (int(r["count"]), float(r["sum_v"]))
+           for r in sink.view()}
+    assert set(got) == set(want)
+    for kk in want:
+        assert got[kk][0] == want[kk][0], kk
+        assert got[kk][1] == pytest.approx(want[kk][1], rel=1e-3)
+
+
+@pytest.mark.chaos
+class TestRetractSinkExactlyOnce:
+    def _conf(self, tmp_path, extra=None):
+        c = {
+            "state.num-key-shards": 8, "state.slots-per-shard": 64,
+            "pipeline.microbatch-size": BATCH,
+            "execution.checkpointing.dir": str(tmp_path / "ckpt"),
+            "execution.checkpointing.interval": 1,
+            "restart-strategy.type": "fixed-delay",
+            "restart-strategy.fixed-delay.attempts": 20,
+            "restart-strategy.fixed-delay.delay": 1,
+        }
+        c.update(extra or {})
+        return Configuration(c)
+
+    def test_fault_free_materialization(self, tmp_path):
+        sink = RetractSink(key_fields=("key",))
+        env = _retract_job(self._conf(tmp_path), sink)
+        env.execute("retract-golden")
+        _check_view(sink)
+
+    def test_crash_on_retract_emit_converges(self, tmp_path):
+        """KNOWN_FAULT_POINTS['changelog.retract.emit'] fires mid-epoch:
+        the -U batch dies before reaching a committed epoch, the job
+        restarts from the last checkpoint, and the committed table must
+        equal the fault-free golden — no half-applied retraction."""
+        sink = RetractSink(key_fields=("key",))  # survives the restarts
+        plan = faults.FaultPlan(seed=CHAOS_SEED).rule(
+            "changelog.retract.emit", "raise", count=1, after=2)
+
+        def build_env(conf):
+            return _retract_job(conf, sink)
+
+        with plan.activate(), _replayable(plan):
+            run_with_recovery(build_env, self._conf(tmp_path),
+                              job_name="retract-chaos")
+        assert any(p == "changelog.retract.emit" for p, _, _ in plan.log), \
+            "fault point never fired — the schedule tests nothing"
+        _check_view(sink)
+
+
+# ---------------------------------------------------------------------------
+# SQL goldens over the lifted shapes.
+# ---------------------------------------------------------------------------
+
+class TestSqlChangelogShapes:
+    def test_unwindowed_group_by_sql_equals_datastream(self):
+        k, v, ts = _data(seed=23)
+
+        env = _env()
+        t_env = TableEnvironment.create(env)
+        stream = env.from_collection({"k": k, "v": v}, ts, batch_size=100)
+        t_env.create_temporary_view(
+            "t", stream, schema=["k", "v", "ts"], time_attr="ts")
+        tbl = t_env.sql_query(
+            "SELECT k, COUNT(*) AS c, SUM(v) AS sv FROM t GROUP BY k")
+        sql_sink = UpsertSink(key_fields=("k",))
+        tbl.stream.add_sink(sql_sink)
+        env.execute("sql-running")
+
+        env2 = _env()
+        ds_sink = UpsertSink(key_fields=("key",))
+        (env2.from_collection({"k": k, "v": v}, ts, batch_size=100)
+             .key_by("k")
+             .running_aggregate(aggregates.multi(
+                 aggregates.count(), aggregates.sum_of("v")), retract=True)
+             .add_sink(ds_sink))
+        env2.execute("ds-running")
+
+        got_sql = {int(r["k"]): (int(r["c"]), float(r["sv"]))
+                   for r in sql_sink.view()}
+        got_ds = {int(r["key"]): (int(r["count"]), float(r["sum_v"]))
+                  for r in ds_sink.view()}
+        assert set(got_sql) == set(got_ds) == set(_oracle(k, v))
+        for kk in got_sql:
+            assert got_sql[kk][0] == got_ds[kk][0]
+            assert got_sql[kk][1] == pytest.approx(got_ds[kk][1], rel=1e-5)
+
+    def test_having_over_unwindowed_agg(self):
+        """HAVING over the changelog (the lifted refusal): the retract
+        filter keeps only rows passing the predicate, so the
+        materialized table equals the filtered finals — identically
+        through RetractSink and UpsertSink."""
+        k, v, ts = _data(seed=31)
+        views = []
+        for sink in (RetractSink(key_fields=("k",)),
+                     UpsertSink(key_fields=("k",))):
+            env = _env()
+            t_env = TableEnvironment.create(env)
+            stream = env.from_collection(
+                {"k": k, "v": v}, ts, batch_size=100)
+            t_env.create_temporary_view(
+                "t", stream, schema=["k", "v", "ts"], time_attr="ts")
+            tbl = t_env.sql_query(
+                "SELECT k, COUNT(*) AS c FROM t GROUP BY k HAVING c > 50")
+            tbl.stream.add_sink(sink)
+            env.execute("sql-having")
+            views.append({int(r["k"]): int(r["c"]) for r in sink.view()})
+        want = {kk: c for kk, (c, _) in _oracle(k, v).items() if c > 50}
+        assert want  # predicate must actually bite
+        assert views[0] == views[1] == want
+
+    def test_agg_over_join_sql_vs_oracle(self):
+        """The second lifted refusal: COUNT/SUM over a tumbling window
+        JOIN (Nexmark Q8-then-count), golden against the O(n^2) pair
+        enumeration."""
+        rng = np.random.default_rng(5)
+        n = 300
+        ts_p = np.sort(rng.integers(0, 6000, n)).astype(np.int64)
+        persons = {"person": rng.integers(0, 8, n).astype(np.int64),
+                   "ts": ts_p}
+        ts_a = np.sort(rng.integers(0, 6000, n)).astype(np.int64)
+        auctions = {"seller": rng.integers(0, 8, n).astype(np.int64),
+                    "reserve": rng.integers(1, 100, n).astype(np.int64),
+                    "ts2": ts_a}
+
+        env = _env()
+        t_env = TableEnvironment.create(env)
+        p = env.from_collection(persons, ts_p, batch_size=100)
+        a = env.from_collection(auctions, ts_a, batch_size=100)
+        t_env.create_temporary_view("P", p, ["person", "ts"])
+        t_env.create_temporary_view("A", a, ["seller", "reserve", "ts2"])
+        t = t_env.sql_query(
+            "SELECT P.person, window_start, COUNT(*) AS c, "
+            "SUM(A.reserve) AS sr "
+            "FROM TABLE(TUMBLE(TABLE P, DESCRIPTOR(ts), "
+            "INTERVAL '1' SECOND)) "
+            "JOIN TABLE(TUMBLE(TABLE A, DESCRIPTOR(ts2), "
+            "INTERVAL '1' SECOND)) "
+            "ON P.person = A.seller "
+            "GROUP BY person, window_start")
+        rows = t.execute("sql-join-agg").collect()
+
+        want = {}
+        for i in range(n):
+            for j in range(n):
+                if (persons["person"][i] == auctions["seller"][j]
+                        and ts_p[i] // 1000 == ts_a[j] // 1000):
+                    key = (int(persons["person"][i]),
+                           int(ts_p[i]) // 1000 * 1000)
+                    c, s = want.get(key, (0, 0))
+                    want[key] = (c + 1, s + int(auctions["reserve"][j]))
+
+        got = {(int(r["person"]), int(r["window_start"])):
+               (int(r["c"]), int(round(float(r["sr"])))) for r in rows}
+        assert len(got) > 0
+        assert got == want
+
+
+class TestCliSmoke:
+    """`python -m flink_tpu run --local` over the two lifted SQL shapes
+    (tests/runner_job_changelog.py), committed output diffed against a
+    reference the test computes without the engine."""
+
+    def _cli(self, capsys, *argv):
+        import json
+
+        from flink_tpu.cli import main as cli_main
+        rc = cli_main(list(argv))
+        out = capsys.readouterr().out.strip().splitlines()
+        return rc, json.loads(out[-1]) if out else {}
+
+    def test_agg_over_join_entry(self, tmp_path, capsys):
+        import runner_job_changelog as jobs
+
+        from flink_tpu.api.sinks import FileTransactionalSink
+
+        sink_dir = str(tmp_path / "sink")
+        rc, out = self._cli(
+            capsys, "run", "--local",
+            "--entry", "runner_job_changelog:build_join_agg",
+            "--job-id", "cl-join",
+            "--conf", f"test.sink-dir={sink_dir}",
+            "--conf", "state.num-key-shards=4",
+            "--conf", "state.slots-per-shard=32",
+            "--conf", "pipeline.microbatch-size=100")
+        assert rc == 0
+        assert out["state"] == "FINISHED"
+        got = {}
+        for r in FileTransactionalSink.committed_rows(sink_dir):
+            key = (int(r["k"]), int(r["window_start"]))
+            assert key not in got  # exactly-once committed output
+            got[key] = (int(r["c"]), int(round(float(r["sw"]))))
+        assert got == jobs.reference_join_agg()
+
+    def test_unwindowed_group_by_entry(self, tmp_path, capsys):
+        import runner_job_changelog as jobs
+
+        rc, out = self._cli(
+            capsys, "run", "--local",
+            "--entry", "runner_job_changelog:build_group_by",
+            "--job-id", "cl-upsert",
+            "--conf", "state.num-key-shards=4",
+            "--conf", "state.slots-per-shard=32",
+            "--conf", "pipeline.microbatch-size=100")
+        assert rc == 0
+        assert out["state"] == "FINISHED"
+        got = {int(r["k"]): (int(r["c"]), int(round(float(r["sv"]))))
+               for r in jobs.group_by_sink.view()}
+        assert got == jobs.reference_group_by()

@@ -90,9 +90,11 @@ class TestSqlUnwindowed:
         stream = env.from_collection({"k": k, "v": v}, ts, batch_size=100)
         t_env.create_temporary_view(
             "t", stream, schema=["k", "v", "ts"], time_attr="ts")
-        with pytest.raises(SqlError, match="HAVING"):
-            t_env.sql_query(
-                "SELECT k, COUNT(*) AS c FROM t GROUP BY k HAVING c > 2")
+        # HAVING over an unwindowed aggregate now plans (changelog
+        # filter over the op-typed rows) — only the re-ranking shape
+        # still refuses
+        t_env.sql_query(
+            "SELECT k, COUNT(*) AS c FROM t GROUP BY k HAVING c > 2")
         with pytest.raises(SqlError, match="ORDER BY"):
             t_env.sql_query(
                 "SELECT k, COUNT(*) AS c FROM t GROUP BY k "
